@@ -1,0 +1,21 @@
+"""rafiki-tpu: a TPU-native AutoML train-and-serve framework.
+
+A ground-up rebuild of the capabilities of Rafiki (``ZhaoxuanWu/rafiki``,
+"Rafiki: Machine Learning as an Analytics Service System", VLDB 2018) on a
+JAX/XLA/Pallas substrate: model templates are JAX modules compiled with
+``jit``/``pjit``; trials are processes pinned to ICI-contiguous TPU
+sub-meshes instead of one-GPU Docker containers; serving uses continuous
+batching with bucketed static shapes on TPU. See SURVEY.md for the
+structural map of the reference this tracks.
+"""
+
+__version__ = "0.1.0"
+
+from .constants import (BudgetOption, InferenceJobStatus, ServiceStatus,
+                        ServiceType, TaskType, TrainJobStatus, TrialStatus,
+                        UserType)
+
+__all__ = [
+    "BudgetOption", "InferenceJobStatus", "ServiceStatus", "ServiceType",
+    "TaskType", "TrainJobStatus", "TrialStatus", "UserType", "__version__",
+]

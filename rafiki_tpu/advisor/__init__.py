@@ -1,0 +1,30 @@
+"""Hyperparameter-search advisors (random / Bayesian-GP / BOHB).
+
+See SURVEY.md §2 "Advisor service" and §3.4 for the propose/feedback
+protocol this package implements.
+"""
+
+from .base import (ADVISOR_REGISTRY, BaseAdvisor, Proposal, TrialResult,
+                   make_advisor)
+from .random_search import RandomAdvisor
+
+ADVISOR_REGISTRY["random"] = RandomAdvisor
+
+try:  # Bayesian-GP needs scikit-learn; register if available
+    from .bayes_gp import BayesOptAdvisor
+
+    ADVISOR_REGISTRY["bayes_gp"] = BayesOptAdvisor
+except ImportError:  # pragma: no cover
+    pass
+
+try:
+    from .hyperband import BOHBAdvisor
+
+    ADVISOR_REGISTRY["bohb"] = BOHBAdvisor
+except ImportError:  # pragma: no cover
+    pass
+
+__all__ = [
+    "ADVISOR_REGISTRY", "BaseAdvisor", "Proposal", "TrialResult",
+    "make_advisor", "RandomAdvisor",
+]

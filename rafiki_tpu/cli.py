@@ -1,0 +1,79 @@
+"""``rafiki-tpu`` command-line entry point.
+
+Replaces the reference's ``scripts/start.sh``/``stop.sh`` + per-service
+Docker entrypoints (SURVEY.md §2 "Deployment") with one multi-command CLI.
+Service subcommands are registered as their layers land.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="rafiki-tpu",
+        description="TPU-native AutoML train-and-serve framework")
+    sub = parser.add_subparsers(dest="cmd")
+
+    sub.add_parser("version", help="print version")
+
+    p_tune = sub.add_parser(
+        "tune", help="local tuning loop over a zoo template (dev use)")
+    p_tune.add_argument("template", help="zoo template name, e.g. JaxFeedForward")
+    p_tune.add_argument("train_dataset")
+    p_tune.add_argument("val_dataset")
+    p_tune.add_argument("--trials", type=int, default=5)
+    p_tune.add_argument("--advisor", default="auto")
+
+    _register_service_commands(sub)
+
+    args = parser.parse_args(argv)
+    if args.cmd is None:
+        parser.print_help()
+        return 2
+    if args.cmd == "version":
+        from . import __version__
+
+        print(__version__)
+        return 0
+    if args.cmd == "tune":
+        from .model import tune_model
+        from .models import get_model_template
+
+        result = tune_model(get_model_template(args.template),
+                            args.train_dataset, args.val_dataset,
+                            total_trials=args.trials,
+                            advisor_type=args.advisor)
+        print(f"best_score={result.best_score:.4f} "
+              f"best_knobs={result.best_knobs}")
+        return 0
+    return _run_service_command(args)
+
+
+def _register_service_commands(sub: argparse._SubParsersAction) -> None:
+    p = sub.add_parser("stack", help="manage the full local service stack")
+    p.add_argument("action", choices=["start", "stop", "status"])
+    p.add_argument("--workdir", default="./rafiki_stack")
+    p.add_argument("--port", type=int, default=3000,
+                   help="admin REST port")
+    p.add_argument("--workers", type=int, default=1)
+
+
+def _run_service_command(args: argparse.Namespace) -> int:
+    if args.cmd == "stack":
+        try:
+            from .admin.stack import stack_command
+        except ImportError:
+            print("the service stack is not available in this build",
+                  file=sys.stderr)
+            return 2
+        return stack_command(args)
+    print(f"unknown command {args.cmd!r}", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

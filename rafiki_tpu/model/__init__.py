@@ -1,0 +1,22 @@
+"""Model-template plugin layer: contract, knobs, logging, dev harness.
+
+This is the system's central interface (SURVEY.md §2 "Model contract").
+"""
+
+from .base import (BaseModel, Params, TrainContext, load_model_class,
+                   serialize_model_class)
+from .dev import test_model_class, tune_model, TuneResult
+from .knob import (BaseKnob, CategoricalKnob, FixedKnob, FloatKnob,
+                   IntegerKnob, KnobConfig, Knobs, PolicyKnob,
+                   knob_config_from_json, knob_config_to_json, sample_knobs,
+                   shape_signature, tunable_knobs, validate_knobs)
+from .log import LogRecord, ModelLogger
+
+__all__ = [
+    "BaseModel", "Params", "TrainContext", "load_model_class",
+    "serialize_model_class", "test_model_class", "tune_model", "TuneResult",
+    "BaseKnob", "CategoricalKnob", "FixedKnob", "FloatKnob", "IntegerKnob",
+    "KnobConfig", "Knobs", "PolicyKnob", "knob_config_from_json",
+    "knob_config_to_json", "sample_knobs", "shape_signature", "tunable_knobs",
+    "validate_knobs", "LogRecord", "ModelLogger",
+]

@@ -1,0 +1,168 @@
+"""The model-template plugin contract — the system's central interface.
+
+Parity target: the reference's ``BaseModel`` (SURVEY.md §2 "Model contract"):
+``get_knob_config() / train / evaluate / predict / dump_parameters /
+load_parameters`` plus the dev-time conformance harness. Every template in
+the zoo implements this; the train worker, inference worker, predictor and
+advisor all speak only this interface.
+
+TPU-first deltas from the reference:
+- Parameters are **JAX pytrees** (dicts of numpy/jax arrays), not opaque
+  byte blobs; serialization to bytes lives in the ParamStore layer
+  (flax.serialization msgpack), keeping models pure.
+- ``train`` receives an optional :class:`TrainContext` carrying the trial's
+  device sub-mesh, budget scale (for BOHB rungs), and a metric logger —
+  instead of the reference's implicit globals.
+- Model classes travel between services as *source code + class name*
+  (see :func:`serialize_model_class` / :func:`load_model_class`), not
+  pickles: safer, diffable, and survives process/interpreter boundaries.
+"""
+
+from __future__ import annotations
+
+import abc
+import hashlib
+import importlib.util
+import sys
+import tempfile
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Type
+
+from ..constants import TaskType
+from .knob import KnobConfig, Knobs, validate_knobs
+from .log import ModelLogger
+
+Params = Dict[str, Any]  # a JAX pytree of arrays (or None)
+
+
+@dataclass
+class TrainContext:
+    """Everything the system injects into a trial's ``train`` call."""
+
+    #: devices this trial owns (a contiguous ICI sub-mesh); None = all local
+    devices: Optional[List[Any]] = None
+    #: fraction of the full training budget to spend (BOHB rung scaling)
+    budget_scale: float = 1.0
+    #: warm-start parameters from the ParamStore (SHARE_PARAMS policy)
+    shared_params: Optional[Params] = None
+    #: per-trial structured metric logger
+    logger: ModelLogger = field(default_factory=ModelLogger)
+    #: trial identity, for checkpoints/log correlation
+    trial_id: Optional[str] = None
+    #: hook the worker uses to let BOHB pause/stop a trial between epochs;
+    #: called with (epoch, score) -> True to continue, False to stop early
+    should_continue: Optional[Any] = None
+
+
+class BaseModel(abc.ABC):
+    """Contract every model template implements.
+
+    Lifecycle driven by the train worker (SURVEY.md §3.1):
+    ``Model(**knobs)`` → ``train(dataset, ctx)`` → ``evaluate(dataset)`` →
+    ``dump_parameters()`` → (ParamStore) — and by the inference worker:
+    ``Model(**best_knobs)`` → ``load_parameters(params)`` →
+    ``predict(queries)``.
+    """
+
+    #: tasks this template can serve; checked by Admin at model registration
+    TASKS: Sequence[str] = (TaskType.IMAGE_CLASSIFICATION,)
+
+    def __init__(self, **knobs: Any) -> None:
+        self.knobs: Knobs = dict(knobs)
+
+    # ---- search space ----
+    @staticmethod
+    @abc.abstractmethod
+    def get_knob_config() -> KnobConfig:
+        """Declare the hyperparameter search space."""
+
+    # ---- training-side ----
+    @abc.abstractmethod
+    def train(self, dataset_path: str,
+              ctx: Optional[TrainContext] = None) -> None:
+        """Train on the dataset at ``dataset_path`` (format is task-specific)."""
+
+    @abc.abstractmethod
+    def evaluate(self, dataset_path: str) -> float:
+        """Return a scalar score (higher is better) on a held-out dataset."""
+
+    # ---- serving-side ----
+    @abc.abstractmethod
+    def predict(self, queries: Sequence[Any]) -> List[Any]:
+        """Predict a batch of queries. For classification tasks, return a
+        list of class-probability vectors (lists of float) so the Predictor
+        can ensemble across models by probability averaging."""
+
+    # ---- checkpointing ----
+    @abc.abstractmethod
+    def dump_parameters(self) -> Params:
+        """Return trained parameters as a JAX pytree (numpy-convertible)."""
+
+    @abc.abstractmethod
+    def load_parameters(self, params: Params) -> None:
+        """Restore parameters produced by :meth:`dump_parameters`."""
+
+    # ---- optional hooks ----
+    def destroy(self) -> None:
+        """Release device memory/resources. Default: no-op."""
+
+    @classmethod
+    def validate_knobs(cls, knobs: Knobs) -> None:
+        validate_knobs(cls.get_knob_config(), knobs)
+
+
+# ---------------------------------------------------------------------------
+# Model class transport: source + class name (replaces reference's pickling)
+# ---------------------------------------------------------------------------
+
+def serialize_model_class(model_class: Type[BaseModel]) -> bytes:
+    """Capture a model class as the UTF-8 source of its defining module."""
+    import inspect
+
+    src = inspect.getsource(sys.modules[model_class.__module__])
+    return src.encode("utf-8")
+
+
+_MODULE_DIR: Optional[Path] = None
+
+
+def _module_dir() -> Path:
+    global _MODULE_DIR
+    if _MODULE_DIR is None:
+        _MODULE_DIR = Path(tempfile.mkdtemp(prefix="rafiki_tpu_models_"))
+    return _MODULE_DIR
+
+
+def load_model_class(model_bytes: bytes, class_name: str,
+                     module_hint: str = "rafiki_model") -> Type[BaseModel]:
+    """Materialize a model class from serialized module source.
+
+    The module is written to a temp file and imported under a
+    content-hashed name so repeated loads of the same bytes share a module
+    and different models never collide.
+    """
+    digest = hashlib.sha256(model_bytes).hexdigest()[:16]
+    mod_name = f"_rafiki_tpu_model_{module_hint}_{digest}"
+    if mod_name in sys.modules:
+        mod = sys.modules[mod_name]
+    else:
+        # per-process private dir: avoids races/symlink games in a shared /tmp
+        tmpdir = _module_dir()
+        path = tmpdir / f"{mod_name}.py"
+        path.write_bytes(model_bytes)
+        spec = importlib.util.spec_from_file_location(mod_name, path)
+        assert spec is not None and spec.loader is not None
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[mod_name] = mod
+        try:
+            spec.loader.exec_module(mod)
+        except Exception:
+            del sys.modules[mod_name]
+            raise
+    clazz = getattr(mod, class_name, None)
+    if clazz is None or not (isinstance(clazz, type)
+                             and issubclass(clazz, BaseModel)):
+        raise ValueError(
+            f"{class_name!r} is not a BaseModel subclass in uploaded module")
+    return clazz

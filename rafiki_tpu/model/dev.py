@@ -1,0 +1,152 @@
+"""Model-developer harness: contract conformance + local tuning loop.
+
+Parity target: the reference's ``test_model_class()`` and ``tune_model()``
+dev utilities (SURVEY.md §3.5, §4) — the de-facto unit test every template
+runs in its ``__main__`` block: construct with knobs → train → evaluate →
+dump → load → predict round-trip, all in-process with no cluster.
+"""
+
+from __future__ import annotations
+
+import random
+import warnings
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Type
+
+import numpy as np
+
+from .base import BaseModel, Params, TrainContext, serialize_model_class, \
+    load_model_class
+from .knob import Knobs, sample_knobs, validate_knobs
+from .log import ModelLogger
+
+
+@dataclass
+class TrialSummary:
+    knobs: Knobs
+    score: float
+    logger: ModelLogger
+    params: Optional[Params] = None
+
+
+@dataclass
+class TuneResult:
+    best_knobs: Knobs
+    best_score: float
+    best_params: Params
+    trials: List[TrialSummary] = field(default_factory=list)
+
+
+def test_model_class(model_class: Type[BaseModel], task: str,
+                     train_dataset_path: str, val_dataset_path: str,
+                     queries: Sequence[Any], knobs: Optional[Knobs] = None,
+                     seed: int = 0) -> List[Any]:
+    """Run one full lifecycle through ``model_class`` and assert the contract.
+
+    Returns the predictions on ``queries`` so callers can eyeball them.
+    Raises AssertionError/ValueError on any contract violation.
+    """
+    assert issubclass(model_class, BaseModel), \
+        "model class must subclass rafiki_tpu BaseModel"
+    assert task in model_class.TASKS, \
+        f"model does not declare task {task!r} (declares {model_class.TASKS})"
+
+    knob_config = model_class.get_knob_config()
+    if knobs is None:
+        knobs = sample_knobs(knob_config, random.Random(seed))
+    validate_knobs(knob_config, knobs)
+
+    # transport round-trip: the class must survive source serialization
+    clazz = load_model_class(serialize_model_class(model_class),
+                             model_class.__name__)
+
+    model = clazz(**knobs)
+    ctx = TrainContext(logger=ModelLogger())
+    model.train(train_dataset_path, ctx)
+    score = model.evaluate(val_dataset_path)
+    assert isinstance(score, float), \
+        f"evaluate() must return float, got {type(score)}"
+
+    params = model.dump_parameters()
+    assert params is not None, "dump_parameters() returned None"
+    params = _round_trip_numpy(params)
+
+    model2 = clazz(**knobs)
+    model2.load_parameters(params)
+    score2 = model2.evaluate(val_dataset_path)
+    assert abs(score - score2) < 1e-3, (
+        f"dump/load round-trip changed eval score: {score} -> {score2}")
+
+    predictions = model2.predict(list(queries))
+    assert len(predictions) == len(queries), \
+        "predict() must return one prediction per query"
+    model.destroy()
+    model2.destroy()
+    return predictions
+
+
+test_model_class.__test__ = False  # it's a dev harness, not a pytest case
+
+
+def tune_model(model_class: Type[BaseModel], train_dataset_path: str,
+               val_dataset_path: str, total_trials: int = 10,
+               advisor_type: str = "auto", seed: int = 0,
+               keep_params: bool = True) -> TuneResult:
+    """Local single-process tuning loop (reference ``tune_model``): run the
+    advisor's propose/feedback cycle in-process and return the best trial."""
+    from ..advisor import make_advisor, TrialResult
+
+    knob_config = model_class.get_knob_config()
+    advisor = make_advisor(knob_config, advisor_type,
+                           total_trials=total_trials, seed=seed)
+
+    trials: List[TrialSummary] = []
+    params_by_trial: Dict[str, Params] = {}
+
+    while True:
+        proposal = advisor.propose()
+        if not proposal.is_valid:
+            break
+        logger = ModelLogger()
+        model = model_class(**proposal.knobs)
+        shared = params_by_trial.get(proposal.warm_start_trial_id)
+        ctx = TrainContext(logger=logger, budget_scale=proposal.budget_scale,
+                           shared_params=shared,
+                           trial_id=f"local-{proposal.trial_no}")
+        try:
+            model.train(train_dataset_path, ctx)
+            score = model.evaluate(val_dataset_path)
+        except Exception as e:
+            # reference semantics: an errored trial is dropped and the
+            # budget moves on (SURVEY.md §5.3)
+            warnings.warn(f"trial {proposal.trial_no} errored: {e!r}")
+            advisor.trial_errored(proposal.trial_no)
+            model.destroy()
+            continue
+        params = _round_trip_numpy(model.dump_parameters())
+        trial_id = f"local-{proposal.trial_no}"
+        if keep_params:
+            params_by_trial[trial_id] = params
+        advisor.feedback(TrialResult(
+            trial_no=proposal.trial_no, knobs=proposal.knobs, score=score,
+            trial_id=trial_id, budget_scale=proposal.budget_scale,
+            meta=proposal.meta))
+        trials.append(TrialSummary(knobs=proposal.knobs, score=score,
+                                   logger=logger,
+                                   params=params if keep_params else None))
+        model.destroy()
+
+    if advisor.best is None:
+        raise RuntimeError("no successful full-budget trial")
+    best = advisor.best
+    return TuneResult(best_knobs=best.knobs, best_score=best.score,
+                      best_params=params_by_trial.get(best.trial_id, {}),
+                      trials=trials)
+
+
+def _round_trip_numpy(params: Params) -> Params:
+    """Force params through host numpy, as the ParamStore would."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if hasattr(x, "shape") else x, params)

@@ -1,0 +1,77 @@
+"""Per-trial structured metric logging.
+
+Parity target: the reference's model ``logger`` / ``utils.logger`` whose
+records land in the DB and render as loss/accuracy curves (SURVEY.md §5.1).
+A :class:`ModelLogger` buffers records in-process; the train worker attaches
+a sink that forwards them to the MetaStore, and the dev harness just reads
+the buffer back.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclass
+class LogRecord:
+    time: float
+    kind: str          # "message" | "values" | "plot_def"
+    data: Dict[str, Any]
+
+
+@dataclass
+class ModelLogger:
+    """Collects messages, metric values, and plot definitions for one trial."""
+
+    records: List[LogRecord] = field(default_factory=list)
+    sink: Optional[Callable[[LogRecord], None]] = None
+
+    def _emit(self, kind: str, data: Dict[str, Any]) -> None:
+        rec = LogRecord(time=time.time(), kind=kind, data=data)
+        self.records.append(rec)
+        if self.sink is not None:
+            self.sink(rec)
+
+    def log(self, message: str = "", **values: Any) -> None:
+        """Log a free-form message and/or named metric values
+        (e.g. ``logger.log(epoch=3, loss=0.12, acc=0.95)``)."""
+        if message:
+            self._emit("message", {"message": message})
+        if values:
+            self._emit("values", {k: _to_plain(v) for k, v in values.items()})
+
+    def log_loss(self, loss: float, epoch: Optional[int] = None) -> None:
+        values: Dict[str, Any] = {"loss": _to_plain(loss)}
+        if epoch is not None:
+            values["epoch"] = epoch
+        self._emit("values", values)
+
+    def define_plot(self, title: str, metrics: List[str],
+                    x_axis: str = "epoch") -> None:
+        """Declare a plot over logged metric names (rendered by the UI)."""
+        self._emit("plot_def",
+                   {"title": title, "metrics": metrics, "x_axis": x_axis})
+
+    # ---- read-back helpers (dev harness / tests) ----
+    def get_values(self, name: str) -> List[Any]:
+        return [r.data[name] for r in self.records
+                if r.kind == "values" and name in r.data]
+
+    def get_messages(self) -> List[str]:
+        return [r.data["message"] for r in self.records if r.kind == "message"]
+
+
+def _to_plain(v: Any) -> Any:
+    """Coerce jax/numpy scalars to plain Python for JSON/SQLite transport."""
+    try:
+        import numpy as np
+
+        if isinstance(v, np.generic):
+            return v.item()
+        if hasattr(v, "item") and getattr(v, "ndim", None) == 0:
+            return v.item()
+    except Exception:
+        pass
+    return v

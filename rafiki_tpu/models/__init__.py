@@ -1,0 +1,48 @@
+"""Model zoo: task templates implementing the BaseModel contract.
+
+Import cost matters here (workers import only the template they run), so
+this module exposes lazy accessors instead of importing every template.
+"""
+
+from typing import Dict, Type
+
+from ..model.base import BaseModel
+
+_ZOO = {
+    "JaxFeedForward": ("rafiki_tpu.models.mlp", "JaxFeedForward"),
+    "JaxCNN": ("rafiki_tpu.models.cnn", "JaxCNN"),
+    "ResNet50": ("rafiki_tpu.models.resnet", "ResNet50"),
+    "ViTBase16": ("rafiki_tpu.models.vit", "ViTBase16"),
+    "BertClassifier": ("rafiki_tpu.models.bert", "BertClassifier"),
+    "LlamaLoRA": ("rafiki_tpu.models.llama_lora", "LlamaLoRA"),
+    "BigramHMM": ("rafiki_tpu.models.pos_tagging", "BigramHMM"),
+    "BiLSTMTagger": ("rafiki_tpu.models.pos_tagging", "BiLSTMTagger"),
+    "SklearnDecisionTree": ("rafiki_tpu.models.sklearn_models",
+                            "SklearnDecisionTree"),
+}
+
+
+def get_model_template(name: str) -> Type[BaseModel]:
+    import importlib
+
+    if name not in _ZOO:
+        raise KeyError(f"unknown template {name!r}; known: {sorted(_ZOO)}")
+    mod_name, cls_name = _ZOO[name]
+    try:
+        mod = importlib.import_module(mod_name)
+    except ModuleNotFoundError as e:
+        raise KeyError(
+            f"template {name!r} is not available in this build "
+            f"({mod_name} missing)") from e
+    return getattr(mod, cls_name)
+
+
+def list_model_templates() -> Dict[str, str]:
+    """Importable templates only (roadmap entries are silently skipped)."""
+    import importlib.util
+
+    out = {}
+    for name, (mod, cls) in _ZOO.items():
+        if importlib.util.find_spec(mod) is not None:
+            out[name] = f"{mod}.{cls}"
+    return out

@@ -1,0 +1,209 @@
+"""JaxFeedForward — the ``TfFeedForward``-equivalent template (config #1).
+
+Parity target: the reference zoo's ``TfFeedForward`` FashionMNIST template
+(SURVEY.md §2 "Model zoo", §6 config 1): a small dense net for image
+classification with knobs over depth/width/lr/batch size. Rebuilt as a
+flax.linen module with a fully ``jax.jit``-compiled train step (donated
+optimizer state, static batch shapes) so the same code path runs CPU or a
+TPU sub-mesh unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+# NOTE: zoo templates use absolute imports — their module source is shipped
+# to workers via serialize_model_class() and re-imported standalone, where
+# relative imports have no parent package.
+from rafiki_tpu.constants import TaskType
+from rafiki_tpu.data import batch_iterator, \
+    load_image_classification_dataset
+from rafiki_tpu.model import (BaseModel, CategoricalKnob, FixedKnob,
+                              FloatKnob, IntegerKnob, KnobConfig, PolicyKnob,
+                              TrainContext)
+
+
+def _same_tree_shapes(a: Any, b: Any) -> bool:
+    """True iff two pytrees share structure and leaf shapes (warm-start is
+    only valid across trials with identical architectures)."""
+    ta = jax.tree_util.tree_structure(a)
+    tb = jax.tree_util.tree_structure(b)
+    if ta != tb:
+        return False
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return all(getattr(x, "shape", None) == getattr(y, "shape", None)
+               for x, y in zip(la, lb))
+
+
+class _MLP(nn.Module):
+    hidden_layer_count: int
+    hidden_layer_units: int
+    n_classes: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x.reshape((x.shape[0], -1))
+        for _ in range(self.hidden_layer_count):
+            x = nn.Dense(self.hidden_layer_units)(x)
+            x = nn.relu(x)
+        return nn.Dense(self.n_classes)(x)
+
+
+class JaxFeedForward(BaseModel):
+    """Dense image classifier (FashionMNIST-class workloads)."""
+
+    TASKS = (TaskType.IMAGE_CLASSIFICATION,)
+
+    @staticmethod
+    def get_knob_config() -> KnobConfig:
+        return {
+            "max_epochs": FixedKnob(5),
+            "hidden_layer_count": IntegerKnob(1, 3, shape_relevant=True),
+            "hidden_layer_units": IntegerKnob(16, 256, is_exp=True,
+                                              shape_relevant=True),
+            "learning_rate": FloatKnob(1e-4, 1e-1, is_exp=True),
+            "batch_size": CategoricalKnob([32, 64, 128],
+                                          shape_relevant=True),
+            "quick_train": PolicyKnob("QUICK_TRAIN"),
+            "share_params": PolicyKnob("SHARE_PARAMS"),
+        }
+
+    def __init__(self, **knobs: Any) -> None:
+        super().__init__(**knobs)
+        self._params: Optional[Any] = None
+        self._n_classes: Optional[int] = None
+        self._image_shape: Optional[Sequence[int]] = None
+
+    # ---- internals ----
+    def _module(self) -> _MLP:
+        assert self._n_classes is not None
+        return _MLP(hidden_layer_count=int(self.knobs["hidden_layer_count"]),
+                    hidden_layer_units=int(self.knobs["hidden_layer_units"]),
+                    n_classes=self._n_classes)
+
+    @staticmethod
+    def _to_float(images: np.ndarray) -> np.ndarray:
+        return images.astype(np.float32) / 255.0
+
+    # ---- contract ----
+    def train(self, dataset_path: str,
+              ctx: Optional[TrainContext] = None) -> None:
+        ctx = ctx or TrainContext()
+        ds = load_image_classification_dataset(dataset_path)
+        self._n_classes = ds.n_classes
+        self._image_shape = ds.image_shape
+        x = self._to_float(ds.images)
+        y = ds.labels
+
+        module = self._module()
+        rng = jax.random.PRNGKey(0)
+        batch_size = int(self.knobs["batch_size"])
+        if self._params is None:  # may be warm-started via load_parameters
+            params = module.init(rng, jnp.zeros((1, *x.shape[1:])))["params"]
+        else:
+            params = self._params
+        if ctx.shared_params is not None and self.knobs.get("share_params"):
+            shared = ctx.shared_params.get("params")
+            if shared is not None and _same_tree_shapes(params, shared):
+                params = jax.tree_util.tree_map(jnp.asarray, shared)
+            # else: incompatible architecture → cold start
+
+        tx = optax.adam(float(self.knobs["learning_rate"]))
+        opt_state = tx.init(params)
+
+        @jax.jit
+        def train_step(params, opt_state, xb, yb, mask):
+            def loss_fn(p):
+                logits = module.apply({"params": p}, xb)
+                losses = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, yb)
+                return jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        epochs = max(1, round(int(self.knobs["max_epochs"])
+                              * float(ctx.budget_scale)))
+        ctx.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
+        for epoch in range(epochs):
+            losses = []
+            for batch in batch_iterator({"x": x, "y": y}, batch_size,
+                                        seed=epoch):
+                params, opt_state, loss = train_step(
+                    params, opt_state, batch["x"], batch["y"],
+                    batch["mask"].astype(np.float32))
+                losses.append(float(loss))
+            mean_loss = float(np.mean(losses))
+            ctx.logger.log(epoch=epoch, loss=mean_loss)
+            if ctx.should_continue is not None and \
+                    not ctx.should_continue(epoch, -mean_loss):
+                break
+        self._params = params
+
+    def evaluate(self, dataset_path: str) -> float:
+        ds = load_image_classification_dataset(dataset_path)
+        probs = self._predict_probs(self._to_float(ds.images))
+        return float(np.mean(np.argmax(probs, -1) == ds.labels))
+
+    def predict(self, queries: Sequence[Any]) -> List[Any]:
+        x = self._to_float(np.stack([np.asarray(q) for q in queries]))
+        if x.ndim == 3:
+            x = x[..., None]
+        return [p.tolist() for p in self._predict_probs(x)]
+
+    def _predict_probs(self, x: np.ndarray) -> np.ndarray:
+        assert self._params is not None, "model is not trained/loaded"
+        module = self._module()
+
+        @jax.jit
+        def forward(params, xb):
+            return jax.nn.softmax(module.apply({"params": params}, xb), -1)
+
+        out = []
+        batch = 256
+        for i in range(0, len(x), batch):
+            xb = x[i:i + batch]
+            pad = batch - len(xb)
+            if pad:
+                xb = np.concatenate([xb, np.zeros((pad, *xb.shape[1:]),
+                                                  xb.dtype)])
+            out.append(np.asarray(forward(self._params, xb))[:batch - pad])
+        return np.concatenate(out)
+
+    def dump_parameters(self) -> Dict[str, Any]:
+        assert self._params is not None, "model is not trained"
+        return {
+            "params": jax.tree_util.tree_map(np.asarray, self._params),
+            "meta": {"n_classes": self._n_classes,
+                     "image_shape": list(self._image_shape or [])},
+        }
+
+    def load_parameters(self, params: Dict[str, Any]) -> None:
+        self._n_classes = int(params["meta"]["n_classes"])
+        self._image_shape = list(params["meta"]["image_shape"])
+        self._params = jax.tree_util.tree_map(jnp.asarray, params["params"])
+
+
+if __name__ == "__main__":  # reference-style self-test block
+    import tempfile
+
+    from rafiki_tpu.data import generate_image_classification_dataset
+    from rafiki_tpu.model import test_model_class
+
+    with tempfile.TemporaryDirectory() as d:
+        train_p = f"{d}/train.npz"
+        val_p = f"{d}/val.npz"
+        generate_image_classification_dataset(train_p, 512, seed=0)
+        ds = generate_image_classification_dataset(val_p, 128, seed=1)
+        preds = test_model_class(
+            JaxFeedForward, TaskType.IMAGE_CLASSIFICATION, train_p, val_p,
+            queries=[ds.images[0], ds.images[1]])
+        print("predictions:", [int(np.argmax(p)) for p in preds])

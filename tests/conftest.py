@@ -1,0 +1,22 @@
+"""Test harness config: run JAX on a virtual 8-device CPU mesh.
+
+This is the TPU-world analog of "multi-node on one box" (SURVEY.md §4):
+sharding/collective code paths are exercised for real, just on host CPU.
+Must run before jax initializes its backends, hence env vars at import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_workdir(tmp_path):
+    return tmp_path

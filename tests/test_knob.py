@@ -1,0 +1,100 @@
+import random
+
+import pytest
+
+from rafiki_tpu.model.knob import (BaseKnob, CategoricalKnob, FixedKnob,
+                                   FloatKnob, IntegerKnob, PolicyKnob,
+                                   knob_config_from_json, knob_config_to_json,
+                                   knobs_from_unit_vector,
+                                   knobs_to_unit_vector, sample_knobs,
+                                   shape_signature, tunable_knobs,
+                                   validate_knobs)
+
+
+def make_config():
+    return {
+        "lr": FloatKnob(1e-5, 1e-1, is_exp=True),
+        "hidden": IntegerKnob(32, 512, is_exp=True, shape_relevant=True),
+        "act": CategoricalKnob(["relu", "gelu", "tanh"]),
+        "epochs": FixedKnob(3),
+        "early_stop": PolicyKnob("EARLY_STOP"),
+    }
+
+
+def test_sample_and_validate():
+    cfg = make_config()
+    rng = random.Random(0)
+    for _ in range(50):
+        knobs = sample_knobs(cfg, rng)
+        validate_knobs(cfg, knobs)
+        assert 1e-5 <= knobs["lr"] <= 1e-1
+        assert 32 <= knobs["hidden"] <= 512
+        assert knobs["act"] in ("relu", "gelu", "tanh")
+        assert knobs["epochs"] == 3
+        assert knobs["early_stop"] is False
+
+
+def test_validate_rejects():
+    cfg = make_config()
+    knobs = sample_knobs(cfg, random.Random(1))
+    with pytest.raises(ValueError):
+        validate_knobs(cfg, {**knobs, "lr": 5.0})
+    with pytest.raises(ValueError):
+        validate_knobs(cfg, {**knobs, "act": "swish"})
+    with pytest.raises(ValueError):
+        validate_knobs(cfg, {**knobs, "epochs": 4})
+    bad = dict(knobs)
+    del bad["lr"]
+    with pytest.raises(ValueError):
+        validate_knobs(cfg, bad)
+    with pytest.raises(ValueError):
+        validate_knobs(cfg, {**knobs, "bogus": 1})
+
+
+def test_json_round_trip():
+    cfg = make_config()
+    cfg2 = knob_config_from_json(knob_config_to_json(cfg))
+    assert cfg == cfg2
+    # serialized form must be stable and dispatchable
+    for knob in cfg.values():
+        assert BaseKnob.from_json(knob.to_json()) == knob
+
+
+def test_unit_vector_round_trip():
+    cfg = make_config()
+    names = tunable_knobs(cfg)
+    assert names == sorted(["lr", "hidden", "act"])
+    knobs = sample_knobs(cfg, random.Random(2))
+    vec = knobs_to_unit_vector(cfg, knobs)
+    assert len(vec) == 3 and all(0.0 <= u <= 1.0 for u in vec)
+    back = knobs_from_unit_vector(cfg, vec)
+    validate_knobs(cfg, back)
+    assert back["act"] == knobs["act"]
+    assert back["hidden"] == knobs["hidden"]
+    assert abs(back["lr"] - knobs["lr"]) / knobs["lr"] < 1e-6
+
+
+def test_log_scale_coverage():
+    # log-scaled sampling should hit small values often enough
+    knob = FloatKnob(1e-5, 1e-1, is_exp=True)
+    rng = random.Random(3)
+    vals = [knob.sample(rng) for _ in range(500)]
+    assert sum(v < 1e-3 for v in vals) > 100
+
+
+def test_shape_signature():
+    cfg = make_config()
+    a = sample_knobs(cfg, random.Random(4))
+    b = dict(a, lr=a["lr"] * 0.5)  # same shapes, different lr
+    c = dict(a, hidden=a["hidden"] + 1)
+    assert shape_signature(cfg, a) == shape_signature(cfg, b)
+    assert shape_signature(cfg, a) != shape_signature(cfg, c)
+
+
+def test_invalid_domains():
+    with pytest.raises(ValueError):
+        IntegerKnob(10, 5)
+    with pytest.raises(ValueError):
+        FloatKnob(0.0, 1.0, is_exp=True)
+    with pytest.raises(ValueError):
+        CategoricalKnob([])

@@ -1,0 +1,50 @@
+"""Config #1 end-to-end on CPU: contract conformance + local tuning."""
+
+import numpy as np
+import pytest
+
+from rafiki_tpu.constants import TaskType
+from rafiki_tpu.data import generate_image_classification_dataset
+from rafiki_tpu.model import test_model_class, tune_model
+from rafiki_tpu.models.mlp import JaxFeedForward
+
+
+@pytest.fixture(scope="module")
+def datasets(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ds")
+    train_p = str(d / "train.npz")
+    val_p = str(d / "val.npz")
+    generate_image_classification_dataset(train_p, n_examples=512, seed=0)
+    val = generate_image_classification_dataset(val_p, n_examples=128, seed=1)
+    return train_p, val_p, val
+
+
+def test_mlp_contract(datasets):
+    train_p, val_p, val = datasets
+    knobs = {"max_epochs": 5, "hidden_layer_count": 1,
+             "hidden_layer_units": 64, "learning_rate": 1e-3,
+             "batch_size": 64, "quick_train": False, "share_params": False}
+    preds = test_model_class(
+        JaxFeedForward, TaskType.IMAGE_CLASSIFICATION, train_p, val_p,
+        queries=[val.images[0], val.images[1]], knobs=knobs)
+    assert len(preds) == 2
+    assert len(preds[0]) == 10
+    assert abs(sum(preds[0]) - 1.0) < 1e-3  # probabilities
+
+
+def test_mlp_learns(datasets):
+    train_p, val_p, _ = datasets
+    m = JaxFeedForward(max_epochs=3, hidden_layer_count=1,
+                       hidden_layer_units=64, learning_rate=1e-3,
+                       batch_size=64, quick_train=False, share_params=False)
+    m.train(train_p)
+    assert m.evaluate(val_p) > 0.5  # 10-class chance is 0.1
+
+
+def test_tune_model_random(datasets):
+    train_p, val_p, _ = datasets
+    result = tune_model(JaxFeedForward, train_p, val_p, total_trials=3,
+                        advisor_type="random", seed=0)
+    assert len(result.trials) == 3
+    assert result.best_score >= max(t.score for t in result.trials) - 1e-9
+    assert result.best_params  # params captured for deployment
