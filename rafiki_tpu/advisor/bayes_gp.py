@@ -1,0 +1,116 @@
+"""Bayesian-optimization advisor: GP surrogate + Expected Improvement.
+
+Parity target: the reference's skopt-GP Bayesian advisor (SURVEY.md §2
+"Advisor service"). skopt is not in this image, so the surrogate is built
+directly on scikit-learn's GaussianProcessRegressor (Matérn 5/2 kernel)
+over the knob unit cube (see ``knob.knobs_to_unit_vector``), with EI
+maximized by candidate sampling. Pending proposals are imputed at the
+posterior mean ("constant liar") so concurrent workers don't collapse onto
+one point.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..model.knob import (KnobConfig, PolicyKnob, knobs_from_unit_vector,
+                          knobs_to_unit_vector, sample_knobs, tunable_knobs)
+from .base import BaseAdvisor, Proposal, TrialResult
+
+
+class BayesOptAdvisor(BaseAdvisor):
+    name = "bayes_gp"
+
+    def __init__(self, knob_config: KnobConfig,
+                 total_trials: Optional[int] = None,
+                 time_budget_s: Optional[float] = None, seed: int = 0,
+                 n_initial_points: int = 8, n_candidates: int = 512,
+                 xi: float = 0.01) -> None:
+        super().__init__(knob_config, total_trials, time_budget_s, seed)
+        self._dims = tunable_knobs(knob_config)
+        self._n_initial = max(2, min(n_initial_points,
+                                     (total_trials or 10) // 2 or 2))
+        self._n_candidates = n_candidates
+        self._xi = xi
+        self._x: List[List[float]] = []
+        self._y: List[float] = []
+        self._pending: Dict[int, List[float]] = {}
+        self._np_rng = np.random.default_rng(seed)
+
+    # ---- BaseAdvisor hooks (called under the base lock) ----
+    def _propose(self, trial_no: int) -> Proposal:
+        if not self._dims or len(self._y) < self._n_initial:
+            knobs = sample_knobs(self.knob_config, self._rng)
+            vec = knobs_to_unit_vector(self.knob_config, knobs)
+        else:
+            vec = self._suggest()
+            knobs = knobs_from_unit_vector(self.knob_config, vec, self._rng)
+        self._pending[trial_no] = vec
+        warm_start = ""
+        if self.best is not None and self.best.trial_id:
+            for n, k in self.knob_config.items():
+                if isinstance(k, PolicyKnob) and k.policy == "SHARE_PARAMS":
+                    knobs[n] = True
+                    warm_start = self.best.trial_id
+        return Proposal(trial_no=trial_no, knobs=knobs,
+                        warm_start_trial_id=warm_start)
+
+    def _feedback(self, result: TrialResult) -> None:
+        vec = self._pending.pop(result.trial_no, None)
+        if vec is None:
+            vec = knobs_to_unit_vector(self.knob_config, result.knobs)
+        self._x.append(vec)
+        self._y.append(float(result.score))
+
+    def _on_trial_errored(self, trial_no: int) -> None:
+        self._pending.pop(trial_no, None)
+
+    # ---- surrogate ----
+    def _fit_gp(self, x: np.ndarray, y: np.ndarray):
+        from sklearn.gaussian_process import GaussianProcessRegressor
+        from sklearn.gaussian_process.kernels import ConstantKernel, Matern
+
+        kernel = ConstantKernel(1.0) * Matern(
+            length_scale=np.full(x.shape[1], 0.3), nu=2.5)
+        gp = GaussianProcessRegressor(
+            kernel=kernel, alpha=1e-6, normalize_y=True,
+            n_restarts_optimizer=1,
+            random_state=int(self._np_rng.integers(2 ** 31)))
+        gp.fit(x, y)
+        return gp
+
+    def _suggest(self) -> List[float]:
+        x = np.asarray(self._x, dtype=np.float64)
+        y = np.asarray(self._y, dtype=np.float64)
+        gp = self._fit_gp(x, y)
+        # constant liar: impute pending points at posterior mean
+        if self._pending:
+            xp = np.asarray(list(self._pending.values()), dtype=np.float64)
+            yp = gp.predict(xp)
+            gp = self._fit_gp(np.vstack([x, xp]), np.concatenate([y, yp]))
+            y_all = np.concatenate([y, yp])
+        else:
+            y_all = y
+        best_y = float(np.max(y_all))
+        cand = self._np_rng.random((self._n_candidates, len(self._dims)))
+        # include jittered copies of the incumbent for local refinement
+        inc = x[int(np.argmax(y))]
+        local = np.clip(inc + self._np_rng.normal(
+            0, 0.05, (self._n_candidates // 8, len(self._dims))), 0, 1)
+        cand = np.vstack([cand, local])
+        mu, sigma = gp.predict(cand, return_std=True)
+        ei = _expected_improvement(mu, np.maximum(sigma, 1e-9),
+                                   best_y, self._xi)
+        return cand[int(np.argmax(ei))].tolist()
+
+
+def _expected_improvement(mu: np.ndarray, sigma: np.ndarray, best_y: float,
+                          xi: float) -> np.ndarray:
+    from scipy.stats import norm
+
+    imp = mu - best_y - xi
+    z = imp / sigma
+    return imp * norm.cdf(z) + sigma * norm.pdf(z)

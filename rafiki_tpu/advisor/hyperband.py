@@ -1,0 +1,180 @@
+"""BOHB-family advisor: asynchronous successive halving + TPE sampling.
+
+Parity target: the reference's HyperBand/BOHB-family advisor (SURVEY.md §2
+"Advisor service", BASELINE.json "Bayesian/BOHB"). Rebuilt as the *async*
+variant (ASHA-style promotion) because trials here are long-running
+processes on TPU sub-meshes: a synchronous rung barrier would idle
+sub-meshes waiting for stragglers, while async promotion keeps every
+sub-mesh busy — the same reasoning that moved the field from HyperBand to
+ASHA. New configurations are drawn from a TPE-style model (top-quantile vs
+rest KDEs over the knob unit cube) once enough full-rung observations
+exist, which is the "BO" in BOHB.
+
+Budget semantics: a proposal's ``budget_scale`` is the fraction of the
+model's full training budget (e.g. epochs) to spend. A promoted trial
+warm-starts from its own lower-rung checkpoint via
+``warm_start_trial_id`` — which maps BOHB rungs directly onto the
+ParamStore's share/resume machinery (SURVEY.md §5.3/§5.4: rungs pair
+naturally with checkpointed, preemptible trials).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..model.knob import (KnobConfig, PolicyKnob, knobs_from_unit_vector,
+                          knobs_to_unit_vector, sample_knobs, tunable_knobs)
+from .base import BaseAdvisor, Proposal, TrialResult
+
+
+class _RungEntry:
+    __slots__ = ("trial_no", "trial_id", "knobs", "vec", "score", "promoted")
+
+    def __init__(self, trial_no: int, knobs: dict, vec: List[float]) -> None:
+        self.trial_no = trial_no
+        self.trial_id = ""
+        self.knobs = knobs
+        self.vec = vec
+        self.score: Optional[float] = None
+        self.promoted = False
+
+
+class BOHBAdvisor(BaseAdvisor):
+    name = "bohb"
+
+    def __init__(self, knob_config: KnobConfig,
+                 total_trials: Optional[int] = None,
+                 time_budget_s: Optional[float] = None, seed: int = 0,
+                 eta: int = 3, min_budget: float = 1.0 / 9.0,
+                 max_budget: float = 1.0, tpe_min_points: int = 8,
+                 tpe_top_quantile: float = 0.33,
+                 n_candidates: int = 256) -> None:
+        super().__init__(knob_config, total_trials, time_budget_s, seed)
+        self.eta = eta
+        # rung budgets: min_budget * eta^k up to max_budget
+        budgets = []
+        b = min_budget
+        while b < max_budget - 1e-9:
+            budgets.append(b)
+            b *= eta
+        budgets.append(max_budget)
+        self.budgets = budgets
+        self._rungs: List[List[_RungEntry]] = [[] for _ in budgets]
+        self._by_trial_no: Dict[int, Tuple[int, _RungEntry]] = {}
+        self._dims = tunable_knobs(knob_config)
+        self._tpe_min_points = tpe_min_points
+        self._tpe_top_quantile = tpe_top_quantile
+        self._n_candidates = n_candidates
+        self._np_rng = np.random.default_rng(seed)
+
+    @property
+    def n_rungs(self) -> int:
+        return len(self.budgets)
+
+    # ---- BaseAdvisor hooks (called under the base lock) ----
+    def _propose(self, trial_no: int) -> Proposal:
+        # 1) try to promote: highest rung first, so survivors finish fast
+        for rung in range(self.n_rungs - 2, -1, -1):
+            entry = self._promotable(rung)
+            if entry is not None:
+                entry.promoted = True
+                new = _RungEntry(trial_no, dict(entry.knobs), entry.vec)
+                self._rungs[rung + 1].append(new)
+                self._by_trial_no[trial_no] = (rung + 1, new)
+                knobs = self._with_policies(dict(entry.knobs), promote=True)
+                return Proposal(
+                    trial_no=trial_no, knobs=knobs,
+                    budget_scale=self.budgets[rung + 1],
+                    warm_start_trial_id=entry.trial_id,
+                    meta={"rung": rung + 1, "parent_trial_no": entry.trial_no})
+        # 2) otherwise: a fresh configuration at the lowest rung
+        if self._dims:
+            vec = self._sample_tpe()
+            knobs = knobs_from_unit_vector(self.knob_config, vec, self._rng)
+        else:
+            knobs = sample_knobs(self.knob_config, self._rng)
+            vec = []
+        entry = _RungEntry(trial_no, dict(knobs), vec)
+        self._rungs[0].append(entry)
+        self._by_trial_no[trial_no] = (0, entry)
+        knobs = self._with_policies(knobs, promote=False)
+        return Proposal(trial_no=trial_no, knobs=knobs,
+                        budget_scale=self.budgets[0], meta={"rung": 0})
+
+    def _feedback(self, result: TrialResult) -> None:
+        info = self._by_trial_no.get(result.trial_no)
+        if info is None:
+            return
+        _, entry = info
+        entry.score = float(result.score)
+        entry.trial_id = result.trial_id
+
+    def _on_trial_errored(self, trial_no: int) -> None:
+        info = self._by_trial_no.pop(trial_no, None)
+        if info is not None:
+            rung, entry = info
+            # drop it from the rung so it never blocks promotions
+            self._rungs[rung] = [e for e in self._rungs[rung] if e is not entry]
+
+    # ---- successive halving ----
+    def _promotable(self, rung: int) -> Optional[_RungEntry]:
+        """Async (ASHA) rule: an entry is promotable when it sits in the top
+        1/eta of *completed* entries at its rung and is not yet promoted."""
+        done = [e for e in self._rungs[rung] if e.score is not None]
+        if len(done) < self.eta:
+            return None
+        k = len(done) // self.eta
+        top = sorted(done, key=lambda e: e.score, reverse=True)[:k]
+        for e in top:
+            if not e.promoted:
+                return e
+        return None
+
+    def _with_policies(self, knobs: dict, promote: bool) -> dict:
+        """Flip the model's declared policy knobs for rung semantics."""
+        for n, k in self.knob_config.items():
+            if not isinstance(k, PolicyKnob):
+                continue
+            if k.policy in ("QUICK_TRAIN", "EARLY_STOP"):
+                knobs[n] = True
+            elif k.policy == "SHARE_PARAMS":
+                knobs[n] = promote  # promotions resume their own checkpoint
+        return knobs
+
+    # ---- TPE sampling over the unit cube ----
+    def _observations(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(x, y) pairs from the highest rung that has enough data."""
+        for rung in range(self.n_rungs - 1, -1, -1):
+            done = [e for e in self._rungs[rung]
+                    if e.score is not None and e.vec]
+            if len(done) >= self._tpe_min_points:
+                return (np.asarray([e.vec for e in done]),
+                        np.asarray([e.score for e in done]))
+        return np.empty((0, len(self._dims))), np.empty((0,))
+
+    def _sample_tpe(self) -> List[float]:
+        x, y = self._observations()
+        if len(y) < self._tpe_min_points:
+            return self._np_rng.random(len(self._dims)).tolist()
+        from scipy.stats import gaussian_kde
+
+        n_top = max(2, int(math.ceil(len(y) * self._tpe_top_quantile)))
+        order = np.argsort(y)[::-1]
+        good, bad = x[order[:n_top]], x[order[n_top:]]
+        jitter = 1e-3 * self._np_rng.standard_normal(good.T.shape)
+        try:
+            kde_good = gaussian_kde(good.T + jitter, bw_method="scott")
+            kde_bad = (gaussian_kde(bad.T, bw_method="scott")
+                       if len(bad) >= 2 else None)
+        except np.linalg.LinAlgError:
+            return self._np_rng.random(len(self._dims)).tolist()
+        cand = np.clip(
+            kde_good.resample(self._n_candidates,
+                              seed=int(self._np_rng.integers(2 ** 31))).T,
+            0.0, 1.0)
+        lg = kde_good.logpdf(cand.T)
+        lb = kde_bad.logpdf(cand.T) if kde_bad is not None else 0.0
+        return cand[int(np.argmax(lg - lb))].tolist()

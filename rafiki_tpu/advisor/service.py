@@ -1,0 +1,123 @@
+"""Advisor as an HTTP service + client.
+
+Parity target: the reference's advisor container serving propose/feedback
+over HTTP to train workers (SURVEY.md §3.4). One advisor service hosts the
+search state for one sub-train-job; the train worker's loop calls
+``propose`` / ``feedback`` / ``trial_errored`` and polls ``status``.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Any, Dict, Optional, Tuple
+
+from ..model.knob import knob_config_from_json
+from ..utils.http import JsonHttpService, json_request
+from .base import BaseAdvisor, Proposal, TrialResult, make_advisor
+
+
+class AdvisorService:
+    """Wraps a BaseAdvisor behind the propose/feedback wire protocol."""
+
+    def __init__(self, advisor: BaseAdvisor, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+        self.advisor = advisor
+        self.http = JsonHttpService(host, port)
+        self.http.route("POST", "/proposal", self._propose)
+        self.http.route("POST", "/feedback", self._feedback)
+        self.http.route("POST", "/trial_errored", self._trial_errored)
+        self.http.route("GET", "/status", self._status)
+
+    def start(self) -> Tuple[str, int]:
+        return self.http.start()
+
+    def stop(self) -> None:
+        self.http.stop()
+
+    # ---- routes ----
+    def _propose(self, _m: Dict[str, str], _body: Any,
+                 _h: Dict[str, str]) -> Tuple[int, Any]:
+        return 200, self.advisor.propose().to_json()
+
+    def _feedback(self, _m: Dict[str, str], body: Any,
+                  _h: Dict[str, str]) -> Tuple[int, Any]:
+        self.advisor.feedback(TrialResult.from_json(body))
+        return 200, {"ok": True}
+
+    def _trial_errored(self, _m: Dict[str, str], body: Any,
+                       _h: Dict[str, str]) -> Tuple[int, Any]:
+        self.advisor.trial_errored(int(body["trial_no"]))
+        return 200, {"ok": True}
+
+    def _status(self, _m: Dict[str, str], _body: Any,
+                _h: Dict[str, str]) -> Tuple[int, Any]:
+        best = self.advisor.best
+        return 200, {
+            "finished": self.advisor.finished,
+            "n_results": len(self.advisor.results),
+            "best": best.to_json() if best else None,
+        }
+
+
+class AdvisorClient:
+    """HTTP client mirroring the BaseAdvisor surface for remote workers."""
+
+    def __init__(self, base_url: str, timeout: float = 60.0) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def propose(self) -> Proposal:
+        return Proposal.from_json(json_request(
+            "POST", f"{self.base_url}/proposal", {}, timeout=self.timeout))
+
+    def feedback(self, result: TrialResult) -> None:
+        json_request("POST", f"{self.base_url}/feedback", result.to_json(),
+                     timeout=self.timeout)
+
+    def trial_errored(self, trial_no: int) -> None:
+        json_request("POST", f"{self.base_url}/trial_errored",
+                     {"trial_no": trial_no}, timeout=self.timeout)
+
+    def status(self) -> Dict[str, Any]:
+        return json_request("GET", f"{self.base_url}/status",
+                            timeout=self.timeout)
+
+
+def main(argv: Optional[list] = None) -> int:
+    """Service entrypoint: ``python -m rafiki_tpu.advisor.service``.
+
+    The ServicesManager spawns this with the knob config and budget as a
+    JSON file path (env-var-sized configs don't survive exec portably).
+    """
+    import json as _json
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", required=True,
+                        help="path to JSON {knob_config, advisor_type, "
+                             "total_trials, time_budget_s, seed}")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--port-file", default="",
+                        help="write the bound port here (service discovery)")
+    args = parser.parse_args(argv)
+
+    with open(args.config) as f:
+        cfg = _json.load(f)
+    advisor = make_advisor(
+        knob_config_from_json(cfg["knob_config"]),
+        cfg.get("advisor_type", "auto"),
+        total_trials=cfg.get("total_trials"),
+        time_budget_s=cfg.get("time_budget_s"),
+        seed=cfg.get("seed", 0))
+    service = AdvisorService(advisor, args.host, args.port)
+    host, port = service.start()
+    if args.port_file:
+        with open(args.port_file, "w") as f:
+            f.write(str(port))
+    print(f"advisor service on {host}:{port}", flush=True)
+    service.http.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,13 @@
+"""Device meshes, sub-mesh allocation, and sharding vocabulary."""
+
+from .mesh import (SubMesh, SubMeshAllocator, partition_devices,
+                   submesh_env_vars)
+from .sharding import (DATA_AXIS, MODEL_AXIS, batch_sharding, make_mesh,
+                       param_shardings, replicate_tree, replicated,
+                       shard_batch)
+
+__all__ = [
+    "SubMesh", "SubMeshAllocator", "partition_devices", "submesh_env_vars",
+    "DATA_AXIS", "MODEL_AXIS", "batch_sharding", "make_mesh",
+    "param_shardings", "replicate_tree", "replicated", "shard_batch",
+]

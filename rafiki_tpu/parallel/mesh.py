@@ -1,0 +1,215 @@
+"""Device meshes and ICI-topology-aware sub-mesh partitioning.
+
+This layer replaces the reference's "one Docker container = one GPU"
+scheduling substrate (SURVEY.md §2.2, §7 "Device multi-tenancy"): a TPU
+slice's chips are partitioned into *contiguous rectangular sub-meshes*, and
+each concurrent trial (or inference replica) owns one sub-mesh. Contiguity
+matters because intra-trial collectives (data-parallel all-reduce etc.)
+must ride ICI links between physically adjacent chips; a fragmented
+allocation would route gradients across the whole slice.
+
+Partitioning strategy: read each device's ``coords`` (TPU gives (x, y, z));
+arrange the slice as a grid; tile the grid into equal rectangles by
+repeatedly halving the longer axis (power-of-two slot sizes — v5e slices
+are powers of two). Devices without coords (CPU backend in tests) fall
+back to index order, which is the degenerate 1-D grid.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+Device = Any  # jax Device
+
+
+def device_sort_key(d: Device) -> Tuple:
+    coords = getattr(d, "coords", None)
+    if coords is not None:
+        return (0, tuple(coords), getattr(d, "core_on_chip", 0))
+    return (1, d.id)
+
+
+def _grid_shape(devices: Sequence[Device]) -> Tuple[int, int]:
+    """Infer the (rows, cols) physical grid of a single-host slice."""
+    coords = [getattr(d, "coords", None) for d in devices]
+    if all(c is not None for c in coords) and len(set(coords)) == len(coords):
+        xs = sorted({c[0] for c in coords})
+        ys = sorted({c[1] for c in coords})
+        if len(xs) * len(ys) == len(devices):
+            return len(ys), len(xs)
+    # fallback: near-square factorization of N in index order
+    n = len(devices)
+    rows = 2 ** (int(math.log2(n)) // 2) if n & (n - 1) == 0 else 1
+    return rows, n // rows
+
+
+def partition_devices(devices: Sequence[Device],
+                      slot_size: int) -> List[List[Device]]:
+    """Split ``devices`` into contiguous sub-meshes of ``slot_size``.
+
+    Returns slots in grid order. Requires ``slot_size`` to divide the
+    device count; power-of-two sizes yield rectangular ICI-contiguous
+    tiles.
+    """
+    n = len(devices)
+    if slot_size <= 0 or n % slot_size != 0:
+        raise ValueError(f"slot_size {slot_size} must divide {n} devices")
+    ordered = sorted(devices, key=device_sort_key)
+    rows, cols = _grid_shape(ordered)
+    grid = np.empty((rows, cols), dtype=object)
+    coords = [getattr(d, "coords", None) for d in ordered]
+    if (all(c is not None for c in coords)
+            and len({(c[0], c[1]) for c in coords}) == len(ordered)):
+        # place by physical coordinates: grid[y][x]
+        xs = sorted({c[0] for c in coords})
+        ys = sorted({c[1] for c in coords})
+        x_index = {x: i for i, x in enumerate(xs)}
+        y_index = {y: i for i, y in enumerate(ys)}
+        for d, c in zip(ordered, coords):
+            grid[y_index[c[1]], x_index[c[0]]] = d
+        if any(grid[r, c] is None for r in range(rows) for c in range(cols)):
+            grid = np.array(ordered, dtype=object).reshape(rows, cols)
+    else:
+        for idx, d in enumerate(ordered):
+            grid[idx // cols, idx % cols] = d
+    tile_r, tile_c = _tile_shape(rows, cols, slot_size)
+    slots: List[List[Device]] = []
+    for r0 in range(0, rows, tile_r):
+        for c0 in range(0, cols, tile_c):
+            tile = grid[r0:r0 + tile_r, c0:c0 + tile_c].reshape(-1)
+            slots.append(list(tile))
+    return slots
+
+
+def _tile_shape(rows: int, cols: int, size: int) -> Tuple[int, int]:
+    """Rectangular tile of ``size`` devices that evenly tiles rows×cols,
+    built by halving the longer axis of the full grid until it fits."""
+    r, c = rows, cols
+    while r * c > size:
+        if r >= c and r % 2 == 0 and (r // 2) * c >= size:
+            r //= 2
+        elif c % 2 == 0 and r * (c // 2) >= size:
+            c //= 2
+        elif r % 2 == 0 and (r // 2) * c >= size:
+            r //= 2
+        else:
+            break
+    if r * c != size:  # non-power-of-two fallback: strip tiling
+        if cols % size == 0:
+            return 1, size
+        if rows % size == 0:
+            return size, 1
+        raise ValueError(
+            f"cannot tile {rows}x{cols} grid into blocks of {size}")
+    return r, c
+
+
+@dataclass
+class SubMesh:
+    """A trial-owned contiguous device subset."""
+
+    index: int
+    devices: List[Device]
+
+    @property
+    def size(self) -> int:
+        return len(self.devices)
+
+    def mesh(self, axes: Optional[Dict[str, int]] = None):
+        """Materialize a jax.sharding.Mesh over this sub-mesh.
+
+        ``axes`` maps axis names to sizes, e.g. ``{"data": 2, "model": 2}``;
+        default is a 1-D ``data`` mesh.
+        """
+        import jax
+        from jax.sharding import Mesh
+
+        axes = axes or {"data": self.size}
+        sizes = list(axes.values())
+        if math.prod(sizes) != self.size:
+            raise ValueError(f"axes {axes} do not cover {self.size} devices")
+        arr = np.array(self.devices, dtype=object).reshape(sizes)
+        return Mesh(arr, tuple(axes.keys()))
+
+
+class SubMeshAllocator:
+    """Thread-safe allocator of sub-meshes to trials.
+
+    The ServicesManager holds one of these per slice; train workers acquire
+    a slot for each trial process and release it on completion — the moral
+    equivalent of the reference's "give this container one GPU"
+    (SURVEY.md §2 "Container manager").
+    """
+
+    def __init__(self, devices: Sequence[Device], slot_size: int) -> None:
+        self._slots = [SubMesh(i, devs) for i, devs in
+                       enumerate(partition_devices(devices, slot_size))]
+        self._free = list(range(len(self._slots)))
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+
+    @property
+    def n_slots(self) -> int:
+        return len(self._slots)
+
+    def acquire(self, timeout: Optional[float] = None) -> Optional[SubMesh]:
+        with self._cv:
+            if not self._cv.wait_for(lambda: bool(self._free),
+                                     timeout=timeout):
+                return None
+            return self._slots[self._free.pop(0)]
+
+    def release(self, submesh: SubMesh) -> None:
+        with self._cv:
+            if submesh.index in self._free:
+                raise ValueError(f"slot {submesh.index} already free")
+            self._free.append(submesh.index)
+            self._free.sort()
+            self._cv.notify()
+
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+
+def submesh_env_vars(platform: str, slot: SubMesh,
+                     total_devices: int) -> Dict[str, str]:
+    """Env vars that confine a *child process* to ``slot``'s devices.
+
+    This is how one host runs N concurrent single-trial JAX processes on
+    disjoint chip subsets (the Docker-GPU-mapping replacement):
+
+    - TPU: ``TPU_VISIBLE_CHIPS`` (per-chip selection on a TPU-VM) plus
+      flags that keep each process in its own local topology.
+    - CPU (tests): a host-device count equal to the slot size — every
+      process sees ``slot.size`` virtual devices, which exercises the same
+      mesh code paths.
+    """
+    if platform == "tpu":
+        chips = sorted({getattr(d, "id", i)
+                        for i, d in enumerate(slot.devices)})
+        coords = [getattr(d, "coords", None) for d in slot.devices]
+        if all(c is not None for c in coords):
+            # bounds follow the slot's physical tile shape (x, y, z)
+            w = max(c[0] for c in coords) - min(c[0] for c in coords) + 1
+            h = max(c[1] for c in coords) - min(c[1] for c in coords) + 1
+            bounds = f"{w},{h},1"
+        else:
+            bounds = f"1,1,{len(chips)}"
+        return {
+            "TPU_VISIBLE_CHIPS": ",".join(str(c) for c in chips),
+            "TPU_CHIPS_PER_PROCESS_BOUNDS": bounds,
+            "TPU_PROCESS_BOUNDS": "1,1,1",
+            "ALLOW_MULTIPLE_LIBTPU_LOAD": "1",
+        }
+    # cpu / tests
+    return {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={slot.size}",
+    }

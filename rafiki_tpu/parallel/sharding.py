@@ -1,0 +1,167 @@
+"""Sharding vocabulary: named meshes + common partition specs.
+
+The reference has no in-trial parallelism (SURVEY.md §2.2); here each trial
+can itself be data-parallel (ResNet/ViT over a sub-mesh) or 2-D
+fsdp×tensor-parallel (Llama LoRA). Everything goes through
+``jax.sharding.NamedSharding`` on a named mesh so XLA inserts the
+collectives (psum/all-gather/reduce-scatter) — never hand-written.
+
+Axis conventions (used across the model zoo):
+- ``data``: batch axis (DP); gradients all-reduce over it.
+- ``model``: tensor-parallel axis; weights split over it, activations
+  all-gather/reduce-scatter around matmuls.
+A 1-D mesh uses ``data`` only; the 2-D Llama mesh is ``(data, model)``
+with fsdp sharding weights over ``data`` as well.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(devices: Optional[Sequence[Any]] = None,
+              data: Optional[int] = None, model: int = 1):
+    """Build a (data, model) mesh over ``devices`` (default: all local)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices) if devices is not None else list(jax.devices())
+    n = len(devs)
+    if data is None:
+        if n % model != 0:
+            raise ValueError(f"{n} devices not divisible by model={model}")
+        data = n // model
+    if data * model != n:
+        raise ValueError(f"data*model = {data * model} != {n} devices")
+    arr = np.array(devs, dtype=object).reshape(data, model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def batch_sharding(mesh):
+    """Shard the leading (batch) dim over ``data``, replicate elsewhere."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch: Any, mesh):
+    """Place a host batch with its leading dim sharded over ``data``."""
+    import jax
+
+    s = batch_sharding(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, s), batch)
+
+
+def replicate_tree(tree: Any, mesh):
+    import jax
+
+    s = replicated(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, s), tree)
+
+
+# ---------------------------------------------------------------------------
+# Parameter partitioning by name rules (fsdp / tensor-parallel)
+# ---------------------------------------------------------------------------
+
+def fsdp_param_spec(path: str, shape: Sequence[int], mesh,
+                    min_size: int = 2 ** 16, base=None):
+    """FSDP-style spec: shard a weight's largest divisible dim over
+    ``data``. Small tensors stay replicated (collective overhead beats the
+    memory win below ``min_size`` elements). ``base`` is an existing
+    (e.g. tensor-parallel) spec to extend — already-sharded dims are
+    skipped."""
+    from jax.sharding import PartitionSpec as P
+
+    taken = list(base) if base is not None else []
+    taken += [None] * (len(shape) - len(taken))
+    n_data = mesh.shape[DATA_AXIS]
+    if math.prod(shape) >= min_size:
+        # prefer the largest free dim divisible by the axis size
+        for i in sorted(range(len(shape)), key=lambda i: -shape[i]):
+            if taken[i] is None and shape[i] % n_data == 0:
+                taken[i] = DATA_AXIS
+                break
+    while taken and taken[-1] is None:  # canonical form: no trailing Nones
+        taken.pop()
+    return P(*taken)
+
+
+def tp_param_spec(path: str, shape: Sequence[int], mesh,
+                  rules: Dict[str, int]):
+    """Tensor-parallel spec from substring rules: ``rules`` maps a
+    parameter-path substring to the dim index sharded over ``model``
+    (negative dims allowed). First matching rule wins."""
+    from jax.sharding import PartitionSpec as P
+
+    if not shape:
+        return P()
+    n_model = mesh.shape[MODEL_AXIS]
+    for frag, dim in rules.items():
+        if frag in path:
+            d = dim % len(shape)
+            if shape[d] % n_model == 0:
+                spec: list = [None] * len(shape)
+                spec[d] = MODEL_AXIS
+                return P(*spec)
+    return P()
+
+
+def combine_specs(a, b):
+    """Merge two PartitionSpecs dim-wise (error on conflicts)."""
+    from jax.sharding import PartitionSpec as P
+
+    la, lb = list(a), list(b)
+    n = max(len(la), len(lb))
+    la += [None] * (n - len(la))
+    lb += [None] * (n - len(lb))
+    out = []
+    for x, y in zip(la, lb):
+        if x is not None and y is not None and x != y:
+            raise ValueError(f"conflicting specs {a} vs {b}")
+        out.append(x if x is not None else y)
+    return P(*out)
+
+
+def param_shardings(params: Any, mesh, tp_rules: Optional[Dict[str, int]]
+                    = None, fsdp: bool = False, min_size: int = 2 ** 16):
+    """NamedShardings for a parameter pytree by path rules.
+
+    ``tp_rules`` shards matching weights over ``model``; ``fsdp=True``
+    additionally shards (non-conflicting) large weights over ``data``.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def path_str(kp) -> str:
+        return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in kp)
+
+    specs = {}
+    for kp, leaf in flat:
+        p = path_str(kp)
+        shape = getattr(leaf, "shape", ())
+        spec = P()
+        if tp_rules:
+            spec = tp_param_spec(p, shape, mesh, tp_rules)
+        if fsdp and shape:
+            spec = fsdp_param_spec(p, shape, mesh, min_size, base=spec)
+        specs[p] = spec
+
+    def to_sharding(kp, leaf):
+        return NamedSharding(mesh, specs[path_str(kp)])
+
+    return jax.tree_util.tree_map_with_path(to_sharding, params)

@@ -1,0 +1,8 @@
+"""Persistence: MetaStore (system metadata) and ParamStore (trial params)."""
+
+from .meta_store import MetaStore
+from .param_store import (FileBackend, InMemoryBackend, ParamStore,
+                          params_from_bytes, params_to_bytes)
+
+__all__ = ["MetaStore", "ParamStore", "FileBackend", "InMemoryBackend",
+           "params_from_bytes", "params_to_bytes"]

@@ -1,0 +1,414 @@
+"""MetaStore: persistent system metadata on SQLite.
+
+Parity target: the reference's SQLAlchemy→PostgreSQL metadata layer
+(SURVEY.md §2 "MetaStore"): users, models, datasets, train jobs,
+sub-train-jobs, trials, inference jobs, services, plus per-trial logs.
+SQLite (WAL) replaces PostgreSQL — the control plane lives on the TPU-VM
+host (SURVEY.md §5.8(b)), where an embedded DB with a single writer-lock
+is the right scale; the API is backend-agnostic so a server DB can slot in.
+
+Rows are returned as plain dicts (JSON-ready) instead of ORM objects.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import os
+import sqlite3
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS users (
+    id TEXT PRIMARY KEY, email TEXT UNIQUE NOT NULL,
+    password_hash TEXT NOT NULL, salt TEXT NOT NULL,
+    user_type TEXT NOT NULL, banned INTEGER DEFAULT 0,
+    created_at REAL NOT NULL);
+CREATE TABLE IF NOT EXISTS models (
+    id TEXT PRIMARY KEY, user_id TEXT NOT NULL, name TEXT NOT NULL,
+    task TEXT NOT NULL, model_class TEXT NOT NULL,
+    model_bytes BLOB NOT NULL, checkpoint_id TEXT,
+    dependencies TEXT, access_right TEXT NOT NULL DEFAULT 'PRIVATE',
+    docker_image TEXT, created_at REAL NOT NULL,
+    UNIQUE(user_id, name));
+CREATE TABLE IF NOT EXISTS datasets (
+    id TEXT PRIMARY KEY, user_id TEXT NOT NULL, name TEXT NOT NULL,
+    task TEXT NOT NULL, uri TEXT NOT NULL, size_bytes INTEGER,
+    stat TEXT, created_at REAL NOT NULL);
+CREATE TABLE IF NOT EXISTS train_jobs (
+    id TEXT PRIMARY KEY, user_id TEXT NOT NULL, app TEXT NOT NULL,
+    app_version INTEGER NOT NULL, task TEXT NOT NULL,
+    budget TEXT NOT NULL, train_dataset_id TEXT NOT NULL,
+    val_dataset_id TEXT NOT NULL, train_args TEXT,
+    status TEXT NOT NULL, created_at REAL NOT NULL,
+    stopped_at REAL, UNIQUE(user_id, app, app_version));
+CREATE TABLE IF NOT EXISTS sub_train_jobs (
+    id TEXT PRIMARY KEY, train_job_id TEXT NOT NULL,
+    model_id TEXT NOT NULL, status TEXT NOT NULL,
+    advisor_service_id TEXT, created_at REAL NOT NULL);
+CREATE TABLE IF NOT EXISTS trials (
+    id TEXT PRIMARY KEY, sub_train_job_id TEXT NOT NULL,
+    trial_no INTEGER NOT NULL, model_id TEXT NOT NULL,
+    worker_id TEXT, knobs TEXT, score REAL, budget_scale REAL DEFAULT 1.0,
+    shape_signature TEXT, status TEXT NOT NULL,
+    params_saved INTEGER DEFAULT 0, error TEXT,
+    started_at REAL, stopped_at REAL, created_at REAL NOT NULL);
+CREATE INDEX IF NOT EXISTS idx_trials_job ON trials(sub_train_job_id);
+CREATE TABLE IF NOT EXISTS trial_logs (
+    id INTEGER PRIMARY KEY AUTOINCREMENT, trial_id TEXT NOT NULL,
+    time REAL NOT NULL, kind TEXT NOT NULL, data TEXT NOT NULL);
+CREATE INDEX IF NOT EXISTS idx_trial_logs ON trial_logs(trial_id);
+CREATE TABLE IF NOT EXISTS inference_jobs (
+    id TEXT PRIMARY KEY, user_id TEXT NOT NULL,
+    train_job_id TEXT NOT NULL, budget TEXT, status TEXT NOT NULL,
+    predictor_host TEXT, created_at REAL NOT NULL, stopped_at REAL);
+CREATE TABLE IF NOT EXISTS services (
+    id TEXT PRIMARY KEY, service_type TEXT NOT NULL,
+    status TEXT NOT NULL, train_job_id TEXT, sub_train_job_id TEXT,
+    inference_job_id TEXT, host TEXT, port INTEGER, pid INTEGER,
+    devices TEXT, error TEXT, created_at REAL NOT NULL, stopped_at REAL);
+"""
+
+
+def _now() -> float:
+    return time.time()
+
+
+def _uid() -> str:
+    return uuid.uuid4().hex
+
+
+class MetaStore:
+    """Thread-safe CRUD over the system schema.
+
+    SQLite connections are per-instance with a process-wide write lock;
+    WAL mode keeps readers unblocked during writes.
+    """
+
+    def __init__(self, db_path: str = ":memory:") -> None:
+        self._db_path = db_path
+        self._conn = sqlite3.connect(db_path, check_same_thread=False)
+        self._conn.row_factory = sqlite3.Row
+        self._lock = threading.RLock()
+        with self._lock:
+            if db_path != ":memory:":
+                self._conn.execute("PRAGMA journal_mode=WAL")
+            # cross-process writers: wait instead of instant 'database is
+            # locked' (the RLock only serializes writers in this instance)
+            self._conn.execute("PRAGMA busy_timeout=10000")
+            self._conn.execute("PRAGMA foreign_keys=ON")
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+    # ---- low-level helpers ----
+    def _insert(self, table: str, row: Dict[str, Any]) -> None:
+        cols = ", ".join(row)
+        ph = ", ".join("?" for _ in row)
+        with self._lock:
+            self._conn.execute(
+                f"INSERT INTO {table} ({cols}) VALUES ({ph})",
+                tuple(row.values()))
+            self._conn.commit()
+
+    def _update(self, table: str, row_id: str, fields: Dict[str, Any]) -> None:
+        sets = ", ".join(f"{k}=?" for k in fields)
+        with self._lock:
+            cur = self._conn.execute(
+                f"UPDATE {table} SET {sets} WHERE id=?",
+                (*fields.values(), row_id))
+            self._conn.commit()
+            if cur.rowcount == 0:
+                raise KeyError(f"no {table} row {row_id!r}")
+
+    def _one(self, sql: str, args: tuple = ()) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            cur = self._conn.execute(sql, args)
+            row = cur.fetchone()
+        return dict(row) if row else None
+
+    def _all(self, sql: str, args: tuple = ()) -> List[Dict[str, Any]]:
+        with self._lock:
+            cur = self._conn.execute(sql, args)
+            return [dict(r) for r in cur.fetchall()]
+
+    # ---- users ----
+    def create_user(self, email: str, password: str,
+                    user_type: str) -> Dict[str, Any]:
+        salt = os.urandom(16).hex()
+        row = {"id": _uid(), "email": email,
+               "password_hash": _hash_password(password, salt), "salt": salt,
+               "user_type": user_type, "created_at": _now()}
+        self._insert("users", row)
+        return self.get_user(row["id"])  # type: ignore[return-value]
+
+    def get_user(self, user_id: str) -> Optional[Dict[str, Any]]:
+        return self._one("SELECT * FROM users WHERE id=?", (user_id,))
+
+    def get_user_by_email(self, email: str) -> Optional[Dict[str, Any]]:
+        return self._one("SELECT * FROM users WHERE email=?", (email,))
+
+    def authenticate_user(self, email: str,
+                          password: str) -> Optional[Dict[str, Any]]:
+        user = self.get_user_by_email(email)
+        if user is None or user["banned"]:
+            return None
+        expected = _hash_password(password, user["salt"])
+        if not hmac.compare_digest(expected, user["password_hash"]):
+            return None
+        return user
+
+    def ban_user(self, user_id: str) -> None:
+        self._update("users", user_id, {"banned": 1})
+
+    # ---- models ----
+    def create_model(self, user_id: str, name: str, task: str,
+                     model_class: str, model_bytes: bytes,
+                     dependencies: Optional[Dict[str, str]] = None,
+                     access_right: str = "PRIVATE") -> Dict[str, Any]:
+        row = {"id": _uid(), "user_id": user_id, "name": name, "task": task,
+               "model_class": model_class, "model_bytes": model_bytes,
+               "dependencies": json.dumps(dependencies or {}),
+               "access_right": access_right, "created_at": _now()}
+        self._insert("models", row)
+        return self.get_model(row["id"])  # type: ignore[return-value]
+
+    def get_model(self, model_id: str) -> Optional[Dict[str, Any]]:
+        return self._one("SELECT * FROM models WHERE id=?", (model_id,))
+
+    def get_model_by_name(self, user_id: str,
+                          name: str) -> Optional[Dict[str, Any]]:
+        return self._one(
+            "SELECT * FROM models WHERE user_id=? AND name=?",
+            (user_id, name))
+
+    def get_available_models(self, task: Optional[str] = None,
+                             user_id: Optional[str] = None
+                             ) -> List[Dict[str, Any]]:
+        """Models usable by ``user_id``: their own plus PUBLIC ones."""
+        sql = "SELECT * FROM models WHERE 1=1"
+        args: list = []
+        if task is not None:
+            sql += " AND task=?"
+            args.append(task)
+        if user_id is not None:
+            sql += " AND (user_id=? OR access_right='PUBLIC')"
+            args.append(user_id)
+        return self._all(sql + " ORDER BY created_at", tuple(args))
+
+    # ---- datasets ----
+    def create_dataset(self, user_id: str, name: str, task: str, uri: str,
+                       size_bytes: int = 0,
+                       stat: Optional[Dict[str, Any]] = None
+                       ) -> Dict[str, Any]:
+        row = {"id": _uid(), "user_id": user_id, "name": name, "task": task,
+               "uri": uri, "size_bytes": size_bytes,
+               "stat": json.dumps(stat or {}), "created_at": _now()}
+        self._insert("datasets", row)
+        return self.get_dataset(row["id"])  # type: ignore[return-value]
+
+    def get_dataset(self, dataset_id: str) -> Optional[Dict[str, Any]]:
+        return self._one("SELECT * FROM datasets WHERE id=?", (dataset_id,))
+
+    def get_datasets(self, user_id: str,
+                     task: Optional[str] = None) -> List[Dict[str, Any]]:
+        if task:
+            return self._all(
+                "SELECT * FROM datasets WHERE user_id=? AND task=?",
+                (user_id, task))
+        return self._all("SELECT * FROM datasets WHERE user_id=?", (user_id,))
+
+    # ---- train jobs ----
+    def create_train_job(self, user_id: str, app: str, app_version: int,
+                         task: str, budget: Dict[str, Any],
+                         train_dataset_id: str, val_dataset_id: str,
+                         train_args: Optional[Dict[str, Any]] = None
+                         ) -> Dict[str, Any]:
+        row = {"id": _uid(), "user_id": user_id, "app": app,
+               "app_version": app_version, "task": task,
+               "budget": json.dumps(budget),
+               "train_dataset_id": train_dataset_id,
+               "val_dataset_id": val_dataset_id,
+               "train_args": json.dumps(train_args or {}),
+               "status": "STARTED", "created_at": _now()}
+        self._insert("train_jobs", row)
+        return self.get_train_job(row["id"])  # type: ignore[return-value]
+
+    def get_train_job(self, job_id: str) -> Optional[Dict[str, Any]]:
+        return self._one("SELECT * FROM train_jobs WHERE id=?", (job_id,))
+
+    def get_train_jobs_of_app(self, user_id: str,
+                              app: str) -> List[Dict[str, Any]]:
+        return self._all(
+            "SELECT * FROM train_jobs WHERE user_id=? AND app=? "
+            "ORDER BY app_version DESC", (user_id, app))
+
+    def get_latest_train_job_of_app(self, user_id: str,
+                                    app: str) -> Optional[Dict[str, Any]]:
+        jobs = self.get_train_jobs_of_app(user_id, app)
+        return jobs[0] if jobs else None
+
+    def update_train_job(self, job_id: str, **fields: Any) -> None:
+        self._update("train_jobs", job_id, fields)
+
+    # ---- sub train jobs ----
+    def create_sub_train_job(self, train_job_id: str,
+                             model_id: str) -> Dict[str, Any]:
+        row = {"id": _uid(), "train_job_id": train_job_id,
+               "model_id": model_id, "status": "STARTED",
+               "created_at": _now()}
+        self._insert("sub_train_jobs", row)
+        return self._one("SELECT * FROM sub_train_jobs WHERE id=?",
+                         (row["id"],))  # type: ignore[return-value]
+
+    def get_sub_train_job(self, sid: str) -> Optional[Dict[str, Any]]:
+        return self._one("SELECT * FROM sub_train_jobs WHERE id=?", (sid,))
+
+    def get_sub_train_jobs_of_train_job(
+            self, train_job_id: str) -> List[Dict[str, Any]]:
+        return self._all(
+            "SELECT * FROM sub_train_jobs WHERE train_job_id=?",
+            (train_job_id,))
+
+    def update_sub_train_job(self, sid: str, **fields: Any) -> None:
+        self._update("sub_train_jobs", sid, fields)
+
+    # ---- trials ----
+    def create_trial(self, sub_train_job_id: str, trial_no: int,
+                     model_id: str, knobs: Dict[str, Any],
+                     worker_id: str = "", budget_scale: float = 1.0,
+                     shape_sig: str = "") -> Dict[str, Any]:
+        row = {"id": _uid(), "sub_train_job_id": sub_train_job_id,
+               "trial_no": trial_no, "model_id": model_id,
+               "worker_id": worker_id, "knobs": json.dumps(knobs),
+               "budget_scale": budget_scale, "shape_signature": shape_sig,
+               "status": "RUNNING", "started_at": _now(),
+               "created_at": _now()}
+        self._insert("trials", row)
+        return self.get_trial(row["id"])  # type: ignore[return-value]
+
+    def get_trial(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        return self._one("SELECT * FROM trials WHERE id=?", (trial_id,))
+
+    def update_trial(self, trial_id: str, **fields: Any) -> None:
+        if "knobs" in fields and not isinstance(fields["knobs"], str):
+            fields["knobs"] = json.dumps(fields["knobs"])
+        self._update("trials", trial_id, fields)
+
+    def mark_trial_completed(self, trial_id: str, score: float,
+                             params_saved: bool) -> None:
+        self.update_trial(trial_id, status="COMPLETED", score=score,
+                          params_saved=int(params_saved), stopped_at=_now())
+
+    def mark_trial_errored(self, trial_id: str, error: str) -> None:
+        self.update_trial(trial_id, status="ERRORED", error=error[:4000],
+                          stopped_at=_now())
+
+    def get_trials_of_sub_train_job(
+            self, sub_train_job_id: str) -> List[Dict[str, Any]]:
+        return self._all(
+            "SELECT * FROM trials WHERE sub_train_job_id=? ORDER BY trial_no",
+            (sub_train_job_id,))
+
+    def get_trials_of_train_job(self,
+                                train_job_id: str) -> List[Dict[str, Any]]:
+        return self._all(
+            "SELECT t.* FROM trials t JOIN sub_train_jobs s "
+            "ON t.sub_train_job_id = s.id WHERE s.train_job_id=? "
+            "ORDER BY t.trial_no", (train_job_id,))
+
+    def get_best_trials_of_train_job(self, train_job_id: str,
+                                     max_count: int = 2
+                                     ) -> List[Dict[str, Any]]:
+        """Top completed full-budget trials with saved params — the set the
+        inference job deploys (reference default: top 2)."""
+        return self._all(
+            "SELECT t.* FROM trials t JOIN sub_train_jobs s "
+            "ON t.sub_train_job_id = s.id "
+            "WHERE s.train_job_id=? AND t.status='COMPLETED' "
+            "AND t.params_saved=1 AND t.budget_scale>=1.0 "
+            "ORDER BY t.score DESC LIMIT ?", (train_job_id, max_count))
+
+    # ---- trial logs ----
+    def add_trial_log(self, trial_id: str, kind: str, data: Dict[str, Any],
+                      t: Optional[float] = None) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO trial_logs (trial_id, time, kind, data) "
+                "VALUES (?,?,?,?)",
+                (trial_id, t if t is not None else _now(), kind,
+                 json.dumps(data)))
+            self._conn.commit()
+
+    def get_trial_logs(self, trial_id: str) -> List[Dict[str, Any]]:
+        rows = self._all(
+            "SELECT * FROM trial_logs WHERE trial_id=? ORDER BY id",
+            (trial_id,))
+        for r in rows:
+            r["data"] = json.loads(r["data"])
+        return rows
+
+    # ---- inference jobs ----
+    def create_inference_job(self, user_id: str, train_job_id: str,
+                             budget: Optional[Dict[str, Any]] = None
+                             ) -> Dict[str, Any]:
+        row = {"id": _uid(), "user_id": user_id,
+               "train_job_id": train_job_id,
+               "budget": json.dumps(budget or {}), "status": "STARTED",
+               "created_at": _now()}
+        self._insert("inference_jobs", row)
+        return self.get_inference_job(row["id"])  # type: ignore[return-value]
+
+    def get_inference_job(self, job_id: str) -> Optional[Dict[str, Any]]:
+        return self._one("SELECT * FROM inference_jobs WHERE id=?", (job_id,))
+
+    def get_inference_jobs_of_train_job(
+            self, train_job_id: str) -> List[Dict[str, Any]]:
+        return self._all(
+            "SELECT * FROM inference_jobs WHERE train_job_id=? "
+            "ORDER BY created_at DESC", (train_job_id,))
+
+    def update_inference_job(self, job_id: str, **fields: Any) -> None:
+        self._update("inference_jobs", job_id, fields)
+
+    # ---- services ----
+    def create_service(self, service_type: str,
+                       train_job_id: Optional[str] = None,
+                       sub_train_job_id: Optional[str] = None,
+                       inference_job_id: Optional[str] = None,
+                       host: str = "", port: int = 0, pid: int = 0,
+                       devices: Optional[List[int]] = None
+                       ) -> Dict[str, Any]:
+        row = {"id": _uid(), "service_type": service_type,
+               "status": "STARTED", "train_job_id": train_job_id,
+               "sub_train_job_id": sub_train_job_id,
+               "inference_job_id": inference_job_id, "host": host,
+               "port": port, "pid": pid,
+               "devices": json.dumps(devices or []), "created_at": _now()}
+        self._insert("services", row)
+        return self.get_service(row["id"])  # type: ignore[return-value]
+
+    def get_service(self, service_id: str) -> Optional[Dict[str, Any]]:
+        return self._one("SELECT * FROM services WHERE id=?", (service_id,))
+
+    def get_services(self, status: Optional[str] = None
+                     ) -> List[Dict[str, Any]]:
+        if status:
+            return self._all("SELECT * FROM services WHERE status=?",
+                             (status,))
+        return self._all("SELECT * FROM services")
+
+    def update_service(self, service_id: str, **fields: Any) -> None:
+        self._update("services", service_id, fields)
+
+
+def _hash_password(password: str, salt: str) -> str:
+    return hashlib.pbkdf2_hmac("sha256", password.encode(),
+                               bytes.fromhex(salt), 100_000).hex()

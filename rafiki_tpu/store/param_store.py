@@ -1,0 +1,237 @@
+"""ParamStore: trial parameter blobs + cross-trial sharing.
+
+Parity target: the reference's Redis-backed ParamStore with a session-level
+cache (SURVEY.md §2 "Param store", §5.4): workers save a trial's parameters
+after training and load them for warm starts (the paper's collaborative
+tuning) and for inference-worker boot.
+
+TPU-first deltas:
+- Blobs are JAX pytrees serialized with flax's msgpack (host numpy), so
+  save/load is framework-native — no pickles.
+- Backends: in-process dict (tests), filesystem directory (the TPU-VM host
+  plays the role the Redis container did — SURVEY.md §5.8(b)), and the
+  native kv server (``rafiki_tpu.native``) for cross-host deployments.
+- An LRU bytes-cache in front of any backend mirrors the reference's
+  "session-level cache".
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import os
+import tempfile
+import threading
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+Params = Dict[str, Any]
+
+
+# ---- serialization ---------------------------------------------------------
+
+def params_to_bytes(params: Params) -> bytes:
+    from flax import serialization
+
+    host = _to_host(params)
+    return serialization.msgpack_serialize(host)
+
+
+def params_from_bytes(data: bytes) -> Params:
+    from flax import serialization
+
+    return serialization.msgpack_restore(data)
+
+
+def _to_host(tree: Any) -> Any:
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if hasattr(x, "shape") else x, tree)
+
+
+# ---- backends --------------------------------------------------------------
+
+class ParamBackend:
+    def put(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    def keys(self) -> List[str]:
+        raise NotImplementedError
+
+
+class InMemoryBackend(ParamBackend):
+    def __init__(self) -> None:
+        self._data: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def put(self, key: str, data: bytes) -> None:
+        with self._lock:
+            self._data[key] = data
+
+    def get(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(key)
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._data.pop(key, None)
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return list(self._data)
+
+
+class FileBackend(ParamBackend):
+    """One blob per file; atomic writes via rename. Keys are sanitized to
+    hashes so arbitrary trial ids can't traverse paths."""
+
+    def __init__(self, root: str) -> None:
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self._names: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        self._load_index()
+
+    def _fname(self, key: str) -> str:
+        return hashlib.sha256(key.encode()).hexdigest()[:32] + ".msgpack"
+
+    def _load_index(self) -> None:
+        idx = self.root / "index.tsv"
+        if idx.exists():
+            for line in idx.read_text().splitlines():
+                if "\t" in line:
+                    k, f = line.split("\t", 1)
+                    self._names[k] = f
+
+    def _append_index(self, key: str, fname: str) -> None:
+        with open(self.root / "index.tsv", "a") as f:
+            f.write(f"{key}\t{fname}\n")
+
+    def put(self, key: str, data: bytes) -> None:
+        fname = self._fname(key)
+        fd, tmp = tempfile.mkstemp(dir=self.root)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            os.replace(tmp, self.root / fname)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        with self._lock:
+            if key not in self._names:
+                self._names[key] = fname
+                self._append_index(key, fname)
+
+    def get(self, key: str) -> Optional[bytes]:
+        path = self.root / self._fname(key)
+        try:
+            return path.read_bytes()
+        except FileNotFoundError:
+            return None
+
+    def delete(self, key: str) -> None:
+        try:
+            (self.root / self._fname(key)).unlink()
+        except FileNotFoundError:
+            pass
+        with self._lock:
+            self._names.pop(key, None)
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return [k for k, f in self._names.items()
+                    if (self.root / f).exists()]
+
+
+class KVBackend(ParamBackend):
+    """Backend over the native kv/queue data-plane server (Redis stand-in)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6399) -> None:
+        from ..native.client import KVClient
+
+        self._client = KVClient(host, port)
+
+    def put(self, key: str, data: bytes) -> None:
+        self._client.set(f"params:{key}", data)
+
+    def get(self, key: str) -> Optional[bytes]:
+        return self._client.get(f"params:{key}")
+
+    def delete(self, key: str) -> None:
+        self._client.delete(f"params:{key}")
+
+    def keys(self) -> List[str]:
+        return [k[len("params:"):] for k in self._client.keys("params:*")]
+
+
+# ---- the store -------------------------------------------------------------
+
+class ParamStore:
+    """Save/load trial parameters with an LRU bytes cache."""
+
+    def __init__(self, backend: Optional[ParamBackend] = None,
+                 cache_size: int = 4) -> None:
+        self.backend = backend or InMemoryBackend()
+        self._cache: "collections.OrderedDict[str, bytes]" = \
+            collections.OrderedDict()
+        self._cache_size = cache_size
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def from_uri(uri: str) -> "ParamStore":
+        """``mem://`` | ``file:///path`` | ``kv://host:port``."""
+        if uri.startswith("mem://") or uri == "mem":
+            return ParamStore(InMemoryBackend())
+        if uri.startswith("file://"):
+            return ParamStore(FileBackend(uri[len("file://"):]))
+        if uri.startswith("kv://"):
+            host, _, port = uri[len("kv://"):].partition(":")
+            return ParamStore(KVBackend(host or "127.0.0.1",
+                                        int(port or 6399)))
+        return ParamStore(FileBackend(uri))  # bare path
+
+    def save(self, trial_id: str, params: Params) -> str:
+        data = params_to_bytes(params)
+        self.backend.put(trial_id, data)
+        with self._lock:
+            self._cache[trial_id] = data
+            self._cache.move_to_end(trial_id)
+            while len(self._cache) > self._cache_size:
+                self._cache.popitem(last=False)
+        return trial_id
+
+    def load(self, trial_id: str) -> Optional[Params]:
+        with self._lock:
+            data = self._cache.get(trial_id)
+            if data is not None:
+                self._cache.move_to_end(trial_id)
+        if data is None:
+            data = self.backend.get(trial_id)
+            if data is None:
+                return None
+            with self._lock:
+                self._cache[trial_id] = data
+                while len(self._cache) > self._cache_size:
+                    self._cache.popitem(last=False)
+        return params_from_bytes(data)
+
+    def delete(self, trial_id: str) -> None:
+        self.backend.delete(trial_id)
+        with self._lock:
+            self._cache.pop(trial_id, None)
+
+    def keys(self) -> List[str]:
+        return self.backend.keys()

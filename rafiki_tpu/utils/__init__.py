@@ -1,0 +1,5 @@
+"""Shared utilities: JSON-HTTP service kit, serialization, ids."""
+
+from .http import JsonHttpService, http_error, json_request
+
+__all__ = ["JsonHttpService", "http_error", "json_request"]

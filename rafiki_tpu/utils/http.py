@@ -1,0 +1,164 @@
+"""Minimal JSON-over-HTTP service kit (stdlib only).
+
+The reference's services speak Flask REST between containers (SURVEY.md §3,
+§5.8). Flask isn't in this image, so this module provides the same
+ergonomics on ``http.server``: a route table of
+``(method, path_pattern) -> handler(match, body_json, headers) -> (status,
+json)`` served by a threading server. Path patterns use ``<name>``
+segments, e.g. ``/train_jobs/<id>/stop``.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+Handler = Callable[[Dict[str, str], Any, Dict[str, str]],
+                   Tuple[int, Any]]
+
+
+def _compile(pattern: str) -> re.Pattern:
+    regex = re.sub(r"<([a-zA-Z_][a-zA-Z0-9_]*)>", r"(?P<\1>[^/]+)", pattern)
+    return re.compile("^" + regex + "$")
+
+
+class JsonHttpService:
+    """A threading HTTP server over a JSON route table."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._routes: List[Tuple[str, re.Pattern, Handler]] = []
+        self._host = host
+        self._port = port
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def route(self, method: str, pattern: str, handler: Handler) -> None:
+        self._routes.append((method.upper(), _compile(pattern), handler))
+
+    # ---- lifecycle ----
+    def start(self) -> Tuple[str, int]:
+        routes = self._routes
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt: str, *args: Any) -> None:
+                pass  # quiet; service logs go through the app layer
+
+            def _dispatch(self, method: str) -> None:
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    raw = self.rfile.read(length) if length else b""
+                    body = json.loads(raw) if raw else None
+                except Exception:
+                    self._reply(400, {"error": "malformed JSON body"})
+                    return
+                path = self.path.split("?", 1)[0]
+                for m, pat, handler in routes:
+                    if m != method:
+                        continue
+                    match = pat.match(path)
+                    if match:
+                        try:
+                            status, payload = handler(
+                                match.groupdict(), body,
+                                dict(self.headers.items()))
+                        except _HttpError as e:
+                            status, payload = e.status, {"error": e.message}
+                        except Exception:
+                            status = 500
+                            payload = {"error": "internal error",
+                                       "detail": traceback.format_exc(
+                                           limit=5)}
+                        self._reply(status, payload)
+                        return
+                self._reply(404, {"error": f"no route {method} {path}"})
+
+            def _reply(self, status: int, payload: Any) -> None:
+                data = json.dumps(payload).encode("utf-8")
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self) -> None:
+                self._dispatch("GET")
+
+            def do_POST(self) -> None:
+                self._dispatch("POST")
+
+            def do_PUT(self) -> None:
+                self._dispatch("PUT")
+
+            def do_DELETE(self) -> None:
+                self._dispatch("DELETE")
+
+        self._server = ThreadingHTTPServer((self._host, self._port), _Handler)
+        self._server.daemon_threads = True
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self._host, self._port
+
+    def serve_forever(self) -> None:
+        """Blocking variant for service main()s."""
+        if self._server is None:
+            self.start()
+        assert self._thread is not None
+        self._thread.join()
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+
+class _HttpError(Exception):
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+def http_error(status: int, message: str) -> _HttpError:
+    return _HttpError(status, message)
+
+
+# ---- client side -----------------------------------------------------------
+
+def json_request(method: str, url: str, body: Any = None,
+                 headers: Optional[Dict[str, str]] = None,
+                 timeout: float = 30.0) -> Any:
+    """Tiny JSON HTTP client (urllib; no external deps in the hot path)."""
+    import urllib.error
+    import urllib.request
+
+    data = json.dumps(body).encode("utf-8") if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method.upper())
+    req.add_header("Content-Type", "application/json")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            raw = resp.read()
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        try:
+            payload = json.loads(raw) if raw else {}
+        except json.JSONDecodeError:
+            payload = {"error": raw.decode("utf-8", "replace")}
+        raise RuntimeError(
+            f"{method} {url} -> {e.code}: {payload.get('error', payload)}"
+        ) from None
+    return json.loads(raw) if raw else None

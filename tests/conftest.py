@@ -7,12 +7,20 @@ Must run before jax initializes its backends, hence env vars at import time.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-override: the image pre-sets JAX_PLATFORMS=axon (the TPU tunnel)
+# and its sitecustomize imports jax at interpreter start, so the env var
+# default is already baked — use jax.config instead, before any backend
+# initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

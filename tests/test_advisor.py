@@ -1,0 +1,157 @@
+"""Advisor algorithms: protocol mechanics + convergence sanity."""
+
+import math
+
+import pytest
+
+from rafiki_tpu.advisor import (ADVISOR_REGISTRY, Proposal, TrialResult,
+                                make_advisor)
+from rafiki_tpu.model.knob import (CategoricalKnob, FixedKnob, FloatKnob,
+                                   IntegerKnob, PolicyKnob)
+
+
+def quadratic_score(knobs):
+    """Smooth objective with max 1.0 at lr=1e-2, width=128."""
+    lr_term = -((math.log10(knobs["lr"]) + 2.0) ** 2) / 4.0
+    w_term = -((math.log2(knobs["width"]) - 7.0) ** 2) / 16.0
+    return 1.0 + lr_term + w_term
+
+
+def search_config():
+    return {
+        "lr": FloatKnob(1e-5, 1e-1, is_exp=True),
+        "width": IntegerKnob(16, 512, is_exp=True),
+        "const": FixedKnob("adam"),
+    }
+
+
+def run_search(advisor, objective, budget_scale_aware=False):
+    trial_id = 0
+    while True:
+        p = advisor.propose()
+        if not p.is_valid:
+            break
+        score = objective(p.knobs)
+        if budget_scale_aware:
+            # low-budget trials see a noisier/worse version of the truth
+            score = score * (0.5 + 0.5 * p.budget_scale)
+        advisor.feedback(TrialResult(
+            trial_no=p.trial_no, knobs=p.knobs, score=score,
+            trial_id=f"t{trial_id}", budget_scale=p.budget_scale,
+            meta=p.meta))
+        trial_id += 1
+    return advisor
+
+
+def test_registry_has_all_algorithms():
+    assert {"random", "bayes_gp", "bohb"} <= set(ADVISOR_REGISTRY)
+
+
+def test_random_respects_trial_budget():
+    adv = make_advisor(search_config(), "random", total_trials=7)
+    run_search(adv, quadratic_score)
+    assert len(adv.results) == 7
+    assert adv.finished
+    assert not adv.propose().is_valid
+
+
+def test_bayes_gp_beats_random():
+    n = 30
+    rnd = run_search(make_advisor(search_config(), "random",
+                                  total_trials=n, seed=0), quadratic_score)
+    gp = run_search(make_advisor(search_config(), "bayes_gp",
+                                 total_trials=n, seed=0), quadratic_score)
+    assert gp.best is not None and rnd.best is not None
+    # GP should find a near-optimal point; random merely a decent one
+    assert gp.best.score >= rnd.best.score - 0.05
+    assert gp.best.score > 0.9
+
+
+def test_bayes_gp_constant_liar_outstanding():
+    adv = make_advisor(search_config(), "bayes_gp", total_trials=20, seed=1)
+    # take several proposals before any feedback (concurrent workers)
+    props = [adv.propose() for _ in range(5)]
+    assert all(p.is_valid for p in props)
+    for p in props:
+        adv.feedback(TrialResult(trial_no=p.trial_no, knobs=p.knobs,
+                                 score=quadratic_score(p.knobs)))
+    run_search(adv, quadratic_score)
+    assert len(adv.results) == 20
+
+
+def bohb_config():
+    cfg = search_config()
+    cfg["quick"] = PolicyKnob("QUICK_TRAIN")
+    cfg["share"] = PolicyKnob("SHARE_PARAMS")
+    return cfg
+
+
+def test_bohb_rungs_and_promotion():
+    adv = make_advisor(bohb_config(), "bohb", total_trials=30, seed=0)
+    assert adv.name == "bohb"
+    run_search(adv, quadratic_score, budget_scale_aware=True)
+    scales = [r.budget_scale for r in adv.results]
+    # some trials ran at reduced budget, some at full
+    assert any(s < 1.0 for s in scales)
+    assert any(s >= 1.0 for s in scales)
+    # promotions warm-start from their parent's checkpoint
+    promoted = [r for r in adv.results if r.meta.get("rung", 0) > 0]
+    assert promoted, "no trial was ever promoted"
+    assert adv.best is not None and adv.best.budget_scale >= 1.0
+
+
+def test_bohb_promotion_chain_reaches_full_budget():
+    adv = make_advisor(bohb_config(), "bohb", total_trials=60, seed=2)
+    run_search(adv, quadratic_score, budget_scale_aware=True)
+    top_rung = max(r.meta.get("rung", 0) for r in adv.results)
+    assert adv.budgets[top_rung] == 1.0
+
+
+def test_bohb_errored_trials_dont_block():
+    adv = make_advisor(bohb_config(), "bohb", total_trials=12, seed=3)
+    ok = 0
+    while True:
+        p = adv.propose()
+        if not p.is_valid:
+            break
+        if p.trial_no % 3 == 0:
+            adv.trial_errored(p.trial_no)
+            continue
+        adv.feedback(TrialResult(trial_no=p.trial_no, knobs=p.knobs,
+                                 score=quadratic_score(p.knobs),
+                                 budget_scale=p.budget_scale, meta=p.meta))
+        ok += 1
+    assert ok > 0
+    assert adv.finished
+
+
+def test_auto_selection():
+    assert make_advisor(bohb_config(), "auto").name == "bohb"
+    assert make_advisor(search_config(), "auto").name == "bayes_gp"
+    assert make_advisor({"c": FixedKnob(1)}, "auto").name == "random"
+
+
+def test_advisor_service_round_trip():
+    from rafiki_tpu.advisor.service import AdvisorClient, AdvisorService
+
+    adv = make_advisor(search_config(), "random", total_trials=4, seed=0)
+    svc = AdvisorService(adv)
+    host, port = svc.start()
+    try:
+        client = AdvisorClient(f"http://{host}:{port}")
+        n = 0
+        while True:
+            p = client.propose()
+            if not p.is_valid:
+                break
+            client.feedback(TrialResult(
+                trial_no=p.trial_no, knobs=p.knobs,
+                score=quadratic_score(p.knobs), trial_id=f"t{n}"))
+            n += 1
+        assert n == 4
+        status = client.status()
+        assert status["finished"] is True
+        assert status["n_results"] == 4
+        assert status["best"]["score"] > 0
+    finally:
+        svc.stop()

@@ -1,0 +1,155 @@
+import threading
+
+import numpy as np
+import pytest
+
+from rafiki_tpu.store import (FileBackend, InMemoryBackend, MetaStore,
+                              ParamStore, params_from_bytes, params_to_bytes)
+
+
+def sample_params():
+    return {"params": {"dense": {"kernel": np.arange(6, dtype=np.float32)
+                                 .reshape(2, 3),
+                                 "bias": np.zeros(3, np.float32)}},
+            "meta": {"n_classes": 3}}
+
+
+def assert_params_equal(a, b):
+    np.testing.assert_array_equal(a["params"]["dense"]["kernel"],
+                                  b["params"]["dense"]["kernel"])
+    assert int(b["meta"]["n_classes"]) == 3
+
+
+def test_params_bytes_round_trip():
+    blob = params_to_bytes(sample_params())
+    assert isinstance(blob, bytes)
+    assert_params_equal(sample_params(), params_from_bytes(blob))
+
+
+@pytest.mark.parametrize("backend_kind", ["mem", "file"])
+def test_param_store_backends(backend_kind, tmp_path):
+    backend = (InMemoryBackend() if backend_kind == "mem"
+               else FileBackend(str(tmp_path / "params")))
+    store = ParamStore(backend, cache_size=2)
+    store.save("trial-1", sample_params())
+    store.save("trial/../2", sample_params())  # hostile key is sanitized
+    assert_params_equal(sample_params(), store.load("trial-1"))
+    assert store.load("nope") is None
+    assert set(store.keys()) == {"trial-1", "trial/../2"}
+    store.delete("trial-1")
+    assert store.load("trial-1") is None
+
+
+def test_param_store_file_persistence(tmp_path):
+    root = str(tmp_path / "params")
+    ParamStore(FileBackend(root)).save("t1", sample_params())
+    # fresh store over the same dir sees the blob (index reload)
+    store2 = ParamStore.from_uri(f"file://{root}")
+    assert_params_equal(sample_params(), store2.load("t1"))
+    assert store2.keys() == ["t1"]
+
+
+def test_meta_store_users_and_auth():
+    ms = MetaStore()
+    u = ms.create_user("dev@x.com", "secret", "MODEL_DEVELOPER")
+    assert ms.authenticate_user("dev@x.com", "secret")["id"] == u["id"]
+    assert ms.authenticate_user("dev@x.com", "wrong") is None
+    assert ms.authenticate_user("ghost@x.com", "secret") is None
+    ms.ban_user(u["id"])
+    assert ms.authenticate_user("dev@x.com", "secret") is None
+
+
+def test_meta_store_models_visibility():
+    ms = MetaStore()
+    a = ms.create_user("a@x.com", "p", "MODEL_DEVELOPER")
+    b = ms.create_user("b@x.com", "p", "MODEL_DEVELOPER")
+    ms.create_model(a["id"], "priv", "IMAGE_CLASSIFICATION", "M", b"src")
+    ms.create_model(a["id"], "pub", "IMAGE_CLASSIFICATION", "M", b"src",
+                    access_right="PUBLIC")
+    ms.create_model(b["id"], "other", "POS_TAGGING", "M", b"src")
+    vis = ms.get_available_models(task="IMAGE_CLASSIFICATION",
+                                  user_id=b["id"])
+    assert [m["name"] for m in vis] == ["pub"]
+    vis_a = ms.get_available_models(user_id=a["id"])
+    assert {m["name"] for m in vis_a} == {"priv", "pub"}
+
+
+def test_meta_store_train_job_lifecycle():
+    ms = MetaStore()
+    u = ms.create_user("u@x.com", "p", "APP_DEVELOPER")
+    m = ms.create_model(u["id"], "mlp", "IMAGE_CLASSIFICATION",
+                        "JaxFeedForward", b"src")
+    d1 = ms.create_dataset(u["id"], "train", "IMAGE_CLASSIFICATION",
+                           "file:///train.npz")
+    d2 = ms.create_dataset(u["id"], "val", "IMAGE_CLASSIFICATION",
+                           "file:///val.npz")
+    job = ms.create_train_job(u["id"], "app", 1, "IMAGE_CLASSIFICATION",
+                              {"TRIAL_COUNT": 4}, d1["id"], d2["id"])
+    sub = ms.create_sub_train_job(job["id"], m["id"])
+
+    t1 = ms.create_trial(sub["id"], 0, m["id"], {"lr": 0.1})
+    t2 = ms.create_trial(sub["id"], 1, m["id"], {"lr": 0.01})
+    t3 = ms.create_trial(sub["id"], 2, m["id"], {"lr": 1.0},
+                         budget_scale=0.3)
+    ms.mark_trial_completed(t1["id"], 0.7, params_saved=True)
+    ms.mark_trial_completed(t2["id"], 0.9, params_saved=True)
+    ms.mark_trial_completed(t3["id"], 0.95, params_saved=True)  # low budget
+    t4 = ms.create_trial(sub["id"], 3, m["id"], {"lr": 9.0})
+    ms.mark_trial_errored(t4["id"], "NaN loss")
+
+    best = ms.get_best_trials_of_train_job(job["id"], max_count=2)
+    # low-budget and errored trials are excluded
+    assert [b["score"] for b in best] == [0.9, 0.7]
+
+    trials = ms.get_trials_of_train_job(job["id"])
+    assert len(trials) == 4
+    assert ms.get_latest_train_job_of_app(u["id"], "app")["id"] == job["id"]
+
+    ms.update_train_job(job["id"], status="STOPPED")
+    assert ms.get_train_job(job["id"])["status"] == "STOPPED"
+    with pytest.raises(KeyError):
+        ms.update_train_job("missing", status="STOPPED")
+
+
+def test_meta_store_trial_logs():
+    ms = MetaStore()
+    ms.add_trial_log("t1", "values", {"epoch": 0, "loss": 1.5})
+    ms.add_trial_log("t1", "values", {"epoch": 1, "loss": 0.5})
+    logs = ms.get_trial_logs("t1")
+    assert [r["data"]["loss"] for r in logs] == [1.5, 0.5]
+
+
+def test_meta_store_concurrent_writes(tmp_path):
+    ms = MetaStore(str(tmp_path / "meta.db"))
+    u = ms.create_user("u@x.com", "p", "APP_DEVELOPER")
+    m = ms.create_model(u["id"], "m", "T", "C", b"s")
+    d = ms.create_dataset(u["id"], "d", "T", "uri")
+    job = ms.create_train_job(u["id"], "app", 1, "T", {}, d["id"], d["id"])
+    sub = ms.create_sub_train_job(job["id"], m["id"])
+
+    errors = []
+
+    def writer(k):
+        try:
+            for i in range(20):
+                t = ms.create_trial(sub["id"], k * 100 + i, m["id"], {})
+                ms.mark_trial_completed(t["id"], 0.5, params_saved=True)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(k,)) for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert len(ms.get_trials_of_sub_train_job(sub["id"])) == 80
+
+
+def test_param_store_lru_cache_eviction():
+    store = ParamStore(InMemoryBackend(), cache_size=2)
+    for i in range(4):
+        store.save(f"t{i}", sample_params())
+    assert len(store._cache) == 2
+    # evicted entries still load through the backend
+    assert_params_equal(sample_params(), store.load("t0"))
