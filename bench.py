@@ -1,0 +1,92 @@
+"""Headline benchmark — prints ONE JSON line for the driver.
+
+Metric: flagship-model training throughput (samples/sec) on the available
+accelerator (one TPU chip under the driver; CPU locally). The reference
+published no numbers (BASELINE.md: ``"published": {}``), so
+``vs_baseline`` compares against the last locally recorded run in
+``.bench_history.json`` when present (ratio >1 = faster), else 1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def _bench_train_throughput():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    try:
+        from rafiki_tpu.models.vit import ViT
+
+        module = ViT(patch_size=16, hidden_dim=768, depth=12, n_heads=12,
+                     mlp_dim=3072, n_classes=1000)
+        batch = 32 if jax.default_backend() != "cpu" else 4
+        x = jnp.zeros((batch, 224, 224, 3), jnp.bfloat16)
+        name = "vit_b16_train_throughput"
+    except ImportError:
+        from rafiki_tpu.models.mlp import _MLP
+
+        module = _MLP(hidden_layer_count=3, hidden_layer_units=256,
+                      n_classes=10)
+        batch = 512
+        x = jnp.zeros((batch, 28, 28, 1), jnp.float32)
+        name = "mlp_train_throughput"
+
+    y = jnp.zeros((batch,), jnp.int32)
+    params = module.init(jax.random.PRNGKey(0), x)["params"]
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, xb, yb):
+        def loss_fn(p):
+            logits = module.apply({"params": p}, xb)
+            return jnp.mean(
+                optax.softmax_cross_entropy_with_integer_labels(logits, yb))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    # warmup / compile
+    params, opt_state, loss = step(params, opt_state, x, y)
+    jax.block_until_ready(loss)
+
+    iters = 20 if jax.default_backend() != "cpu" else 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, x, y)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return name, batch * iters / dt
+
+
+def main() -> None:
+    name, value = _bench_train_throughput()
+    hist_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".bench_history.json")
+    vs = 1.0
+    try:
+        with open(hist_path) as f:
+            hist = json.load(f)
+        prev = hist.get(name)
+        if prev:
+            vs = value / prev
+    except (OSError, ValueError):
+        hist = {}
+    hist[name] = value
+    try:
+        with open(hist_path, "w") as f:
+            json.dump(hist, f)
+    except OSError:
+        pass
+    print(json.dumps({"metric": name, "value": round(value, 2),
+                      "unit": "samples/sec", "vs_baseline": round(vs, 3)}))
+
+
+if __name__ == "__main__":
+    main()

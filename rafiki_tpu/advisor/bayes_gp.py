@@ -17,7 +17,8 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..model.knob import (KnobConfig, PolicyKnob, knobs_from_unit_vector,
-                          knobs_to_unit_vector, sample_knobs, tunable_knobs)
+                          knobs_to_unit_vector, sample_knobs,
+                          shape_signature, tunable_knobs)
 from .base import BaseAdvisor, Proposal, TrialResult
 
 
@@ -50,7 +51,11 @@ class BayesOptAdvisor(BaseAdvisor):
             knobs = knobs_from_unit_vector(self.knob_config, vec, self._rng)
         self._pending[trial_no] = vec
         warm_start = ""
-        if self.best is not None and self.best.trial_id:
+        # Warm-start from the incumbent only when the proposal's traced
+        # shapes match it — otherwise loading its pytree would mis-shape.
+        if (self.best is not None and self.best.trial_id
+                and shape_signature(self.knob_config, knobs)
+                == shape_signature(self.knob_config, self.best.knobs)):
             for n, k in self.knob_config.items():
                 if isinstance(k, PolicyKnob) and k.policy == "SHARE_PARAMS":
                     knobs[n] = True

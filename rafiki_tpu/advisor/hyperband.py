@@ -104,13 +104,26 @@ class BOHBAdvisor(BaseAdvisor):
         return Proposal(trial_no=trial_no, knobs=knobs,
                         budget_scale=self.budgets[0], meta={"rung": 0})
 
+    #: per-rung history cap for long-running services: beyond this, the
+    #: worst-scoring unpromoted entries are pruned (they are strictly
+    #: dominated, so dropping them only tightens the promotion bar).
+    MAX_RUNG_ENTRIES = 2048
+
     def _feedback(self, result: TrialResult) -> None:
-        info = self._by_trial_no.get(result.trial_no)
+        info = self._by_trial_no.pop(result.trial_no, None)
         if info is None:
             return
-        _, entry = info
+        rung, entry = info
         entry.score = float(result.score)
         entry.trial_id = result.trial_id
+        if len(self._rungs[rung]) > self.MAX_RUNG_ENTRIES:
+            done = sorted((e for e in self._rungs[rung]
+                           if e.score is not None and not e.promoted),
+                          key=lambda e: e.score)
+            drop = set(id(e) for e in
+                       done[:len(self._rungs[rung]) - self.MAX_RUNG_ENTRIES])
+            self._rungs[rung] = [e for e in self._rungs[rung]
+                                 if id(e) not in drop]
 
     def _on_trial_errored(self, trial_no: int) -> None:
         info = self._by_trial_no.pop(trial_no, None)

@@ -62,13 +62,14 @@ def partition_devices(devices: Sequence[Device],
         raise ValueError(f"slot_size {slot_size} must divide {n} devices")
     ordered = sorted(devices, key=device_sort_key)
     rows, cols = _grid_shape(ordered)
-    grid = np.empty((rows, cols), dtype=object)
+    grid = np.full((rows, cols), None, dtype=object)
     coords = [getattr(d, "coords", None) for d in ordered]
+    xs = sorted({c[0] for c in coords if c is not None})
+    ys = sorted({c[1] for c in coords if c is not None})
     if (all(c is not None for c in coords)
-            and len({(c[0], c[1]) for c in coords}) == len(ordered)):
-        # place by physical coordinates: grid[y][x]
-        xs = sorted({c[0] for c in coords})
-        ys = sorted({c[1] for c in coords})
+            and len({(c[0], c[1]) for c in coords}) == len(ordered)
+            and (len(ys), len(xs)) == (rows, cols)):
+        # coords form a full rectangle: place by physical position grid[y][x]
         x_index = {x: i for i, x in enumerate(xs)}
         y_index = {y: i for i, y in enumerate(ys)}
         for d, c in zip(ordered, coords):
@@ -178,8 +179,7 @@ class SubMeshAllocator:
             return len(self._free)
 
 
-def submesh_env_vars(platform: str, slot: SubMesh,
-                     total_devices: int) -> Dict[str, str]:
+def submesh_env_vars(platform: str, slot: SubMesh) -> Dict[str, str]:
     """Env vars that confine a *child process* to ``slot``'s devices.
 
     This is how one host runs N concurrent single-trial JAX processes on

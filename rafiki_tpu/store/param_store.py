@@ -203,14 +203,17 @@ class ParamStore:
                                         int(port or 6399)))
         return ParamStore(FileBackend(uri))  # bare path
 
-    def save(self, trial_id: str, params: Params) -> str:
-        data = params_to_bytes(params)
-        self.backend.put(trial_id, data)
+    def _cache_put(self, trial_id: str, data: bytes) -> None:
         with self._lock:
             self._cache[trial_id] = data
             self._cache.move_to_end(trial_id)
             while len(self._cache) > self._cache_size:
                 self._cache.popitem(last=False)
+
+    def save(self, trial_id: str, params: Params) -> str:
+        data = params_to_bytes(params)
+        self.backend.put(trial_id, data)
+        self._cache_put(trial_id, data)
         return trial_id
 
     def load(self, trial_id: str) -> Optional[Params]:
@@ -222,10 +225,7 @@ class ParamStore:
             data = self.backend.get(trial_id)
             if data is None:
                 return None
-            with self._lock:
-                self._cache[trial_id] = data
-                while len(self._cache) > self._cache_size:
-                    self._cache.popitem(last=False)
+            self._cache_put(trial_id, data)
         return params_from_bytes(data)
 
     def delete(self, trial_id: str) -> None:

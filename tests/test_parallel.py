@@ -98,9 +98,9 @@ def test_submesh_mesh_axes():
 
 def test_submesh_env_vars():
     sm = SubMesh(0, list(jax.devices())[:2])
-    env = submesh_env_vars("cpu", sm, 8)
+    env = submesh_env_vars("cpu", sm)
     assert "device_count=2" in env["XLA_FLAGS"]
-    tpu_env = submesh_env_vars("tpu", sm, 8)
+    tpu_env = submesh_env_vars("tpu", sm)
     assert tpu_env["TPU_VISIBLE_CHIPS"] == "0,1"
 
 
