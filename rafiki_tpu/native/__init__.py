@@ -1,0 +1,9 @@
+"""Native (C++) data-plane components and their Python clients.
+
+``kv_server.cc`` → ``rafiki-kvd``: the host-side kv/queue server standing
+in for the reference deployment's Redis (params + query queues).
+"""
+
+from .client import KVClient, KVServer, ensure_built, wait_for_server
+
+__all__ = ["KVClient", "KVServer", "ensure_built", "wait_for_server"]

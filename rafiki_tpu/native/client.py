@@ -1,0 +1,216 @@
+"""Python client + lifecycle helpers for the native kv/queue server.
+
+The server (``kv_server.cc``) is the rebuild's Redis: the reference keeps
+trial parameter blobs and the predictor's query/prediction queues in a
+Redis container (SURVEY.md §2, §5.8(b)); here the same data plane is a
+single small C++ binary on the TPU-VM host. The wire protocol is a
+RESP-compatible subset, so this client is a thin framing layer.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import socket
+import subprocess
+import threading
+import time
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+_NATIVE_DIR = Path(__file__).resolve().parent
+_BINARY = _NATIVE_DIR / "build" / "rafiki-kvd"
+
+
+def ensure_built(force: bool = False) -> Path:
+    """Compile the server if needed; returns the binary path."""
+    src = _NATIVE_DIR / "kv_server.cc"
+    if not force and _BINARY.exists() and \
+            _BINARY.stat().st_mtime >= src.stat().st_mtime:
+        return _BINARY
+    make = shutil.which("make")
+    if make is None:
+        raise RuntimeError("`make` not found; cannot build rafiki-kvd")
+    subprocess.run([make, "-C", str(_NATIVE_DIR)], check=True,
+                   capture_output=True)
+    return _BINARY
+
+
+class KVServer:
+    """Spawn/own a rafiki-kvd process (test + single-host deployments)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        binary = ensure_built()
+        self._proc = subprocess.Popen(
+            [str(binary), "--host", host, "--port", str(port)],
+            stdout=subprocess.PIPE, text=True)
+        line = self._proc.stdout.readline()  # "... listening on H:P"
+        if "listening on" not in line:
+            raise RuntimeError(f"rafiki-kvd failed to start: {line!r}")
+        hp = line.rsplit(" ", 1)[-1].strip()
+        self.host, _, port_s = hp.partition(":")
+        self.port = int(port_s)
+
+    def stop(self) -> None:
+        try:
+            KVClient(self.host, self.port).shutdown()
+        except OSError:
+            pass
+        try:
+            self._proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            self._proc.kill()
+
+    def __enter__(self) -> "KVServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def _encode(args: List[bytes]) -> bytes:
+    out = [b"*%d\r\n" % len(args)]
+    for a in args:
+        out.append(b"$%d\r\n%s\r\n" % (len(a), a))
+    return b"".join(out)
+
+
+class KVClient:
+    """Blocking client; thread-safe (one socket, one lock).
+
+    For concurrent blocking pops (inference workers) use one client per
+    thread — a BRPOP holds the socket for up to its timeout.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6399,
+                 connect_timeout: float = 5.0) -> None:
+        self._sock = socket.create_connection((host, port),
+                                              timeout=connect_timeout)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._buf = b""
+        self._lock = threading.Lock()
+
+    # ---- framing ----
+    def _read_line(self) -> bytes:
+        while b"\r\n" not in self._buf:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("kv server closed connection")
+            self._buf += chunk
+        line, self._buf = self._buf.split(b"\r\n", 1)
+        return line
+
+    def _read_n(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("kv server closed connection")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def _read_reply(self):
+        line = self._read_line()
+        tag, rest = line[:1], line[1:]
+        if tag == b"+":
+            return rest.decode()
+        if tag == b"-":
+            raise RuntimeError(rest.decode())
+        if tag == b":":
+            return int(rest)
+        if tag == b"$":
+            n = int(rest)
+            if n < 0:
+                return None
+            data = self._read_n(n)
+            self._read_n(2)  # CRLF
+            return data
+        if tag == b"*":
+            n = int(rest)
+            if n < 0:
+                return None
+            return [self._read_reply() for _ in range(n)]
+        raise RuntimeError(f"bad reply tag {line!r}")
+
+    def _cmd(self, *args) -> object:
+        enc = [a if isinstance(a, bytes) else str(a).encode()
+               for a in args]
+        with self._lock:
+            self._sock.sendall(_encode(enc))
+            return self._read_reply()
+
+    # ---- api ----
+    def ping(self) -> bool:
+        return self._cmd("PING") == "PONG"
+
+    def set(self, key: str, value: bytes) -> None:
+        self._cmd("SET", key, value)
+
+    def get(self, key: str) -> Optional[bytes]:
+        return self._cmd("GET", key)
+
+    def delete(self, *keys: str) -> int:
+        return int(self._cmd("DEL", *keys))
+
+    def exists(self, key: str) -> bool:
+        return bool(self._cmd("EXISTS", key))
+
+    def keys(self, pattern: str = "*") -> List[str]:
+        return sorted(k.decode() for k in self._cmd("KEYS", pattern))
+
+    def incr(self, key: str) -> int:
+        return int(self._cmd("INCR", key))
+
+    def lpush(self, key: str, *values: bytes) -> int:
+        return int(self._cmd("LPUSH", key, *values))
+
+    def rpush(self, key: str, *values: bytes) -> int:
+        return int(self._cmd("RPUSH", key, *values))
+
+    def lpop(self, key: str) -> Optional[bytes]:
+        return self._cmd("LPOP", key)
+
+    def llen(self, key: str) -> int:
+        return int(self._cmd("LLEN", key))
+
+    def brpop(self, keys, timeout: float
+              ) -> Optional[Tuple[str, bytes]]:
+        """Blocking tail-pop across ``keys``; None on timeout."""
+        if isinstance(keys, str):
+            keys = [keys]
+        reply = self._cmd("BRPOP", *keys, timeout)
+        if reply is None:
+            return None
+        k, v = reply
+        return k.decode(), v
+
+    def flushall(self) -> None:
+        self._cmd("FLUSHALL")
+
+    def shutdown(self) -> None:
+        try:
+            self._cmd("SHUTDOWN")
+        except (ConnectionError, RuntimeError):
+            pass
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def wait_for_server(host: str, port: int, timeout: float = 10.0) -> KVClient:
+    """Connect with retries until the server answers PING."""
+    deadline = time.monotonic() + timeout
+    last: Optional[Exception] = None
+    while time.monotonic() < deadline:
+        try:
+            c = KVClient(host, port, connect_timeout=1.0)
+            if c.ping():
+                return c
+        except OSError as e:
+            last = e
+            time.sleep(0.05)
+    raise TimeoutError(f"kv server at {host}:{port} not up: {last}")

@@ -1,0 +1,306 @@
+// rafiki-kvd — the native kv/queue data-plane server.
+//
+// Plays the role Redis plays in the reference deployment (SURVEY.md §2
+// "Param store" / "Query/prediction queues", §5.8(b)): one small server on
+// the TPU-VM host carrying (a) trial parameter blobs and (b) the
+// predictor's per-worker query/prediction queues. Speaks a RESP-compatible
+// subset so the Python client stays trivial; the implementation is original
+// (thread-per-connection, one store mutex, condition variable for blocking
+// pops — the right scale for tens of workers on one host, not thousands).
+//
+// Commands: PING, SET, GET, DEL, EXISTS, KEYS <glob>, INCR,
+//           LPUSH, RPUSH, BRPOP <key...> <timeout_s>, LPOP, LLEN,
+//           FLUSHALL, SHUTDOWN.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::mutex mu;
+  std::condition_variable list_cv;  // signalled on any list push
+  std::unordered_map<std::string, std::string> kv;
+  std::unordered_map<std::string, std::deque<std::string>> lists;
+};
+
+Store g_store;
+std::atomic<bool> g_shutdown{false};
+int g_listen_fd = -1;
+
+// ---- glob match (supports * and ?) ----------------------------------------
+bool GlobMatch(const char* p, const char* s) {
+  for (; *p; ++p, ++s) {
+    if (*p == '*') {
+      while (*(p + 1) == '*') ++p;
+      for (const char* t = s + strlen(s); t >= s; --t)
+        if (GlobMatch(p + 1, t)) return true;
+      return false;
+    }
+    if (*s == '\0' || (*p != '?' && *p != *s)) return false;
+  }
+  return *s == '\0';
+}
+
+// ---- socket io ------------------------------------------------------------
+bool ReadN(int fd, char* buf, size_t n) {
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = read(fd, buf + got, n - got);
+    if (r <= 0) return false;
+    got += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool ReadLine(int fd, std::string* out) {
+  // RESP lines are short (headers only); read byte-wise up to CRLF.
+  out->clear();
+  char c;
+  while (true) {
+    if (!ReadN(fd, &c, 1)) return false;
+    if (c == '\r') {
+      if (!ReadN(fd, &c, 1) || c != '\n') return false;
+      return true;
+    }
+    out->push_back(c);
+    if (out->size() > 1 << 16) return false;  // header bomb guard
+  }
+}
+
+bool WriteAll(int fd, const std::string& data) {
+  size_t sent = 0;
+  while (sent < data.size()) {
+    ssize_t w = write(fd, data.data() + sent, data.size() - sent);
+    if (w <= 0) return false;
+    sent += static_cast<size_t>(w);
+  }
+  return true;
+}
+
+std::string Bulk(const std::string& s) {
+  return "$" + std::to_string(s.size()) + "\r\n" + s + "\r\n";
+}
+const std::string kNil = "$-1\r\n";
+const std::string kNilArray = "*-1\r\n";
+std::string Int(long long v) { return ":" + std::to_string(v) + "\r\n"; }
+std::string Err(const std::string& m) { return "-ERR " + m + "\r\n"; }
+
+// ---- command dispatch ------------------------------------------------------
+std::string Execute(std::vector<std::string>& args) {
+  std::string cmd = args[0];
+  for (auto& c : cmd) c = static_cast<char>(toupper(c));
+
+  if (cmd == "PING") return "+PONG\r\n";
+  if (cmd == "SHUTDOWN") {
+    g_shutdown.store(true);
+    if (g_listen_fd >= 0) shutdown(g_listen_fd, SHUT_RDWR);
+    return "+OK\r\n";
+  }
+  if (cmd == "FLUSHALL") {
+    std::lock_guard<std::mutex> l(g_store.mu);
+    g_store.kv.clear();
+    g_store.lists.clear();
+    return "+OK\r\n";
+  }
+  if (cmd == "SET" && args.size() == 3) {
+    std::lock_guard<std::mutex> l(g_store.mu);
+    g_store.kv[args[1]] = std::move(args[2]);
+    return "+OK\r\n";
+  }
+  if (cmd == "GET" && args.size() == 2) {
+    std::lock_guard<std::mutex> l(g_store.mu);
+    auto it = g_store.kv.find(args[1]);
+    return it == g_store.kv.end() ? kNil : Bulk(it->second);
+  }
+  if (cmd == "DEL" && args.size() >= 2) {
+    std::lock_guard<std::mutex> l(g_store.mu);
+    long long n = 0;
+    for (size_t i = 1; i < args.size(); ++i) {
+      n += g_store.kv.erase(args[i]);
+      n += g_store.lists.erase(args[i]);
+    }
+    return Int(n);
+  }
+  if (cmd == "EXISTS" && args.size() == 2) {
+    std::lock_guard<std::mutex> l(g_store.mu);
+    return Int(g_store.kv.count(args[1]) || g_store.lists.count(args[1]));
+  }
+  if (cmd == "KEYS" && args.size() == 2) {
+    std::lock_guard<std::mutex> l(g_store.mu);
+    std::string out;
+    long long n = 0;
+    for (auto& [k, _] : g_store.kv)
+      if (GlobMatch(args[1].c_str(), k.c_str())) { out += Bulk(k); ++n; }
+    for (auto& [k, _] : g_store.lists)
+      if (GlobMatch(args[1].c_str(), k.c_str())) { out += Bulk(k); ++n; }
+    return "*" + std::to_string(n) + "\r\n" + out;
+  }
+  if (cmd == "INCR" && args.size() == 2) {
+    std::lock_guard<std::mutex> l(g_store.mu);
+    auto& v = g_store.kv[args[1]];
+    long long n = v.empty() ? 0 : strtoll(v.c_str(), nullptr, 10);
+    v = std::to_string(n + 1);
+    return Int(n + 1);
+  }
+  if ((cmd == "LPUSH" || cmd == "RPUSH") && args.size() >= 3) {
+    std::lock_guard<std::mutex> l(g_store.mu);
+    auto& dq = g_store.lists[args[1]];
+    for (size_t i = 2; i < args.size(); ++i) {
+      if (cmd == "LPUSH") dq.push_front(std::move(args[i]));
+      else dq.push_back(std::move(args[i]));
+    }
+    g_store.list_cv.notify_all();
+    return Int(static_cast<long long>(dq.size()));
+  }
+  if (cmd == "LPOP" && args.size() == 2) {
+    std::lock_guard<std::mutex> l(g_store.mu);
+    auto it = g_store.lists.find(args[1]);
+    if (it == g_store.lists.end() || it->second.empty()) return kNil;
+    std::string v = std::move(it->second.front());
+    it->second.pop_front();
+    return Bulk(v);
+  }
+  if (cmd == "LLEN" && args.size() == 2) {
+    std::lock_guard<std::mutex> l(g_store.mu);
+    auto it = g_store.lists.find(args[1]);
+    return Int(it == g_store.lists.end()
+                   ? 0
+                   : static_cast<long long>(it->second.size()));
+  }
+  if (cmd == "BRPOP" && args.size() >= 3) {
+    // BRPOP key [key...] timeout_seconds — pops the *tail* of the first
+    // non-empty key; replies *2 [key, value] or nil-array on timeout.
+    double timeout_s = strtod(args.back().c_str(), nullptr);
+    std::vector<std::string> keys(args.begin() + 1, args.end() - 1);
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::duration_cast<std::chrono::steady_clock::duration>(
+                        std::chrono::duration<double>(timeout_s));
+    std::unique_lock<std::mutex> l(g_store.mu);
+    while (true) {
+      for (auto& k : keys) {
+        auto it = g_store.lists.find(k);
+        if (it != g_store.lists.end() && !it->second.empty()) {
+          std::string v = std::move(it->second.back());
+          it->second.pop_back();
+          return "*2\r\n" + Bulk(k) + Bulk(v);
+        }
+      }
+      if (g_shutdown.load()) return kNilArray;
+      if (timeout_s <= 0) {  // 0 = wait forever (redis semantics)
+        g_store.list_cv.wait_for(l, std::chrono::milliseconds(100));
+      } else {
+        if (g_store.list_cv.wait_until(l, deadline) ==
+            std::cv_status::timeout) {
+          // re-check once after timeout, then give up
+          for (auto& k : keys) {
+            auto it = g_store.lists.find(k);
+            if (it != g_store.lists.end() && !it->second.empty()) {
+              std::string v = std::move(it->second.back());
+              it->second.pop_back();
+              return "*2\r\n" + Bulk(k) + Bulk(v);
+            }
+          }
+          return kNilArray;
+        }
+      }
+    }
+  }
+  return Err("unknown command or wrong arity: " + cmd);
+}
+
+void ServeConn(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  std::string line;
+  while (!g_shutdown.load()) {
+    if (!ReadLine(fd, &line) || line.empty() || line[0] != '*') break;
+    long n = strtol(line.c_str() + 1, nullptr, 10);
+    if (n <= 0 || n > 1 << 20) break;
+    std::vector<std::string> args;
+    args.reserve(static_cast<size_t>(n));
+    bool ok = true;
+    for (long i = 0; i < n && ok; ++i) {
+      if (!ReadLine(fd, &line) || line.empty() || line[0] != '$') {
+        ok = false;
+        break;
+      }
+      long len = strtol(line.c_str() + 1, nullptr, 10);
+      if (len < 0 || len > (1L << 31)) { ok = false; break; }
+      std::string payload(static_cast<size_t>(len), '\0');
+      if (!ReadN(fd, payload.data(), static_cast<size_t>(len))) {
+        ok = false;
+        break;
+      }
+      char crlf[2];
+      if (!ReadN(fd, crlf, 2)) { ok = false; break; }
+      args.push_back(std::move(payload));
+    }
+    if (!ok || args.empty()) break;
+    if (!WriteAll(fd, Execute(args))) break;
+  }
+  close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = 6399;
+  const char* host = "127.0.0.1";
+  for (int i = 1; i < argc - 1; ++i) {
+    if (!strcmp(argv[i], "--port")) port = atoi(argv[i + 1]);
+    if (!strcmp(argv[i], "--host")) host = argv[i + 1];
+  }
+  signal(SIGPIPE, SIG_IGN);
+
+  g_listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(g_listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  inet_pton(AF_INET, host, &addr.sin_addr);
+  if (bind(g_listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+      0) {
+    perror("bind");
+    return 1;
+  }
+  // port 0 → kernel-assigned; report the real one for the spawner
+  socklen_t alen = sizeof(addr);
+  getsockname(g_listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  if (listen(g_listen_fd, 128) < 0) {
+    perror("listen");
+    return 1;
+  }
+  fprintf(stdout, "rafiki-kvd listening on %s:%d\n", host,
+          ntohs(addr.sin_port));
+  fflush(stdout);
+
+  std::vector<std::thread> conns;
+  while (!g_shutdown.load()) {
+    int fd = accept(g_listen_fd, nullptr, nullptr);
+    if (fd < 0) break;
+    conns.emplace_back(ServeConn, fd);
+  }
+  g_store.list_cv.notify_all();
+  close(g_listen_fd);
+  for (auto& t : conns)
+    if (t.joinable()) t.join();
+  return 0;
+}
