@@ -171,6 +171,9 @@ class KVClient:
     def lpop(self, key: str) -> Optional[bytes]:
         return self._cmd("LPOP", key)
 
+    def rpop(self, key: str) -> Optional[bytes]:
+        return self._cmd("RPOP", key)
+
     def llen(self, key: str) -> int:
         return int(self._cmd("LLEN", key))
 

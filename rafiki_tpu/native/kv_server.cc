@@ -169,12 +169,18 @@ std::string Execute(std::vector<std::string>& args) {
     g_store.list_cv.notify_all();
     return Int(static_cast<long long>(dq.size()));
   }
-  if (cmd == "LPOP" && args.size() == 2) {
+  if ((cmd == "LPOP" || cmd == "RPOP") && args.size() == 2) {
     std::lock_guard<std::mutex> l(g_store.mu);
     auto it = g_store.lists.find(args[1]);
     if (it == g_store.lists.end() || it->second.empty()) return kNil;
-    std::string v = std::move(it->second.front());
-    it->second.pop_front();
+    std::string v;
+    if (cmd == "LPOP") {
+      v = std::move(it->second.front());
+      it->second.pop_front();
+    } else {
+      v = std::move(it->second.back());
+      it->second.pop_back();
+    }
     return Bulk(v);
   }
   if (cmd == "LLEN" && args.size() == 2) {

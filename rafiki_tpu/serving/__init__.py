@@ -1,0 +1,7 @@
+"""Online serving: queues, predictor endpoint, ensembling."""
+
+from .queues import (InProcQueueHub, KVQueueHub, QueueHub, pack_message,
+                     unpack_message)
+
+__all__ = ["QueueHub", "InProcQueueHub", "KVQueueHub", "pack_message",
+           "unpack_message"]

@@ -1,0 +1,149 @@
+"""Predictor: the public inference endpoint with top-N ensembling.
+
+Parity target: the reference's predictor service (SURVEY.md §2 "Predictor",
+§3.3): ``POST /predict`` assigns each request a query id, scatters it onto
+every inference worker's queue, gathers the replicas' predictions with a
+timeout, and ensembles — probability averaging for classification vectors,
+majority vote otherwise. Partial gathers still answer (latency/accuracy
+trade-off, SURVEY.md §3.3 note): whatever arrived by the deadline is
+ensembled; zero arrivals is a 504.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.http import JsonHttpService
+from .queues import QueueHub, pack_message, unpack_message
+
+
+def ensemble_predictions(per_worker: List[List[Any]]) -> List[Any]:
+    """Combine replicas' per-query predictions.
+
+    Numeric same-length vectors (class probabilities) are averaged;
+    anything else falls back to majority vote (ties → first seen).
+    """
+    if not per_worker:
+        return []
+    n_queries = len(per_worker[0])
+    out: List[Any] = []
+    for q in range(n_queries):
+        votes = [w[q] for w in per_worker if q < len(w) and w[q] is not None]
+        if not votes:
+            out.append(None)
+            continue
+        try:
+            arrs = [np.asarray(v, dtype=np.float64) for v in votes]
+            if all(a.shape == arrs[0].shape and a.ndim >= 1 for a in arrs):
+                out.append(np.mean(arrs, axis=0).tolist())
+                continue
+        except (TypeError, ValueError):
+            pass
+        keys = [repr(v) for v in votes]
+        best = max(set(keys), key=lambda k: (keys.count(k), -keys.index(k)))
+        out.append(votes[keys.index(best)])
+    return out
+
+
+class Predictor:
+    """Scatter/gather over inference workers + ensemble."""
+
+    def __init__(self, hub: QueueHub, worker_ids: Sequence[str],
+                 gather_timeout: float = 10.0) -> None:
+        self.hub = hub
+        self.worker_ids = list(worker_ids)
+        self.gather_timeout = gather_timeout
+        self._n_queries = 0
+        self._latency_sum = 0.0
+        self._lock = threading.Lock()
+
+    def predict(self, queries: Sequence[Any],
+                timeout: Optional[float] = None) -> Tuple[List[Any], Dict]:
+        """Returns (ensembled predictions, info dict)."""
+        t0 = time.monotonic()
+        timeout = self.gather_timeout if timeout is None else timeout
+        qid = uuid.uuid4().hex
+        msg = pack_message({"id": qid, "queries": _stack(queries)})
+        for wid in self.worker_ids:
+            self.hub.push_query(wid, msg)
+
+        per_worker: List[List[Any]] = []
+        errors: List[str] = []
+        deadline = t0 + timeout
+        for _ in self.worker_ids:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            reply_bytes = self.hub.pop_prediction(qid, remaining)
+            if reply_bytes is None:
+                break
+            reply = unpack_message(reply_bytes)
+            if reply.get("error"):
+                errors.append(str(reply["error"]))
+                continue
+            per_worker.append(list(reply["predictions"]))
+
+        latency = time.monotonic() - t0
+        with self._lock:
+            self._n_queries += len(queries)
+            self._latency_sum += latency
+        info = {"workers_answered": len(per_worker),
+                "workers_asked": len(self.worker_ids),
+                "latency_s": latency, "errors": errors}
+        return ensemble_predictions(per_worker), info
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"queries_served": self._n_queries,
+                    "latency_sum_s": self._latency_sum}
+
+
+def _stack(queries: Sequence[Any]) -> Any:
+    """Stack homogeneous array queries for compact transport; fall back to
+    a list for ragged/object queries."""
+    try:
+        arrs = [np.asarray(q) for q in queries]
+        if arrs and all(a.shape == arrs[0].shape and
+                        a.dtype == arrs[0].dtype and
+                        a.dtype != object for a in arrs):
+            return np.stack(arrs)
+    except (TypeError, ValueError):
+        pass
+    return list(queries)
+
+
+class PredictorService:
+    """HTTP front: POST /predict {queries} → {predictions}."""
+
+    def __init__(self, predictor: Predictor, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+        self.predictor = predictor
+        self.http = JsonHttpService(host, port)
+        self.http.route("POST", "/predict", self._predict)
+        self.http.route("GET", "/health", self._health)
+
+    def start(self) -> Tuple[str, int]:
+        return self.http.start()
+
+    def stop(self) -> None:
+        self.http.stop()
+
+    def _predict(self, _m, body, _h) -> Tuple[int, Any]:
+        queries = (body or {}).get("queries")
+        if not isinstance(queries, list) or not queries:
+            return 400, {"error": "body must be {queries: [...]}"}
+        timeout = (body or {}).get("timeout")
+        preds, info = self.predictor.predict(
+            queries, timeout=float(timeout) if timeout else None)
+        if info["workers_answered"] == 0:
+            return 504, {"error": "no worker answered in time",
+                         "info": info}
+        return 200, {"predictions": preds, "info": info}
+
+    def _health(self, _m, _b, _h) -> Tuple[int, Any]:
+        return 200, {"ok": True, **self.predictor.stats()}

@@ -1,0 +1,131 @@
+"""Query/prediction queues between the Predictor and inference workers.
+
+Parity target: the reference's per-worker Redis lists (SURVEY.md §2
+"Query/prediction queues", §3.3): the predictor pushes each query batch
+onto every worker's query queue and gathers replies; workers block-pop,
+predict, and push predictions back.
+
+Two hubs, one interface: ``InProcQueueHub`` (threads in one process —
+tests and the single-host fast path) and ``KVQueueHub`` (the native
+``rafiki-kvd`` server — multi-process deployments). Replies land on a
+per-query-id queue so the predictor can gather exactly the replicas it
+scattered to, concurrently across outstanding queries.
+
+Messages are msgpack-serialized pytrees (same codec as the ParamStore) so
+query arrays cross process boundaries without JSON inflation.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Dict, Optional
+
+from ..store.param_store import params_from_bytes, params_to_bytes
+
+
+def pack_message(msg: Dict[str, Any]) -> bytes:
+    return params_to_bytes(msg)
+
+
+def unpack_message(data: bytes) -> Dict[str, Any]:
+    return params_from_bytes(data)
+
+
+class QueueHub:
+    """Scatter/gather data plane between one predictor and its workers."""
+
+    def push_query(self, worker_id: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def pop_query(self, worker_id: str,
+                  timeout: float) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def push_prediction(self, query_id: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def pop_prediction(self, query_id: str,
+                       timeout: float) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def query_depth(self, worker_id: str) -> int:
+        raise NotImplementedError
+
+
+class InProcQueueHub(QueueHub):
+    def __init__(self) -> None:
+        self._queues: Dict[str, collections.deque] = \
+            collections.defaultdict(collections.deque)
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+
+    def _push(self, key: str, data: bytes) -> None:
+        with self._cv:
+            self._queues[key].append(data)
+            self._cv.notify_all()
+
+    def _pop(self, key: str, timeout: float) -> Optional[bytes]:
+        with self._cv:
+            ok = self._cv.wait_for(lambda: bool(self._queues.get(key)),
+                                   timeout=timeout)
+            if not ok:
+                return None
+            return self._queues[key].popleft()
+
+    def push_query(self, worker_id: str, data: bytes) -> None:
+        self._push(f"q:{worker_id}", data)
+
+    def pop_query(self, worker_id: str, timeout: float) -> Optional[bytes]:
+        return self._pop(f"q:{worker_id}", timeout)
+
+    def push_prediction(self, query_id: str, data: bytes) -> None:
+        self._push(f"p:{query_id}", data)
+
+    def pop_prediction(self, query_id: str,
+                       timeout: float) -> Optional[bytes]:
+        return self._pop(f"p:{query_id}", timeout)
+
+    def query_depth(self, worker_id: str) -> int:
+        with self._lock:
+            return len(self._queues.get(f"q:{worker_id}", ()))
+
+
+class KVQueueHub(QueueHub):
+    """Queues on the native kv server. Blocking pops hold a socket, so each
+    hub keeps one client per calling thread (thread-local)."""
+
+    def __init__(self, host: str, port: int) -> None:
+        self._host, self._port = host, port
+        self._tl = threading.local()
+
+    def _client(self):
+        from ..native.client import KVClient
+
+        c = getattr(self._tl, "client", None)
+        if c is None:
+            c = KVClient(self._host, self._port)
+            self._tl.client = c
+        return c
+
+    def push_query(self, worker_id: str, data: bytes) -> None:
+        self._client().lpush(f"q:queries:{worker_id}", data)
+
+    def pop_query(self, worker_id: str, timeout: float) -> Optional[bytes]:
+        if timeout <= 0:  # non-blocking drain (BRPOP 0 means block forever)
+            return self._client().rpop(f"q:queries:{worker_id}")
+        got = self._client().brpop(f"q:queries:{worker_id}", timeout)
+        return None if got is None else got[1]
+
+    def push_prediction(self, query_id: str, data: bytes) -> None:
+        self._client().lpush(f"q:preds:{query_id}", data)
+
+    def pop_prediction(self, query_id: str,
+                       timeout: float) -> Optional[bytes]:
+        if timeout <= 0:
+            return self._client().rpop(f"q:preds:{query_id}")
+        got = self._client().brpop(f"q:preds:{query_id}", timeout)
+        return None if got is None else got[1]
+
+    def query_depth(self, worker_id: str) -> int:
+        return self._client().llen(f"q:queries:{worker_id}")

@@ -128,16 +128,29 @@ class MetaStore:
             if cur.rowcount == 0:
                 raise KeyError(f"no {table} row {row_id!r}")
 
+    #: columns stored as JSON text, decoded on every read
+    _JSON_COLS = ("knobs", "budget", "train_args", "config")
+
+    def _decode(self, row: Dict[str, Any]) -> Dict[str, Any]:
+        for col in self._JSON_COLS:
+            v = row.get(col)
+            if isinstance(v, str):
+                try:
+                    row[col] = json.loads(v)
+                except ValueError:
+                    pass
+        return row
+
     def _one(self, sql: str, args: tuple = ()) -> Optional[Dict[str, Any]]:
         with self._lock:
             cur = self._conn.execute(sql, args)
             row = cur.fetchone()
-        return dict(row) if row else None
+        return self._decode(dict(row)) if row else None
 
     def _all(self, sql: str, args: tuple = ()) -> List[Dict[str, Any]]:
         with self._lock:
             cur = self._conn.execute(sql, args)
-            return [dict(r) for r in cur.fetchall()]
+            return [self._decode(dict(r)) for r in cur.fetchall()]
 
     # ---- users ----
     def create_user(self, email: str, password: str,

@@ -1,0 +1,6 @@
+"""Workers: the per-sub-mesh trial loop and serving replicas."""
+
+from .inference import InferenceWorker
+from .train import TrainWorker
+
+__all__ = ["TrainWorker", "InferenceWorker"]

@@ -1,0 +1,135 @@
+"""Inference worker: one serving replica of a best trial.
+
+Parity target: the reference's ``worker/inference.py`` (SURVEY.md §3.3):
+boot by loading a trial's parameters from the ParamStore, then loop —
+block-pop the query queue, batch what's pending, run ``model.predict``,
+push predictions keyed by query id.
+
+TPU-first delta: opportunistic micro-batching. After a blocking pop the
+worker drains whatever else is queued (up to ``max_batch_msgs``) and runs
+one forward over the union — on TPU the forward is a compiled program whose
+cost is dominated by launch + HBM traffic, so batching waiting queries is
+nearly free throughput. Static-shape padding happens inside the template's
+``predict`` (bucketed), not here.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Any, List, Optional, Type
+
+import numpy as np
+
+from ..model.base import BaseModel
+from ..serving.queues import QueueHub, pack_message, unpack_message
+from ..store.param_store import ParamStore
+
+
+class InferenceWorker:
+    def __init__(self, model_class: Type[BaseModel], trial_id: str,
+                 knobs: dict, param_store: ParamStore, hub: QueueHub,
+                 worker_id: str, max_batch_msgs: int = 16) -> None:
+        self.worker_id = worker_id
+        self.hub = hub
+        self.max_batch_msgs = max_batch_msgs
+        self._stop = threading.Event()
+        self.model = model_class(**knobs)
+        params = param_store.load(trial_id)
+        if params is None:
+            raise KeyError(f"no parameters for trial {trial_id!r}")
+        self.model.load_parameters(params)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # ---- the loop ----
+    def run(self, poll_timeout: float = 0.5,
+            max_iterations: Optional[int] = None) -> None:
+        n = 0
+        while not self._stop.is_set():
+            if max_iterations is not None and n >= max_iterations:
+                break
+            n += 1
+            first = self.hub.pop_query(self.worker_id, poll_timeout)
+            if first is None:
+                continue
+            messages = [unpack_message(first)]
+            while len(messages) < self.max_batch_msgs:
+                more = self.hub.pop_query(self.worker_id, 0.0)
+                if more is None:
+                    break
+                messages.append(unpack_message(more))
+            self._serve_batch(messages)
+
+    def _serve_batch(self, messages: List[dict]) -> None:
+        # flatten all messages' queries into one forward pass
+        counts = []
+        flat: List[Any] = []
+        for m in messages:
+            qs = m["queries"]
+            qs = list(qs) if not isinstance(qs, (list, tuple)) else qs
+            counts.append(len(qs))
+            flat.extend(qs)
+        try:
+            preds = self.model.predict(flat)
+            err = ""
+        except Exception:
+            preds = []
+            err = traceback.format_exc()
+        # split results back per message and reply on per-query-id queues
+        ofs = 0
+        for m, c in zip(messages, counts):
+            chunk = preds[ofs:ofs + c] if not err else []
+            ofs += c
+            reply = {"id": m["id"], "worker_id": self.worker_id,
+                     "predictions": _to_plain(chunk)}
+            if err:
+                reply["error"] = err
+            self.hub.push_prediction(m["id"], pack_message(reply))
+
+
+def _to_plain(preds: List[Any]) -> List[Any]:
+    """Predictions as a list of plain lists/scalars (msgpack-safe)."""
+    out = []
+    for p in preds:
+        if isinstance(p, np.ndarray):
+            out.append(p.tolist())
+        elif hasattr(p, "tolist"):
+            out.append(np.asarray(p).tolist())
+        else:
+            out.append(p)
+    return out
+
+
+def main(argv: Optional[list] = None) -> int:
+    """Service entrypoint: ``python -m rafiki_tpu.worker.inference``."""
+    import argparse
+    import json
+
+    from ..model.base import load_model_class
+    from ..serving.queues import KVQueueHub
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", required=True,
+                        help="JSON: {model_file, model_class, trial_id, "
+                             "knobs, param_store_uri, kv_host, kv_port, "
+                             "worker_id}")
+    args = parser.parse_args(argv)
+    with open(args.config) as f:
+        cfg = json.load(f)
+    with open(cfg["model_file"], "rb") as f:
+        model_class = load_model_class(f.read(), cfg["model_class"])
+    worker = InferenceWorker(
+        model_class=model_class, trial_id=cfg["trial_id"],
+        knobs=cfg.get("knobs", {}),
+        param_store=ParamStore.from_uri(cfg["param_store_uri"]),
+        hub=KVQueueHub(cfg["kv_host"], int(cfg["kv_port"])),
+        worker_id=cfg["worker_id"])
+    print(f"inference worker {worker.worker_id} serving", flush=True)
+    worker.run()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

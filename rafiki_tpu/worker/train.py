@@ -1,0 +1,158 @@
+"""Train worker: the per-sub-mesh trial loop.
+
+Parity target: the reference's ``worker/train.py`` (SURVEY.md §3.1): loop
+until the advisor's budget is exhausted — get a proposal, build the model
+template with the proposed knobs, train, evaluate, report the score, save
+parameters. One worker per TPU sub-mesh replaces one container per GPU.
+
+TPU-first deltas:
+- The worker passes its sub-mesh devices into ``TrainContext`` so templates
+  pjit over exactly the chips they own (device multi-tenancy, SURVEY.md §7).
+- BOHB rung semantics ride the same loop: ``budget_scale`` scales epochs,
+  ``warm_start_trial_id`` resumes a promoted trial from its own lower-rung
+  checkpoint in the ParamStore.
+- ``should_continue`` gives the advisor a per-epoch early-stop hook
+  (preemption-friendly: the last completed epoch is always checkpointable).
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Any, List, Optional, Type
+
+from ..model.base import BaseModel, TrainContext
+from ..model.log import ModelLogger
+from ..store.param_store import ParamStore
+
+
+class TrainWorker:
+    """Runs trials against an advisor (in-proc object or HTTP client —
+    both expose propose/feedback/trial_errored)."""
+
+    def __init__(self, model_class: Type[BaseModel], advisor: Any,
+                 train_dataset_path: str, val_dataset_path: str,
+                 param_store: Optional[ParamStore] = None,
+                 meta_store: Optional[Any] = None,
+                 sub_train_job_id: str = "", model_id: str = "",
+                 devices: Optional[List[Any]] = None,
+                 worker_id: str = "worker-0") -> None:
+        self.model_class = model_class
+        self.advisor = advisor
+        self.train_dataset_path = train_dataset_path
+        self.val_dataset_path = val_dataset_path
+        self.param_store = param_store or ParamStore()
+        self.meta_store = meta_store
+        self.sub_train_job_id = sub_train_job_id
+        self.model_id = model_id
+        self.devices = devices
+        self.worker_id = worker_id
+        self.trials_run = 0
+
+    # ---- one trial ----
+    def run_trial(self, proposal) -> Optional[float]:
+        from ..advisor.base import TrialResult
+
+        from ..model.knob import shape_signature
+
+        if self.meta_store is not None:
+            trial_id = self.meta_store.create_trial(
+                self.sub_train_job_id, proposal.trial_no,
+                model_id=self.model_id, knobs=proposal.knobs,
+                worker_id=self.worker_id,
+                budget_scale=proposal.budget_scale,
+                shape_sig=shape_signature(
+                    self.model_class.get_knob_config(), proposal.knobs))["id"]
+        else:
+            trial_id = f"{self.worker_id}-t{proposal.trial_no}"
+
+        logger = ModelLogger()
+        if self.meta_store is not None:
+            logger.sink = lambda rec: self.meta_store.add_trial_log(
+                trial_id, rec.kind, rec.data, rec.time)
+
+        try:
+            self.model_class.validate_knobs(proposal.knobs)
+            model = self.model_class(**proposal.knobs)
+            shared = None
+            if proposal.warm_start_trial_id:
+                shared = self.param_store.load(proposal.warm_start_trial_id)
+            ctx = TrainContext(devices=self.devices,
+                               budget_scale=proposal.budget_scale,
+                               shared_params=shared, logger=logger,
+                               trial_id=trial_id)
+            model.train(self.train_dataset_path, ctx)
+            score = float(model.evaluate(self.val_dataset_path))
+
+            self.param_store.save(trial_id, model.dump_parameters())
+            model.destroy()
+            if self.meta_store is not None:
+                self.meta_store.mark_trial_completed(trial_id, score,
+                                                     params_saved=True)
+            self.advisor.feedback(TrialResult(
+                trial_no=proposal.trial_no, knobs=proposal.knobs,
+                score=score, trial_id=trial_id,
+                budget_scale=proposal.budget_scale, meta=proposal.meta))
+            self.trials_run += 1
+            return score
+        except Exception as e:  # trial-level fault isolation (SURVEY.md §5.3)
+            if self.meta_store is not None:
+                self.meta_store.mark_trial_errored(
+                    trial_id, f"{e}\n{traceback.format_exc()}")
+            self.advisor.trial_errored(proposal.trial_no)
+            return None
+
+    # ---- the loop ----
+    def run(self, max_trials: Optional[int] = None) -> int:
+        """Pull proposals until the advisor says stop; returns #trials."""
+        n = 0
+        while max_trials is None or n < max_trials:
+            proposal = self.advisor.propose()
+            if not proposal.is_valid:
+                break
+            self.run_trial(proposal)
+            n += 1
+        return n
+
+
+def main(argv: Optional[list] = None) -> int:
+    """Service entrypoint: ``python -m rafiki_tpu.worker.train``.
+
+    Spawned by the ServicesManager with a JSON config file; connects to the
+    advisor service over HTTP and to the shared stores.
+    """
+    import argparse
+    import json
+
+    from ..advisor.service import AdvisorClient
+    from ..model.base import load_model_class
+    from ..store.meta_store import MetaStore
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", required=True,
+                        help="JSON: {advisor_url, model_file, model_class, "
+                             "train_dataset, val_dataset, param_store_uri, "
+                             "meta_store_path, sub_train_job_id, worker_id}")
+    args = parser.parse_args(argv)
+    with open(args.config) as f:
+        cfg = json.load(f)
+
+    with open(cfg["model_file"], "rb") as f:
+        model_class = load_model_class(f.read(), cfg["model_class"])
+    meta_store = (MetaStore(cfg["meta_store_path"])
+                  if cfg.get("meta_store_path") else None)
+    worker = TrainWorker(
+        model_class=model_class,
+        advisor=AdvisorClient(cfg["advisor_url"]),
+        train_dataset_path=cfg["train_dataset"],
+        val_dataset_path=cfg["val_dataset"],
+        param_store=ParamStore.from_uri(cfg.get("param_store_uri", "mem://")),
+        meta_store=meta_store,
+        sub_train_job_id=cfg.get("sub_train_job_id", ""),
+        worker_id=cfg.get("worker_id", "worker-0"))
+    n = worker.run()
+    print(f"train worker {worker.worker_id} done: {n} trials", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,192 @@
+"""Train worker loop, inference workers, predictor scatter/gather/ensemble."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from rafiki_tpu.advisor import make_advisor
+from rafiki_tpu.data import generate_image_classification_dataset
+from rafiki_tpu.models.mlp import JaxFeedForward
+from rafiki_tpu.serving import InProcQueueHub, KVQueueHub
+from rafiki_tpu.serving.predictor import (Predictor, PredictorService,
+                                          ensemble_predictions)
+from rafiki_tpu.store.meta_store import MetaStore
+from rafiki_tpu.store.param_store import ParamStore
+from rafiki_tpu.utils.http import json_request
+from rafiki_tpu.worker import InferenceWorker, TrainWorker
+
+
+@pytest.fixture(scope="module")
+def datasets(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ds")
+    tr, va = str(d / "train.npz"), str(d / "val.npz")
+    generate_image_classification_dataset(tr, 256, seed=0)
+    val_ds = generate_image_classification_dataset(va, 64, seed=1)
+    return tr, va, val_ds
+
+
+@pytest.fixture()
+def trained(datasets):
+    """One completed sub-train-job: meta rows + params in the store."""
+    tr, va, _ = datasets
+    meta = MetaStore(":memory:")
+    params = ParamStore()
+    user = meta.create_user("u@x", "pw", "ADMIN")
+    model = meta.create_model(user["id"], "mlp", "IMAGE_CLASSIFICATION",
+                              model_bytes=b"", model_class="JaxFeedForward")
+    job = meta.create_train_job(user["id"], app="app", app_version=1,
+                                task="IMAGE_CLASSIFICATION",
+                                budget={"TRIAL_COUNT": 3},
+                                train_dataset_id=tr, val_dataset_id=va)
+    sub = meta.create_sub_train_job(job["id"], model["id"])
+    advisor = make_advisor(JaxFeedForward.get_knob_config(), "random",
+                           total_trials=3, seed=0)
+    worker = TrainWorker(JaxFeedForward, advisor, tr, va,
+                         param_store=params, meta_store=meta,
+                         sub_train_job_id=sub["id"], model_id=model["id"])
+    n = worker.run()
+    assert n == 3
+    return meta, params, job, advisor
+
+
+def test_train_worker_records_trials(trained):
+    meta, params, job, advisor = trained
+    trials = meta.get_trials_of_train_job(job["id"])
+    assert len(trials) == 3
+    completed = [t for t in trials if t["status"] == "COMPLETED"]
+    assert completed, "at least one trial should complete"
+    best = meta.get_best_trials_of_train_job(job["id"], max_count=2)
+    assert best and best[0]["score"] >= best[-1]["score"]
+    # params were saved for completed trials
+    for t in completed:
+        assert params.load(t["id"]) is not None
+    # trial logs flowed through the sink
+    logs = meta.get_trial_logs(completed[0]["id"])
+    assert any(r["kind"] == "values" for r in logs)
+    assert advisor.best is not None
+
+
+def test_trial_error_isolated(datasets):
+    tr, va, _ = datasets
+
+    class Exploding(JaxFeedForward):
+        def train(self, path, ctx=None):
+            raise RuntimeError("boom")
+
+    meta = MetaStore(":memory:")
+    advisor = make_advisor(Exploding.get_knob_config(), "random",
+                           total_trials=2, seed=0)
+    user = meta.create_user("u@x", "pw", "ADMIN")
+    model = meta.create_model(user["id"], "exploding",
+                              "IMAGE_CLASSIFICATION",
+                              model_class="Exploding", model_bytes=b"")
+    job = meta.create_train_job(user["id"], "app", 1, "IMAGE_CLASSIFICATION",
+                                {"TRIAL_COUNT": 2}, tr, va)
+    sub = meta.create_sub_train_job(job["id"], model["id"])
+    w = TrainWorker(Exploding, advisor, tr, va, meta_store=meta,
+                    sub_train_job_id=sub["id"], model_id=model["id"])
+    assert w.run() == 2  # loop survives both failures
+    trials = meta.get_trials_of_sub_train_job(sub["id"])
+    assert all(t["status"] == "ERRORED" for t in trials)
+    assert "boom" in trials[0]["error"]
+
+
+def _boot_workers(trained, hub, n=2):
+    meta, params, job, _ = trained
+    best = meta.get_best_trials_of_train_job(job["id"], max_count=n)
+    workers, threads = [], []
+    for i, t in enumerate(best):
+        w = InferenceWorker(JaxFeedForward, t["id"], t["knobs"], params,
+                            hub, worker_id=f"iw-{i}")
+        th = threading.Thread(target=w.run, kwargs={"poll_timeout": 0.1})
+        th.start()
+        workers.append(w)
+        threads.append(th)
+    return workers, threads
+
+
+def test_predict_end_to_end_inproc(trained, datasets):
+    _, _, val_ds = datasets
+    hub = InProcQueueHub()
+    workers, threads = _boot_workers(trained, hub)
+    try:
+        pred = Predictor(hub, [w.worker_id for w in workers],
+                         gather_timeout=30.0)
+        queries = [val_ds.images[i] for i in range(8)]
+        preds, info = pred.predict(queries)
+        assert info["workers_answered"] == 2
+        assert len(preds) == 8
+        acc = np.mean([int(np.argmax(p)) == val_ds.labels[i]
+                       for i, p in enumerate(preds)])
+        assert acc >= 0.5  # trained ensemble beats chance easily
+    finally:
+        for w in workers:
+            w.stop()
+        for th in threads:
+            th.join(timeout=5)
+
+
+def test_predict_end_to_end_kv(trained, datasets):
+    from rafiki_tpu.native import KVServer
+
+    _, _, val_ds = datasets
+    with KVServer() as server:
+        hub = KVQueueHub(server.host, server.port)
+        workers, threads = _boot_workers(trained, hub)
+        try:
+            pred = Predictor(hub, [w.worker_id for w in workers],
+                             gather_timeout=30.0)
+            preds, info = pred.predict([val_ds.images[0]])
+            assert info["workers_answered"] == 2
+            assert len(preds) == 1 and len(preds[0]) == val_ds.n_classes
+        finally:
+            for w in workers:
+                w.stop()
+            for th in threads:
+                th.join(timeout=5)
+
+
+def test_predictor_http_service(trained, datasets):
+    _, _, val_ds = datasets
+    hub = InProcQueueHub()
+    workers, threads = _boot_workers(trained, hub, n=1)
+    svc = PredictorService(Predictor(hub, [workers[0].worker_id],
+                                     gather_timeout=30.0))
+    host, port = svc.start()
+    try:
+        out = json_request(
+            "POST", f"http://{host}:{port}/predict",
+            {"queries": [np.asarray(val_ds.images[0]).tolist()]},
+            timeout=60.0)
+        assert len(out["predictions"]) == 1
+        health = json_request("GET", f"http://{host}:{port}/health",
+                              timeout=5.0)
+        assert health["ok"]
+    finally:
+        svc.stop()
+        for w in workers:
+            w.stop()
+        for th in threads:
+            th.join(timeout=5)
+
+
+def test_predictor_timeout_no_workers():
+    hub = InProcQueueHub()
+    pred = Predictor(hub, ["ghost"], gather_timeout=0.2)
+    preds, info = pred.predict([[1, 2, 3]])
+    assert preds == [] and info["workers_answered"] == 0
+
+
+def test_ensemble_prob_averaging():
+    a = [[0.8, 0.2], [0.1, 0.9]]
+    b = [[0.6, 0.4], [0.3, 0.7]]
+    out = ensemble_predictions([a, b])
+    np.testing.assert_allclose(out[0], [0.7, 0.3])
+    np.testing.assert_allclose(out[1], [0.2, 0.8])
+
+
+def test_ensemble_majority_vote():
+    out = ensemble_predictions([["cat", "dog"], ["cat", "cow"],
+                                ["dog", "cow"]])
+    assert out == ["cat", "cow"]
