@@ -1,0 +1,6 @@
+"""Control plane: Admin brain, REST app, service orchestration."""
+
+from .admin import Admin, AuthError
+from .services_manager import ManagedService, ServicesManager
+
+__all__ = ["Admin", "AuthError", "ServicesManager", "ManagedService"]

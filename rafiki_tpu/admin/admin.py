@@ -1,0 +1,245 @@
+"""Admin: the control-plane brain behind the REST API.
+
+Parity target: the reference's ``Admin`` class (SURVEY.md §2 "Admin",
+§3.1/§3.2): auth, model upload, dataset registry, train/inference-job
+lifecycle; spawns services through the ServicesManager. Auth tokens are
+random in-process session tokens (the reference uses JWT-style bearer
+tokens against the same Flask process).
+
+A monitor thread replaces the reference's implicit Docker restart/status
+machinery: it reaps dead services and finalizes train jobs whose workers
+have all exited (stopping their advisors), i.e. the failure-detection loop
+of SURVEY.md §5.3.
+"""
+
+from __future__ import annotations
+
+import secrets
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..constants import (ServiceType, SubTrainJobStatus, TrainJobStatus,
+                         UserType)
+from ..store.meta_store import MetaStore
+from .services_manager import ServicesManager
+
+
+class AuthError(Exception):
+    pass
+
+
+class Admin:
+    def __init__(self, meta_store: MetaStore,
+                 services_manager: ServicesManager,
+                 superadmin_email: str = "superadmin@rafiki",
+                 superadmin_password: str = "rafiki") -> None:
+        self.meta = meta_store
+        self.services = services_manager
+        self._tokens: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        self._monitor_stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        if self.meta.get_user_by_email(superadmin_email) is None:
+            self.meta.create_user(superadmin_email, superadmin_password,
+                                  UserType.SUPERADMIN)
+
+    # ---- lifecycle ----
+    def start_monitor(self, interval_s: float = 0.5) -> None:
+        def loop() -> None:
+            while not self._monitor_stop.wait(interval_s):
+                try:
+                    self.services.poll()
+                    self._finalize_finished_train_jobs()
+                except Exception:  # keep the monitor alive
+                    pass
+
+        self._monitor = threading.Thread(target=loop, daemon=True)
+        self._monitor.start()
+
+    def stop(self) -> None:
+        self._monitor_stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5)
+        self.services.stop_all()
+
+    def _finalize_finished_train_jobs(self) -> None:
+        running = [s for s in self.services.services.values()
+                   if s.service_type == ServiceType.TRAIN_WORKER]
+        busy_jobs = set()
+        for s in running:
+            row = self.meta.get_service(s.service_id)
+            if row and row.get("train_job_id"):
+                busy_jobs.add(row["train_job_id"])
+        for svc in list(self.services.services.values()):
+            if svc.service_type != ServiceType.ADVISOR:
+                continue
+            row = self.meta.get_service(svc.service_id)
+            job_id = row.get("train_job_id") if row else None
+            if job_id and job_id not in busy_jobs:
+                self.services.stop_service(svc.service_id)
+                for sub in self.meta.get_sub_train_jobs_of_train_job(job_id):
+                    self.meta.update_sub_train_job(
+                        sub["id"], status=SubTrainJobStatus.STOPPED)
+                self.meta.update_train_job(job_id,
+                                           status=TrainJobStatus.STOPPED,
+                                           stopped_at=time.time())
+
+    # ---- auth ----
+    def login(self, email: str, password: str) -> Dict[str, Any]:
+        user = self.meta.authenticate_user(email, password)
+        if user is None:
+            raise AuthError("invalid email or password")
+        token = secrets.token_hex(16)
+        with self._lock:
+            self._tokens[token] = user["id"]
+        return {"token": token, "user_id": user["id"],
+                "user_type": user["user_type"]}
+
+    def authorize(self, token: str) -> Dict[str, Any]:
+        with self._lock:
+            user_id = self._tokens.get(token)
+        user = self.meta.get_user(user_id) if user_id else None
+        if user is None or user.get("banned"):
+            raise AuthError("invalid or expired token")
+        return user
+
+    def create_user(self, email: str, password: str,
+                    user_type: str) -> Dict[str, Any]:
+        u = self.meta.create_user(email, password, user_type)
+        return {k: u[k] for k in ("id", "email", "user_type")}
+
+    # ---- models ----
+    def create_model(self, user_id: str, name: str, task: str,
+                     model_class: str, model_bytes: bytes,
+                     access_right: str = "PRIVATE") -> Dict[str, Any]:
+        from ..model.base import load_model_class
+
+        load_model_class(model_bytes, model_class)  # validate importable
+        m = self.meta.create_model(user_id, name, task, model_class,
+                                   model_bytes, access_right=access_right)
+        return _model_public(m)
+
+    def get_models(self, user_id: str,
+                   task: Optional[str] = None) -> List[Dict[str, Any]]:
+        return [_model_public(m)
+                for m in self.meta.get_available_models(task=task,
+                                                        user_id=user_id)]
+
+    # ---- datasets ----
+    def create_dataset(self, user_id: str, name: str, task: str,
+                       uri: str) -> Dict[str, Any]:
+        return self.meta.create_dataset(user_id, name, task, uri)
+
+    def get_datasets(self, user_id: str,
+                     task: Optional[str] = None) -> List[Dict[str, Any]]:
+        return self.meta.get_datasets(user_id, task=task)
+
+    # ---- train jobs ----
+    def create_train_job(self, user_id: str, app: str, task: str,
+                         train_dataset_id: str, val_dataset_id: str,
+                         budget: Dict[str, Any],
+                         model_ids: Optional[List[str]] = None,
+                         train_args: Optional[Dict[str, Any]] = None
+                         ) -> Dict[str, Any]:
+        latest = self.meta.get_latest_train_job_of_app(user_id, app)
+        version = (latest["app_version"] + 1) if latest else 1
+        # datasets may be registered ids or raw host paths
+        for ds_id in (train_dataset_id, val_dataset_id):
+            ds = self.meta.get_dataset(ds_id)
+            if ds is not None:
+                continue
+        train_uri = self._resolve_dataset(train_dataset_id)
+        val_uri = self._resolve_dataset(val_dataset_id)
+
+        if model_ids is None:
+            models = self.meta.get_available_models(task=task,
+                                                    user_id=user_id)
+            model_ids = [m["id"] for m in models]
+        if not model_ids:
+            raise ValueError(f"no models available for task {task!r}")
+
+        job = self.meta.create_train_job(
+            user_id, app, version, task, budget,
+            train_uri, val_uri, train_args=train_args)
+        for mid in model_ids:
+            self.meta.create_sub_train_job(job["id"], mid)
+        self.services.create_train_services(job["id"])
+        return self.get_train_job(job["id"])
+
+    def _resolve_dataset(self, dataset_id_or_uri: str) -> str:
+        ds = self.meta.get_dataset(dataset_id_or_uri)
+        return ds["uri"] if ds is not None else dataset_id_or_uri
+
+    def get_train_job(self, job_id: str) -> Dict[str, Any]:
+        job = self.meta.get_train_job(job_id)
+        if job is None:
+            raise KeyError(f"no train job {job_id!r}")
+        job["sub_train_jobs"] = \
+            self.meta.get_sub_train_jobs_of_train_job(job_id)
+        job["n_trials"] = len(self.meta.get_trials_of_train_job(job_id))
+        return job
+
+    def get_train_job_of_app(self, user_id: str, app: str,
+                             app_version: int = -1) -> Dict[str, Any]:
+        if app_version < 0:
+            job = self.meta.get_latest_train_job_of_app(user_id, app)
+        else:
+            jobs = self.meta.get_train_jobs_of_app(user_id, app)
+            job = next((j for j in jobs
+                        if j["app_version"] == app_version), None)
+        if job is None:
+            raise KeyError(f"no train job for app {app!r}")
+        return self.get_train_job(job["id"])
+
+    def stop_train_job(self, job_id: str) -> None:
+        for svc in list(self.services.services.values()):
+            row = self.meta.get_service(svc.service_id)
+            if row and row.get("train_job_id") == job_id:
+                self.services.stop_service(svc.service_id)
+        for sub in self.meta.get_sub_train_jobs_of_train_job(job_id):
+            self.meta.update_sub_train_job(sub["id"],
+                                           status=SubTrainJobStatus.STOPPED)
+        self.meta.update_train_job(job_id, status=TrainJobStatus.STOPPED,
+                                   stopped_at=time.time())
+
+    def get_trials(self, job_id: str) -> List[Dict[str, Any]]:
+        return self.meta.get_trials_of_train_job(job_id)
+
+    def get_best_trials(self, job_id: str,
+                        max_count: int = 2) -> List[Dict[str, Any]]:
+        return self.meta.get_best_trials_of_train_job(job_id,
+                                                      max_count=max_count)
+
+    def get_trial_logs(self, trial_id: str) -> List[Dict[str, Any]]:
+        return self.meta.get_trial_logs(trial_id)
+
+    # ---- inference jobs ----
+    def create_inference_job(self, user_id: str, train_job_id: str,
+                             max_workers: int = 2) -> Dict[str, Any]:
+        job = self.meta.create_inference_job(user_id, train_job_id)
+        self.services.create_inference_services(job["id"],
+                                                max_workers=max_workers)
+        return self.get_inference_job(job["id"])
+
+    def get_inference_job(self, job_id: str) -> Dict[str, Any]:
+        job = self.meta.get_inference_job(job_id)
+        if job is None:
+            raise KeyError(f"no inference job {job_id!r}")
+        host = job.get("predictor_host") or ""
+        job["predictor_url"] = f"http://{host}" if host else None
+        return job
+
+    def stop_inference_job(self, job_id: str) -> None:
+        for svc in list(self.services.services.values()):
+            row = self.meta.get_service(svc.service_id)
+            if row and row.get("inference_job_id") == job_id:
+                self.services.stop_service(svc.service_id)
+        self.meta.update_inference_job(job_id, status="STOPPED",
+                                       stopped_at=time.time())
+
+
+def _model_public(m: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: m[k] for k in
+            ("id", "name", "task", "model_class", "access_right",
+             "created_at")}

@@ -1,0 +1,191 @@
+"""Admin REST API over the JSON HTTP kit.
+
+Parity target: the reference's Flask route table (SURVEY.md §2 "Admin",
+§3.1): tokens, users, models, datasets, train jobs, trials, inference
+jobs. Model bytes travel base64-encoded in JSON (the reference posts
+pickled classes as multipart; source-code-as-bytes is the transport here —
+see ``model.base.serialize_model_class``).
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Any, Dict, Optional, Tuple
+
+from ..utils.http import JsonHttpService
+from .admin import Admin, AuthError
+
+
+class AdminApp:
+    def __init__(self, admin: Admin, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+        self.admin = admin
+        self.http = JsonHttpService(host, port)
+        r = self.http.route
+        r("POST", "/tokens", self._login)
+        r("GET", "/health", self._health)
+        r("POST", "/users", self._auth(self._create_user))
+        r("POST", "/models", self._auth(self._create_model))
+        r("GET", "/models", self._auth(self._get_models))
+        r("POST", "/datasets", self._auth(self._create_dataset))
+        r("GET", "/datasets", self._auth(self._get_datasets))
+        r("POST", "/train_jobs", self._auth(self._create_train_job))
+        r("GET", "/train_jobs/app/<app>", self._auth(self._get_job_of_app))
+        r("GET", "/train_jobs/<id>", self._auth(self._get_train_job))
+        r("POST", "/train_jobs/<id>/stop", self._auth(self._stop_train_job))
+        r("GET", "/train_jobs/<id>/trials", self._auth(self._get_trials))
+        r("GET", "/train_jobs/<id>/best_trials",
+          self._auth(self._get_best_trials))
+        r("GET", "/trials/<id>/logs", self._auth(self._get_trial_logs))
+        r("POST", "/inference_jobs", self._auth(self._create_inference_job))
+        r("GET", "/inference_jobs/<id>", self._auth(self._get_inference_job))
+        r("POST", "/inference_jobs/<id>/stop",
+          self._auth(self._stop_inference_job))
+
+    def start(self) -> Tuple[str, int]:
+        return self.http.start()
+
+    def stop(self) -> None:
+        self.http.stop()
+        self.admin.stop()
+
+    # ---- middleware ----
+    def _auth(self, handler):
+        def wrapped(m: Dict[str, str], body: Any,
+                    headers: Dict[str, str]) -> Tuple[int, Any]:
+            hdrs = {k.lower(): v for k, v in headers.items()}
+            token = (hdrs.get("authorization") or "").removeprefix(
+                "Bearer ").strip()
+            try:
+                user = self.admin.authorize(token)
+            except AuthError as e:
+                return 401, {"error": str(e)}
+            try:
+                return handler(m, body or {}, user)
+            except (KeyError, ValueError) as e:
+                return 400, {"error": str(e)}
+
+        return wrapped
+
+    # ---- routes ----
+    def _health(self, _m, _b, _h) -> Tuple[int, Any]:
+        return 200, {"ok": True,
+                     "n_services": len(self.admin.services.services),
+                     "free_slots": self.admin.services.allocator.free_count()}
+
+    def _login(self, _m, body, _h) -> Tuple[int, Any]:
+        try:
+            return 200, self.admin.login(body["email"], body["password"])
+        except AuthError as e:
+            return 401, {"error": str(e)}
+
+    def _create_user(self, _m, body, user) -> Tuple[int, Any]:
+        return 200, self.admin.create_user(body["email"], body["password"],
+                                           body.get("user_type",
+                                                    "APP_DEVELOPER"))
+
+    def _create_model(self, _m, body, user) -> Tuple[int, Any]:
+        return 200, self.admin.create_model(
+            user["id"], body["name"], body["task"], body["model_class"],
+            base64.b64decode(body["model_bytes"]),
+            access_right=body.get("access_right", "PRIVATE"))
+
+    def _get_models(self, _m, body, user) -> Tuple[int, Any]:
+        return 200, self.admin.get_models(user["id"],
+                                          task=body.get("task"))
+
+    def _create_dataset(self, _m, body, user) -> Tuple[int, Any]:
+        return 200, self.admin.create_dataset(user["id"], body["name"],
+                                              body["task"], body["uri"])
+
+    def _get_datasets(self, _m, body, user) -> Tuple[int, Any]:
+        return 200, self.admin.get_datasets(user["id"],
+                                            task=body.get("task"))
+
+    def _create_train_job(self, _m, body, user) -> Tuple[int, Any]:
+        return 200, self.admin.create_train_job(
+            user["id"], body["app"], body["task"],
+            body["train_dataset_id"], body["val_dataset_id"],
+            body.get("budget", {"TRIAL_COUNT": 5}),
+            model_ids=body.get("model_ids"),
+            train_args=body.get("train_args"))
+
+    def _get_train_job(self, m, _b, user) -> Tuple[int, Any]:
+        return 200, self.admin.get_train_job(m["id"])
+
+    def _get_job_of_app(self, m, body, user) -> Tuple[int, Any]:
+        return 200, self.admin.get_train_job_of_app(
+            user["id"], m["app"], int(body.get("app_version", -1)))
+
+    def _stop_train_job(self, m, _b, user) -> Tuple[int, Any]:
+        self.admin.stop_train_job(m["id"])
+        return 200, {"ok": True}
+
+    def _get_trials(self, m, _b, user) -> Tuple[int, Any]:
+        return 200, self.admin.get_trials(m["id"])
+
+    def _get_best_trials(self, m, body, user) -> Tuple[int, Any]:
+        return 200, self.admin.get_best_trials(
+            m["id"], max_count=int(body.get("max_count", 2)))
+
+    def _get_trial_logs(self, m, _b, user) -> Tuple[int, Any]:
+        return 200, self.admin.get_trial_logs(m["id"])
+
+    def _create_inference_job(self, _m, body, user) -> Tuple[int, Any]:
+        try:
+            return 200, self.admin.create_inference_job(
+                user["id"], body["train_job_id"],
+                max_workers=int(body.get("max_workers", 2)))
+        except RuntimeError as e:
+            return 409, {"error": str(e)}
+
+    def _get_inference_job(self, m, _b, user) -> Tuple[int, Any]:
+        return 200, self.admin.get_inference_job(m["id"])
+
+    def _stop_inference_job(self, m, _b, user) -> Tuple[int, Any]:
+        self.admin.stop_inference_job(m["id"])
+        return 200, {"ok": True}
+
+
+def main(argv: Optional[list] = None) -> int:
+    """Service entrypoint: ``python -m rafiki_tpu.admin.app``."""
+    import argparse
+    import json
+
+    from ..utils.platform import apply_platform_env
+
+    apply_platform_env()
+
+    from ..store.meta_store import MetaStore
+    from .services_manager import ServicesManager
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", required=True,
+                        help="JSON: {workdir, db_path, host, port, "
+                             "slot_size, port_file}")
+    args = parser.parse_args(argv)
+    with open(args.config) as f:
+        cfg = json.load(f)
+
+    meta = MetaStore(cfg["db_path"])
+    manager = ServicesManager(meta, cfg["workdir"],
+                              slot_size=int(cfg.get("slot_size", 1)))
+    manager.start_data_plane()
+    admin = Admin(meta, manager)
+    admin.start_monitor()
+    app = AdminApp(admin, cfg.get("host", "127.0.0.1"),
+                   int(cfg.get("port", 0)))
+    host, port = app.start()
+    if cfg.get("port_file"):
+        with open(cfg["port_file"], "w") as f:
+            f.write(str(port))
+    print(f"admin on {host}:{port}", flush=True)
+    try:
+        app.http.serve_forever()
+    finally:
+        app.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

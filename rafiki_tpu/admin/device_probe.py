@@ -1,0 +1,37 @@
+"""Device inventory probe, run as a throwaway subprocess.
+
+The ServicesManager must learn the slice topology without initializing the
+accelerator runtime in its own process — on a TPU-VM, whichever process
+first opens the chips owns them, and the manager's job is to hand them to
+trial workers, not hold them (SURVEY.md §7 "Device multi-tenancy"). So it
+execs this module, which imports jax, dumps the inventory as one JSON line,
+and exits, releasing the chips.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def probe() -> dict:
+    from ..utils.platform import apply_platform_env
+
+    apply_platform_env()
+    import jax
+
+    devices = []
+    for d in jax.devices():
+        devices.append({
+            "id": d.id,
+            "platform": d.platform,
+            "coords": list(getattr(d, "coords", None) or []) or None,
+            "core_on_chip": getattr(d, "core_on_chip", 0),
+        })
+    return {"platform": jax.default_backend(), "devices": devices}
+
+
+if __name__ == "__main__":
+    json.dump(probe(), sys.stdout)
+    sys.stdout.write("\n")
+    sys.exit(0)

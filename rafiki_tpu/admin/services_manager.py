@@ -1,0 +1,348 @@
+"""ServicesManager: spawn/track service processes on TPU sub-meshes.
+
+Parity target: the reference's ``ServicesManager`` + ``ContainerManager``
+pair (SURVEY.md §2 "Admin"/"Container manager", §3.1/§3.2): the control
+plane spawns an advisor plus N train workers per train job, and a predictor
+plus N inference workers per inference job. The rebuild replaces "Docker
+service with one GPU" by "host process pinned to an ICI-contiguous TPU
+sub-mesh" via env vars (``TPU_VISIBLE_CHIPS`` et al., SURVEY.md §7):
+
+- Topology discovery runs in a throwaway probe subprocess so the manager
+  never holds the chips itself (``device_probe.py``).
+- A :class:`SubMeshAllocator` hands each worker a slot; the slot's env
+  vars confine the child's JAX runtime to those chips.
+- Service rows land in the MetaStore exactly as the reference records its
+  Docker services; ``poll()`` is the failure detector (SURVEY.md §5.3).
+- The data plane (param blobs + query queues) is one ``rafiki-kvd``
+  process per stack (the Redis container equivalent, SURVEY.md §5.8(b)).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+from ..constants import (ServiceStatus, ServiceType, SubTrainJobStatus,
+                         TrainJobStatus)
+from ..parallel.mesh import DeviceSpec, SubMesh, SubMeshAllocator, \
+    submesh_env_vars
+from ..store.meta_store import MetaStore
+
+
+class ManagedService:
+    """One spawned child process + its MetaStore row + its device slot."""
+
+    def __init__(self, service_id: str, service_type: str,
+                 proc: subprocess.Popen, slot: Optional[SubMesh] = None,
+                 host: str = "", port: int = 0) -> None:
+        self.service_id = service_id
+        self.service_type = service_type
+        self.proc = proc
+        self.slot = slot
+        self.host = host
+        self.port = port
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+
+def probe_devices(timeout: float = 120.0) -> Dict[str, Any]:
+    """Run the device probe subprocess; returns {platform, devices}."""
+    out = subprocess.run(
+        [sys.executable, "-m", "rafiki_tpu.admin.device_probe"],
+        capture_output=True, text=True, timeout=timeout, check=True,
+        env=os.environ.copy())
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+class ServicesManager:
+    def __init__(self, meta_store: MetaStore, workdir: str,
+                 slot_size: int = 1, platform: Optional[str] = None,
+                 devices: Optional[List[DeviceSpec]] = None) -> None:
+        self.meta = meta_store
+        self.workdir = Path(workdir)
+        self.workdir.mkdir(parents=True, exist_ok=True)
+        if devices is None:
+            inv = probe_devices()
+            platform = platform or inv["platform"]
+            devices = [DeviceSpec.from_probe(d) for d in inv["devices"]]
+        self.platform = platform or "cpu"
+        self.devices = devices
+        self.allocator = SubMeshAllocator(devices, slot_size)
+        #: serializes spawn/stop/poll across the admin + monitor threads
+        #: (e.g. the monitor must not reap an advisor between its spawn and
+        #: its workers' spawn)
+        self.op_lock = threading.RLock()
+        self.services: Dict[str, ManagedService] = {}
+        self.kv_host: str = ""
+        self.kv_port: int = 0
+        self._kv_proc: Optional[subprocess.Popen] = None
+
+    # ---- data plane ----
+    def start_data_plane(self) -> None:
+        from ..native.client import KVServer
+
+        server = KVServer()
+        self._kv_server = server
+        self._kv_proc = server._proc
+        self.kv_host, self.kv_port = server.host, server.port
+        self.meta.create_service(ServiceType.DATA_PLANE, host=server.host,
+                                 port=server.port, pid=server._proc.pid)
+
+    @property
+    def param_store_uri(self) -> str:
+        if self.kv_port:
+            return f"kv://{self.kv_host}:{self.kv_port}"
+        return f"file://{self.workdir / 'params'}"
+
+    # ---- process plumbing ----
+    def _spawn(self, module: str, config: Dict[str, Any],
+               service_type: str, slot: Optional[SubMesh] = None,
+               wait_port_file: bool = False, timeout: float = 180.0,
+               **meta_kwargs: Any) -> ManagedService:
+        tag = f"{service_type.lower()}-{uuid.uuid4().hex[:8]}"
+        cfg_path = self.workdir / f"{tag}.json"
+        port_file = self.workdir / f"{tag}.port"
+        if wait_port_file:
+            config = {**config, "port_file": str(port_file)}
+        cfg_path.write_text(json.dumps(config))
+
+        env = os.environ.copy()
+        if slot is not None:
+            env.update(submesh_env_vars(self.platform, slot))
+        else:
+            # control-plane children (advisor/predictor) must never claim
+            # accelerator chips — pin them to host CPU
+            env.update({"JAX_PLATFORMS": "cpu",
+                        "RAFIKI_JAX_PLATFORM": "cpu"})
+        log = open(self.workdir / f"{tag}.log", "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", module, "--config", str(cfg_path)],
+            env=env, stdout=log, stderr=subprocess.STDOUT)
+        log.close()
+
+        host, port = "127.0.0.1", 0
+        if wait_port_file:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if port_file.exists() and port_file.read_text().strip():
+                    port = int(port_file.read_text().strip())
+                    break
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"{service_type} died on startup; see "
+                        f"{self.workdir / f'{tag}.log'}")
+                time.sleep(0.05)
+            else:
+                proc.kill()
+                raise TimeoutError(f"{service_type} did not report a port")
+
+        row = self.meta.create_service(
+            service_type, host=host, port=port, pid=proc.pid,
+            devices=[d.id for d in (slot.devices if slot else [])],
+            **meta_kwargs)
+        svc = ManagedService(row["id"], service_type, proc, slot, host, port)
+        self.services[row["id"]] = svc
+        self.meta.update_service(row["id"], status=ServiceStatus.RUNNING)
+        return svc
+
+    # ---- train jobs (SURVEY.md §3.1) ----
+    def create_train_services(self, train_job_id: str,
+                              n_workers: int = 1) -> List[ManagedService]:
+        with self.op_lock:
+            return self._create_train_services(train_job_id, n_workers)
+
+    def _create_train_services(self, train_job_id: str,
+                               n_workers: int) -> List[ManagedService]:
+        job = self.meta.get_train_job(train_job_id)
+        if job is None:
+            raise KeyError(f"no train job {train_job_id!r}")
+        budget = job["budget"]
+        n_workers = int(budget.get("WORKER_COUNT",
+                                   budget.get("GPU_COUNT", n_workers)))
+        spawned: List[ManagedService] = []
+        for sub in self.meta.get_sub_train_jobs_of_train_job(train_job_id):
+            model = self.meta.get_model(sub["model_id"])
+            model_file = self.workdir / f"model-{model['id']}.py"
+            model_file.write_bytes(model["model_bytes"])
+
+            # one advisor service per sub-train-job (reference: one advisor
+            # container per model under tuning)
+            from ..model.base import load_model_class
+            from ..model.knob import knob_config_to_json
+
+            model_class = load_model_class(model["model_bytes"],
+                                           model["model_class"])
+            advisor = self._spawn(
+                "rafiki_tpu.advisor.service",
+                {"knob_config":
+                     knob_config_to_json(model_class.get_knob_config()),
+                 "advisor_type": job["train_args"].get("advisor", "auto"),
+                 "total_trials": budget.get("TRIAL_COUNT"),
+                 "time_budget_s": (float(budget["TIME_HOURS"]) * 3600
+                                   if budget.get("TIME_HOURS") else None)},
+                ServiceType.ADVISOR, wait_port_file=True,
+                train_job_id=train_job_id, sub_train_job_id=sub["id"])
+            spawned.append(advisor)
+
+            for w in range(n_workers):
+                slot = self.allocator.acquire(timeout=0.0)
+                if slot is None:
+                    break  # no free sub-mesh; trials queue on fewer workers
+                worker = self._spawn(
+                    "rafiki_tpu.worker.train",
+                    {"advisor_url": advisor.url,
+                     "model_file": str(model_file),
+                     "model_class": model["model_class"],
+                     "model_id": model["id"],
+                     "train_dataset": job["train_dataset_id"],
+                     "val_dataset": job["val_dataset_id"],
+                     "param_store_uri": self.param_store_uri,
+                     "meta_store_path": self.meta._db_path,
+                     "sub_train_job_id": sub["id"],
+                     "worker_id": f"tw-{sub['id'][:8]}-{w}"},
+                    ServiceType.TRAIN_WORKER, slot=slot,
+                    train_job_id=train_job_id, sub_train_job_id=sub["id"])
+                spawned.append(worker)
+            self.meta.update_sub_train_job(
+                sub["id"], status=SubTrainJobStatus.RUNNING)
+        self.meta.update_train_job(train_job_id,
+                                   status=TrainJobStatus.RUNNING)
+        return spawned
+
+    def wait_train_job(self, train_job_id: str,
+                       timeout: float = 3600.0) -> bool:
+        """Block until every train worker of the job exits; stops the
+        job's advisors; returns True if it finished in time."""
+        deadline = time.monotonic() + timeout
+        workers = [s for s in self.services.values()
+                   if s.service_type == ServiceType.TRAIN_WORKER]
+        while time.monotonic() < deadline:
+            self.poll()
+            if all(not s.alive() for s in workers):
+                break
+            time.sleep(0.2)
+        else:
+            return False
+        for s in list(self.services.values()):
+            if s.service_type == ServiceType.ADVISOR:
+                self.stop_service(s.service_id)
+        for sub in self.meta.get_sub_train_jobs_of_train_job(train_job_id):
+            self.meta.update_sub_train_job(sub["id"],
+                                           status=SubTrainJobStatus.STOPPED)
+        self.meta.update_train_job(train_job_id,
+                                   status=TrainJobStatus.STOPPED)
+        return True
+
+    # ---- inference jobs (SURVEY.md §3.2) ----
+    def create_inference_services(self, inference_job_id: str,
+                                  max_workers: int = 2
+                                  ) -> List[ManagedService]:
+        with self.op_lock:
+            return self._create_inference_services(inference_job_id,
+                                                   max_workers)
+
+    def _create_inference_services(self, inference_job_id: str,
+                                   max_workers: int) -> List[ManagedService]:
+        if not self.kv_port:
+            self.start_data_plane()
+        ijob = self.meta.get_inference_job(inference_job_id)
+        if ijob is None:
+            raise KeyError(f"no inference job {inference_job_id!r}")
+        best = self.meta.get_best_trials_of_train_job(
+            ijob["train_job_id"], max_count=max_workers)
+        if not best:
+            raise RuntimeError("no completed trials to deploy")
+
+        spawned: List[ManagedService] = []
+        worker_ids: List[str] = []
+        for i, trial in enumerate(best):
+            sub = self.meta.get_sub_train_job(trial["sub_train_job_id"])
+            model = self.meta.get_model(sub["model_id"])
+            model_file = self.workdir / f"model-{model['id']}.py"
+            model_file.write_bytes(model["model_bytes"])
+            wid = f"iw-{inference_job_id[:8]}-{i}"
+            slot = self.allocator.acquire(timeout=0.0)
+            svc = self._spawn(
+                "rafiki_tpu.worker.inference",
+                {"model_file": str(model_file),
+                 "model_class": model["model_class"],
+                 "trial_id": trial["id"], "knobs": trial["knobs"],
+                 "param_store_uri": self.param_store_uri,
+                 "kv_host": self.kv_host, "kv_port": self.kv_port,
+                 "worker_id": wid},
+                ServiceType.INFERENCE_WORKER, slot=slot,
+                inference_job_id=inference_job_id)
+            spawned.append(svc)
+            worker_ids.append(wid)
+
+        predictor = self._spawn(
+            "rafiki_tpu.serving.predictor",
+            {"worker_ids": worker_ids, "kv_host": self.kv_host,
+             "kv_port": self.kv_port, "host": "127.0.0.1", "port": 0},
+            ServiceType.PREDICTOR, wait_port_file=True,
+            inference_job_id=inference_job_id)
+        spawned.append(predictor)
+        self.meta.update_inference_job(
+            inference_job_id, status="RUNNING",
+            predictor_host=f"{predictor.host}:{predictor.port}")
+        return spawned
+
+    # ---- lifecycle / failure detection ----
+    def poll(self) -> None:
+        """Reap exited children; release their slots; record status."""
+        with self.op_lock:
+            self._poll()
+
+    def _poll(self) -> None:
+        for svc in list(self.services.values()):
+            if svc.alive():
+                continue
+            code = svc.proc.returncode
+            status = (ServiceStatus.STOPPED if code == 0
+                      else ServiceStatus.ERRORED)
+            self.meta.update_service(svc.service_id, status=status)
+            if svc.slot is not None:
+                self.allocator.release(svc.slot)
+                svc.slot = None
+            del self.services[svc.service_id]
+
+    def stop_service(self, service_id: str, timeout: float = 10.0) -> None:
+        with self.op_lock:
+            self._stop_service(service_id, timeout)
+
+    def _stop_service(self, service_id: str, timeout: float) -> None:
+        svc = self.services.get(service_id)
+        if svc is None:
+            return
+        if svc.alive():
+            svc.proc.terminate()
+            try:
+                svc.proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                svc.proc.kill()
+                svc.proc.wait()
+        self.meta.update_service(service_id, status=ServiceStatus.STOPPED)
+        if svc.slot is not None:
+            self.allocator.release(svc.slot)
+            svc.slot = None
+        del self.services[service_id]
+
+    def stop_all(self) -> None:
+        for sid in list(self.services):
+            self.stop_service(sid)
+        if self._kv_proc is not None:
+            self._kv_server.stop()
+            self._kv_proc = None
+            self.kv_host, self.kv_port = "", 0

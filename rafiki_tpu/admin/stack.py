@@ -1,0 +1,109 @@
+"""`rafiki-tpu stack` — start/stop/status of the full local service stack.
+
+Parity target: the reference's ``scripts/start.sh`` / ``stop.sh``
+(SURVEY.md §2 "Deployment"): one command brings up the whole topology.
+Here that is a single detached Admin process (which itself owns the
+data-plane server and spawns advisors/workers/predictors); state lives
+under ``--workdir``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+from ..utils.http import json_request
+
+
+def stack_command(args: argparse.Namespace) -> int:
+    workdir = Path(args.workdir).absolute()
+    pid_file = workdir / "admin.pid"
+    url_file = workdir / "admin.url"
+
+    if args.action == "start":
+        if pid_file.exists() and _pid_alive(int(pid_file.read_text())):
+            print(f"stack already running (pid {pid_file.read_text()})",
+                  file=sys.stderr)
+            return 1
+        workdir.mkdir(parents=True, exist_ok=True)
+        cfg = {"workdir": str(workdir), "db_path": str(workdir / "meta.db"),
+               "host": "127.0.0.1", "port": args.port,
+               "slot_size": getattr(args, "slot_size", 1),
+               "port_file": str(workdir / "admin.port")}
+        cfg_path = workdir / "admin.json"
+        cfg_path.write_text(json.dumps(cfg))
+        log = open(workdir / "admin.log", "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "rafiki_tpu.admin.app",
+             "--config", str(cfg_path)],
+            stdout=log, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        log.close()
+        port_file = workdir / "admin.port"
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            if port_file.exists() and port_file.read_text().strip():
+                break
+            if proc.poll() is not None:
+                print(f"admin died on startup; see {workdir / 'admin.log'}",
+                      file=sys.stderr)
+                return 1
+            time.sleep(0.1)
+        else:
+            proc.kill()
+            print("admin did not come up in time", file=sys.stderr)
+            return 1
+        port = int(port_file.read_text().strip())
+        url = f"http://127.0.0.1:{port}"
+        pid_file.write_text(str(proc.pid))
+        url_file.write_text(url)
+        print(f"stack up: {url} (pid {proc.pid})")
+        print("login: superadmin@rafiki / rafiki")
+        return 0
+
+    if args.action == "stop":
+        if not pid_file.exists():
+            print("stack is not running", file=sys.stderr)
+            return 1
+        pid = int(pid_file.read_text())
+        if _pid_alive(pid):
+            os.kill(pid, signal.SIGTERM)
+            for _ in range(100):
+                if not _pid_alive(pid):
+                    break
+                time.sleep(0.1)
+            else:
+                os.kill(pid, signal.SIGKILL)
+        pid_file.unlink(missing_ok=True)
+        print("stack stopped")
+        return 0
+
+    if args.action == "status":
+        if not url_file.exists():
+            print("stack is not running")
+            return 1
+        url = url_file.read_text().strip()
+        try:
+            health = json_request("GET", f"{url}/health", timeout=5)
+        except OSError:
+            print(f"stack at {url} is not answering")
+            return 1
+        print(json.dumps({"url": url, **health}))
+        return 0
+
+    print(f"unknown stack action {args.action!r}", file=sys.stderr)
+    return 2
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except (ProcessLookupError, PermissionError):
+        return False

@@ -103,6 +103,7 @@ def main(argv: Optional[list] = None) -> int:
 
     with open(args.config) as f:
         cfg = _json.load(f)
+    port_file = args.port_file or cfg.get("port_file", "")
     advisor = make_advisor(
         knob_config_from_json(cfg["knob_config"]),
         cfg.get("advisor_type", "auto"),
@@ -111,8 +112,8 @@ def main(argv: Optional[list] = None) -> int:
         seed=cfg.get("seed", 0))
     service = AdvisorService(advisor, args.host, args.port)
     host, port = service.start()
-    if args.port_file:
-        with open(args.port_file, "w") as f:
+    if port_file:
+        with open(port_file, "w") as f:
             f.write(str(port))
     print(f"advisor service on {host}:{port}", flush=True)
     service.http.serve_forever()

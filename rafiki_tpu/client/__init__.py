@@ -1,0 +1,5 @@
+"""User-facing SDK mirroring the reference's ``rafiki.client``."""
+
+from .client import Client
+
+__all__ = ["Client"]

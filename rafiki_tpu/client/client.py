@@ -1,0 +1,160 @@
+"""Client SDK: the user-facing mirror of the Admin REST API.
+
+Parity target: the reference's ``rafiki/client/client.py`` ``Client``
+surface (SURVEY.md §2 "Client SDK", §1 layer 2): ``login``,
+``create_model``, ``create_dataset``, ``create_train_job``,
+``get_train_job``, ``get_best_trials_of_train_job``,
+``create_inference_job``, and a ``predict`` helper against the deployed
+predictor endpoint.
+"""
+
+from __future__ import annotations
+
+import base64
+import time
+from typing import Any, Dict, List, Optional, Sequence, Type
+
+from ..utils.http import json_request
+
+
+class Client:
+    def __init__(self, admin_url: str = "http://127.0.0.1:3000",
+                 timeout: float = 120.0) -> None:
+        self.admin_url = admin_url.rstrip("/")
+        self.timeout = timeout
+        self._token: Optional[str] = None
+
+    # ---- plumbing ----
+    def _call(self, method: str, path: str,
+              body: Any = None) -> Any:
+        headers = {}
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
+        return json_request(method, f"{self.admin_url}{path}", body,
+                            headers=headers, timeout=self.timeout)
+
+    # ---- auth ----
+    def login(self, email: str, password: str) -> Dict[str, Any]:
+        out = json_request("POST", f"{self.admin_url}/tokens",
+                           {"email": email, "password": password},
+                           timeout=self.timeout)
+        self._token = out["token"]
+        return out
+
+    def create_user(self, email: str, password: str,
+                    user_type: str = "APP_DEVELOPER") -> Dict[str, Any]:
+        return self._call("POST", "/users",
+                          {"email": email, "password": password,
+                           "user_type": user_type})
+
+    # ---- models ----
+    def create_model(self, name: str, task: str, model_class: Any,
+                     access_right: str = "PRIVATE") -> Dict[str, Any]:
+        """``model_class`` may be a BaseModel subclass (its module source
+        is shipped) or raw source bytes + ``name:class`` string."""
+        if isinstance(model_class, (bytes, bytearray)):
+            raise TypeError("pass (bytes, class_name) via create_model_raw")
+        from ..model.base import serialize_model_class
+
+        model_bytes = serialize_model_class(model_class)
+        return self.create_model_raw(name, task, model_class.__name__,
+                                     model_bytes, access_right)
+
+    def create_model_raw(self, name: str, task: str, class_name: str,
+                         model_bytes: bytes,
+                         access_right: str = "PRIVATE") -> Dict[str, Any]:
+        return self._call("POST", "/models", {
+            "name": name, "task": task, "model_class": class_name,
+            "model_bytes": base64.b64encode(model_bytes).decode(),
+            "access_right": access_right})
+
+    def get_models(self, task: Optional[str] = None) -> List[Dict]:
+        out = self._call("GET", "/models")
+        return [m for m in out if task is None or m["task"] == task]
+
+    # ---- datasets ----
+    def create_dataset(self, name: str, task: str,
+                       uri: str) -> Dict[str, Any]:
+        return self._call("POST", "/datasets",
+                          {"name": name, "task": task, "uri": uri})
+
+    # ---- train jobs ----
+    def create_train_job(self, app: str, task: str, train_dataset_id: str,
+                         val_dataset_id: str,
+                         budget: Optional[Dict[str, Any]] = None,
+                         model_ids: Optional[List[str]] = None,
+                         train_args: Optional[Dict[str, Any]] = None
+                         ) -> Dict[str, Any]:
+        return self._call("POST", "/train_jobs", {
+            "app": app, "task": task,
+            "train_dataset_id": train_dataset_id,
+            "val_dataset_id": val_dataset_id,
+            "budget": budget or {"TRIAL_COUNT": 5},
+            "model_ids": model_ids, "train_args": train_args})
+
+    def get_train_job(self, job_id: str) -> Dict[str, Any]:
+        return self._call("GET", f"/train_jobs/{job_id}")
+
+    def get_train_job_of_app(self, app: str) -> Dict[str, Any]:
+        return self._call("GET", f"/train_jobs/app/{app}")
+
+    def stop_train_job(self, job_id: str) -> None:
+        self._call("POST", f"/train_jobs/{job_id}/stop")
+
+    def wait_until_train_job_finished(self, job_id: str,
+                                      timeout: float = 1800.0,
+                                      poll_s: float = 1.0
+                                      ) -> Dict[str, Any]:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            job = self.get_train_job(job_id)
+            if job["status"] in ("STOPPED", "ERRORED"):
+                return job
+            time.sleep(poll_s)
+        raise TimeoutError(f"train job {job_id} still running")
+
+    def get_trials_of_train_job(self, job_id: str) -> List[Dict]:
+        return self._call("GET", f"/train_jobs/{job_id}/trials")
+
+    def get_best_trials_of_train_job(self, job_id: str,
+                                     max_count: int = 2) -> List[Dict]:
+        return self._call("GET", f"/train_jobs/{job_id}/best_trials",
+                          {"max_count": max_count})
+
+    def get_trial_logs(self, trial_id: str) -> List[Dict]:
+        return self._call("GET", f"/trials/{trial_id}/logs")
+
+    # ---- inference jobs ----
+    def create_inference_job(self, train_job_id: str,
+                             max_workers: int = 2) -> Dict[str, Any]:
+        return self._call("POST", "/inference_jobs",
+                          {"train_job_id": train_job_id,
+                           "max_workers": max_workers})
+
+    def get_inference_job(self, job_id: str) -> Dict[str, Any]:
+        return self._call("GET", f"/inference_jobs/{job_id}")
+
+    def stop_inference_job(self, job_id: str) -> None:
+        self._call("POST", f"/inference_jobs/{job_id}/stop")
+
+    # ---- online prediction ----
+    def predict(self, predictor_url: str, queries: Sequence[Any],
+                timeout: Optional[float] = None) -> List[Any]:
+        body: Dict[str, Any] = {"queries": _jsonable(queries)}
+        if timeout is not None:
+            body["timeout"] = timeout
+        out = json_request("POST", f"{predictor_url.rstrip('/')}/predict",
+                           body, timeout=self.timeout)
+        return out["predictions"]
+
+
+def _jsonable(queries: Sequence[Any]) -> List[Any]:
+    import numpy as np
+
+    out = []
+    for q in queries:
+        if isinstance(q, np.ndarray) or hasattr(q, "tolist"):
+            out.append(np.asarray(q).tolist())
+        else:
+            out.append(q)
+    return out

@@ -28,6 +28,25 @@ import numpy as np
 Device = Any  # jax Device
 
 
+@dataclass(frozen=True)
+class DeviceSpec:
+    """Topology-only stand-in for a jax Device (what the device probe
+    reports): enough for partitioning without holding the runtime."""
+
+    id: int
+    coords: Optional[Tuple[int, ...]] = None
+    core_on_chip: int = 0
+    platform: str = "cpu"
+
+    @staticmethod
+    def from_probe(d: Dict[str, Any]) -> "DeviceSpec":
+        coords = d.get("coords")
+        return DeviceSpec(id=int(d["id"]),
+                          coords=tuple(coords) if coords else None,
+                          core_on_chip=int(d.get("core_on_chip", 0)),
+                          platform=d.get("platform", "cpu"))
+
+
 def device_sort_key(d: Device) -> Tuple:
     coords = getattr(d, "coords", None)
     if coords is not None:
@@ -208,8 +227,16 @@ def submesh_env_vars(platform: str, slot: SubMesh) -> Dict[str, str]:
             "TPU_PROCESS_BOUNDS": "1,1,1",
             "ALLOW_MULTIPLE_LIBTPU_LOAD": "1",
         }
-    # cpu / tests
-    return {
-        "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": f"--xla_force_host_platform_device_count={slot.size}",
-    }
+    if platform == "cpu":
+        # tests — RAFIKI_JAX_PLATFORM makes the child override via
+        # jax.config too (env alone loses to an image-level sitecustomize)
+        return {
+            "JAX_PLATFORMS": "cpu",
+            "RAFIKI_JAX_PLATFORM": "cpu",
+            "XLA_FLAGS":
+                f"--xla_force_host_platform_device_count={slot.size}",
+        }
+    # unknown accelerator platform (e.g. a tunneled PJRT plugin): inherit
+    # the parent environment — the allocator still guarantees one worker
+    # per slot, which is the whole-device case here
+    return {}

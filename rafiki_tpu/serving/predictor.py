@@ -147,3 +147,40 @@ class PredictorService:
 
     def _health(self, _m, _b, _h) -> Tuple[int, Any]:
         return 200, {"ok": True, **self.predictor.stats()}
+
+
+def main(argv: Optional[list] = None) -> int:
+    """Service entrypoint: ``python -m rafiki_tpu.serving.predictor``."""
+    import argparse
+    import json
+
+    from ..utils.platform import apply_platform_env
+
+    apply_platform_env()  # ensemble math is numpy; never claim the chips
+
+    from .queues import KVQueueHub
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", required=True,
+                        help="JSON: {worker_ids, kv_host, kv_port, host, "
+                             "port, port_file, gather_timeout}")
+    args = parser.parse_args(argv)
+    with open(args.config) as f:
+        cfg = json.load(f)
+    hub = KVQueueHub(cfg["kv_host"], int(cfg["kv_port"]))
+    predictor = Predictor(hub, cfg["worker_ids"],
+                          gather_timeout=float(cfg.get("gather_timeout",
+                                                       30.0)))
+    svc = PredictorService(predictor, cfg.get("host", "127.0.0.1"),
+                           int(cfg.get("port", 0)))
+    host, port = svc.start()
+    if cfg.get("port_file"):
+        with open(cfg["port_file"], "w") as f:
+            f.write(str(port))
+    print(f"predictor on {host}:{port}", flush=True)
+    svc.http.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,25 @@
+"""Platform pinning for spawned service processes.
+
+The TPU-VM image may register an accelerator PJRT plugin at interpreter
+start (sitecustomize) and pin ``JAX_PLATFORMS`` in the environment, so a
+child that should run on CPU (tests, control-plane probes) cannot rely on
+env vars alone — it must override via ``jax.config`` before any backend
+initializes. Service entrypoints call :func:`apply_platform_env` first.
+"""
+
+from __future__ import annotations
+
+import os
+
+#: set by the ServicesManager on children: "cpu" | "tpu" | "" (inherit)
+PLATFORM_ENV = "RAFIKI_JAX_PLATFORM"
+
+
+def apply_platform_env() -> str:
+    """Apply the requested platform before jax backends initialize."""
+    platform = os.environ.get(PLATFORM_ENV, "")
+    if platform and platform != "tpu":
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    return platform

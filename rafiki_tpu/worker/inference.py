@@ -107,6 +107,10 @@ def main(argv: Optional[list] = None) -> int:
     import argparse
     import json
 
+    from ..utils.platform import apply_platform_env
+
+    apply_platform_env()  # before any jax backend initializes
+
     from ..model.base import load_model_class
     from ..serving.queues import KVQueueHub
 

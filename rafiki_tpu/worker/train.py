@@ -123,6 +123,10 @@ def main(argv: Optional[list] = None) -> int:
     import argparse
     import json
 
+    from ..utils.platform import apply_platform_env
+
+    apply_platform_env()  # before any jax backend initializes
+
     from ..advisor.service import AdvisorClient
     from ..model.base import load_model_class
     from ..store.meta_store import MetaStore
@@ -148,6 +152,7 @@ def main(argv: Optional[list] = None) -> int:
         param_store=ParamStore.from_uri(cfg.get("param_store_uri", "mem://")),
         meta_store=meta_store,
         sub_train_job_id=cfg.get("sub_train_job_id", ""),
+        model_id=cfg.get("model_id", ""),
         worker_id=cfg.get("worker_id", "worker-0"))
     n = worker.run()
     print(f"train worker {worker.worker_id} done: {n} trials", flush=True)

@@ -1,0 +1,97 @@
+"""Full-stack end-to-end: Admin REST → train services → deploy → predict.
+
+The scale-down analog of the reference's quickstart integration flow
+(SURVEY.md §4): the whole multi-service topology on one machine, CPU JAX,
+real subprocesses for advisor / train workers / data plane / inference
+workers / predictor.
+"""
+
+import numpy as np
+import pytest
+
+from rafiki_tpu.admin.admin import Admin
+from rafiki_tpu.admin.app import AdminApp
+from rafiki_tpu.admin.services_manager import ServicesManager
+from rafiki_tpu.client import Client
+from rafiki_tpu.data import generate_image_classification_dataset
+from rafiki_tpu.models.mlp import JaxFeedForward
+from rafiki_tpu.parallel.mesh import DeviceSpec
+from rafiki_tpu.store.meta_store import MetaStore
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    work = tmp_path_factory.mktemp("stack")
+    meta = MetaStore(str(work / "meta.db"))
+    manager = ServicesManager(
+        meta, str(work), slot_size=1, platform="cpu",
+        devices=[DeviceSpec(id=i) for i in range(4)])
+    manager.start_data_plane()
+    admin = Admin(meta, manager)
+    admin.start_monitor(interval_s=0.3)
+    app = AdminApp(admin)
+    host, port = app.start()
+    client = Client(f"http://{host}:{port}")
+    try:
+        yield client, work
+    finally:
+        app.stop()
+
+
+@pytest.fixture(scope="module")
+def datasets(tmp_path_factory):
+    d = tmp_path_factory.mktemp("e2e_ds")
+    tr, va = str(d / "train.npz"), str(d / "val.npz")
+    generate_image_classification_dataset(tr, 256, seed=0)
+    val = generate_image_classification_dataset(va, 64, seed=1)
+    return tr, va, val
+
+
+@pytest.mark.slow
+def test_full_stack_train_deploy_predict(stack, datasets):
+    client, _work = stack
+    tr, va, val = datasets
+
+    out = client.login("superadmin@rafiki", "rafiki")
+    assert out["token"]
+
+    model = client.create_model("mlp", "IMAGE_CLASSIFICATION",
+                                JaxFeedForward)
+    ds_tr = client.create_dataset("train", "IMAGE_CLASSIFICATION", tr)
+    ds_va = client.create_dataset("val", "IMAGE_CLASSIFICATION", va)
+
+    job = client.create_train_job(
+        app="e2e-app", task="IMAGE_CLASSIFICATION",
+        train_dataset_id=ds_tr["id"], val_dataset_id=ds_va["id"],
+        budget={"TRIAL_COUNT": 2, "WORKER_COUNT": 2},
+        model_ids=[model["id"]],
+        train_args={"advisor": "random"})
+    assert job["status"] == "RUNNING"
+    assert len(job["sub_train_jobs"]) == 1
+
+    job = client.wait_until_train_job_finished(job["id"], timeout=600)
+    assert job["status"] == "STOPPED"
+
+    trials = client.get_trials_of_train_job(job["id"])
+    assert len(trials) == 2
+    completed = [t for t in trials if t["status"] == "COMPLETED"]
+    assert completed, f"no completed trials: {trials}"
+
+    best = client.get_best_trials_of_train_job(job["id"])
+    assert best[0]["score"] > 0.3
+    logs = client.get_trial_logs(best[0]["id"])
+    assert any(r["kind"] == "values" for r in logs)
+
+    ijob = client.create_inference_job(job["id"], max_workers=2)
+    assert ijob["predictor_url"]
+
+    preds = client.predict(ijob["predictor_url"],
+                           [val.images[i] for i in range(4)], timeout=120)
+    assert len(preds) == 4
+    acc = np.mean([int(np.argmax(p)) == val.labels[i]
+                   for i, p in enumerate(preds)])
+    assert acc >= 0.5
+
+    client.stop_inference_job(ijob["id"])
+    final = client.get_inference_job(ijob["id"])
+    assert final["status"] == "STOPPED"
