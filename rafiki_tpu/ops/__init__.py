@@ -1,0 +1,8 @@
+"""Pallas TPU kernels for the hot ops (XLA-fallback-free on TPU;
+interpreter mode on CPU so tests run the same code path)."""
+
+from .attention import flash_attention, mha
+from .patch_embed import extract_patches, matmul_bias, patch_embed
+
+__all__ = ["flash_attention", "mha", "patch_embed", "matmul_bias",
+           "extract_patches"]

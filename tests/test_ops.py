@@ -1,0 +1,140 @@
+"""Pallas kernels vs pure-XLA oracles (interpreter mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rafiki_tpu.ops.attention import (_attention_reference, flash_attention,
+                                      mha)
+from rafiki_tpu.ops.patch_embed import (extract_patches, matmul_bias,
+                                        patch_embed)
+
+
+def _rand(*shape, key=0, dtype=jnp.float32):
+    return jax.random.normal(jax.random.PRNGKey(key), shape, dtype)
+
+
+@pytest.mark.parametrize("s_q,s_kv", [(128, 128), (100, 100), (197, 197),
+                                      (64, 256)])
+def test_flash_attention_matches_reference(s_q, s_kv):
+    q = _rand(2, 4, s_q, 64, key=0)
+    k = _rand(2, 4, s_kv, 64, key=1)
+    v = _rand(2, 4, s_kv, 64, key=2)
+    out = flash_attention(q, k, v)
+    ref = _attention_reference(q, k, v, 1.0 / np.sqrt(64), False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_attention_causal():
+    q = _rand(1, 2, 130, 32, key=0)
+    k = _rand(1, 2, 130, 32, key=1)
+    v = _rand(1, 2, 130, 32, key=2)
+    out = flash_attention(q, k, v, None, True)
+    ref = _attention_reference(q, k, v, 1.0 / np.sqrt(32), True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_attention_grads():
+    q = _rand(1, 2, 64, 32, key=0)
+    k = _rand(1, 2, 64, 32, key=1)
+    v = _rand(1, 2, 64, 32, key=2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(
+            _attention_reference(q, k, v, 1.0 / np.sqrt(32), False) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_flash_attention_bf16():
+    q = _rand(1, 2, 128, 64, key=0, dtype=jnp.bfloat16)
+    k = _rand(1, 2, 128, 64, key=1, dtype=jnp.bfloat16)
+    v = _rand(1, 2, 128, 64, key=2, dtype=jnp.bfloat16)
+    out = flash_attention(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    ref = _attention_reference(q.astype(jnp.float32),
+                               k.astype(jnp.float32),
+                               v.astype(jnp.float32), 1.0 / np.sqrt(64),
+                               False)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), atol=3e-2, rtol=3e-2)
+
+
+def test_mha_layer_shapes():
+    d_model, n_heads = 64, 4
+    params = {
+        "wq": _rand(d_model, d_model, key=0),
+        "wk": _rand(d_model, d_model, key=1),
+        "wv": _rand(d_model, d_model, key=2),
+        "wo": _rand(d_model, d_model, key=3),
+        "bq": jnp.zeros(d_model), "bk": jnp.zeros(d_model),
+        "bv": jnp.zeros(d_model), "bo": jnp.zeros(d_model),
+    }
+    x = _rand(2, 50, d_model, key=4)
+    out = mha(x, x, params, n_heads)
+    assert out.shape == (2, 50, d_model)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_matmul_bias():
+    x = _rand(300, 200, key=0)
+    w = _rand(200, 130, key=1)
+    b = _rand(130, key=2)
+    out = matmul_bias(x, w, b)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w + b),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_extract_patches_roundtrip():
+    imgs = _rand(2, 8, 8, 3, key=0)
+    patches = extract_patches(imgs, 4)
+    assert patches.shape == (2, 4, 48)
+    # first patch == top-left 4x4 block flattened
+    np.testing.assert_allclose(np.asarray(patches[0, 0]),
+                               np.asarray(imgs[0, :4, :4, :]).reshape(-1))
+
+
+def test_patch_embed_matches_conv():
+    imgs = _rand(2, 32, 32, 3, key=0)
+    p, d = 8, 96
+    w = _rand(p * p * 3, d, key=1) * 0.02
+    b = _rand(d, key=2) * 0.01
+    out = patch_embed(imgs, w, b, p)
+    assert out.shape == (2, 16, d)
+    # oracle: conv with stride=kernel=p
+    wk = w.reshape(p, p, 3, d)
+    ref = jax.lax.conv_general_dilated(
+        imgs, wk, (p, p), "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC")).reshape(2, 16, d) + b
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_patch_embed_grads():
+    imgs = _rand(1, 16, 16, 3, key=0)
+    p, d = 8, 32
+    w = _rand(p * p * 3, d, key=1) * 0.02
+    b = jnp.zeros(d)
+
+    def loss_pe(imgs, w, b):
+        return jnp.sum(patch_embed(imgs, w, b, p) ** 2)
+
+    def loss_ref(imgs, w, b):
+        pt = extract_patches(imgs, p)
+        return jnp.sum((jnp.einsum("bnk,kd->bnd", pt, w) + b) ** 2)
+
+    g1 = jax.grad(loss_pe, argnums=(0, 1, 2))(imgs, w, b)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(imgs, w, b)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=1e-4, rtol=1e-4)
