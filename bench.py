@@ -52,15 +52,16 @@ def _bench_train_throughput():
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    # warmup / compile
+    # warmup / compile; float() forces a device sync (block_until_ready
+    # alone does not drain remote-execution backends)
     params, opt_state, loss = step(params, opt_state, x, y)
-    jax.block_until_ready(loss)
+    float(loss)
 
     iters = 20 if jax.default_backend() != "cpu" else 5
     t0 = time.perf_counter()
     for _ in range(iters):
         params, opt_state, loss = step(params, opt_state, x, y)
-    jax.block_until_ready(loss)
+    float(loss)
     dt = time.perf_counter() - t0
     return name, batch * iters / dt
 

@@ -1,0 +1,315 @@
+"""ViT — the north-star model family (BASELINE.md config #3).
+
+The flax module runs its two hot ops through the Pallas kernels
+(``rafiki_tpu.ops``): patch embedding as the fused MXU matmul and
+attention as flash attention with online softmax. The ``ViTBase16`` template
+wraps it in the model contract with data-parallel training over the
+trial's TPU sub-mesh (gradients all-reduced by XLA via NamedSharding —
+SURVEY.md §2.2 "data-parallel over ICI").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from rafiki_tpu.constants import TaskType
+from rafiki_tpu.data import batch_iterator, \
+    load_image_classification_dataset
+from rafiki_tpu.model import (BaseModel, CategoricalKnob, FixedKnob,
+                              FloatKnob, IntegerKnob, KnobConfig, PolicyKnob,
+                              TrainContext)
+from rafiki_tpu.ops.attention import flash_attention
+from rafiki_tpu.ops.patch_embed import patch_embed
+from rafiki_tpu.parallel.sharding import (batch_sharding, make_mesh,
+                                          replicated)
+
+
+class _Attention(nn.Module):
+    n_heads: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, s, d = x.shape
+        dh = d // self.n_heads
+        qkv = nn.Dense(3 * d, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(b, s, self.n_heads, dh).transpose(0, 2, 1, 3)
+
+        o = flash_attention(heads(q), heads(k), heads(v))
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
+        return nn.Dense(d, name="proj")(o)
+
+
+class _Block(nn.Module):
+    n_heads: int
+    mlp_dim: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x + _Attention(self.n_heads, name="attn")(nn.LayerNorm()(x))
+        y = nn.LayerNorm()(x)
+        y = nn.Dense(self.mlp_dim)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(x.shape[-1])(y)
+        return x + y
+
+
+class _PatchEmbed(nn.Module):
+    """Pallas-fused patch projection as a flax layer."""
+
+    patch_size: int
+    hidden_dim: int
+
+    @nn.compact
+    def __call__(self, images: jnp.ndarray) -> jnp.ndarray:
+        p = self.patch_size
+        c = images.shape[-1]
+        w = self.param("kernel", nn.initializers.lecun_normal(),
+                       (p * p * c, self.hidden_dim))
+        b = self.param("bias", nn.initializers.zeros, (self.hidden_dim,))
+        return patch_embed(images, w, b, p)
+
+
+class ViT(nn.Module):
+    """Vision Transformer over (B, H, W, C) images.
+
+    ViT-B/16 = patch_size=16, hidden_dim=768, depth=12, n_heads=12,
+    mlp_dim=3072.
+    """
+
+    patch_size: int = 16
+    hidden_dim: int = 768
+    depth: int = 12
+    n_heads: int = 12
+    mlp_dim: int = 3072
+    n_classes: int = 1000
+
+    @nn.compact
+    def __call__(self, images: jnp.ndarray) -> jnp.ndarray:
+        x = _PatchEmbed(self.patch_size, self.hidden_dim,
+                        name="patch_embed")(images)
+        b, n, d = x.shape
+        cls = self.param("cls", nn.initializers.zeros, (1, 1, d))
+        x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, d)), x], axis=1)
+        pos = self.param("pos_embed",
+                         nn.initializers.normal(0.02), (1, n + 1, d))
+        x = x + pos
+        for i in range(self.depth):
+            x = _Block(self.n_heads, self.mlp_dim, name=f"block_{i}")(x)
+        x = nn.LayerNorm(name="final_norm")(x)
+        return nn.Dense(self.n_classes, name="head")(x[:, 0])
+
+
+class ViTBase16(BaseModel):
+    """ViT template: image classification with DP over the trial sub-mesh."""
+
+    TASKS = (TaskType.IMAGE_CLASSIFICATION,)
+
+    @staticmethod
+    def get_knob_config() -> KnobConfig:
+        return {
+            "max_epochs": FixedKnob(5),
+            "patch_size": CategoricalKnob([4, 7, 14, 16],
+                                          shape_relevant=True),
+            "hidden_dim": CategoricalKnob([64, 128, 192, 768],
+                                          shape_relevant=True),
+            "depth": IntegerKnob(2, 12, shape_relevant=True),
+            "n_heads": CategoricalKnob([4, 8, 12], shape_relevant=True),
+            "learning_rate": FloatKnob(1e-5, 1e-2, is_exp=True),
+            "weight_decay": FloatKnob(1e-5, 1e-1, is_exp=True),
+            "batch_size": CategoricalKnob([16, 32, 64, 128],
+                                          shape_relevant=True),
+            "bf16": CategoricalKnob([True, False]),
+            "quick_train": PolicyKnob("QUICK_TRAIN"),
+            "share_params": PolicyKnob("SHARE_PARAMS"),
+        }
+
+    def __init__(self, **knobs: Any) -> None:
+        super().__init__(**knobs)
+        self._params: Optional[Any] = None
+        self._n_classes: Optional[int] = None
+        self._image_shape: Optional[Sequence[int]] = None
+
+    # ---- internals ----
+    def _module(self) -> ViT:
+        k = self.knobs
+        hd = int(k["hidden_dim"])
+        heads = int(k["n_heads"])
+        if hd % heads:
+            heads = max(h for h in (1, 2, 4, 8, 12) if hd % h == 0)
+        return ViT(patch_size=int(k["patch_size"]), hidden_dim=hd,
+                   depth=int(k["depth"]), n_heads=heads,
+                   mlp_dim=4 * hd, n_classes=int(self._n_classes))
+
+    def _prep(self, images: np.ndarray) -> np.ndarray:
+        x = images.astype(np.float32) / 255.0
+        if x.ndim == 3:
+            x = x[..., None]
+        p = int(self.knobs["patch_size"])
+        # pad H/W up to patch multiples (e.g. 28x28 with p=16 → 32x32)
+        ph = (-x.shape[1]) % p
+        pw = (-x.shape[2]) % p
+        if ph or pw:
+            x = np.pad(x, ((0, 0), (0, ph), (0, pw), (0, 0)))
+        return x
+
+    def _dtype(self):
+        return jnp.bfloat16 if self.knobs.get("bf16", True) else jnp.float32
+
+    # ---- contract ----
+    def train(self, dataset_path: str,
+              ctx: Optional[TrainContext] = None) -> None:
+        ctx = ctx or TrainContext()
+        ds = load_image_classification_dataset(dataset_path)
+        self._n_classes = ds.n_classes
+        self._image_shape = ds.image_shape
+        x = self._prep(ds.images)
+        y = ds.labels
+
+        module = self._module()
+        devices = ctx.devices or jax.local_devices()
+        mesh = make_mesh(devices)
+        b_shard = batch_sharding(mesh)
+        r_shard = replicated(mesh)
+
+        batch_size = int(self.knobs["batch_size"])
+        # static shapes: batch must divide the data axis
+        n_data = len(devices)
+        batch_size = max(n_data, batch_size - batch_size % n_data)
+        dtype = self._dtype()
+
+        if self._params is None:
+            params = module.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((1, *x.shape[1:]), dtype))["params"]
+        else:
+            params = self._params
+        if ctx.shared_params is not None and self.knobs.get("share_params"):
+            shared = ctx.shared_params.get("params")
+            if shared is not None and _same_shapes(params, shared):
+                params = jax.tree_util.tree_map(jnp.asarray, shared)
+
+        lr = float(self.knobs["learning_rate"])
+        tx = optax.adamw(lr, weight_decay=float(self.knobs["weight_decay"]))
+        params = jax.device_put(params, r_shard)
+        opt_state = jax.device_put(tx.init(params), r_shard)
+
+        @jax.jit
+        def train_step(params, opt_state, xb, yb, mask):
+            def loss_fn(p):
+                logits = module.apply({"params": p}, xb.astype(dtype))
+                losses = optax.softmax_cross_entropy_with_integer_labels(
+                    logits.astype(jnp.float32), yb)
+                return jnp.sum(losses * mask) / jnp.maximum(
+                    jnp.sum(mask), 1.0)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        epochs = max(1, round(int(self.knobs["max_epochs"])
+                              * float(ctx.budget_scale)))
+        if self.knobs.get("quick_train"):
+            epochs = min(epochs, 2)
+        ctx.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
+        with mesh:
+            for epoch in range(epochs):
+                losses = []
+                for batch in batch_iterator({"x": x, "y": y}, batch_size,
+                                            seed=epoch):
+                    xb = jax.device_put(batch["x"], b_shard)
+                    yb = jax.device_put(batch["y"], b_shard)
+                    mb = jax.device_put(
+                        batch["mask"].astype(np.float32), b_shard)
+                    params, opt_state, loss = train_step(
+                        params, opt_state, xb, yb, mb)
+                    losses.append(float(loss))
+                mean_loss = float(np.mean(losses))
+                ctx.logger.log(epoch=epoch, loss=mean_loss)
+                if ctx.should_continue is not None and \
+                        not ctx.should_continue(epoch, -mean_loss):
+                    break
+        self._params = params
+
+    def evaluate(self, dataset_path: str) -> float:
+        ds = load_image_classification_dataset(dataset_path)
+        probs = self._predict_probs(self._prep(ds.images))
+        return float(np.mean(np.argmax(probs, -1) == ds.labels))
+
+    def predict(self, queries: Sequence[Any]) -> List[Any]:
+        x = self._prep(np.stack([np.asarray(q) for q in queries]))
+        return [p.tolist() for p in self._predict_probs(x)]
+
+    def _predict_probs(self, x: np.ndarray) -> np.ndarray:
+        assert self._params is not None, "model is not trained/loaded"
+        module = self._module()
+        dtype = self._dtype()
+
+        @jax.jit
+        def forward(params, xb):
+            logits = module.apply({"params": params}, xb.astype(dtype))
+            return jax.nn.softmax(logits.astype(jnp.float32), -1)
+
+        out = []
+        bucket = 64  # static-shape bucketing (one compile per bucket)
+        for i in range(0, len(x), bucket):
+            xb = x[i:i + bucket]
+            pad = bucket - len(xb)
+            if pad:
+                xb = np.concatenate(
+                    [xb, np.zeros((pad, *xb.shape[1:]), xb.dtype)])
+            out.append(np.asarray(forward(self._params, xb))[:bucket - pad])
+        return np.concatenate(out)
+
+    def dump_parameters(self) -> Dict[str, Any]:
+        assert self._params is not None, "model is not trained"
+        return {
+            "params": jax.tree_util.tree_map(np.asarray, self._params),
+            "meta": {"n_classes": self._n_classes,
+                     "image_shape": list(self._image_shape or [])},
+        }
+
+    def load_parameters(self, params: Dict[str, Any]) -> None:
+        self._n_classes = int(params["meta"]["n_classes"])
+        self._image_shape = list(params["meta"]["image_shape"])
+        self._params = jax.tree_util.tree_map(jnp.asarray, params["params"])
+
+
+def _same_shapes(a: Any, b: Any) -> bool:
+    ta = jax.tree_util.tree_structure(a)
+    tb = jax.tree_util.tree_structure(b)
+    if ta != tb:
+        return False
+    return all(getattr(x, "shape", None) == getattr(y, "shape", None)
+               for x, y in zip(jax.tree_util.tree_leaves(a),
+                               jax.tree_util.tree_leaves(b)))
+
+
+if __name__ == "__main__":  # reference-style self-test block
+    import tempfile
+
+    from rafiki_tpu.data import generate_image_classification_dataset
+    from rafiki_tpu.model import test_model_class
+
+    with tempfile.TemporaryDirectory() as d:
+        train_p = f"{d}/train.npz"
+        val_p = f"{d}/val.npz"
+        generate_image_classification_dataset(train_p, 256, seed=0)
+        ds = generate_image_classification_dataset(val_p, 64, seed=1)
+        preds = test_model_class(
+            ViTBase16, TaskType.IMAGE_CLASSIFICATION, train_p, val_p,
+            queries=[ds.images[0]],
+            knobs={"patch_size": 4, "hidden_dim": 64, "depth": 2,
+                   "n_heads": 4, "batch_size": 32, "max_epochs": 5,
+                   "learning_rate": 1e-3, "weight_decay": 1e-4,
+                   "bf16": False, "quick_train": False,
+                   "share_params": False})
+        print("prediction:", int(np.argmax(preds[0])))
