@@ -150,6 +150,21 @@ class BaseAdvisor:
         with self._lock:
             return self._budget_exhausted() and not self._outstanding
 
+    @property
+    def best_effort(self) -> Optional[TrialResult]:
+        """``best`` when a full-budget trial exists, else the top scorer
+        among the highest-budget completed trials (scores are only
+        comparable within one budget level)."""
+        with self._lock:
+            if self.best is not None:
+                return self.best
+            if not self.results:
+                return None
+            max_budget = max(r.budget_scale for r in self.results)
+            candidates = [r for r in self.results
+                          if r.budget_scale >= max_budget - 1e-9]
+            return max(candidates, key=lambda r: r.score)
+
     # ---- subclass interface ----
     def _propose(self, trial_no: int) -> Proposal:
         raise NotImplementedError

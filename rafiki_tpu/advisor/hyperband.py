@@ -76,6 +76,17 @@ class BOHBAdvisor(BaseAdvisor):
 
     # ---- BaseAdvisor hooks (called under the base lock) ----
     def _propose(self, trial_no: int) -> Proposal:
+        # 0) final-trial reservation: with a small trial budget the ASHA
+        # rungs may never organically reach full budget (promotion needs
+        # >= eta completions per rung), which would leave the job with no
+        # full-budget trial at all. Spend the last trial running the
+        # incumbent at budget 1.0 so a best trial always exists.
+        if (self.total_trials is not None
+                and self.total_trials - trial_no <= 1
+                and not any(r.budget_scale >= 1.0 for r in self.results)
+                and not any(p.budget_scale >= 1.0
+                            for p in self._outstanding.values())):
+            return self._final_fill(trial_no)
         # 1) try to promote: highest rung first, so survivors finish fast
         for rung in range(self.n_rungs - 2, -1, -1):
             entry = self._promotable(rung)
@@ -91,6 +102,11 @@ class BOHBAdvisor(BaseAdvisor):
                     warm_start_trial_id=entry.trial_id,
                     meta={"rung": rung + 1, "parent_trial_no": entry.trial_no})
         # 2) otherwise: a fresh configuration at the lowest rung
+        return self._fresh_entry(trial_no, rung=0)
+
+    def _fresh_entry(self, trial_no: int, rung: int,
+                     final_fill: bool = False) -> Proposal:
+        """Sample a fresh configuration and register it at ``rung``."""
         if self._dims:
             vec = self._sample_tpe()
             knobs = knobs_from_unit_vector(self.knob_config, vec, self._rng)
@@ -98,11 +114,37 @@ class BOHBAdvisor(BaseAdvisor):
             knobs = sample_knobs(self.knob_config, self._rng)
             vec = []
         entry = _RungEntry(trial_no, dict(knobs), vec)
-        self._rungs[0].append(entry)
-        self._by_trial_no[trial_no] = (0, entry)
+        self._rungs[rung].append(entry)
+        self._by_trial_no[trial_no] = (rung, entry)
         knobs = self._with_policies(knobs, promote=False)
+        meta = {"rung": rung}
+        if final_fill:
+            meta["final_fill"] = True
         return Proposal(trial_no=trial_no, knobs=knobs,
-                        budget_scale=self.budgets[0], meta={"rung": 0})
+                        budget_scale=self.budgets[rung], meta=meta)
+
+    def _final_fill(self, trial_no: int) -> Proposal:
+        """Run the best completed entry (highest rung, then score) at full
+        budget, warm-started from its checkpoint; fresh sample if nothing
+        has completed yet."""
+        top = self.n_rungs - 1
+        best = None
+        for rung in range(self.n_rungs - 1, -1, -1):
+            done = [e for e in self._rungs[rung] if e.score is not None]
+            if done:
+                best = max(done, key=lambda e: e.score)
+                break
+        if best is not None:
+            entry = _RungEntry(trial_no, dict(best.knobs), best.vec)
+            self._rungs[top].append(entry)
+            self._by_trial_no[trial_no] = (top, entry)
+            knobs = self._with_policies(dict(best.knobs), promote=True)
+            return Proposal(
+                trial_no=trial_no, knobs=knobs, budget_scale=1.0,
+                warm_start_trial_id=best.trial_id,
+                meta={"rung": top, "parent_trial_no": best.trial_no,
+                      "final_fill": True})
+        return self._fresh_entry(trial_no, rung=top, final_fill=True)
 
     #: per-rung history cap for long-running services: beyond this, the
     #: worst-scoring unpromoted entries are pruned (they are strictly

@@ -34,6 +34,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.cmd is None:
         parser.print_help()
         return 2
+    # honor RAFIKI_JAX_PLATFORM before any backend initializes: the TPU-VM
+    # image pre-imports jax with the accelerator platform pinned, so env
+    # vars alone cannot force dev/tune runs onto CPU
+    from .utils.platform import apply_platform_env
+
+    apply_platform_env()
     if args.cmd == "version":
         from . import __version__
 

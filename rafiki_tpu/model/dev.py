@@ -136,9 +136,9 @@ def tune_model(model_class: Type[BaseModel], train_dataset_path: str,
                                    params=params if keep_params else None))
         model.destroy()
 
-    if advisor.best is None:
-        raise RuntimeError("no successful full-budget trial")
-    best = advisor.best
+    best = advisor.best_effort
+    if best is None:
+        raise RuntimeError("no successful trial")
     return TuneResult(best_knobs=best.knobs, best_score=best.score,
                       best_params=params_by_trial.get(best.trial_id, {}),
                       trials=trials)

@@ -1,0 +1,72 @@
+"""Shared helpers for zoo templates (kept next to the contract so every
+template uses one copy instead of re-implementing per file).
+
+These are deliberately tiny and dependency-light: templates ship to workers
+as standalone module source (see ``base.serialize_model_class``) and import
+this via the absolute ``rafiki_tpu.model`` package.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+
+def same_tree_shapes(a: Any, b: Any) -> bool:
+    """True iff two pytrees share structure and leaf shapes. Warm-starting
+    (SHARE_PARAMS) is only valid across trials with identical
+    architectures, so this gates every shared-params load."""
+    import jax
+
+    ta = jax.tree_util.tree_structure(a)
+    tb = jax.tree_util.tree_structure(b)
+    if ta != tb:
+        return False
+    return all(getattr(x, "shape", None) == getattr(y, "shape", None)
+               for x, y in zip(jax.tree_util.tree_leaves(a),
+                               jax.tree_util.tree_leaves(b)))
+
+
+def bucketed_forward(forward: Callable[[Any, np.ndarray], Any], params: Any,
+                     x: np.ndarray, bucket: int = 64) -> np.ndarray:
+    """Run a jitted ``forward(params, xb)`` over ``x`` in fixed-size padded
+    buckets: static shapes mean exactly one XLA compile per bucket size.
+    ``forward`` must be cached by the caller (jit caches by function
+    identity, so a fresh closure per call would recompile every time)."""
+    out = []
+    for i in range(0, len(x), bucket):
+        xb = x[i:i + bucket]
+        pad = bucket - len(xb)
+        if pad:
+            xb = np.concatenate(
+                [xb, np.zeros((pad, *xb.shape[1:]), xb.dtype)])
+        out.append(np.asarray(forward(params, xb))[:bucket - pad])
+    return np.concatenate(out)
+
+
+def conform_images(x: np.ndarray,
+                   image_shape: Optional[Sequence[int]]) -> np.ndarray:
+    """Pad/center-crop query images [N,H,W,C] to the train-time
+    ``image_shape`` (H,W,C). Models with resolution-dependent parameters
+    (ViT pos-embed, MLP flatten) crash on mismatched query sizes without
+    this; channel counts must genuinely match and raise otherwise."""
+    if image_shape is None:
+        return x
+    h, w, c = (int(v) for v in image_shape)
+    if x.shape[-1] != c:
+        if x.shape[-1] == 1:  # grayscale query against RGB-trained model
+            x = np.repeat(x, c, axis=-1)
+        else:
+            raise ValueError(
+                f"query has {x.shape[-1]} channels, model trained with {c}")
+    # pad up
+    ph, pw = max(0, h - x.shape[1]), max(0, w - x.shape[2])
+    if ph or pw:
+        x = np.pad(x, ((0, 0), (0, ph), (0, pw), (0, 0)))
+    # center-crop down
+    if x.shape[1] > h or x.shape[2] > w:
+        oh = (x.shape[1] - h) // 2
+        ow = (x.shape[2] - w) // 2
+        x = x[:, oh:oh + h, ow:ow + w, :]
+    return x

@@ -11,7 +11,7 @@ from ..model.base import BaseModel
 _ZOO = {
     "JaxFeedForward": ("rafiki_tpu.models.mlp", "JaxFeedForward"),
     "JaxCNN": ("rafiki_tpu.models.cnn", "JaxCNN"),
-    "ResNet50": ("rafiki_tpu.models.resnet", "ResNet50"),
+    "ResNetClassifier": ("rafiki_tpu.models.resnet", "ResNetClassifier"),
     "ViTBase16": ("rafiki_tpu.models.vit", "ViTBase16"),
     "BertClassifier": ("rafiki_tpu.models.bert", "BertClassifier"),
     "LlamaLoRA": ("rafiki_tpu.models.llama_lora", "LlamaLoRA"),

@@ -26,20 +26,8 @@ from rafiki_tpu.data import batch_iterator, \
     load_image_classification_dataset
 from rafiki_tpu.model import (BaseModel, CategoricalKnob, FixedKnob,
                               FloatKnob, IntegerKnob, KnobConfig, PolicyKnob,
-                              TrainContext)
-
-
-def _same_tree_shapes(a: Any, b: Any) -> bool:
-    """True iff two pytrees share structure and leaf shapes (warm-start is
-    only valid across trials with identical architectures)."""
-    ta = jax.tree_util.tree_structure(a)
-    tb = jax.tree_util.tree_structure(b)
-    if ta != tb:
-        return False
-    la = jax.tree_util.tree_leaves(a)
-    lb = jax.tree_util.tree_leaves(b)
-    return all(getattr(x, "shape", None) == getattr(y, "shape", None)
-               for x, y in zip(la, lb))
+                              TrainContext, bucketed_forward, conform_images,
+                              same_tree_shapes)
 
 
 class _MLP(nn.Module):
@@ -80,6 +68,7 @@ class JaxFeedForward(BaseModel):
         self._params: Optional[Any] = None
         self._n_classes: Optional[int] = None
         self._image_shape: Optional[Sequence[int]] = None
+        self._fwd: Optional[Any] = None  # cached jitted forward
 
     # ---- internals ----
     def _module(self) -> _MLP:
@@ -111,7 +100,7 @@ class JaxFeedForward(BaseModel):
             params = self._params
         if ctx.shared_params is not None and self.knobs.get("share_params"):
             shared = ctx.shared_params.get("params")
-            if shared is not None and _same_tree_shapes(params, shared):
+            if shared is not None and same_tree_shapes(params, shared):
                 params = jax.tree_util.tree_map(jnp.asarray, shared)
             # else: incompatible architecture → cold start
 
@@ -147,36 +136,34 @@ class JaxFeedForward(BaseModel):
                     not ctx.should_continue(epoch, -mean_loss):
                 break
         self._params = params
+        self._fwd = None  # new params/arch → rebuild the cached jit
 
     def evaluate(self, dataset_path: str) -> float:
         ds = load_image_classification_dataset(dataset_path)
-        probs = self._predict_probs(self._to_float(ds.images))
+        x = conform_images(self._to_float(ds.images), self._image_shape)
+        probs = self._predict_probs(x)
         return float(np.mean(np.argmax(probs, -1) == ds.labels))
 
     def predict(self, queries: Sequence[Any]) -> List[Any]:
         x = self._to_float(np.stack([np.asarray(q) for q in queries]))
         if x.ndim == 3:
             x = x[..., None]
+        # the flatten→Dense input width is fixed at train time
+        x = conform_images(x, self._image_shape)
         return [p.tolist() for p in self._predict_probs(x)]
 
     def _predict_probs(self, x: np.ndarray) -> np.ndarray:
         assert self._params is not None, "model is not trained/loaded"
-        module = self._module()
+        if self._fwd is None:  # cache: jit memoizes by function identity
+            module = self._module()
 
-        @jax.jit
-        def forward(params, xb):
-            return jax.nn.softmax(module.apply({"params": params}, xb), -1)
+            @jax.jit
+            def forward(params, xb):
+                return jax.nn.softmax(
+                    module.apply({"params": params}, xb), -1)
 
-        out = []
-        batch = 256
-        for i in range(0, len(x), batch):
-            xb = x[i:i + batch]
-            pad = batch - len(xb)
-            if pad:
-                xb = np.concatenate([xb, np.zeros((pad, *xb.shape[1:]),
-                                                  xb.dtype)])
-            out.append(np.asarray(forward(self._params, xb))[:batch - pad])
-        return np.concatenate(out)
+            self._fwd = forward
+        return bucketed_forward(self._fwd, self._params, x, bucket=256)
 
     def dump_parameters(self) -> Dict[str, Any]:
         assert self._params is not None, "model is not trained"
@@ -190,6 +177,7 @@ class JaxFeedForward(BaseModel):
         self._n_classes = int(params["meta"]["n_classes"])
         self._image_shape = list(params["meta"]["image_shape"])
         self._params = jax.tree_util.tree_map(jnp.asarray, params["params"])
+        self._fwd = None
 
 
 if __name__ == "__main__":  # reference-style self-test block

@@ -1,0 +1,352 @@
+"""ResNet — the BOHB-search workhorse family (BASELINE.md config #2).
+
+Parity target: the reference zoo's VGG/DenseNet-style TF CNN templates
+(SURVEY.md §2 "Model zoo") and benchmark config #2 ("ResNet-50 / ImageNet
+with BOHB search across a TPU slice"). TPU-first design notes:
+
+- Convolutions lower straight onto the MXU via XLA; there is no Pallas
+  kernel here on purpose — conv+BN+relu is XLA's best-fused path already.
+- BatchNorm statistics are **globally correct under data parallelism for
+  free**: the batch axis is sharded over the mesh's ``data`` axis and the
+  train step is jitted over the mesh, so GSPMD turns the batch-mean
+  reductions into cross-device collectives (no hand-written psum, unlike
+  torch's SyncBatchNorm).
+- Mixed precision: params and BN stats stay f32; compute dtype is bf16 by
+  knob (MXU-native).
+- Small-image inputs (CIFAR/FashionMNIST-scale) get a 3x3/stride-1 stem
+  with no max-pool; ImageNet-scale inputs the classic 7x7/stride-2 stem.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from rafiki_tpu.constants import TaskType
+from rafiki_tpu.data import batch_iterator, \
+    load_image_classification_dataset
+from rafiki_tpu.model import (BaseModel, CategoricalKnob, FixedKnob,
+                              FloatKnob, KnobConfig, PolicyKnob,
+                              TrainContext, bucketed_forward, conform_images,
+                              same_tree_shapes)
+from rafiki_tpu.parallel.sharding import (batch_sharding, make_mesh,
+                                          replicated)
+
+#: variant name -> (stage sizes, use bottleneck blocks)
+VARIANTS: Dict[str, Tuple[Tuple[int, ...], bool]] = {
+    "resnet18": ((2, 2, 2, 2), False),
+    "resnet34": ((3, 4, 6, 3), False),
+    "resnet50": ((3, 4, 6, 3), True),
+    "resnet101": ((3, 4, 23, 3), True),
+}
+
+
+class _Block(nn.Module):
+    """Basic residual block: 3x3 conv ×2."""
+
+    filters: int
+    strides: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, dtype=self.dtype)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (3, 3), (self.strides, self.strides))(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3))(y)
+        # zero-init final BN scale: residual branch starts as identity
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1),
+                            (self.strides, self.strides),
+                            name="shortcut")(residual)
+            residual = norm(name="shortcut_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class _Bottleneck(nn.Module):
+    """Bottleneck residual block: 1x1 → 3x3 → 1x1 (4× expansion)."""
+
+    filters: int
+    strides: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, dtype=self.dtype)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (1, 1))(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        # stride on the 3x3 (the "v1.5" placement — better accuracy than
+        # striding the first 1x1)
+        y = conv(self.filters, (3, 3), (self.strides, self.strides))(y)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.filters * 4, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1),
+                            (self.strides, self.strides),
+                            name="shortcut")(residual)
+            residual = norm(name="shortcut_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """ResNet over (B, H, W, C) images.
+
+    ``resnet50`` = stage_sizes (3,4,6,3) with bottleneck=True, width=64.
+    """
+
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    bottleneck: bool = True
+    width: int = 64
+    n_classes: int = 1000
+    small_inputs: bool = False  # CIFAR-style stem
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        if self.small_inputs:
+            x = nn.Conv(self.width, (3, 3), use_bias=False,
+                        dtype=self.dtype, name="stem")(x)
+        else:
+            x = nn.Conv(self.width, (7, 7), (2, 2), use_bias=False,
+                        dtype=self.dtype, name="stem")(x)
+        x = norm(name="stem_bn")(x)
+        x = nn.relu(x)
+        if not self.small_inputs:
+            x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        block: Callable[..., Any] = _Bottleneck if self.bottleneck else _Block
+        for i, n_blocks in enumerate(self.stage_sizes):
+            filters = self.width * (2 ** i)
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = block(filters, strides, self.dtype,
+                          name=f"stage{i}_block{j}")(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        return nn.Dense(self.n_classes, dtype=jnp.float32, name="head")(
+            x.astype(jnp.float32))
+
+
+class ResNetClassifier(BaseModel):
+    """ResNet template: image classification, DP over the trial sub-mesh,
+    SGD-momentum with cosine decay (the classic recipe)."""
+
+    TASKS = (TaskType.IMAGE_CLASSIFICATION,)
+
+    @staticmethod
+    def get_knob_config() -> KnobConfig:
+        return {
+            "max_epochs": FixedKnob(5),
+            "variant": CategoricalKnob(list(VARIANTS),
+                                       shape_relevant=True),
+            "width_mult": CategoricalKnob([0.25, 0.5, 1.0],
+                                          shape_relevant=True),
+            "learning_rate": FloatKnob(1e-3, 1.0, is_exp=True),
+            "weight_decay": FloatKnob(1e-5, 1e-2, is_exp=True),
+            "batch_size": CategoricalKnob([32, 64, 128, 256],
+                                          shape_relevant=True),
+            "bf16": CategoricalKnob([True, False]),
+            "quick_train": PolicyKnob("QUICK_TRAIN"),
+            "share_params": PolicyKnob("SHARE_PARAMS"),
+        }
+
+    def __init__(self, **knobs: Any) -> None:
+        super().__init__(**knobs)
+        self._vars: Optional[Dict[str, Any]] = None
+        self._n_classes: Optional[int] = None
+        self._image_shape: Optional[Sequence[int]] = None
+        self._fwd: Optional[Any] = None  # cached jitted forward
+
+    # ---- internals ----
+    def _module(self) -> ResNet:
+        assert self._n_classes is not None and self._image_shape is not None
+        stages, bottleneck = VARIANTS[str(self.knobs["variant"])]
+        width = max(8, int(64 * float(self.knobs["width_mult"])))
+        small = min(self._image_shape[0], self._image_shape[1]) < 64
+        dtype = jnp.bfloat16 if self.knobs.get("bf16", True) else jnp.float32
+        return ResNet(stage_sizes=stages, bottleneck=bottleneck, width=width,
+                      n_classes=int(self._n_classes), small_inputs=small,
+                      dtype=dtype)
+
+    def _prep(self, images: np.ndarray) -> np.ndarray:
+        x = images.astype(np.float32) / 255.0
+        if x.ndim == 3:
+            x = x[..., None]
+        # global average pooling makes the net resolution-agnostic, but the
+        # stem conv's input channel count is fixed at train time
+        return conform_images(x, self._image_shape)
+
+    # ---- contract ----
+    def train(self, dataset_path: str,
+              ctx: Optional[TrainContext] = None) -> None:
+        ctx = ctx or TrainContext()
+        ds = load_image_classification_dataset(dataset_path)
+        self._n_classes = ds.n_classes
+        self._image_shape = ds.image_shape
+        x = self._prep(ds.images)
+        y = ds.labels
+
+        module = self._module()
+        devices = ctx.devices or jax.local_devices()
+        mesh = make_mesh(devices)
+        b_shard = batch_sharding(mesh)
+        r_shard = replicated(mesh)
+
+        n_data = len(devices)
+        batch_size = int(self.knobs["batch_size"])
+        batch_size = max(n_data, batch_size - batch_size % n_data)
+
+        if self._vars is None:
+            variables = module.init(jax.random.PRNGKey(0),
+                                    jnp.zeros((1, *x.shape[1:])), train=False)
+            variables = {"params": variables["params"],
+                         "batch_stats": variables["batch_stats"]}
+        else:
+            variables = self._vars
+        if ctx.shared_params is not None and self.knobs.get("share_params"):
+            shared = ctx.shared_params.get("params")
+            if shared is not None and same_tree_shapes(variables["params"],
+                                                       shared):
+                variables = {
+                    "params": jax.tree_util.tree_map(jnp.asarray, shared),
+                    "batch_stats": jax.tree_util.tree_map(
+                        jnp.asarray,
+                        ctx.shared_params.get("batch_stats",
+                                              variables["batch_stats"])),
+                }
+
+        epochs = max(1, round(int(self.knobs["max_epochs"])
+                              * float(ctx.budget_scale)))
+        if self.knobs.get("quick_train"):
+            epochs = min(epochs, 2)
+        steps_per_epoch = max(1, (len(x) + batch_size - 1) // batch_size)
+        schedule = optax.cosine_decay_schedule(
+            float(self.knobs["learning_rate"]), epochs * steps_per_epoch)
+        tx = optax.chain(
+            optax.add_decayed_weights(float(self.knobs["weight_decay"])),
+            optax.sgd(schedule, momentum=0.9, nesterov=True))
+
+        params = jax.device_put(variables["params"], r_shard)
+        batch_stats = jax.device_put(variables["batch_stats"], r_shard)
+        opt_state = jax.device_put(tx.init(params), r_shard)
+
+        @jax.jit
+        def train_step(params, batch_stats, opt_state, xb, yb, mask):
+            def loss_fn(p):
+                logits, updates = module.apply(
+                    {"params": p, "batch_stats": batch_stats}, xb,
+                    train=True, mutable=["batch_stats"])
+                losses = optax.softmax_cross_entropy_with_integer_labels(
+                    logits.astype(jnp.float32), yb)
+                loss = jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask),
+                                                            1.0)
+                return loss, updates["batch_stats"]
+
+            (loss, new_stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), new_stats,
+                    opt_state, loss)
+
+        ctx.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
+        with mesh:
+            for epoch in range(epochs):
+                losses = []
+                for batch in batch_iterator({"x": x, "y": y}, batch_size,
+                                            seed=epoch):
+                    xb = jax.device_put(batch["x"], b_shard)
+                    yb = jax.device_put(batch["y"], b_shard)
+                    mb = jax.device_put(
+                        batch["mask"].astype(np.float32), b_shard)
+                    params, batch_stats, opt_state, loss = train_step(
+                        params, batch_stats, opt_state, xb, yb, mb)
+                    losses.append(float(loss))
+                mean_loss = float(np.mean(losses))
+                ctx.logger.log(epoch=epoch, loss=mean_loss)
+                if ctx.should_continue is not None and \
+                        not ctx.should_continue(epoch, -mean_loss):
+                    break
+        self._vars = {"params": params, "batch_stats": batch_stats}
+        self._fwd = None  # new params/arch → rebuild the cached jit
+
+    def evaluate(self, dataset_path: str) -> float:
+        ds = load_image_classification_dataset(dataset_path)
+        probs = self._predict_probs(self._prep(ds.images))
+        return float(np.mean(np.argmax(probs, -1) == ds.labels))
+
+    def predict(self, queries: Sequence[Any]) -> List[Any]:
+        x = self._prep(np.stack([np.asarray(q) for q in queries]))
+        return [p.tolist() for p in self._predict_probs(x)]
+
+    def _predict_probs(self, x: np.ndarray) -> np.ndarray:
+        assert self._vars is not None, "model is not trained/loaded"
+        if self._fwd is None:  # cache: jit memoizes by function identity
+            module = self._module()
+
+            @jax.jit
+            def forward(variables, xb):
+                logits = module.apply(variables, xb, train=False)
+                return jax.nn.softmax(logits.astype(jnp.float32), -1)
+
+            self._fwd = forward
+        return bucketed_forward(self._fwd, self._vars, x, bucket=64)
+
+    def dump_parameters(self) -> Dict[str, Any]:
+        assert self._vars is not None, "model is not trained"
+        return {
+            "params": jax.tree_util.tree_map(np.asarray,
+                                             self._vars["params"]),
+            "batch_stats": jax.tree_util.tree_map(
+                np.asarray, self._vars["batch_stats"]),
+            "meta": {"n_classes": self._n_classes,
+                     "image_shape": list(self._image_shape or [])},
+        }
+
+    def load_parameters(self, params: Dict[str, Any]) -> None:
+        self._n_classes = int(params["meta"]["n_classes"])
+        self._image_shape = list(params["meta"]["image_shape"])
+        self._vars = {
+            "params": jax.tree_util.tree_map(jnp.asarray, params["params"]),
+            "batch_stats": jax.tree_util.tree_map(jnp.asarray,
+                                                  params["batch_stats"]),
+        }
+        self._fwd = None
+
+
+if __name__ == "__main__":  # reference-style self-test block
+    import tempfile
+
+    from rafiki_tpu.data import generate_image_classification_dataset
+    from rafiki_tpu.model import test_model_class
+
+    with tempfile.TemporaryDirectory() as d:
+        train_p = f"{d}/train.npz"
+        val_p = f"{d}/val.npz"
+        generate_image_classification_dataset(train_p, 256, seed=0)
+        ds = generate_image_classification_dataset(val_p, 64, seed=1)
+        preds = test_model_class(
+            ResNetClassifier, TaskType.IMAGE_CLASSIFICATION, train_p, val_p,
+            queries=[ds.images[0]],
+            knobs={"variant": "resnet18", "width_mult": 0.25,
+                   "batch_size": 32, "max_epochs": 5, "learning_rate": 0.1,
+                   "weight_decay": 1e-4, "bf16": False,
+                   "quick_train": False, "share_params": False})
+        print("prediction:", int(np.argmax(preds[0])))

@@ -23,7 +23,8 @@ from rafiki_tpu.data import batch_iterator, \
     load_image_classification_dataset
 from rafiki_tpu.model import (BaseModel, CategoricalKnob, FixedKnob,
                               FloatKnob, IntegerKnob, KnobConfig, PolicyKnob,
-                              TrainContext)
+                              TrainContext, bucketed_forward, conform_images,
+                              same_tree_shapes)
 from rafiki_tpu.ops.attention import flash_attention
 from rafiki_tpu.ops.patch_embed import patch_embed
 from rafiki_tpu.parallel.sharding import (batch_sharding, make_mesh,
@@ -119,7 +120,10 @@ class ViTBase16(BaseModel):
             "max_epochs": FixedKnob(5),
             "patch_size": CategoricalKnob([4, 7, 14, 16],
                                           shape_relevant=True),
-            "hidden_dim": CategoricalKnob([64, 128, 192, 768],
+            # every hidden_dim is divisible by every n_heads choice, so the
+            # tuner's (hidden_dim, n_heads) point is exactly the model built
+            # (no silent head-count remapping to pollute the search history)
+            "hidden_dim": CategoricalKnob([96, 192, 384, 768],
                                           shape_relevant=True),
             "depth": IntegerKnob(2, 12, shape_relevant=True),
             "n_heads": CategoricalKnob([4, 8, 12], shape_relevant=True),
@@ -137,6 +141,7 @@ class ViTBase16(BaseModel):
         self._params: Optional[Any] = None
         self._n_classes: Optional[int] = None
         self._image_shape: Optional[Sequence[int]] = None
+        self._fwd: Optional[Any] = None  # cached jitted forward
 
     # ---- internals ----
     def _module(self) -> ViT:
@@ -144,7 +149,8 @@ class ViTBase16(BaseModel):
         hd = int(k["hidden_dim"])
         heads = int(k["n_heads"])
         if hd % heads:
-            heads = max(h for h in (1, 2, 4, 8, 12) if hd % h == 0)
+            raise ValueError(f"hidden_dim={hd} not divisible by "
+                             f"n_heads={heads}")
         return ViT(patch_size=int(k["patch_size"]), hidden_dim=hd,
                    depth=int(k["depth"]), n_heads=heads,
                    mlp_dim=4 * hd, n_classes=int(self._n_classes))
@@ -153,6 +159,9 @@ class ViTBase16(BaseModel):
         x = images.astype(np.float32) / 255.0
         if x.ndim == 3:
             x = x[..., None]
+        # pos_embed is sized to the train-time patch count: conform queries
+        # of other resolutions to the trained shape first
+        x = conform_images(x, self._image_shape)
         p = int(self.knobs["patch_size"])
         # pad H/W up to patch multiples (e.g. 28x28 with p=16 → 32x32)
         ph = (-x.shape[1]) % p
@@ -194,7 +203,7 @@ class ViTBase16(BaseModel):
             params = self._params
         if ctx.shared_params is not None and self.knobs.get("share_params"):
             shared = ctx.shared_params.get("params")
-            if shared is not None and _same_shapes(params, shared):
+            if shared is not None and same_tree_shapes(params, shared):
                 params = jax.tree_util.tree_map(jnp.asarray, shared)
 
         lr = float(self.knobs["learning_rate"])
@@ -238,6 +247,7 @@ class ViTBase16(BaseModel):
                         not ctx.should_continue(epoch, -mean_loss):
                     break
         self._params = params
+        self._fwd = None  # new params/arch → rebuild the cached jit
 
     def evaluate(self, dataset_path: str) -> float:
         ds = load_image_classification_dataset(dataset_path)
@@ -250,24 +260,17 @@ class ViTBase16(BaseModel):
 
     def _predict_probs(self, x: np.ndarray) -> np.ndarray:
         assert self._params is not None, "model is not trained/loaded"
-        module = self._module()
-        dtype = self._dtype()
+        if self._fwd is None:  # cache: jit memoizes by function identity
+            module = self._module()
+            dtype = self._dtype()
 
-        @jax.jit
-        def forward(params, xb):
-            logits = module.apply({"params": params}, xb.astype(dtype))
-            return jax.nn.softmax(logits.astype(jnp.float32), -1)
+            @jax.jit
+            def forward(params, xb):
+                logits = module.apply({"params": params}, xb.astype(dtype))
+                return jax.nn.softmax(logits.astype(jnp.float32), -1)
 
-        out = []
-        bucket = 64  # static-shape bucketing (one compile per bucket)
-        for i in range(0, len(x), bucket):
-            xb = x[i:i + bucket]
-            pad = bucket - len(xb)
-            if pad:
-                xb = np.concatenate(
-                    [xb, np.zeros((pad, *xb.shape[1:]), xb.dtype)])
-            out.append(np.asarray(forward(self._params, xb))[:bucket - pad])
-        return np.concatenate(out)
+            self._fwd = forward
+        return bucketed_forward(self._fwd, self._params, x, bucket=64)
 
     def dump_parameters(self) -> Dict[str, Any]:
         assert self._params is not None, "model is not trained"
@@ -281,16 +284,7 @@ class ViTBase16(BaseModel):
         self._n_classes = int(params["meta"]["n_classes"])
         self._image_shape = list(params["meta"]["image_shape"])
         self._params = jax.tree_util.tree_map(jnp.asarray, params["params"])
-
-
-def _same_shapes(a: Any, b: Any) -> bool:
-    ta = jax.tree_util.tree_structure(a)
-    tb = jax.tree_util.tree_structure(b)
-    if ta != tb:
-        return False
-    return all(getattr(x, "shape", None) == getattr(y, "shape", None)
-               for x, y in zip(jax.tree_util.tree_leaves(a),
-                               jax.tree_util.tree_leaves(b)))
+        self._fwd = None
 
 
 if __name__ == "__main__":  # reference-style self-test block
@@ -307,7 +301,7 @@ if __name__ == "__main__":  # reference-style self-test block
         preds = test_model_class(
             ViTBase16, TaskType.IMAGE_CLASSIFICATION, train_p, val_p,
             queries=[ds.images[0]],
-            knobs={"patch_size": 4, "hidden_dim": 64, "depth": 2,
+            knobs={"patch_size": 4, "hidden_dim": 96, "depth": 2,
                    "n_heads": 4, "batch_size": 32, "max_epochs": 5,
                    "learning_rate": 1e-3, "weight_decay": 1e-4,
                    "bf16": False, "quick_train": False,

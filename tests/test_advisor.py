@@ -107,6 +107,30 @@ def test_bohb_promotion_chain_reaches_full_budget():
     assert adv.budgets[top_rung] == 1.0
 
 
+def test_bohb_small_budget_still_yields_full_budget_best():
+    """With a tiny trial budget the rungs can't promote organically; the
+    final-trial reservation must still produce a full-budget best."""
+    for n in (1, 2, 4):
+        adv = make_advisor(bohb_config(), "bohb", total_trials=n, seed=0)
+        run_search(adv, quadratic_score, budget_scale_aware=True)
+        assert len(adv.results) == n
+        assert adv.best is not None and adv.best.budget_scale >= 1.0
+        assert adv.best_effort is adv.best
+
+
+def test_best_effort_falls_back_to_highest_budget():
+    adv = make_advisor(search_config(), "random", total_trials=3, seed=0)
+    # feed only low-budget results (as if the job was stopped mid-bracket)
+    for i in range(3):
+        p = adv.propose()
+        adv.feedback(TrialResult(trial_no=p.trial_no, knobs=p.knobs,
+                                 score=float(i), trial_id=f"t{i}",
+                                 budget_scale=1.0 / 3.0))
+    assert adv.best is None
+    be = adv.best_effort
+    assert be is not None and be.score == 2.0
+
+
 def test_bohb_errored_trials_dont_block():
     adv = make_advisor(bohb_config(), "bohb", total_trials=12, seed=3)
     ok = 0

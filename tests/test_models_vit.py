@@ -8,7 +8,7 @@ from rafiki_tpu.data import generate_image_classification_dataset
 from rafiki_tpu.model import TrainContext, test_model_class
 from rafiki_tpu.models.vit import ViT, ViTBase16
 
-TINY = {"patch_size": 4, "hidden_dim": 64, "depth": 2, "n_heads": 4,
+TINY = {"patch_size": 4, "hidden_dim": 96, "depth": 2, "n_heads": 4,
         "batch_size": 32, "max_epochs": 5, "learning_rate": 1e-3,
         "weight_decay": 1e-4, "bf16": False, "quick_train": False,
         "share_params": False}
