@@ -178,6 +178,41 @@ class CorpusDataset:
 
 
 # ---------------------------------------------------------------------------
+# Text classification
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TextClassificationDataset:
+    """Labeled text: ``.jsonl`` with a ``{"n_classes": N}`` meta first line
+    then ``{"text": ..., "label": int}`` lines (the format
+    :func:`generate_text_classification_dataset` emits)."""
+
+    texts: List[str]
+    labels: np.ndarray  # int64 [N]
+    n_classes: int
+
+    def __len__(self) -> int:
+        return len(self.texts)
+
+    @staticmethod
+    def load(path: str) -> "TextClassificationDataset":
+        texts: List[str] = []
+        labels: List[int] = []
+        with open(path) as f:
+            meta = json.loads(f.readline())
+            for line in f:
+                d = json.loads(line)
+                texts.append(str(d["text"]))
+                labels.append(int(d["label"]))
+        return TextClassificationDataset(
+            texts, np.asarray(labels, np.int64), int(meta["n_classes"]))
+
+
+def load_text_classification_dataset(path: str) -> TextClassificationDataset:
+    return TextClassificationDataset.load(path)
+
+
+# ---------------------------------------------------------------------------
 # Synthetic generators (no-egress stand-ins for benchmark datasets)
 # ---------------------------------------------------------------------------
 

@@ -28,20 +28,23 @@ def same_tree_shapes(a: Any, b: Any) -> bool:
                                jax.tree_util.tree_leaves(b)))
 
 
-def bucketed_forward(forward: Callable[[Any, np.ndarray], Any], params: Any,
-                     x: np.ndarray, bucket: int = 64) -> np.ndarray:
-    """Run a jitted ``forward(params, xb)`` over ``x`` in fixed-size padded
-    buckets: static shapes mean exactly one XLA compile per bucket size.
-    ``forward`` must be cached by the caller (jit caches by function
-    identity, so a fresh closure per call would recompile every time)."""
+def bucketed_forward(forward: Callable[..., Any], params: Any,
+                     *xs: np.ndarray, bucket: int = 64) -> np.ndarray:
+    """Run a jitted ``forward(params, *chunks)`` over per-example arrays
+    ``xs`` in fixed-size zero-padded buckets: static shapes mean exactly
+    one XLA compile per bucket size. ``forward`` must be cached by the
+    caller (jit caches by function identity, so a fresh closure per call
+    would recompile every time)."""
+    n = len(xs[0])
     out = []
-    for i in range(0, len(x), bucket):
-        xb = x[i:i + bucket]
-        pad = bucket - len(xb)
+    for i in range(0, n, bucket):
+        chunks = [x[i:i + bucket] for x in xs]
+        pad = bucket - len(chunks[0])
         if pad:
-            xb = np.concatenate(
-                [xb, np.zeros((pad, *xb.shape[1:]), xb.dtype)])
-        out.append(np.asarray(forward(params, xb))[:bucket - pad])
+            chunks = [np.concatenate(
+                [c, np.zeros((pad, *c.shape[1:]), c.dtype)])
+                for c in chunks]
+        out.append(np.asarray(forward(params, *chunks))[:bucket - pad])
     return np.concatenate(out)
 
 

@@ -240,8 +240,16 @@ class ResNetClassifier(BaseModel):
         steps_per_epoch = max(1, (len(x) + batch_size - 1) // batch_size)
         schedule = optax.cosine_decay_schedule(
             float(self.knobs["learning_rate"]), epochs * steps_per_epoch)
+
+        def decay_mask(tree):
+            # classic recipe: no decay on biases or BatchNorm scale/bias
+            return jax.tree_util.tree_map_with_path(
+                lambda kp, _: str(getattr(kp[-1], "key", "")) not in
+                ("bias", "scale"), tree)
+
         tx = optax.chain(
-            optax.add_decayed_weights(float(self.knobs["weight_decay"])),
+            optax.add_decayed_weights(float(self.knobs["weight_decay"]),
+                                      mask=decay_mask),
             optax.sgd(schedule, momentum=0.9, nesterov=True))
 
         params = jax.device_put(variables["params"], r_shard)
@@ -333,6 +341,10 @@ class ResNetClassifier(BaseModel):
 
 if __name__ == "__main__":  # reference-style self-test block
     import tempfile
+
+    from rafiki_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()  # honor RAFIKI_JAX_PLATFORM=cpu for dev runs
 
     from rafiki_tpu.data import generate_image_classification_dataset
     from rafiki_tpu.model import test_model_class

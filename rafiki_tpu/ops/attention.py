@@ -29,13 +29,14 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
-def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
-                     causal: bool, kv_len: int, block_q: int, block_k: int,
+def _attn_fwd_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, *, sm_scale: float,
+                     causal: bool, block_q: int, block_k: int,
                      n_kv_blocks: int):
     from jax.experimental import pallas as pl
 
     qb = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * sm_scale  # (block_q, d)
+    kv_len = len_ref[0]  # this example's valid key count (pads masked out)
 
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
@@ -68,12 +69,14 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
+    # skip key blocks that are fully masked: past this example's kv_len,
+    # and (causal) strictly after this query block
+    n_blocks = jnp.minimum(
+        jnp.asarray(n_kv_blocks, jnp.int32),
+        (kv_len + block_k - 1) // block_k)
     if causal:
-        # key blocks strictly after this query block contribute nothing
         n_blocks = jnp.minimum(
-            n_kv_blocks, (qb * block_q + block_q + block_k - 1) // block_k)
-    else:
-        n_blocks = n_kv_blocks
+            n_blocks, (qb * block_q + block_q + block_k - 1) // block_k)
     m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
@@ -88,8 +91,8 @@ def _pad_to(x: jnp.ndarray, axis: int, mult: int) -> jnp.ndarray:
     return jnp.pad(x, widths)
 
 
-def _flash_attention_fwd_impl(q, k, v, sm_scale: float, causal: bool,
-                              block_q: int, block_k: int,
+def _flash_attention_fwd_impl(q, k, v, kv_lens, sm_scale: float,
+                              causal: bool, block_q: int, block_k: int,
                               interpret: Optional[bool]):
     from jax.experimental import pallas as pl
 
@@ -108,9 +111,15 @@ def _flash_attention_fwd_impl(q, k, v, sm_scale: float, causal: bool,
     qp = qp.reshape(b * h, sq_p, d)
     kp = kp.reshape(b * h, skv_p, d)
     vp = vp.reshape(b * h, skv_p, d)
+    # per-(example,head) valid key count; None → all real keys valid
+    if kv_lens is None:
+        lens = jnp.full((b,), s_kv, jnp.int32)
+    else:
+        lens = jnp.minimum(jnp.asarray(kv_lens, jnp.int32), s_kv)
+    lens = jnp.repeat(lens, h)  # (b*h,)
 
     kernel = functools.partial(
-        _attn_fwd_kernel, sm_scale=sm_scale, causal=causal, kv_len=s_kv,
+        _attn_fwd_kernel, sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, n_kv_blocks=n_kv_blocks)
     out = pl.pallas_call(
         kernel,
@@ -119,42 +128,63 @@ def _flash_attention_fwd_impl(q, k, v, sm_scale: float, causal: bool,
             pl.BlockSpec((1, block_q, d), lambda bh, qb: (bh, qb, 0)),
             pl.BlockSpec((1, skv_p, d), lambda bh, qb: (bh, 0, 0)),
             pl.BlockSpec((1, skv_p, d), lambda bh, qb: (bh, 0, 0)),
+            pl.BlockSpec((1,), lambda bh, qb: (bh,)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qb: (bh, qb, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
         interpret=interpret,
-    )(qp, kp, vp)
+    )(qp, kp, vp, lens)
     return out.reshape(b, h, sq_p, d)[:, :, :s_q, :]
 
 
-def _attention_reference(q, k, v, sm_scale: float, causal: bool):
+def _attention_reference(q, k, v, sm_scale: float, causal: bool,
+                         kv_lens=None):
     """Pure-XLA attention (the correctness oracle + backward path)."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * sm_scale
+    s_q, s_k = s.shape[-2], s.shape[-1]
     if causal:
-        s_q, s_k = s.shape[-2], s.shape[-1]
         mask = (jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0)
                 >= jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1))
         s = jnp.where(mask, s, NEG_INF)
+    if kv_lens is not None:
+        k_pos = jnp.arange(s_k)[None, None, None, :]
+        s = jnp.where(k_pos < jnp.asarray(kv_lens)[:, None, None, None],
+                      s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p,
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, sm_scale: Optional[float] = None,
                     causal: bool = False, block_q: int = 128,
-                    block_k: int = 128,
-                    interpret: Optional[bool] = None) -> jnp.ndarray:
-    """Fused attention over (batch, heads, seq, head_dim) tensors."""
+                    block_k: int = 128, interpret: Optional[bool] = None,
+                    kv_lens=None) -> jnp.ndarray:
+    """Fused attention over (batch, heads, seq, head_dim) tensors.
+
+    ``kv_lens`` (optional int32 [batch]) masks each example's keys past its
+    valid length — the padding mask for BERT-style batches and bucketed
+    continuous-batch serving.
+    """
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    return _flash_attention_fwd_impl(q, k, v, scale, causal,
+    if kv_lens is None:
+        return _flash_attention_full(q, k, v, scale, causal, block_q,
+                                     block_k, interpret)
+    return _flash_attention_varlen(q, k, v, jnp.asarray(kv_lens, jnp.int32),
+                                   scale, causal, block_q, block_k,
+                                   interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_full(q, k, v, sm_scale, causal, block_q, block_k,
+                          interpret):
+    return _flash_attention_fwd_impl(q, k, v, None, sm_scale, causal,
                                      block_q, block_k, interpret)
 
 
 def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, sm_scale, causal, block_q, block_k,
-                          interpret)
+    out = _flash_attention_full(q, k, v, sm_scale, causal, block_q, block_k,
+                                interpret)
     return out, (q, k, v)
 
 
@@ -163,16 +193,46 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, residuals, g):
     # training scales this framework targets (ViT/BERT); the fwd kernel
     # stays the serving hot path.
     q, k, v = residuals
-    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
 
     def ref(q_, k_, v_):
-        return _attention_reference(q_, k_, v_, scale, causal)
+        return _attention_reference(q_, k_, v_, sm_scale, causal)
 
     _, vjp = jax.vjp(ref, q, k, v)
     return vjp(g)
 
 
-flash_attention.defvjp(_fwd, _bwd)
+_flash_attention_full.defvjp(_fwd, _bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_attention_varlen(q, k, v, kv_lens, sm_scale, causal, block_q,
+                            block_k, interpret):
+    return _flash_attention_fwd_impl(q, k, v, kv_lens, sm_scale, causal,
+                                     block_q, block_k, interpret)
+
+
+def _vfwd(q, k, v, kv_lens, sm_scale, causal, block_q, block_k, interpret):
+    out = _flash_attention_varlen(q, k, v, kv_lens, sm_scale, causal,
+                                  block_q, block_k, interpret)
+    return out, (q, k, v, kv_lens)
+
+
+def _vbwd(sm_scale, causal, block_q, block_k, interpret, residuals, g):
+    import numpy as np
+
+    q, k, v, kv_lens = residuals
+
+    def ref(q_, k_, v_):
+        return _attention_reference(q_, k_, v_, sm_scale, causal, kv_lens)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    dq, dk, dv = vjp(g)
+    # integer primal → symbolic-zero cotangent (float0)
+    d_lens = np.zeros(kv_lens.shape, jax.dtypes.float0)
+    return dq, dk, dv, d_lens
+
+
+_flash_attention_varlen.defvjp(_vfwd, _vbwd)
 
 
 def mha(x_q, x_kv, params: dict, n_heads: int, causal: bool = False,
