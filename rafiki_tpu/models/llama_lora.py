@@ -1,0 +1,532 @@
+"""Llama-style decoder LM with LoRA fine-tuning — BASELINE.md config #5.
+
+Parity target: benchmark config #5 ("Llama-3 8B LoRA fine-tune +
+continuous-batch serving via Predictor"). TPU-first design notes:
+
+- The decoder (RMSNorm → RoPE → GQA causal flash attention → SwiGLU) is a
+  flax module whose training attention runs through the Pallas flash
+  kernel with per-example ``kv_lens`` (packed ragged batches stay one
+  static-shape tensor).
+- **2-D (fsdp × tensor) sharding** via ``parallel.sharding``: attention
+  and MLP projections are tensor-parallel over the mesh's ``model`` axis
+  (wq/wk/wv/gate/up split on the output dim, wo/down on the input dim —
+  the Megatron pairing, so XLA inserts exactly one all-reduce per block),
+  everything large is additionally fsdp-sharded over ``data``. No
+  hand-written collectives anywhere.
+- **LoRA**: every projection carries frozen ``kernel`` plus trainable
+  ``lora_a``/``lora_b``; freezing is an ``optax.masked`` transform (the
+  idiomatic JAX equivalent of requires_grad=False), so the base stays
+  untouched and checkpoints can ship adapters only.
+- **Generation**: greedy decode over a flax ``cache`` collection carried
+  through ``lax.scan`` — one compiled step regardless of output length.
+  Prefill is per-token through the same step (correct and simple; chunked
+  prefill is a serving-layer optimization).
+- No pretrained weights exist in this zero-egress environment, so the
+  "base" is random and LoRA+head training carries the learning signal;
+  the architecture and sharding are what the 8B config exercises.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from rafiki_tpu.constants import TaskType
+from rafiki_tpu.data import batch_iterator, \
+    load_text_classification_dataset
+from rafiki_tpu.model import (BaseModel, CategoricalKnob, FixedKnob,
+                              FloatKnob, IntegerKnob, KnobConfig, PolicyKnob,
+                              TrainContext, same_tree_shapes)
+from rafiki_tpu.models.bert import _TOKEN_RE, PAD_ID, HashTokenizer
+from rafiki_tpu.ops.attention import flash_attention
+from rafiki_tpu.parallel.sharding import (DATA_AXIS, MODEL_AXIS,
+                                          batch_sharding, make_mesh,
+                                          param_shardings)
+
+BOS_ID = 1  # reuse bert's CLS slot as BOS
+
+#: Megatron-style tensor-parallel rules: column-parallel projections split
+#: the output dim, row-parallel ones the input dim → one all-reduce per
+#: attention/MLP block. Keys match LoRADense instance names below.
+TP_RULES = {"wq": -1, "wk": -1, "wv": -1, "gate": -1, "up": -1,
+            "wo": 0, "down": 0, "lm_head": -1, "tok_embed": -1}
+
+
+def rope(x: jnp.ndarray, positions: jnp.ndarray,
+         theta: float = 10000.0) -> jnp.ndarray:
+    """Rotary embedding over (b, s, heads, head_dim) with (b, s) positions."""
+    half = x.shape[-1] // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (b, s, half)
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+        axis=-1).astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (norm * scale).astype(x.dtype)
+
+
+class LoRADense(nn.Module):
+    """Frozen base kernel + trainable low-rank adapter (classic LoRA)."""
+
+    features: int
+    rank: int = 0
+    alpha: float = 16.0
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        d_in = x.shape[-1]
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (d_in, self.features))
+        y = x @ kernel
+        if self.rank > 0:
+            a = self.param("lora_a", nn.initializers.normal(0.02),
+                           (d_in, self.rank))
+            b = self.param("lora_b", nn.initializers.zeros,
+                           (self.rank, self.features))
+            y = y + ((x @ a) @ b) * (self.alpha / self.rank)
+        return y
+
+
+class _DecoderAttention(nn.Module):
+    n_heads: int
+    n_kv_heads: int
+    max_len: int
+    lora_rank: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, lens: jnp.ndarray,
+                 positions: jnp.ndarray, decode: bool) -> jnp.ndarray:
+        b, s, d = x.shape
+        dh = d // self.n_heads
+        q = LoRADense(self.n_heads * dh, self.lora_rank, name="wq")(x)
+        k = LoRADense(self.n_kv_heads * dh, self.lora_rank, name="wk")(x)
+        v = LoRADense(self.n_kv_heads * dh, self.lora_rank, name="wv")(x)
+        q = rope(q.reshape(b, s, self.n_heads, dh), positions)
+        k = rope(k.reshape(b, s, self.n_kv_heads, dh), positions)
+        v = v.reshape(b, s, self.n_kv_heads, dh)
+        rep = self.n_heads // self.n_kv_heads
+
+        if decode:
+            # autoregressive path: append this step's k/v to the cache and
+            # attend the single query over all cached positions. The flax
+            # init pass also traces this branch — guard with has_variable
+            # so initialization only allocates zeros and never writes
+            # (otherwise decoding would start at idx=1 over a garbage row).
+            is_live = self.has_variable("cache", "k")
+            ck = self.variable("cache", "k", jnp.zeros,
+                               (b, self.max_len, self.n_kv_heads, dh),
+                               x.dtype)
+            cv = self.variable("cache", "v", jnp.zeros,
+                               (b, self.max_len, self.n_kv_heads, dh),
+                               x.dtype)
+            idx = self.variable("cache", "idx",
+                                lambda: jnp.zeros((), jnp.int32))
+            if not is_live:
+                # init trace: local attention for output shape only
+                kk = jnp.repeat(k, rep, axis=2)
+                vv = jnp.repeat(v, rep, axis=2)
+                scores = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / np.sqrt(dh)
+                probs = jax.nn.softmax(scores.astype(jnp.float32), -1)
+                o = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(x.dtype), vv)
+            else:
+                t = idx.value
+                ck.value = jax.lax.dynamic_update_slice(ck.value, k,
+                                                        (0, t, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(cv.value, v,
+                                                        (0, t, 0, 0))
+                idx.value = t + s
+                kk = jnp.repeat(ck.value, rep, axis=2)
+                vv = jnp.repeat(cv.value, rep, axis=2)
+                scores = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / np.sqrt(dh)
+                k_pos = jnp.arange(self.max_len)[None, None, None, :]
+                scores = jnp.where(k_pos <= t, scores, -1e30)
+                probs = jax.nn.softmax(scores.astype(jnp.float32), -1)
+                o = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(x.dtype),
+                               vv)
+        else:
+            kk = jnp.repeat(k, rep, axis=2)
+            vv = jnp.repeat(v, rep, axis=2)
+            o = flash_attention(q.transpose(0, 2, 1, 3),
+                                kk.transpose(0, 2, 1, 3),
+                                vv.transpose(0, 2, 1, 3),
+                                causal=True, kv_lens=lens)
+            o = o.transpose(0, 2, 1, 3)
+        o = o.reshape(b, s, self.n_heads * dh)
+        return LoRADense(d, self.lora_rank, name="wo")(o)
+
+
+class _DecoderBlock(nn.Module):
+    n_heads: int
+    n_kv_heads: int
+    mlp_dim: int
+    max_len: int
+    lora_rank: int
+
+    @nn.compact
+    def __call__(self, x, lens, positions, decode):
+        x = x + _DecoderAttention(
+            self.n_heads, self.n_kv_heads, self.max_len, self.lora_rank,
+            name="attn")(RMSNorm()(x), lens, positions, decode)
+        y = RMSNorm()(x)
+        gate = LoRADense(self.mlp_dim, self.lora_rank, name="gate")(y)
+        up = LoRADense(self.mlp_dim, self.lora_rank, name="up")(y)
+        y = nn.silu(gate) * up  # SwiGLU
+        return x + LoRADense(x.shape[-1], self.lora_rank, name="down")(y)
+
+
+class Llama(nn.Module):
+    """Decoder-only LM. Llama-3-8B = hidden 4096, depth 32, heads 32,
+    kv_heads 8, mlp_dim 14336, vocab 128256."""
+
+    vocab_size: int
+    max_len: int
+    hidden_dim: int = 4096
+    depth: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    mlp_dim: int = 14336
+    lora_rank: int = 0
+
+    @nn.compact
+    def __call__(self, ids: jnp.ndarray, lens: Optional[jnp.ndarray] = None,
+                 positions: Optional[jnp.ndarray] = None,
+                 decode: bool = False) -> jnp.ndarray:
+        b, s = ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+        if lens is None:
+            lens = jnp.full((b,), s, jnp.int32)
+        x = nn.Embed(self.vocab_size, self.hidden_dim,
+                     name="tok_embed")(ids)
+        for i in range(self.depth):
+            x = _DecoderBlock(self.n_heads, self.n_kv_heads, self.mlp_dim,
+                              self.max_len, self.lora_rank,
+                              name=f"block_{i}")(x, lens, positions, decode)
+        x = RMSNorm(name="final_norm")(x)
+        return LoRADense(self.vocab_size, 0, name="lm_head")(x)
+
+
+def lm_loss_terms(logits: jnp.ndarray, ids: jnp.ndarray,
+                  lens: jnp.ndarray,
+                  example_mask: Optional[jnp.ndarray] = None
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Masked next-token cross-entropy: (sum of losses, valid count).
+
+    Targets are ``ids`` shifted left; positions at/after each example's
+    last real token (and examples with ``example_mask == 0``) are
+    excluded. One implementation shared by train/evaluate/dry-run.
+    """
+    targets = jnp.pad(ids[:, 1:], ((0, 0), (0, 1)))
+    pos = jnp.arange(ids.shape[1])[None, :]
+    valid = pos < (lens[:, None] - 1)
+    if example_mask is not None:
+        valid = valid & (example_mask[:, None] > 0)
+    losses = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), targets)
+    return jnp.sum(losses * valid), jnp.sum(valid)
+
+
+def lora_trainable_mask(params: Any) -> Any:
+    """True for LoRA adapters, norms and the LM head; False (frozen) for
+    base kernels and the embedding — the LoRA fine-tuning recipe."""
+
+    def trainable(kp, _) -> bool:
+        path = "/".join(str(getattr(k, "key", k)) for k in kp).lower()
+        # lower(): flax auto-names unnamed instances "RMSNorm_0" etc.
+        return ("lora_" in path or "norm" in path
+                or path.startswith("lm_head"))
+
+    return jax.tree_util.tree_map_with_path(trainable, params)
+
+
+def greedy_generate(module: Llama, params: Any, prompt_ids: np.ndarray,
+                    prompt_lens: np.ndarray, max_new: int) -> jnp.ndarray:
+    """Greedy decode: scan one compiled cache step over prompt+generation.
+
+    ``prompt_ids`` (b, P) left-aligned with PAD tails; each example starts
+    generating right after its own last prompt token, so pads never enter
+    the cache. Returns (b, max_new) generated ids.
+    """
+    b, p_len = prompt_ids.shape
+    total = p_len + max_new
+    cache = module.init(jax.random.PRNGKey(0),
+                        jnp.zeros((b, 1), jnp.int32), decode=True)["cache"]
+    prompt = jnp.asarray(prompt_ids)
+    plens = jnp.asarray(prompt_lens)
+
+    def step(carry, t):
+        cache, tok = carry
+        logits, muts = module.apply(
+            {"params": params, "cache": cache}, tok[:, None], decode=True,
+            positions=jnp.full((b, 1), t, jnp.int32), mutable=["cache"])
+        nxt = jnp.argmax(logits[:, -1].astype(jnp.float32), -1)
+        nxt = nxt.astype(jnp.int32)
+        # next input: the prompt token while it lasts, else our own output
+        in_prompt = (t + 1) < plens
+        tok_next = jnp.where(in_prompt,
+                             prompt[:, jnp.minimum(t + 1, p_len - 1)], nxt)
+        return (muts["cache"], tok_next), nxt
+
+    (_, _), outs = jax.lax.scan(step, (cache, prompt[:, 0]),
+                                jnp.arange(total - 1))
+    # outs[t] is the model's prediction after consuming token t; example i's
+    # generation starts at t = plens[i]-1
+    outs = outs.transpose(1, 0)  # (b, total-1)
+    gather = (plens[:, None] - 1) + jnp.arange(max_new)[None, :]
+    gather = jnp.clip(gather, 0, total - 2)
+    return jnp.take_along_axis(outs, gather, axis=1)
+
+
+class LlamaLoRA(BaseModel):
+    """Causal-LM template: LoRA fine-tune over a 2-D (fsdp × tensor) mesh,
+    greedy generation for serving. Accepts the ``.jsonl`` text corpus
+    format (labels, if present, are ignored)."""
+
+    TASKS = (TaskType.LANGUAGE_MODELING,)
+
+    @staticmethod
+    def get_knob_config() -> KnobConfig:
+        return {
+            "max_epochs": FixedKnob(6),
+            "vocab_size": FixedKnob(1 << 14),
+            "hidden_dim": CategoricalKnob([64, 128, 256, 512],
+                                          shape_relevant=True),
+            "depth": IntegerKnob(2, 8, shape_relevant=True),
+            "n_heads": CategoricalKnob([4, 8], shape_relevant=True),
+            "kv_ratio": CategoricalKnob([1, 2, 4], shape_relevant=True),
+            "lora_rank": CategoricalKnob([4, 8, 16], shape_relevant=True),
+            "max_len": CategoricalKnob([32, 64, 128], shape_relevant=True),
+            "model_parallel": CategoricalKnob([1, 2, 4],
+                                              shape_relevant=True),
+            "learning_rate": FloatKnob(1e-4, 3e-2, is_exp=True),
+            "batch_size": CategoricalKnob([8, 16, 32], shape_relevant=True),
+            "quick_train": PolicyKnob("QUICK_TRAIN"),
+            "share_params": PolicyKnob("SHARE_PARAMS"),
+        }
+
+    def __init__(self, **knobs: Any) -> None:
+        super().__init__(**knobs)
+        self._params: Optional[Any] = None
+        self._id2tok: Dict[int, str] = {}
+        self._fwd: Optional[Any] = None
+        self.tokenizer = HashTokenizer(int(self.knobs.get("vocab_size",
+                                                          1 << 14)))
+
+    # ---- internals ----
+    def _module(self) -> Llama:
+        k = self.knobs
+        hd = int(k["hidden_dim"])
+        heads = int(k["n_heads"])
+        kv_heads = max(1, heads // int(k["kv_ratio"]))
+        return Llama(vocab_size=self.tokenizer.vocab_size,
+                     max_len=int(k["max_len"]), hidden_dim=hd,
+                     depth=int(k["depth"]), n_heads=heads,
+                     n_kv_heads=kv_heads, mlp_dim=4 * hd,
+                     lora_rank=int(k["lora_rank"]))
+
+    def _encode_lm(self, texts: Sequence[str]) -> Tuple[np.ndarray,
+                                                        np.ndarray]:
+        """BOS-prefixed hashed token rows; also grows the id→token table
+        used to detokenize generations (hashing is one-way)."""
+        max_len = int(self.knobs["max_len"])
+        ids = np.zeros((len(texts), max_len), np.int32)
+        lens = np.zeros((len(texts),), np.int32)
+        for i, t in enumerate(texts):
+            row, n = self.tokenizer.encode(t, max_len)  # CLS slot = BOS
+            ids[i], lens[i] = row, n
+            # mirror the tokenizer's own splitting so ids align with words
+            for tok_str, tok_id in zip(_TOKEN_RE.findall(t.lower()),
+                                       row[1:n]):
+                self._id2tok[int(tok_id)] = tok_str
+        return ids, lens
+
+    def _mesh(self, devices):
+        n = len(devices)
+        mp = int(self.knobs.get("model_parallel", 1))
+        while n % mp:
+            mp //= 2
+        return make_mesh(devices, model=max(1, mp))
+
+    # ---- contract ----
+    def train(self, dataset_path: str,
+              ctx: Optional[TrainContext] = None) -> None:
+        ctx = ctx or TrainContext()
+        ds = load_text_classification_dataset(dataset_path)
+        ids, lens = self._encode_lm(ds.texts)
+
+        module = self._module()
+        devices = ctx.devices or jax.local_devices()
+        mesh = self._mesh(devices)
+        b_shard = batch_sharding(mesh)
+
+        n_data = mesh.shape[DATA_AXIS]
+        batch_size = int(self.knobs["batch_size"])
+        batch_size = max(n_data, batch_size - batch_size % n_data)
+
+        if self._params is None:
+            params = module.init(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, ids.shape[1]),
+                                           jnp.int32))["params"]
+        else:
+            params = self._params
+        if ctx.shared_params is not None and self.knobs.get("share_params"):
+            shared = ctx.shared_params.get("params")
+            if shared is not None and same_tree_shapes(params, shared):
+                params = jax.tree_util.tree_map(jnp.asarray, shared)
+
+        # 2-D sharding: tensor-parallel per TP_RULES over `model`, fsdp
+        # over `data` for everything large (min_size=0 keeps tiny test
+        # shapes exercising the same code path)
+        p_shard = param_shardings(params, mesh, tp_rules=TP_RULES,
+                                  fsdp=True, min_size=2 ** 12)
+        params = jax.tree_util.tree_map(jax.device_put, params, p_shard)
+
+        lr = float(self.knobs["learning_rate"])
+        # multi_transform (not optax.masked): masked leaves pass raw
+        # gradients through as updates, set_to_zero actually freezes
+        tx = optax.multi_transform(
+            {"train": optax.adamw(lr), "freeze": optax.set_to_zero()},
+            lambda p: jax.tree_util.tree_map(
+                lambda t: "train" if t else "freeze",
+                lora_trainable_mask(p)))
+        opt_state = tx.init(params)
+
+        @jax.jit
+        def train_step(params, opt_state, ib, lb, mask):
+            def loss_fn(p):
+                logits = module.apply({"params": p}, ib, lens=lb)
+                total, count = lm_loss_terms(logits, ib, lb, mask)
+                return total / jnp.maximum(count, 1.0)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        epochs = max(1, round(int(self.knobs["max_epochs"])
+                              * float(ctx.budget_scale)))
+        if self.knobs.get("quick_train"):
+            epochs = min(epochs, 2)
+        ctx.logger.define_plot("LM loss", ["loss"], x_axis="epoch")
+        with mesh:
+            for epoch in range(epochs):
+                losses = []
+                for batch in batch_iterator({"ids": ids, "lens": lens},
+                                            batch_size, seed=epoch):
+                    ib = jax.device_put(batch["ids"], b_shard)
+                    lb = jax.device_put(batch["lens"], b_shard)
+                    mb = jax.device_put(batch["mask"].astype(np.float32),
+                                        b_shard)
+                    params, opt_state, loss = train_step(params, opt_state,
+                                                         ib, lb, mb)
+                    losses.append(float(loss))
+                mean_loss = float(np.mean(losses))
+                ctx.logger.log(epoch=epoch, loss=mean_loss)
+                if ctx.should_continue is not None and \
+                        not ctx.should_continue(epoch, -mean_loss):
+                    break
+        self._params = params
+        self._fwd = None
+
+    def evaluate(self, dataset_path: str) -> float:
+        """Inverse perplexity exp(-nll) in (0, 1]; higher is better."""
+        assert self._params is not None
+        ds = load_text_classification_dataset(dataset_path)
+        ids, lens = self._encode_lm(ds.texts)
+        if self._fwd is None:  # cache: jit memoizes by function identity
+            module = self._module()
+
+            @jax.jit
+            def nll(params, ib, lb):
+                logits = module.apply({"params": params}, ib, lens=lb)
+                return lm_loss_terms(logits, ib, lb)
+
+            self._fwd = nll
+        nll = self._fwd
+        total, count = 0.0, 0.0
+        bucket = 32
+        for i in range(0, len(ids), bucket):
+            ib, lb = ids[i:i + bucket], lens[i:i + bucket]
+            pad = bucket - len(ib)
+            if pad:
+                ib = np.concatenate([ib, np.zeros((pad, ids.shape[1]),
+                                                  ib.dtype)])
+                lb = np.concatenate([lb, np.zeros((pad,), lb.dtype)])
+            s, c = nll(self._params, ib, lb)
+            total += float(s)
+            count += float(c)
+        return float(np.exp(-total / max(count, 1.0)))
+
+    def predict(self, queries: Sequence[Any],
+                max_new_tokens: int = 8) -> List[Any]:
+        """Greedy continuations, detokenized via the learned id→token
+        table (unknown ids render as ``<id>``)."""
+        assert self._params is not None, "model is not trained/loaded"
+        texts = [q if isinstance(q, str) else str(q) for q in queries]
+        max_len = int(self.knobs["max_len"])
+        # the KV cache holds max_len positions total (prompt + generation)
+        max_new = min(max_new_tokens, max_len - 1)
+        prompt_cap = max(1, max_len - max_new)
+        ids, lens = self.tokenizer.encode_batch(texts, prompt_cap)
+        module = self._module()
+        out = np.asarray(greedy_generate(module, self._params, ids, lens,
+                                         max_new))
+        return [" ".join(self._id2tok.get(int(t), f"<{int(t)}>")
+                         for t in row) for row in out]
+
+    def dump_parameters(self) -> Dict[str, Any]:
+        assert self._params is not None, "model is not trained"
+        return {
+            "params": jax.tree_util.tree_map(np.asarray, self._params),
+            "meta": {"id2tok": {str(k): v
+                                for k, v in self._id2tok.items()}},
+        }
+
+    def load_parameters(self, params: Dict[str, Any]) -> None:
+        self._id2tok = {int(k): v
+                        for k, v in params["meta"]["id2tok"].items()}
+        self._params = jax.tree_util.tree_map(jnp.asarray, params["params"])
+        self._fwd = None
+
+
+if __name__ == "__main__":  # reference-style self-test block
+    import tempfile
+
+    from rafiki_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()  # honor RAFIKI_JAX_PLATFORM=cpu for dev runs
+
+    from rafiki_tpu.data import generate_text_classification_dataset
+    from rafiki_tpu.model import test_model_class
+
+    with tempfile.TemporaryDirectory() as d:
+        train_p = f"{d}/train.jsonl"
+        val_p = f"{d}/val.jsonl"
+        generate_text_classification_dataset(train_p, 192, seed=0)
+        generate_text_classification_dataset(val_p, 48, seed=1)
+        preds = test_model_class(
+            LlamaLoRA, TaskType.LANGUAGE_MODELING, train_p, val_p,
+            queries=["tok1 tok2 tok3"],
+            knobs={"max_epochs": 6, "vocab_size": 1 << 14, "hidden_dim": 64,
+                   "depth": 2, "n_heads": 4, "kv_ratio": 2, "lora_rank": 4,
+                   "max_len": 32, "model_parallel": 1,
+                   "learning_rate": 1e-2, "batch_size": 16,
+                   "quick_train": False, "share_params": False})
+        print("continuation:", preds[0])
