@@ -5,26 +5,77 @@ accelerator (one TPU chip under the driver; CPU locally). The reference
 published no numbers (BASELINE.md: ``"published": {}``), so
 ``vs_baseline`` compares against the last locally recorded run in
 ``.bench_history.json`` when present (ratio >1 = faster), else 1.0.
+
+Hardening (round-1 BENCH was rc=1): backend initialization is probed with
+retry + backoff; if the accelerator never comes up the bench reruns itself
+pinned to CPU and labels the result ``backend:cpu-fallback``. Any
+unexpected error still emits a parseable JSON line and exits 0.
+
+Extra metrics (predictor req/s, p50, advisor trials/hour — SURVEY.md §6)
+live in ``bench_extra.py`` so this stays one line.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
+_CPU_FALLBACK_ENV = "RAFIKI_BENCH_CPU_FALLBACK"
 
-def _bench_train_throughput():
+# One matmul on the default backend; proves init AND execution both work.
+_PROBE_SRC = ("import jax, jax.numpy as jnp; b = jax.default_backend(); "
+              "x = jnp.ones((256, 256), jnp.bfloat16); "
+              "(x @ x).block_until_ready(); print(b)")
+
+
+def _probe_backend(tries: int = 2, probe_timeout: float = 150.0) -> str:
+    """Return the working backend name, probing in a SUBPROCESS.
+
+    The accelerator failure mode observed in this image is a *hang* during
+    backend init (the axon TPU tunnel blocks forever), not an exception —
+    an in-process try/except never returns (round-1 BENCH_r01 rc=1 /
+    MULTICHIP rc=124 family). So the probe runs in a child with a hard
+    timeout; only after it proves the backend alive does the parent
+    initialize jax itself. On failure → labeled CPU fallback.
+    """
+    import subprocess
+
+    if os.environ.get(_CPU_FALLBACK_ENV):
+        return "cpu"
+    last = ""
+    for attempt in range(tries):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC], timeout=probe_timeout,
+                capture_output=True, text=True)
+            if out.returncode == 0 and out.stdout.strip():
+                return out.stdout.strip().splitlines()[-1]
+            last = (out.stderr or "")[-200:]
+        except subprocess.TimeoutExpired:
+            last = f"probe hang >{probe_timeout}s"
+        time.sleep(5.0 * (attempt + 1))
+    print(f"bench: accelerator probe failed ({last}); CPU fallback",
+          file=sys.stderr)
+    os.environ[_CPU_FALLBACK_ENV] = "1"
+    return "cpu"
+
+
+def _bench_train_throughput(backend: str):
     import jax
     import jax.numpy as jnp
     import optax
 
+    on_accel = backend not in ("cpu",)
     try:
         from rafiki_tpu.models.vit import ViT
 
         module = ViT(patch_size=16, hidden_dim=768, depth=12, n_heads=12,
                      mlp_dim=3072, n_classes=1000)
-        batch = 32 if jax.default_backend() != "cpu" else 4
+        # bs=128 to saturate the chip (round-1 bs=32 left the MXU idle);
+        # tiny on CPU so the fallback path still finishes.
+        batch = 128 if on_accel else 4
         x = jnp.zeros((batch, 224, 224, 3), jnp.bfloat16)
         name = "vit_b16_train_throughput"
     except ImportError:
@@ -57,7 +108,7 @@ def _bench_train_throughput():
     params, opt_state, loss = step(params, opt_state, x, y)
     float(loss)
 
-    iters = 20 if jax.default_backend() != "cpu" else 5
+    iters = 20 if on_accel else 3
     t0 = time.perf_counter()
     for _ in range(iters):
         params, opt_state, loss = step(params, opt_state, x, y)
@@ -66,8 +117,7 @@ def _bench_train_throughput():
     return name, batch * iters / dt
 
 
-def main() -> None:
-    name, value = _bench_train_throughput()
+def _emit(name: str, value: float, backend: str) -> None:
     hist_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              ".bench_history.json")
     vs = 1.0
@@ -79,14 +129,41 @@ def main() -> None:
             vs = value / prev
     except (OSError, ValueError):
         hist = {}
-    hist[name] = value
-    try:
-        with open(hist_path, "w") as f:
-            json.dump(hist, f)
-    except OSError:
-        pass
+    if backend != "cpu-fallback":  # fallback runs don't become the baseline
+        hist[name] = value
+        try:
+            with open(hist_path, "w") as f:
+                json.dump(hist, f)
+        except OSError:
+            pass
     print(json.dumps({"metric": name, "value": round(value, 2),
-                      "unit": "samples/sec", "vs_baseline": round(vs, 3)}))
+                      "unit": "samples/sec", "vs_baseline": round(vs, 3),
+                      "backend": backend}))
+
+
+def main() -> None:
+    backend = _probe_backend()
+    fallback = bool(os.environ.get(_CPU_FALLBACK_ENV))
+    label = "cpu-fallback" if fallback else backend
+    if fallback:
+        # Pin BEFORE the first in-process jax backend init (sitecustomize
+        # bakes the env default, so use jax.config too).
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    try:
+        name, value = _bench_train_throughput(backend)
+        _emit(name, value, label)
+    except Exception as e:
+        # Never hand the driver a traceback: a parseable failure record
+        # beats rc=1 with no metric.
+        print(json.dumps({"metric": "bench_error", "value": 0.0,
+                          "unit": "samples/sec", "vs_baseline": 0.0,
+                          "backend": label, "error": repr(e)[:300]}))
 
 
 if __name__ == "__main__":
