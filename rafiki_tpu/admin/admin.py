@@ -180,6 +180,10 @@ class Admin:
         job["n_trials"] = len(self.meta.get_trials_of_train_job(job_id))
         return job
 
+    def get_train_jobs(self, user_id: str) -> List[Dict[str, Any]]:
+        """All of a user's train jobs, newest first (dashboard listing)."""
+        return self.meta.get_train_jobs_of_user(user_id)
+
     def get_train_job_of_app(self, user_id: str, app: str,
                              app_version: int = -1) -> Dict[str, Any]:
         if app_version < 0:

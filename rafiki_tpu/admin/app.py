@@ -12,7 +12,7 @@ from __future__ import annotations
 import base64
 from typing import Any, Dict, Optional, Tuple
 
-from ..utils.http import JsonHttpService
+from ..utils.http import JsonHttpService, RawResponse
 from .admin import Admin, AuthError
 
 
@@ -24,6 +24,8 @@ class AdminApp:
         r = self.http.route
         r("POST", "/tokens", self._login)
         r("GET", "/health", self._health)
+        r("GET", "/", self._dashboard)
+        r("GET", "/train_jobs", self._auth(self._get_train_jobs))
         r("POST", "/users", self._auth(self._create_user))
         r("POST", "/models", self._auth(self._create_model))
         r("GET", "/models", self._auth(self._get_models))
@@ -68,6 +70,22 @@ class AdminApp:
         return wrapped
 
     # ---- routes ----
+    def _dashboard(self, _m, _b, _h) -> Tuple[int, Any]:
+        """Operator dashboard (SURVEY.md §1 layer 1): a self-contained
+        HTML+JS page over this very REST API — jobs → trials → loss
+        curves from ``/trials/<id>/logs``."""
+        import importlib.resources
+
+        try:
+            html = (importlib.resources.files("rafiki_tpu.admin")
+                    / "dashboard.html").read_bytes()
+        except (FileNotFoundError, ModuleNotFoundError):
+            return 404, {"error": "dashboard.html not packaged"}
+        return 200, RawResponse(html, "text/html; charset=utf-8")
+
+    def _get_train_jobs(self, _m, _b, user) -> Tuple[int, Any]:
+        return 200, self.admin.get_train_jobs(user["id"])
+
     def _health(self, _m, _b, _h) -> Tuple[int, Any]:
         return 200, {"ok": True,
                      "n_services": len(self.admin.services.services),

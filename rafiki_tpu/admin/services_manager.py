@@ -69,8 +69,10 @@ def probe_devices(timeout: float = 120.0) -> Dict[str, Any]:
 class ServicesManager:
     def __init__(self, meta_store: MetaStore, workdir: str,
                  slot_size: int = 1, platform: Optional[str] = None,
-                 devices: Optional[List[DeviceSpec]] = None) -> None:
+                 devices: Optional[List[DeviceSpec]] = None,
+                 slot_timeout: float = 30.0) -> None:
         self.meta = meta_store
+        self.slot_timeout = slot_timeout
         self.workdir = Path(workdir)
         self.workdir.mkdir(parents=True, exist_ok=True)
         if devices is None:
@@ -196,6 +198,10 @@ class ServicesManager:
                 train_job_id=train_job_id, sub_train_job_id=sub["id"])
             spawned.append(advisor)
 
+            # per-trial jax.profiler traces, opt-in via train_args
+            profile_dir = ""
+            if job["train_args"].get("profile"):
+                profile_dir = str(self.workdir / "profiles" / sub["id"])
             for w in range(n_workers):
                 slot = self.allocator.acquire(timeout=0.0)
                 if slot is None:
@@ -211,6 +217,7 @@ class ServicesManager:
                      "param_store_uri": self.param_store_uri,
                      "meta_store_path": self.meta._db_path,
                      "sub_train_job_id": sub["id"],
+                     "profile_dir": profile_dir,
                      "worker_id": f"tw-{sub['id'][:8]}-{w}"},
                     ServiceType.TRAIN_WORKER, slot=slot,
                     train_job_id=train_job_id, sub_train_job_id=sub["id"])
@@ -249,14 +256,6 @@ class ServicesManager:
     def create_inference_services(self, inference_job_id: str,
                                   max_workers: int = 2
                                   ) -> List[ManagedService]:
-        with self.op_lock:
-            return self._create_inference_services(inference_job_id,
-                                                   max_workers)
-
-    def _create_inference_services(self, inference_job_id: str,
-                                   max_workers: int) -> List[ManagedService]:
-        if not self.kv_port:
-            self.start_data_plane()
         ijob = self.meta.get_inference_job(inference_job_id)
         if ijob is None:
             raise KeyError(f"no inference job {inference_job_id!r}")
@@ -264,6 +263,52 @@ class ServicesManager:
             ijob["train_job_id"], max_count=max_workers)
         if not best:
             raise RuntimeError("no completed trials to deploy")
+
+        # A replica MUST own a device slot: quietly pinning it to host CPU
+        # would serve at CPU speed — a perf cliff, never a default. Acquire
+        # every slot BEFORE taking op_lock: release paths (poll /
+        # stop_service) need that lock, so blocking on the allocator while
+        # holding it could never be satisfied by a concurrent release.
+        slots: List[SubMesh] = []
+        for i in range(len(best)):
+            slot = self.allocator.acquire(timeout=self.slot_timeout)
+            if slot is None:
+                for s in slots:
+                    self.allocator.release(s)
+                self.meta.update_inference_job(inference_job_id,
+                                               status="ERRORED")
+                raise RuntimeError(
+                    f"no free device slot for inference replica {i} after "
+                    f"{self.slot_timeout:.0f}s ({self.allocator.n_slots} "
+                    f"slots, {self.allocator.free_count()} free); stop a "
+                    "running job or lower the replica count")
+            slots.append(slot)
+
+        with self.op_lock:
+            try:
+                return self._create_inference_services(
+                    inference_job_id, best, slots)
+            except BaseException:
+                # slots not yet handed to a spawned service stay ours —
+                # give them back (spawned services release via _poll/stop)
+                held = {id(s.slot) for s in self.services.values()
+                        if s.slot is not None}
+                for slot in slots:
+                    if id(slot) not in held:
+                        try:
+                            self.allocator.release(slot)
+                        except ValueError:
+                            pass  # already released by a service stop
+                self.meta.update_inference_job(inference_job_id,
+                                               status="ERRORED")
+                raise
+
+    def _create_inference_services(self, inference_job_id: str,
+                                   best: List[Dict[str, Any]],
+                                   slots: List["SubMesh"]
+                                   ) -> List[ManagedService]:
+        if not self.kv_port:
+            self.start_data_plane()
 
         spawned: List[ManagedService] = []
         worker_ids: List[str] = []
@@ -273,7 +318,7 @@ class ServicesManager:
             model_file = self.workdir / f"model-{model['id']}.py"
             model_file.write_bytes(model["model_bytes"])
             wid = f"iw-{inference_job_id[:8]}-{i}"
-            slot = self.allocator.acquire(timeout=0.0)
+            slot = slots[i]
             svc = self._spawn(
                 "rafiki_tpu.worker.inference",
                 {"model_file": str(model_file),

@@ -95,7 +95,9 @@ class BOHBAdvisor(BaseAdvisor):
                 new = _RungEntry(trial_no, dict(entry.knobs), entry.vec)
                 self._rungs[rung + 1].append(new)
                 self._by_trial_no[trial_no] = (rung + 1, new)
-                knobs = self._with_policies(dict(entry.knobs), promote=True)
+                knobs = self._with_policies(
+                    dict(entry.knobs), promote=True,
+                    budget_scale=self.budgets[rung + 1])
                 return Proposal(
                     trial_no=trial_no, knobs=knobs,
                     budget_scale=self.budgets[rung + 1],
@@ -116,7 +118,8 @@ class BOHBAdvisor(BaseAdvisor):
         entry = _RungEntry(trial_no, dict(knobs), vec)
         self._rungs[rung].append(entry)
         self._by_trial_no[trial_no] = (rung, entry)
-        knobs = self._with_policies(knobs, promote=False)
+        knobs = self._with_policies(knobs, promote=False,
+                                    budget_scale=self.budgets[rung])
         meta = {"rung": rung}
         if final_fill:
             meta["final_fill"] = True
@@ -138,7 +141,8 @@ class BOHBAdvisor(BaseAdvisor):
             entry = _RungEntry(trial_no, dict(best.knobs), best.vec)
             self._rungs[top].append(entry)
             self._by_trial_no[trial_no] = (top, entry)
-            knobs = self._with_policies(dict(best.knobs), promote=True)
+            knobs = self._with_policies(dict(best.knobs), promote=True,
+                                        budget_scale=1.0)
             return Proposal(
                 trial_no=trial_no, knobs=knobs, budget_scale=1.0,
                 warm_start_trial_id=best.trial_id,
@@ -188,12 +192,19 @@ class BOHBAdvisor(BaseAdvisor):
                 return e
         return None
 
-    def _with_policies(self, knobs: dict, promote: bool) -> dict:
-        """Flip the model's declared policy knobs for rung semantics."""
+    def _with_policies(self, knobs: dict, promote: bool,
+                       budget_scale: float) -> dict:
+        """Flip the model's declared policy knobs for rung semantics.
+
+        QUICK_TRAIN only on sub-full rungs: a full-budget (scale 1.0)
+        trial must actually train at full budget, or rung budgets become
+        indistinguishable for models whose quick_train caps epochs."""
         for n, k in self.knob_config.items():
             if not isinstance(k, PolicyKnob):
                 continue
-            if k.policy in ("QUICK_TRAIN", "EARLY_STOP"):
+            if k.policy == "QUICK_TRAIN":
+                knobs[n] = budget_scale < 1.0 - 1e-9
+            elif k.policy == "EARLY_STOP":
                 knobs[n] = True
             elif k.policy == "SHARE_PARAMS":
                 knobs[n] = promote  # promotions resume their own checkpoint

@@ -27,6 +27,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_tune.add_argument("val_dataset")
     p_tune.add_argument("--trials", type=int, default=5)
     p_tune.add_argument("--advisor", default="auto")
+    p_tune.add_argument("--profile", metavar="DIR", default=None,
+                        help="write a jax.profiler trace per trial to DIR")
 
     _register_service_commands(sub)
 
@@ -52,7 +54,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         result = tune_model(get_model_template(args.template),
                             args.train_dataset, args.val_dataset,
                             total_trials=args.trials,
-                            advisor_type=args.advisor)
+                            advisor_type=args.advisor,
+                            profile_dir=args.profile)
         print(f"best_score={result.best_score:.4f} "
               f"best_knobs={result.best_knobs}")
         return 0

@@ -1,19 +1,22 @@
 """Dataset formats, loaders, synthetic generators, device prefetch."""
 
 from .dataset import (CorpusDataset, ImageClassificationDataset,
-                      TextClassificationDataset, generate_corpus_dataset,
+                      TabularDataset, TextClassificationDataset,
+                      generate_corpus_dataset,
                       generate_image_classification_dataset,
+                      generate_tabular_dataset,
                       generate_text_classification_dataset,
                       load_image_classification_dataset,
+                      load_tabular_dataset,
                       load_text_classification_dataset)
 from .loader import batch_iterator, bucket_pad, prefetch_to_device
 
 __all__ = [
-    "CorpusDataset", "ImageClassificationDataset",
+    "CorpusDataset", "ImageClassificationDataset", "TabularDataset",
     "TextClassificationDataset", "generate_corpus_dataset",
-    "generate_image_classification_dataset",
+    "generate_image_classification_dataset", "generate_tabular_dataset",
     "generate_text_classification_dataset",
-    "load_image_classification_dataset",
+    "load_image_classification_dataset", "load_tabular_dataset",
     "load_text_classification_dataset", "batch_iterator", "bucket_pad",
     "prefetch_to_device",
 ]

@@ -178,6 +178,82 @@ class CorpusDataset:
 
 
 # ---------------------------------------------------------------------------
+# Tabular
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TabularDataset:
+    """Feature-vector table (reference zoo: sklearn DT / xgboost tabular).
+
+    Canonical on-disk form: ``.npz`` with float32 ``features`` [N, D],
+    int64 ``labels`` [N] and scalar ``n_classes`` (0 ⇒ regression, labels
+    float). A ``.csv`` importer (last column = label, header optional) is
+    provided for reference-format compatibility.
+    """
+
+    features: np.ndarray  # float32 [N, D]
+    labels: np.ndarray    # int64 [N] (classification) | float32 (regression)
+    n_classes: int        # 0 for regression
+    feature_names: Optional[List[str]] = None
+
+    def __len__(self) -> int:
+        return int(self.features.shape[0])
+
+    def save(self, path: str) -> None:
+        p = Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        kwargs: Dict[str, np.ndarray] = dict(
+            features=self.features.astype(np.float32), labels=self.labels,
+            n_classes=np.asarray(self.n_classes))
+        if self.feature_names is not None:
+            kwargs["feature_names"] = np.asarray(self.feature_names)
+        np.savez_compressed(p, **kwargs)
+
+    @staticmethod
+    def load(path: str) -> "TabularDataset":
+        with np.load(path, allow_pickle=False) as z:
+            feats = z["features"].astype(np.float32)
+            n_classes = int(z["n_classes"])
+            labels = (z["labels"].astype(np.int64) if n_classes
+                      else z["labels"].astype(np.float32))
+            names = (list(map(str, z["feature_names"]))
+                     if "feature_names" in z else None)
+        return TabularDataset(feats, labels, n_classes, names)
+
+
+def load_tabular_dataset(path: str) -> TabularDataset:
+    p = Path(path)
+    if p.suffix == ".npz":
+        return TabularDataset.load(path)
+    if p.suffix == ".csv":
+        return _load_csv_tabular(p)
+    raise ValueError(f"unrecognized tabular dataset at {path!r}")
+
+
+def _load_csv_tabular(p: Path) -> TabularDataset:
+    with open(p) as f:
+        rows = list(csv.reader(f))
+    names: Optional[List[str]] = None
+    try:
+        float(rows[0][0])
+    except (ValueError, IndexError):
+        names, rows = rows[0][:-1], rows[1:]
+    feats = np.asarray([[float(v) for v in r[:-1]] for r in rows],
+                       np.float32)
+    raw = [r[-1].strip() for r in rows]
+    try:
+        as_float = np.asarray([float(v) for v in raw])
+        if np.allclose(as_float, np.round(as_float)):
+            labels = as_float.astype(np.int64)
+            return TabularDataset(feats, labels,
+                                  int(labels.max()) + 1, names)
+        return TabularDataset(feats, as_float.astype(np.float32), 0, names)
+    except ValueError:  # string class labels
+        labels, classes = _labels_to_ids(raw)
+        return TabularDataset(feats, labels, len(classes), names)
+
+
+# ---------------------------------------------------------------------------
 # Text classification
 # ---------------------------------------------------------------------------
 
@@ -288,6 +364,37 @@ def generate_corpus_dataset(path: str, n_sentences: int = 400,
             t = int(rng.choice(n_tags, p=trans[t]))
         sentences.append((toks, [tag_names[i] for i in tags]))
     ds = CorpusDataset(sentences, tag_names)
+    if path:
+        ds.save(path)
+    return ds
+
+
+def generate_tabular_dataset(path: str, n_examples: int = 1024,
+                             n_features: int = 16, n_classes: int = 3,
+                             noise: float = 0.1, seed: int = 0,
+                             class_seed: int = 7) -> TabularDataset:
+    """Learnable synthetic table: labels come from a fixed random
+    axis-aligned decision structure (depth-3 teacher tree) plus noise, so
+    both tree learners and MLPs have signal and headroom.
+
+    ``class_seed`` fixes the teacher independently of ``seed``.
+    """
+    rng = np.random.default_rng(seed)
+    teacher_rng = np.random.default_rng(class_seed + n_features * 100)
+    x = rng.normal(0.0, 1.0, size=(n_examples, n_features)).astype(
+        np.float32)
+    # teacher: 3 random feature thresholds → 8 leaves → class ids
+    feat = teacher_rng.integers(0, n_features, size=3)
+    thr = teacher_rng.normal(0.0, 0.5, size=3)
+    leaf_class = teacher_rng.integers(0, n_classes, size=8)
+    bits = ((x[:, feat] > thr).astype(np.int64) *
+            np.asarray([4, 2, 1])).sum(axis=1)
+    labels = leaf_class[bits]
+    flip = rng.random(n_examples) < noise
+    labels = np.where(flip, rng.integers(0, n_classes, size=n_examples),
+                      labels).astype(np.int64)
+    ds = TabularDataset(x, labels, n_classes,
+                        [f"f{i}" for i in range(n_features)])
     if path:
         ds.save(path)
     return ds

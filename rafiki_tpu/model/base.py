@@ -53,6 +53,10 @@ class TrainContext:
     #: hook the worker uses to let BOHB pause/stop a trial between epochs;
     #: called with (epoch, score) -> True to continue, False to stop early
     should_continue: Optional[Any] = None
+    #: when set, the worker wraps train() in a ``jax.profiler`` trace and
+    #: writes it here (SURVEY.md §5.1 — a per-trial capability the
+    #: reference lacks); templates may also drop their own artifacts here
+    profile_dir: Optional[str] = None
 
 
 class BaseModel(abc.ABC):

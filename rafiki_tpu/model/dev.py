@@ -91,9 +91,13 @@ test_model_class.__test__ = False  # it's a dev harness, not a pytest case
 def tune_model(model_class: Type[BaseModel], train_dataset_path: str,
                val_dataset_path: str, total_trials: int = 10,
                advisor_type: str = "auto", seed: int = 0,
-               keep_params: bool = True) -> TuneResult:
+               keep_params: bool = True,
+               profile_dir: Optional[str] = None) -> TuneResult:
     """Local single-process tuning loop (reference ``tune_model``): run the
-    advisor's propose/feedback cycle in-process and return the best trial."""
+    advisor's propose/feedback cycle in-process and return the best trial.
+
+    ``profile_dir`` wraps each trial's train() in a ``jax.profiler`` trace
+    written to ``profile_dir/local-<trial_no>/`` (SURVEY.md §5.1)."""
     from ..advisor import make_advisor, TrialResult
 
     knob_config = model_class.get_knob_config()
@@ -110,11 +114,25 @@ def tune_model(model_class: Type[BaseModel], train_dataset_path: str,
         logger = ModelLogger()
         model = model_class(**proposal.knobs)
         shared = params_by_trial.get(proposal.warm_start_trial_id)
+        trial_profile_dir = None
+        if profile_dir:
+            import os
+
+            trial_profile_dir = os.path.join(profile_dir,
+                                             f"local-{proposal.trial_no}")
+            os.makedirs(trial_profile_dir, exist_ok=True)
         ctx = TrainContext(logger=logger, budget_scale=proposal.budget_scale,
                            shared_params=shared,
-                           trial_id=f"local-{proposal.trial_no}")
+                           trial_id=f"local-{proposal.trial_no}",
+                           profile_dir=trial_profile_dir)
         try:
-            model.train(train_dataset_path, ctx)
+            if trial_profile_dir:
+                import jax
+
+                with jax.profiler.trace(trial_profile_dir):
+                    model.train(train_dataset_path, ctx)
+            else:
+                model.train(train_dataset_path, ctx)
             score = model.evaluate(val_dataset_path)
         except Exception as e:
             # reference semantics: an errored trial is dropped and the

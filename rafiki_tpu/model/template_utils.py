@@ -36,6 +36,12 @@ def bucketed_forward(forward: Callable[..., Any], params: Any,
     caller (jit caches by function identity, so a fresh closure per call
     would recompile every time)."""
     n = len(xs[0])
+    if n == 0:  # predict([]) / empty eval set: shape-probe, no compile
+        import jax
+
+        chunks = [np.zeros((bucket, *x.shape[1:]), x.dtype) for x in xs]
+        probe = jax.eval_shape(forward, params, *chunks)
+        return np.zeros((0, *probe.shape[1:]), np.dtype(probe.dtype))
     out = []
     for i in range(0, n, bucket):
         chunks = [x[i:i + bucket] for x in xs]

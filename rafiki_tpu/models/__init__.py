@@ -19,6 +19,7 @@ _ZOO = {
     "BiLSTMTagger": ("rafiki_tpu.models.pos_tagging", "BiLSTMTagger"),
     "SklearnDecisionTree": ("rafiki_tpu.models.sklearn_models",
                             "SklearnDecisionTree"),
+    "JaxTabularMLP": ("rafiki_tpu.models.tabular", "JaxTabularMLP"),
 }
 
 

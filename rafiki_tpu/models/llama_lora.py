@@ -393,8 +393,11 @@ class LlamaLoRA(BaseModel):
                 params = jax.tree_util.tree_map(jnp.asarray, shared)
 
         # 2-D sharding: tensor-parallel per TP_RULES over `model`, fsdp
-        # over `data` for everything large (min_size=0 keeps tiny test
-        # shapes exercising the same code path)
+        # over `data` for everything of >=4k elements — smaller tensors
+        # (and test-scale params) are replicated, where fsdp's gather
+        # traffic outweighs the memory it saves. The fsdp code path at
+        # tiny shapes is covered by __graft_entry__.dryrun_multichip
+        # (min_size=0 there).
         p_shard = param_shardings(params, mesh, tp_rules=TP_RULES,
                                   fsdp=True, min_size=2 ** 12)
         params = jax.tree_util.tree_map(jax.device_put, params, p_shard)

@@ -1,0 +1,369 @@
+"""POS-tagging templates: bigram HMM + BiLSTM (SURVEY.md §2 "Model zoo":
+the reference ships a bigram HMM and a PyTorch BiLSTM for POS tagging).
+
+- :class:`BigramHMM` — count-based emissions/transitions with add-k
+  smoothing and a vectorized numpy Viterbi decode. Training is a single
+  counting pass: the cheap, strong baseline the reference uses, and a
+  fast advisor target (the knob space is just smoothing strengths).
+- :class:`BiLSTMTagger` — flax ``nn.RNN`` over ``OptimizedLSTMCell`` in
+  both directions, hash-vocab token embeddings (no downloaded vocab; same
+  scheme as the BERT template), padded/bucketed batches with masked loss —
+  the jit-compiled TPU counterpart of the reference's PyTorch BiLSTM.
+
+Queries for both: a list of token lists → list of tag-name lists.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# NOTE: zoo templates use absolute imports — their module source is shipped
+# to workers via serialize_model_class() and re-imported standalone.
+from rafiki_tpu.constants import TaskType
+from rafiki_tpu.data import CorpusDataset
+from rafiki_tpu.model import (BaseModel, CategoricalKnob, FixedKnob,
+                              FloatKnob, IntegerKnob, KnobConfig,
+                              PolicyKnob, TrainContext, same_tree_shapes)
+
+UNK = "<unk>"
+
+
+# ---------------------------------------------------------------------------
+# Bigram HMM
+# ---------------------------------------------------------------------------
+
+class BigramHMM(BaseModel):
+    """Count-based bigram HMM tagger with add-k smoothing."""
+
+    TASKS = (TaskType.POS_TAGGING,)
+
+    @staticmethod
+    def get_knob_config() -> KnobConfig:
+        return {
+            # smoothing strengths are the whole hyperparameter story for a
+            # counting model; both matter on small corpora
+            "emission_k": FloatKnob(1e-3, 1.0, is_exp=True),
+            "transition_k": FloatKnob(1e-3, 1.0, is_exp=True),
+            "min_word_count": IntegerKnob(1, 3),
+        }
+
+    def __init__(self, **knobs: Any) -> None:
+        super().__init__(**knobs)
+        self._vocab: Dict[str, int] = {}
+        self._tags: List[str] = []
+        self._log_emit: Optional[np.ndarray] = None   # [T, V]
+        self._log_trans: Optional[np.ndarray] = None  # [T+1, T] (0 = start)
+
+    # ---- contract ----
+    def train(self, dataset_path: str,
+              ctx: Optional[TrainContext] = None) -> None:
+        ctx = ctx or TrainContext()
+        ds = CorpusDataset.load(dataset_path)
+        self._tags = list(ds.tag_names)
+        tag_ix = {t: i for i, t in enumerate(self._tags)}
+
+        counts: Dict[str, int] = {}
+        for tokens, _ in ds.sentences:
+            for w in tokens:
+                counts[w] = counts.get(w, 0) + 1
+        min_count = int(self.knobs.get("min_word_count", 1))
+        self._vocab = {UNK: 0}
+        for w, c in sorted(counts.items()):
+            if c >= min_count:
+                self._vocab[w] = len(self._vocab)
+
+        T, V = len(self._tags), len(self._vocab)
+        emit = np.zeros((T, V), np.float64)
+        trans = np.zeros((T + 1, T), np.float64)  # row 0 = sentence start
+        for tokens, tags in ds.sentences:
+            prev = 0
+            for w, tag in zip(tokens, tags):
+                t = tag_ix[tag]
+                emit[t, self._vocab.get(w, 0)] += 1
+                trans[prev, t] += 1
+                prev = t + 1
+        ek = float(self.knobs.get("emission_k", 0.1))
+        tk = float(self.knobs.get("transition_k", 0.1))
+        self._log_emit = np.log(emit + ek) - np.log(
+            (emit + ek).sum(axis=1, keepdims=True))
+        self._log_trans = np.log(trans + tk) - np.log(
+            (trans + tk).sum(axis=1, keepdims=True))
+        ctx.logger.log(epoch=0, loss=0.0)  # single counting pass
+
+    def _viterbi(self, tokens: Sequence[str]) -> List[str]:
+        assert self._log_emit is not None and self._log_trans is not None
+        T = len(self._tags)
+        ids = [self._vocab.get(w, 0) for w in tokens]
+        if not ids:
+            return []
+        # vectorized over tags: delta [T], psi [len, T]
+        delta = self._log_trans[0] + self._log_emit[:, ids[0]]
+        psi = np.zeros((len(ids), T), np.int64)
+        for i in range(1, len(ids)):
+            # scores[p, t] = delta[p] + trans[p+1, t]
+            scores = delta[:, None] + self._log_trans[1:]
+            psi[i] = np.argmax(scores, axis=0)
+            delta = scores[psi[i], np.arange(T)] + self._log_emit[:, ids[i]]
+        path = [int(np.argmax(delta))]
+        for i in range(len(ids) - 1, 0, -1):
+            path.append(int(psi[i][path[-1]]))
+        return [self._tags[t] for t in reversed(path)]
+
+    def evaluate(self, dataset_path: str) -> float:
+        ds = CorpusDataset.load(dataset_path)
+        correct = total = 0
+        for tokens, tags in ds.sentences:
+            pred = self._viterbi(tokens)
+            correct += sum(p == t for p, t in zip(pred, tags))
+            total += len(tags)
+        return correct / max(total, 1)
+
+    def predict(self, queries: Sequence[Any]) -> List[Any]:
+        return [self._viterbi([str(w) for w in q]) for q in queries]
+
+    def dump_parameters(self) -> Dict[str, Any]:
+        assert self._log_emit is not None, "model is not trained"
+        words = sorted(self._vocab, key=self._vocab.get)
+        return {"log_emit": self._log_emit, "log_trans": self._log_trans,
+                "meta": {"tags": self._tags, "words": words}}
+
+    def load_parameters(self, params: Dict[str, Any]) -> None:
+        self._log_emit = np.asarray(params["log_emit"])
+        self._log_trans = np.asarray(params["log_trans"])
+        self._tags = [str(t) for t in params["meta"]["tags"]]
+        self._vocab = {str(w): i
+                       for i, w in enumerate(params["meta"]["words"])}
+
+
+# ---------------------------------------------------------------------------
+# BiLSTM
+# ---------------------------------------------------------------------------
+
+def _hash_token(w: str, vocab_size: int) -> int:
+    """Deterministic token→id (FNV-1a); id 0 is reserved for padding."""
+    h = 2166136261
+    for ch in w.encode("utf-8"):
+        h = ((h ^ ch) * 16777619) & 0xFFFFFFFF
+    return 1 + h % (vocab_size - 1)
+
+
+class BiLSTMTagger(BaseModel):
+    """Bidirectional LSTM tagger (flax ``nn.RNN``; masked CE loss)."""
+
+    TASKS = (TaskType.POS_TAGGING,)
+
+    @staticmethod
+    def get_knob_config() -> KnobConfig:
+        return {
+            "max_epochs": FixedKnob(10),
+            "vocab_size": CategoricalKnob([1024, 4096],
+                                          shape_relevant=True),
+            "embed_dim": CategoricalKnob([32, 64, 128],
+                                         shape_relevant=True),
+            "hidden_dim": CategoricalKnob([64, 128, 256],
+                                          shape_relevant=True),
+            "learning_rate": FloatKnob(1e-4, 3e-2, is_exp=True),
+            "batch_size": CategoricalKnob([16, 32, 64],
+                                          shape_relevant=True),
+            "max_len": FixedKnob(32),
+            "quick_train": PolicyKnob("QUICK_TRAIN"),
+            "share_params": PolicyKnob("SHARE_PARAMS"),
+        }
+
+    def __init__(self, **knobs: Any) -> None:
+        super().__init__(**knobs)
+        self._params: Optional[Any] = None
+        self._tags: List[str] = []
+        self._fwd: Optional[Any] = None
+
+    # ---- internals ----
+    def _module(self):
+        from flax import linen as nn
+
+        import jax.numpy as jnp
+
+        vocab = int(self.knobs["vocab_size"])
+        embed = int(self.knobs["embed_dim"])
+        hidden = int(self.knobs["hidden_dim"])
+        n_tags = len(self._tags)
+
+        class _BiLSTM(nn.Module):
+            @nn.compact
+            def __call__(self, ids: jnp.ndarray,
+                         lens: jnp.ndarray) -> jnp.ndarray:
+                x = nn.Embed(vocab, embed)(ids)                # [B,S,E]
+                fwd = nn.RNN(nn.OptimizedLSTMCell(hidden))(
+                    x, seq_lengths=lens)
+                bwd = nn.RNN(nn.OptimizedLSTMCell(hidden), reverse=True,
+                             keep_order=True)(x, seq_lengths=lens)
+                h = jnp.concatenate([fwd, bwd], axis=-1)       # [B,S,2H]
+                return nn.Dense(n_tags)(h)                     # [B,S,T]
+
+        return _BiLSTM()
+
+    def _encode(self, sents: Sequence[Sequence[str]]
+                ) -> Tuple[np.ndarray, np.ndarray]:
+        vocab = int(self.knobs["vocab_size"])
+        max_len = int(self.knobs["max_len"])
+        n = len(sents)
+        ids = np.zeros((n, max_len), np.int32)
+        lens = np.zeros((n,), np.int32)
+        for i, toks in enumerate(sents):
+            toks = list(toks)[:max_len]
+            lens[i] = len(toks)
+            for j, w in enumerate(toks):
+                ids[i, j] = _hash_token(str(w), vocab)
+        return ids, lens
+
+    # ---- contract ----
+    def train(self, dataset_path: str,
+              ctx: Optional[TrainContext] = None) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from rafiki_tpu.data import batch_iterator
+
+        ctx = ctx or TrainContext()
+        ds = CorpusDataset.load(dataset_path)
+        self._tags = list(ds.tag_names)
+        tag_ix = {t: i for i, t in enumerate(self._tags)}
+        max_len = int(self.knobs["max_len"])
+
+        ids, lens = self._encode([toks for toks, _ in ds.sentences])
+        tags = np.zeros_like(ids)
+        for i, (_, ts) in enumerate(ds.sentences):
+            for j, t in enumerate(list(ts)[:max_len]):
+                tags[i, j] = tag_ix[t]
+
+        module = self._module()
+        if self._params is None:
+            params = module.init(jax.random.PRNGKey(0),
+                                 jnp.asarray(ids[:1]),
+                                 jnp.asarray(lens[:1]))["params"]
+        else:
+            params = self._params
+        if ctx.shared_params is not None and self.knobs.get("share_params"):
+            shared = ctx.shared_params.get("params")
+            if shared is not None and same_tree_shapes(params, shared):
+                params = jax.tree_util.tree_map(jnp.asarray, shared)
+
+        tx = optax.adam(float(self.knobs["learning_rate"]))
+        opt_state = tx.init(params)
+
+        @jax.jit
+        def train_step(params, opt_state, ib, lb, tb, mask):
+            def loss_fn(p):
+                logits = module.apply({"params": p}, ib, lb)
+                tok_mask = (jnp.arange(ib.shape[1])[None, :]
+                            < lb[:, None]).astype(jnp.float32)
+                tok_mask = tok_mask * mask[:, None]
+                losses = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, tb)
+                return jnp.sum(losses * tok_mask) / jnp.maximum(
+                    jnp.sum(tok_mask), 1.0)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        epochs = max(1, round(int(self.knobs["max_epochs"])
+                              * float(ctx.budget_scale)))
+        if self.knobs.get("quick_train"):
+            epochs = min(epochs, 2)
+        batch_size = int(self.knobs["batch_size"])
+        ctx.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
+        for epoch in range(epochs):
+            losses = []
+            for b in batch_iterator({"i": ids, "l": lens, "t": tags},
+                                    batch_size, seed=epoch):
+                params, opt_state, loss = train_step(
+                    params, opt_state, b["i"], b["l"], b["t"],
+                    b["mask"].astype(np.float32))
+                losses.append(float(loss))
+            mean_loss = float(np.mean(losses))
+            ctx.logger.log(epoch=epoch, loss=mean_loss)
+            if ctx.should_continue is not None and \
+                    not ctx.should_continue(epoch, -mean_loss):
+                break
+        self._params = params
+        self._fwd = None
+
+    def _predict_tags(self, sents: Sequence[Sequence[str]]) -> List[List[str]]:
+        import jax
+
+        assert self._params is not None, "model is not trained/loaded"
+        ids, lens = self._encode(sents)
+        if self._fwd is None:
+            module = self._module()
+
+            @jax.jit
+            def forward(params, ib, lb):
+                return module.apply({"params": params}, ib, lb).argmax(-1)
+
+            self._fwd = forward
+        out: List[List[str]] = []
+        bucket = 64
+        for i in range(0, len(ids), bucket):
+            ib, lb = ids[i:i + bucket], lens[i:i + bucket]
+            pad = bucket - len(ib)
+            if pad:
+                ib = np.concatenate([ib, np.zeros((pad, ib.shape[1]),
+                                                  ib.dtype)])
+                lb = np.concatenate([lb, np.zeros((pad,), lb.dtype)])
+            pred = np.asarray(self._fwd(self._params, ib, lb))
+            for j in range(len(lb) - pad):
+                out.append([self._tags[t] for t in pred[j, :lb[j]]])
+        return out
+
+    def evaluate(self, dataset_path: str) -> float:
+        ds = CorpusDataset.load(dataset_path)
+        max_len = int(self.knobs["max_len"])
+        preds = self._predict_tags([toks for toks, _ in ds.sentences])
+        correct = total = 0
+        for pred, (_, tags) in zip(preds, ds.sentences):
+            tags = list(tags)[:max_len]
+            correct += sum(p == t for p, t in zip(pred, tags))
+            total += len(tags)
+        return correct / max(total, 1)
+
+    def predict(self, queries: Sequence[Any]) -> List[Any]:
+        return self._predict_tags([[str(w) for w in q] for q in queries])
+
+    def dump_parameters(self) -> Dict[str, Any]:
+        import jax
+
+        assert self._params is not None, "model is not trained"
+        return {"params": jax.tree_util.tree_map(np.asarray, self._params),
+                "meta": {"tags": self._tags}}
+
+    def load_parameters(self, params: Dict[str, Any]) -> None:
+        import jax.numpy as jnp
+        import jax
+
+        self._tags = [str(t) for t in params["meta"]["tags"]]
+        self._params = jax.tree_util.tree_map(jnp.asarray, params["params"])
+        self._fwd = None
+
+
+if __name__ == "__main__":  # reference-style self-test block
+    import tempfile
+
+    from rafiki_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()
+
+    from rafiki_tpu.data import generate_corpus_dataset
+    from rafiki_tpu.model import test_model_class
+
+    with tempfile.TemporaryDirectory() as d:
+        train_p, val_p = f"{d}/train.jsonl", f"{d}/val.jsonl"
+        generate_corpus_dataset(train_p, 400, seed=0)
+        ds = generate_corpus_dataset(val_p, 100, seed=1)
+        for cls in (BigramHMM, BiLSTMTagger):
+            preds = test_model_class(
+                cls, TaskType.POS_TAGGING, train_p, val_p,
+                queries=[ds.sentences[0][0]])
+            print(cls.__name__, "tags:", preds[0])

@@ -1,0 +1,131 @@
+"""Tabular-classification templates (SURVEY.md §2 "Model zoo": the
+reference ships sklearn decision-tree / xgboost tabular templates).
+
+:class:`SklearnDecisionTree` fits ``sklearn.tree.DecisionTreeClassifier``
+but serializes the fitted tree as plain numpy arrays (children/feature/
+threshold/leaf-distribution) instead of pickles — the ParamStore transport
+is msgpack'd arrays, and unpickling foreign blobs on workers is exactly
+the attack surface the model-transport design avoids. Prediction walks
+the exported arrays directly (vectorized numpy), so a loaded model does
+not even need sklearn present.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+# NOTE: zoo templates use absolute imports — their module source is shipped
+# to workers via serialize_model_class() and re-imported standalone.
+from rafiki_tpu.constants import TaskType
+from rafiki_tpu.data import load_tabular_dataset
+from rafiki_tpu.model import (BaseModel, CategoricalKnob, FloatKnob,
+                              IntegerKnob, KnobConfig, TrainContext)
+
+
+class SklearnDecisionTree(BaseModel):
+    """Decision-tree classifier over tabular features."""
+
+    TASKS = (TaskType.TABULAR_CLASSIFICATION,)
+
+    @staticmethod
+    def get_knob_config() -> KnobConfig:
+        return {
+            "max_depth": IntegerKnob(2, 16),
+            "min_samples_split": IntegerKnob(2, 32, is_exp=True),
+            "min_impurity_decrease": FloatKnob(1e-6, 1e-1, is_exp=True),
+            "criterion": CategoricalKnob(["gini", "entropy"]),
+        }
+
+    def __init__(self, **knobs: Any) -> None:
+        super().__init__(**knobs)
+        # exported tree arrays (see dump_parameters)
+        self._tree: Optional[Dict[str, np.ndarray]] = None
+        self._n_classes: int = 0
+
+    # ---- contract ----
+    def train(self, dataset_path: str,
+              ctx: Optional[TrainContext] = None) -> None:
+        from sklearn.tree import DecisionTreeClassifier
+
+        ctx = ctx or TrainContext()
+        ds = load_tabular_dataset(dataset_path)
+        if ds.n_classes == 0:
+            raise ValueError("SklearnDecisionTree is a classifier; "
+                             "dataset is regression (n_classes=0)")
+        clf = DecisionTreeClassifier(
+            max_depth=int(self.knobs["max_depth"]),
+            min_samples_split=int(self.knobs["min_samples_split"]),
+            min_impurity_decrease=float(
+                self.knobs["min_impurity_decrease"]),
+            criterion=str(self.knobs["criterion"]), random_state=0)
+        clf.fit(ds.features, ds.labels)
+        t = clf.tree_
+        # leaf value → class distribution (normalized counts)
+        dist = t.value[:, 0, :].astype(np.float64)
+        dist = dist / np.maximum(dist.sum(axis=1, keepdims=True), 1e-12)
+        self._tree = {
+            "children_left": t.children_left.astype(np.int32),
+            "children_right": t.children_right.astype(np.int32),
+            "feature": t.feature.astype(np.int32),
+            "threshold": t.threshold.astype(np.float32),
+            "dist": dist.astype(np.float32),
+        }
+        self._n_classes = int(ds.n_classes)
+        ctx.logger.log(epoch=0, loss=float(1.0 - clf.score(ds.features,
+                                                           ds.labels)))
+
+    def _probs(self, x: np.ndarray) -> np.ndarray:
+        assert self._tree is not None, "model is not trained/loaded"
+        t = self._tree
+        node = np.zeros(len(x), np.int32)
+        # vectorized traversal: all rows step one level per iteration;
+        # leaves have children == -1 and simply stay put
+        for _ in range(64):  # > max tree depth
+            feat = t["feature"][node]
+            leaf = feat < 0
+            if leaf.all():
+                break
+            go_left = x[np.arange(len(x)), np.maximum(feat, 0)] \
+                <= t["threshold"][node]
+            nxt = np.where(go_left, t["children_left"][node],
+                           t["children_right"][node])
+            node = np.where(leaf, node, nxt).astype(np.int32)
+        return t["dist"][node]
+
+    def evaluate(self, dataset_path: str) -> float:
+        ds = load_tabular_dataset(dataset_path)
+        probs = self._probs(ds.features)
+        return float(np.mean(np.argmax(probs, -1) == ds.labels))
+
+    def predict(self, queries: Sequence[Any]) -> List[Any]:
+        x = np.asarray([np.asarray(q, np.float32).ravel()
+                        for q in queries], np.float32)
+        return [p.tolist() for p in self._probs(x)]
+
+    def dump_parameters(self) -> Dict[str, Any]:
+        assert self._tree is not None, "model is not trained"
+        return {**self._tree, "meta": {"n_classes": self._n_classes}}
+
+    def load_parameters(self, params: Dict[str, Any]) -> None:
+        self._n_classes = int(params["meta"]["n_classes"])
+        self._tree = {k: np.asarray(params[k]) for k in
+                      ("children_left", "children_right", "feature",
+                       "threshold", "dist")}
+
+
+if __name__ == "__main__":  # reference-style self-test block
+    import tempfile
+
+    from rafiki_tpu.data import generate_tabular_dataset
+    from rafiki_tpu.model import test_model_class
+
+    with tempfile.TemporaryDirectory() as d:
+        train_p, val_p = f"{d}/train.npz", f"{d}/val.npz"
+        generate_tabular_dataset(train_p, 1024, seed=0)
+        ds = generate_tabular_dataset(val_p, 256, seed=1)
+        preds = test_model_class(
+            SklearnDecisionTree, TaskType.TABULAR_CLASSIFICATION,
+            train_p, val_p, queries=[ds.features[0]])
+        print("probs:", [round(p, 3) for p in preds[0]])

@@ -1,0 +1,208 @@
+"""JaxTabularMLP — TPU-first tabular classifier.
+
+The reference's tabular story is CPU sklearn/xgboost (SURVEY.md §2 "Model
+zoo"); this template is its accelerator-native counterpart: a jit-compiled
+flax MLP over standardized features, so tabular jobs ride the same TPU
+sub-mesh scheduling as every other template. Feature standardization
+(mean/std learned at train time) ships inside the parameter blob.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+# NOTE: zoo templates use absolute imports — their module source is shipped
+# to workers via serialize_model_class() and re-imported standalone.
+from rafiki_tpu.constants import TaskType
+from rafiki_tpu.data import batch_iterator, load_tabular_dataset
+from rafiki_tpu.model import (BaseModel, CategoricalKnob, FixedKnob,
+                              FloatKnob, IntegerKnob, KnobConfig,
+                              PolicyKnob, TrainContext, bucketed_forward,
+                              same_tree_shapes)
+
+
+class JaxTabularMLP(BaseModel):
+    """Dense net over standardized tabular features."""
+
+    TASKS = (TaskType.TABULAR_CLASSIFICATION,)
+
+    @staticmethod
+    def get_knob_config() -> KnobConfig:
+        return {
+            "max_epochs": FixedKnob(10),
+            "hidden_layer_count": IntegerKnob(1, 4, shape_relevant=True),
+            "hidden_layer_units": IntegerKnob(16, 256, is_exp=True,
+                                              shape_relevant=True),
+            "dropout": FloatKnob(0.0, 0.5),
+            "learning_rate": FloatKnob(1e-4, 1e-1, is_exp=True),
+            "batch_size": CategoricalKnob([64, 128, 256],
+                                          shape_relevant=True),
+            "quick_train": PolicyKnob("QUICK_TRAIN"),
+            "share_params": PolicyKnob("SHARE_PARAMS"),
+        }
+
+    def __init__(self, **knobs: Any) -> None:
+        super().__init__(**knobs)
+        self._params: Optional[Any] = None
+        self._n_classes: int = 0
+        self._mean: Optional[np.ndarray] = None
+        self._std: Optional[np.ndarray] = None
+        self._fwd: Optional[Any] = None
+
+    # ---- internals ----
+    def _module(self):
+        from flax import linen as nn
+
+        layers = int(self.knobs["hidden_layer_count"])
+        units = int(self.knobs["hidden_layer_units"])
+        rate = float(self.knobs.get("dropout", 0.0))
+        n_classes = self._n_classes
+
+        class _Net(nn.Module):
+            @nn.compact
+            def __call__(self, x, train: bool = False):
+                for _ in range(layers):
+                    x = nn.relu(nn.Dense(units)(x))
+                    x = nn.Dropout(rate, deterministic=not train)(x)
+                return nn.Dense(n_classes)(x)
+
+        return _Net()
+
+    def _standardize(self, x: np.ndarray) -> np.ndarray:
+        assert self._mean is not None and self._std is not None
+        return ((x - self._mean) / self._std).astype(np.float32)
+
+    # ---- contract ----
+    def train(self, dataset_path: str,
+              ctx: Optional[TrainContext] = None) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        ctx = ctx or TrainContext()
+        ds = load_tabular_dataset(dataset_path)
+        if ds.n_classes == 0:
+            raise ValueError("JaxTabularMLP is a classifier; dataset is "
+                             "regression (n_classes=0)")
+        self._n_classes = int(ds.n_classes)
+        self._mean = ds.features.mean(axis=0)
+        self._std = ds.features.std(axis=0) + 1e-6
+        x = self._standardize(ds.features)
+        y = ds.labels
+
+        module = self._module()
+        if self._params is None:
+            params = module.init(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, x.shape[1])))["params"]
+        else:
+            params = self._params
+        if ctx.shared_params is not None and self.knobs.get("share_params"):
+            shared = ctx.shared_params.get("params")
+            if shared is not None and same_tree_shapes(params, shared):
+                params = jax.tree_util.tree_map(jnp.asarray, shared)
+
+        tx = optax.adam(float(self.knobs["learning_rate"]))
+        opt_state = tx.init(params)
+
+        @jax.jit
+        def train_step(params, opt_state, rng, xb, yb, mask):
+            def loss_fn(p):
+                logits = module.apply({"params": p}, xb, train=True,
+                                      rngs={"dropout": rng})
+                losses = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, yb)
+                return jnp.sum(losses * mask) / jnp.maximum(
+                    jnp.sum(mask), 1.0)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        epochs = max(1, round(int(self.knobs["max_epochs"])
+                              * float(ctx.budget_scale)))
+        if self.knobs.get("quick_train"):
+            epochs = min(epochs, 2)
+        batch_size = int(self.knobs["batch_size"])
+        rng = jax.random.PRNGKey(1)
+        ctx.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
+        for epoch in range(epochs):
+            losses = []
+            for b in batch_iterator({"x": x, "y": y}, batch_size,
+                                    seed=epoch):
+                rng, step_rng = jax.random.split(rng)
+                params, opt_state, loss = train_step(
+                    params, opt_state, step_rng, b["x"], b["y"],
+                    b["mask"].astype(np.float32))
+                losses.append(float(loss))
+            mean_loss = float(np.mean(losses))
+            ctx.logger.log(epoch=epoch, loss=mean_loss)
+            if ctx.should_continue is not None and \
+                    not ctx.should_continue(epoch, -mean_loss):
+                break
+        self._params = params
+        self._fwd = None
+
+    def _probs(self, x: np.ndarray) -> np.ndarray:
+        import jax
+
+        assert self._params is not None, "model is not trained/loaded"
+        if self._fwd is None:
+            module = self._module()
+
+            @jax.jit
+            def forward(params, xb):
+                return jax.nn.softmax(
+                    module.apply({"params": params}, xb), -1)
+
+            self._fwd = forward
+        return bucketed_forward(self._fwd, self._params, x, bucket=256)
+
+    def evaluate(self, dataset_path: str) -> float:
+        ds = load_tabular_dataset(dataset_path)
+        probs = self._probs(self._standardize(ds.features))
+        return float(np.mean(np.argmax(probs, -1) == ds.labels))
+
+    def predict(self, queries: Sequence[Any]) -> List[Any]:
+        x = np.asarray([np.asarray(q, np.float32).ravel()
+                        for q in queries], np.float32)
+        return [p.tolist() for p in self._probs(self._standardize(x))]
+
+    def dump_parameters(self) -> Dict[str, Any]:
+        import jax
+
+        assert self._params is not None, "model is not trained"
+        return {"params": jax.tree_util.tree_map(np.asarray, self._params),
+                "mean": self._mean, "std": self._std,
+                "meta": {"n_classes": self._n_classes}}
+
+    def load_parameters(self, params: Dict[str, Any]) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self._n_classes = int(params["meta"]["n_classes"])
+        self._mean = np.asarray(params["mean"])
+        self._std = np.asarray(params["std"])
+        self._params = jax.tree_util.tree_map(jnp.asarray, params["params"])
+        self._fwd = None
+
+
+if __name__ == "__main__":  # reference-style self-test block
+    import tempfile
+
+    from rafiki_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()
+
+    from rafiki_tpu.data import generate_tabular_dataset
+    from rafiki_tpu.model import test_model_class
+
+    with tempfile.TemporaryDirectory() as d:
+        train_p, val_p = f"{d}/train.npz", f"{d}/val.npz"
+        generate_tabular_dataset(train_p, 1024, seed=0)
+        ds = generate_tabular_dataset(val_p, 256, seed=1)
+        preds = test_model_class(
+            JaxTabularMLP, TaskType.TABULAR_CLASSIFICATION, train_p, val_p,
+            queries=[ds.features[0]])
+        print("probs:", [round(p, 3) for p in preds[0]])

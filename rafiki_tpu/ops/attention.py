@@ -1,4 +1,4 @@
-"""Fused multi-head attention (flash-style) as a Pallas TPU kernel.
+"""Fused multi-head attention (flash-style) as Pallas TPU kernels.
 
 Replaces the cuDNN fused attention the reference's templates get for free
 inside TF/PyTorch (SURVEY.md §2.1: the rebuild's native obligation is
@@ -8,12 +8,19 @@ XLA/Pallas kernels; ViT attention is the named target). Design:
   score matrix in HBM): for each query block the kernel keeps running
   (max, sum, weighted-V accumulator) in f32 and rescales as new key blocks
   arrive — the flash-attention recurrence.
+- Backward pass: fused Pallas kernels too. The forward saves each row's
+  logsumexp (LSE); backward runs two kernels — dQ (grid over query blocks,
+  streaming keys) and dK/dV (grid over key blocks, streaming queries) —
+  with ``delta = rowsum(dO · O)`` precomputed in XLA. HBM stays O(S·d)
+  per (batch, head); the S×S matrix is never materialized.
+- Per-row scalars (LSE, delta) are stored replicated across a 128-lane
+  trailing dim so every kernel touches only native (sublane, lane) tiles —
+  no 1-D refs, no in-kernel transposes (Mosaic-restricted patterns).
+- Variable-length batches: ``kv_lens`` rides in as a scalar-prefetch
+  operand (SMEM), read per grid row to bound the key loop and mask pads.
 - Block sizes default to 128 to match MXU tiling; inputs are padded to
-  block multiples by the wrapper and the pad keys are masked out, so any
-  sequence length works.
-- f32 accumulation regardless of input dtype (bf16 in, bf16 out, f32 math).
-- Backward pass: recompute-based custom VJP in XLA (correctness first; the
-  fwd kernel is the serving hot path). CPU backend runs the same kernel in
+  block multiples by the wrapper. f32 accumulation regardless of input
+  dtype (bf16 in, bf16 out, f32 math). CPU runs the same kernels in
   interpreter mode, so tests exercise the identical code path.
 """
 
@@ -27,16 +34,23 @@ import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+# LSE written for rows whose every key is masked: exp(s - 1e30) == 0 for
+# any finite score, so such rows contribute exactly zero gradient.
+LSE_MASKED = 1e30
+# Per-row scalars are replicated across this many lanes (one f32 vreg lane
+# dim) so kernels only ever see (sublane, lane)-tiled 2-D blocks.
+LANES = 128
 
 
-def _attn_fwd_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, *, sm_scale: float,
-                     causal: bool, block_q: int, block_k: int,
-                     n_kv_blocks: int):
+def _attn_fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *lse_refs,
+                     sm_scale: float, causal: bool, block_q: int,
+                     block_k: int, n_kv_blocks: int):
     from jax.experimental import pallas as pl
 
+    bh = pl.program_id(0)
     qb = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * sm_scale  # (block_q, d)
-    kv_len = len_ref[0]  # this example's valid key count (pads masked out)
+    kv_len = len_ref[bh]  # this example's valid key count (pads masked out)
 
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
@@ -79,6 +93,123 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, *, sm_scale: float,
             n_blocks, (qb * block_q + block_q + block_k - 1) // block_k)
     m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    if lse_refs:  # training path only; serving skips the residual write
+        lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)),
+                        LSE_MASKED)
+        lse_refs[0][0] = jax.lax.broadcast_in_dim(
+            lse, (block_q, LANES), (0, 1))
+
+
+def _attn_bwd_dq_kernel(len_ref, q_ref, g_ref, lse_ref, delta_ref, k_ref,
+                        v_ref, dq_ref, *, sm_scale: float, causal: bool,
+                        block_q: int, block_k: int, n_kv_blocks: int):
+    """dQ for one query block: stream key blocks, accumulate ds·K.
+
+    Requires ``block_k == LANES`` so the lane-replicated LSE/delta tiles
+    line up elementwise with the (block_q, block_k) score tile.
+    """
+    from jax.experimental import pallas as pl
+
+    bh = pl.program_id(0)
+    qb = pl.program_id(1)
+    kv_len = len_ref[bh]
+    q = q_ref[0].astype(jnp.float32)      # (block_q, d)
+    g = g_ref[0].astype(jnp.float32)      # (block_q, d)
+    lse = lse_ref[0]                      # (block_q, LANES) f32
+    delta = delta_ref[0]                  # (block_q, LANES) f32
+
+    q_pos = qb * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(kb, acc):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < kv_len
+        if causal:
+            mask = jnp.logical_and(mask, k_pos <= q_pos)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse)                                # (bq, bk)
+        dp = jax.lax.dot_general(
+            g, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (bq, bk)
+        ds = p * (dp - delta) * sm_scale
+        return acc + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (bq, d)
+
+    n_blocks = jnp.minimum(
+        jnp.asarray(n_kv_blocks, jnp.int32),
+        (kv_len + block_k - 1) // block_k)
+    if causal:
+        n_blocks = jnp.minimum(
+            n_blocks, (qb * block_q + block_q + block_k - 1) // block_k)
+    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    acc = jax.lax.fori_loop(0, n_blocks, body, acc0)
+    dq_ref[0] = acc.astype(dq_ref.dtype)
+
+
+def _attn_bwd_dkv_kernel(len_ref, q_ref, g_ref, lse_ref, delta_ref, k_ref,
+                         v_ref, dk_ref, dv_ref, *, sm_scale: float,
+                         causal: bool, block_q: int, block_k: int,
+                         n_q_blocks: int):
+    """dK/dV for one key block: stream query blocks, accumulate pᵀ·dO and
+    dsᵀ·Q. Causal skips query blocks strictly above the diagonal."""
+    from jax.experimental import pallas as pl
+
+    bh = pl.program_id(0)
+    kb = pl.program_id(1)
+    kv_len = len_ref[bh]
+    k_blk = k_ref[0].astype(jnp.float32)  # (block_k, d)
+    v_blk = v_ref[0].astype(jnp.float32)  # (block_k, d)
+
+    k_pos = kb * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+
+    def body(qb, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        g_blk = g_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q), :]    # (bq, LANES)
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q), :]
+        s = jax.lax.dot_general(
+            q_blk, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale   # (bq, bk)
+        q_pos = qb * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        mask = k_pos < kv_len
+        if causal:
+            mask = jnp.logical_and(mask, k_pos <= q_pos)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dv = dv + jax.lax.dot_general(
+            p, g_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bk, d)
+        dp = jax.lax.dot_general(
+            g_blk, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bq, bk)
+        ds = p * (dp - delta) * sm_scale
+        dk = dk + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bk, d)
+        return dk, dv
+
+    # causal: the first query row that can see key kb*block_k is that same
+    # position, so start at its query block
+    start = (kb * block_k) // block_q if causal else 0
+    # key block entirely past kv_len → every p underflows to zero; skip
+    # the whole query loop instead of multiplying zeros on the MXU
+    stop = jnp.where(kb * block_k < kv_len,
+                     jnp.asarray(n_q_blocks, jnp.int32),
+                     jnp.asarray(start, jnp.int32))
+    z = jnp.zeros((block_k, k_blk.shape[-1]), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, stop, body, (z, z))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _pad_to(x: jnp.ndarray, axis: int, mult: int) -> jnp.ndarray:
@@ -91,15 +222,29 @@ def _pad_to(x: jnp.ndarray, axis: int, mult: int) -> jnp.ndarray:
     return jnp.pad(x, widths)
 
 
+def _resolve_interpret(interpret: Optional[bool]) -> bool:
+    return jax.default_backend() != "tpu" if interpret is None else interpret
+
+
+def _prep_lens(kv_lens, b: int, h: int, s_kv: int) -> jnp.ndarray:
+    """(b,) valid-key counts → (b*h,) int32 scalar-prefetch operand."""
+    if kv_lens is None:
+        lens = jnp.full((b,), s_kv, jnp.int32)
+    else:
+        lens = jnp.minimum(jnp.asarray(kv_lens, jnp.int32), s_kv)
+    return jnp.repeat(lens, h)
+
+
 def _flash_attention_fwd_impl(q, k, v, kv_lens, sm_scale: float,
                               causal: bool, block_q: int, block_k: int,
-                              interpret: Optional[bool]):
+                              interpret: Optional[bool], *,
+                              with_lse: bool = False):
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     b, h, s_q, d = q.shape
     s_kv = k.shape[2]
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    interpret = _resolve_interpret(interpret)
 
     qp = _pad_to(q, 2, block_q)
     kp = _pad_to(k, 2, block_k)
@@ -111,35 +256,135 @@ def _flash_attention_fwd_impl(q, k, v, kv_lens, sm_scale: float,
     qp = qp.reshape(b * h, sq_p, d)
     kp = kp.reshape(b * h, skv_p, d)
     vp = vp.reshape(b * h, skv_p, d)
-    # per-(example,head) valid key count; None → all real keys valid
-    if kv_lens is None:
-        lens = jnp.full((b,), s_kv, jnp.int32)
-    else:
-        lens = jnp.minimum(jnp.asarray(kv_lens, jnp.int32), s_kv)
-    lens = jnp.repeat(lens, h)  # (b*h,)
+    lens = _prep_lens(kv_lens, b, h, s_kv)
 
     kernel = functools.partial(
         _attn_fwd_kernel, sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, n_kv_blocks=n_kv_blocks)
-    out = pl.pallas_call(
-        kernel,
+    out_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qb, lens: (bh, qb, 0)),
+    ]
+    out_shape = [jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype)]
+    if with_lse:  # residual for the fused backward (training path only)
+        out_specs.append(pl.BlockSpec((1, block_q, LANES),
+                                      lambda bh, qb, lens: (bh, qb, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * h, sq_p, LANES), jnp.float32))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=(b * h, n_q_blocks),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qb: (bh, qb, 0)),
-            pl.BlockSpec((1, skv_p, d), lambda bh, qb: (bh, 0, 0)),
-            pl.BlockSpec((1, skv_p, d), lambda bh, qb: (bh, 0, 0)),
-            pl.BlockSpec((1,), lambda bh, qb: (bh,)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qb, lens: (bh, qb, 0)),
+            pl.BlockSpec((1, skv_p, d), lambda bh, qb, lens: (bh, 0, 0)),
+            pl.BlockSpec((1, skv_p, d), lambda bh, qb, lens: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qb: (bh, qb, 0)),
+        out_specs=out_specs,
+    )
+    res = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(lens, qp, kp, vp)
+    out = res[0].reshape(b, h, sq_p, d)[:, :, :s_q, :]
+    if with_lse:
+        return out, res[1]  # lse stays padded/lane-replicated for the bwd
+    return out
+
+
+def _flash_attention_bwd_impl(q, k, v, kv_lens, o, lse, g, sm_scale: float,
+                              causal: bool, block_q: int, block_k: int,
+                              interpret: Optional[bool]):
+    """Fused dq/dk/dv. ``lse`` is the (b*h, sq_padded, LANES) residual."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    # The backward always tiles keys at LANES so the lane-replicated
+    # LSE/delta tiles line up elementwise with the (block_q, block_k)
+    # score tile — the caller's block_k only shapes the forward. block_q
+    # must stay the forward's: the saved lse is padded at its granularity.
+    block_k = LANES
+    b, h, s_q, d = q.shape
+    s_kv = k.shape[2]
+    interpret = _resolve_interpret(interpret)
+
+    qp = _pad_to(q, 2, block_q).reshape(b * h, -1, d)
+    kp = _pad_to(k, 2, block_k).reshape(b * h, -1, d)
+    vp = _pad_to(v, 2, block_k).reshape(b * h, -1, d)
+    gp = _pad_to(g, 2, block_q).reshape(b * h, -1, d)
+    op = _pad_to(o, 2, block_q).reshape(b * h, -1, d)
+    sq_p, skv_p = qp.shape[1], kp.shape[1]
+    n_q_blocks = sq_p // block_q
+    n_kv_blocks = skv_p // block_k
+    lens = _prep_lens(kv_lens, b, h, s_kv)
+
+    # delta_i = Σ_d dO_id · O_id, lane-replicated like the LSE
+    delta = jnp.sum(gp.astype(jnp.float32) * op.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    delta = jnp.broadcast_to(delta, (b * h, sq_p, LANES))
+
+    dq_kernel = functools.partial(
+        _attn_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, n_kv_blocks=n_kv_blocks)
+    dq_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * h, n_q_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qb, lens: (bh, qb, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qb, lens: (bh, qb, 0)),
+            pl.BlockSpec((1, block_q, LANES),
+                         lambda bh, qb, lens: (bh, qb, 0)),
+            pl.BlockSpec((1, block_q, LANES),
+                         lambda bh, qb, lens: (bh, qb, 0)),
+            pl.BlockSpec((1, skv_p, d), lambda bh, qb, lens: (bh, 0, 0)),
+            pl.BlockSpec((1, skv_p, d), lambda bh, qb, lens: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh, qb, lens: (bh, qb, 0)),
+    )
+    dq = pl.pallas_call(
+        dq_kernel, grid_spec=dq_spec,
         out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
         interpret=interpret,
-    )(qp, kp, vp, lens)
-    return out.reshape(b, h, sq_p, d)[:, :, :s_q, :]
+    )(lens, qp, gp, lse, delta, kp, vp)
+
+    dkv_kernel = functools.partial(
+        _attn_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, n_q_blocks=n_q_blocks)
+    dkv_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * h, n_kv_blocks),
+        in_specs=[
+            pl.BlockSpec((1, sq_p, d), lambda bh, kb, lens: (bh, 0, 0)),
+            pl.BlockSpec((1, sq_p, d), lambda bh, kb, lens: (bh, 0, 0)),
+            pl.BlockSpec((1, sq_p, LANES), lambda bh, kb, lens: (bh, 0, 0)),
+            pl.BlockSpec((1, sq_p, LANES), lambda bh, kb, lens: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kb, lens: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kb, lens: (bh, kb, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, kb, lens: (bh, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kb, lens: (bh, kb, 0)),
+        ],
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel, grid_spec=dkv_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, skv_p, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, skv_p, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(lens, qp, gp, lse, delta, kp, vp)
+
+    dq = dq.reshape(b, h, sq_p, d)[:, :, :s_q, :]
+    dk = dk.reshape(b, h, skv_p, d)[:, :, :s_kv, :]
+    dv = dv.reshape(b, h, skv_p, d)[:, :, :s_kv, :]
+    return dq, dk, dv
 
 
 def _attention_reference(q, k, v, sm_scale: float, causal: bool,
                          kv_lens=None):
-    """Pure-XLA attention (the correctness oracle + backward path)."""
+    """Pure-XLA attention (the correctness oracle for kernel tests)."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * sm_scale
     s_q, s_k = s.shape[-2], s.shape[-1]
@@ -164,7 +409,8 @@ def flash_attention(q, k, v, sm_scale: Optional[float] = None,
 
     ``kv_lens`` (optional int32 [batch]) masks each example's keys past its
     valid length — the padding mask for BERT-style batches and bucketed
-    continuous-batch serving.
+    continuous-batch serving. Differentiable end-to-end via the fused
+    Pallas backward kernels.
     """
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
     if kv_lens is None:
@@ -183,22 +429,16 @@ def _flash_attention_full(q, k, v, sm_scale, causal, block_q, block_k,
 
 
 def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    out = _flash_attention_full(q, k, v, sm_scale, causal, block_q, block_k,
-                                interpret)
-    return out, (q, k, v)
+    out, lse = _flash_attention_fwd_impl(
+        q, k, v, None, sm_scale, causal, block_q, block_k, interpret,
+        with_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(sm_scale, causal, block_q, block_k, interpret, residuals, g):
-    # Recompute-based backward in XLA: memory O(S^2) per (b,h) at the
-    # training scales this framework targets (ViT/BERT); the fwd kernel
-    # stays the serving hot path.
-    q, k, v = residuals
-
-    def ref(q_, k_, v_):
-        return _attention_reference(q_, k_, v_, sm_scale, causal)
-
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = residuals
+    return _flash_attention_bwd_impl(q, k, v, None, o, lse, g, sm_scale,
+                                     causal, block_q, block_k, interpret)
 
 
 _flash_attention_full.defvjp(_fwd, _bwd)
@@ -212,21 +452,19 @@ def _flash_attention_varlen(q, k, v, kv_lens, sm_scale, causal, block_q,
 
 
 def _vfwd(q, k, v, kv_lens, sm_scale, causal, block_q, block_k, interpret):
-    out = _flash_attention_varlen(q, k, v, kv_lens, sm_scale, causal,
-                                  block_q, block_k, interpret)
-    return out, (q, k, v, kv_lens)
+    out, lse = _flash_attention_fwd_impl(
+        q, k, v, kv_lens, sm_scale, causal, block_q, block_k, interpret,
+        with_lse=True)
+    return out, (q, k, v, kv_lens, out, lse)
 
 
 def _vbwd(sm_scale, causal, block_q, block_k, interpret, residuals, g):
     import numpy as np
 
-    q, k, v, kv_lens = residuals
-
-    def ref(q_, k_, v_):
-        return _attention_reference(q_, k_, v_, sm_scale, causal, kv_lens)
-
-    _, vjp = jax.vjp(ref, q, k, v)
-    dq, dk, dv = vjp(g)
+    q, k, v, kv_lens, o, lse = residuals
+    dq, dk, dv = _flash_attention_bwd_impl(
+        q, k, v, kv_lens, o, lse, g, sm_scale, causal, block_q, block_k,
+        interpret)
     # integer primal → symbolic-zero cotangent (float0)
     d_lens = np.zeros(kv_lens.shape, jax.dtypes.float0)
     return dq, dk, dv, d_lens

@@ -257,6 +257,12 @@ class MetaStore:
     def get_train_job(self, job_id: str) -> Optional[Dict[str, Any]]:
         return self._one("SELECT * FROM train_jobs WHERE id=?", (job_id,))
 
+    def get_train_jobs_of_user(self,
+                               user_id: str) -> List[Dict[str, Any]]:
+        return self._all(
+            "SELECT * FROM train_jobs WHERE user_id=? "
+            "ORDER BY created_at DESC", (user_id,))
+
     def get_train_jobs_of_app(self, user_id: str,
                               app: str) -> List[Dict[str, Any]]:
         return self._all(

@@ -21,6 +21,14 @@ Handler = Callable[[Dict[str, str], Any, Dict[str, str]],
                    Tuple[int, Any]]
 
 
+class RawResponse:
+    """Non-JSON handler payload (static HTML/JS for the dashboard)."""
+
+    def __init__(self, data: bytes, content_type: str) -> None:
+        self.data = data
+        self.content_type = content_type
+
+
 def _compile(pattern: str) -> re.Pattern:
     regex = re.sub(r"<([a-zA-Z_][a-zA-Z0-9_]*)>", r"(?P<\1>[^/]+)", pattern)
     return re.compile("^" + regex + "$")
@@ -79,9 +87,13 @@ class JsonHttpService:
                 self._reply(404, {"error": f"no route {method} {path}"})
 
             def _reply(self, status: int, payload: Any) -> None:
-                data = json.dumps(payload).encode("utf-8")
+                if isinstance(payload, RawResponse):  # e.g. dashboard HTML
+                    data, ctype = payload.data, payload.content_type
+                else:
+                    data = json.dumps(payload).encode("utf-8")
+                    ctype = "application/json"
                 self.send_response(status)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)

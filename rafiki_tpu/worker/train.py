@@ -35,7 +35,8 @@ class TrainWorker:
                  meta_store: Optional[Any] = None,
                  sub_train_job_id: str = "", model_id: str = "",
                  devices: Optional[List[Any]] = None,
-                 worker_id: str = "worker-0") -> None:
+                 worker_id: str = "worker-0",
+                 profile_dir: Optional[str] = None) -> None:
         self.model_class = model_class
         self.advisor = advisor
         self.train_dataset_path = train_dataset_path
@@ -46,6 +47,7 @@ class TrainWorker:
         self.model_id = model_id
         self.devices = devices
         self.worker_id = worker_id
+        self.profile_dir = profile_dir
         self.trials_run = 0
 
     # ---- one trial ----
@@ -76,11 +78,27 @@ class TrainWorker:
             shared = None
             if proposal.warm_start_trial_id:
                 shared = self.param_store.load(proposal.warm_start_trial_id)
+            trial_profile_dir = None
+            if self.profile_dir:
+                import os
+
+                trial_profile_dir = os.path.join(self.profile_dir, trial_id)
+                os.makedirs(trial_profile_dir, exist_ok=True)
             ctx = TrainContext(devices=self.devices,
                                budget_scale=proposal.budget_scale,
                                shared_params=shared, logger=logger,
-                               trial_id=trial_id)
-            model.train(self.train_dataset_path, ctx)
+                               trial_id=trial_id,
+                               profile_dir=trial_profile_dir)
+            if trial_profile_dir:
+                # per-trial jax.profiler trace (SURVEY.md §5.1): XLA/HLO
+                # timing + (on TPU) hardware counters, viewable in
+                # TensorBoard / Perfetto
+                import jax
+
+                with jax.profiler.trace(trial_profile_dir):
+                    model.train(self.train_dataset_path, ctx)
+            else:
+                model.train(self.train_dataset_path, ctx)
             score = float(model.evaluate(self.val_dataset_path))
 
             self.param_store.save(trial_id, model.dump_parameters())
@@ -153,7 +171,8 @@ def main(argv: Optional[list] = None) -> int:
         meta_store=meta_store,
         sub_train_job_id=cfg.get("sub_train_job_id", ""),
         model_id=cfg.get("model_id", ""),
-        worker_id=cfg.get("worker_id", "worker-0"))
+        worker_id=cfg.get("worker_id", "worker-0"),
+        profile_dir=cfg.get("profile_dir"))
     n = worker.run()
     print(f"train worker {worker.worker_id} done: {n} trials", flush=True)
     return 0

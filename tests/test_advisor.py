@@ -179,3 +179,15 @@ def test_advisor_service_round_trip():
         assert status["best"]["score"] > 0
     finally:
         svc.stop()
+
+
+def test_bohb_quick_train_only_on_subfull_rungs():
+    # a full-budget (scale 1.0) proposal must NOT carry QUICK_TRAIN: models
+    # cap epochs under it, which would make rung budgets indistinguishable
+    adv = make_advisor(bohb_config(), "bohb", total_trials=40, seed=3)
+    run_search(adv, quadratic_score, budget_scale_aware=True)
+    full = [r for r in adv.results if r.budget_scale >= 1.0]
+    sub = [r for r in adv.results if r.budget_scale < 1.0]
+    assert full and sub
+    assert all(r.knobs["quick"] is False for r in full)
+    assert all(r.knobs["quick"] is True for r in sub)

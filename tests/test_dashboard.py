@@ -1,0 +1,36 @@
+"""Dashboard page + jobs-listing REST (SURVEY.md §1 layer 1)."""
+
+import urllib.request
+
+from rafiki_tpu.admin.admin import Admin
+from rafiki_tpu.admin.app import AdminApp
+from rafiki_tpu.admin.services_manager import ServicesManager
+from rafiki_tpu.parallel.mesh import DeviceSpec
+from rafiki_tpu.store.meta_store import MetaStore
+from rafiki_tpu.utils.http import json_request
+
+
+def test_dashboard_and_job_listing(tmp_path):
+    meta = MetaStore(str(tmp_path / "meta.db"))
+    manager = ServicesManager(meta, str(tmp_path), slot_size=1,
+                              platform="cpu",
+                              devices=[DeviceSpec(id=0)])
+    admin = Admin(meta, manager)
+    app = AdminApp(admin)
+    host, port = app.start()
+    base = f"http://{host}:{port}"
+    try:
+        with urllib.request.urlopen(base + "/", timeout=10) as resp:
+            assert resp.headers["Content-Type"].startswith("text/html")
+            html = resp.read().decode()
+        assert "rafiki-tpu dashboard" in html
+        assert "/trials/" in html  # wired to the loss-curve endpoint
+
+        token = json_request("POST", base + "/tokens",
+                             {"email": "superadmin@rafiki",
+                              "password": "rafiki"})["token"]
+        hdrs = {"Authorization": f"Bearer {token}"}
+        jobs = json_request("GET", base + "/train_jobs", headers=hdrs)
+        assert jobs == []
+    finally:
+        app.stop()

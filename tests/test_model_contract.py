@@ -48,3 +48,37 @@ def test_tune_model_random(datasets):
     assert len(result.trials) == 3
     assert result.best_score >= max(t.score for t in result.trials) - 1e-9
     assert result.best_params  # params captured for deployment
+
+
+def test_bucketed_forward_empty_input():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rafiki_tpu.model import bucketed_forward
+
+    @jax.jit
+    def fwd(params, xb):
+        return jnp.dot(xb, params)
+
+    params = jnp.ones((4, 3))
+    out = bucketed_forward(fwd, params, np.zeros((0, 4), np.float32),
+                          bucket=8)
+    assert out.shape == (0, 3)
+    assert out.dtype == np.float32
+
+
+def test_profiler_trace_per_trial(tmp_path, datasets):
+    from rafiki_tpu.model import tune_model
+    from rafiki_tpu.models.mlp import JaxFeedForward
+
+    train_p, val_p, _ = datasets
+    prof = tmp_path / "profiles"
+    tune_model(JaxFeedForward, train_p, val_p,
+               total_trials=1, advisor_type="random",
+               profile_dir=str(prof))
+    trial_dirs = list(prof.iterdir())
+    assert len(trial_dirs) == 1 and trial_dirs[0].name == "local-0"
+    # jax.profiler writes plugins/profile/<ts>/*.trace.json.gz (and more)
+    traces = list(trial_dirs[0].rglob("*"))
+    assert any(f.is_file() for f in traces), "no trace artifacts written"

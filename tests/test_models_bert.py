@@ -1,6 +1,8 @@
 """BERT family: tokenizer determinism, module shapes, contract, DP, and
 padding-mask invariance."""
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -10,6 +12,7 @@ from rafiki_tpu.data import generate_text_classification_dataset
 from rafiki_tpu.model import TrainContext, test_model_class
 from rafiki_tpu.models.bert import (Bert, BertClassifier, HashTokenizer,
                                     PAD_ID)
+
 
 TINY = {"max_epochs": 8, "vocab_size": 1 << 15, "hidden_dim": 96,
         "depth": 2, "n_heads": 4, "max_len": 32, "learning_rate": 1e-3,
@@ -54,6 +57,7 @@ def test_bert_padding_invariance():
                                atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_bert_template_contract(tmp_path):
     tr, va = str(tmp_path / "t.jsonl"), str(tmp_path / "v.jsonl")
     generate_text_classification_dataset(tr, 256, seed=0)
@@ -63,6 +67,7 @@ def test_bert_template_contract(tmp_path):
     assert len(preds) == 1 and len(preds[0]) == 4
 
 
+@pytest.mark.slow
 def test_bert_trains_data_parallel(tmp_path):
     tr = str(tmp_path / "t.jsonl")
     va = str(tmp_path / "v.jsonl")

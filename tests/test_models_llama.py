@@ -1,5 +1,7 @@
 """Llama LoRA family: module, LoRA freezing, 2-D sharding, generation."""
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -9,6 +11,7 @@ from rafiki_tpu.data import generate_text_classification_dataset
 from rafiki_tpu.model import TrainContext, test_model_class
 from rafiki_tpu.models.llama_lora import (Llama, LlamaLoRA, greedy_generate,
                                           lora_trainable_mask)
+
 
 TINY = {"max_epochs": 6, "vocab_size": 1 << 14, "hidden_dim": 64,
         "depth": 2, "n_heads": 4, "kv_ratio": 2, "lora_rank": 4,
@@ -47,6 +50,7 @@ def test_lora_mask_freezes_base():
     assert any("RMSNorm" in p and v for p, v in by_path.items())
 
 
+@pytest.mark.slow
 def test_greedy_generate_matches_full_forward():
     """Cache decode must reproduce the full-forward next-token argmax."""
     m = _tiny_module(max_len=24)
@@ -70,6 +74,7 @@ def test_greedy_generate_matches_full_forward():
         ids.append(nxt)
 
 
+@pytest.mark.slow
 def test_llama_trains_2d_sharded(tmp_path):
     """fsdp × tensor (4×2) over 8 virtual devices; loss decreases and the
     frozen base stays bit-identical."""
@@ -96,6 +101,7 @@ def test_llama_trains_2d_sharded(tmp_path):
         params["block_0"]["attn"]["wq"]["lora_b"])).sum()) > 0
 
 
+@pytest.mark.slow
 def test_llama_template_contract(tmp_path):
     tr, va = str(tmp_path / "t.jsonl"), str(tmp_path / "v.jsonl")
     generate_text_classification_dataset(tr, 128, seed=0)

@@ -1,0 +1,123 @@
+"""POS-tagging + tabular templates: contract + learnability.
+
+These bring TaskType.POS_TAGGING / TABULAR_CLASSIFICATION alive
+(SURVEY.md §2 "Model zoo": bigram HMM, BiLSTM tagger, sklearn DT,
+plus the TPU-native tabular MLP).
+"""
+
+import numpy as np
+import pytest
+
+from rafiki_tpu.constants import TaskType
+from rafiki_tpu.data import (generate_corpus_dataset,
+                             generate_tabular_dataset,
+                             load_tabular_dataset)
+from rafiki_tpu.model import test_model_class
+from rafiki_tpu.models.pos_tagging import BigramHMM, BiLSTMTagger
+from rafiki_tpu.models.sklearn_models import SklearnDecisionTree
+from rafiki_tpu.models.tabular import JaxTabularMLP
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    d = tmp_path_factory.mktemp("corpus")
+    tr, va = str(d / "train.jsonl"), str(d / "val.jsonl")
+    generate_corpus_dataset(tr, 500, seed=0)
+    ds = generate_corpus_dataset(va, 120, seed=1)
+    return tr, va, ds
+
+
+@pytest.fixture(scope="module")
+def table(tmp_path_factory):
+    d = tmp_path_factory.mktemp("table")
+    tr, va = str(d / "train.npz"), str(d / "val.npz")
+    generate_tabular_dataset(tr, 1024, seed=0)
+    ds = generate_tabular_dataset(va, 256, seed=1)
+    return tr, va, ds
+
+
+def test_hmm_contract_and_learns(corpus):
+    tr, va, ds = corpus
+    preds = test_model_class(
+        BigramHMM, TaskType.POS_TAGGING, tr, va,
+        queries=[ds.sentences[0][0]],
+        knobs={"emission_k": 0.01, "transition_k": 0.1,
+               "min_word_count": 1})
+    assert len(preds[0]) == len(ds.sentences[0][0])
+    assert all(t in ds.tag_names for t in preds[0])
+    m = BigramHMM(emission_k=0.01, transition_k=0.1, min_word_count=1)
+    m.train(tr)
+    # the synthetic corpus has a dominant word→tag lexicon: an HMM must
+    # beat uniform guessing (1/8) by a wide margin
+    assert m.evaluate(va) > 0.7
+
+
+@pytest.mark.slow
+def test_bilstm_contract_and_learns(corpus):
+    tr, va, ds = corpus
+    preds = test_model_class(
+        BiLSTMTagger, TaskType.POS_TAGGING, tr, va,
+        queries=[ds.sentences[0][0]],
+        knobs={"max_epochs": 10, "vocab_size": 1024, "embed_dim": 32,
+               "hidden_dim": 64, "learning_rate": 5e-3, "batch_size": 32,
+               "max_len": 32, "quick_train": False, "share_params": False})
+    assert len(preds[0]) == len(ds.sentences[0][0])
+    m = BiLSTMTagger(max_epochs=10, vocab_size=1024, embed_dim=32,
+                     hidden_dim=64, learning_rate=5e-3, batch_size=32,
+                     max_len=32, quick_train=False, share_params=False)
+    m.train(tr)
+    assert m.evaluate(va) > 0.7
+
+
+def test_decision_tree_contract_and_learns(table):
+    tr, va, ds = table
+    preds = test_model_class(
+        SklearnDecisionTree, TaskType.TABULAR_CLASSIFICATION, tr, va,
+        queries=[ds.features[0]],
+        knobs={"max_depth": 8, "min_samples_split": 4,
+               "min_impurity_decrease": 1e-6, "criterion": "gini"})
+    assert len(preds[0]) == ds.n_classes
+    m = SklearnDecisionTree(max_depth=8, min_samples_split=4,
+                            min_impurity_decrease=1e-6, criterion="gini")
+    m.train(tr)
+    # teacher is a depth-3 axis-aligned tree with 10% label noise: a DT
+    # should essentially recover it
+    assert m.evaluate(va) > 0.8
+    # loaded-from-arrays predictor matches the freshly fit one
+    blob = m.dump_parameters()
+    m2 = SklearnDecisionTree(max_depth=8, min_samples_split=4,
+                             min_impurity_decrease=1e-6, criterion="gini")
+    m2.load_parameters(blob)
+    q = ds.features[:32]
+    np.testing.assert_allclose(m.predict(list(q)), m2.predict(list(q)))
+
+
+def test_tabular_mlp_contract_and_learns(table):
+    tr, va, ds = table
+    preds = test_model_class(
+        JaxTabularMLP, TaskType.TABULAR_CLASSIFICATION, tr, va,
+        queries=[ds.features[0]],
+        knobs={"max_epochs": 10, "hidden_layer_count": 2,
+               "hidden_layer_units": 64, "dropout": 0.1,
+               "learning_rate": 1e-2, "batch_size": 128,
+               "quick_train": False, "share_params": False})
+    assert len(preds[0]) == ds.n_classes
+    m = JaxTabularMLP(max_epochs=10, hidden_layer_count=2,
+                      hidden_layer_units=64, dropout=0.1,
+                      learning_rate=1e-2, batch_size=128,
+                      quick_train=False, share_params=False)
+    m.train(tr)
+    assert m.evaluate(va) > 0.8
+
+
+def test_tabular_csv_roundtrip(tmp_path):
+    ds = generate_tabular_dataset("", 64, n_features=4, seed=3)
+    p = tmp_path / "t.csv"
+    with open(p, "w") as f:
+        f.write("f0,f1,f2,f3,label\n")
+        for row, lab in zip(ds.features, ds.labels):
+            f.write(",".join(f"{v:.6f}" for v in row) + f",{lab}\n")
+    loaded = load_tabular_dataset(str(p))
+    assert loaded.n_classes == ds.n_classes
+    np.testing.assert_allclose(loaded.features, ds.features, atol=1e-5)
+    np.testing.assert_array_equal(loaded.labels, ds.labels)

@@ -1,5 +1,7 @@
 """ResNet family: module shapes, contract conformance, DP training."""
 
+import pytest
+
 import jax
 import numpy as np
 
@@ -7,6 +9,7 @@ from rafiki_tpu.constants import TaskType
 from rafiki_tpu.data import generate_image_classification_dataset
 from rafiki_tpu.model import TrainContext, test_model_class
 from rafiki_tpu.models.resnet import ResNet, ResNetClassifier
+
 
 TINY = {"variant": "resnet18", "width_mult": 0.25, "batch_size": 32,
         "max_epochs": 5, "learning_rate": 0.1, "weight_decay": 1e-4,
@@ -32,6 +35,7 @@ def test_resnet_module_large_stem():
     assert out.shape == (1, 3)
 
 
+@pytest.mark.slow
 def test_resnet_template_contract(tmp_path):
     tr, va = str(tmp_path / "t.npz"), str(tmp_path / "v.npz")
     generate_image_classification_dataset(tr, 192, seed=0)
@@ -41,6 +45,7 @@ def test_resnet_template_contract(tmp_path):
     assert len(preds) == 1 and len(preds[0]) == ds.n_classes
 
 
+@pytest.mark.slow
 def test_resnet_trains_data_parallel(tmp_path):
     """Train over 8 virtual devices; loss must decrease and BN stats must
     update away from init."""

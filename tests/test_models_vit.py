@@ -1,5 +1,7 @@
 """ViT template: contract conformance + DP sharding on the virtual mesh."""
 
+import pytest
+
 import jax
 import numpy as np
 
@@ -7,6 +9,7 @@ from rafiki_tpu.constants import TaskType
 from rafiki_tpu.data import generate_image_classification_dataset
 from rafiki_tpu.model import TrainContext, test_model_class
 from rafiki_tpu.models.vit import ViT, ViTBase16
+
 
 TINY = {"patch_size": 4, "hidden_dim": 96, "depth": 2, "n_heads": 4,
         "batch_size": 32, "max_epochs": 5, "learning_rate": 1e-3,
@@ -23,6 +26,7 @@ def test_vit_module_shapes():
     assert out.shape == (2, 7)
 
 
+@pytest.mark.slow
 def test_vit_template_contract(tmp_path):
     tr, va = str(tmp_path / "t.npz"), str(tmp_path / "v.npz")
     generate_image_classification_dataset(tr, 192, seed=0)
@@ -32,6 +36,7 @@ def test_vit_template_contract(tmp_path):
     assert len(preds) == 1 and len(preds[0]) == ds.n_classes
 
 
+@pytest.mark.slow
 def test_vit_trains_data_parallel(tmp_path):
     """Train over 8 virtual devices; loss must decrease."""
     tr = str(tmp_path / "t.npz")

@@ -1,0 +1,35 @@
+"""No free device slot for an inference replica → loud failure, no CPU pin."""
+
+import pytest
+
+from rafiki_tpu.admin.services_manager import ServicesManager
+from rafiki_tpu.parallel.mesh import DeviceSpec
+from rafiki_tpu.store.meta_store import MetaStore
+
+
+def test_inference_replica_requires_slot(tmp_path):
+    meta = MetaStore(str(tmp_path / "meta.db"))
+    user = meta.create_user("op@x", "pw", "ADMIN")
+    model = meta.create_model(user["id"], "m", "IMAGE_CLASSIFICATION",
+                              "M", b"class M: pass\n")
+    job = meta.create_train_job(user["id"], "app", 1,
+                                "IMAGE_CLASSIFICATION", {"TRIAL_COUNT": 1},
+                                "d1", "d2")
+    sub = meta.create_sub_train_job(job["id"], model["id"])
+    trial = meta.create_trial(sub["id"], 0, model["id"], {"k": 1})
+    meta.mark_trial_completed(trial["id"], 0.9, params_saved=True)
+    ijob = meta.create_inference_job(user["id"], job["id"])
+
+    mgr = ServicesManager(meta, str(tmp_path / "wd"), slot_size=1,
+                          platform="cpu",
+                          devices=[DeviceSpec(id=0)], slot_timeout=0.2)
+    mgr.allocator.acquire()  # someone else holds the only slot
+    try:
+        with pytest.raises(RuntimeError, match="no free device slot"):
+            mgr.create_inference_services(ijob["id"], max_workers=1)
+        assert meta.get_inference_job(ijob["id"])["status"] == "ERRORED"
+        # nothing left running or holding a slot
+        assert not mgr.services
+        assert mgr.allocator.free_count() == 0
+    finally:
+        mgr.stop_all()
