@@ -20,8 +20,10 @@ XLA/Pallas kernels; ViT attention is the named target). Design:
   operand (SMEM), read per grid row to bound the key loop and mask pads.
 - Block sizes default to 128 to match MXU tiling; inputs are padded to
   block multiples by the wrapper. f32 accumulation regardless of input
-  dtype (bf16 in, bf16 out, f32 math). CPU runs the same kernels in
-  interpreter mode, so tests exercise the identical code path.
+  dtype (bf16 in, bf16 out, f32 math). Off-TPU the default dispatch uses
+  the equivalent pure-XLA path (fast on CPU); the kernel-equivalence
+  tests force the kernels through the Pallas interpreter with
+  ``interpret=True``.
 """
 
 from __future__ import annotations
@@ -32,6 +34,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from rafiki_tpu.ops.common import use_xla_fallback
 
 NEG_INF = -1e30
 # LSE written for rows whose every key is masked: exp(s - 1e30) == 0 for
@@ -384,7 +388,13 @@ def _flash_attention_bwd_impl(q, k, v, kv_lens, o, lse, g, sm_scale: float,
 
 def _attention_reference(q, k, v, sm_scale: float, causal: bool,
                          kv_lens=None):
-    """Pure-XLA attention (the correctness oracle for kernel tests)."""
+    """Pure-XLA attention (correctness oracle AND the off-TPU fast path).
+
+    Matches the kernels bit-for-behavior on fully masked rows too: a row
+    whose every key is masked (kv_len == 0) outputs exact zeros with zero
+    gradient, like the kernels' ``LSE_MASKED`` path — not softmax's
+    uniform-weights answer.
+    """
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * sm_scale
     s_q, s_k = s.shape[-2], s.shape[-1]
@@ -397,6 +407,9 @@ def _attention_reference(q, k, v, sm_scale: float, causal: bool,
         s = jnp.where(k_pos < jnp.asarray(kv_lens)[:, None, None, None],
                       s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    if kv_lens is not None:
+        nonempty = (jnp.asarray(kv_lens) > 0)[:, None, None, None]
+        p = jnp.where(nonempty, p, 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", p,
                       v.astype(jnp.float32)).astype(q.dtype)
 
@@ -411,8 +424,19 @@ def flash_attention(q, k, v, sm_scale: Optional[float] = None,
     valid length — the padding mask for BERT-style batches and bucketed
     continuous-batch serving. Differentiable end-to-end via the fused
     Pallas backward kernels.
+
+    Dispatch: with ``interpret=None`` (the default used by every model
+    template) the Pallas kernels run only on a real TPU backend; off-TPU
+    the call routes to the mathematically identical pure-XLA path, which
+    is orders of magnitude faster than the Pallas interpreter on CPU.
+    Pass ``interpret=True`` to force the kernels through the interpreter
+    (the kernel-equivalence tests do), or ``interpret=False`` for Mosaic
+    lowering.
     """
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if use_xla_fallback(interpret):
+        lens = None if kv_lens is None else jnp.asarray(kv_lens, jnp.int32)
+        return _attention_reference(q, k, v, scale, causal, lens)
     if kv_lens is None:
         return _flash_attention_full(q, k, v, scale, causal, block_q,
                                      block_k, interpret)

@@ -1,0 +1,22 @@
+"""Shared dispatch policy for the Pallas ops.
+
+One place decides when the kernels run vs the pure-XLA fallback so
+attention and patch-embed can't drift apart.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def use_xla_fallback(interpret: Optional[bool]) -> bool:
+    """True → run the mathematically equivalent pure-XLA path.
+
+    Policy: templates call ops with ``interpret=None``; off-TPU that means
+    the XLA path (the Pallas interpreter is orders of magnitude slower on
+    CPU and is exercised separately by the kernel-equivalence tests via
+    ``interpret=True``). On TPU, ``None`` means real Mosaic lowering.
+    """
+    return interpret is None and jax.default_backend() != "tpu"

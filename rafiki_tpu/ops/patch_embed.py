@@ -36,14 +36,23 @@ def _matmul_bias_kernel(x_ref, w_ref, b_ref, o_ref, *, n_k_blocks: int,
 def matmul_bias(x: jnp.ndarray, w: jnp.ndarray, b: jnp.ndarray,
                 block_m: int = 256, block_n: int = 256, block_k: int = 512,
                 interpret: Optional[bool] = None) -> jnp.ndarray:
-    """Tiled ``x @ w + b`` on the MXU; pads every dim to block multiples."""
+    """Tiled ``x @ w + b`` on the MXU; pads every dim to block multiples.
+
+    Off-TPU with ``interpret=None`` this routes to plain XLA ``x @ w + b``
+    (the interpreter is test-only, forced via ``interpret=True``).
+    """
     from jax.experimental import pallas as pl
 
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    from rafiki_tpu.ops.common import use_xla_fallback
+
     m, k = x.shape
     k2, n = w.shape
     assert k == k2 and b.shape == (n,), (x.shape, w.shape, b.shape)
+    if use_xla_fallback(interpret):
+        # f32 math like the kernel, cast back to the input dtype
+        return (x.astype(jnp.float32) @ w.astype(jnp.float32)
+                + b.astype(jnp.float32)).astype(x.dtype)
+    interpret = bool(interpret)
 
     block_m = min(block_m, max(8, m))
     block_n = min(block_n, max(128, n))

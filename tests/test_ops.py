@@ -1,4 +1,9 @@
-"""Pallas kernels vs pure-XLA oracles (interpreter mode on CPU)."""
+"""Pallas kernels vs pure-XLA oracles.
+
+Every call passes ``interpret=True`` explicitly: off-TPU the default
+dispatch in ``rafiki_tpu.ops`` routes to the XLA path (fast model tests),
+so equivalence tests must force the kernels through the interpreter to
+actually exercise them."""
 
 import jax
 import jax.numpy as jnp
@@ -21,7 +26,7 @@ def test_flash_attention_matches_reference(s_q, s_kv):
     q = _rand(2, 4, s_q, 64, key=0)
     k = _rand(2, 4, s_kv, 64, key=1)
     v = _rand(2, 4, s_kv, 64, key=2)
-    out = flash_attention(q, k, v)
+    out = flash_attention(q, k, v, interpret=True)
     ref = _attention_reference(q, k, v, 1.0 / np.sqrt(64), False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
@@ -31,7 +36,7 @@ def test_flash_attention_causal():
     q = _rand(1, 2, 130, 32, key=0)
     k = _rand(1, 2, 130, 32, key=1)
     v = _rand(1, 2, 130, 32, key=2)
-    out = flash_attention(q, k, v, None, True)
+    out = flash_attention(q, k, v, None, True, interpret=True)
     ref = _attention_reference(q, k, v, 1.0 / np.sqrt(32), True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
@@ -42,7 +47,7 @@ def test_flash_attention_kv_lens_matches_reference():
     k = _rand(3, 2, 96, 32, key=1)
     v = _rand(3, 2, 96, 32, key=2)
     lens = jnp.asarray([96, 17, 50], jnp.int32)
-    out = flash_attention(q, k, v, kv_lens=lens)
+    out = flash_attention(q, k, v, kv_lens=lens, interpret=True)
     ref = _attention_reference(q, k, v, 1.0 / np.sqrt(32), False, lens)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
@@ -55,7 +60,7 @@ def test_flash_attention_kv_lens_grads():
     lens = jnp.asarray([40, 9], jnp.int32)
 
     def f(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, kv_lens=lens) ** 2)
+        return jnp.sum(flash_attention(q, k, v, kv_lens=lens, interpret=True) ** 2)
 
     def f_ref(q, k, v):
         return jnp.sum(
@@ -79,7 +84,7 @@ def test_flash_attention_kv_lens_under_jit_and_causal():
 
     @jax.jit
     def run(q, k, v, lens):
-        return flash_attention(q, k, v, causal=True, kv_lens=lens)
+        return flash_attention(q, k, v, causal=True, kv_lens=lens, interpret=True)
 
     out = run(q, k, v, lens)
     ref = _attention_reference(q, k, v, 1.0 / np.sqrt(16), True, lens)
@@ -93,7 +98,7 @@ def test_flash_attention_grads():
     v = _rand(1, 2, 64, 32, key=2)
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v) ** 2)
+        return jnp.sum(flash_attention(q, k, v, interpret=True) ** 2)
 
     def loss_ref(q, k, v):
         return jnp.sum(
@@ -110,7 +115,7 @@ def test_flash_attention_bf16():
     q = _rand(1, 2, 128, 64, key=0, dtype=jnp.bfloat16)
     k = _rand(1, 2, 128, 64, key=1, dtype=jnp.bfloat16)
     v = _rand(1, 2, 128, 64, key=2, dtype=jnp.bfloat16)
-    out = flash_attention(q, k, v)
+    out = flash_attention(q, k, v, interpret=True)
     assert out.dtype == jnp.bfloat16
     ref = _attention_reference(q.astype(jnp.float32),
                                k.astype(jnp.float32),
@@ -131,7 +136,7 @@ def test_mha_layer_shapes():
         "bv": jnp.zeros(d_model), "bo": jnp.zeros(d_model),
     }
     x = _rand(2, 50, d_model, key=4)
-    out = mha(x, x, params, n_heads)
+    out = mha(x, x, params, n_heads, interpret=True)
     assert out.shape == (2, 50, d_model)
     assert np.all(np.isfinite(np.asarray(out)))
 
@@ -140,7 +145,7 @@ def test_matmul_bias():
     x = _rand(300, 200, key=0)
     w = _rand(200, 130, key=1)
     b = _rand(130, key=2)
-    out = matmul_bias(x, w, b)
+    out = matmul_bias(x, w, b, interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w + b),
                                atol=1e-4, rtol=1e-4)
 
@@ -159,7 +164,7 @@ def test_patch_embed_matches_conv():
     p, d = 8, 96
     w = _rand(p * p * 3, d, key=1) * 0.02
     b = _rand(d, key=2) * 0.01
-    out = patch_embed(imgs, w, b, p)
+    out = patch_embed(imgs, w, b, p, True)
     assert out.shape == (2, 16, d)
     # oracle: conv with stride=kernel=p
     wk = w.reshape(p, p, 3, d)
@@ -177,7 +182,7 @@ def test_patch_embed_grads():
     b = jnp.zeros(d)
 
     def loss_pe(imgs, w, b):
-        return jnp.sum(patch_embed(imgs, w, b, p) ** 2)
+        return jnp.sum(patch_embed(imgs, w, b, p, True) ** 2)
 
     def loss_ref(imgs, w, b):
         pt = extract_patches(imgs, p)
@@ -198,7 +203,7 @@ def test_flash_attention_grads_causal_multiblock():
     v = _rand(2, 2, 320, 32, key=12)
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+        return jnp.sum(flash_attention(q, k, v, causal=True, interpret=True) ** 2)
 
     def loss_ref(q, k, v):
         return jnp.sum(
@@ -220,7 +225,7 @@ def test_flash_attention_varlen_grads_multiblock_and_empty():
     lens = jnp.asarray([200, 131, 0], jnp.int32)
 
     def f(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, kv_lens=lens) ** 2)
+        return jnp.sum(flash_attention(q, k, v, kv_lens=lens, interpret=True) ** 2)
 
     def f_ref(q, k, v):
         return jnp.sum(
@@ -245,7 +250,7 @@ def test_flash_attention_bf16_grads():
     v = _rand(1, 2, 128, 64, key=18, dtype=jnp.bfloat16)
 
     def loss(q, k, v):
-        return jnp.sum(flash_attention(q, k, v).astype(jnp.float32) ** 2)
+        return jnp.sum(flash_attention(q, k, v, interpret=True).astype(jnp.float32) ** 2)
 
     g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
     gr = jax.grad(
@@ -284,3 +289,26 @@ def test_flash_attention_compiles_on_tpu():
     assert np.isfinite(float(val))
     for g_ in grads:
         assert np.all(np.isfinite(np.asarray(g_, np.float32)))
+
+
+def test_xla_fallback_empty_row_matches_kernels():
+    """Off-TPU default dispatch (pure-XLA path): kv_len==0 rows must output
+    exact zeros with zero grads, matching the kernels' LSE_MASKED path."""
+    q = _rand(2, 2, 32, 16, key=20)
+    k = _rand(2, 2, 32, 16, key=21)
+    v = _rand(2, 2, 32, 16, key=22)
+    lens = jnp.asarray([32, 0], jnp.int32)
+
+    def f(q, k, v):
+        # interpret unset -> XLA path on CPU (the model-template default)
+        return jnp.sum(flash_attention(q, k, v, kv_lens=lens) ** 2)
+
+    out = flash_attention(q, k, v, kv_lens=lens)
+    assert float(jnp.abs(out[1]).max()) == 0.0
+    g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    assert float(jnp.abs(g[1][1]).max()) == 0.0
+    assert float(jnp.abs(g[2][1]).max()) == 0.0
+    # and the nonempty example still matches the interpreter kernels
+    ker = flash_attention(q, k, v, kv_lens=lens, interpret=True)
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(ker[0]),
+                               atol=2e-5, rtol=2e-5)
