@@ -1,0 +1,257 @@
+"""Serving-side benchmarks — the SURVEY.md §6 / BASELINE.json metrics.
+
+Measures (one JSON line per metric, all emitted at the end):
+
+1. ``predictor_req_per_s`` + ``predictor_p50_ms`` — ViT-B/16 replicas
+   served through the real scatter/gather path (Predictor → QueueHub →
+   InferenceWorker.model.predict → ensemble), closed-loop clients.
+2. ``advisor_trials_per_hour`` — the in-process tune loop (MLP template,
+   config #1) measured for N trials and extrapolated.
+
+Same parent/child deadline architecture as ``bench.py``: accelerator
+work runs in a child streaming stage records to a file; the parent owns
+the clock and always prints parseable lines, rc=0. Run directly:
+
+    python bench_extra.py                 # accelerator (axon/TPU) or CPU
+    RAFIKI_BENCH_DEADLINE=600 python bench_extra.py
+
+The predictor leg uses the InProc hub by default (single-host fast
+path); ``--kv`` routes it through the native kv server instead (one
+``rafiki-kvd`` subprocess), which measures the cross-process transport.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+from _bench_common import (collect_errors, record as _record,
+                           run_with_cpu_fallback)
+
+DEADLINE = float(os.environ.get("RAFIKI_BENCH_DEADLINE", "480"))
+
+
+# ----------------------------------------------------------------- child
+
+def _bench_predictor(out_path: str, use_kv: bool, duration: float) -> None:
+    import threading
+
+    import numpy as np
+
+    from rafiki_tpu.models.vit import ViTBase16
+    from rafiki_tpu.serving.predictor import Predictor
+    from rafiki_tpu.serving.queues import InProcQueueHub
+    from rafiki_tpu.store.param_store import ParamStore
+    from rafiki_tpu.worker.inference import InferenceWorker
+
+    import jax
+
+    backend = jax.default_backend()
+    on_accel = backend not in ("cpu",)
+
+    # ViT-B/16 on the accelerator; a small ViT on CPU so the run finishes
+    knobs = {
+        "max_epochs": 1, "patch_size": 16 if on_accel else 8,
+        "hidden_dim": 768 if on_accel else 96,
+        "depth": 12 if on_accel else 2,
+        "n_heads": 12 if on_accel else 4,
+        "learning_rate": 1e-3, "weight_decay": 1e-4,
+        "batch_size": 32, "bf16": True,
+        "quick_train": True, "share_params": False,
+    }
+    img = 224 if on_accel else 64
+
+    # serving perf does not depend on trained weights: init-and-dump
+    model = ViTBase16(**knobs)
+    model._n_classes = 1000 if on_accel else 10
+    model._image_shape = [img, img, 3]
+    import jax.numpy as jnp
+
+    module = model._module()
+    model._params = module.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, img, img, 3),
+                  jnp.bfloat16 if knobs["bf16"] else jnp.float32))["params"]
+    blob = model.dump_parameters()
+
+    store = ParamStore.from_uri("mem://")
+    store.save("trial-bench", blob)
+
+    kvd = None
+    worker = None
+    try:
+        if use_kv:
+            from rafiki_tpu.native.client import KVServer
+            from rafiki_tpu.serving.queues import KVQueueHub
+
+            kvd = KVServer()
+            hub = KVQueueHub(kvd.host, kvd.port)
+        else:
+            hub = InProcQueueHub()
+
+        worker = InferenceWorker(ViTBase16, "trial-bench", knobs, store,
+                                 hub, worker_id="w0")
+        wt = threading.Thread(target=worker.run, daemon=True)
+        wt.start()
+
+        predictor = Predictor(hub, ["w0"], gather_timeout=30.0)
+
+        rng = np.random.default_rng(0)
+        query = rng.integers(0, 255, size=(img, img, 3), dtype=np.uint8)
+
+        # warm the serving path (compile happens in-worker on first
+        # predict)
+        preds, info = predictor.predict([query] * 8)
+        if not preds or preds[0] is None:
+            raise RuntimeError(f"warmup failed: {info}")
+        _record(out_path, {"stage": "predictor_warm", "backend": backend})
+
+        # closed-loop clients, batch of 8 queries per request
+        stop_at = time.monotonic() + duration
+        counts = {"req": 0, "q": 0}
+        lock = threading.Lock()
+
+        def client() -> None:
+            while time.monotonic() < stop_at:
+                p, _ = predictor.predict([query] * 8)
+                with lock:
+                    counts["req"] += 1
+                    counts["q"] += len(p)
+
+        clients = [threading.Thread(target=client, daemon=True)
+                   for _ in range(4)]
+        t0 = time.monotonic()
+        for c in clients:
+            c.start()
+        for c in clients:
+            c.join(timeout=duration + 30.0)
+        dt = time.monotonic() - t0
+    finally:
+        if worker is not None:
+            worker.stop()
+        if kvd is not None:
+            kvd.stop()
+
+    stats = predictor.stats()
+    _record(out_path, {
+        "stage": "predictor", "backend": backend,
+        "req_per_s": counts["req"] / dt,
+        "queries_per_s": counts["q"] / dt,
+        "p50_ms": stats["latency_p50_s"] * 1e3,
+        "p95_ms": stats["latency_p95_s"] * 1e3,
+        "model": "vit_b16" if on_accel else "vit_s64",
+    })
+
+
+def _bench_advisor(out_path: str, n_trials: int) -> None:
+    import tempfile
+
+    import jax
+
+    from rafiki_tpu.data import generate_image_classification_dataset
+    from rafiki_tpu.model import tune_model
+    from rafiki_tpu.models.mlp import JaxFeedForward
+
+    with tempfile.TemporaryDirectory() as d:
+        tr, va = f"{d}/tr.npz", f"{d}/va.npz"
+        generate_image_classification_dataset(tr, 512, seed=0)
+        generate_image_classification_dataset(va, 128, seed=1)
+        # one throwaway trial pays the first-compile cost
+        tune_model(JaxFeedForward, tr, va, total_trials=1,
+                   advisor_type="random")
+        t0 = time.monotonic()
+        res = tune_model(JaxFeedForward, tr, va, total_trials=n_trials,
+                         advisor_type="bayes_gp")
+        dt = time.monotonic() - t0
+    _record(out_path, {
+        "stage": "advisor", "backend": jax.default_backend(),
+        "trials_per_hour": n_trials / dt * 3600.0,
+        "n_trials": n_trials, "best_score": res.best_score,
+    })
+
+
+def _child(out_path: str, budget: float, use_kv: bool) -> None:
+    t_start = time.monotonic()
+
+    from rafiki_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()
+
+    import jax
+
+    jax.devices()  # force backend init inside the child's budget
+    _record(out_path, {"stage": "probe", "backend": jax.default_backend()})
+
+    try:
+        _bench_predictor(out_path, use_kv,
+                         duration=min(20.0, budget / 6.0))
+    except Exception as e:  # noqa: BLE001
+        _record(out_path, {"stage": "predictor_error",
+                           "error": repr(e)[:300]})
+
+    if budget - (time.monotonic() - t_start) > 60:
+        try:
+            _bench_advisor(out_path, n_trials=6)
+        except Exception as e:  # noqa: BLE001
+            _record(out_path, {"stage": "advisor_error",
+                               "error": repr(e)[:300]})
+    _record(out_path, {"stage": "done"})
+
+
+# ---------------------------------------------------------------- parent
+
+def main() -> None:
+    use_kv = "--kv" in sys.argv
+    t0 = time.monotonic()
+    out_path = os.path.abspath(f".benchx_stages_{os.getpid()}.jsonl")
+
+    def _no_results(records: list) -> bool:
+        return not any(r.get("stage") in ("predictor", "advisor")
+                       for r in records)
+
+    records, _fallback = run_with_cpu_fallback(
+        __file__, out_path, DEADLINE, time.monotonic, t0,
+        fallback_reserve=85.0, need_rerun=_no_results,
+        extra_args=["--kv"] if use_kv else None)
+
+    pred = next((r for r in records if r.get("stage") == "predictor"), None)
+    adv = next((r for r in records if r.get("stage") == "advisor"), None)
+    if pred:
+        print(json.dumps({
+            "metric": f"predictor_req_per_s_{pred['model']}",
+            "value": round(pred["req_per_s"], 2), "unit": "req/s",
+            "backend": pred["backend"],
+            "queries_per_s": round(pred["queries_per_s"], 2),
+            "p50_ms": round(pred["p50_ms"], 2),
+            "p95_ms": round(pred["p95_ms"], 2),
+            "transport": "kv" if use_kv else "inproc"}))
+    if adv:
+        print(json.dumps({
+            "metric": "advisor_trials_per_hour",
+            "value": round(adv["trials_per_hour"], 1),
+            "unit": "trials/hour", "backend": adv["backend"],
+            "n_trials": adv["n_trials"],
+            "best_score": adv["best_score"]}))
+    if not pred and not adv:
+        print(json.dumps({"metric": "bench_extra_error", "value": 0.0,
+                          "unit": "", "errors": collect_errors(records)}))
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        try:
+            _child(sys.argv[2], float(sys.argv[3]),
+                   use_kv="--kv" in sys.argv)
+        except Exception as e:  # noqa: BLE001
+            _record(sys.argv[2], {"stage": "child_error",
+                                  "error": repr(e)[:300]})
+            sys.exit(1)
+        sys.exit(0)
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({"metric": "bench_extra_error", "value": 0.0,
+                          "unit": "", "error": repr(e)[:300]}))
+        sys.exit(0)

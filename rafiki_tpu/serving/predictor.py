@@ -11,6 +11,8 @@ ensembled; zero arrivals is a 504.
 
 from __future__ import annotations
 
+import collections
+import math
 import threading
 import time
 import uuid
@@ -53,13 +55,20 @@ def ensemble_predictions(per_worker: List[List[Any]]) -> List[Any]:
 class Predictor:
     """Scatter/gather over inference workers + ensemble."""
 
+    #: bounded reservoir of recent request latencies; big enough for
+    #: stable p50/p95/p99, small enough to sort on every stats() call
+    LATENCY_WINDOW = 2048
+
     def __init__(self, hub: QueueHub, worker_ids: Sequence[str],
                  gather_timeout: float = 10.0) -> None:
         self.hub = hub
         self.worker_ids = list(worker_ids)
         self.gather_timeout = gather_timeout
         self._n_queries = 0
+        self._n_requests = 0
         self._latency_sum = 0.0
+        self._latencies: "collections.deque[float]" = collections.deque(
+            maxlen=self.LATENCY_WINDOW)
         self._lock = threading.Lock()
 
     def predict(self, queries: Sequence[Any],
@@ -91,16 +100,35 @@ class Predictor:
         latency = time.monotonic() - t0
         with self._lock:
             self._n_queries += len(queries)
+            self._n_requests += 1
             self._latency_sum += latency
+            self._latencies.append(latency)
         info = {"workers_answered": len(per_worker),
                 "workers_asked": len(self.worker_ids),
                 "latency_s": latency, "errors": errors}
         return ensemble_predictions(per_worker), info
 
     def stats(self) -> Dict[str, Any]:
+        """Counters + latency percentiles over the recent-request window
+        (the BASELINE p50 metric; surfaced in ``GET /health``)."""
         with self._lock:
-            return {"queries_served": self._n_queries,
-                    "latency_sum_s": self._latency_sum}
+            lat = sorted(self._latencies)
+            n_req = self._n_requests
+            n_q = self._n_queries
+            lat_sum = self._latency_sum
+
+        def pct(p: float) -> float:
+            # nearest-rank: ceil(p*n)-1, so p95 of 20 samples is the
+            # 19th-smallest, not the max
+            if not lat:
+                return 0.0
+            return lat[max(0, min(len(lat) - 1,
+                                  math.ceil(p * len(lat)) - 1))]
+
+        return {"queries_served": n_q, "requests_served": n_req,
+                "latency_sum_s": lat_sum, "latency_window_n": len(lat),
+                "latency_p50_s": pct(0.50), "latency_p95_s": pct(0.95),
+                "latency_p99_s": pct(0.99)}
 
 
 def _stack(queries: Sequence[Any]) -> Any:
