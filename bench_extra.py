@@ -145,6 +145,93 @@ def _bench_predictor(out_path: str, use_kv: bool, duration: float) -> None:
     })
 
 
+def _bench_generation(out_path: str, duration: float) -> None:
+    """Continuous-batch LM serving (BASELINE config #5): decode-loop
+    worker + predictor, overlapping clients, generation req/s and
+    tokens/s."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rafiki_tpu.models.llama_lora import LlamaLoRA
+    from rafiki_tpu.serving.predictor import Predictor
+    from rafiki_tpu.serving.queues import InProcQueueHub
+    from rafiki_tpu.store.param_store import ParamStore
+    from rafiki_tpu.worker.inference import InferenceWorker
+
+    backend = jax.default_backend()
+    on_accel = backend not in ("cpu",)
+    knobs = {
+        "max_epochs": 1, "vocab_size": 1 << 14,
+        "hidden_dim": 512 if on_accel else 64,
+        "depth": 8 if on_accel else 2,
+        "n_heads": 8 if on_accel else 4, "kv_ratio": 2,
+        "lora_rank": 8, "max_len": 128 if on_accel else 32,
+        "model_parallel": 1, "learning_rate": 1e-3, "batch_size": 8,
+        "quick_train": True, "share_params": False,
+    }
+    model = LlamaLoRA(**knobs)
+    module = model._module()
+    model._params = module.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    blob = model.dump_parameters()
+    store = ParamStore.from_uri("mem://")
+    store.save("trial-lm", blob)
+
+    hub = InProcQueueHub()
+    max_new = 16 if on_accel else 6
+    worker = InferenceWorker(LlamaLoRA, "trial-lm", knobs, store, hub,
+                             worker_id="w0", decode_loop=True,
+                             max_slots=8, max_new_tokens=max_new)
+    wt = threading.Thread(target=worker.run, daemon=True)
+    wt.start()
+    try:
+        predictor = Predictor(hub, ["w0"], gather_timeout=120.0)
+        preds, info = predictor.predict(["tok1 tok2 tok3"])  # warm/compile
+        if not preds or not preds[0]:
+            raise RuntimeError(f"generation warmup failed: {info}")
+        _record(out_path, {"stage": "generation_warm",
+                           "backend": backend})
+
+        stop_at = time.monotonic() + duration
+        counts = {"req": 0, "q": 0}
+        lock = threading.Lock()
+
+        def client(i: int) -> None:
+            prompt = f"tok{i} tok{i + 1} tok{i + 2}"
+            while time.monotonic() < stop_at:
+                p, _ = predictor.predict([prompt, prompt + " tokx"])
+                with lock:
+                    counts["req"] += 1
+                    counts["q"] += len(p)
+
+        clients = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(4)]
+        t0 = time.monotonic()
+        for c in clients:
+            c.start()
+        for c in clients:
+            c.join(timeout=duration + 60.0)
+        dt = time.monotonic() - t0
+    finally:
+        worker.stop()
+
+    stats = predictor.stats()
+    eng = worker.engine.stats
+    _record(out_path, {
+        "stage": "generation", "backend": backend,
+        "req_per_s": counts["req"] / dt,
+        "queries_per_s": counts["q"] / dt,
+        "tokens_per_s": eng["tokens_generated"] / dt,
+        "max_concurrent_slots": eng["max_concurrent"],
+        "p50_ms": stats["latency_p50_s"] * 1e3,
+        "max_new": max_new,
+        "model": "llama_512x8" if on_accel else "llama_64x2",
+    })
+
+
 def _bench_advisor(out_path: str, n_trials: int) -> None:
     import tempfile
 
@@ -186,10 +273,17 @@ def _child(out_path: str, budget: float, use_kv: bool) -> None:
 
     try:
         _bench_predictor(out_path, use_kv,
-                         duration=min(20.0, budget / 6.0))
+                         duration=min(20.0, budget / 8.0))
     except Exception as e:  # noqa: BLE001
         _record(out_path, {"stage": "predictor_error",
                            "error": repr(e)[:300]})
+
+    if budget - (time.monotonic() - t_start) > 90:
+        try:
+            _bench_generation(out_path, duration=min(20.0, budget / 8.0))
+        except Exception as e:  # noqa: BLE001
+            _record(out_path, {"stage": "generation_error",
+                               "error": repr(e)[:300]})
 
     if budget - (time.monotonic() - t_start) > 60:
         try:
@@ -208,8 +302,8 @@ def main() -> None:
     out_path = os.path.abspath(f".benchx_stages_{os.getpid()}.jsonl")
 
     def _no_results(records: list) -> bool:
-        return not any(r.get("stage") in ("predictor", "advisor")
-                       for r in records)
+        return not any(r.get("stage") in ("predictor", "generation",
+                                          "advisor") for r in records)
 
     records, _fallback = run_with_cpu_fallback(
         __file__, out_path, DEADLINE, time.monotonic, t0,
@@ -217,7 +311,17 @@ def main() -> None:
         extra_args=["--kv"] if use_kv else None)
 
     pred = next((r for r in records if r.get("stage") == "predictor"), None)
+    gen = next((r for r in records if r.get("stage") == "generation"), None)
     adv = next((r for r in records if r.get("stage") == "advisor"), None)
+    if gen:
+        print(json.dumps({
+            "metric": f"generation_req_per_s_{gen['model']}",
+            "value": round(gen["req_per_s"], 2), "unit": "req/s",
+            "backend": gen["backend"],
+            "tokens_per_s": round(gen["tokens_per_s"], 1),
+            "p50_ms": round(gen["p50_ms"], 2),
+            "max_concurrent_slots": gen["max_concurrent_slots"],
+            "max_new": gen["max_new"]}))
     if pred:
         print(json.dumps({
             "metric": f"predictor_req_per_s_{pred['model']}",
@@ -234,7 +338,7 @@ def main() -> None:
             "unit": "trials/hour", "backend": adv["backend"],
             "n_trials": adv["n_trials"],
             "best_score": adv["best_score"]}))
-    if not pred and not adv:
+    if not pred and not gen and not adv:
         print(json.dumps({"metric": "bench_extra_error", "value": 0.0,
                           "unit": "", "errors": collect_errors(records)}))
 

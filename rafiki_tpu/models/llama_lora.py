@@ -28,6 +28,7 @@ continuous-batch serving via Predictor"). TPU-first design notes:
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -125,11 +126,14 @@ class _DecoderAttention(nn.Module):
         rep = self.n_heads // self.n_kv_heads
 
         if decode:
-            # autoregressive path: append this step's k/v to the cache and
-            # attend the single query over all cached positions. The flax
-            # init pass also traces this branch — guard with has_variable
-            # so initialization only allocates zeros and never writes
-            # (otherwise decoding would start at idx=1 over a garbage row).
+            # autoregressive path: write this step's k/v into each
+            # example's OWN cache row at its OWN position (vectorized
+            # scatter), then attend the single query over that example's
+            # prefix. Per-slot positions are what continuous batching
+            # needs — slots admitted mid-flight run at different depths
+            # in the same compiled step. The flax init pass also traces
+            # this branch — guard with has_variable so initialization
+            # only allocates zeros and never writes.
             is_live = self.has_variable("cache", "k")
             ck = self.variable("cache", "k", jnp.zeros,
                                (b, self.max_len, self.n_kv_heads, dh),
@@ -137,8 +141,6 @@ class _DecoderAttention(nn.Module):
             cv = self.variable("cache", "v", jnp.zeros,
                                (b, self.max_len, self.n_kv_heads, dh),
                                x.dtype)
-            idx = self.variable("cache", "idx",
-                                lambda: jnp.zeros((), jnp.int32))
             if not is_live:
                 # init trace: local attention for output shape only
                 kk = jnp.repeat(k, rep, axis=2)
@@ -147,17 +149,17 @@ class _DecoderAttention(nn.Module):
                 probs = jax.nn.softmax(scores.astype(jnp.float32), -1)
                 o = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(x.dtype), vv)
             else:
-                t = idx.value
-                ck.value = jax.lax.dynamic_update_slice(ck.value, k,
-                                                        (0, t, 0, 0))
-                cv.value = jax.lax.dynamic_update_slice(cv.value, v,
-                                                        (0, t, 0, 0))
-                idx.value = t + s
+                assert s == 1, "decode mode steps one token per slot"
+                t = positions[:, 0]  # (b,) — per-slot write index
+                rows = jnp.arange(b)
+                ck.value = ck.value.at[rows, t].set(k[:, 0])
+                cv.value = cv.value.at[rows, t].set(v[:, 0])
                 kk = jnp.repeat(ck.value, rep, axis=2)
                 vv = jnp.repeat(cv.value, rep, axis=2)
                 scores = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / np.sqrt(dh)
                 k_pos = jnp.arange(self.max_len)[None, None, None, :]
-                scores = jnp.where(k_pos <= t, scores, -1e30)
+                scores = jnp.where(k_pos <= t[:, None, None, None],
+                                   scores, -1e30)
                 probs = jax.nn.softmax(scores.astype(jnp.float32), -1)
                 o = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(x.dtype),
                                vv)
@@ -257,20 +259,13 @@ def lora_trainable_mask(params: Any) -> Any:
     return jax.tree_util.tree_map_with_path(trainable, params)
 
 
-def greedy_generate(module: Llama, params: Any, prompt_ids: np.ndarray,
-                    prompt_lens: np.ndarray, max_new: int) -> jnp.ndarray:
-    """Greedy decode: scan one compiled cache step over prompt+generation.
-
-    ``prompt_ids`` (b, P) left-aligned with PAD tails; each example starts
-    generating right after its own last prompt token, so pads never enter
-    the cache. Returns (b, max_new) generated ids.
-    """
-    b, p_len = prompt_ids.shape
+@functools.partial(jax.jit, static_argnums=(0, 4))
+def _greedy_generate_impl(module: Llama, params: Any, prompt: jnp.ndarray,
+                          plens: jnp.ndarray, max_new: int) -> jnp.ndarray:
+    b, p_len = prompt.shape
     total = p_len + max_new
     cache = module.init(jax.random.PRNGKey(0),
                         jnp.zeros((b, 1), jnp.int32), decode=True)["cache"]
-    prompt = jnp.asarray(prompt_ids)
-    plens = jnp.asarray(prompt_lens)
 
     def step(carry, t):
         cache, tok = carry
@@ -293,6 +288,25 @@ def greedy_generate(module: Llama, params: Any, prompt_ids: np.ndarray,
     gather = (plens[:, None] - 1) + jnp.arange(max_new)[None, :]
     gather = jnp.clip(gather, 0, total - 2)
     return jnp.take_along_axis(outs, gather, axis=1)
+
+
+def greedy_generate(module: Llama, params: Any, prompt_ids: np.ndarray,
+                    prompt_lens: np.ndarray, max_new: int) -> jnp.ndarray:
+    """Greedy decode: scan one compiled cache step over prompt+generation.
+
+    ``prompt_ids`` (b, P) left-aligned with PAD tails; each example starts
+    generating right after its own last prompt token, so pads never enter
+    the cache. Returns (b, max_new) generated ids.
+
+    Compiled ONCE per (module config, batch, prompt width, max_new):
+    ``module`` and ``max_new`` ride as static jit args, so repeated
+    serving calls at bucketed shapes hit the executable cache instead of
+    re-tracing the scan (the round-1/round-2 compile-per-request bug).
+    """
+    return _greedy_generate_impl(module, params,
+                                 jnp.asarray(prompt_ids, jnp.int32),
+                                 jnp.asarray(prompt_lens, jnp.int32),
+                                 int(max_new))
 
 
 class LlamaLoRA(BaseModel):
@@ -480,7 +494,11 @@ class LlamaLoRA(BaseModel):
     def predict(self, queries: Sequence[Any],
                 max_new_tokens: int = 8) -> List[Any]:
         """Greedy continuations, detokenized via the learned id→token
-        table (unknown ids render as ``<id>``)."""
+        table (unknown ids render as ``<id>``).
+
+        The batch dim is padded up to a power-of-two bucket so repeated
+        serving calls reuse the compiled generate (static module +
+        max_new, bucketed (b, prompt) shapes → executable-cache hits)."""
         assert self._params is not None, "model is not trained/loaded"
         texts = [q if isinstance(q, str) else str(q) for q in queries]
         max_len = int(self.knobs["max_len"])
@@ -488,11 +506,44 @@ class LlamaLoRA(BaseModel):
         max_new = min(max_new_tokens, max_len - 1)
         prompt_cap = max(1, max_len - max_new)
         ids, lens = self.tokenizer.encode_batch(texts, prompt_cap)
+        n = len(texts)
+        bucket = 1 << max(0, (n - 1).bit_length())  # next power of two
+        if bucket > n:  # pad rows are BOS-only prompts, discarded below
+            ids = np.concatenate(
+                [ids, np.full((bucket - n, ids.shape[1]), 0, ids.dtype)])
+            ids[n:, 0] = BOS_ID
+            lens = np.concatenate(
+                [lens, np.ones((bucket - n,), lens.dtype)])
         module = self._module()
         out = np.asarray(greedy_generate(module, self._params, ids, lens,
-                                         max_new))
-        return [" ".join(self._id2tok.get(int(t), f"<{int(t)}>")
-                         for t in row) for row in out]
+                                         max_new))[:n]
+        return [self._detok(row) for row in out]
+
+    def _detok(self, ids: Sequence[Any]) -> str:
+        """Render generated ids via the learned id→token table (hashing
+        is one-way; unknown ids render as ``<id>``)."""
+        return " ".join(self._id2tok.get(int(t), f"<{int(t)}>")
+                        for t in ids)
+
+    def make_decode_engine(self, max_slots: int = 8,
+                           max_new_tokens: int = 8):
+        """Continuous-batching serving engine over this model's weights
+        (BASELINE.md config #5). The inference worker drives it when
+        running in decode-loop mode; see ``serving/decode_engine.py``."""
+        from rafiki_tpu.serving.decode_engine import (DecodeEngine,
+                                                      TextDecodeEngine)
+
+        assert self._params is not None, "model is not trained/loaded"
+        max_len = int(self.knobs["max_len"])
+
+        def encode(text: str) -> np.ndarray:
+            row, n = self.tokenizer.encode(str(text), max_len)
+            return row[:max(1, int(n))]
+
+        core = DecodeEngine(self._module(), self._params,
+                            max_slots=max_slots, max_len=max_len)
+        return TextDecodeEngine(core, encode, self._detok,
+                                max_new=min(max_new_tokens, max_len - 1))
 
     def dump_parameters(self) -> Dict[str, Any]:
         assert self._params is not None, "model is not trained"

@@ -1,0 +1,214 @@
+"""Continuous-batching decode engine for causal-LM serving.
+
+Parity target: BASELINE.md config #5's "continuous-batch serving via
+Predictor". The reference serves classifications by batching queued
+queries per forward (SURVEY.md §3.3); generation needs more — requests
+of different lengths must share the accelerator *mid-flight*. TPU-first
+design:
+
+- **One compiled step, fixed slots.** The engine owns a KV cache with
+  ``max_slots`` rows and steps ALL slots in one jitted program per
+  token. Static shapes: admission/completion never recompiles anything —
+  a new request just changes the host-side slot table and the (tiny)
+  per-slot token/position vectors fed each step.
+- **Per-slot positions.** Each slot runs at its own depth (one mid-
+  prompt, one mid-generation); the decoder writes each slot's KV at its
+  own index (``models/llama_lora.py`` ``_DecoderAttention`` decode
+  branch) and masks keys past it, so stale cache rows from a previous
+  occupant are unreachable (a fresh slot starts at position 0).
+- **Admission at step boundaries.** Between steps the host pulls queued
+  requests into free slots: unified prefill/decode — a slot consumes
+  its prompt token-by-token through the same step program, then flips
+  to feeding back its own argmax. That is lockstep continuous batching:
+  no separate prefill program, no pipeline bubble between phases.
+- Completed slots detokenize/reply and free immediately; the step loop
+  only runs while any slot is live, so an idle engine costs nothing.
+
+The engine is token-level and model-agnostic: it needs a flax module
+with the ``decode=True`` cache protocol. Text encode/detok is the
+caller's job (``LlamaLoRA.make_decode_engine`` wires its tokenizer).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class _Slot:
+    request_id: Any
+    prompt: np.ndarray          # (p,) int32, valid tokens only
+    max_new: int
+    n_consumed: int = 0         # tokens fed to the model so far
+    generated: List[int] = field(default_factory=list)
+
+
+class DecodeEngine:
+    """Slot-based continuous batching over one compiled decode step."""
+
+    def __init__(self, module: Any, params: Any, max_slots: int,
+                 max_len: int) -> None:
+        self.module = module
+        self.params = params
+        self.B = int(max_slots)
+        self.L = int(max_len)
+        self._slots: List[Optional[_Slot]] = [None] * self.B
+        self._queue: List[_Slot] = []
+        self._done: List[Tuple[Any, List[int]]] = []
+        self._lock = threading.Lock()
+        # host mirrors of the per-slot device inputs
+        self._tok = np.zeros((self.B,), np.int32)
+        self._pos = np.zeros((self.B,), np.int32)
+        self._cache = module.init(
+            jax.random.PRNGKey(0), jnp.zeros((self.B, 1), jnp.int32),
+            decode=True)["cache"]
+        self._step_fn = _make_step(module, self.B)
+        self.stats: Dict[str, int] = {
+            "steps": 0, "tokens_generated": 0, "requests_done": 0,
+            "max_concurrent": 0}
+
+    # ---- submission / results (thread-safe: worker loop vs callers) ----
+    def submit(self, request_id: Any, prompt_ids: np.ndarray,
+               max_new: int) -> None:
+        """Queue a request. ``prompt_ids``: 1-D valid tokens (≥1); the
+        prompt + generation must fit the cache (truncated to fit)."""
+        prompt = np.asarray(prompt_ids, np.int32).ravel()
+        max_new = max(1, min(int(max_new), self.L - 1))
+        prompt = prompt[:max(1, self.L - max_new)]
+        with self._lock:
+            self._queue.append(_Slot(request_id, prompt, max_new))
+
+    def poll(self) -> List[Tuple[Any, List[int]]]:
+        """Completed (request_id, generated ids) since the last poll."""
+        with self._lock:
+            done, self._done = self._done, []
+        return done
+
+    @property
+    def busy(self) -> bool:
+        with self._lock:
+            return bool(self._queue) or any(s is not None
+                                            for s in self._slots)
+
+    def reset(self) -> None:
+        """Drop all occupants and rebuild device state. For error
+        recovery: a step that raised may have consumed the donated cache
+        buffer, so the old cache must not be touched again."""
+        with self._lock:
+            self._slots = [None] * self.B
+            self._queue.clear()
+            self._done.clear()
+        self._tok[:] = 0
+        self._pos[:] = 0
+        self._cache = self.module.init(
+            jax.random.PRNGKey(0), jnp.zeros((self.B, 1), jnp.int32),
+            decode=True)["cache"]
+
+    # ---- the loop body ----
+    def step(self) -> int:
+        """Admit queued requests into free slots, run ONE compiled step
+        for every live slot, harvest completions. Returns live count."""
+        with self._lock:
+            for i in range(self.B):
+                if self._slots[i] is None and self._queue:
+                    slot = self._queue.pop(0)
+                    self._slots[i] = slot
+                    self._tok[i] = slot.prompt[0]
+                    self._pos[i] = 0
+            live = [i for i in range(self.B) if self._slots[i] is not None]
+            self.stats["max_concurrent"] = max(self.stats["max_concurrent"],
+                                               len(live))
+        if not live:
+            return 0
+
+        self._cache, nxt = self._step_fn(
+            self.params, self._cache, jnp.asarray(self._tok),
+            jnp.asarray(self._pos))
+        nxt = np.asarray(nxt)
+        self.stats["steps"] += 1
+
+        finished: List[Tuple[Any, List[int]]] = []
+        for i in live:
+            slot = self._slots[i]
+            slot.n_consumed += 1
+            if slot.n_consumed < len(slot.prompt):
+                # still prefilling: feed the next prompt token
+                self._tok[i] = slot.prompt[slot.n_consumed]
+            else:
+                # generating: the model's output becomes the next input
+                slot.generated.append(int(nxt[i]))
+                self.stats["tokens_generated"] += 1
+                self._tok[i] = nxt[i]
+            self._pos[i] += 1
+            if (len(slot.generated) >= slot.max_new
+                    or int(self._pos[i]) >= self.L):
+                finished.append((slot.request_id, slot.generated))
+                self._slots[i] = None
+                self._tok[i] = 0
+                self._pos[i] = 0  # fresh occupant restarts at position 0
+        if finished:
+            with self._lock:
+                self._done.extend(finished)
+                self.stats["requests_done"] += len(finished)
+        return len(live)
+
+
+@functools.lru_cache(maxsize=8)
+def _make_step(module: Any, n_slots: int) -> Callable:
+    """One compiled decode step over all slots (cache donated in-place)."""
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def step_fn(params, cache, tok, pos):
+        logits, muts = module.apply(
+            {"params": params, "cache": cache}, tok[:, None],
+            positions=pos[:, None], decode=True, mutable=["cache"])
+        nxt = jnp.argmax(logits[:, -1].astype(jnp.float32), -1)
+        return muts["cache"], nxt.astype(jnp.int32)
+
+    return step_fn
+
+
+class TextDecodeEngine:
+    """Text-level wrapper: encode prompts, detokenize completions.
+
+    ``encode(text) -> 1-D int32 ids`` and ``decode(ids) -> text`` come
+    from the owning model template (see ``LlamaLoRA.make_decode_engine``).
+    """
+
+    def __init__(self, engine: DecodeEngine,
+                 encode: Callable[[str], np.ndarray],
+                 decode: Callable[[List[int]], str],
+                 max_new: int = 8) -> None:
+        self.engine = engine
+        self._encode = encode
+        self._decode = decode
+        self.max_new = int(max_new)
+
+    def submit(self, request_id: Any, text: str,
+               max_new: Optional[int] = None) -> None:
+        self.engine.submit(request_id, self._encode(text),
+                           self.max_new if max_new is None else max_new)
+
+    def poll(self) -> List[Tuple[Any, str]]:
+        return [(rid, self._decode(ids)) for rid, ids in self.engine.poll()]
+
+    def step(self) -> int:
+        return self.engine.step()
+
+    def reset(self) -> None:
+        self.engine.reset()
+
+    @property
+    def busy(self) -> bool:
+        return self.engine.busy
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        return self.engine.stats

@@ -136,9 +136,11 @@ def _stack(queries: Sequence[Any]) -> Any:
     a list for ragged/object queries."""
     try:
         arrs = [np.asarray(q) for q in queries]
+        # numeric/bool only: unicode/bytes/object arrays don't survive
+        # the msgpack pytree codec (text queries ship as plain lists)
         if arrs and all(a.shape == arrs[0].shape and
                         a.dtype == arrs[0].dtype and
-                        a.dtype != object for a in arrs):
+                        a.dtype.kind not in "USO" for a in arrs):
             return np.stack(arrs)
     except (TypeError, ValueError):
         pass

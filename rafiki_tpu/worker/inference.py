@@ -5,12 +5,22 @@ boot by loading a trial's parameters from the ParamStore, then loop —
 block-pop the query queue, batch what's pending, run ``model.predict``,
 push predictions keyed by query id.
 
-TPU-first delta: opportunistic micro-batching. After a blocking pop the
-worker drains whatever else is queued (up to ``max_batch_msgs``) and runs
-one forward over the union — on TPU the forward is a compiled program whose
-cost is dominated by launch + HBM traffic, so batching waiting queries is
-nearly free throughput. Static-shape padding happens inside the template's
-``predict`` (bucketed), not here.
+TPU-first deltas:
+
+- **Opportunistic micro-batching** (classification path): after a
+  blocking pop the worker drains whatever else is queued (up to
+  ``max_batch_msgs``) and runs one forward over the union — on TPU the
+  forward is a compiled program whose cost is dominated by launch + HBM
+  traffic, so batching waiting queries is nearly free throughput.
+  Static-shape padding happens inside the template's ``predict``
+  (bucketed), not here.
+- **Continuous-batching decode loop** (generation path, BASELINE.md
+  config #5): when constructed with ``decode_loop=True`` and the model
+  exposes ``make_decode_engine`` (e.g. ``LlamaLoRA``), the worker runs
+  a slot-based decode loop instead — new requests are admitted into
+  free KV-cache slots at step boundaries while earlier requests are
+  mid-generation, and replies go out per-message as each message's
+  queries all complete.
 """
 
 from __future__ import annotations
@@ -29,7 +39,9 @@ from ..store.param_store import ParamStore
 class InferenceWorker:
     def __init__(self, model_class: Type[BaseModel], trial_id: str,
                  knobs: dict, param_store: ParamStore, hub: QueueHub,
-                 worker_id: str, max_batch_msgs: int = 16) -> None:
+                 worker_id: str, max_batch_msgs: int = 16,
+                 decode_loop: bool = False, max_slots: int = 8,
+                 max_new_tokens: int = 8) -> None:
         self.worker_id = worker_id
         self.hub = hub
         self.max_batch_msgs = max_batch_msgs
@@ -39,6 +51,14 @@ class InferenceWorker:
         if params is None:
             raise KeyError(f"no parameters for trial {trial_id!r}")
         self.model.load_parameters(params)
+        self.engine = None
+        if decode_loop:
+            if not hasattr(self.model, "make_decode_engine"):
+                raise TypeError(
+                    f"{model_class.__name__} has no make_decode_engine; "
+                    "decode_loop mode needs a generative template")
+            self.engine = self.model.make_decode_engine(
+                max_slots=max_slots, max_new_tokens=max_new_tokens)
 
     def stop(self) -> None:
         self._stop.set()
@@ -46,6 +66,8 @@ class InferenceWorker:
     # ---- the loop ----
     def run(self, poll_timeout: float = 0.5,
             max_iterations: Optional[int] = None) -> None:
+        if self.engine is not None:
+            return self._run_decode_loop(poll_timeout, max_iterations)
         n = 0
         while not self._stop.is_set():
             if max_iterations is not None and n >= max_iterations:
@@ -61,6 +83,66 @@ class InferenceWorker:
                     break
                 messages.append(unpack_message(more))
             self._serve_batch(messages)
+
+    def _run_decode_loop(self, poll_timeout: float,
+                         max_iterations: Optional[int]) -> None:
+        """Continuous batching: admit queued messages into engine slots
+        between steps; reply per message once all its queries finish.
+
+        One loop iteration = (drain the queue, admit, one engine step,
+        harvest). While the engine is busy the queue pop is non-blocking
+        so decoding never stalls on an empty queue."""
+        # message id -> [n_pending, {query_index: text}]
+        inflight: dict = {}
+        n = 0
+        while not self._stop.is_set():
+            if max_iterations is not None and n >= max_iterations:
+                break
+            n += 1
+            busy = self.engine.busy
+            raw = self.hub.pop_query(self.worker_id,
+                                     0.0 if busy else poll_timeout)
+            while raw is not None:
+                m = unpack_message(raw)
+                qs = m["queries"]
+                qs = list(qs) if not isinstance(qs, (list, tuple)) else qs
+                if not qs:  # answer empty messages immediately, like
+                    # _serve_batch does — nothing will ever poll() for them
+                    self.hub.push_prediction(m["id"], pack_message(
+                        {"id": m["id"], "worker_id": self.worker_id,
+                         "predictions": []}))
+                else:
+                    inflight[m["id"]] = [len(qs), {}]
+                    for qi, text in enumerate(qs):
+                        self.engine.submit((m["id"], qi), str(text))
+                raw = self.hub.pop_query(self.worker_id, 0.0)
+            if not self.engine.busy:
+                continue
+            try:
+                self.engine.step()
+            except Exception:
+                err = traceback.format_exc()
+                for mid in list(inflight):
+                    self.hub.push_prediction(mid, pack_message(
+                        {"id": mid, "worker_id": self.worker_id,
+                         "predictions": [], "error": err}))
+                    del inflight[mid]
+                # a failed step may have consumed the donated cache:
+                # drop every occupant and rebuild device state, or the
+                # loop hot-spins on a permanently broken engine
+                self.engine.reset()
+                continue
+            for (mid, qi), text in self.engine.poll():
+                entry = inflight.get(mid)
+                if entry is None:
+                    continue
+                entry[1][qi] = text
+                if len(entry[1]) >= entry[0]:
+                    preds = [entry[1].get(i) for i in range(entry[0])]
+                    self.hub.push_prediction(mid, pack_message(
+                        {"id": mid, "worker_id": self.worker_id,
+                         "predictions": preds}))
+                    del inflight[mid]
 
     def _serve_batch(self, messages: List[dict]) -> None:
         # flatten all messages' queries into one forward pass
@@ -129,7 +211,10 @@ def main(argv: Optional[list] = None) -> int:
         knobs=cfg.get("knobs", {}),
         param_store=ParamStore.from_uri(cfg["param_store_uri"]),
         hub=KVQueueHub(cfg["kv_host"], int(cfg["kv_port"])),
-        worker_id=cfg["worker_id"])
+        worker_id=cfg["worker_id"],
+        decode_loop=bool(cfg.get("decode_loop")),
+        max_slots=int(cfg.get("max_slots", 8)),
+        max_new_tokens=int(cfg.get("max_new_tokens", 8)))
     print(f"inference worker {worker.worker_id} serving", flush=True)
     worker.run()
     return 0
