@@ -50,13 +50,15 @@ def batch_iterator(arrays: Dict[str, np.ndarray], batch_size: int,
 
 
 def prefetch_to_device(iterator: Iterator[Any], size: int = 2,
-                       devices: Optional[Sequence[Any]] = None
-                       ) -> Iterator[Any]:
+                       devices: Optional[Sequence[Any]] = None,
+                       sharding: Optional[Any] = None) -> Iterator[Any]:
     """Double-buffer host batches onto device ahead of compute.
 
-    With ``devices`` given, the batch is replicated/placed via
-    ``jax.device_put`` on the first device (per-trial sub-meshes place
-    explicitly via shardings; this path is the single-device fast path).
+    With ``sharding`` given (e.g. a batch-axis ``NamedSharding``), every
+    leaf of the batch pytree is placed with it — the template train loops
+    use this so host→HBM transfer of batch k+1 overlaps the compiled
+    step on batch k. With ``devices``, placement is on the first device
+    (single-device fast path). With neither, the default device.
     """
     import collections
 
@@ -66,6 +68,8 @@ def prefetch_to_device(iterator: Iterator[Any], size: int = 2,
     device = devices[0] if devices else None
 
     def _put(batch: Any) -> Any:
+        if sharding is not None:
+            return jax.device_put(batch, sharding)
         if device is not None:
             return jax.device_put(batch, device)
         return jax.device_put(batch)

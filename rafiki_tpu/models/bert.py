@@ -18,6 +18,7 @@ the Advisor"). TPU-first design notes:
 
 from __future__ import annotations
 
+import functools
 import hashlib
 import re
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -30,7 +31,7 @@ from flax import linen as nn
 
 from rafiki_tpu.constants import TaskType
 from rafiki_tpu.data import batch_iterator, \
-    load_text_classification_dataset
+    load_text_classification_dataset, prefetch_to_device
 from rafiki_tpu.model import (BaseModel, CategoricalKnob, FixedKnob,
                               FloatKnob, IntegerKnob, KnobConfig, PolicyKnob,
                               TrainContext, bucketed_forward,
@@ -241,7 +242,8 @@ class BertClassifier(BaseModel):
         params = jax.device_put(params, r_shard)
         opt_state = jax.device_put(tx.init(params), r_shard)
 
-        @jax.jit
+        # donate the param/opt trees: in-place update, no per-step copies
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
         def train_step(params, opt_state, ib, lb, yb, mask):
             def loss_fn(p):
                 logits = module.apply({"params": p}, ib, lb)
@@ -255,21 +257,28 @@ class BertClassifier(BaseModel):
             return optax.apply_updates(params, updates), opt_state, loss
 
         ctx.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
+        # donation invalidates buffers that may alias self._params (warm
+        # start / re-train): drop the stale reference first
+        self._params = None
         with mesh:
             for epoch in range(epochs):
                 losses = []
-                for batch in batch_iterator(
-                        {"ids": ids, "lens": lens, "y": y}, batch_size,
-                        seed=epoch):
-                    ib = jax.device_put(batch["ids"], b_shard)
-                    lb = jax.device_put(batch["lens"], b_shard)
-                    yb = jax.device_put(batch["y"], b_shard)
-                    mb = jax.device_put(batch["mask"].astype(np.float32),
-                                        b_shard)
+                batches = prefetch_to_device(
+                    ({"ids": b["ids"], "lens": b["lens"], "y": b["y"],
+                      "m": b["mask"].astype(np.float32)}
+                     for b in batch_iterator(
+                         {"ids": ids, "lens": lens, "y": y}, batch_size,
+                         seed=epoch)),
+                    sharding=b_shard)
+                for batch in batches:
                     params, opt_state, loss = train_step(
-                        params, opt_state, ib, lb, yb, mb)
-                    losses.append(float(loss))
-                mean_loss = float(np.mean(losses))
+                        params, opt_state, batch["ids"], batch["lens"],
+                        batch["y"], batch["m"])
+                    # device scalar; bounded run-ahead (see vit.py note)
+                    losses.append(loss)
+                    if len(losses) % 8 == 0:
+                        jax.block_until_ready(loss)
+                mean_loss = float(np.mean([float(l) for l in losses]))
                 ctx.logger.log(epoch=epoch, loss=mean_loss)
                 if ctx.should_continue is not None and \
                         not ctx.should_continue(epoch, -mean_loss):

@@ -39,7 +39,7 @@ from flax import linen as nn
 
 from rafiki_tpu.constants import TaskType
 from rafiki_tpu.data import batch_iterator, \
-    load_text_classification_dataset
+    load_text_classification_dataset, prefetch_to_device
 from rafiki_tpu.model import (BaseModel, CategoricalKnob, FixedKnob,
                               FloatKnob, IntegerKnob, KnobConfig, PolicyKnob,
                               TrainContext, same_tree_shapes)
@@ -426,7 +426,8 @@ class LlamaLoRA(BaseModel):
                 lora_trainable_mask(p)))
         opt_state = tx.init(params)
 
-        @jax.jit
+        # donate the param/opt trees: in-place update, no per-step copies
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
         def train_step(params, opt_state, ib, lb, mask):
             def loss_fn(p):
                 logits = module.apply({"params": p}, ib, lens=lb)
@@ -442,19 +443,27 @@ class LlamaLoRA(BaseModel):
         if self.knobs.get("quick_train"):
             epochs = min(epochs, 2)
         ctx.logger.define_plot("LM loss", ["loss"], x_axis="epoch")
+        # donation invalidates buffers that may alias self._params (warm
+        # start / re-train): drop the stale reference first
+        self._params = None
         with mesh:
             for epoch in range(epochs):
                 losses = []
-                for batch in batch_iterator({"ids": ids, "lens": lens},
-                                            batch_size, seed=epoch):
-                    ib = jax.device_put(batch["ids"], b_shard)
-                    lb = jax.device_put(batch["lens"], b_shard)
-                    mb = jax.device_put(batch["mask"].astype(np.float32),
-                                        b_shard)
-                    params, opt_state, loss = train_step(params, opt_state,
-                                                         ib, lb, mb)
-                    losses.append(float(loss))
-                mean_loss = float(np.mean(losses))
+                batches = prefetch_to_device(
+                    ({"ids": b["ids"], "lens": b["lens"],
+                      "m": b["mask"].astype(np.float32)}
+                     for b in batch_iterator({"ids": ids, "lens": lens},
+                                             batch_size, seed=epoch)),
+                    sharding=b_shard)
+                for batch in batches:
+                    params, opt_state, loss = train_step(
+                        params, opt_state, batch["ids"], batch["lens"],
+                        batch["m"])
+                    # device scalar; bounded run-ahead (see vit.py note)
+                    losses.append(loss)
+                    if len(losses) % 8 == 0:
+                        jax.block_until_ready(loss)
+                mean_loss = float(np.mean([float(l) for l in losses]))
                 ctx.logger.log(epoch=epoch, loss=mean_loss)
                 if ctx.should_continue is not None and \
                         not ctx.should_continue(epoch, -mean_loss):

@@ -10,6 +10,7 @@ TPU sub-mesh unchanged.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -107,7 +108,8 @@ class JaxFeedForward(BaseModel):
         tx = optax.adam(float(self.knobs["learning_rate"]))
         opt_state = tx.init(params)
 
-        @jax.jit
+        # donate the param/opt trees: in-place update, no per-step copies
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
         def train_step(params, opt_state, xb, yb, mask):
             def loss_fn(p):
                 logits = module.apply({"params": p}, xb)
@@ -122,6 +124,9 @@ class JaxFeedForward(BaseModel):
         epochs = max(1, round(int(self.knobs["max_epochs"])
                               * float(ctx.budget_scale)))
         ctx.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
+        # donation invalidates buffers that may alias self._params (warm
+        # start / re-train): drop the stale reference first
+        self._params = None
         for epoch in range(epochs):
             losses = []
             for batch in batch_iterator({"x": x, "y": y}, batch_size,

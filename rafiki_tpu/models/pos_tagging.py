@@ -15,6 +15,7 @@ Queries for both: a list of token lists → list of tag-name lists.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -253,7 +254,8 @@ class BiLSTMTagger(BaseModel):
         tx = optax.adam(float(self.knobs["learning_rate"]))
         opt_state = tx.init(params)
 
-        @jax.jit
+        # donate the param/opt trees: in-place update, no per-step copies
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
         def train_step(params, opt_state, ib, lb, tb, mask):
             def loss_fn(p):
                 logits = module.apply({"params": p}, ib, lb)
@@ -275,6 +277,9 @@ class BiLSTMTagger(BaseModel):
             epochs = min(epochs, 2)
         batch_size = int(self.knobs["batch_size"])
         ctx.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
+        # donation invalidates buffers that may alias self._params (warm
+        # start / re-train): drop the stale reference first
+        self._params = None
         for epoch in range(epochs):
             losses = []
             for b in batch_iterator({"i": ids, "l": lens, "t": tags},

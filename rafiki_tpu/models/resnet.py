@@ -30,7 +30,7 @@ from flax import linen as nn
 
 from rafiki_tpu.constants import TaskType
 from rafiki_tpu.data import batch_iterator, \
-    load_image_classification_dataset
+    load_image_classification_dataset, prefetch_to_device
 from rafiki_tpu.model import (BaseModel, CategoricalKnob, FixedKnob,
                               FloatKnob, KnobConfig, PolicyKnob,
                               TrainContext, bucketed_forward, conform_images,
@@ -256,7 +256,9 @@ class ResNetClassifier(BaseModel):
         batch_stats = jax.device_put(variables["batch_stats"], r_shard)
         opt_state = jax.device_put(tx.init(params), r_shard)
 
-        @jax.jit
+        # donate the param/stats/opt trees: in-place update, no per-step
+        # copies riding HBM bandwidth
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
         def train_step(params, batch_stats, opt_state, xb, yb, mask):
             def loss_fn(p):
                 logits, updates = module.apply(
@@ -275,19 +277,27 @@ class ResNetClassifier(BaseModel):
                     opt_state, loss)
 
         ctx.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
+        # donation invalidates buffers that may alias self._vars (warm
+        # start / re-train): drop the stale reference first
+        self._vars = None
         with mesh:
             for epoch in range(epochs):
                 losses = []
-                for batch in batch_iterator({"x": x, "y": y}, batch_size,
-                                            seed=epoch):
-                    xb = jax.device_put(batch["x"], b_shard)
-                    yb = jax.device_put(batch["y"], b_shard)
-                    mb = jax.device_put(
-                        batch["mask"].astype(np.float32), b_shard)
+                batches = prefetch_to_device(
+                    ({"x": b["x"], "y": b["y"],
+                      "m": b["mask"].astype(np.float32)}
+                     for b in batch_iterator({"x": x, "y": y}, batch_size,
+                                             seed=epoch)),
+                    sharding=b_shard)
+                for batch in batches:
                     params, batch_stats, opt_state, loss = train_step(
-                        params, batch_stats, opt_state, xb, yb, mb)
-                    losses.append(float(loss))
-                mean_loss = float(np.mean(losses))
+                        params, batch_stats, opt_state, batch["x"],
+                        batch["y"], batch["m"])
+                    # device scalar; bounded run-ahead (see vit.py note)
+                    losses.append(loss)
+                    if len(losses) % 8 == 0:
+                        jax.block_until_ready(loss)
+                mean_loss = float(np.mean([float(l) for l in losses]))
                 ctx.logger.log(epoch=epoch, loss=mean_loss)
                 if ctx.should_continue is not None and \
                         not ctx.should_continue(epoch, -mean_loss):

@@ -9,6 +9,7 @@ sub-mesh scheduling as every other template. Feature standardization
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -106,7 +107,8 @@ class JaxTabularMLP(BaseModel):
         tx = optax.adam(float(self.knobs["learning_rate"]))
         opt_state = tx.init(params)
 
-        @jax.jit
+        # donate the param/opt trees: in-place update, no per-step copies
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
         def train_step(params, opt_state, rng, xb, yb, mask):
             def loss_fn(p):
                 logits = module.apply({"params": p}, xb, train=True,
@@ -127,6 +129,9 @@ class JaxTabularMLP(BaseModel):
         batch_size = int(self.knobs["batch_size"])
         rng = jax.random.PRNGKey(1)
         ctx.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
+        # donation invalidates buffers that may alias self._params (warm
+        # start / re-train): drop the stale reference first
+        self._params = None
         for epoch in range(epochs):
             losses = []
             for b in batch_iterator({"x": x, "y": y}, batch_size,

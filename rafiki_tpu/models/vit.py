@@ -10,6 +10,7 @@ SURVEY.md §2.2 "data-parallel over ICI").
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -20,7 +21,7 @@ from flax import linen as nn
 
 from rafiki_tpu.constants import TaskType
 from rafiki_tpu.data import batch_iterator, \
-    load_image_classification_dataset
+    load_image_classification_dataset, prefetch_to_device
 from rafiki_tpu.model import (BaseModel, CategoricalKnob, FixedKnob,
                               FloatKnob, IntegerKnob, KnobConfig, PolicyKnob,
                               TrainContext, bucketed_forward, conform_images,
@@ -211,7 +212,9 @@ class ViTBase16(BaseModel):
         params = jax.device_put(params, r_shard)
         opt_state = jax.device_put(tx.init(params), r_shard)
 
-        @jax.jit
+        # donate params/opt_state: the optimizer update writes in place
+        # instead of copying the full trees every step (HBM traffic)
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
         def train_step(params, opt_state, xb, yb, mask):
             def loss_fn(p):
                 logits = module.apply({"params": p}, xb.astype(dtype))
@@ -229,19 +232,31 @@ class ViTBase16(BaseModel):
         if self.knobs.get("quick_train"):
             epochs = min(epochs, 2)
         ctx.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
+        # donation below invalidates buffers that may alias self._params
+        # (warm start / re-train): drop the stale reference so a failure
+        # mid-train can't leave the model holding deleted arrays
+        self._params = None
         with mesh:
             for epoch in range(epochs):
                 losses = []
-                for batch in batch_iterator({"x": x, "y": y}, batch_size,
-                                            seed=epoch):
-                    xb = jax.device_put(batch["x"], b_shard)
-                    yb = jax.device_put(batch["y"], b_shard)
-                    mb = jax.device_put(
-                        batch["mask"].astype(np.float32), b_shard)
+                batches = prefetch_to_device(
+                    ({"x": b["x"], "y": b["y"],
+                      "m": b["mask"].astype(np.float32)}
+                     for b in batch_iterator({"x": x, "y": y}, batch_size,
+                                             seed=epoch)),
+                    sharding=b_shard)
+                for batch in batches:
                     params, opt_state, loss = train_step(
-                        params, opt_state, xb, yb, mb)
-                    losses.append(float(loss))
-                mean_loss = float(np.mean(losses))
+                        params, opt_state, batch["x"], batch["y"],
+                        batch["m"])
+                    # device scalar, synced every few steps: a per-step
+                    # float() would serialize the prefetch pipeline, but
+                    # no sync at all lets the host run unboundedly ahead
+                    # (every in-flight batch stays resident in HBM)
+                    losses.append(loss)
+                    if len(losses) % 8 == 0:
+                        jax.block_until_ready(loss)
+                mean_loss = float(np.mean([float(l) for l in losses]))
                 ctx.logger.log(epoch=epoch, loss=mean_loss)
                 if ctx.should_continue is not None and \
                         not ctx.should_continue(epoch, -mean_loss):

@@ -82,3 +82,28 @@ def test_profiler_trace_per_trial(tmp_path, datasets):
     # jax.profiler writes plugins/profile/<ts>/*.trace.json.gz (and more)
     traces = list(trial_dirs[0].rglob("*"))
     assert any(f.is_file() for f in traces), "no trace artifacts written"
+
+
+def test_retrain_after_load_is_donation_safe(tmp_path):
+    """train() donates its param buffers; a model warm-started via
+    load_parameters must survive a second train() + dump/predict cycle
+    (the donated buffers must never alias self._params)."""
+    from rafiki_tpu.data import generate_image_classification_dataset
+    from rafiki_tpu.models.mlp import JaxFeedForward
+
+    tr = str(tmp_path / "tr.npz")
+    generate_image_classification_dataset(tr, 128, seed=0)
+    knobs = {"max_epochs": 1, "hidden_layer_count": 1,
+             "hidden_layer_units": 16, "learning_rate": 1e-3,
+             "batch_size": 64, "quick_train": True, "share_params": False}
+    m = JaxFeedForward(**knobs)
+    m.train(tr)
+    blob = m.dump_parameters()
+
+    m2 = JaxFeedForward(**knobs)
+    m2.load_parameters(blob)
+    m2.train(tr)  # donates buffers that must not alias the loaded tree
+    out = m2.dump_parameters()
+    assert out["params"] is not None
+    preds = m2.predict([__import__("numpy").zeros((28, 28, 1))])
+    assert len(preds) == 1
