@@ -144,11 +144,17 @@ class Admin:
                          ) -> Dict[str, Any]:
         latest = self.meta.get_latest_train_job_of_app(user_id, app)
         version = (latest["app_version"] + 1) if latest else 1
-        # datasets may be registered ids or raw host paths
+        # datasets may be registered ids or raw host paths; reject
+        # anything that is neither HERE, at the API boundary — otherwise
+        # a typo'd dataset id only fails later inside a worker
+        import os
+
         for ds_id in (train_dataset_id, val_dataset_id):
-            ds = self.meta.get_dataset(ds_id)
-            if ds is not None:
-                continue
+            if self.meta.get_dataset(ds_id) is None and \
+                    not os.path.exists(ds_id):
+                raise ValueError(
+                    f"dataset {ds_id!r} is neither a registered dataset "
+                    "id nor an existing path")
         train_uri = self._resolve_dataset(train_dataset_id)
         val_uri = self._resolve_dataset(val_dataset_id)
 
@@ -233,6 +239,30 @@ class Admin:
         host = job.get("predictor_host") or ""
         job["predictor_url"] = f"http://{host}" if host else None
         return job
+
+    def get_inference_jobs(self, user_id: str) -> List[Dict[str, Any]]:
+        jobs = self.meta.get_inference_jobs(user_id)
+        for job in jobs:
+            host = job.get("predictor_host") or ""
+            job["predictor_url"] = f"http://{host}" if host else None
+        return jobs
+
+    def get_inference_job_health(self, job_id: str) -> Dict[str, Any]:
+        """Server-side proxy to the predictor's ``GET /health`` (req/s
+        counters + latency percentiles): the dashboard cannot fetch the
+        predictor's port directly from the browser (cross-origin)."""
+        from ..utils.http import json_request
+
+        job = self.get_inference_job(job_id)
+        if not job.get("predictor_url"):
+            return {"ok": False, "error": "no predictor"}
+        try:
+            return json_request("GET", f"{job['predictor_url']}/health",
+                                timeout=5)
+        except Exception as e:  # noqa: BLE001 — unreachable/500/garbage
+            # predictor all map to a structured "down" answer, never a
+            # 500 from the admin itself
+            return {"ok": False, "error": str(e)}
 
     def stop_inference_job(self, job_id: str) -> None:
         for svc in list(self.services.services.values()):

@@ -40,7 +40,10 @@ class AdminApp:
           self._auth(self._get_best_trials))
         r("GET", "/trials/<id>/logs", self._auth(self._get_trial_logs))
         r("POST", "/inference_jobs", self._auth(self._create_inference_job))
+        r("GET", "/inference_jobs", self._auth(self._get_inference_jobs))
         r("GET", "/inference_jobs/<id>", self._auth(self._get_inference_job))
+        r("GET", "/inference_jobs/<id>/health",
+          self._auth(self._get_inference_job_health))
         r("POST", "/inference_jobs/<id>/stop",
           self._auth(self._stop_inference_job))
 
@@ -160,6 +163,12 @@ class AdminApp:
     def _get_inference_job(self, m, _b, user) -> Tuple[int, Any]:
         return 200, self.admin.get_inference_job(m["id"])
 
+    def _get_inference_jobs(self, _m, _b, user) -> Tuple[int, Any]:
+        return 200, self.admin.get_inference_jobs(user["id"])
+
+    def _get_inference_job_health(self, m, _b, user) -> Tuple[int, Any]:
+        return 200, self.admin.get_inference_job_health(m["id"])
+
     def _stop_inference_job(self, m, _b, user) -> Tuple[int, Any]:
         self.admin.stop_inference_job(m["id"])
         return 200, {"ok": True}
@@ -187,7 +196,12 @@ def main(argv: Optional[list] = None) -> int:
 
     meta = MetaStore(cfg["db_path"])
     manager = ServicesManager(meta, cfg["workdir"],
-                              slot_size=int(cfg.get("slot_size", 1)))
+                              slot_size=int(cfg.get("slot_size", 1)),
+                              default_workers=int(cfg.get("workers", 1)))
+    # restart adoption: rows left RUNNING by a dead admin are stale
+    reaped = manager.reap_stale_services()
+    if reaped:
+        print(f"reaped {reaped} stale service rows", flush=True)
     manager.start_data_plane()
     admin = Admin(meta, manager)
     admin.start_monitor()
@@ -198,10 +212,22 @@ def main(argv: Optional[list] = None) -> int:
         with open(cfg["port_file"], "w") as f:
             f.write(str(port))
     print(f"admin on {host}:{port}", flush=True)
+
+    # graceful shutdown: SIGTERM/SIGINT unblock serve_forever so the
+    # finally clause stops the monitor, every child service, and the kv
+    # data plane — `stack stop`'s SIGTERM must not orphan workers
+    import signal
+
+    def _on_term(_signum, _frame):
+        app.http.stop()
+
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
     try:
         app.http.serve_forever()
     finally:
         app.stop()
+        print("admin stopped cleanly", flush=True)
     return 0
 
 

@@ -57,6 +57,17 @@ class ManagedService:
         return self.proc.poll() is None
 
 
+def _cmdline_is_ours(pid: int) -> bool:
+    """Guard against recycled pids before killing a recorded service pid:
+    only processes whose cmdline looks like a rafiki service count."""
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            cmd = f.read().replace(b"\0", b" ").decode(errors="replace")
+    except OSError:
+        return False
+    return "rafiki" in cmd
+
+
 def probe_devices(timeout: float = 120.0) -> Dict[str, Any]:
     """Run the device probe subprocess; returns {platform, devices}."""
     out = subprocess.run(
@@ -70,9 +81,13 @@ class ServicesManager:
     def __init__(self, meta_store: MetaStore, workdir: str,
                  slot_size: int = 1, platform: Optional[str] = None,
                  devices: Optional[List[DeviceSpec]] = None,
-                 slot_timeout: float = 30.0) -> None:
+                 slot_timeout: float = 30.0,
+                 default_workers: int = 1) -> None:
         self.meta = meta_store
         self.slot_timeout = slot_timeout
+        #: train workers per job when the budget names no WORKER_COUNT /
+        #: GPU_COUNT (the CLI's --workers)
+        self.default_workers = max(1, int(default_workers))
         self.workdir = Path(workdir)
         self.workdir.mkdir(parents=True, exist_ok=True)
         if devices is None:
@@ -91,6 +106,35 @@ class ServicesManager:
         self.kv_port: int = 0
         self._kv_proc: Optional[subprocess.Popen] = None
 
+    def reap_stale_services(self) -> int:
+        """Admin restart adoption: service rows left non-STOPPED by a
+        previous admin in this workdir belong to processes that died with
+        it (children share its session) or leaked — kill any that still
+        answer their recorded pid and mark every stale row STOPPED.
+        Returns the number of rows reaped. Call before spawning anything
+        so a restarted control plane starts from consistent MetaStore
+        state."""
+        import os
+        import signal as _signal
+
+        reaped = 0
+        for row in self.meta.get_services():
+            if row["status"] in (ServiceStatus.STOPPED,
+                                 ServiceStatus.ERRORED):
+                continue
+            if row["id"] in self.services:  # owned by THIS manager
+                continue
+            pid = int(row.get("pid") or 0)
+            if pid > 0 and _cmdline_is_ours(pid):
+                try:
+                    os.kill(pid, _signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+            self.meta.update_service(row["id"],
+                                     status=ServiceStatus.STOPPED)
+            reaped += 1
+        return reaped
+
     # ---- data plane ----
     def start_data_plane(self) -> None:
         from ..native.client import KVServer
@@ -99,8 +143,10 @@ class ServicesManager:
         self._kv_server = server
         self._kv_proc = server._proc
         self.kv_host, self.kv_port = server.host, server.port
-        self.meta.create_service(ServiceType.DATA_PLANE, host=server.host,
-                                 port=server.port, pid=server._proc.pid)
+        row = self.meta.create_service(
+            ServiceType.DATA_PLANE, host=server.host, port=server.port,
+            pid=server._proc.pid)
+        self._kv_service_id = row["id"]
 
     @property
     def param_store_uri(self) -> str:
@@ -161,9 +207,12 @@ class ServicesManager:
 
     # ---- train jobs (SURVEY.md §3.1) ----
     def create_train_services(self, train_job_id: str,
-                              n_workers: int = 1) -> List[ManagedService]:
+                              n_workers: Optional[int] = None
+                              ) -> List[ManagedService]:
         with self.op_lock:
-            return self._create_train_services(train_job_id, n_workers)
+            return self._create_train_services(
+                train_job_id,
+                self.default_workers if n_workers is None else n_workers)
 
     def _create_train_services(self, train_job_id: str,
                                n_workers: int) -> List[ManagedService]:
@@ -391,3 +440,6 @@ class ServicesManager:
             self._kv_server.stop()
             self._kv_proc = None
             self.kv_host, self.kv_port = "", 0
+            if getattr(self, "_kv_service_id", None):
+                self.meta.update_service(self._kv_service_id,
+                                         status=ServiceStatus.STOPPED)

@@ -34,7 +34,7 @@ def stack_command(args: argparse.Namespace) -> int:
         workdir.mkdir(parents=True, exist_ok=True)
         cfg = {"workdir": str(workdir), "db_path": str(workdir / "meta.db"),
                "host": "127.0.0.1", "port": args.port,
-               "slot_size": getattr(args, "slot_size", 1),
+               "slot_size": args.slot_size, "workers": args.workers,
                "port_file": str(workdir / "admin.port")}
         cfg_path = workdir / "admin.json"
         cfg_path.write_text(json.dumps(cfg))
@@ -81,6 +81,10 @@ def stack_command(args: argparse.Namespace) -> int:
             else:
                 os.kill(pid, signal.SIGKILL)
         pid_file.unlink(missing_ok=True)
+        orphans = _reap_orphans(workdir)
+        if orphans:
+            print(f"killed {orphans} orphaned service processes",
+                  file=sys.stderr)
         print("stack stopped")
         return 0
 
@@ -107,3 +111,46 @@ def _pid_alive(pid: int) -> bool:
         return True
     except (ProcessLookupError, PermissionError):
         return False
+
+
+def _reap_orphans(workdir: Path) -> int:
+    """Kill service processes that outlived the admin (e.g. the admin was
+    SIGKILLed so its graceful shutdown never ran) and mark their MetaStore
+    rows STOPPED. The admin records every child's pid in the services
+    table, so the stack CLI can finish the cleanup from the db alone."""
+    db = workdir / "meta.db"
+    if not db.exists():
+        return 0
+    from ..store.meta_store import MetaStore
+
+    meta = MetaStore(str(db))
+    killed = 0
+    for row in meta.get_services():
+        if row["status"] in ("STOPPED", "ERRORED"):
+            continue
+        pid = int(row.get("pid") or 0)
+        if pid > 0 and _pid_alive(pid) and _looks_like_service(pid):
+            try:
+                os.kill(pid, signal.SIGTERM)
+                for _ in range(50):
+                    if not _pid_alive(pid):
+                        break
+                    time.sleep(0.1)
+                else:
+                    os.kill(pid, signal.SIGKILL)
+                killed += 1
+            except (ProcessLookupError, PermissionError):
+                pass  # exited between the check and the kill
+        meta.update_service(row["id"], status="STOPPED")
+    return killed
+
+
+def _looks_like_service(pid: int) -> bool:
+    """Guard against recycled pids: only kill processes whose cmdline
+    looks like one of ours (rafiki service module or the kv daemon)."""
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            cmd = f.read().replace(b"\0", b" ").decode(errors="replace")
+    except OSError:
+        return False
+    return "rafiki" in cmd

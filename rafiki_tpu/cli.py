@@ -68,7 +68,12 @@ def _register_service_commands(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--workdir", default="./rafiki_stack")
     p.add_argument("--port", type=int, default=3000,
                    help="admin REST port")
-    p.add_argument("--workers", type=int, default=1)
+    p.add_argument("--workers", type=int, default=1,
+                   help="train workers per job when the budget names no "
+                        "WORKER_COUNT/GPU_COUNT")
+    p.add_argument("--slot-size", dest="slot_size", type=int, default=1,
+                   help="devices per trial slot (ICI-contiguous sub-mesh "
+                        "size; e.g. 2 on 8 devices -> 4 slots)")
 
 
 def _run_service_command(args: argparse.Namespace) -> int:

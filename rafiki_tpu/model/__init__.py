@@ -11,11 +11,12 @@ from .knob import (BaseKnob, CategoricalKnob, FixedKnob, FloatKnob,
                    knob_config_from_json, knob_config_to_json, sample_knobs,
                    shape_signature, tunable_knobs, validate_knobs)
 from .log import LogRecord, ModelLogger
+from .loop import train_epoch
 from .template_utils import bucketed_forward, conform_images, \
     same_tree_shapes
 
 __all__ = [
-    "bucketed_forward", "conform_images", "same_tree_shapes",
+    "bucketed_forward", "conform_images", "same_tree_shapes", "train_epoch",
     "BaseModel", "Params", "TrainContext", "load_model_class",
     "serialize_model_class", "test_model_class", "tune_model", "TuneResult",
     "BaseKnob", "CategoricalKnob", "FixedKnob", "FloatKnob", "IntegerKnob",

@@ -31,11 +31,11 @@ from flax import linen as nn
 
 from rafiki_tpu.constants import TaskType
 from rafiki_tpu.data import batch_iterator, \
-    load_text_classification_dataset, prefetch_to_device
+    load_text_classification_dataset
 from rafiki_tpu.model import (BaseModel, CategoricalKnob, FixedKnob,
                               FloatKnob, IntegerKnob, KnobConfig, PolicyKnob,
                               TrainContext, bucketed_forward,
-                              same_tree_shapes)
+                              same_tree_shapes, train_epoch)
 from rafiki_tpu.ops.attention import flash_attention
 from rafiki_tpu.parallel.sharding import (batch_sharding, make_mesh,
                                           replicated)
@@ -256,29 +256,26 @@ class BertClassifier(BaseModel):
             updates, opt_state = tx.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt_state, loss
 
+        def step(state, b):
+            params, opt_state = state
+            params, opt_state, loss = train_step(
+                params, opt_state, b["ids"], b["lens"], b["y"], b["m"])
+            return (params, opt_state), loss
+
         ctx.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
         # donation invalidates buffers that may alias self._params (warm
         # start / re-train): drop the stale reference first
         self._params = None
         with mesh:
             for epoch in range(epochs):
-                losses = []
-                batches = prefetch_to_device(
+                (params, opt_state), mean_loss = train_epoch(
+                    step, (params, opt_state),
                     ({"ids": b["ids"], "lens": b["lens"], "y": b["y"],
                       "m": b["mask"].astype(np.float32)}
                      for b in batch_iterator(
                          {"ids": ids, "lens": lens, "y": y}, batch_size,
                          seed=epoch)),
                     sharding=b_shard)
-                for batch in batches:
-                    params, opt_state, loss = train_step(
-                        params, opt_state, batch["ids"], batch["lens"],
-                        batch["y"], batch["m"])
-                    # device scalar; bounded run-ahead (see vit.py note)
-                    losses.append(loss)
-                    if len(losses) % 8 == 0:
-                        jax.block_until_ready(loss)
-                mean_loss = float(np.mean([float(l) for l in losses]))
                 ctx.logger.log(epoch=epoch, loss=mean_loss)
                 if ctx.should_continue is not None and \
                         not ctx.should_continue(epoch, -mean_loss):

@@ -30,11 +30,11 @@ from flax import linen as nn
 
 from rafiki_tpu.constants import TaskType
 from rafiki_tpu.data import batch_iterator, \
-    load_image_classification_dataset, prefetch_to_device
+    load_image_classification_dataset
 from rafiki_tpu.model import (BaseModel, CategoricalKnob, FixedKnob,
                               FloatKnob, KnobConfig, PolicyKnob,
                               TrainContext, bucketed_forward, conform_images,
-                              same_tree_shapes)
+                              same_tree_shapes, train_epoch)
 from rafiki_tpu.parallel.sharding import (batch_sharding, make_mesh,
                                           replicated)
 
@@ -276,28 +276,26 @@ class ResNetClassifier(BaseModel):
             return (optax.apply_updates(params, updates), new_stats,
                     opt_state, loss)
 
+        def step(state, b):
+            params, batch_stats, opt_state = state
+            params, batch_stats, opt_state, loss = train_step(
+                params, batch_stats, opt_state, b["x"], b["y"], b["m"])
+            return (params, batch_stats, opt_state), loss
+
         ctx.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
         # donation invalidates buffers that may alias self._vars (warm
         # start / re-train): drop the stale reference first
         self._vars = None
         with mesh:
             for epoch in range(epochs):
-                losses = []
-                batches = prefetch_to_device(
+                state = (params, batch_stats, opt_state)
+                (params, batch_stats, opt_state), mean_loss = train_epoch(
+                    step, state,
                     ({"x": b["x"], "y": b["y"],
                       "m": b["mask"].astype(np.float32)}
                      for b in batch_iterator({"x": x, "y": y}, batch_size,
                                              seed=epoch)),
                     sharding=b_shard)
-                for batch in batches:
-                    params, batch_stats, opt_state, loss = train_step(
-                        params, batch_stats, opt_state, batch["x"],
-                        batch["y"], batch["m"])
-                    # device scalar; bounded run-ahead (see vit.py note)
-                    losses.append(loss)
-                    if len(losses) % 8 == 0:
-                        jax.block_until_ready(loss)
-                mean_loss = float(np.mean([float(l) for l in losses]))
                 ctx.logger.log(epoch=epoch, loss=mean_loss)
                 if ctx.should_continue is not None and \
                         not ctx.should_continue(epoch, -mean_loss):

@@ -21,11 +21,11 @@ from flax import linen as nn
 
 from rafiki_tpu.constants import TaskType
 from rafiki_tpu.data import batch_iterator, \
-    load_image_classification_dataset, prefetch_to_device
+    load_image_classification_dataset
 from rafiki_tpu.model import (BaseModel, CategoricalKnob, FixedKnob,
                               FloatKnob, IntegerKnob, KnobConfig, PolicyKnob,
                               TrainContext, bucketed_forward, conform_images,
-                              same_tree_shapes)
+                              same_tree_shapes, train_epoch)
 from rafiki_tpu.ops.attention import flash_attention
 from rafiki_tpu.ops.patch_embed import patch_embed
 from rafiki_tpu.parallel.sharding import (batch_sharding, make_mesh,
@@ -231,6 +231,12 @@ class ViTBase16(BaseModel):
                               * float(ctx.budget_scale)))
         if self.knobs.get("quick_train"):
             epochs = min(epochs, 2)
+        def step(state, b):
+            params, opt_state = state
+            params, opt_state, loss = train_step(params, opt_state,
+                                                 b["x"], b["y"], b["m"])
+            return (params, opt_state), loss
+
         ctx.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
         # donation below invalidates buffers that may alias self._params
         # (warm start / re-train): drop the stale reference so a failure
@@ -238,25 +244,13 @@ class ViTBase16(BaseModel):
         self._params = None
         with mesh:
             for epoch in range(epochs):
-                losses = []
-                batches = prefetch_to_device(
+                (params, opt_state), mean_loss = train_epoch(
+                    step, (params, opt_state),
                     ({"x": b["x"], "y": b["y"],
                       "m": b["mask"].astype(np.float32)}
                      for b in batch_iterator({"x": x, "y": y}, batch_size,
                                              seed=epoch)),
                     sharding=b_shard)
-                for batch in batches:
-                    params, opt_state, loss = train_step(
-                        params, opt_state, batch["x"], batch["y"],
-                        batch["m"])
-                    # device scalar, synced every few steps: a per-step
-                    # float() would serialize the prefetch pipeline, but
-                    # no sync at all lets the host run unboundedly ahead
-                    # (every in-flight batch stays resident in HBM)
-                    losses.append(loss)
-                    if len(losses) % 8 == 0:
-                        jax.block_until_ready(loss)
-                mean_loss = float(np.mean([float(l) for l in losses]))
                 ctx.logger.log(epoch=epoch, loss=mean_loss)
                 if ctx.should_continue is not None and \
                         not ctx.should_continue(epoch, -mean_loss):

@@ -238,5 +238,12 @@ def submesh_env_vars(platform: str, slot: SubMesh) -> Dict[str, str]:
         }
     # unknown accelerator platform (e.g. a tunneled PJRT plugin): inherit
     # the parent environment — the allocator still guarantees one worker
-    # per slot, which is the whole-device case here
+    # per slot, but NOTHING confines the child to its slot's chips, so
+    # concurrent trials would share every device. Say so loudly.
+    import logging
+
+    logging.getLogger(__name__).warning(
+        "no device-confinement env vars for platform %r: child processes "
+        "inherit ALL visible devices; run one trial at a time or use a "
+        "tpu/cpu platform for slot isolation", platform)
     return {}

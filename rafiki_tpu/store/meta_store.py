@@ -394,6 +394,15 @@ class MetaStore:
             "SELECT * FROM inference_jobs WHERE train_job_id=? "
             "ORDER BY created_at DESC", (train_job_id,))
 
+    def get_inference_jobs(self, user_id: Optional[str] = None
+                           ) -> List[Dict[str, Any]]:
+        if user_id:
+            return self._all(
+                "SELECT * FROM inference_jobs WHERE user_id=? "
+                "ORDER BY created_at DESC", (user_id,))
+        return self._all(
+            "SELECT * FROM inference_jobs ORDER BY created_at DESC")
+
     def update_inference_job(self, job_id: str, **fields: Any) -> None:
         self._update("inference_jobs", job_id, fields)
 

@@ -34,3 +34,44 @@ def test_dashboard_and_job_listing(tmp_path):
         assert jobs == []
     finally:
         app.stop()
+
+
+def test_dashboard_panels_and_endpoints(tmp_path):
+    """The round-3 panels (models/datasets/inference jobs + predictor
+    health) render and their REST endpoints answer live."""
+    meta = MetaStore(str(tmp_path / "meta.db"))
+    manager = ServicesManager(meta, str(tmp_path), slot_size=1,
+                              platform="cpu",
+                              devices=[DeviceSpec(id=0)])
+    admin = Admin(meta, manager)
+    app = AdminApp(admin)
+    host, port = app.start()
+    base = f"http://{host}:{port}"
+    try:
+        with urllib.request.urlopen(base + "/", timeout=10) as resp:
+            html = resp.read().decode()
+        # panels present and wired to their endpoints
+        for section in ("Models", "Datasets", "Inference jobs"):
+            assert section in html, section
+        for endpoint in ('"/models"', '"/datasets"', '"/inference_jobs"',
+                         "/health"):
+            assert endpoint in html, endpoint
+
+        token = json_request("POST", base + "/tokens",
+                             {"email": "superadmin@rafiki",
+                              "password": "rafiki"})["token"]
+        hdrs = {"Authorization": f"Bearer {token}"}
+        assert json_request("GET", base + "/models", headers=hdrs) == []
+        assert json_request("GET", base + "/datasets", headers=hdrs) == []
+        assert json_request("GET", base + "/inference_jobs",
+                            headers=hdrs) == []
+
+        # register a dataset + model; the listings pick them up
+        ds = json_request("POST", base + "/datasets",
+                          {"name": "d1", "task": "IMAGE_CLASSIFICATION",
+                           "uri": str(tmp_path / "d.npz")}, headers=hdrs)
+        assert ds["name"] == "d1"
+        datasets = json_request("GET", base + "/datasets", headers=hdrs)
+        assert [d["name"] for d in datasets] == ["d1"]
+    finally:
+        app.stop()
