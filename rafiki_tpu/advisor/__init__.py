@@ -1,4 +1,5 @@
-"""Hyperparameter-search advisors (random / Bayesian-GP / BOHB).
+"""Hyperparameter-search advisors (random / Bayesian-GP / BOHB /
+architecture evolution).
 
 See SURVEY.md §2 "Advisor service" and §3.4 for the propose/feedback
 protocol this package implements.
@@ -6,9 +7,11 @@ protocol this package implements.
 
 from .base import (ADVISOR_REGISTRY, BaseAdvisor, Proposal, TrialResult,
                    make_advisor)
+from .evolution import ArchEvolutionAdvisor
 from .random_search import RandomAdvisor
 
 ADVISOR_REGISTRY["random"] = RandomAdvisor
+ADVISOR_REGISTRY["arch_evo"] = ArchEvolutionAdvisor
 
 try:  # Bayesian-GP needs scikit-learn; register if available
     from .bayes_gp import BayesOptAdvisor
@@ -26,5 +29,5 @@ except ImportError:  # pragma: no cover
 
 __all__ = [
     "ADVISOR_REGISTRY", "BaseAdvisor", "Proposal", "TrialResult",
-    "make_advisor", "RandomAdvisor",
+    "make_advisor", "RandomAdvisor", "ArchEvolutionAdvisor",
 ]

@@ -1,0 +1,106 @@
+"""Architecture-search advisor: regularized evolution with param sharing.
+
+Parity target: the late-upstream reference's ENAS-style architecture
+search (SURVEY.md §2 "Advisor service"). The TPU-first re-design uses
+aging (regularized) evolution over the template's ``shape_relevant``
+knobs instead of an RL controller — same search behavior class, no
+recurrent controller to train, and it composes with this framework's
+two native affordances:
+
+- **Parameter sharing (the "ENAS" part):** a mutation that touches only
+  non-shape knobs keeps the child's ``shape_signature`` equal to its
+  parent's, so the proposal warm-starts from the parent's checkpoint
+  (``warm_start_trial_id`` + SHARE_PARAMS policy). Weights flow along
+  the lineage exactly like ENAS's shared supernet weights, but through
+  the ParamStore the framework already has.
+- **Compile-cache affinity:** children that keep the parent's shape
+  signature also reuse its XLA executable (workers cache by
+  ``shape_signature``), so the search spends chips on math, not
+  recompiles.
+
+Algorithm (Real et al., "Regularized Evolution for Image Classifier
+Architecture Search", AAAI 2019 — public method, reimplemented):
+seed ``population`` random configs; afterwards each proposal is a
+mutation of the winner of a ``sample_size`` tournament drawn from the
+most recent ``population`` results (aging: old individuals fall out of
+the window, which is what regularizes).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Deque, Optional
+
+from ..model.knob import (PolicyKnob, sample_knobs, shape_signature,
+                          tunable_knobs)
+from .base import BaseAdvisor, Proposal, TrialResult
+
+
+class ArchEvolutionAdvisor(BaseAdvisor):
+    name = "arch_evo"
+
+    def __init__(self, *args, population: int = 8, sample_size: int = 3,
+                 **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        self.population = max(2, int(population))
+        self.sample_size = max(1, int(sample_size))
+        #: aging window — only the newest ``population`` results compete
+        self._window: Deque[TrialResult] = collections.deque(
+            maxlen=self.population)
+
+    # ---- BaseAdvisor hooks (called under the base lock) ----
+    def _propose(self, trial_no: int) -> Proposal:
+        if len(self._window) < self.population:
+            return Proposal(trial_no=trial_no,
+                            knobs=self._with_policies(
+                                sample_knobs(self.knob_config, self._rng)))
+        parent = max(self._rng.sample(list(self._window),
+                                      min(self.sample_size,
+                                          len(self._window))),
+                     key=lambda r: r.score)
+        child = dict(parent.knobs)
+        mutated = self._mutate(child)
+        child = self._with_policies(child)
+        warm = ""
+        if parent.trial_id and not self._changes_shape(parent.knobs,
+                                                       child, mutated):
+            # ENAS-style weight inheritance: same shapes → same pytree
+            warm = parent.trial_id
+        return Proposal(trial_no=trial_no, knobs=child,
+                        warm_start_trial_id=warm,
+                        meta={"parent_trial_no": parent.trial_no,
+                              "mutated": mutated})
+
+    def _feedback(self, result: TrialResult) -> None:
+        self._window.append(result)
+
+    # ---- internals ----
+    def _mutate(self, knobs: dict) -> str:
+        """Resample ONE tunable knob in place; returns its name."""
+        names = tunable_knobs(self.knob_config)
+        if not names:
+            return ""
+        for _ in range(8):  # retry until the value actually changes
+            name = self._rng.choice(names)
+            new = self.knob_config[name].sample(self._rng)
+            if new != knobs.get(name):
+                knobs[name] = new
+                return name
+        knobs[name] = self.knob_config[name].sample(self._rng)
+        return name
+
+    def _changes_shape(self, parent_knobs: dict, child_knobs: dict,
+                       mutated: str) -> bool:
+        if mutated and not getattr(self.knob_config.get(mutated),
+                                   "shape_relevant", False):
+            return False
+        return shape_signature(self.knob_config, parent_knobs) != \
+            shape_signature(self.knob_config, child_knobs)
+
+    def _with_policies(self, knobs: dict) -> dict:
+        """Policy knobs: enable SHARE_PARAMS so warm starts take effect;
+        leave other policies at their sampled values."""
+        for n, k in self.knob_config.items():
+            if isinstance(k, PolicyKnob) and k.policy == "SHARE_PARAMS":
+                knobs[n] = True
+        return knobs

@@ -19,6 +19,8 @@ _ZOO = {
     "BiLSTMTagger": ("rafiki_tpu.models.pos_tagging", "BiLSTMTagger"),
     "SklearnDecisionTree": ("rafiki_tpu.models.sklearn_models",
                             "SklearnDecisionTree"),
+    "SklearnGBDT": ("rafiki_tpu.models.sklearn_models", "SklearnGBDT"),
+    "SklearnSVM": ("rafiki_tpu.models.sklearn_models", "SklearnSVM"),
     "JaxTabularMLP": ("rafiki_tpu.models.tabular", "JaxTabularMLP"),
 }
 

@@ -79,19 +79,8 @@ class SklearnDecisionTree(BaseModel):
     def _probs(self, x: np.ndarray) -> np.ndarray:
         assert self._tree is not None, "model is not trained/loaded"
         t = self._tree
-        node = np.zeros(len(x), np.int32)
-        # vectorized traversal: all rows step one level per iteration;
-        # leaves have children == -1 and simply stay put
-        for _ in range(64):  # > max tree depth
-            feat = t["feature"][node]
-            leaf = feat < 0
-            if leaf.all():
-                break
-            go_left = x[np.arange(len(x)), np.maximum(feat, 0)] \
-                <= t["threshold"][node]
-            nxt = np.where(go_left, t["children_left"][node],
-                           t["children_right"][node])
-            node = np.where(leaf, node, nxt).astype(np.int32)
+        node = _walk_tree(x, t["children_left"], t["children_right"],
+                          t["feature"], t["threshold"])
         return t["dist"][node]
 
     def evaluate(self, dataset_path: str) -> float:
@@ -115,6 +104,266 @@ class SklearnDecisionTree(BaseModel):
                        "threshold", "dist")}
 
 
+def _walk_tree(x: np.ndarray, left: np.ndarray, right: np.ndarray,
+               feature: np.ndarray, threshold: np.ndarray,
+               rows: Optional[np.ndarray] = None) -> np.ndarray:
+    """Vectorized leaf lookup shared by the DT and GBDT templates.
+
+    ``rows`` lets hot callers (GBDT sums hundreds of trees per batch)
+    pass one precomputed ``np.arange(len(x))``.
+    """
+    node = np.zeros(len(x), np.int32)
+    if rows is None:
+        rows = np.arange(len(x))
+    for _ in range(64):  # > max tree depth
+        feat = feature[node]
+        leaf = feat < 0
+        if leaf.all():
+            break
+        go_left = x[rows, np.maximum(feat, 0)] <= threshold[node]
+        nxt = np.where(go_left, left[node], right[node])
+        node = np.where(leaf, node, nxt).astype(np.int32)
+    return node
+
+
+class SklearnGBDT(BaseModel):
+    """Gradient-boosted decision trees over tabular features — the
+    xgboost-equivalent template (SURVEY.md §2 "Model zoo": the reference
+    ships an xgboost tabular template).
+
+    Fits ``sklearn.ensemble.GradientBoostingClassifier`` but, like
+    :class:`SklearnDecisionTree`, exports the fitted ensemble as plain
+    numpy arrays (per-tree structure + leaf values + class priors)
+    rather than pickles; prediction reimplements the staged-additive
+    raw-score accumulation + softmax/sigmoid link over those arrays, so
+    loaded models never unpickle foreign blobs and don't need sklearn.
+    """
+
+    TASKS = (TaskType.TABULAR_CLASSIFICATION,)
+
+    @staticmethod
+    def get_knob_config() -> KnobConfig:
+        return {
+            "n_estimators": IntegerKnob(10, 200, is_exp=True),
+            "learning_rate_gb": FloatKnob(0.01, 0.5, is_exp=True),
+            "max_depth": IntegerKnob(2, 6),
+            "subsample": FloatKnob(0.5, 1.0),
+        }
+
+    def __init__(self, **knobs: Any) -> None:
+        super().__init__(**knobs)
+        self._blob: Optional[Dict[str, Any]] = None
+
+    # ---- contract ----
+    def train(self, dataset_path: str,
+              ctx: Optional[TrainContext] = None) -> None:
+        from sklearn.ensemble import GradientBoostingClassifier
+
+        ctx = ctx or TrainContext()
+        ds = load_tabular_dataset(dataset_path)
+        if ds.n_classes == 0:
+            raise ValueError("SklearnGBDT is a classifier; dataset is "
+                             "regression (n_classes=0)")
+        clf = GradientBoostingClassifier(
+            n_estimators=int(self.knobs["n_estimators"]),
+            learning_rate=float(self.knobs["learning_rate_gb"]),
+            max_depth=int(self.knobs["max_depth"]),
+            subsample=float(self.knobs["subsample"]), random_state=0)
+        clf.fit(ds.features, ds.labels)
+        # export: estimators_ is (n_stages, K) DecisionTreeRegressors
+        # (K=1 binary); raw score_k(x) = prior_k + lr * Σ_s tree_sk(x)
+        n_stages, k = clf.estimators_.shape
+        trees = []
+        for s in range(n_stages):
+            for c in range(k):
+                t = clf.estimators_[s, c].tree_
+                trees.append({
+                    "left": t.children_left.astype(np.int32),
+                    "right": t.children_right.astype(np.int32),
+                    "feature": t.feature.astype(np.int32),
+                    "threshold": t.threshold.astype(np.float32),
+                    "value": t.value[:, 0, 0].astype(np.float32),
+                })
+        # baseline raw scores from the PUBLIC init_ estimator (the
+        # private _raw_predict_init has no API stability): sklearn's
+        # default 'log-odds' init is log(p/(1-p)) for binary and
+        # log(prior_k) for multiclass
+        p0 = np.clip(clf.init_.predict_proba(ds.features[:1])[0],
+                     1e-12, 1 - 1e-12)
+        if k == 1:
+            raw0 = np.asarray([np.log(p0[1] / (1.0 - p0[1]))])
+        else:
+            raw0 = np.log(p0)
+        self._blob = {
+            "trees": trees, "n_stages": n_stages, "k": k,
+            "lr": float(clf.learning_rate),
+            "prior": np.asarray(raw0, np.float32),
+            "classes": clf.classes_.astype(np.int64),
+            "n_classes": int(ds.n_classes),
+        }
+        ctx.logger.log(epoch=0, loss=float(1.0 - clf.score(ds.features,
+                                                           ds.labels)))
+
+    def _probs(self, x: np.ndarray) -> np.ndarray:
+        assert self._blob is not None, "model is not trained/loaded"
+        b = self._blob
+        n_stages, k, lr = int(b["n_stages"]), int(b["k"]), float(b["lr"])
+        raw = np.tile(np.asarray(b["prior"], np.float64), (len(x), 1))
+        rows = np.arange(len(x))
+        for s in range(n_stages):
+            for c in range(k):
+                t = b["trees"][s * k + c]
+                node = _walk_tree(x, np.asarray(t["left"]),
+                                  np.asarray(t["right"]),
+                                  np.asarray(t["feature"]),
+                                  np.asarray(t["threshold"]), rows=rows)
+                raw[:, c] += lr * np.asarray(t["value"], np.float64)[node]
+        if k == 1:  # binary: sigmoid link over the single raw column
+            p1 = 1.0 / (1.0 + np.exp(-raw[:, 0]))
+            local = np.stack([1.0 - p1, p1], axis=1)
+        else:  # multiclass: softmax link
+            raw -= raw.max(axis=1, keepdims=True)
+            e = np.exp(raw)
+            local = e / e.sum(axis=1, keepdims=True)
+        # scatter back onto the full label space (classes_ ⊆ labels)
+        probs = np.zeros((len(x), int(b["n_classes"])), np.float64)
+        for i, cls in enumerate(np.asarray(b["classes"])):
+            probs[:, int(cls)] = local[:, i]
+        return probs
+
+    def evaluate(self, dataset_path: str) -> float:
+        ds = load_tabular_dataset(dataset_path)
+        probs = self._probs(ds.features)
+        return float(np.mean(np.argmax(probs, -1) == ds.labels))
+
+    def predict(self, queries: Sequence[Any]) -> List[Any]:
+        x = np.asarray([np.asarray(q, np.float32).ravel()
+                        for q in queries], np.float32)
+        return [p.tolist() for p in self._probs(x)]
+
+    def dump_parameters(self) -> Dict[str, Any]:
+        assert self._blob is not None, "model is not trained"
+        return self._blob
+
+    def load_parameters(self, params: Dict[str, Any]) -> None:
+        self._blob = params
+
+
+class SklearnSVM(BaseModel):
+    """Kernel SVM over tabular features (SURVEY.md §2 "Model zoo": the
+    reference zoo's sklearn SVM template).
+
+    Fits ``sklearn.svm.SVC`` and exports support vectors, dual
+    coefficients, and intercepts as arrays; prediction reimplements the
+    one-vs-one decision functions (libsvm layout: pair (i, j) combines
+    class-i SVs weighted by ``dual_coef_[j-1]`` and class-j SVs by
+    ``dual_coef_[i]``) with pairwise voting — vote shares stand in for
+    probabilities so the predictor's ensemble averaging still works.
+    """
+
+    TASKS = (TaskType.TABULAR_CLASSIFICATION,)
+
+    @staticmethod
+    def get_knob_config() -> KnobConfig:
+        return {
+            "C": FloatKnob(0.01, 100.0, is_exp=True),
+            "kernel": CategoricalKnob(["linear", "rbf"]),
+            "gamma_scale": FloatKnob(0.1, 10.0, is_exp=True),
+        }
+
+    def __init__(self, **knobs: Any) -> None:
+        super().__init__(**knobs)
+        self._blob: Optional[Dict[str, Any]] = None
+
+    def train(self, dataset_path: str,
+              ctx: Optional[TrainContext] = None) -> None:
+        from sklearn.svm import SVC
+
+        ctx = ctx or TrainContext()
+        ds = load_tabular_dataset(dataset_path)
+        if ds.n_classes == 0:
+            raise ValueError("SklearnSVM is a classifier; dataset is "
+                             "regression (n_classes=0)")
+        mean = ds.features.mean(axis=0)
+        std = ds.features.std(axis=0) + 1e-6
+        x = (ds.features - mean) / std
+        # gamma: 'scale' default times a tunable multiplier
+        base_gamma = 1.0 / (x.shape[1] * max(x.var(), 1e-12))
+        gamma = base_gamma * float(self.knobs["gamma_scale"])
+        clf = SVC(C=float(self.knobs["C"]),
+                  kernel=str(self.knobs["kernel"]), gamma=gamma,
+                  random_state=0)
+        clf.fit(x, ds.labels)
+        self._blob = {
+            "sv": clf.support_vectors_.astype(np.float32),
+            "dual_coef": clf.dual_coef_.astype(np.float32),
+            "intercept": clf.intercept_.astype(np.float32),
+            "n_support": clf.n_support_.astype(np.int32),
+            "classes": clf.classes_.astype(np.int64),
+            "mean": mean.astype(np.float32), "std": std.astype(np.float32),
+            "meta": {"kernel": str(self.knobs["kernel"]),
+                     "gamma": float(gamma),
+                     "n_classes": int(ds.n_classes)},
+        }
+        ctx.logger.log(epoch=0, loss=float(1.0 - clf.score(x, ds.labels)))
+
+    def _kernel(self, x: np.ndarray, sv: np.ndarray) -> np.ndarray:
+        if self._blob["meta"]["kernel"] == "linear":
+            return x @ sv.T
+        gamma = float(self._blob["meta"]["gamma"])
+        d2 = (np.sum(x * x, 1)[:, None] + np.sum(sv * sv, 1)[None, :]
+              - 2.0 * (x @ sv.T))
+        return np.exp(-gamma * np.maximum(d2, 0.0))
+
+    def _probs(self, x: np.ndarray) -> np.ndarray:
+        assert self._blob is not None, "model is not trained/loaded"
+        b = self._blob
+        x = (x - np.asarray(b["mean"])) / np.asarray(b["std"])
+        km = self._kernel(np.asarray(x, np.float64),
+                          np.asarray(b["sv"], np.float64))
+        n_support = np.asarray(b["n_support"])
+        classes = np.asarray(b["classes"])
+        dual = np.asarray(b["dual_coef"], np.float64)
+        intercept = np.asarray(b["intercept"], np.float64)
+        k = len(classes)
+        starts = np.concatenate([[0], np.cumsum(n_support)])
+        votes = np.zeros((len(x), k), np.float64)
+        p = 0
+        for i in range(k):
+            for j in range(i + 1, k):
+                si, ei = starts[i], starts[i + 1]
+                sj, ej = starts[j], starts[j + 1]
+                dec = (km[:, si:ei] @ dual[j - 1, si:ei]
+                       + km[:, sj:ej] @ dual[i, sj:ej] + intercept[p])
+                votes[:, i] += dec > 0
+                votes[:, j] += dec <= 0
+                p += 1
+        if k == 1:  # degenerate single-class fit
+            votes[:, 0] = 1.0
+        share = votes / np.maximum(votes.sum(axis=1, keepdims=True), 1e-12)
+        probs = np.zeros((len(x), int(b["meta"]["n_classes"])), np.float64)
+        for i, cls in enumerate(classes):
+            probs[:, int(cls)] = share[:, i]
+        return probs
+
+    def evaluate(self, dataset_path: str) -> float:
+        ds = load_tabular_dataset(dataset_path)
+        probs = self._probs(np.asarray(ds.features, np.float64))
+        return float(np.mean(np.argmax(probs, -1) == ds.labels))
+
+    def predict(self, queries: Sequence[Any]) -> List[Any]:
+        x = np.asarray([np.asarray(q, np.float64).ravel()
+                        for q in queries], np.float64)
+        return [p.tolist() for p in self._probs(x)]
+
+    def dump_parameters(self) -> Dict[str, Any]:
+        assert self._blob is not None, "model is not trained"
+        return self._blob
+
+    def load_parameters(self, params: Dict[str, Any]) -> None:
+        self._blob = params
+
+
 if __name__ == "__main__":  # reference-style self-test block
     import tempfile
 
@@ -125,7 +374,9 @@ if __name__ == "__main__":  # reference-style self-test block
         train_p, val_p = f"{d}/train.npz", f"{d}/val.npz"
         generate_tabular_dataset(train_p, 1024, seed=0)
         ds = generate_tabular_dataset(val_p, 256, seed=1)
-        preds = test_model_class(
-            SklearnDecisionTree, TaskType.TABULAR_CLASSIFICATION,
-            train_p, val_p, queries=[ds.features[0]])
-        print("probs:", [round(p, 3) for p in preds[0]])
+        for cls in (SklearnDecisionTree, SklearnGBDT, SklearnSVM):
+            preds = test_model_class(
+                cls, TaskType.TABULAR_CLASSIFICATION,
+                train_p, val_p, queries=[ds.features[0]])
+            print(cls.__name__, "probs:",
+                  [round(p, 3) for p in preds[0]])

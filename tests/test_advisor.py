@@ -236,3 +236,51 @@ def test_bohb_concurrent_workers_race_final_trial():
     assert full, "final-trial reservation must guarantee a full-budget run"
     assert adv.best_effort is not None
     assert adv.best_effort.budget_scale >= 1.0
+
+
+def test_arch_evolution_advisor():
+    """ENAS-lite: seeds a random population, then mutates tournament
+    winners; a non-shape mutation inherits the parent's params
+    (warm_start), a shape mutation does not."""
+    from rafiki_tpu.advisor import TrialResult, make_advisor
+    from rafiki_tpu.model import (CategoricalKnob, FloatKnob, IntegerKnob,
+                                  PolicyKnob)
+
+    knob_config = {
+        "width": CategoricalKnob([32, 64, 128], shape_relevant=True),
+        "depth": IntegerKnob(2, 6, shape_relevant=True),
+        "lr": FloatKnob(1e-4, 1e-1, is_exp=True),
+        "share": PolicyKnob("SHARE_PARAMS"),
+    }
+    total = 24
+    adv = make_advisor(knob_config, "arch_evo", total_trials=total,
+                       seed=3, population=4, sample_size=2)
+    warm_starts = 0
+    shape_mutations = 0
+    for _ in range(total):
+        p = adv.propose()
+        assert p.is_valid
+        assert p.knobs["share"] is True
+        if p.warm_start_trial_id:
+            warm_starts += 1
+            # inherited weights require identical shapes
+            parent = next(r for r in adv.results
+                          if r.trial_no == p.meta["parent_trial_no"])
+            from rafiki_tpu.model.knob import shape_signature
+            assert shape_signature(knob_config, parent.knobs) == \
+                shape_signature(knob_config, p.knobs)
+        if p.meta.get("mutated") in ("width", "depth"):
+            shape_mutations += 1
+        # score favors wide+deep so evolution has a gradient to climb
+        score = (0.3 * (p.knobs["width"] / 128)
+                 + 0.3 * (p.knobs["depth"] / 6)
+                 + 0.1 * adv._rng.random())
+        adv.feedback(TrialResult(trial_no=p.trial_no, knobs=p.knobs,
+                                 score=score, trial_id=f"t{p.trial_no}"))
+    assert not adv.propose().is_valid  # budget exhausted
+    assert warm_starts > 0, "lr-only mutations should inherit params"
+    assert shape_mutations > 0, "architecture dims should be explored"
+    # evolution should concentrate on better architectures over time
+    late = sum(r.score for r in adv.results[-8:]) / 8
+    early = sum(r.score for r in adv.results[:8]) / 8
+    assert late >= early - 0.05
