@@ -1,8 +1,15 @@
-"""Pallas TPU kernels for the hot ops (XLA-fallback-free on TPU;
-interpreter mode on CPU so tests run the same code path)."""
+"""Hot-path ops: Pallas TPU kernels + sequence-parallel ring attention.
+
+On TPU the flash-attention / patch-embed kernels lower through Mosaic;
+off-TPU the default dispatch routes to equivalent pure-XLA math (the
+Pallas interpreter is test-only — see ``ops/common.py``). Ring attention
+shards the sequence axis over a mesh and rotates K/V via ppermute
+(long-context support; ``ops/ring_attention.py``).
+"""
 
 from .attention import flash_attention, mha
 from .patch_embed import extract_patches, matmul_bias, patch_embed
+from .ring_attention import ring_attention
 
 __all__ = ["flash_attention", "mha", "patch_embed", "matmul_bias",
-           "extract_patches"]
+           "extract_patches", "ring_attention"]

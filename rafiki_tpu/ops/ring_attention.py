@@ -1,0 +1,124 @@
+"""Ring attention — sequence-parallel exact attention over an ICI ring.
+
+Long-context support the TPU-first way (the reference has nothing here —
+SURVEY.md §5.7 — but a TPU framework must scale sequence length past one
+chip's HBM): the sequence axis is sharded over a mesh axis, every device
+holds an L/P slice of Q, K, V, and K/V blocks rotate around the ring via
+``jax.lax.ppermute`` while each device accumulates its queries' attention
+over every block with the online-softmax (flash) recurrence. Peak memory
+is O(L²/P²) per device for the blockwise scores — never the full L×L
+matrix — and the K/V transfers ride neighbor-to-neighbor ICI links,
+overlapping compute steps.
+
+Built with ``shard_map`` + plain jnp math inside, so:
+- XLA sees P program instances exchanging with ``ppermute`` — the
+  collective schedule is the compiler's to overlap;
+- the whole thing is differentiable for free (``ppermute`` has a
+  transpose rule; the VJP runs the reverse ring), no custom backward;
+- on one device it degrades to ordinary blockwise attention.
+
+Causality uses global positions: device i's queries start at i·L/P, and
+after s rotations its resident K/V block originated on device
+(i − s) mod P, so the mask is exact across the ring — no recomputation
+or padding tricks.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _local_block(q, k, v, q_off, k_off, sm_scale: float, causal: bool,
+                 m, l, acc):
+    """One online-softmax update of local queries against one K/V block.
+
+    q: (b, h, sq, d); k/v: (b, h, sk, d); (m, l, acc): running max /
+    normalizer / weighted-V accumulator, all f32.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        q_pos = q_off + jax.lax.broadcasted_iota(
+            jnp.int32, (q.shape[2], k.shape[2]), 0)
+        k_pos = k_off + jax.lax.broadcasted_iota(
+            jnp.int32, (q.shape[2], k.shape[2]), 1)
+        s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    acc_new = acc * alpha[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return m_new, l_new, acc_new
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   mesh, axis: str, sm_scale: Optional[float] = None,
+                   causal: bool = False,
+                   batch_axis: Optional[str] = None) -> jnp.ndarray:
+    """Exact attention with Q/K/V sequence-sharded over ``mesh[axis]``.
+
+    Inputs are (batch, heads, seq, head_dim) arrays whose ``seq`` dim is
+    (or will be) sharded over the named mesh axis. On a multi-axis mesh
+    pass ``batch_axis`` to keep the batch dim sharded over it (2-D
+    dp × sp); any mesh axis named in neither is replicated over.
+    Returns the attention output with the same sharding as the inputs
+    were placed to. Differentiable end-to-end.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    scale = (sm_scale if sm_scale is not None
+             else 1.0 / math.sqrt(q.shape[-1]))
+    n_ring = mesh.shape[axis]
+    seq_spec = P(batch_axis, None, axis, None)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(seq_spec, seq_spec, seq_spec),
+        out_specs=seq_spec)
+    def _ring(ql, kl, vl):
+        # ql/kl/vl: the local (b, h, L/P, d) shards
+        idx = jax.lax.axis_index(axis)
+        sq = ql.shape[2]
+        q_off = idx * sq
+
+        m0 = jnp.full(ql.shape[:3], NEG_INF, jnp.float32)
+        l0 = jnp.zeros(ql.shape[:3], jnp.float32)
+        a0 = jnp.zeros(ql.shape, jnp.float32)
+
+        def body(s, carry):
+            kb, vb, m, l, acc = carry
+            # block resident after s rotations originated on (idx - s)
+            k_off = ((idx - s) % n_ring) * sq
+            m, l, acc = _local_block(ql, kb, vb, q_off, k_off, scale,
+                                     causal, m, l, acc)
+            # rotate K/V one hop around the ring (neighbor ICI links)
+            perm = [(j, (j + 1) % n_ring) for j in range(n_ring)]
+            kb = jax.lax.ppermute(kb, axis, perm)
+            vb = jax.lax.ppermute(vb, axis, perm)
+            return kb, vb, m, l, acc
+
+        # unrolled python loop: n_ring is static (mesh shape), and
+        # unrolling lets XLA overlap each step's ppermute with the
+        # next block's einsum
+        carry = (kl, vl, m0, l0, a0)
+        for s in range(n_ring):
+            carry = body(s, carry)
+        m, l, acc = carry[2:]
+        out = acc / jnp.maximum(l, 1e-30)[..., None]
+        if causal:
+            # fully-masked rows (none exist for causal self-attention,
+            # but keep the zero convention of ops.attention)
+            out = jnp.where((l > 0)[..., None], out, 0.0)
+        return out.astype(ql.dtype)
+
+    shard = NamedSharding(mesh, seq_spec)
+    return _ring(jax.device_put(q, shard), jax.device_put(k, shard),
+                 jax.device_put(v, shard))
