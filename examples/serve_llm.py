@@ -1,0 +1,79 @@
+"""LLM fine-tune + continuous-batch serving (BASELINE.md config #5).
+
+Trains a small LlamaLoRA under the advisor, deploys it, and sends
+overlapping generation requests — the inference worker serves them
+through the slot-based continuous-batching decode loop.
+
+    rafiki-tpu stack start --workdir ./rafiki_stack
+    RAFIKI_JAX_PLATFORM=cpu python examples/serve_llm.py \
+        --admin http://127.0.0.1:3000
+"""
+
+from __future__ import annotations
+
+import argparse
+import tempfile
+import threading
+
+from rafiki_tpu.utils.platform import apply_platform_env
+
+apply_platform_env()
+
+from rafiki_tpu.client import Client  # noqa: E402
+from rafiki_tpu.data import \
+    generate_text_classification_dataset  # noqa: E402
+from rafiki_tpu.models.llama_lora import LlamaLoRA  # noqa: E402
+
+#: tiny in-domain pins so the demo fits a laptop; drop for a real run
+SMALL = {"hidden_dim": 64, "depth": 2, "n_heads": 4, "kv_ratio": 2,
+         "lora_rank": 4, "max_len": 32, "model_parallel": 1,
+         "learning_rate": 1e-2, "batch_size": 8, "quick_train": True,
+         "share_params": False}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--admin", default="http://127.0.0.1:3000")
+    args = ap.parse_args()
+
+    client = Client(args.admin)
+    client.login("superadmin@rafiki", "rafiki")
+
+    with tempfile.TemporaryDirectory() as d:
+        tr, va = f"{d}/train.jsonl", f"{d}/val.jsonl"
+        generate_text_classification_dataset(tr, 128, seed=0)
+        generate_text_classification_dataset(va, 32, seed=1)
+
+        model = client.create_model("demo-llama", "LANGUAGE_MODELING",
+                                    LlamaLoRA)
+        job = client.create_train_job(
+            app="llm-demo", task="LANGUAGE_MODELING",
+            train_dataset_id=tr, val_dataset_id=va,
+            budget={"TRIAL_COUNT": 1},
+            model_ids=[model["id"]],
+            train_args={"advisor": "random", "knob_overrides": SMALL})
+        job = client.wait_until_train_job_finished(job["id"], timeout=900)
+        print("train job:", job["status"])
+
+        ijob = client.create_inference_job(job["id"], max_workers=1)
+        url = ijob["predictor_url"]
+        print("predictor:", url)
+
+        # overlapping clients: requests admitted into free KV slots
+        # mid-flight share one decode loop on the worker
+        def ask(prompt: str) -> None:
+            out = client.predict(url, [prompt], timeout=180)
+            print(f"  {prompt!r} -> {out[0]!r}")
+
+        threads = [threading.Thread(target=ask, args=(p,))
+                   for p in ("tok1 tok2 tok3", "tok4 tok5",
+                             "tok6 tok7 tok8 tok9")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        client.stop_inference_job(ijob["id"])
+
+
+if __name__ == "__main__":
+    main()

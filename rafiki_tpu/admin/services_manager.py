@@ -30,7 +30,7 @@ from pathlib import Path
 from typing import Any, Dict, List, Optional
 
 from ..constants import (ServiceStatus, ServiceType, SubTrainJobStatus,
-                         TrainJobStatus)
+                         TaskType, TrainJobStatus)
 from ..parallel.mesh import DeviceSpec, SubMesh, SubMeshAllocator, \
     submesh_env_vars
 from ..store.meta_store import MetaStore
@@ -235,10 +235,26 @@ class ServicesManager:
 
             model_class = load_model_class(model["model_bytes"],
                                            model["model_class"])
+            knob_config = model_class.get_knob_config()
+            # job-level knob pins: keep only the knobs THIS model has
+            # (multi-model jobs — other models' knobs must not leak into
+            # its proposals) and substitute FixedKnob into the advisor's
+            # search space so no trial budget is spent re-sampling pinned
+            # dimensions. The worker still merges the same values as a
+            # belt-and-braces.
+            overrides = {
+                k: v for k, v in (job["train_args"].get("knob_overrides")
+                                  or {}).items() if k in knob_config}
+            if overrides:
+                from ..model.knob import FixedKnob
+
+                knob_config = {
+                    name: (FixedKnob(overrides[name])
+                           if name in overrides else knob)
+                    for name, knob in knob_config.items()}
             advisor = self._spawn(
                 "rafiki_tpu.advisor.service",
-                {"knob_config":
-                     knob_config_to_json(model_class.get_knob_config()),
+                {"knob_config": knob_config_to_json(knob_config),
                  "advisor_type": job["train_args"].get("advisor", "auto"),
                  "total_trials": budget.get("TRIAL_COUNT"),
                  "time_budget_s": (float(budget["TIME_HOURS"]) * 3600
@@ -267,6 +283,7 @@ class ServicesManager:
                      "meta_store_path": self.meta._db_path,
                      "sub_train_job_id": sub["id"],
                      "profile_dir": profile_dir,
+                     "knob_overrides": overrides,
                      "worker_id": f"tw-{sub['id'][:8]}-{w}"},
                     ServiceType.TRAIN_WORKER, slot=slot,
                     train_job_id=train_job_id, sub_train_job_id=sub["id"])
@@ -368,6 +385,10 @@ class ServicesManager:
             model_file.write_bytes(model["model_bytes"])
             wid = f"iw-{inference_job_id[:8]}-{i}"
             slot = slots[i]
+            # generative tasks serve through the continuous-batching
+            # decode loop (slot-based KV admission) instead of the
+            # classification micro-batcher
+            decode_loop = model["task"] == TaskType.LANGUAGE_MODELING
             svc = self._spawn(
                 "rafiki_tpu.worker.inference",
                 {"model_file": str(model_file),
@@ -375,7 +396,7 @@ class ServicesManager:
                  "trial_id": trial["id"], "knobs": trial["knobs"],
                  "param_store_uri": self.param_store_uri,
                  "kv_host": self.kv_host, "kv_port": self.kv_port,
-                 "worker_id": wid},
+                 "worker_id": wid, "decode_loop": decode_loop},
                 ServiceType.INFERENCE_WORKER, slot=slot,
                 inference_job_id=inference_job_id)
             spawned.append(svc)

@@ -53,12 +53,19 @@ class InferenceWorker:
         self.model.load_parameters(params)
         self.engine = None
         if decode_loop:
-            if not hasattr(self.model, "make_decode_engine"):
-                raise TypeError(
-                    f"{model_class.__name__} has no make_decode_engine; "
-                    "decode_loop mode needs a generative template")
-            self.engine = self.model.make_decode_engine(
-                max_slots=max_slots, max_new_tokens=max_new_tokens)
+            if hasattr(self.model, "make_decode_engine"):
+                self.engine = self.model.make_decode_engine(
+                    max_slots=max_slots, max_new_tokens=max_new_tokens)
+            else:
+                # the stack enables decode_loop for every LM-task model;
+                # a template without an engine still serves fine through
+                # the micro-batcher — degrade, don't die
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "%s has no make_decode_engine; serving through the "
+                    "predict() micro-batcher instead of the continuous-"
+                    "batching decode loop", model_class.__name__)
 
     def stop(self) -> None:
         self._stop.set()

@@ -36,7 +36,8 @@ class TrainWorker:
                  sub_train_job_id: str = "", model_id: str = "",
                  devices: Optional[List[Any]] = None,
                  worker_id: str = "worker-0",
-                 profile_dir: Optional[str] = None) -> None:
+                 profile_dir: Optional[str] = None,
+                 knob_overrides: Optional[dict] = None) -> None:
         self.model_class = model_class
         self.advisor = advisor
         self.train_dataset_path = train_dataset_path
@@ -48,6 +49,10 @@ class TrainWorker:
         self.devices = devices
         self.worker_id = worker_id
         self.profile_dir = profile_dir
+        #: job-level knob pins (train_args["knob_overrides"]) merged over
+        #: every proposal — how a job fixes e.g. max_len or batch_size
+        #: regardless of what the advisor samples
+        self.knob_overrides = dict(knob_overrides or {})
         self.trials_run = 0
 
     # ---- one trial ----
@@ -56,6 +61,8 @@ class TrainWorker:
 
         from ..model.knob import shape_signature
 
+        if self.knob_overrides:
+            proposal.knobs = {**proposal.knobs, **self.knob_overrides}
         if self.meta_store is not None:
             trial_id = self.meta_store.create_trial(
                 self.sub_train_job_id, proposal.trial_no,
@@ -172,7 +179,8 @@ def main(argv: Optional[list] = None) -> int:
         sub_train_job_id=cfg.get("sub_train_job_id", ""),
         model_id=cfg.get("model_id", ""),
         worker_id=cfg.get("worker_id", "worker-0"),
-        profile_dir=cfg.get("profile_dir"))
+        profile_dir=cfg.get("profile_dir"),
+        knob_overrides=cfg.get("knob_overrides"))
     n = worker.run()
     print(f"train worker {worker.worker_id} done: {n} trials", flush=True)
     return 0

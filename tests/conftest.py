@@ -22,6 +22,15 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA-executable cache: the suite is compile-dominated (every
+# template/mesh combo pays tracing+lowering on CPU), and the programs are
+# identical across runs — cache them on disk so reruns are minutes
+# faster. Safe to delete .jax_cache/ at any time.
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.dirname(
+                      os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
 import pytest  # noqa: E402
 
 

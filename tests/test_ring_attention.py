@@ -24,8 +24,7 @@ def _mesh(n):
 
 
 @pytest.mark.parametrize("n_ring,causal", [(1, False), (4, False),
-                                           (8, False), (4, True),
-                                           (8, True)])
+                                           (4, True), (8, True)])
 def test_ring_matches_dense(n_ring, causal):
     s = 64  # global sequence, divides every ring size
     q = _rand(2, 2, s, 16, key=0)
@@ -36,15 +35,20 @@ def test_ring_matches_dense(n_ring, causal):
     ref = _attention_reference(q, k, v, 1.0 / np.sqrt(16), causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+    # output stays sequence-sharded — no all-gather of the result
+    assert tuple(out.sharding.spec) == (None, None, "sp", None)
 
 
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_grads_match_dense(causal):
+    # ring size 2: the VJP's reverse ring is fully exercised at any ring
+    # size, and the unrolled shard_map backward is expensive to compile
+    # on CPU (~60s at ring 4); both mask branches get grad coverage
     s = 32
     q = _rand(1, 2, s, 8, key=3)
     k = _rand(1, 2, s, 8, key=4)
     v = _rand(1, 2, s, 8, key=5)
-    mesh = _mesh(4)
+    mesh = _mesh(2)
 
     def f(q, k, v):
         return jnp.sum(ring_attention(q, k, v, mesh, "sp",
@@ -81,15 +85,6 @@ def test_ring_bf16_long_sequence_under_jit():
                                1.0 / np.sqrt(32), True)
     np.testing.assert_allclose(np.asarray(out, np.float32),
                                np.asarray(ref), atol=3e-2, rtol=3e-2)
-
-
-def test_ring_output_sequence_sharding():
-    """The output stays sequence-sharded — no all-gather of the result."""
-    mesh = _mesh(8)
-    q = _rand(1, 1, 64, 8, key=9)
-    out = ring_attention(q, q, q, mesh, "sp")
-    spec = out.sharding.spec
-    assert tuple(spec) == (None, None, "sp", None), spec
 
 
 def test_ring_2d_mesh_dp_times_sp():

@@ -95,3 +95,46 @@ def test_full_stack_train_deploy_predict(stack, datasets):
     client.stop_inference_job(ijob["id"])
     final = client.get_inference_job(ijob["id"])
     assert final["status"] == "STOPPED"
+
+
+@pytest.mark.slow
+def test_full_stack_lm_generation(stack):
+    """Config #5 through the REST stack: LlamaLoRA train job -> deploy ->
+    the inference worker serves generations via the continuous-batching
+    decode loop (decode_loop auto-enabled for LANGUAGE_MODELING)."""
+    from rafiki_tpu.data import generate_text_classification_dataset
+    from rafiki_tpu.models.llama_lora import LlamaLoRA
+
+    client, work = stack
+    d = work / "lm_ds"
+    d.mkdir(exist_ok=True)
+    tr, va = str(d / "train.jsonl"), str(d / "val.jsonl")
+    generate_text_classification_dataset(tr, 64, seed=0)
+    generate_text_classification_dataset(va, 24, seed=1)
+
+    client.login("superadmin@rafiki", "rafiki")
+    model = client.create_model("llama", "LANGUAGE_MODELING", LlamaLoRA)
+    job = client.create_train_job(
+        app="lm-app", task="LANGUAGE_MODELING",
+        train_dataset_id=tr, val_dataset_id=va,
+        budget={"TRIAL_COUNT": 1, "WORKER_COUNT": 1},
+        model_ids=[model["id"]],
+        # knob_overrides pin the advisor's samples to a tiny in-domain
+        # config (FixedKnobs like vocab_size can't be overridden)
+        train_args={"advisor": "random", "knob_overrides": {
+            "hidden_dim": 64, "depth": 2, "n_heads": 4, "kv_ratio": 2,
+            "lora_rank": 4, "max_len": 32, "model_parallel": 1,
+            "learning_rate": 1e-2, "batch_size": 8, "quick_train": True,
+            "share_params": False}})
+    job = client.wait_until_train_job_finished(job["id"], timeout=600)
+    assert job["status"] == "STOPPED"
+    trials = client.get_trials_of_train_job(job["id"])
+    assert any(t["status"] == "COMPLETED" for t in trials), trials
+
+    ijob = client.create_inference_job(job["id"], max_workers=1)
+    assert ijob["predictor_url"]
+    preds = client.predict(ijob["predictor_url"],
+                           ["tok1 tok2 tok3", "tok4 tok5"], timeout=180)
+    assert len(preds) == 2
+    assert all(isinstance(p, str) and p for p in preds), preds
+    client.stop_inference_job(ijob["id"])

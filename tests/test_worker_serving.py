@@ -190,3 +190,27 @@ def test_ensemble_majority_vote():
     out = ensemble_predictions([["cat", "dog"], ["cat", "cow"],
                                 ["dog", "cow"]])
     assert out == ["cat", "cow"]
+
+
+def test_train_worker_knob_overrides(tmp_path):
+    """Job-level knob pins merge over every advisor proposal."""
+    from rafiki_tpu.advisor import make_advisor
+    from rafiki_tpu.data import generate_image_classification_dataset
+    from rafiki_tpu.models.mlp import JaxFeedForward
+    from rafiki_tpu.worker.train import TrainWorker
+
+    tr = str(tmp_path / "tr.npz")
+    va = str(tmp_path / "va.npz")
+    generate_image_classification_dataset(tr, 128, seed=0)
+    generate_image_classification_dataset(va, 64, seed=1)
+    advisor = make_advisor(JaxFeedForward.get_knob_config(), "random",
+                           total_trials=2, seed=0)
+    worker = TrainWorker(
+        JaxFeedForward, advisor, tr, va,
+        knob_overrides={"hidden_layer_count": 1,
+                        "hidden_layer_units": 16, "quick_train": True})
+    n = worker.run()
+    assert n == 2
+    for r in advisor.results:
+        assert r.knobs["hidden_layer_count"] == 1
+        assert r.knobs["hidden_layer_units"] == 16
