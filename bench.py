@@ -72,8 +72,10 @@ def _child(out_path: str, budget: float) -> None:
     from rafiki_tpu.models.vit import ViT
 
     if on_accel:
+        # bf16 compute (params f32): f32 matmuls lower to multi-pass bf16
+        # on the MXU at ~3x the cost — never benchmark the promoted path
         module = ViT(patch_size=16, hidden_dim=768, depth=12, n_heads=12,
-                     mlp_dim=3072, n_classes=1000)
+                     mlp_dim=3072, n_classes=1000, dtype=jnp.bfloat16)
         img, batches, metric = 224, (32, 128), METRIC
     else:  # fallback: prove the path end-to-end in seconds. A toy model
         # under its OWN metric name — never comparable to B/16 history.

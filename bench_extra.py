@@ -57,7 +57,7 @@ def _bench_predictor(out_path: str, use_kv: bool, duration: float) -> None:
         "hidden_dim": 768 if on_accel else 96,
         "depth": 12 if on_accel else 2,
         "n_heads": 12 if on_accel else 4,
-        "learning_rate": 1e-3, "weight_decay": 1e-4,
+        "learning_rate": 1e-3, "weight_decay": 1e-4, "warmup_frac": 0.1,
         "batch_size": 32, "bf16": True,
         "quick_train": True, "share_params": False,
     }

@@ -27,8 +27,8 @@ from rafiki_tpu.models.llama_lora import LlamaLoRA  # noqa: E402
 #: tiny in-domain pins so the demo fits a laptop; drop for a real run
 SMALL = {"hidden_dim": 64, "depth": 2, "n_heads": 4, "kv_ratio": 2,
          "lora_rank": 4, "max_len": 32, "model_parallel": 1,
-         "learning_rate": 1e-2, "batch_size": 8, "quick_train": True,
-         "share_params": False}
+         "learning_rate": 1e-2, "batch_size": 8, "bf16": False,
+         "quick_train": True, "share_params": False}
 
 
 def main() -> None:

@@ -170,7 +170,17 @@ class Admin:
             train_uri, val_uri, train_args=train_args)
         for mid in model_ids:
             self.meta.create_sub_train_job(job["id"], mid)
-        self.services.create_train_services(job["id"])
+        try:
+            self.services.create_train_services(job["id"])
+        except ValueError:
+            # pre-spawn validation failed (e.g. typo'd knob_overrides):
+            # don't leave a zombie RUNNING job (or STARTED sub-jobs — the
+            # monitor's finalize path never runs for a job with no
+            # services) behind the 400 response
+            for sub in self.meta.get_sub_train_jobs_of_train_job(job["id"]):
+                self.meta.update_sub_train_job(sub["id"], status="ERRORED")
+            self.meta.update_train_job(job["id"], status="ERRORED")
+            raise
         return self.get_train_job(job["id"])
 
     def _resolve_dataset(self, dataset_id_or_uri: str) -> str:

@@ -222,8 +222,29 @@ class ServicesManager:
         budget = job["budget"]
         n_workers = int(budget.get("WORKER_COUNT",
                                    budget.get("GPU_COUNT", n_workers)))
+        subs = self.meta.get_sub_train_jobs_of_train_job(train_job_id)
+
+        # a knob_overrides key that matches NO model's knob config is a
+        # typo: fail before spawning anything rather than silently running
+        # the full search on the dimension the user believes is pinned
+        requested = set((job["train_args"].get("knob_overrides") or {}))
+        if requested:
+            from ..model.base import load_model_class
+
+            known: set = set()
+            for sub in subs:
+                model = self.meta.get_model(sub["model_id"])
+                known |= set(load_model_class(
+                    model["model_bytes"],
+                    model["model_class"]).get_knob_config())
+            unknown = requested - known
+            if unknown:
+                raise ValueError(
+                    f"knob_overrides {sorted(unknown)} match no knob of "
+                    f"any model in this job (known: {sorted(known)})")
+
         spawned: List[ManagedService] = []
-        for sub in self.meta.get_sub_train_jobs_of_train_job(train_job_id):
+        for sub in subs:
             model = self.meta.get_model(sub["model_id"])
             model_file = self.workdir / f"model-{model['id']}.py"
             model_file.write_bytes(model["model_bytes"])

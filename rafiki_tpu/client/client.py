@@ -143,8 +143,12 @@ class Client:
         body: Dict[str, Any] = {"queries": _jsonable(queries)}
         if timeout is not None:
             body["timeout"] = timeout
+        # the socket must outlive the server-side gather deadline, or a
+        # slow-but-working predictor (first-request compile) looks dead
+        sock_timeout = self.timeout if timeout is None else \
+            max(self.timeout, timeout + 30.0)
         out = json_request("POST", f"{predictor_url.rstrip('/')}/predict",
-                           body, timeout=self.timeout)
+                           body, timeout=sock_timeout)
         return out["predictions"]
 
 

@@ -96,13 +96,16 @@ class LoRADense(nn.Module):
         d_in = x.shape[-1]
         kernel = self.param("kernel", nn.initializers.lecun_normal(),
                             (d_in, self.features))
-        y = x @ kernel
+        # compute in x's dtype (params stay f32): a bf16 activation must
+        # not promote the matmul to f32, which costs ~3x on the MXU
+        y = x @ kernel.astype(x.dtype)
         if self.rank > 0:
             a = self.param("lora_a", nn.initializers.normal(0.02),
                            (d_in, self.rank))
             b = self.param("lora_b", nn.initializers.zeros,
                            (self.rank, self.features))
-            y = y + ((x @ a) @ b) * (self.alpha / self.rank)
+            y = y + ((x @ a.astype(x.dtype)) @ b.astype(x.dtype)) * (
+                self.alpha / self.rank)
         return y
 
 
@@ -206,6 +209,10 @@ class Llama(nn.Module):
     n_kv_heads: int = 8
     mlp_dim: int = 14336
     lora_rank: int = 0
+    # compute dtype for activations/matmuls (params stay f32). None =
+    # f32 compute; templates pass bf16 on TPU (f32 matmuls lower to
+    # ~3x-cost multi-pass bf16 on the MXU).
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, ids: jnp.ndarray, lens: Optional[jnp.ndarray] = None,
@@ -218,6 +225,8 @@ class Llama(nn.Module):
             lens = jnp.full((b,), s, jnp.int32)
         x = nn.Embed(self.vocab_size, self.hidden_dim,
                      name="tok_embed")(ids)
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
         for i in range(self.depth):
             x = _DecoderBlock(self.n_heads, self.n_kv_heads, self.mlp_dim,
                               self.max_len, self.lora_rank,
@@ -332,6 +341,7 @@ class LlamaLoRA(BaseModel):
                                               shape_relevant=True),
             "learning_rate": FloatKnob(1e-4, 3e-2, is_exp=True),
             "batch_size": CategoricalKnob([8, 16, 32], shape_relevant=True),
+            "bf16": CategoricalKnob([True, False]),
             "quick_train": PolicyKnob("QUICK_TRAIN"),
             "share_params": PolicyKnob("SHARE_PARAMS"),
         }
@@ -354,7 +364,13 @@ class LlamaLoRA(BaseModel):
                      max_len=int(k["max_len"]), hidden_dim=hd,
                      depth=int(k["depth"]), n_heads=heads,
                      n_kv_heads=kv_heads, mlp_dim=4 * hd,
-                     lora_rank=int(k["lora_rank"]))
+                     lora_rank=int(k["lora_rank"]),
+                     dtype=self._dtype())
+
+    def _dtype(self):
+        # single source of truth for the bf16 knob → compute dtype
+        # (params stay f32; the matmul-heavy layers run in this dtype)
+        return jnp.bfloat16 if self.knobs.get("bf16", True) else None
 
     def _encode_lm(self, texts: Sequence[str]) -> Tuple[np.ndarray,
                                                         np.ndarray]:

@@ -1,0 +1,104 @@
+"""Hardware block-size sweep for the Pallas flash-attention kernels.
+
+Times fwd+bwd of `flash_attention` on the real TPU across block_q/block_k
+candidates for the shapes our templates actually run (ViT-B/16 seq 197→256
+d64 h12; BERT seq 128; Llama seq 512 GQA), plus the pure-XLA attention as
+the thing to beat. Prints a JSON report; run manually when the axon tunnel
+claims (VERDICT r02 "weak #3": block sizes never timed on hardware).
+
+Usage: python scripts/tune_attention_tpu.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from rafiki_tpu.ops.attention import _attention_reference, flash_attention
+
+
+def _time_fn(fn, *args, iters: int = 20) -> float:
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3  # ms
+
+
+def sweep(shape, causal: bool, blocks, iters: int) -> list[dict]:
+    b, h, s, d = shape
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (b, h, s, d), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, h, s, d), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, h, s, d), jnp.bfloat16)
+
+    rows = []
+
+    def loss(fn):
+        def f(q, k, v):
+            return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+        return jax.jit(jax.value_and_grad(f, argnums=(0, 1, 2)))
+
+    # the thing to beat: XLA's own attention (what jnp einsum+softmax gives)
+    xla_fn = loss(lambda q, k, v: _attention_reference(
+        q, k, v, 1.0 / (d ** 0.5), causal))
+    rows.append({"impl": "xla", "fwd_bwd_ms": _time_fn(
+        xla_fn, q, k, v, iters=iters)})
+
+    for bq, bk in blocks:
+        if bq > s * 2 or bk > s * 2:
+            continue
+        fn = loss(lambda q, k, v, bq=bq, bk=bk: flash_attention(
+            q, k, v, causal=causal, block_q=bq, block_k=bk,
+            interpret=False))
+        try:
+            ms = _time_fn(fn, q, k, v, iters=iters)
+            rows.append({"impl": f"pallas_q{bq}_k{bk}", "fwd_bwd_ms": ms})
+        except Exception as e:  # noqa: BLE001 — record and keep sweeping
+            rows.append({"impl": f"pallas_q{bq}_k{bk}",
+                         "error": repr(e)[:120]})
+    return rows
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    assert jax.default_backend() == "tpu", jax.default_backend()
+    iters = 10 if args.quick else 30
+    blocks = list(itertools.product([128, 256, 512], [128, 256, 512]))
+    if args.quick:
+        blocks = [(128, 128), (256, 128), (256, 256), (512, 256)]
+
+    report = {}
+    cases = {
+        # ViT-B/16: 197 tokens (padded to 256 by the wrapper), 12 heads d64
+        "vit_b16_bs32": ((32 * 1, 12, 197, 64), False),
+        # BERT-base seq128
+        "bert_bs32_s128": ((32, 12, 128, 64), False),
+        # Llama-style causal seq512 (8 kv heads worth after GQA repeat)
+        "llama_bs4_s512": ((4, 32, 512, 128), True),
+    }
+    for name, (shape, causal) in cases.items():
+        report[name] = sweep(shape, causal, blocks, iters)
+        best = min((r for r in report[name] if "fwd_bwd_ms" in r),
+                   key=lambda r: r["fwd_bwd_ms"])
+        print(f"# {name}: best={best['impl']} "
+              f"{best['fwd_bwd_ms']:.2f}ms", flush=True)
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()

@@ -24,7 +24,8 @@ from rafiki_tpu.worker.inference import InferenceWorker
 KNOBS = {"max_epochs": 1, "vocab_size": 1 << 10, "hidden_dim": 32,
          "depth": 2, "n_heads": 4, "kv_ratio": 2, "lora_rank": 4,
          "max_len": 32, "model_parallel": 1, "learning_rate": 1e-2,
-         "batch_size": 8, "quick_train": True, "share_params": False}
+         "batch_size": 8, "bf16": False, "quick_train": True,
+         "share_params": False}
 
 
 @pytest.fixture(scope="module")

@@ -13,8 +13,8 @@ from rafiki_tpu.models.vit import ViT, ViTBase16
 
 TINY = {"patch_size": 4, "hidden_dim": 96, "depth": 2, "n_heads": 4,
         "batch_size": 32, "max_epochs": 5, "learning_rate": 1e-3,
-        "weight_decay": 1e-4, "bf16": False, "quick_train": False,
-        "share_params": False}
+        "weight_decay": 1e-4, "warmup_frac": 0.1, "bf16": False,
+        "quick_train": False, "share_params": False}
 
 
 def test_vit_module_shapes():

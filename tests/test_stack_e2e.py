@@ -124,8 +124,8 @@ def test_full_stack_lm_generation(stack):
         train_args={"advisor": "random", "knob_overrides": {
             "hidden_dim": 64, "depth": 2, "n_heads": 4, "kv_ratio": 2,
             "lora_rank": 4, "max_len": 32, "model_parallel": 1,
-            "learning_rate": 1e-2, "batch_size": 8, "quick_train": True,
-            "share_params": False}})
+            "learning_rate": 1e-2, "batch_size": 8, "bf16": False,
+            "quick_train": True, "share_params": False}})
     job = client.wait_until_train_job_finished(job["id"], timeout=600)
     assert job["status"] == "STOPPED"
     trials = client.get_trials_of_train_job(job["id"])
@@ -138,3 +138,25 @@ def test_full_stack_lm_generation(stack):
     assert len(preds) == 2
     assert all(isinstance(p, str) and p for p in preds), preds
     client.stop_inference_job(ijob["id"])
+
+
+@pytest.mark.slow
+def test_typod_knob_override_rejected_at_api(stack, datasets):
+    """A knob_overrides key matching no model's knob config must 400 at
+    job creation (not silently run the search unpinned), and must not
+    leave a zombie RUNNING job behind."""
+    client, _work = stack
+    tr, va, _val = datasets
+
+    client.login("superadmin@rafiki", "rafiki")
+    model = client.create_model("mlp-typo", "IMAGE_CLASSIFICATION",
+                                JaxFeedForward)
+    with pytest.raises(RuntimeError, match="knob_overrides.*learnin_rate"):
+        client.create_train_job(
+            app="typo-app", task="IMAGE_CLASSIFICATION",
+            train_dataset_id=tr, val_dataset_id=va,
+            budget={"TRIAL_COUNT": 1, "WORKER_COUNT": 1},
+            model_ids=[model["id"]],
+            train_args={"knob_overrides": {"learnin_rate": 1e-4}})
+    job = client.get_train_job_of_app("typo-app")
+    assert job["status"] == "ERRORED", job
