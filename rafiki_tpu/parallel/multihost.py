@@ -1,0 +1,133 @@
+"""Multi-host distributed backend: coordinator bootstrap + global meshes.
+
+The reference's cross-machine story is NCCL/MPI inside its training
+processes plus HTTP/Redis between services (SURVEY.md §5.8). The
+TPU-native equivalent has two halves, and this module is the first:
+
+- **In-program collectives across hosts**: one JAX program spanning every
+  host's chips. Processes rendezvous at a coordinator
+  (:func:`initialize_from_env`), after which ``jax.devices()`` is GLOBAL
+  and a :func:`global_mesh` spans hosts — XLA then routes collectives
+  over ICI within a slice and DCN between slices. No hand-written
+  transport; the "comm backend" is the XLA runtime, which is the point.
+- The host-side control plane (admin/advisor/param store) stays
+  single-coordinator HTTP + kv, exactly like the reference's.
+
+Mesh layout: DCN-connected dimensions MUST be outermost so that the
+fast-changing mesh axes map to ICI neighbors
+(``mesh_utils.create_hybrid_device_mesh`` encodes this); put ``data``
+(gradient all-reduce, latency-tolerant, once per step) across DCN and
+``model``/tensor axes inside a slice.
+
+Verified on one box by ``tests/test_multihost.py``: two real OS
+processes, each owning 4 virtual CPU devices, rendezvous at a local
+coordinator and run one SPMD program over the joint 8-device mesh with a
+cross-process gradient all-reduce.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Sequence
+
+#: env contract for service processes (mirrors the knob style of
+#: utils.platform): unset → single-process mode, no rendezvous.
+COORD_ENV = "RAFIKI_COORDINATOR"          # "host:port"
+NUM_PROCS_ENV = "RAFIKI_NUM_PROCESSES"
+PROC_ID_ENV = "RAFIKI_PROCESS_ID"
+
+
+def initialize_from_env(timeout_s: float = 60.0) -> bool:
+    """Rendezvous this process with its peers if the env asks for it.
+
+    Must run before any jax backend initializes. Returns True when a
+    multi-process runtime was set up (``jax.devices()`` is now global),
+    False for ordinary single-process mode. Idempotent.
+    """
+    coord = os.environ.get(COORD_ENV, "")
+    if not coord:
+        return False
+    n_procs = os.environ.get(NUM_PROCS_ENV, "")
+    proc_id = os.environ.get(PROC_ID_ENV, "")
+    if not n_procs or not proc_id:
+        raise ValueError(
+            f"{COORD_ENV} is set but {NUM_PROCS_ENV}={n_procs!r} / "
+            f"{PROC_ID_ENV}={proc_id!r}: a multi-host rendezvous needs "
+            "all three (unset the coordinator for single-host mode)")
+    import jax
+
+    if getattr(initialize_from_env, "_done", False):
+        return True
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=int(n_procs),
+        process_id=int(proc_id),
+        initialization_timeout=int(timeout_s))
+    initialize_from_env._done = True
+    return True
+
+
+def global_mesh(data: Optional[int] = None, model: int = 1,
+                devices: Optional[Sequence[Any]] = None):
+    """A (data, model) mesh over ALL processes' devices.
+
+    ``data`` spans hosts (outermost ⇒ DCN), ``model`` stays within a
+    host's slice (innermost ⇒ ICI) — the layout that keeps tensor-
+    parallel collectives off DCN. Single-process callers get the same
+    mesh :func:`rafiki_tpu.parallel.sharding.make_mesh` would build.
+    """
+    import collections
+
+    import jax
+
+    from rafiki_tpu.parallel.sharding import make_mesh
+
+    devs = list(devices) if devices is not None else list(jax.devices())
+    # order devices host-major so reshaping puts `data` across processes
+    # and `model` within one process's chips
+    devs = sorted(devs, key=lambda d: (d.process_index, d.id))
+    per_proc = collections.Counter(d.process_index for d in devs)
+    if len(per_proc) > 1 and any(c % model for c in per_proc.values()):
+        # a model group crossing hosts would route tensor-parallel
+        # collectives over DCN — refuse rather than silently degrade
+        raise ValueError(
+            f"model={model} does not divide every host's local device "
+            f"count {dict(per_proc)}; tensor parallelism must stay on "
+            "one host's ICI")
+    return make_mesh(devs, data=data, model=model)
+
+
+def global_batch(local_batch: Any, mesh) -> Any:
+    """Assemble each host's local batch shard into one global array tree.
+
+    Every process passes its OWN slice of the global batch (equal sizes);
+    the result is a pytree of jax global arrays sharded batch-over-
+    ``data`` that any pjit step function consumes directly — the
+    data-loading pattern for multi-host training (each host reads only
+    its shard; no host ever materializes the global batch).
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from rafiki_tpu.parallel.sharding import DATA_AXIS
+
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+
+    def place(x):
+        return jax.make_array_from_process_local_data(
+            sharding, np.asarray(x))
+
+    return jax.tree_util.tree_map(place, local_batch)
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    import jax
+
+    return jax.process_index() == 0

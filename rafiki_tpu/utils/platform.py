@@ -14,12 +14,47 @@ import os
 #: set by the ServicesManager on children: "cpu" | "tpu" | "" (inherit)
 PLATFORM_ENV = "RAFIKI_JAX_PLATFORM"
 
+#: persistent XLA-executable cache shared by all service processes. Trials
+#: are separate processes but overwhelmingly compile the SAME programs
+#: (same template, same shape-relevant knobs across rungs/replicas), so a
+#: disk cache turns every repeat compile into a load — this is the
+#: "cache compiled executables by shape-signature" obligation from
+#: SURVEY.md §7. Override/disable with RAFIKI_COMPILE_CACHE=path|off.
+CACHE_ENV = "RAFIKI_COMPILE_CACHE"
+
 
 def apply_platform_env() -> str:
-    """Apply the requested platform before jax backends initialize."""
+    """Apply platform + compile-cache config before jax backends init.
+
+    Keeps the no-op path jax-free: numpy-only services (the predictor)
+    call this too and must not pay a jax import for nothing.
+    """
     platform = os.environ.get(PLATFORM_ENV, "")
     if platform and platform != "tpu":
         import jax
 
         jax.config.update("jax_platforms", platform)
+    cache = os.environ.get(CACHE_ENV, "")
+    if cache != "off":
+        cache = os.path.expanduser(cache) if cache else os.path.join(
+            os.path.expanduser("~"), ".cache", "rafiki_tpu", "xla_cache")
+        try:
+            os.makedirs(cache, exist_ok=True)
+        except OSError:
+            return platform  # unwritable dir: run without the cache
+        import sys
+
+        if "jax" in sys.modules:  # already imported (e.g. sitecustomize):
+            # env vars were read at import time — use config updates
+            try:
+                jax = sys.modules["jax"]
+                jax.config.update("jax_compilation_cache_dir", cache)
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 0.3)
+            except AttributeError:
+                pass  # older jax without these knobs
+        else:  # defer via env: numpy-only services never pay a jax import
+            os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache)
+            os.environ.setdefault(
+                "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.3")
     return platform

@@ -148,9 +148,11 @@ def main(argv: Optional[list] = None) -> int:
     import argparse
     import json
 
+    from ..parallel.multihost import initialize_from_env
     from ..utils.platform import apply_platform_env
 
     apply_platform_env()  # before any jax backend initializes
+    initialize_from_env()  # multi-host rendezvous (no-op if unconfigured)
 
     from ..advisor.service import AdvisorClient
     from ..model.base import load_model_class

@@ -1,0 +1,125 @@
+"""Multi-host distributed backend, scaled down to one box (SURVEY.md §4):
+two REAL OS processes rendezvous at a JAX coordination service and run one
+SPMD program over their joint device set — the same code path a v5e
+multi-host pod uses, with virtual CPU devices standing in for chips.
+
+Runs as subprocesses (not in-proc fakes) because jax.distributed wires a
+per-process global runtime; the parent asserts on both children's output.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from rafiki_tpu.parallel.multihost import (
+        global_batch, global_mesh, initialize_from_env, is_coordinator)
+
+    assert initialize_from_env(), "env did not request multi-process"
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8, len(jax.devices())  # 2 hosts x 4
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = global_mesh(data=4, model=2)
+    # `data` rows must span processes (DCN-outermost layout)
+    row_procs = {d.process_index for d in mesh.devices[:, 0]}
+    assert len(row_procs) == 2, row_procs
+
+    # each "host" contributes ITS half of the global batch
+    pid = jax.process_index()
+    local = np.arange(8, dtype=np.float32).reshape(8, 1) + 8 * pid
+    batch = global_batch({"x": local}, mesh)
+    assert batch["x"].shape == (16, 1)
+
+    @jax.jit
+    def global_mean(b):
+        return jnp.mean(b["x"])  # cross-process all-reduce under the hood
+
+    out = float(global_mean(batch))
+    assert abs(out - 7.5) < 1e-6, out  # mean(0..15): needs BOTH halves
+    print(f"proc{pid} ok mean={out} coordinator={is_coordinator()}",
+          flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_two_process_global_mesh_allreduce(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update({
+            "RAFIKI_COORDINATOR": f"127.0.0.1:{port}",
+            "RAFIKI_NUM_PROCESSES": "2",
+            "RAFIKI_PROCESS_ID": str(pid),
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        env.pop("JAX_PLATFORMS", None)  # child pins cpu itself
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", CHILD], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc{pid} failed:\n{out}"
+        assert f"proc{pid} ok mean=7.5" in out, out
+    assert "coordinator=True" in outs[0]
+
+
+class _FakeDev:
+    def __init__(self, pid, did):
+        self.process_index, self.id = pid, did
+
+    def __repr__(self):
+        return f"d{self.process_index}.{self.id}"
+
+
+def test_global_mesh_refuses_model_axis_across_hosts():
+    from rafiki_tpu.parallel.multihost import global_mesh
+
+    # 4 hosts x 2 devices, model=4: a model group would span two hosts
+    devs = [_FakeDev(p, d) for p in range(4) for d in range(2)]
+    with pytest.raises(ValueError, match="ICI"):
+        global_mesh(data=2, model=4, devices=devs)
+    # model=2 fits within each host: accepted, data rows span hosts
+    mesh = global_mesh(data=4, model=2, devices=devs)
+    for row in mesh.devices:
+        assert len({d.process_index for d in row}) == 1, row
+
+
+def test_initialize_from_env_rejects_partial_env(monkeypatch):
+    from rafiki_tpu.parallel import multihost
+
+    monkeypatch.setenv(multihost.COORD_ENV, "127.0.0.1:1")
+    monkeypatch.delenv(multihost.NUM_PROCS_ENV, raising=False)
+    monkeypatch.delenv(multihost.PROC_ID_ENV, raising=False)
+    with pytest.raises(ValueError, match="RAFIKI_NUM_PROCESSES"):
+        multihost.initialize_from_env()
