@@ -305,6 +305,8 @@ class ServicesManager:
                      "sub_train_job_id": sub["id"],
                      "profile_dir": profile_dir,
                      "knob_overrides": overrides,
+                     "checkpoint_interval_s": job["train_args"].get(
+                         "checkpoint_interval_s", 30.0),
                      "worker_id": f"tw-{sub['id'][:8]}-{w}"},
                     ServiceType.TRAIN_WORKER, slot=slot,
                     train_job_id=train_job_id, sub_train_job_id=sub["id"])

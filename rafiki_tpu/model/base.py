@@ -57,6 +57,14 @@ class TrainContext:
     #: writes it here (SURVEY.md §5.1 — a per-trial capability the
     #: reference lacks); templates may also drop their own artifacts here
     profile_dir: Optional[str] = None
+    #: preemption safety (SURVEY.md §5.3): when set, templates call
+    #: ``ctx.checkpoint(self.dump_parameters, frac_done=(e+1)/epochs)`` at
+    #: epoch boundaries with a ZERO-ARG blob factory — the worker
+    #: throttles by wall clock and only then materializes the blob (host
+    #: copy) and saves it. ``frac_done`` records training progress so a
+    #: resumed trial trains only the REMAINING budget, keeping scores
+    #: comparable to un-preempted trials.
+    checkpoint: Optional[Any] = None
 
 
 class BaseModel(abc.ABC):

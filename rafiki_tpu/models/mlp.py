@@ -137,6 +137,11 @@ class JaxFeedForward(BaseModel):
                 losses.append(float(loss))
             mean_loss = float(np.mean(losses))
             ctx.logger.log(epoch=epoch, loss=mean_loss)
+            if ctx.checkpoint is not None:
+                # preemption safety: worker throttles + persists
+                self._params = params
+                ctx.checkpoint(self.dump_parameters,
+                               frac_done=(epoch + 1) / epochs)
             if ctx.should_continue is not None and \
                     not ctx.should_continue(epoch, -mean_loss):
                 break

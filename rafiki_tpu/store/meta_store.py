@@ -55,7 +55,7 @@ CREATE TABLE IF NOT EXISTS trials (
     trial_no INTEGER NOT NULL, model_id TEXT NOT NULL,
     worker_id TEXT, knobs TEXT, score REAL, budget_scale REAL DEFAULT 1.0,
     shape_signature TEXT, status TEXT NOT NULL,
-    params_saved INTEGER DEFAULT 0, error TEXT,
+    params_saved INTEGER DEFAULT 0, error TEXT, heartbeat_at REAL,
     started_at REAL, stopped_at REAL, created_at REAL NOT NULL);
 CREATE INDEX IF NOT EXISTS idx_trials_job ON trials(sub_train_job_id);
 CREATE TABLE IF NOT EXISTS trial_logs (
@@ -102,6 +102,13 @@ class MetaStore:
             self._conn.execute("PRAGMA busy_timeout=10000")
             self._conn.execute("PRAGMA foreign_keys=ON")
             self._conn.executescript(_SCHEMA)
+            # migrate pre-heartbeat databases (column added for
+            # preemption-safe trials; no-op once present)
+            try:
+                self._conn.execute(
+                    "ALTER TABLE trials ADD COLUMN heartbeat_at REAL")
+            except sqlite3.OperationalError:
+                pass
             self._conn.commit()
 
     def close(self) -> None:
@@ -322,13 +329,57 @@ class MetaStore:
         self._update("trials", trial_id, fields)
 
     def mark_trial_completed(self, trial_id: str, score: float,
-                             params_saved: bool) -> None:
-        self.update_trial(trial_id, status="COMPLETED", score=score,
-                          params_saved=int(params_saved), stopped_at=_now())
+                             params_saved: bool) -> bool:
+        """Fenced terminal update: only a still-RUNNING row completes.
+        Returns False when a resume claimant already TERMINATED the row
+        (this worker was presumed dead, e.g. a long VM suspend) — the
+        caller must then NOT feed the score back to the advisor, or one
+        trial_no gets double feedback."""
+        with self._lock, self._conn:
+            cur = self._conn.execute(
+                "UPDATE trials SET status='COMPLETED', score=?, "
+                "params_saved=?, stopped_at=? WHERE id=? "
+                "AND status='RUNNING'",
+                (score, int(params_saved), _now(), trial_id))
+            return cur.rowcount == 1
 
-    def mark_trial_errored(self, trial_id: str, error: str) -> None:
-        self.update_trial(trial_id, status="ERRORED", error=error[:4000],
-                          stopped_at=_now())
+    def mark_trial_errored(self, trial_id: str, error: str) -> bool:
+        """Fenced like :meth:`mark_trial_completed`."""
+        with self._lock, self._conn:
+            cur = self._conn.execute(
+                "UPDATE trials SET status='ERRORED', error=?, stopped_at=? "
+                "WHERE id=? AND status='RUNNING'",
+                (error[:4000], _now(), trial_id))
+            return cur.rowcount == 1
+
+    def heartbeat_trial(self, trial_id: str) -> None:
+        """Liveness beacon: the owning worker stamps this every few
+        seconds while training, so peers can tell a preempted trial from
+        one that is merely slow."""
+        self.update_trial(trial_id, heartbeat_at=_now())
+
+    def claim_trial_for_resume(self, trial_id: str, worker_id: str,
+                               stale_after_s: float = 60.0) -> bool:
+        """Atomically take ownership of an orphaned trial for resume.
+
+        Eligible: status ERRORED (crash already recorded), or RUNNING
+        with no heartbeat for ``stale_after_s`` — a live peer heartbeats
+        every few seconds, so a fresh heartbeat means the trial is NOT
+        orphaned and the claim loses. The staleness condition sits inside
+        the UPDATE itself, so exactly one concurrent claimant can win and
+        a revived heartbeat between scan and claim voids the claim. The
+        original error text is preserved (pointer appended)."""
+        cutoff = _now() - stale_after_s
+        marker = f"resumed by {worker_id}"
+        with self._lock, self._conn:
+            cur = self._conn.execute(
+                "UPDATE trials SET status='TERMINATED', stopped_at=?, "
+                "error=(CASE WHEN error IS NULL OR error='' THEN ? "
+                "ELSE error || ? END) "
+                "WHERE id=? AND (status='ERRORED' OR (status='RUNNING' "
+                "AND COALESCE(heartbeat_at, started_at, 0) < ?))",
+                (_now(), marker, f" | {marker}", trial_id, cutoff))
+            return cur.rowcount == 1
 
     def get_trials_of_sub_train_job(
             self, sub_train_job_id: str) -> List[Dict[str, Any]]:

@@ -68,6 +68,11 @@ class ParamBackend:
     def keys(self) -> List[str]:
         raise NotImplementedError
 
+    def exists(self, key: str) -> bool:
+        """Presence check without fetching the blob (override where the
+        backend can do better than a full get)."""
+        return self.get(key) is not None
+
 
 class InMemoryBackend(ParamBackend):
     def __init__(self) -> None:
@@ -89,6 +94,10 @@ class InMemoryBackend(ParamBackend):
     def keys(self) -> List[str]:
         with self._lock:
             return list(self._data)
+
+    def exists(self, key: str) -> bool:
+        with self._lock:
+            return key in self._data
 
 
 class FileBackend(ParamBackend):
@@ -155,6 +164,9 @@ class FileBackend(ParamBackend):
             return [k for k, f in self._names.items()
                     if (self.root / f).exists()]
 
+    def exists(self, key: str) -> bool:
+        return (self.root / self._fname(key)).exists()
+
 
 class KVBackend(ParamBackend):
     """Backend over the native kv/queue data-plane server (Redis stand-in)."""
@@ -175,6 +187,9 @@ class KVBackend(ParamBackend):
 
     def keys(self) -> List[str]:
         return [k[len("params:"):] for k in self._client.keys("params:*")]
+
+    def exists(self, key: str) -> bool:
+        return self._client.exists(f"params:{key}")
 
 
 # ---- the store -------------------------------------------------------------
@@ -235,3 +250,23 @@ class ParamStore:
 
     def keys(self) -> List[str]:
         return self.backend.keys()
+
+    def exists(self, trial_id: str) -> bool:
+        """Presence check without fetching/decoding the blob."""
+        with self._lock:
+            if trial_id in self._cache:
+                return True
+        return self.backend.exists(trial_id)
+
+    def copy(self, src: str, dst: str) -> bool:
+        """Bytes-level blob copy — no msgpack decode/re-encode (matters
+        for multi-GB checkpoints on the resume path). False if absent."""
+        with self._lock:
+            data = self._cache.get(src)
+        if data is None:
+            data = self.backend.get(src)
+            if data is None:
+                return False
+        self.backend.put(dst, data)
+        self._cache_put(dst, data)
+        return True

@@ -37,7 +37,8 @@ class TrainWorker:
                  devices: Optional[List[Any]] = None,
                  worker_id: str = "worker-0",
                  profile_dir: Optional[str] = None,
-                 knob_overrides: Optional[dict] = None) -> None:
+                 knob_overrides: Optional[dict] = None,
+                 checkpoint_interval_s: float = 30.0) -> None:
         self.model_class = model_class
         self.advisor = advisor
         self.train_dataset_path = train_dataset_path
@@ -53,6 +54,20 @@ class TrainWorker:
         #: every proposal — how a job fixes e.g. max_len or batch_size
         #: regardless of what the advisor samples
         self.knob_overrides = dict(knob_overrides or {})
+        #: min seconds between mid-trial checkpoints; <=0 disables them
+        self.checkpoint_interval_s = checkpoint_interval_s
+        #: liveness beacon period while a trial trains (threaded, so
+        #: long epochs don't read as death)
+        self.heartbeat_interval_s = 5.0
+        #: a RUNNING trial with no heartbeat for this long is an orphan
+        self.orphan_stale_s = 60.0
+        #: lifetime cap on resumed orphans (bounds ping-pong when a
+        #: resumed trial keeps crashing deterministically across workers)
+        self.max_resumes = 16
+        self._resumes_done = 0
+        #: trial ids created by THIS process (self-resume exclusion that
+        #: still lets a restarted worker reclaim its pre-restart orphan)
+        self._own_trial_ids: set = set()
         self.trials_run = 0
 
     # ---- one trial ----
@@ -63,6 +78,18 @@ class TrainWorker:
 
         if self.knob_overrides:
             proposal.knobs = {**proposal.knobs, **self.knob_overrides}
+        if proposal.meta.get("resumed_from") and \
+                proposal.warm_start_trial_id and \
+                "share_params" in self.model_class.get_knob_config():
+            # AFTER the override merge: a job-level share_params pin must
+            # not silently drop the resume's warm start (the reduced
+            # budget only makes sense on top of the checkpoint)
+            proposal.knobs = {**proposal.knobs, "share_params": True}
+        # resumed trials: the row records the ORIGINAL budget_scale (so a
+        # later re-resume computes remainders against the true total);
+        # only the in-context scale is reduced by progress already made
+        base_frac = float(proposal.meta.get("resume_frac_done") or 0.0)
+        ctx_budget_scale = proposal.budget_scale * max(0.0, 1.0 - base_frac)
         if self.meta_store is not None:
             trial_id = self.meta_store.create_trial(
                 self.sub_train_job_id, proposal.trial_no,
@@ -73,69 +100,281 @@ class TrainWorker:
                     self.model_class.get_knob_config(), proposal.knobs))["id"]
         else:
             trial_id = f"{self.worker_id}-t{proposal.trial_no}"
+        self._own_trial_ids.add(trial_id)
 
         logger = ModelLogger()
         if self.meta_store is not None:
             logger.sink = lambda rec: self.meta_store.add_trial_log(
                 trial_id, rec.kind, rec.data, rec.time)
 
+        # heartbeat covers the trial row's ENTIRE time in RUNNING state —
+        # including the final (possibly multi-GB) parameter save — so a
+        # live finishing trial can never look orphaned to a peer
+        hb_stop = self._start_heartbeat(trial_id)
         try:
-            self.model_class.validate_knobs(proposal.knobs)
-            model = self.model_class(**proposal.knobs)
-            shared = None
-            if proposal.warm_start_trial_id:
-                shared = self.param_store.load(proposal.warm_start_trial_id)
-            trial_profile_dir = None
-            if self.profile_dir:
-                import os
+            try:
+                self.model_class.validate_knobs(proposal.knobs)
+                model = self.model_class(**proposal.knobs)
+                shared = None
+                if proposal.warm_start_trial_id:
+                    shared = self.param_store.load(
+                        proposal.warm_start_trial_id)
+                trial_profile_dir = None
+                if self.profile_dir:
+                    import os
 
-                trial_profile_dir = os.path.join(self.profile_dir, trial_id)
-                os.makedirs(trial_profile_dir, exist_ok=True)
-            ctx = TrainContext(devices=self.devices,
-                               budget_scale=proposal.budget_scale,
-                               shared_params=shared, logger=logger,
-                               trial_id=trial_id,
-                               profile_dir=trial_profile_dir)
-            if trial_profile_dir:
-                # per-trial jax.profiler trace (SURVEY.md §5.1): XLA/HLO
-                # timing + (on TPU) hardware counters, viewable in
-                # TensorBoard / Perfetto
-                import jax
+                    trial_profile_dir = os.path.join(self.profile_dir,
+                                                     trial_id)
+                    os.makedirs(trial_profile_dir, exist_ok=True)
+                ctx = TrainContext(devices=self.devices,
+                                   budget_scale=ctx_budget_scale,
+                                   shared_params=shared, logger=logger,
+                                   trial_id=trial_id,
+                                   profile_dir=trial_profile_dir)
+                ckpt_key = f"ckpt-{trial_id}"
+                if self.checkpoint_interval_s > 0:
+                    self._wire_checkpointing(ctx, ckpt_key, base_frac,
+                                             proposal, shared)
+                if trial_profile_dir:
+                    # per-trial jax.profiler trace (SURVEY.md §5.1):
+                    # XLA/HLO timing + (on TPU) hardware counters,
+                    # viewable in TensorBoard / Perfetto
+                    import jax
 
-                with jax.profiler.trace(trial_profile_dir):
+                    with jax.profiler.trace(trial_profile_dir):
+                        model.train(self.train_dataset_path, ctx)
+                else:
                     model.train(self.train_dataset_path, ctx)
-            else:
-                model.train(self.train_dataset_path, ctx)
-            score = float(model.evaluate(self.val_dataset_path))
+                score = float(model.evaluate(self.val_dataset_path))
 
-            self.param_store.save(trial_id, model.dump_parameters())
-            model.destroy()
-            if self.meta_store is not None:
-                self.meta_store.mark_trial_completed(trial_id, score,
-                                                     params_saved=True)
-            self.advisor.feedback(TrialResult(
-                trial_no=proposal.trial_no, knobs=proposal.knobs,
-                score=score, trial_id=trial_id,
-                budget_scale=proposal.budget_scale, meta=proposal.meta))
-            self.trials_run += 1
-            return score
-        except Exception as e:  # trial-level fault isolation (SURVEY.md §5.3)
-            if self.meta_store is not None:
-                self.meta_store.mark_trial_errored(
-                    trial_id, f"{e}\n{traceback.format_exc()}")
-            self.advisor.trial_errored(proposal.trial_no)
-            return None
+                self.param_store.save(trial_id, model.dump_parameters())
+                model.destroy()
+                fenced_out = False
+                if self.meta_store is not None:
+                    # fenced completion: False = a resume claimant already
+                    # TERMINATED this row (we were presumed dead during a
+                    # long stall) — our duplicate must NOT double-feed the
+                    # advisor for this trial_no
+                    fenced_out = not self.meta_store.mark_trial_completed(
+                        trial_id, score, params_saved=True)
+                try:
+                    # cleanup is best-effort AFTER the terminal mark: a
+                    # kv hiccup here must not void a finished trial
+                    self.param_store.delete(ckpt_key)
+                    self.param_store.delete(f"{ckpt_key}-meta")
+                except Exception:  # noqa: BLE001
+                    pass
+                if not fenced_out:
+                    try:
+                        self.advisor.feedback(TrialResult(
+                            trial_no=proposal.trial_no,
+                            knobs=proposal.knobs,
+                            score=score, trial_id=trial_id,
+                            budget_scale=proposal.budget_scale,
+                            meta=proposal.meta))
+                    except Exception:  # noqa: BLE001
+                        # a resumed trial may outlive its advisor's
+                        # bracket state (advisor restarted with the
+                        # stack); the score is already durable in the
+                        # MetaStore, which is what deployment reads
+                        if not proposal.meta.get("resumed_from"):
+                            raise
+                self.trials_run += 1
+                return score
+            except Exception as e:  # trial fault isolation (SURVEY §5.3)
+                fenced_out = False
+                if self.meta_store is not None:
+                    fenced_out = not self.meta_store.mark_trial_errored(
+                        trial_id, f"{e}\n{traceback.format_exc()}")
+                if not fenced_out:
+                    try:
+                        self.advisor.trial_errored(proposal.trial_no)
+                    except Exception:  # noqa: BLE001 — a dead/restarted
+                        # advisor must not kill the surviving worker; the
+                        # error is durable in the MetaStore either way
+                        pass
+                return None
+        finally:
+            hb_stop()
+
+    def _wire_checkpointing(self, ctx, ckpt_key: str, base_frac: float,
+                            proposal, shared) -> None:
+        """Attach throttled epoch-boundary checkpointing to ``ctx``.
+
+        The blob factory only runs when a save actually happens.
+        ``frac_done`` rides in a tiny sidecar entry (NOT inside the blob —
+        warm-start consumers expect ``dump_parameters()``'s exact shape)
+        and is always GLOBAL progress: a resumed trial's template reports
+        fractions of its REMAINING budget, which are mapped back onto the
+        original total so chained resumes stay correct.
+
+        A resumed trial is also pre-seeded with the orphan's checkpoint
+        under its OWN key, so if this attempt dies before its first
+        throttled save, the warm state is still reachable from this
+        trial's row (the orphan's row is already TERMINATED and will
+        never be scanned again)."""
+        import time as _time
+
+        if proposal.meta.get("resumed_from") and shared is not None:
+            # bytes-level copy: no msgpack re-encode of a possibly
+            # multi-GB tree that was deserialized moments ago
+            self.param_store.copy(proposal.warm_start_trial_id, ckpt_key)
+            if base_frac > 0:
+                self.param_store.save(f"{ckpt_key}-meta",
+                                      {"frac_done": base_frac})
+
+        last_save = [_time.monotonic()]
+
+        def save_checkpoint(make_blob, frac_done=None) -> None:
+            now = _time.monotonic()
+            if now - last_save[0] < self.checkpoint_interval_s:
+                return
+            self.param_store.save(ckpt_key, make_blob())
+            if frac_done is not None:
+                global_frac = base_frac + float(frac_done) * (1 - base_frac)
+                self.param_store.save(f"{ckpt_key}-meta",
+                                      {"frac_done": global_frac})
+            last_save[0] = now
+
+        ctx.checkpoint = save_checkpoint
+
+    def _start_heartbeat(self, trial_id: str):
+        """Stamp the trial row every few seconds while training so peers
+        can tell a preempted trial from a live slow one. Returns a
+        stopper."""
+        if self.meta_store is None:
+            return lambda: None
+        import threading
+
+        stop = threading.Event()
+
+        def beat() -> None:
+            while not stop.wait(self.heartbeat_interval_s):
+                try:
+                    self.meta_store.heartbeat_trial(trial_id)
+                except Exception:  # noqa: BLE001 — never kill the trial
+                    pass
+
+        t = threading.Thread(target=beat, daemon=True,
+                             name=f"hb-{trial_id[:8]}")
+        t.start()
+        return stop.set
+
+    # ---- preemption recovery ----
+    def resume_orphaned_trials(self) -> int:
+        """Finish trials a dead worker left behind (SURVEY.md §5.3).
+
+        Orphan = status ERRORED, or RUNNING with a stale heartbeat (a
+        live owner stamps every ``heartbeat_interval_s``; the staleness
+        test is enforced INSIDE the atomic claim, so a live peer's trial
+        cannot be hijacked and exactly one claimant wins). With a
+        ``ckpt-<id>`` blob the trial resumes warm under the same knobs
+        and trial_no, training only the remaining budget recorded at
+        checkpoint time; without one (killed before the first throttled
+        save) it re-runs cold — either way no zombie RUNNING rows remain.
+        """
+        if self.meta_store is None or self._resumes_done >= self.max_resumes:
+            return 0
+        import json as _json
+
+        from ..advisor.base import Proposal
+
+        n = 0
+        for t in self.meta_store.get_trials_of_sub_train_job(
+                self.sub_train_job_id):
+            if t["status"] not in ("RUNNING", "ERRORED"):
+                continue
+            if t["id"] in self._own_trial_ids:
+                # trials from THIS process's lifetime: own failures are
+                # code errors, not preemption, and a worker must never
+                # loop resuming its own deterministic crash. (Keyed by
+                # trial id, not worker_id — a RESTARTED worker with the
+                # same deterministic name has an empty set and correctly
+                # reclaims its pre-restart orphan.)
+                continue
+            if self._resumes_done >= self.max_resumes:
+                break  # bound cross-worker ping-pong on persistent bugs
+            if not self.meta_store.claim_trial_for_resume(
+                    t["id"], self.worker_id,
+                    stale_after_s=self.orphan_stale_s):
+                continue  # live heartbeat, or another worker won
+            ckpt_key = f"ckpt-{t['id']}"
+            has_ckpt = self.param_store.exists(ckpt_key)
+            frac = 0.0
+            if has_ckpt:
+                meta = self.param_store.load(f"{ckpt_key}-meta")
+                if meta and meta.get("frac_done"):
+                    frac = float(meta["frac_done"])
+            knobs = t["knobs"]
+            if isinstance(knobs, str):
+                knobs = _json.loads(knobs)
+            # the new row keeps the ORIGINAL budget_scale; run_trial
+            # reduces only the in-context budget by frac and pre-seeds
+            # the new trial's own checkpoint from the orphan's, so a
+            # crashed resume is itself resumable at the right progress
+            score = self.run_trial(Proposal(
+                trial_no=int(t["trial_no"]), knobs=knobs,
+                budget_scale=float(t["budget_scale"] or 1.0),
+                warm_start_trial_id=ckpt_key if has_ckpt else "",
+                meta={"resumed_from": t["id"],
+                      "resume_frac_done": frac}))
+            if score is not None:
+                # delete the orphan's blob only on a COMPLETED resume: a
+                # failed attempt may have died before the pre-seed copied
+                # it, and this TERMINATED row's ckpt is then the only
+                # warm state left (a successful pre-seed makes it merely
+                # redundant — a bounded, harmless leak on failure)
+                try:
+                    self.param_store.delete(ckpt_key)
+                    self.param_store.delete(f"{ckpt_key}-meta")
+                except Exception:  # noqa: BLE001 — cleanup must never
+                    pass           # kill the worker loop
+            self._resumes_done += 1
+            n += 1
+        return n
 
     # ---- the loop ----
     def run(self, max_trials: Optional[int] = None) -> int:
-        """Pull proposals until the advisor says stop; returns #trials."""
-        n = 0
+        """Pull proposals until the advisor says stop; returns #trials.
+
+        Orphan pickup happens at startup, between proposals, AND in a
+        bounded linger after the advisor is exhausted — a peer preempted
+        moments ago has a trial that only turns claimably stale after
+        ``orphan_stale_s``, and exiting immediately would strand it as a
+        zombie the job finalizer can't resolve.
+        """
+        n = self.resume_orphaned_trials()
         while max_trials is None or n < max_trials:
             proposal = self.advisor.propose()
             if not proposal.is_valid:
                 break
             self.run_trial(proposal)
             n += 1
+            n += self.resume_orphaned_trials()
+        n += self._linger_for_orphans()
+        return n
+
+    def _linger_for_orphans(self) -> int:
+        """Wait (bounded) for peers' RUNNING trials to either finish or
+        turn stale, resuming any that do. A live peer ends the linger
+        early by completing; a dead one becomes claimable within
+        ``orphan_stale_s``."""
+        if self.meta_store is None:
+            return 0
+        import time as _time
+
+        deadline = _time.monotonic() + self.orphan_stale_s \
+            + 2 * self.heartbeat_interval_s
+        n = 0
+        while _time.monotonic() < deadline:
+            peers_running = any(
+                t["status"] == "RUNNING" and t["worker_id"] != self.worker_id
+                for t in self.meta_store.get_trials_of_sub_train_job(
+                    self.sub_train_job_id))
+            if not peers_running:
+                break
+            n += self.resume_orphaned_trials()
+            _time.sleep(min(2.0, self.heartbeat_interval_s))
         return n
 
 
@@ -182,7 +421,9 @@ def main(argv: Optional[list] = None) -> int:
         model_id=cfg.get("model_id", ""),
         worker_id=cfg.get("worker_id", "worker-0"),
         profile_dir=cfg.get("profile_dir"),
-        knob_overrides=cfg.get("knob_overrides"))
+        knob_overrides=cfg.get("knob_overrides"),
+        checkpoint_interval_s=float(
+            cfg.get("checkpoint_interval_s", 30.0)))
     n = worker.run()
     print(f"train worker {worker.worker_id} done: {n} trials", flush=True)
     return 0

@@ -1,0 +1,321 @@
+"""Preemption-safe trials (SURVEY.md §5.3): mid-train checkpoints +
+atomic claim + warm resume of trials a dead worker left behind."""
+
+from typing import Any, Optional
+
+import numpy as np
+import pytest
+
+from rafiki_tpu.advisor.base import make_advisor
+from rafiki_tpu.model.base import BaseModel, TrainContext
+from rafiki_tpu.model.knob import FixedKnob, PolicyKnob
+from rafiki_tpu.store.meta_store import MetaStore
+from rafiki_tpu.store.param_store import ParamStore
+from rafiki_tpu.worker.train import TrainWorker
+
+
+class ToyModel(BaseModel):
+    """5-"epoch" counter model: w += 1 per epoch, checkpointing each one.
+    Evaluate returns w, so a warm resume is visible as w > fresh-train w."""
+
+    TASKS = ("IMAGE_CLASSIFICATION",)
+    FAIL_AT: Optional[int] = None  # raise after this epoch's checkpoint
+
+    @staticmethod
+    def get_knob_config():
+        return {"max_epochs": FixedKnob(5),
+                "share_params": PolicyKnob("SHARE_PARAMS")}
+
+    def __init__(self, **knobs: Any) -> None:
+        super().__init__(**knobs)
+        self._w = None
+
+    def train(self, dataset_path: str,
+              ctx: Optional[TrainContext] = None) -> None:
+        ctx = ctx or TrainContext()
+        self._w = np.zeros(())
+        if ctx.shared_params is not None and self.knobs.get("share_params"):
+            self._w = np.asarray(ctx.shared_params["w"])
+        epochs = max(1, round(5 * float(ctx.budget_scale)))
+        for epoch in range(epochs):
+            self._w = self._w + 1.0
+            if ctx.checkpoint is not None:
+                # like the real templates: fraction of the ASSIGNED
+                # budget (the worker maps it to global progress)
+                ctx.checkpoint(self.dump_parameters,
+                               frac_done=(epoch + 1) / epochs)
+            if self.FAIL_AT is not None and epoch >= self.FAIL_AT:
+                raise RuntimeError("simulated preemption")
+
+    def evaluate(self, dataset_path: str) -> float:
+        return float(self._w)
+
+    def predict(self, queries):
+        return [0 for _ in queries]
+
+    def dump_parameters(self):
+        return {"w": np.asarray(self._w)}
+
+    def load_parameters(self, params):
+        self._w = np.asarray(params["w"])
+
+
+class FlakyToyModel(ToyModel):
+    FAIL_AT = 2  # dies with w == 3 checkpointed
+
+
+def _worker(model_class, meta, store, sub_id, wid, trials):
+    return TrainWorker(
+        model_class=model_class,
+        advisor=make_advisor(model_class.get_knob_config(), "random",
+                             total_trials=trials),
+        train_dataset_path="unused", val_dataset_path="unused",
+        param_store=store, meta_store=meta, sub_train_job_id=sub_id,
+        model_id="m0", worker_id=wid,
+        checkpoint_interval_s=1e-9)  # checkpoint every epoch
+
+
+@pytest.fixture()
+def stores(tmp_path):
+    meta = MetaStore(str(tmp_path / "meta.db"))
+    user = meta.create_user("u@x", "pw", "ADMIN")
+    model = meta.create_model(user["id"], "toy", "IMAGE_CLASSIFICATION",
+                              "ToyModel", b"")
+    job = meta.create_train_job(user["id"], "app", 1,
+                                "IMAGE_CLASSIFICATION", {"TRIAL_COUNT": 1},
+                                "tr", "va")
+    sub = meta.create_sub_train_job(job["id"], model["id"])
+    return meta, ParamStore.from_uri("mem://"), sub["id"]
+
+
+def test_preempted_trial_leaves_checkpoint(stores):
+    meta, store, sub_id = stores
+    w = _worker(FlakyToyModel, meta, store, sub_id, "w0", trials=1)
+    w.run(max_trials=1)
+    trials = meta.get_trials_of_sub_train_job(sub_id)
+    assert len(trials) == 1 and trials[0]["status"] == "ERRORED"
+    ckpt = store.load(f"ckpt-{trials[0]['id']}")
+    assert ckpt is not None and float(np.asarray(ckpt["w"])) == 3.0
+
+
+def test_resume_finishes_orphan_warm(stores):
+    meta, store, sub_id = stores
+    _worker(FlakyToyModel, meta, store, sub_id, "w0", 1).run(max_trials=1)
+    old = meta.get_trials_of_sub_train_job(sub_id)[0]
+
+    # a replacement worker picks the orphan up before asking the advisor
+    w2 = _worker(ToyModel, meta, store, sub_id, "w1", trials=0)
+    assert w2.resume_orphaned_trials() == 1
+
+    trials = meta.get_trials_of_sub_train_job(sub_id)
+    by_status = {t["status"]: t for t in trials}
+    assert by_status["TERMINATED"]["id"] == old["id"]
+    assert "resumed by w1" in by_status["TERMINATED"]["error"]
+    done = by_status["COMPLETED"]
+    assert done["trial_no"] == old["trial_no"]
+    # warm start + remaining-budget scaling: resumed from w=3 with
+    # frac_done=3/5, so it trains round(5*0.4)=2 more epochs → 5, the
+    # SAME total budget an un-preempted trial gets (scores comparable)
+    assert done["score"] == 5.0
+    # the orphan's checkpoint is consumed; the resumed trial's own
+    # checkpoint is superseded by its final params
+    assert store.load(f"ckpt-{old['id']}") is None
+    assert store.load(f"ckpt-{done['id']}") is None
+    assert store.load(done["id"]) is not None  # final params saved
+
+
+def test_claim_is_exclusive(stores):
+    meta, store, sub_id = stores
+    _worker(FlakyToyModel, meta, store, sub_id, "w0", 1).run(max_trials=1)
+    tid = meta.get_trials_of_sub_train_job(sub_id)[0]["id"]
+    assert meta.claim_trial_for_resume(tid, "w1") is True
+    assert meta.claim_trial_for_resume(tid, "w2") is False
+
+
+def test_completed_trials_never_resumed(stores):
+    meta, store, sub_id = stores
+    _worker(ToyModel, meta, store, sub_id, "w0", 1).run(max_trials=1)
+    w2 = _worker(ToyModel, meta, store, sub_id, "w1", trials=0)
+    assert w2.resume_orphaned_trials() == 0
+
+
+def test_worker_never_resumes_own_failure(stores):
+    meta, store, sub_id = stores
+    w = _worker(FlakyToyModel, meta, store, sub_id, "w0", trials=2)
+    w.run(max_trials=2)  # in-loop orphan scan must skip its own wrecks
+    trials = meta.get_trials_of_sub_train_job(sub_id)
+    assert all(t["status"] == "ERRORED" for t in trials), trials
+    assert len(trials) == 2  # two advisor proposals, zero self-resumes
+
+
+def test_resume_cap_bounds_pingpong(stores):
+    meta, store, sub_id = stores
+    _worker(FlakyToyModel, meta, store, sub_id, "w0", 1).run(max_trials=1)
+    w2 = _worker(FlakyToyModel, meta, store, sub_id, "w1", trials=0)
+    w2.max_resumes = 1
+    # resumed trial ALSO crashes (leaves its own orphan under w1) but the
+    # cap stops w1 from chasing anything further
+    assert w2.resume_orphaned_trials() == 1
+    assert w2.resume_orphaned_trials() == 0
+
+
+def test_live_peer_trial_is_not_hijacked(stores):
+    meta, store, sub_id = stores
+    # simulate worker A 40s into a trial, heartbeating normally
+    t = meta.create_trial(sub_id, 0, model_id="m0", worker_id="wA",
+                          knobs={"max_epochs": 5, "share_params": False})
+    meta.heartbeat_trial(t["id"])
+    store.save(f"ckpt-{t['id']}", {"w": np.asarray(2.0)})
+
+    w2 = _worker(ToyModel, meta, store, sub_id, "wB", trials=0)
+    assert w2.resume_orphaned_trials() == 0  # fresh heartbeat → hands off
+    assert meta.get_trial(t["id"])["status"] == "RUNNING"
+    # claim with an artificially generous staleness still refuses
+    assert meta.claim_trial_for_resume(t["id"], "wB",
+                                       stale_after_s=60.0) is False
+
+
+def test_stale_running_trial_is_resumed(stores):
+    meta, store, sub_id = stores
+    # dead worker: RUNNING trial, heartbeat long gone, ckpt present
+    t = meta.create_trial(sub_id, 0, model_id="m0", worker_id="wA",
+                          knobs={"max_epochs": 5, "share_params": False})
+    meta.update_trial(t["id"], heartbeat_at=0.0)  # epoch 1970
+    store.save(f"ckpt-{t['id']}", {"w": np.asarray(3.0)})
+    store.save(f"ckpt-{t['id']}-meta", {"frac_done": 3 / 5})
+
+    w2 = _worker(ToyModel, meta, store, sub_id, "wB", trials=0)
+    assert w2.resume_orphaned_trials() == 1
+    done = [x for x in meta.get_trials_of_sub_train_job(sub_id)
+            if x["status"] == "COMPLETED"]
+    assert len(done) == 1 and done[0]["score"] == 5.0
+
+
+def test_checkpointless_zombie_gets_cold_rerun(stores):
+    meta, store, sub_id = stores
+    # killed before the first throttled checkpoint: RUNNING, no ckpt
+    t = meta.create_trial(sub_id, 0, model_id="m0", worker_id="wA",
+                          knobs={"max_epochs": 5, "share_params": False})
+    meta.update_trial(t["id"], heartbeat_at=0.0)
+
+    w2 = _worker(ToyModel, meta, store, sub_id, "wB", trials=0)
+    assert w2.resume_orphaned_trials() == 1
+    by_status = {x["status"]: x for x in
+                 meta.get_trials_of_sub_train_job(sub_id)}
+    assert by_status["TERMINATED"]["id"] == t["id"]  # no zombie row
+    assert by_status["COMPLETED"]["score"] == 5.0  # full cold re-run
+
+
+def test_failed_resume_chains_warm_state(stores):
+    meta, store, sub_id = stores
+
+    class AlwaysFail(ToyModel):
+        FAIL_AT = 0
+
+    _worker(FlakyToyModel, meta, store, sub_id, "w0", 1).run(max_trials=1)
+    old = meta.get_trials_of_sub_train_job(sub_id)[0]
+    # the resume attempt ALSO crashes → warm state must remain reachable
+    # from the NEW (errored) row, since the old row is TERMINATED and
+    # never scanned again
+    w2 = _worker(AlwaysFail, meta, store, sub_id, "w1", trials=0)
+    assert w2.resume_orphaned_trials() == 1
+    errored = [t for t in meta.get_trials_of_sub_train_job(sub_id)
+               if t["status"] == "ERRORED"]
+    assert len(errored) == 1 and errored[0]["worker_id"] == "w1"
+    # pre-seeded checkpoint + GLOBAL progress sidecar on the new row
+    new_ckpt = store.load(f"ckpt-{errored[0]['id']}")
+    assert new_ckpt is not None
+    meta_blob = store.load(f"ckpt-{errored[0]['id']}-meta")
+    assert meta_blob and meta_blob["frac_done"] >= 3 / 5
+    # the new row records the ORIGINAL budget scale, so a third worker
+    # resuming it computes the remainder against the true total
+    assert errored[0]["budget_scale"] == 1.0
+    # failed resume → the orphan's own blob is conservatively KEPT (the
+    # pre-seed might not have happened); only a completed resume deletes
+    assert store.load(f"ckpt-{old['id']}") is not None
+
+    # and the chain actually completes: a third worker finishes it warm
+    w3 = _worker(ToyModel, meta, store, sub_id, "w2", trials=0)
+    assert w3.resume_orphaned_trials() == 1
+    done = [t for t in meta.get_trials_of_sub_train_job(sub_id)
+            if t["status"] == "COMPLETED"]
+    assert len(done) == 1 and done[0]["score"] == 5.0
+
+
+def test_original_error_text_preserved_on_claim(stores):
+    meta, store, sub_id = stores
+    _worker(FlakyToyModel, meta, store, sub_id, "w0", 1).run(max_trials=1)
+    tid = meta.get_trials_of_sub_train_job(sub_id)[0]["id"]
+    assert meta.claim_trial_for_resume(tid, "w1") is True
+    err = meta.get_trial(tid)["error"]
+    assert "simulated preemption" in err and "resumed by w1" in err
+
+
+def test_end_of_run_linger_catches_fresh_orphan(stores):
+    meta, store, sub_id = stores
+    # peer wA died seconds ago: RUNNING, heartbeat fresh-ish but about to
+    # turn stale; the advisor-exhausted worker must linger and claim it
+    t = meta.create_trial(sub_id, 0, model_id="m0", worker_id="wA",
+                          knobs={"max_epochs": 5, "share_params": False})
+    meta.heartbeat_trial(t["id"])
+    store.save(f"ckpt-{t['id']}", {"w": np.asarray(3.0)})
+    store.save(f"ckpt-{t['id']}-meta", {"frac_done": 3 / 5})
+
+    w2 = _worker(ToyModel, meta, store, sub_id, "wB", trials=0)
+    w2.orphan_stale_s = 1.5
+    w2.heartbeat_interval_s = 0.3
+    assert w2.run(max_trials=None) == 1  # advisor empty → linger resumes
+    by_status = {x["status"]: x for x in
+                 meta.get_trials_of_sub_train_job(sub_id)}
+    assert by_status["COMPLETED"]["score"] == 5.0
+    assert by_status["TERMINATED"]["id"] == t["id"]
+
+
+def test_linger_exits_early_when_peer_finishes(stores):
+    import threading
+    import time
+
+    meta, store, sub_id = stores
+    t = meta.create_trial(sub_id, 0, model_id="m0", worker_id="wA",
+                          knobs={"max_epochs": 5, "share_params": False})
+    meta.heartbeat_trial(t["id"])
+
+    def finish_soon():
+        time.sleep(0.6)
+        meta.mark_trial_completed(t["id"], 1.0, params_saved=False)
+
+    threading.Thread(target=finish_soon, daemon=True).start()
+    w2 = _worker(ToyModel, meta, store, sub_id, "wB", trials=0)
+    w2.orphan_stale_s = 30.0  # linger window long; must NOT wait it out
+    t0 = time.monotonic()
+    assert w2.run(max_trials=None) == 0
+    assert time.monotonic() - t0 < 10.0  # exited when the peer completed
+    assert meta.get_trial(t["id"])["status"] == "COMPLETED"  # untouched
+
+
+def test_restarted_worker_reclaims_own_orphan(stores):
+    meta, store, sub_id = stores
+    # process 1 of worker "w0" dies mid-trial (stale heartbeat)
+    _worker(FlakyToyModel, meta, store, sub_id, "w0", 1).run(max_trials=1)
+    # process 2 boots with the SAME deterministic worker_id (restart
+    # adoption); its own-trial set is empty, so it must reclaim
+    w_restarted = _worker(ToyModel, meta, store, sub_id, "w0", trials=0)
+    assert w_restarted.resume_orphaned_trials() == 1
+    done = [t for t in meta.get_trials_of_sub_train_job(sub_id)
+            if t["status"] == "COMPLETED"]
+    assert len(done) == 1 and done[0]["score"] == 5.0
+
+
+def test_fenced_completion_after_claim(stores):
+    meta, store, sub_id = stores
+    t = meta.create_trial(sub_id, 0, model_id="m0", worker_id="wA",
+                          knobs={})
+    # wA stalls >stale window; wB claims the row
+    meta.update_trial(t["id"], heartbeat_at=0.0)
+    assert meta.claim_trial_for_resume(t["id"], "wB") is True
+    # wA un-stalls and tries to finish: the fence must refuse — the row
+    # stays TERMINATED and wA learns not to feed the advisor
+    assert meta.mark_trial_completed(t["id"], 0.9,
+                                     params_saved=True) is False
+    assert meta.get_trial(t["id"])["status"] == "TERMINATED"
+    assert meta.mark_trial_errored(t["id"], "late error") is False
