@@ -58,7 +58,9 @@ def _bench_predictor(out_path: str, use_kv: bool, duration: float) -> None:
         "depth": 12 if on_accel else 2,
         "n_heads": 12 if on_accel else 4,
         "learning_rate": 1e-3, "weight_decay": 1e-4, "warmup_frac": 0.1,
-        "batch_size": 32, "bf16": True,
+        # bf16 compute only where the MXU wants it: on CPU it would be
+        # EMULATED bf16 and slow the serving numbers down
+        "batch_size": 32, "bf16": on_accel,
         "quick_train": True, "share_params": False,
     }
     img = 224 if on_accel else 64
@@ -170,7 +172,7 @@ def _bench_generation(out_path: str, duration: float) -> None:
         "n_heads": 8 if on_accel else 4, "kv_ratio": 2,
         "lora_rank": 8, "max_len": 128 if on_accel else 32,
         "model_parallel": 1, "learning_rate": 1e-3, "batch_size": 8,
-        "quick_train": True, "share_params": False,
+        "bf16": on_accel, "quick_train": True, "share_params": False,
     }
     model = LlamaLoRA(**knobs)
     module = model._module()
