@@ -71,6 +71,9 @@ class Admin:
             row = self.meta.get_service(s.service_id)
             if row and row.get("train_job_id"):
                 busy_jobs.add(row["train_job_id"])
+        # a queued (slot-starved) worker respawn keeps its job busy —
+        # finalizing here would drop the healing on the floor
+        busy_jobs |= self.services.pending_respawn_job_ids()
         for svc in list(self.services.services.values()):
             if svc.service_type != ServiceType.ADVISOR:
                 continue
@@ -213,6 +216,11 @@ class Admin:
         return self.get_train_job(job["id"])
 
     def stop_train_job(self, job_id: str) -> None:
+        # mark STOPPED FIRST: the monitor's respawner checks job status,
+        # so a worker that crashes in this very window is not replaced
+        # behind our back (the service snapshot below would miss it)
+        self.meta.update_train_job(job_id, status=TrainJobStatus.STOPPED,
+                                   stopped_at=time.time())
         for svc in list(self.services.services.values()):
             row = self.meta.get_service(svc.service_id)
             if row and row.get("train_job_id") == job_id:
@@ -220,8 +228,6 @@ class Admin:
         for sub in self.meta.get_sub_train_jobs_of_train_job(job_id):
             self.meta.update_sub_train_job(sub["id"],
                                            status=SubTrainJobStatus.STOPPED)
-        self.meta.update_train_job(job_id, status=TrainJobStatus.STOPPED,
-                                   stopped_at=time.time())
 
     def get_trials(self, job_id: str) -> List[Dict[str, Any]]:
         return self.meta.get_trials_of_train_job(job_id)
@@ -275,12 +281,13 @@ class Admin:
             return {"ok": False, "error": str(e)}
 
     def stop_inference_job(self, job_id: str) -> None:
+        # STOPPED first — same respawn-race reasoning as stop_train_job
+        self.meta.update_inference_job(job_id, status="STOPPED",
+                                       stopped_at=time.time())
         for svc in list(self.services.services.values()):
             row = self.meta.get_service(svc.service_id)
             if row and row.get("inference_job_id") == job_id:
                 self.services.stop_service(svc.service_id)
-        self.meta.update_inference_job(job_id, status="STOPPED",
-                                       stopped_at=time.time())
 
 
 def _model_public(m: Dict[str, Any]) -> Dict[str, Any]:

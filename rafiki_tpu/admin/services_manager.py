@@ -105,6 +105,18 @@ class ServicesManager:
         self.kv_host: str = ""
         self.kv_port: int = 0
         self._kv_proc: Optional[subprocess.Popen] = None
+        #: self-healing: spawn spec per live service so a CRASHED worker
+        #: (train or inference) can be respawned while its parent job is
+        #: still RUNNING. Lineage = (type, job id): the restart budget is
+        #: shared by a job's workers so a crash-looping config converges.
+        self._respawn_specs: Dict[str, Dict[str, Any]] = {}
+        self._respawn_counts: Dict[Any, int] = {}
+        #: max replacement spawns per (service type, job) lineage
+        self.max_respawns = 3
+        #: respawns that found no free slot, retried on every poll —
+        #: without this, a single-worker job whose only slot got snatched
+        #: between release and re-acquire would lose healing forever
+        self._pending_respawns: List[Dict[str, Any]] = []
 
     def reap_stale_services(self) -> int:
         """Admin restart adoption: service rows left non-STOPPED by a
@@ -202,6 +214,12 @@ class ServicesManager:
             **meta_kwargs)
         svc = ManagedService(row["id"], service_type, proc, slot, host, port)
         self.services[row["id"]] = svc
+        if service_type in (ServiceType.TRAIN_WORKER,
+                            ServiceType.INFERENCE_WORKER):
+            self._respawn_specs[row["id"]] = {
+                "module": module, "config": dict(config),
+                "service_type": service_type, "needs_slot": slot is not None,
+                "meta_kwargs": dict(meta_kwargs)}
         self.meta.update_service(row["id"], status=ServiceStatus.RUNNING)
         return svc
 
@@ -322,11 +340,16 @@ class ServicesManager:
         """Block until every train worker of the job exits; stops the
         job's advisors; returns True if it finished in time."""
         deadline = time.monotonic() + timeout
-        workers = [s for s in self.services.values()
-                   if s.service_type == ServiceType.TRAIN_WORKER]
         while time.monotonic() < deadline:
             self.poll()
-            if all(not s.alive() for s in workers):
+            # re-list each tick: poll() may have RESPAWNED a crashed
+            # worker — a snapshot would declare the job done while the
+            # replacement is still training. A queued (slot-starved)
+            # respawn also keeps the job busy.
+            workers = [s for s in self.services.values()
+                       if s.service_type == ServiceType.TRAIN_WORKER]
+            if all(not s.alive() for s in workers) and \
+                    train_job_id not in self.pending_respawn_job_ids():
                 break
             time.sleep(0.2)
         else:
@@ -444,6 +467,19 @@ class ServicesManager:
             self._poll()
 
     def _poll(self) -> None:
+        if self._pending_respawns:
+            still_pending: List[Dict[str, Any]] = []
+            for item in self._pending_respawns:
+                try:
+                    if not self._respawn(item["dead_id"], item["spec"]):
+                        still_pending.append(item)
+                except Exception as e:  # noqa: BLE001 — keep polling,
+                    import logging      # but never drop healing silently
+
+                    logging.getLogger(__name__).warning(
+                        "queued respawn for %s failed and was dropped: "
+                        "%s", item["dead_id"], e)
+            self._pending_respawns = still_pending
         for svc in list(self.services.values()):
             if svc.alive():
                 continue
@@ -454,7 +490,77 @@ class ServicesManager:
             if svc.slot is not None:
                 self.allocator.release(svc.slot)
                 svc.slot = None
+            spec = self._respawn_specs.pop(svc.service_id, None)
             del self.services[svc.service_id]
+            if status == ServiceStatus.ERRORED and spec is not None:
+                # self-healing: a CRASHED worker is replaced while its
+                # job still runs (rc==0 = normal completion, no respawn).
+                # Train-worker replacements then reclaim the dead
+                # process's orphaned trial via the resume machinery.
+                try:
+                    if not self._respawn(svc.service_id, spec):
+                        # no free slot this instant (a concurrent spawn
+                        # may have snatched the released one): retry on
+                        # subsequent polls rather than losing healing
+                        self._pending_respawns.append(
+                            {"dead_id": svc.service_id, "spec": spec})
+                except Exception as e:  # noqa: BLE001 — the monitor loop
+                    import logging     # must survive respawn failures
+
+                    logging.getLogger(__name__).warning(
+                        "respawn of %s failed: %s", svc.service_id, e)
+
+    def _respawn(self, dead_service_id: str, spec: Dict[str, Any]) -> bool:
+        """Spawn a replacement for a crashed worker. Returns True when
+        the case is RESOLVED (respawned, or no longer needed); False =
+        no free slot right now, caller should queue a retry."""
+        meta_kwargs = spec["meta_kwargs"]
+        job_id = meta_kwargs.get("train_job_id") or \
+            meta_kwargs.get("inference_job_id")
+        stype = spec["service_type"]
+        if stype == ServiceType.TRAIN_WORKER:
+            job = self.meta.get_train_job(job_id) if job_id else None
+        else:
+            job = self.meta.get_inference_job(job_id) if job_id else None
+        if not job or job["status"] != "RUNNING":
+            return True  # parent finished/stopped: nothing to heal
+        lineage = (stype, job_id)
+        if self._respawn_counts.get(lineage, 0) >= self.max_respawns:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "respawn budget exhausted for %s job %s (last casualty "
+                "%s) — a worker config appears to crash "
+                "deterministically", stype, job_id, dead_service_id)
+            return True
+        slot = None
+        if spec["needs_slot"]:
+            slot = self.allocator.acquire(timeout=0.0)
+            if slot is None:
+                return False  # no free chips; caller queues a retry
+        try:
+            self._spawn(spec["module"], spec["config"], stype, slot=slot,
+                        **meta_kwargs)
+        except Exception:
+            if slot is not None:
+                self.allocator.release(slot)
+            raise
+        self._respawn_counts[lineage] = \
+            self._respawn_counts.get(lineage, 0) + 1
+        return True
+
+    def pending_respawn_job_ids(self) -> set:
+        """Jobs that currently have a queued (slot-starved) worker
+        respawn — they must count as busy, or the finalizers declare
+        them done and the queued healing is dropped."""
+        with self.op_lock:
+            out = set()
+            for item in self._pending_respawns:
+                mk = item["spec"]["meta_kwargs"]
+                jid = mk.get("train_job_id") or mk.get("inference_job_id")
+                if jid:
+                    out.add(jid)
+            return out
 
     def stop_service(self, service_id: str, timeout: float = 10.0) -> None:
         with self.op_lock:
@@ -475,6 +581,7 @@ class ServicesManager:
         if svc.slot is not None:
             self.allocator.release(svc.slot)
             svc.slot = None
+        self._respawn_specs.pop(service_id, None)
         del self.services[service_id]
 
     def stop_all(self) -> None:

@@ -367,8 +367,13 @@ class TrainWorker:
             + 2 * self.heartbeat_interval_s
         n = 0
         while _time.monotonic() < deadline:
+            # "not mine" = not created by THIS process — a respawned
+            # replacement shares its dead predecessor's worker_id, and
+            # the predecessor's mid-flight trial is exactly what it is
+            # here to pick up
             peers_running = any(
-                t["status"] == "RUNNING" and t["worker_id"] != self.worker_id
+                t["status"] == "RUNNING"
+                and t["id"] not in self._own_trial_ids
                 for t in self.meta_store.get_trials_of_sub_train_job(
                     self.sub_train_job_id))
             if not peers_running:

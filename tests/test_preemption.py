@@ -319,3 +319,23 @@ def test_fenced_completion_after_claim(stores):
                                      params_saved=True) is False
     assert meta.get_trial(t["id"])["status"] == "TERMINATED"
     assert meta.mark_trial_errored(t["id"], "late error") is False
+
+
+def test_respawned_worker_same_name_lingers_for_predecessor(stores):
+    meta, store, sub_id = stores
+    # dead predecessor "w0" left trial RUNNING with a recent heartbeat
+    # (killed moments ago); the REPLACEMENT inherits the same worker_id,
+    # so the linger must key on per-process trial ids, not the name
+    t = meta.create_trial(sub_id, 0, model_id="m0", worker_id="w0",
+                          knobs={"max_epochs": 5, "share_params": False})
+    meta.heartbeat_trial(t["id"])
+    store.save(f"ckpt-{t['id']}", {"w": np.asarray(3.0)})
+    store.save(f"ckpt-{t['id']}-meta", {"frac_done": 3 / 5})
+
+    w2 = _worker(ToyModel, meta, store, sub_id, "w0", trials=0)  # SAME id
+    w2.orphan_stale_s = 1.5
+    w2.heartbeat_interval_s = 0.3
+    assert w2.run(max_trials=None) == 1  # lingered until stale, resumed
+    done = [x for x in meta.get_trials_of_sub_train_job(sub_id)
+            if x["status"] == "COMPLETED"]
+    assert len(done) == 1 and done[0]["score"] == 5.0

@@ -1,0 +1,137 @@
+"""Worker self-healing: crashed train/inference workers are respawned
+(capped per job) while their parent job is still RUNNING."""
+
+import time
+
+import pytest
+
+from rafiki_tpu.admin.services_manager import ServicesManager
+from rafiki_tpu.constants import ServiceType
+from rafiki_tpu.parallel.mesh import DeviceSpec
+from rafiki_tpu.store.meta_store import MetaStore
+
+
+@pytest.fixture()
+def mgr_and_job(tmp_path):
+    meta = MetaStore(str(tmp_path / "meta.db"))
+    user = meta.create_user("op@x", "pw", "ADMIN")
+    job = meta.create_train_job(user["id"], "app", 1,
+                                "IMAGE_CLASSIFICATION", {"TRIAL_COUNT": 1},
+                                "d1", "d2")
+    meta.update_train_job(job["id"], status="RUNNING")
+    mgr = ServicesManager(meta, str(tmp_path / "wd"), slot_size=1,
+                          platform="cpu",
+                          devices=[DeviceSpec(id=0), DeviceSpec(id=1)])
+    try:
+        yield mgr, meta, job
+    finally:
+        mgr.stop_all()
+
+
+def _wait_dead_then_poll(mgr, svc, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if not svc.alive():
+            mgr.poll()
+            return
+        time.sleep(0.2)
+    raise TimeoutError("service did not exit")
+
+
+@pytest.mark.slow
+def test_crashed_train_worker_respawned_until_cap(mgr_and_job):
+    mgr, meta, job = mgr_and_job
+    # a worker whose config is unreadable crashes on startup (rc != 0)
+    svc = mgr._spawn("rafiki_tpu.worker.train",
+                     {"model_file": "/nonexistent", "model_class": "X",
+                      "train_dataset": "d", "val_dataset": "d"},
+                     ServiceType.TRAIN_WORKER,
+                     slot=mgr.allocator.acquire(),
+                     train_job_id=job["id"])
+    mgr.max_respawns = 2
+    seen = {svc.service_id}
+    for _ in range(2):  # each crash yields one replacement, twice
+        cur = next(iter(
+            s for s in mgr.services.values()
+            if s.service_type == ServiceType.TRAIN_WORKER))
+        _wait_dead_then_poll(mgr, cur)
+        live = [s for s in mgr.services.values()
+                if s.service_type == ServiceType.TRAIN_WORKER]
+        assert len(live) == 1, "crashed worker was not replaced"
+        assert live[0].service_id not in seen
+        seen.add(live[0].service_id)
+    # budget exhausted: the next crash is terminal
+    cur = next(iter(
+        s for s in mgr.services.values()
+        if s.service_type == ServiceType.TRAIN_WORKER))
+    _wait_dead_then_poll(mgr, cur)
+    assert not [s for s in mgr.services.values()
+                if s.service_type == ServiceType.TRAIN_WORKER]
+    assert mgr._respawn_counts[(ServiceType.TRAIN_WORKER, job["id"])] == 2
+    # every slot made it back to the allocator
+    assert mgr.allocator.free_count() == 2
+
+
+def test_no_respawn_after_job_stops(mgr_and_job):
+    mgr, meta, job = mgr_and_job
+    spec = {"module": "rafiki_tpu.worker.train",
+            "config": {}, "service_type": ServiceType.TRAIN_WORKER,
+            "needs_slot": False, "meta_kwargs": {"train_job_id": job["id"]}}
+    meta.update_train_job(job["id"], status="STOPPED")
+    mgr._respawn("dead-svc", spec)
+    assert not mgr.services  # finished job: nothing respawned
+
+
+def test_normal_exit_is_not_respawned(mgr_and_job):
+    import subprocess
+
+    from rafiki_tpu.admin.services_manager import ManagedService
+
+    mgr, meta, job = mgr_and_job
+    # rc == 0 (e.g. advisor budget exhausted → worker done) must NOT
+    # trigger healing; register a finished rc=0 process directly
+    proc = subprocess.Popen(["/bin/true"])
+    proc.wait()
+    row = meta.create_service(ServiceType.TRAIN_WORKER, host="", port=0,
+                              pid=proc.pid, train_job_id=job["id"])
+    mgr.services[row["id"]] = ManagedService(
+        row["id"], ServiceType.TRAIN_WORKER, proc)
+    mgr._respawn_specs[row["id"]] = {
+        "module": "rafiki_tpu.worker.train", "config": {},
+        "service_type": ServiceType.TRAIN_WORKER, "needs_slot": False,
+        "meta_kwargs": {"train_job_id": job["id"]}}
+    mgr.poll()
+    assert not mgr.services
+    assert (ServiceType.TRAIN_WORKER, job["id"]) not in mgr._respawn_counts
+
+
+def test_slotless_respawn_queued_and_retried(mgr_and_job):
+    import subprocess
+
+    from rafiki_tpu.admin.services_manager import ManagedService
+
+    mgr, meta, job = mgr_and_job
+    # both slots taken by someone else: the crashed worker can't respawn
+    held = [mgr.allocator.acquire(), mgr.allocator.acquire()]
+    proc = subprocess.Popen(["/bin/false"])
+    proc.wait()
+    row = meta.create_service(ServiceType.TRAIN_WORKER, host="", port=0,
+                              pid=proc.pid, train_job_id=job["id"])
+    mgr.services[row["id"]] = ManagedService(
+        row["id"], ServiceType.TRAIN_WORKER, proc)
+    mgr._respawn_specs[row["id"]] = {
+        "module": "rafiki_tpu.worker.train",
+        "config": {"model_file": "/nonexistent", "model_class": "X",
+                   "train_dataset": "d", "val_dataset": "d"},
+        "service_type": ServiceType.TRAIN_WORKER, "needs_slot": True,
+        "meta_kwargs": {"train_job_id": job["id"]}}
+    mgr.poll()
+    assert len(mgr._pending_respawns) == 1  # queued, not lost
+    mgr.poll()
+    assert len(mgr._pending_respawns) == 1  # still no slot: still queued
+    mgr.allocator.release(held.pop())
+    mgr.poll()  # slot free now → replacement spawns
+    assert not mgr._pending_respawns
+    live = [s for s in mgr.services.values()
+            if s.service_type == ServiceType.TRAIN_WORKER]
+    assert len(live) == 1
