@@ -25,6 +25,8 @@ def test_dashboard_and_job_listing(tmp_path):
             html = resp.read().decode()
         assert "rafiki-tpu dashboard" in html
         assert "/trials/" in html  # wired to the loss-curve endpoint
+        assert "Search convergence" in html  # best-score-vs-trials plot
+        assert "trialDetail" in html  # preemption/error forensics pane
 
         token = json_request("POST", base + "/tokens",
                              {"email": "superadmin@rafiki",
