@@ -110,3 +110,17 @@ def test_llama_template_contract(tmp_path):
     preds = test_model_class(LlamaLoRA, TaskType.LANGUAGE_MODELING,
                              tr, va, queries=["tok1 tok2 tok3"], knobs=TINY)
     assert len(preds) == 1 and isinstance(preds[0], str)
+
+
+def test_llama_bf16_compute_keeps_f32_params():
+    m = Llama(vocab_size=128, max_len=16, hidden_dim=32, depth=1,
+              n_heads=4, n_kv_heads=2, mlp_dim=64, lora_rank=2,
+              dtype=jnp.bfloat16)
+    ids = jnp.ones((2, 8), jnp.int32)
+    params = m.init(jax.random.PRNGKey(0), ids)["params"]
+    assert all(p.dtype == jnp.float32
+               for p in jax.tree_util.tree_leaves(params))
+    _, state = m.apply({"params": params}, ids,
+                       capture_intermediates=True)
+    block_out = state["intermediates"]["block_0"]["__call__"][0]
+    assert block_out.dtype == jnp.bfloat16, block_out.dtype

@@ -46,3 +46,24 @@ def test_vit_trains_data_parallel(tmp_path):
     model.train(tr, ctx)
     losses = ctx.logger.get_values("loss")
     assert len(losses) >= 2 and losses[-1] < losses[0]
+
+
+def test_vit_bf16_compute_keeps_f32_params():
+    """The bf16 knob must give bf16 ACTIVATIONS with f32 params — a
+    promotion regression would silently triple MXU cost on TPU."""
+    import jax.numpy as jnp
+
+    m = ViT(patch_size=4, hidden_dim=64, depth=1, n_heads=4, mlp_dim=128,
+            n_classes=5, dtype=jnp.bfloat16)
+    x = jnp.zeros((2, 16, 16, 3), jnp.bfloat16)
+    params = m.init(jax.random.PRNGKey(0), x)["params"]
+    # master params stay f32 (optimizer numerics + checkpoints)
+    assert all(p.dtype == jnp.float32
+               for p in jax.tree_util.tree_leaves(params))
+    # the transformer blocks compute in bf16: check a block's dense
+    # output dtype via a captured intermediate
+    out, state = m.apply({"params": params}, x, capture_intermediates=True)
+    block_out = state["intermediates"]["block_0"]["__call__"][0]
+    assert block_out.dtype == jnp.bfloat16, block_out.dtype
+    # logits head stays f32 for a stable softmax/loss
+    assert out.dtype == jnp.float32
