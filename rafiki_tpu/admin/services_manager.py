@@ -422,6 +422,8 @@ class ServicesManager:
         if not self.kv_port:
             self.start_data_plane()
 
+        ijob = self.meta.get_inference_job(inference_job_id) or {}
+        budget = ijob.get("budget") or {}
         spawned: List[ManagedService] = []
         worker_ids: List[str] = []
         for i, trial in enumerate(best):
@@ -442,7 +444,10 @@ class ServicesManager:
                  "trial_id": trial["id"], "knobs": trial["knobs"],
                  "param_store_uri": self.param_store_uri,
                  "kv_host": self.kv_host, "kv_port": self.kv_port,
-                 "worker_id": wid, "decode_loop": decode_loop},
+                 "worker_id": wid, "decode_loop": decode_loop,
+                 # decode-loop dispatch amortization (ops guide): K fused
+                 # steps per device program, operator-tunable per job
+                 "steps_per_sync": int(budget.get("STEPS_PER_SYNC", 4))},
                 ServiceType.INFERENCE_WORKER, slot=slot,
                 inference_job_id=inference_job_id)
             spawned.append(svc)

@@ -553,7 +553,8 @@ class LlamaLoRA(BaseModel):
                         for t in ids)
 
     def make_decode_engine(self, max_slots: int = 8,
-                           max_new_tokens: int = 8):
+                           max_new_tokens: int = 8,
+                           steps_per_sync: int = 4):
         """Continuous-batching serving engine over this model's weights
         (BASELINE.md config #5). The inference worker drives it when
         running in decode-loop mode; see ``serving/decode_engine.py``."""
@@ -568,7 +569,8 @@ class LlamaLoRA(BaseModel):
             return row[:max(1, int(n))]
 
         core = DecodeEngine(self._module(), self._params,
-                            max_slots=max_slots, max_len=max_len)
+                            max_slots=max_slots, max_len=max_len,
+                            steps_per_sync=steps_per_sync)
         return TextDecodeEngine(core, encode, self._detok,
                                 max_new=min(max_new_tokens, max_len - 1))
 

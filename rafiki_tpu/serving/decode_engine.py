@@ -51,25 +51,44 @@ class _Slot:
 
 
 class DecodeEngine:
-    """Slot-based continuous batching over one compiled decode step."""
+    """Slot-based continuous batching over one compiled decode step.
+
+    ``steps_per_sync`` fuses K decode steps into ONE device program
+    (``lax.scan``) with on-device input selection (next prompt token
+    while prefilling, argmax feedback while generating). The host then
+    pays one dispatch + one sync per K tokens instead of per token —
+    the difference between per-token round-trips and streaming on a
+    remote-execution TPU backend. Admission still happens at fused-step
+    boundaries, so K trades a little admission latency for dispatch
+    amortization. K=1 reproduces classic lockstep exactly; any K
+    produces identical tokens (the selection logic is the same math).
+    """
 
     def __init__(self, module: Any, params: Any, max_slots: int,
-                 max_len: int) -> None:
+                 max_len: int, steps_per_sync: int = 4) -> None:
         self.module = module
         self.params = params
         self.B = int(max_slots)
         self.L = int(max_len)
+        self.K = max(1, int(steps_per_sync))
         self._slots: List[Optional[_Slot]] = [None] * self.B
         self._queue: List[_Slot] = []
         self._done: List[Tuple[Any, List[int]]] = []
         self._lock = threading.Lock()
-        # host mirrors of the per-slot device inputs
+        # host mirrors of the per-slot device inputs; prompts ride to the
+        # device so mid-scan prefill continues without host involvement
         self._tok = np.zeros((self.B,), np.int32)
         self._pos = np.zeros((self.B,), np.int32)
+        self._prompt_buf = np.zeros((self.B, self.L), np.int32)
+        self._prompt_len = np.ones((self.B,), np.int32)
+        self._stop_pos = np.zeros((self.B,), np.int32)
+        #: device-resident prompt copy, refreshed only on admission — the
+        #: (B, L) buffer must not ride host→device on every dispatch
+        self._prompt_dev: Optional[jnp.ndarray] = None
         self._cache = module.init(
             jax.random.PRNGKey(0), jnp.zeros((self.B, 1), jnp.int32),
             decode=True)["cache"]
-        self._step_fn = _make_step(module, self.B)
+        self._step_fn = _make_step(module, self.B, self.K)
         self.stats: Dict[str, int] = {
             "steps": 0, "tokens_generated": 0, "requests_done": 0,
             "max_concurrent": 0}
@@ -107,52 +126,82 @@ class DecodeEngine:
             self._done.clear()
         self._tok[:] = 0
         self._pos[:] = 0
+        self._prompt_buf[:] = 0
+        self._prompt_len[:] = 1
+        self._stop_pos[:] = 0  # empty slots must be device-inactive
+        self._prompt_dev = None
         self._cache = self.module.init(
             jax.random.PRNGKey(0), jnp.zeros((self.B, 1), jnp.int32),
             decode=True)["cache"]
 
     # ---- the loop body ----
     def step(self) -> int:
-        """Admit queued requests into free slots, run ONE compiled step
-        for every live slot, harvest completions. Returns live count."""
+        """Admit queued requests into free slots, run K fused compiled
+        steps for every live slot, harvest completions. Returns live
+        count (at admission time)."""
         with self._lock:
+            admitted = False
             for i in range(self.B):
                 if self._slots[i] is None and self._queue:
                     slot = self._queue.pop(0)
                     self._slots[i] = slot
                     self._tok[i] = slot.prompt[0]
                     self._pos[i] = 0
+                    self._prompt_buf[i, :] = 0
+                    self._prompt_buf[i, :len(slot.prompt)] = slot.prompt
+                    self._prompt_len[i] = len(slot.prompt)
+                    # finish once pos reaches plen - 1 + max_new (the
+                    # step at input position p emits a GENERATED token
+                    # iff p >= plen - 1)
+                    self._stop_pos[i] = min(
+                        len(slot.prompt) - 1 + slot.max_new, self.L)
+                    admitted = True
             live = [i for i in range(self.B) if self._slots[i] is not None]
             self.stats["max_concurrent"] = max(self.stats["max_concurrent"],
                                                len(live))
         if not live:
             return 0
+        if admitted or self._prompt_dev is None:
+            # refresh the device-resident prompts only when they changed
+            self._prompt_dev = jnp.asarray(self._prompt_buf)
 
-        self._cache, nxt = self._step_fn(
+        self._cache, emitted = self._step_fn(
             self.params, self._cache, jnp.asarray(self._tok),
-            jnp.asarray(self._pos))
-        nxt = np.asarray(nxt)
-        self.stats["steps"] += 1
+            jnp.asarray(self._pos), self._prompt_dev,
+            jnp.asarray(self._prompt_len), jnp.asarray(self._stop_pos))
+        emitted = np.asarray(emitted)  # (K, B) — the per-token sync
+        self.stats["steps"] += self.K
 
         finished: List[Tuple[Any, List[int]]] = []
         for i in live:
             slot = self._slots[i]
-            slot.n_consumed += 1
-            if slot.n_consumed < len(slot.prompt):
-                # still prefilling: feed the next prompt token
-                self._tok[i] = slot.prompt[slot.n_consumed]
-            else:
-                # generating: the model's output becomes the next input
-                slot.generated.append(int(nxt[i]))
-                self.stats["tokens_generated"] += 1
-                self._tok[i] = nxt[i]
-            self._pos[i] += 1
+            plen = len(slot.prompt)
+            pos0 = int(self._pos[i])
+            # steps this slot actually took inside the fused program
+            # (slots that hit their stop mid-scan idle for the rest)
+            n_real = max(0, min(self.K, int(self._stop_pos[i]) - pos0,
+                                self.L - pos0))
+            for j in range(n_real):
+                if pos0 + j >= plen - 1:  # emission at a generated pos
+                    slot.generated.append(int(emitted[j, i]))
+                    self.stats["tokens_generated"] += 1
+            slot.n_consumed += n_real
+            self._pos[i] = pos0 + n_real
             if (len(slot.generated) >= slot.max_new
                     or int(self._pos[i]) >= self.L):
                 finished.append((slot.request_id, slot.generated))
                 self._slots[i] = None
                 self._tok[i] = 0
                 self._pos[i] = 0  # fresh occupant restarts at position 0
+                self._prompt_len[i] = 1
+                self._stop_pos[i] = 0
+            else:
+                # reconstruct the next input host-side (mirrors the
+                # on-device selection, so the next fused call continues
+                # seamlessly)
+                self._tok[i] = (slot.prompt[slot.n_consumed]
+                                if slot.n_consumed < plen
+                                else slot.generated[-1])
         if finished:
             with self._lock:
                 self._done.extend(finished)
@@ -161,16 +210,40 @@ class DecodeEngine:
 
 
 @functools.lru_cache(maxsize=8)
-def _make_step(module: Any, n_slots: int) -> Callable:
-    """One compiled decode step over all slots (cache donated in-place)."""
+def _make_step(module: Any, n_slots: int, k: int) -> Callable:
+    """K fused decode steps over all slots (cache donated in-place).
+
+    On-device input selection between steps: while a slot's next
+    position is still inside its prompt, the next input is the next
+    prompt token (device-resident prompt buffer); afterwards it is the
+    slot's own argmax. Slots whose next position reaches ``stop_pos``
+    freeze (their tok/pos stop advancing) so a finished slot idles
+    harmlessly for the remainder of the scan."""
 
     @functools.partial(jax.jit, donate_argnums=(1,))
-    def step_fn(params, cache, tok, pos):
-        logits, muts = module.apply(
-            {"params": params, "cache": cache}, tok[:, None],
-            positions=pos[:, None], decode=True, mutable=["cache"])
-        nxt = jnp.argmax(logits[:, -1].astype(jnp.float32), -1)
-        return muts["cache"], nxt.astype(jnp.int32)
+    def step_fn(params, cache, tok, pos, prompt_buf, prompt_len, stop_pos):
+        rows = jnp.arange(n_slots)
+
+        def body(carry, _):
+            cache, tok, pos = carry
+            logits, muts = module.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                positions=pos[:, None], decode=True, mutable=["cache"])
+            nxt = jnp.argmax(logits[:, -1].astype(jnp.float32),
+                             -1).astype(jnp.int32)
+            new_pos = pos + 1
+            is_prefill = new_pos < prompt_len
+            nxt_prompt = prompt_buf[
+                rows, jnp.minimum(new_pos, prompt_buf.shape[1] - 1)]
+            nxt_input = jnp.where(is_prefill, nxt_prompt, nxt)
+            active = new_pos < stop_pos
+            tok2 = jnp.where(active, nxt_input, tok)
+            pos2 = jnp.where(active, new_pos, pos)
+            return (muts["cache"], tok2, pos2), nxt
+
+        (cache, tok, pos), emitted = jax.lax.scan(
+            body, (cache, tok, pos), None, length=k)
+        return cache, emitted  # (K, n_slots)
 
     return step_fn
 

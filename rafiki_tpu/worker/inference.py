@@ -41,7 +41,7 @@ class InferenceWorker:
                  knobs: dict, param_store: ParamStore, hub: QueueHub,
                  worker_id: str, max_batch_msgs: int = 16,
                  decode_loop: bool = False, max_slots: int = 8,
-                 max_new_tokens: int = 8) -> None:
+                 max_new_tokens: int = 8, steps_per_sync: int = 4) -> None:
         self.worker_id = worker_id
         self.hub = hub
         self.max_batch_msgs = max_batch_msgs
@@ -55,7 +55,8 @@ class InferenceWorker:
         if decode_loop:
             if hasattr(self.model, "make_decode_engine"):
                 self.engine = self.model.make_decode_engine(
-                    max_slots=max_slots, max_new_tokens=max_new_tokens)
+                    max_slots=max_slots, max_new_tokens=max_new_tokens,
+                    steps_per_sync=steps_per_sync)
             else:
                 # the stack enables decode_loop for every LM-task model;
                 # a template without an engine still serves fine through
@@ -223,6 +224,7 @@ def main(argv: Optional[list] = None) -> int:
         worker_id=cfg["worker_id"],
         decode_loop=bool(cfg.get("decode_loop")),
         max_slots=int(cfg.get("max_slots", 8)),
+        steps_per_sync=int(cfg.get("steps_per_sync", 4)),
         max_new_tokens=int(cfg.get("max_new_tokens", 8)))
     print(f"inference worker {worker.worker_id} serving", flush=True)
     worker.run()

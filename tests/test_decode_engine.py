@@ -91,7 +91,9 @@ def test_engine_mid_flight_admission(trained):
 
     ref1, ref2 = solo(p1), solo(p2)
 
-    eng = DecodeEngine(module, params, max_slots=4, max_len=32)
+    # K=1: the test reasons about exact single-token step boundaries
+    eng = DecodeEngine(module, params, max_slots=4, max_len=32,
+                       steps_per_sync=1)
     eng.submit("r1", p1, max_new)
     # run r1 past its prefill and into generation
     for _ in range(len(p1) + 2):
@@ -192,3 +194,73 @@ def test_predict_batch_bucketing(trained):
     out = trained.predict(["tok1 tok2", "tok3", "tok4 tok5 tok6"])
     assert len(out) == 3
     assert all(isinstance(t, str) and t for t in out)
+
+
+def test_fused_steps_match_lockstep(trained):
+    """steps_per_sync=K fuses K decode steps into one device program;
+    outputs must be IDENTICAL to K=1 lockstep for any K, including
+    mid-scan prefill→generate transitions and mid-scan completions."""
+    module, params = _module_and_params(trained)
+    prompts = {"a": np.asarray([1, 5, 9, 13], np.int32),
+               "b": np.asarray([1, 7], np.int32),
+               "c": np.asarray([1, 2, 3, 4, 5, 6, 7], np.int32)}
+    max_new = {"a": 6, "b": 3, "c": 5}
+
+    def run(k):
+        e = DecodeEngine(module, params, max_slots=4, max_len=32,
+                         steps_per_sync=k)
+        for rid, p in prompts.items():
+            e.submit(rid, p, max_new[rid])
+        out = {}
+        for _ in range(200):
+            if not e.busy:
+                break
+            e.step()
+            out.update(dict(e.poll()))
+        assert not e.busy
+        return out
+
+    ref = run(1)
+    for k in (2, 4, 7):
+        got = run(k)
+        assert got == ref, (k, got, ref)
+    for rid in prompts:
+        assert len(ref[rid]) == max_new[rid]
+
+
+def test_fused_mid_flight_admission_and_slot_reuse(trained):
+    """K>1: requests admitted at fused-step boundaries into REUSED slots
+    must match their solo outputs (exercises the host-side input
+    reconstruction and stale-prompt-row clearing under K>1)."""
+    module, params = _module_and_params(trained)
+    prompts = [np.asarray([1, 5, 9, 13], np.int32),
+               np.asarray([1, 7], np.int32),
+               np.asarray([1, 2, 3], np.int32)]
+
+    def solo(p):
+        e = DecodeEngine(module, params, max_slots=1, max_len=32,
+                         steps_per_sync=1)
+        e.submit("x", p, 6)
+        while e.busy:
+            e.step()
+        return dict(e.poll())["x"]
+
+    refs = [solo(p) for p in prompts]
+
+    # ONE slot, K=3: every request flows through the same reused slot,
+    # later ones admitted mid-run at fused boundaries
+    eng = DecodeEngine(module, params, max_slots=1, max_len=32,
+                       steps_per_sync=3)
+    eng.submit(0, prompts[0], 6)
+    eng.step()  # first request mid-flight...
+    eng.submit(1, prompts[1], 6)  # ...queued behind it
+    eng.submit(2, prompts[2], 6)
+    done = {}
+    for _ in range(100):
+        if not eng.busy:
+            break
+        eng.step()
+        done.update(dict(eng.poll()))
+    assert not eng.busy
+    for i, ref in enumerate(refs):
+        assert done[i] == list(ref), (i, done[i], ref)
