@@ -53,25 +53,70 @@ class QueueHub:
         raise NotImplementedError
 
 
+class _KeyQueue:
+    """One deque + its OWN condvar. A shared hub-wide condition would
+    notify_all() every waiter (workers blocked on queries, predictor
+    threads blocked on unrelated replies) for every push — a thundering
+    herd that measurably lost to the socket-based kv hub under
+    multi-client load."""
+
+    __slots__ = ("dq", "cv", "last_used", "waiters")
+
+    def __init__(self) -> None:
+        self.dq: collections.deque = collections.deque()
+        self.cv = threading.Condition()
+        self.last_used = 0.0
+        self.waiters = 0  # parked poppers — sweeping their entry would
+        #                   orphan them (a later push notifies a NEW obj)
+
+
+#: reply queues are per-query-id and transient; entries idle this long
+#: with nothing queued are swept (abandoned after a gather deadline)
+_IDLE_TTL_S = 120.0
+_SWEEP_EVERY = 1024  # hub ops between sweeps
+
+
 class InProcQueueHub(QueueHub):
     def __init__(self) -> None:
-        self._queues: Dict[str, collections.deque] = \
-            collections.defaultdict(collections.deque)
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
+        self._queues: Dict[str, _KeyQueue] = {}
+        self._meta = threading.Lock()  # guards the key → queue dict
+        self._ops = 0
+
+    def _get(self, key: str) -> _KeyQueue:
+        import time
+
+        with self._meta:
+            q = self._queues.get(key)
+            if q is None:
+                q = self._queues[key] = _KeyQueue()
+            q.last_used = time.monotonic()
+            self._ops += 1
+            if self._ops % _SWEEP_EVERY == 0:
+                cutoff = q.last_used - _IDLE_TTL_S
+                dead = [k for k, v in self._queues.items()
+                        if not v.dq and not v.waiters
+                        and v.last_used < cutoff]
+                for k in dead:  # e.g. replies that arrived after their
+                    del self._queues[k]  # query's gather deadline
+            return q
 
     def _push(self, key: str, data: bytes) -> None:
-        with self._cv:
-            self._queues[key].append(data)
-            self._cv.notify_all()
+        q = self._get(key)
+        with q.cv:
+            q.dq.append(data)
+            q.cv.notify()
 
     def _pop(self, key: str, timeout: float) -> Optional[bytes]:
-        with self._cv:
-            ok = self._cv.wait_for(lambda: bool(self._queues.get(key)),
-                                   timeout=timeout)
+        q = self._get(key)
+        with q.cv:
+            q.waiters += 1
+            try:
+                ok = q.cv.wait_for(lambda: bool(q.dq), timeout=timeout)
+            finally:
+                q.waiters -= 1
             if not ok:
                 return None
-            return self._queues[key].popleft()
+            return q.dq.popleft()
 
     def push_query(self, worker_id: str, data: bytes) -> None:
         self._push(f"q:{worker_id}", data)
@@ -87,8 +132,9 @@ class InProcQueueHub(QueueHub):
         return self._pop(f"p:{query_id}", timeout)
 
     def query_depth(self, worker_id: str) -> int:
-        with self._lock:
-            return len(self._queues.get(f"q:{worker_id}", ()))
+        with self._meta:
+            q = self._queues.get(f"q:{worker_id}")
+        return len(q.dq) if q is not None else 0
 
 
 class KVQueueHub(QueueHub):

@@ -214,3 +214,27 @@ def test_train_worker_knob_overrides(tmp_path):
     for r in advisor.results:
         assert r.knobs["hidden_layer_count"] == 1
         assert r.knobs["hidden_layer_units"] == 16
+
+
+def test_inproc_hub_sweep_never_orphans_waiters(monkeypatch):
+    """The idle-entry sweep must skip keys with parked poppers: deleting
+    one would orphan the waiter (a later push notifies a NEW object)."""
+    import threading
+    import time
+
+    from rafiki_tpu.serving import queues as qmod
+
+    monkeypatch.setattr(qmod, "_IDLE_TTL_S", 0.0)  # everything is stale
+    monkeypatch.setattr(qmod, "_SWEEP_EVERY", 4)   # sweep constantly
+    hub = qmod.InProcQueueHub()
+
+    got = []
+    waiter = threading.Thread(
+        target=lambda: got.append(hub.pop_prediction("q1", timeout=10.0)))
+    waiter.start()
+    time.sleep(0.2)  # parked on the condvar, entry empty + "stale"
+    for i in range(64):  # churn other keys → many sweeps run
+        hub.push_query(f"w{i}", b"x")
+    hub.push_prediction("q1", b"reply")
+    waiter.join(timeout=5.0)
+    assert got == [b"reply"]
