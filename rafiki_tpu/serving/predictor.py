@@ -77,26 +77,37 @@ class Predictor:
         t0 = time.monotonic()
         timeout = self.gather_timeout if timeout is None else timeout
         qid = uuid.uuid4().hex
-        msg = pack_message({"id": qid, "queries": _stack(queries)})
+        deadline = t0 + timeout
+        # the wall-clock deadline rides with the query: a worker that
+        # pops it too late drops it instead of computing an answer
+        # nobody will read (and recreating a discarded reply queue)
+        msg = pack_message({"id": qid, "queries": _stack(queries),
+                            "deadline_ts": time.time() + timeout})
         for wid in self.worker_ids:
             self.hub.push_query(wid, msg)
 
         per_worker: List[List[Any]] = []
         errors: List[str] = []
-        deadline = t0 + timeout
-        for _ in self.worker_ids:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                break
-            reply_bytes = self.hub.pop_prediction(qid, remaining)
-            if reply_bytes is None:
-                break
-            reply = unpack_message(reply_bytes)
-            if reply.get("error"):
-                errors.append(str(reply["error"]))
-                continue
-            per_worker.append(list(reply["predictions"]))
-
+        try:
+            for _ in self.worker_ids:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                reply_bytes = self.hub.pop_prediction(qid, remaining)
+                if reply_bytes is None:
+                    break
+                reply = unpack_message(reply_bytes)
+                if reply.get("error"):
+                    errors.append(str(reply["error"]))
+                    continue
+                per_worker.append(list(reply["predictions"]))
+        finally:
+            # drop the reply queue even on a gather error: late answers
+            # must not accumulate in the hub/kv store forever
+            try:
+                self.hub.discard_prediction_queue(qid)
+            except Exception:  # noqa: BLE001 — cleanup is best-effort
+                pass
         latency = time.monotonic() - t0
         with self._lock:
             self._n_queries += len(queries)

@@ -52,6 +52,12 @@ class QueueHub:
     def query_depth(self, worker_id: str) -> int:
         raise NotImplementedError
 
+    def discard_prediction_queue(self, query_id: str) -> None:
+        """Drop a query's reply queue after the gather finishes. Late
+        replies (a worker answering after the deadline) would otherwise
+        accumulate forever in the backing store."""
+        raise NotImplementedError
+
 
 class _KeyQueue:
     """One deque + its OWN condvar. A shared hub-wide condition would
@@ -94,8 +100,11 @@ class InProcQueueHub(QueueHub):
             if self._ops % _SWEEP_EVERY == 0:
                 cutoff = q.last_used - _IDLE_TTL_S
                 dead = [k for k, v in self._queues.items()
-                        if not v.dq and not v.waiters
-                        and v.last_used < cutoff]
+                        if not v.waiters and v.last_used < cutoff
+                        # reply queues (p:*) expire even NON-empty: a
+                        # late push after discard recreates the entry
+                        # and nothing would ever pop it
+                        and (not v.dq or k.startswith("p:"))]
                 for k in dead:  # e.g. replies that arrived after their
                     del self._queues[k]  # query's gather deadline
             return q
@@ -136,6 +145,12 @@ class InProcQueueHub(QueueHub):
             q = self._queues.get(f"q:{worker_id}")
         return len(q.dq) if q is not None else 0
 
+    def discard_prediction_queue(self, query_id: str) -> None:
+        with self._meta:
+            q = self._queues.get(f"p:{query_id}")
+            if q is not None and not q.waiters:
+                del self._queues[f"p:{query_id}"]
+
 
 class KVQueueHub(QueueHub):
     """Queues on the native kv server. Blocking pops hold a socket, so each
@@ -175,3 +190,6 @@ class KVQueueHub(QueueHub):
 
     def query_depth(self, worker_id: str) -> int:
         return self._client().llen(f"q:queries:{worker_id}")
+
+    def discard_prediction_queue(self, query_id: str) -> None:
+        self._client().delete(f"q:preds:{query_id}")

@@ -90,7 +90,9 @@ class InferenceWorker:
                 if more is None:
                     break
                 messages.append(unpack_message(more))
-            self._serve_batch(messages)
+            messages = [m for m in messages if not _expired(m)]
+            if messages:
+                self._serve_batch(messages)
 
     def _run_decode_loop(self, poll_timeout: float,
                          max_iterations: Optional[int]) -> None:
@@ -112,6 +114,9 @@ class InferenceWorker:
                                      0.0 if busy else poll_timeout)
             while raw is not None:
                 m = unpack_message(raw)
+                if _expired(m):
+                    raw = self.hub.pop_query(self.worker_id, 0.0)
+                    continue
                 qs = m["queries"]
                 qs = list(qs) if not isinstance(qs, (list, tuple)) else qs
                 if not qs:  # answer empty messages immediately, like
@@ -177,6 +182,17 @@ class InferenceWorker:
             if err:
                 reply["error"] = err
             self.hub.push_prediction(m["id"], pack_message(reply))
+
+
+def _expired(msg: dict) -> bool:
+    """The predictor stamps each query with its gather deadline; a
+    worker that pops it too late must drop it — the answer would land
+    in a discarded reply queue and leak there forever (and the forward
+    pass would be wasted compute)."""
+    import time
+
+    ts = msg.get("deadline_ts")
+    return ts is not None and time.time() > float(ts)
 
 
 def _to_plain(preds: List[Any]) -> List[Any]:

@@ -238,3 +238,81 @@ def test_inproc_hub_sweep_never_orphans_waiters(monkeypatch):
     hub.push_prediction("q1", b"reply")
     waiter.join(timeout=5.0)
     assert got == [b"reply"]
+
+
+def test_predictor_discards_reply_queue_after_gather():
+    """Late replies must not accumulate forever: the predictor drops its
+    per-query reply queue once the gather finishes (both hubs)."""
+    from rafiki_tpu.serving.predictor import Predictor
+    from rafiki_tpu.serving.queues import (InProcQueueHub, pack_message,
+                                           unpack_message)
+
+    hub = InProcQueueHub()
+    pred = Predictor(hub, ["w0"], gather_timeout=5.0)
+
+    import threading
+
+    def worker():  # answer the single query promptly
+        raw = hub.pop_query("w0", timeout=5.0)
+        msg = unpack_message(raw)
+        hub.push_prediction(msg["id"], pack_message(
+            {"id": msg["id"], "predictions": [[1.0]]}))
+
+    t = threading.Thread(target=worker)
+    t.start()
+    preds, info = pred.predict([[0.0]])
+    t.join(timeout=5)
+    assert info["workers_answered"] == 1
+    # the reply queue is gone from the hub map
+    reply_keys = [k for k in hub._queues if k.startswith("p:")]
+    assert reply_keys == [], reply_keys
+
+
+def test_worker_drops_expired_queries():
+    """A query popped after its gather deadline is dropped (no wasted
+    forward, no reply into a discarded queue)."""
+    import time
+
+    from rafiki_tpu.serving.queues import pack_message, unpack_message
+    from rafiki_tpu.worker.inference import InferenceWorker
+
+    hub = InProcQueueHub()
+    hub.push_query("w0", pack_message(
+        {"id": "dead", "queries": [[0.0]],
+         "deadline_ts": time.time() - 1.0}))  # already expired
+    hub.push_query("w0", pack_message(
+        {"id": "live", "queries": [[0.0]],
+         "deadline_ts": time.time() + 30.0}))
+
+    from rafiki_tpu.model.base import BaseModel
+
+    class OneShot(BaseModel):
+        TASKS = ("IMAGE_CLASSIFICATION",)
+
+        @staticmethod
+        def get_knob_config():
+            return {}
+
+        def train(self, dataset_path, ctx=None):
+            pass
+
+        def evaluate(self, dataset_path):
+            return 1.0
+
+        def predict(self, queries):
+            return [[1.0] for _ in queries]
+
+        def dump_parameters(self):
+            return {"ok": np.asarray(1)}
+
+        def load_parameters(self, params):
+            pass
+
+    store = ParamStore.from_uri("mem://")
+    store.save("t0", OneShot().dump_parameters())
+    w = InferenceWorker(OneShot, "t0", {}, store, hub, "w0")
+    w.run(poll_timeout=0.1, max_iterations=1)
+    # only the live query was answered
+    assert hub.pop_prediction("dead", timeout=0.1) is None
+    live = hub.pop_prediction("live", timeout=1.0)
+    assert live is not None and unpack_message(live)["id"] == "live"
