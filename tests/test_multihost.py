@@ -29,7 +29,7 @@ CHILD = textwrap.dedent("""
     from rafiki_tpu.parallel.multihost import (
         global_batch, global_mesh, initialize_from_env, is_coordinator)
 
-    assert initialize_from_env(), "env did not request multi-process"
+    assert initialize_from_env(timeout_s=300), "env did not request multi-process"
     assert jax.process_count() == 2, jax.process_count()
     assert len(jax.devices()) == 8, len(jax.devices())  # 2 hosts x 4
 
@@ -82,7 +82,7 @@ def test_two_process_global_mesh_allreduce(tmp_path):
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=600)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
