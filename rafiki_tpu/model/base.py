@@ -119,6 +119,13 @@ class BaseModel(abc.ABC):
     def destroy(self) -> None:
         """Release device memory/resources. Default: no-op."""
 
+    def warmup(self) -> None:
+        """Pre-compile the serving path (called by the inference worker
+        at boot, AFTER load_parameters). Without it the first user
+        request pays the XLA compile — seconds to minutes on TPU.
+        Default: no-op; templates run one dummy query through their
+        cached jitted forward."""
+
     @classmethod
     def validate_knobs(cls, knobs: Knobs) -> None:
         validate_knobs(cls.get_knob_config(), knobs)

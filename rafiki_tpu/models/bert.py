@@ -312,6 +312,12 @@ class BertClassifier(BaseModel):
         return bucketed_forward(self._fwd, self._params, ids, lens,
                                 bucket=64)
 
+    def warmup(self) -> None:
+        """Compile the serving forward before traffic arrives."""
+        if self._params is None:
+            return
+        self.predict(["warmup"])
+
     def dump_parameters(self) -> Dict[str, Any]:
         assert self._params is not None, "model is not trained"
         return {

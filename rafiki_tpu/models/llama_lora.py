@@ -552,6 +552,13 @@ class LlamaLoRA(BaseModel):
         return " ".join(self._id2tok.get(int(t), f"<{int(t)}>")
                         for t in ids)
 
+    def warmup(self) -> None:
+        """Compile the serving generate (smallest bucket) before
+        traffic arrives."""
+        if self._params is None:
+            return
+        self.predict(["warmup"])
+
     def make_decode_engine(self, max_slots: int = 8,
                            max_new_tokens: int = 8,
                            steps_per_sync: int = 4):

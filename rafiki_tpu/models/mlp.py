@@ -175,6 +175,12 @@ class JaxFeedForward(BaseModel):
             self._fwd = forward
         return bucketed_forward(self._fwd, self._params, x, bucket=256)
 
+    def warmup(self) -> None:
+        """Compile the serving forward before traffic arrives."""
+        if self._params is None or self._image_shape is None:
+            return
+        self.predict([np.zeros(list(self._image_shape), np.uint8)])
+
     def dump_parameters(self) -> Dict[str, Any]:
         assert self._params is not None, "model is not trained"
         return {

@@ -320,6 +320,14 @@ class ViTBase16(BaseModel):
             self._fwd = forward
         return bucketed_forward(self._fwd, self._params, x, bucket=64)
 
+    def warmup(self) -> None:
+        """Compile the serving forward (one zero query through the same
+        bucketed path predict() uses) before traffic arrives."""
+        if self._params is None or self._image_shape is None:
+            return
+        shape = list(self._image_shape)
+        self.predict([np.zeros(shape, np.uint8)])
+
     def dump_parameters(self) -> Dict[str, Any]:
         assert self._params is not None, "model is not trained"
         return {

@@ -67,6 +67,34 @@ class InferenceWorker:
                     "%s has no make_decode_engine; serving through the "
                     "predict() micro-batcher instead of the continuous-"
                     "batching decode loop", model_class.__name__)
+        self._warmup()
+
+    def _warmup(self) -> None:
+        """Pre-compile the serving path at boot so the FIRST request
+        doesn't pay XLA compilation (seconds to minutes on TPU)."""
+        import logging
+
+        try:
+            if self.engine is not None:
+                # one dummy token through the fused decode step
+                self.engine.submit("__warmup__", "warmup", max_new=1)
+                while self.engine.busy:
+                    self.engine.step()
+                self.engine.poll()  # drop the dummy completion
+                for k in self.engine.stats:  # don't count the dummy in
+                    self.engine.stats[k] = 0  # served-traffic metrics
+            else:
+                self.model.warmup()
+        except Exception:  # noqa: BLE001 — slower first request, not a
+            logging.getLogger(__name__).warning(  # dead worker
+                "serving warmup failed; first request pays the compile",
+                exc_info=True)
+            if self.engine is not None:
+                # a failed step may have consumed the donated cache and
+                # left the dummy occupying a slot: rebuild device state
+                # so the loop doesn't admit real requests into a broken
+                # engine
+                self.engine.reset()
 
     def stop(self) -> None:
         self._stop.set()

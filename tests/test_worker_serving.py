@@ -316,3 +316,20 @@ def test_worker_drops_expired_queries():
     assert hub.pop_prediction("dead", timeout=0.1) is None
     live = hub.pop_prediction("live", timeout=1.0)
     assert live is not None and unpack_message(live)["id"] == "live"
+
+
+def test_worker_warms_serving_path_at_boot(trained):
+    """Boot must pre-compile the serving forward so the first request
+    doesn't pay XLA compilation."""
+    meta, params, job, _ = trained
+    best = meta.get_best_trials_of_train_job(job["id"], max_count=1)[0]
+    calls = []
+
+    class Spy(JaxFeedForward):
+        def warmup(self):
+            calls.append(1)
+            super().warmup()
+
+    hub = InProcQueueHub()
+    InferenceWorker(Spy, best["id"], best["knobs"], params, hub, "w-warm")
+    assert calls == [1]
