@@ -10,8 +10,8 @@ from ..model.base import BaseModel
 
 _ZOO = {
     "JaxFeedForward": ("rafiki_tpu.models.mlp", "JaxFeedForward"),
-    "JaxCNN": ("rafiki_tpu.models.cnn", "JaxCNN"),
     "ResNetClassifier": ("rafiki_tpu.models.resnet", "ResNetClassifier"),
+    "VGGClassifier": ("rafiki_tpu.models.vgg", "VGGClassifier"),
     "ViTBase16": ("rafiki_tpu.models.vit", "ViTBase16"),
     "BertClassifier": ("rafiki_tpu.models.bert", "BertClassifier"),
     "LlamaLoRA": ("rafiki_tpu.models.llama_lora", "LlamaLoRA"),

@@ -1,26 +1,20 @@
-"""ResNet — the BOHB-search workhorse family (BASELINE.md config #2).
+"""VGG-style CNN family — the reference zoo's second CNN shape.
 
-Parity target: the reference zoo's VGG/DenseNet-style TF CNN templates
-(SURVEY.md §2 "Model zoo") and benchmark config #2 ("ResNet-50 / ImageNet
-with BOHB search across a TPU slice"). TPU-first design notes:
-
-- Convolutions lower straight onto the MXU via XLA; there is no Pallas
-  kernel here on purpose — conv+BN+relu is XLA's best-fused path already.
-- BatchNorm statistics are **globally correct under data parallelism for
-  free**: the batch axis is sharded over the mesh's ``data`` axis and the
-  train step is jitted over the mesh, so GSPMD turns the batch-mean
-  reductions into cross-device collectives (no hand-written psum, unlike
-  torch's SyncBatchNorm).
-- Mixed precision: params and BN stats stay f32; compute dtype is bf16 by
-  knob (MXU-native).
-- Small-image inputs (CIFAR/FashionMNIST-scale) get a 3x3/stride-1 stem
-  with no max-pool; ImageNet-scale inputs the classic 7x7/stride-2 stem.
+Parity target: SURVEY.md §2 "Model zoo" lists "TF VGG/DenseNet-style
+CNNs" next to the feed-forward and ResNet families; this is the
+TPU-native VGG: plain 3×3 conv stacks (+BatchNorm — the VGG-BN variant,
+which actually trains without tricks) with stage-wise max-pool, a
+global-average-pool head instead of VGG's 3 giant FC layers (GAP keeps
+the net resolution-agnostic and drops ~90% of the parameters for free),
+bf16 compute with f32 params/BN stats, data-parallel over the trial's
+sub-mesh via NamedSharding. Convs are XLA's business — they lower
+straight onto the MXU; no hand kernels needed here.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -38,116 +32,44 @@ from rafiki_tpu.model import (BaseModel, CategoricalKnob, FixedKnob,
 from rafiki_tpu.parallel.sharding import (batch_sharding, make_mesh,
                                           replicated)
 
-#: variant name -> (stage sizes, use bottleneck blocks)
-VARIANTS: Dict[str, Tuple[Tuple[int, ...], bool]] = {
-    "resnet18": ((2, 2, 2, 2), False),
-    "resnet34": ((3, 4, 6, 3), False),
-    "resnet50": ((3, 4, 6, 3), True),
-    "resnet101": ((3, 4, 23, 3), True),
+#: convs per stage (each stage ends in 2x2 max-pool); channel width
+#: doubles per stage from `width` up to 8x, VGG-style
+VARIANTS: Dict[str, Sequence[int]] = {
+    "vgg11": (1, 1, 2, 2, 2),
+    "vgg13": (2, 2, 2, 2, 2),
+    "vgg16": (2, 2, 3, 3, 3),
 }
 
 
-class _Block(nn.Module):
-    """Basic residual block: 3x3 conv ×2."""
+class VGG(nn.Module):
+    """Conv stacks over (B, H, W, C); logits head on global avg pool."""
 
-    filters: int
-    strides: int
-    dtype: Any
-
-    @nn.compact
-    def __call__(self, x, train: bool):
-        norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, dtype=self.dtype)
-        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
-        residual = x
-        y = conv(self.filters, (3, 3), (self.strides, self.strides))(x)
-        y = norm()(y)
-        y = nn.relu(y)
-        y = conv(self.filters, (3, 3))(y)
-        # zero-init final BN scale: residual branch starts as identity
-        y = norm(scale_init=nn.initializers.zeros)(y)
-        if residual.shape != y.shape:
-            residual = conv(self.filters, (1, 1),
-                            (self.strides, self.strides),
-                            name="shortcut")(residual)
-            residual = norm(name="shortcut_bn")(residual)
-        return nn.relu(residual + y)
-
-
-class _Bottleneck(nn.Module):
-    """Bottleneck residual block: 1x1 → 3x3 → 1x1 (4× expansion)."""
-
-    filters: int
-    strides: int
-    dtype: Any
-
-    @nn.compact
-    def __call__(self, x, train: bool):
-        norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, dtype=self.dtype)
-        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
-        residual = x
-        y = conv(self.filters, (1, 1))(x)
-        y = norm()(y)
-        y = nn.relu(y)
-        # stride on the 3x3 (the "v1.5" placement — better accuracy than
-        # striding the first 1x1)
-        y = conv(self.filters, (3, 3), (self.strides, self.strides))(y)
-        y = norm()(y)
-        y = nn.relu(y)
-        y = conv(self.filters * 4, (1, 1))(y)
-        y = norm(scale_init=nn.initializers.zeros)(y)
-        if residual.shape != y.shape:
-            residual = conv(self.filters * 4, (1, 1),
-                            (self.strides, self.strides),
-                            name="shortcut")(residual)
-            residual = norm(name="shortcut_bn")(residual)
-        return nn.relu(residual + y)
-
-
-class ResNet(nn.Module):
-    """ResNet over (B, H, W, C) images.
-
-    ``resnet50`` = stage_sizes (3,4,6,3) with bottleneck=True, width=64.
-    """
-
-    stage_sizes: Sequence[int] = (3, 4, 6, 3)
-    bottleneck: bool = True
-    width: int = 64
-    n_classes: int = 1000
-    small_inputs: bool = False  # CIFAR-style stem
+    stage_sizes: Sequence[int]
+    width: int
+    n_classes: int
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
         norm = partial(nn.BatchNorm, use_running_average=not train,
                        momentum=0.9, dtype=self.dtype)
         x = x.astype(self.dtype)
-        if self.small_inputs:
-            x = nn.Conv(self.width, (3, 3), use_bias=False,
-                        dtype=self.dtype, name="stem")(x)
-        else:
-            x = nn.Conv(self.width, (7, 7), (2, 2), use_bias=False,
-                        dtype=self.dtype, name="stem")(x)
-        x = norm(name="stem_bn")(x)
-        x = nn.relu(x)
-        if not self.small_inputs:
-            x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
-        block: Callable[..., Any] = _Bottleneck if self.bottleneck else _Block
-        for i, n_blocks in enumerate(self.stage_sizes):
-            filters = self.width * (2 ** i)
-            for j in range(n_blocks):
-                strides = 2 if i > 0 and j == 0 else 1
-                x = block(filters, strides, self.dtype,
-                          name=f"stage{i}_block{j}")(x, train=train)
-        x = jnp.mean(x, axis=(1, 2))  # global average pool
-        return nn.Dense(self.n_classes, dtype=jnp.float32, name="head")(
-            x.astype(jnp.float32))
+        for stage, n_convs in enumerate(self.stage_sizes):
+            ch = min(self.width * (2 ** stage), self.width * 8)
+            for _ in range(n_convs):
+                x = nn.Conv(ch, (3, 3), padding="SAME", use_bias=False,
+                            dtype=self.dtype)(x)
+                x = nn.relu(norm()(x))
+            if min(x.shape[1], x.shape[2]) >= 2:  # never pool below 1px
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = jnp.mean(x, axis=(1, 2))  # GAP: resolution-agnostic head
+        return nn.Dense(self.n_classes, dtype=jnp.float32,
+                        name="head")(x.astype(jnp.float32))
 
 
-class ResNetClassifier(BaseModel):
-    """ResNet template: image classification, DP over the trial sub-mesh,
-    SGD-momentum with cosine decay (the classic recipe)."""
+class VGGClassifier(BaseModel):
+    """VGG template: image classification, DP over the trial sub-mesh,
+    SGD-momentum with cosine decay (same classic recipe as ResNet)."""
 
     TASKS = (TaskType.IMAGE_CLASSIFICATION,)
 
@@ -176,22 +98,17 @@ class ResNetClassifier(BaseModel):
         self._fwd: Optional[Any] = None  # cached jitted forward
 
     # ---- internals ----
-    def _module(self) -> ResNet:
-        assert self._n_classes is not None and self._image_shape is not None
-        stages, bottleneck = VARIANTS[str(self.knobs["variant"])]
+    def _module(self) -> VGG:
+        assert self._n_classes is not None
         width = max(8, int(64 * float(self.knobs["width_mult"])))
-        small = min(self._image_shape[0], self._image_shape[1]) < 64
         dtype = jnp.bfloat16 if self.knobs.get("bf16", True) else jnp.float32
-        return ResNet(stage_sizes=stages, bottleneck=bottleneck, width=width,
-                      n_classes=int(self._n_classes), small_inputs=small,
-                      dtype=dtype)
+        return VGG(stage_sizes=VARIANTS[str(self.knobs["variant"])],
+                   width=width, n_classes=int(self._n_classes), dtype=dtype)
 
     def _prep(self, images: np.ndarray) -> np.ndarray:
         x = images.astype(np.float32) / 255.0
         if x.ndim == 3:
             x = x[..., None]
-        # global average pooling makes the net resolution-agnostic, but the
-        # stem conv's input channel count is fixed at train time
         return conform_images(x, self._image_shape)
 
     # ---- contract ----
@@ -216,7 +133,8 @@ class ResNetClassifier(BaseModel):
 
         if self._vars is None:
             variables = module.init(jax.random.PRNGKey(0),
-                                    jnp.zeros((1, *x.shape[1:])), train=False)
+                                    jnp.zeros((1, *x.shape[1:])),
+                                    train=False)
             variables = {"params": variables["params"],
                          "batch_stats": variables["batch_stats"]}
         else:
@@ -242,7 +160,6 @@ class ResNetClassifier(BaseModel):
             float(self.knobs["learning_rate"]), epochs * steps_per_epoch)
 
         def decay_mask(tree):
-            # classic recipe: no decay on biases or BatchNorm scale/bias
             return jax.tree_util.tree_map_with_path(
                 lambda kp, _: str(getattr(kp[-1], "key", "")) not in
                 ("bias", "scale"), tree)
@@ -256,8 +173,6 @@ class ResNetClassifier(BaseModel):
         batch_stats = jax.device_put(variables["batch_stats"], r_shard)
         opt_state = jax.device_put(tx.init(params), r_shard)
 
-        # donate the param/stats/opt trees: in-place update, no per-step
-        # copies riding HBM bandwidth
         @partial(jax.jit, donate_argnums=(0, 1, 2))
         def train_step(params, batch_stats, opt_state, xb, yb, mask):
             def loss_fn(p):
@@ -283,9 +198,7 @@ class ResNetClassifier(BaseModel):
             return (params, batch_stats, opt_state), loss
 
         ctx.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
-        # donation invalidates buffers that may alias self._vars (warm
-        # start / re-train): drop the stale reference first
-        self._vars = None
+        self._vars = None  # donation invalidates aliased buffers
         with mesh:
             for epoch in range(epochs):
                 state = (params, batch_stats, opt_state)
@@ -299,14 +212,15 @@ class ResNetClassifier(BaseModel):
                 ctx.logger.log(epoch=epoch, loss=mean_loss)
                 if ctx.checkpoint is not None:
                     # preemption safety: worker throttles + persists
-                    self._vars = {"params": params, "batch_stats": batch_stats}
+                    self._vars = {"params": params,
+                                  "batch_stats": batch_stats}
                     ctx.checkpoint(self.dump_parameters,
                                    frac_done=(epoch + 1) / epochs)
                 if ctx.should_continue is not None and \
                         not ctx.should_continue(epoch, -mean_loss):
                     break
         self._vars = {"params": params, "batch_stats": batch_stats}
-        self._fwd = None  # new params/arch → rebuild the cached jit
+        self._fwd = None
 
     def evaluate(self, dataset_path: str) -> float:
         ds = load_image_classification_dataset(dataset_path)
@@ -325,7 +239,7 @@ class ResNetClassifier(BaseModel):
 
     def _predict_probs(self, x: np.ndarray) -> np.ndarray:
         assert self._vars is not None, "model is not trained/loaded"
-        if self._fwd is None:  # cache: jit memoizes by function identity
+        if self._fwd is None:
             module = self._module()
 
             @jax.jit
@@ -374,10 +288,10 @@ if __name__ == "__main__":  # reference-style self-test block
         generate_image_classification_dataset(train_p, 256, seed=0)
         ds = generate_image_classification_dataset(val_p, 64, seed=1)
         preds = test_model_class(
-            ResNetClassifier, TaskType.IMAGE_CLASSIFICATION, train_p, val_p,
+            VGGClassifier, TaskType.IMAGE_CLASSIFICATION, train_p, val_p,
             queries=[ds.images[0]],
-            knobs={"variant": "resnet18", "width_mult": 0.25,
-                   "batch_size": 32, "max_epochs": 5, "learning_rate": 0.1,
+            knobs={"variant": "vgg11", "width_mult": 0.25,
+                   "batch_size": 32, "max_epochs": 5, "learning_rate": 0.05,
                    "weight_decay": 1e-4, "bf16": False,
                    "quick_train": False, "share_params": False})
         print("prediction:", int(np.argmax(preds[0])))
