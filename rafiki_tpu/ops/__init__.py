@@ -4,12 +4,15 @@ On TPU the flash-attention / patch-embed kernels lower through Mosaic;
 off-TPU the default dispatch routes to equivalent pure-XLA math (the
 Pallas interpreter is test-only — see ``ops/common.py``). Ring attention
 shards the sequence axis over a mesh and rotates K/V via ppermute
-(long-context support; ``ops/ring_attention.py``).
+(long-context support; ``ops/ring_attention.py``); Ulysses swaps the
+sharded axis head↔sequence with two all-to-alls and runs the ordinary
+kernel per head group (``ops/ulysses.py``).
 """
 
 from .attention import flash_attention, mha
 from .patch_embed import extract_patches, matmul_bias, patch_embed
 from .ring_attention import ring_attention
+from .ulysses import ulysses_attention
 
 __all__ = ["flash_attention", "mha", "patch_embed", "matmul_bias",
-           "extract_patches", "ring_attention"]
+           "extract_patches", "ring_attention", "ulysses_attention"]

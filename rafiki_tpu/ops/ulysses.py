@@ -1,0 +1,79 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence swap.
+
+The second long-context strategy next to ring attention
+(``ops/ring_attention.py``), trading differently: instead of rotating
+K/V blocks P times around the ICI ring (P collectives of size L/P per
+device), Ulysses does TWO all-to-alls — swap the sharded axis from
+sequence to heads, run ordinary FULL-sequence attention on each
+device's head group, swap back. Per-device memory for scores is
+O(h/P · L²/block) with flash attention (streamed), communication is
+2 all-to-alls regardless of P, and the attention itself is exactly the
+single-device kernel — so the Pallas flash path applies unchanged on
+TPU.
+
+Pick Ulysses when heads divide the mesh axis (h % P == 0) and the full
+sequence fits one device's HBM once heads are split; pick ring
+attention when sequence length itself is the constraint. Both are
+``shard_map`` + standard XLA collectives — no hand-written transport —
+and differentiable end-to-end (``all_to_all`` has a transpose rule).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      mesh, axis: str,
+                      sm_scale: Optional[float] = None,
+                      causal: bool = False,
+                      batch_axis: Optional[str] = None) -> jnp.ndarray:
+    """Attention over (batch, heads, seq, head_dim) with ``seq`` sharded
+    on ``mesh[axis]``; heads must be divisible by that axis size.
+
+    Internally: all-to-all to (batch, heads/P, SEQ, head_dim) — full
+    sequence, split heads — ordinary attention (Pallas flash on TPU,
+    pure XLA elsewhere, via :func:`rafiki_tpu.ops.attention
+    .flash_attention`), then the inverse all-to-all. Output sharding
+    matches the inputs'. Differentiable end-to-end.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from rafiki_tpu.ops.attention import flash_attention
+
+    n_par = mesh.shape[axis]
+    h = q.shape[1]
+    if h % n_par:
+        raise ValueError(
+            f"ulysses needs heads % mesh[{axis!r}] == 0; got {h} heads "
+            f"over {n_par} devices (use ring_attention instead)")
+    scale = (sm_scale if sm_scale is not None
+             else 1.0 / math.sqrt(q.shape[-1]))
+    seq_spec = P(batch_axis, None, axis, None)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(seq_spec, seq_spec, seq_spec),
+        out_specs=seq_spec)
+    def _ulysses(ql, kl, vl):
+        # local (b, h, L/P, d) → (b, h/P, L, d): split heads, gather seq
+        def swap(t):
+            return jax.lax.all_to_all(t, axis, split_axis=1,
+                                      concat_axis=2, tiled=True)
+
+        qh, kh, vh = swap(ql), swap(kl), swap(vl)
+        # full-sequence attention on this device's head group — the
+        # ordinary kernel, so causal masks need no offset bookkeeping
+        oh = flash_attention(qh, kh, vh, sm_scale=scale, causal=causal)
+        # inverse: split seq back out, gather this device's heads
+        return jax.lax.all_to_all(oh, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    shard = NamedSharding(mesh, seq_spec)
+    return _ulysses(jax.device_put(q, shard), jax.device_put(k, shard),
+                    jax.device_put(v, shard))
