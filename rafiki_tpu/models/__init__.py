@@ -12,6 +12,8 @@ _ZOO = {
     "JaxFeedForward": ("rafiki_tpu.models.mlp", "JaxFeedForward"),
     "ResNetClassifier": ("rafiki_tpu.models.resnet", "ResNetClassifier"),
     "VGGClassifier": ("rafiki_tpu.models.vgg", "VGGClassifier"),
+    "DenseNetClassifier": ("rafiki_tpu.models.densenet",
+                           "DenseNetClassifier"),
     "ViTBase16": ("rafiki_tpu.models.vit", "ViTBase16"),
     "BertClassifier": ("rafiki_tpu.models.bert", "BertClassifier"),
     "LlamaLoRA": ("rafiki_tpu.models.llama_lora", "LlamaLoRA"),

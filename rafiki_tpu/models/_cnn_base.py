@@ -1,0 +1,223 @@
+"""Shared template body for the BatchNorm CNN families (ResNet / VGG /
+DenseNet).
+
+All three train with the same classic recipe — SGD-momentum + cosine
+decay, no weight decay on biases/BN, bf16 compute with f32 params and
+BN stats, DP over the trial's sub-mesh, donated train-step buffers,
+epoch-boundary preemption checkpoints — and serve through the same
+bucketed cached-jit forward. One implementation lives here; each family
+contributes only its flax module (``_module``) and knob config.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from rafiki_tpu.constants import TaskType
+from rafiki_tpu.data import batch_iterator, \
+    load_image_classification_dataset
+from rafiki_tpu.model import (BaseModel, TrainContext, bucketed_forward,
+                              conform_images, same_tree_shapes, train_epoch)
+from rafiki_tpu.parallel.sharding import (batch_sharding, make_mesh,
+                                          replicated)
+
+
+class BatchNormCNNTemplate(BaseModel):
+    """Image-classification template over a flax module with
+    ``batch_stats``. Subclasses implement ``get_knob_config`` and
+    ``_module``; everything else — train/evaluate/predict/serving
+    warmup/dump/load — is shared."""
+
+    TASKS = (TaskType.IMAGE_CLASSIFICATION,)
+
+    def __init__(self, **knobs: Any) -> None:
+        super().__init__(**knobs)
+        self._vars: Optional[Dict[str, Any]] = None
+        self._n_classes: Optional[int] = None
+        self._image_shape: Optional[Sequence[int]] = None
+        self._fwd: Optional[Any] = None  # cached jitted forward
+
+    # ---- family-specific ----
+    def _module(self):
+        raise NotImplementedError
+
+    # ---- shared internals ----
+    def _prep(self, images: np.ndarray) -> np.ndarray:
+        x = images.astype(np.float32) / 255.0
+        if x.ndim == 3:
+            x = x[..., None]
+        # BN at/near the stem absorbs input scale/shift, so no centering
+        # is needed (unlike the ViT template); the stem's channel count
+        # is fixed at train time, hence the conform
+        return conform_images(x, self._image_shape)
+
+    # ---- contract ----
+    def train(self, dataset_path: str,
+              ctx: Optional[TrainContext] = None) -> None:
+        ctx = ctx or TrainContext()
+        ds = load_image_classification_dataset(dataset_path)
+        self._n_classes = ds.n_classes
+        self._image_shape = ds.image_shape
+        x = self._prep(ds.images)
+        y = ds.labels
+
+        module = self._module()
+        devices = ctx.devices or jax.local_devices()
+        mesh = make_mesh(devices)
+        b_shard = batch_sharding(mesh)
+        r_shard = replicated(mesh)
+
+        n_data = len(devices)
+        batch_size = int(self.knobs["batch_size"])
+        batch_size = max(n_data, batch_size - batch_size % n_data)
+
+        if self._vars is None:
+            variables = module.init(jax.random.PRNGKey(0),
+                                    jnp.zeros((1, *x.shape[1:])),
+                                    train=False)
+            variables = {"params": variables["params"],
+                         "batch_stats": variables["batch_stats"]}
+        else:
+            variables = self._vars
+        if ctx.shared_params is not None and self.knobs.get("share_params"):
+            shared = ctx.shared_params.get("params")
+            if shared is not None and same_tree_shapes(variables["params"],
+                                                       shared):
+                variables = {
+                    "params": jax.tree_util.tree_map(jnp.asarray, shared),
+                    "batch_stats": jax.tree_util.tree_map(
+                        jnp.asarray,
+                        ctx.shared_params.get("batch_stats",
+                                              variables["batch_stats"])),
+                }
+
+        epochs = max(1, round(int(self.knobs["max_epochs"])
+                              * float(ctx.budget_scale)))
+        if self.knobs.get("quick_train"):
+            epochs = min(epochs, 2)
+        steps_per_epoch = max(1, (len(x) + batch_size - 1) // batch_size)
+        schedule = optax.cosine_decay_schedule(
+            float(self.knobs["learning_rate"]), epochs * steps_per_epoch)
+
+        def decay_mask(tree):
+            # classic recipe: no decay on biases or BatchNorm scale/bias
+            return jax.tree_util.tree_map_with_path(
+                lambda kp, _: str(getattr(kp[-1], "key", "")) not in
+                ("bias", "scale"), tree)
+
+        tx = optax.chain(
+            optax.add_decayed_weights(float(self.knobs["weight_decay"]),
+                                      mask=decay_mask),
+            optax.sgd(schedule, momentum=0.9, nesterov=True))
+
+        params = jax.device_put(variables["params"], r_shard)
+        batch_stats = jax.device_put(variables["batch_stats"], r_shard)
+        opt_state = jax.device_put(tx.init(params), r_shard)
+
+        # donate the param/stats/opt trees: in-place update, no per-step
+        # copies riding HBM bandwidth
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def train_step(params, batch_stats, opt_state, xb, yb, mask):
+            def loss_fn(p):
+                logits, updates = module.apply(
+                    {"params": p, "batch_stats": batch_stats}, xb,
+                    train=True, mutable=["batch_stats"])
+                losses = optax.softmax_cross_entropy_with_integer_labels(
+                    logits.astype(jnp.float32), yb)
+                loss = jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask),
+                                                            1.0)
+                return loss, updates["batch_stats"]
+
+            (loss, new_stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), new_stats,
+                    opt_state, loss)
+
+        def step(state, b):
+            params, batch_stats, opt_state = state
+            params, batch_stats, opt_state, loss = train_step(
+                params, batch_stats, opt_state, b["x"], b["y"], b["m"])
+            return (params, batch_stats, opt_state), loss
+
+        ctx.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
+        # donation invalidates buffers that may alias self._vars (warm
+        # start / re-train): drop the stale reference first
+        self._vars = None
+        with mesh:
+            for epoch in range(epochs):
+                state = (params, batch_stats, opt_state)
+                (params, batch_stats, opt_state), mean_loss = train_epoch(
+                    step, state,
+                    ({"x": b["x"], "y": b["y"],
+                      "m": b["mask"].astype(np.float32)}
+                     for b in batch_iterator({"x": x, "y": y}, batch_size,
+                                             seed=epoch)),
+                    sharding=b_shard)
+                ctx.logger.log(epoch=epoch, loss=mean_loss)
+                if ctx.checkpoint is not None:
+                    # preemption safety: worker throttles + persists
+                    self._vars = {"params": params,
+                                  "batch_stats": batch_stats}
+                    ctx.checkpoint(self.dump_parameters,
+                                   frac_done=(epoch + 1) / epochs)
+                if ctx.should_continue is not None and \
+                        not ctx.should_continue(epoch, -mean_loss):
+                    break
+        self._vars = {"params": params, "batch_stats": batch_stats}
+        self._fwd = None  # new params/arch → rebuild the cached jit
+
+    def evaluate(self, dataset_path: str) -> float:
+        ds = load_image_classification_dataset(dataset_path)
+        probs = self._predict_probs(self._prep(ds.images))
+        return float(np.mean(np.argmax(probs, -1) == ds.labels))
+
+    def predict(self, queries: Sequence[Any]) -> List[Any]:
+        x = self._prep(np.stack([np.asarray(q) for q in queries]))
+        return [p.tolist() for p in self._predict_probs(x)]
+
+    def warmup(self) -> None:
+        """Compile the serving forward before traffic arrives."""
+        if self._vars is None or self._image_shape is None:
+            return
+        self.predict([np.zeros(list(self._image_shape), np.uint8)])
+
+    def _predict_probs(self, x: np.ndarray) -> np.ndarray:
+        assert self._vars is not None, "model is not trained/loaded"
+        if self._fwd is None:  # cache: jit memoizes by function identity
+            module = self._module()
+
+            @jax.jit
+            def forward(variables, xb):
+                logits = module.apply(variables, xb, train=False)
+                return jax.nn.softmax(logits.astype(jnp.float32), -1)
+
+            self._fwd = forward
+        return bucketed_forward(self._fwd, self._vars, x, bucket=64)
+
+    def dump_parameters(self) -> Dict[str, Any]:
+        assert self._vars is not None, "model is not trained"
+        return {
+            "params": jax.tree_util.tree_map(np.asarray,
+                                             self._vars["params"]),
+            "batch_stats": jax.tree_util.tree_map(
+                np.asarray, self._vars["batch_stats"]),
+            "meta": {"n_classes": self._n_classes,
+                     "image_shape": list(self._image_shape or [])},
+        }
+
+    def load_parameters(self, params: Dict[str, Any]) -> None:
+        self._n_classes = int(params["meta"]["n_classes"])
+        self._image_shape = list(params["meta"]["image_shape"])
+        self._vars = {
+            "params": jax.tree_util.tree_map(jnp.asarray, params["params"]),
+            "batch_stats": jax.tree_util.tree_map(jnp.asarray,
+                                                  params["batch_stats"]),
+        }
+        self._fwd = None
