@@ -9,7 +9,7 @@ batching with bucketed static shapes on TPU. See SURVEY.md for the
 structural map of the reference this tracks.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
 from .constants import (BudgetOption, InferenceJobStatus, ServiceStatus,
                         ServiceType, TaskType, TrainJobStatus, TrialStatus,
