@@ -90,9 +90,13 @@ class AdminApp:
         return 200, self.admin.get_train_jobs(user["id"])
 
     def _health(self, _m, _b, _h) -> Tuple[int, Any]:
+        svc = self.admin.services
+        # respawn_stats is lock-protected: the monitor thread mutates the
+        # underlying dicts while this HTTP thread reads
         return 200, {"ok": True,
-                     "n_services": len(self.admin.services.services),
-                     "free_slots": self.admin.services.allocator.free_count()}
+                     "n_services": len(svc.services),
+                     "free_slots": svc.allocator.free_count(),
+                     **svc.respawn_stats()}
 
     def _login(self, _m, body, _h) -> Tuple[int, Any]:
         try:

@@ -554,6 +554,13 @@ class ServicesManager:
             self._respawn_counts.get(lineage, 0) + 1
         return True
 
+    def respawn_stats(self) -> Dict[str, int]:
+        """Self-healing counters for /health (locked: the monitor thread
+        mutates these dicts while HTTP threads read)."""
+        with self.op_lock:
+            return {"respawns_done": sum(self._respawn_counts.values()),
+                    "pending_respawns": len(self._pending_respawns)}
+
     def pending_respawn_job_ids(self) -> set:
         """Jobs that currently have a queued (slot-starved) worker
         respawn — they must count as busy, or the finalizers declare

@@ -34,6 +34,9 @@ def test_dashboard_and_job_listing(tmp_path):
         hdrs = {"Authorization": f"Bearer {token}"}
         jobs = json_request("GET", base + "/train_jobs", headers=hdrs)
         assert jobs == []
+        health = json_request("GET", base + "/health")
+        assert health["ok"] and health["respawns_done"] == 0
+        assert health["pending_respawns"] == 0
     finally:
         app.stop()
 
