@@ -87,6 +87,10 @@ class Admin:
                 self.meta.update_train_job(job_id,
                                            status=TrainJobStatus.STOPPED,
                                            stopped_at=time.time())
+                # natural completion is the COMMON finalization path —
+                # it must sweep leaked mid-train ckpts too, not just
+                # explicit stop_train_job
+                self._sweep_trial_checkpoints(job_id)
 
     # ---- auth ----
     def login(self, email: str, password: str) -> Dict[str, Any]:
@@ -228,6 +232,32 @@ class Admin:
         for sub in self.meta.get_sub_train_jobs_of_train_job(job_id):
             self.meta.update_sub_train_job(sub["id"],
                                            status=SubTrainJobStatus.STOPPED)
+        self._sweep_trial_checkpoints(job_id)
+
+    def _sweep_trial_checkpoints(self, job_id: str) -> None:
+        """Drop ``ckpt-<trial_id>`` working blobs once the job is done.
+        Mid-train checkpoints of preempted trials that were never resumed
+        (respawn budget exhausted, job stopped) and of failed resumes
+        otherwise live forever in the ParamStore (ADVICE r3); after job
+        finalization nothing will ever resume them. ALL trials are swept
+        — including RUNNING zombies whose worker was SIGKILLed (the
+        state a preemption leaves behind): the job's worker pool is gone,
+        so no claimant remains. Final trial params (key = trial_id) are
+        artifacts and are kept — deployment reads them."""
+        from ..store.param_store import ParamStore
+
+        try:
+            store = ParamStore.from_uri(self.services.param_store_uri)
+            for t in self.meta.get_trials_of_train_job(job_id):
+                store.delete(f"ckpt-{t['id']}")
+                store.delete(f"ckpt-{t['id']}-meta")
+        except Exception:  # noqa: BLE001 — a kv hiccup must not turn a
+            # clean job stop into a 500; the leak is bounded and logged
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "trial checkpoint sweep failed for job %s", job_id,
+                exc_info=True)
 
     def get_trials(self, job_id: str) -> List[Dict[str, Any]]:
         return self.meta.get_trials_of_train_job(job_id)

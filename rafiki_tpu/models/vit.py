@@ -160,6 +160,10 @@ class ViTBase16(BaseModel):
         self._n_classes: Optional[int] = None
         self._image_shape: Optional[Sequence[int]] = None
         self._fwd: Optional[Any] = None  # cached jitted forward
+        #: input-normalization contract the ACTIVE params were trained
+        #: under; fresh trains use v2, load_parameters adopts the
+        #: checkpoint's version so old params keep serving correctly
+        self._prep_version: int = 2
 
     # ---- internals ----
     def _module(self) -> ViT:
@@ -177,11 +181,17 @@ class ViTBase16(BaseModel):
                    dtype=self._dtype())
 
     def _prep(self, images: np.ndarray) -> np.ndarray:
-        # center to [-1, 1]: with raw [0, 1] pixels the DC component
-        # dominates every patch projection and a small ViT sits in a
-        # uniform-logits plateau for its whole budget (measured: chance
-        # accuracy at 15 epochs uncentered vs ~0.7 by epoch 8 centered)
-        x = images.astype(np.float32) / 127.5 - 1.0
+        if self._prep_version == 1:
+            # v1-checkpoint compatibility: params trained on [0, 1]
+            # inputs must keep seeing [0, 1] at serving time
+            x = images.astype(np.float32) / 255.0
+        else:
+            # center to [-1, 1]: with raw [0, 1] pixels the DC component
+            # dominates every patch projection and a small ViT sits in a
+            # uniform-logits plateau for its whole budget (measured:
+            # chance accuracy at 15 epochs uncentered vs ~0.7 by epoch 8
+            # centered)
+            x = images.astype(np.float32) / 127.5 - 1.0
         if x.ndim == 3:
             x = x[..., None]
         # pos_embed is sized to the train-time patch count: conform queries
@@ -228,7 +238,20 @@ class ViTBase16(BaseModel):
             params = self._params
         if ctx.shared_params is not None and self.knobs.get("share_params"):
             shared = ctx.shared_params.get("params")
-            if shared is not None and same_tree_shapes(params, shared):
+            donor_prep = int(ctx.shared_params.get("meta", {})
+                             .get("prep_version", 1))
+            if shared is not None and donor_prep != self._prep_version:
+                # input-contract mismatch: weights trained on v1 [0,1]
+                # inputs warm-starting a v2 [-1,1] train would begin at
+                # worse-than-random loss AND get re-stamped v2 on dump,
+                # erasing the evidence — cold start is strictly better
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "skipping warm start: donor checkpoint prep_version="
+                    "%d != this train's %d (input normalization "
+                    "contracts differ)", donor_prep, self._prep_version)
+            elif shared is not None and same_tree_shapes(params, shared):
                 params = jax.tree_util.tree_map(jnp.asarray, shared)
 
         epochs = max(1, round(int(self.knobs["max_epochs"])
@@ -334,20 +357,20 @@ class ViTBase16(BaseModel):
             "params": jax.tree_util.tree_map(np.asarray, self._params),
             "meta": {"n_classes": self._n_classes,
                      "image_shape": list(self._image_shape or []),
-                     # input normalization version: 2 = centered [-1, 1]
-                     "prep_version": 2},
+                     # input normalization the params were trained under
+                     # (1 = [0,1], 2 = centered [-1,1]); a re-dumped v1
+                     # load stays v1 — the version follows the weights
+                     "prep_version": self._prep_version},
         }
 
     def load_parameters(self, params: Dict[str, Any]) -> None:
         self._n_classes = int(params["meta"]["n_classes"])
         self._image_shape = list(params["meta"]["image_shape"])
-        if params["meta"].get("prep_version", 1) != 2:
-            import logging
-
-            logging.getLogger(__name__).warning(
-                "ViT checkpoint was trained with v1 [0,1] input "
-                "normalization; this build feeds centered [-1,1] inputs — "
-                "re-train or expect degraded predictions")
+        # honor the checkpoint's input contract: _prep applies the
+        # normalization these weights were trained under, so v1
+        # checkpoints serve at full quality instead of silently seeing
+        # shifted inputs (ADVICE r3)
+        self._prep_version = int(params["meta"].get("prep_version", 1))
         self._params = jax.tree_util.tree_map(jnp.asarray, params["params"])
         self._fwd = None
 

@@ -177,6 +177,12 @@ class KVClient:
     def llen(self, key: str) -> int:
         return int(self._cmd("LLEN", key))
 
+    def expire(self, key: str, seconds: float) -> None:
+        """Condemn ``key`` (kv or list) ``seconds`` from now. kvd delta
+        vs Redis: the key need not exist yet and the TTL survives
+        DEL/recreation until it fires — see kv_server.cc."""
+        self._cmd("EXPIRE", key, seconds)
+
     def brpop(self, keys, timeout: float
               ) -> Optional[Tuple[str, bytes]]:
         """Blocking tail-pop across ``keys``; None on timeout."""

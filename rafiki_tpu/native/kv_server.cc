@@ -10,7 +10,14 @@
 //
 // Commands: PING, SET, GET, DEL, EXISTS, KEYS <glob>, INCR,
 //           LPUSH, RPUSH, BRPOP <key...> <timeout_s>, LPOP, LLEN,
-//           FLUSHALL, SHUTDOWN.
+//           EXPIRE <key> <seconds>, FLUSHALL, SHUTDOWN.
+//
+// EXPIRE delta vs Redis: the TTL survives key deletion/recreation until
+// it fires. That is deliberate — the predictor sets a TTL on each
+// transient reply queue (q:preds:<query_id>), and a worker's LATE push
+// after the gather's discard must not resurrect an immortal key (query
+// ids are never reused, so a lingering TTL can only ever collect
+// garbage). Without this, every late reply leaked a list forever.
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -38,11 +45,42 @@ struct Store {
   std::condition_variable list_cv;  // signalled on any list push
   std::unordered_map<std::string, std::string> kv;
   std::unordered_map<std::string, std::deque<std::string>> lists;
+  // key → absolute expiry; purged opportunistically (throttled scan at
+  // command dispatch). Only transient queue keys carry TTLs, so the
+  // scan is O(outstanding queries), not O(all blobs).
+  std::unordered_map<std::string,
+                     std::chrono::steady_clock::time_point> ttl;
 };
 
 Store g_store;
 std::atomic<bool> g_shutdown{false};
+std::atomic<int64_t> g_last_purge_ms{0};
 int g_listen_fd = -1;
+
+void PurgeExpiredLocked() {
+  auto now = std::chrono::steady_clock::now();
+  for (auto it = g_store.ttl.begin(); it != g_store.ttl.end();) {
+    if (it->second <= now) {
+      g_store.kv.erase(it->first);
+      g_store.lists.erase(it->first);
+      it = g_store.ttl.erase(it);
+    } else {
+      ++it;
+    }
+  }
+}
+
+void MaybePurgeExpired() {
+  // throttle the scan: correctness only needs eventual collection
+  int64_t now_ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                       std::chrono::steady_clock::now().time_since_epoch())
+                       .count();
+  int64_t last = g_last_purge_ms.load(std::memory_order_relaxed);
+  if (now_ms - last < 50) return;
+  if (!g_last_purge_ms.compare_exchange_strong(last, now_ms)) return;
+  std::lock_guard<std::mutex> l(g_store.mu);
+  PurgeExpiredLocked();
+}
 
 // ---- glob match (supports * and ?) ----------------------------------------
 bool GlobMatch(const char* p, const char* s) {
@@ -106,6 +144,7 @@ std::string Err(const std::string& m) { return "-ERR " + m + "\r\n"; }
 std::string Execute(std::vector<std::string>& args) {
   std::string cmd = args[0];
   for (auto& c : cmd) c = static_cast<char>(toupper(c));
+  MaybePurgeExpired();
 
   if (cmd == "PING") return "+PONG\r\n";
   if (cmd == "SHUTDOWN") {
@@ -117,7 +156,20 @@ std::string Execute(std::vector<std::string>& args) {
     std::lock_guard<std::mutex> l(g_store.mu);
     g_store.kv.clear();
     g_store.lists.clear();
+    g_store.ttl.clear();
     return "+OK\r\n";
+  }
+  if (cmd == "EXPIRE" && args.size() == 3) {
+    double secs = strtod(args[2].c_str(), nullptr);
+    std::lock_guard<std::mutex> l(g_store.mu);
+    // unlike Redis, the key need not exist yet: the predictor arms the
+    // TTL when it ISSUES a query, so even a reply that arrives after
+    // the gather's discard is already condemned
+    g_store.ttl[args[1]] =
+        std::chrono::steady_clock::now() +
+        std::chrono::duration_cast<std::chrono::steady_clock::duration>(
+            std::chrono::duration<double>(secs));
+    return Int(1);
   }
   if (cmd == "SET" && args.size() == 3) {
     std::lock_guard<std::mutex> l(g_store.mu);

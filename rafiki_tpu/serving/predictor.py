@@ -21,7 +21,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..utils.http import JsonHttpService
-from .queues import QueueHub, pack_message, unpack_message
+from .queues import (EXPIRY_SKEW_TOLERANCE_S, QueueHub, pack_message,
+                     unpack_message)
 
 
 def ensemble_predictions(per_worker: List[List[Any]]) -> List[Any]:
@@ -83,6 +84,14 @@ class Predictor:
         # nobody will read (and recreating a discarded reply queue)
         msg = pack_message({"id": qid, "queries": _stack(queries),
                             "deadline_ts": time.time() + timeout})
+        # condemn the reply queue up front: a worker inside its expiry
+        # skew tolerance may answer after our discard below, recreating
+        # the queue in the kv store — the pre-armed TTL collects it
+        try:
+            self.hub.arm_reply_ttl(
+                qid, timeout + EXPIRY_SKEW_TOLERANCE_S + 30.0)
+        except Exception:  # noqa: BLE001 — TTL is defense-in-depth
+            pass
         for wid in self.worker_ids:
             self.hub.push_query(wid, msg)
 

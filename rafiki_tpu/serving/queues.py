@@ -24,6 +24,14 @@ from typing import Any, Dict, Optional
 from ..store.param_store import params_from_bytes, params_to_bytes
 
 
+#: tolerance a worker adds to a query's deadline_ts before dropping it
+#: as expired — covers predictor↔worker wall-clock skew (ADVICE r3).
+#: Lives here (the shared data-plane module) because both sides size
+#: against it: workers pad the drop test, the predictor pads reply-queue
+#: TTLs so skew-window stragglers still get collected.
+EXPIRY_SKEW_TOLERANCE_S = 3.0
+
+
 def pack_message(msg: Dict[str, Any]) -> bytes:
     return params_to_bytes(msg)
 
@@ -58,6 +66,13 @@ class QueueHub:
         accumulate forever in the backing store."""
         raise NotImplementedError
 
+    def arm_reply_ttl(self, query_id: str, ttl_s: float) -> None:
+        """Condemn a query's reply queue ``ttl_s`` from now, armed at
+        SCATTER time. Belt to discard's suspenders: a worker inside the
+        expiry skew window may push a reply AFTER the gather discarded
+        the queue, recreating it — the pre-armed TTL collects that
+        straggler. Backends with their own sweep may no-op."""
+
 
 class _KeyQueue:
     """One deque + its OWN condvar. A shared hub-wide condition would
@@ -88,13 +103,20 @@ class InProcQueueHub(QueueHub):
         self._meta = threading.Lock()  # guards the key → queue dict
         self._ops = 0
 
-    def _get(self, key: str) -> _KeyQueue:
+    def _get(self, key: str, *, as_waiter: bool = False) -> _KeyQueue:
         import time
 
         with self._meta:
             q = self._queues.get(key)
             if q is None:
                 q = self._queues[key] = _KeyQueue()
+            if as_waiter:
+                # registered BEFORE _meta is released: waiters is read by
+                # discard/sweep under _meta, so a popper that has fetched
+                # the queue can never be invisible to them (the window
+                # between fetch and a later increment under q.cv orphaned
+                # poppers on deleted entries — ADVICE r3)
+                q.waiters += 1
             q.last_used = time.monotonic()
             self._ops += 1
             if self._ops % _SWEEP_EVERY == 0:
@@ -116,16 +138,16 @@ class InProcQueueHub(QueueHub):
             q.cv.notify()
 
     def _pop(self, key: str, timeout: float) -> Optional[bytes]:
-        q = self._get(key)
-        with q.cv:
-            q.waiters += 1
-            try:
+        q = self._get(key, as_waiter=True)
+        try:
+            with q.cv:
                 ok = q.cv.wait_for(lambda: bool(q.dq), timeout=timeout)
-            finally:
+                if not ok:
+                    return None
+                return q.dq.popleft()
+        finally:
+            with self._meta:  # all waiters transitions happen under _meta
                 q.waiters -= 1
-            if not ok:
-                return None
-            return q.dq.popleft()
 
     def push_query(self, worker_id: str, data: bytes) -> None:
         self._push(f"q:{worker_id}", data)
@@ -178,8 +200,16 @@ class KVQueueHub(QueueHub):
         got = self._client().brpop(f"q:queries:{worker_id}", timeout)
         return None if got is None else got[1]
 
+    #: push-time TTL on reply queues: every reply key is mortal even
+    #: when the scatter-time TTL already fired and was purged before a
+    #: very late push (e.g. a worker stuck in a >30s XLA recompile
+    #: inside its expiry-skew window) recreated the key
+    REPLY_TTL_S = 120.0
+
     def push_prediction(self, query_id: str, data: bytes) -> None:
-        self._client().lpush(f"q:preds:{query_id}", data)
+        c = self._client()
+        c.lpush(f"q:preds:{query_id}", data)
+        c.expire(f"q:preds:{query_id}", self.REPLY_TTL_S)
 
     def pop_prediction(self, query_id: str,
                        timeout: float) -> Optional[bytes]:
@@ -193,3 +223,9 @@ class KVQueueHub(QueueHub):
 
     def discard_prediction_queue(self, query_id: str) -> None:
         self._client().delete(f"q:preds:{query_id}")
+
+    def arm_reply_ttl(self, query_id: str, ttl_s: float) -> None:
+        # kvd TTLs deliberately survive deletion/recreation (see
+        # kv_server.cc) — one EXPIRE at scatter covers the whole
+        # query lifetime including post-discard stragglers
+        self._client().expire(f"q:preds:{query_id}", ttl_s)

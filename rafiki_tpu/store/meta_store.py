@@ -55,7 +55,8 @@ CREATE TABLE IF NOT EXISTS trials (
     trial_no INTEGER NOT NULL, model_id TEXT NOT NULL,
     worker_id TEXT, knobs TEXT, score REAL, budget_scale REAL DEFAULT 1.0,
     shape_signature TEXT, status TEXT NOT NULL,
-    params_saved INTEGER DEFAULT 0, error TEXT, heartbeat_at REAL,
+    params_saved INTEGER DEFAULT 0, error TEXT, error_class TEXT,
+    heartbeat_at REAL,
     started_at REAL, stopped_at REAL, created_at REAL NOT NULL);
 CREATE INDEX IF NOT EXISTS idx_trials_job ON trials(sub_train_job_id);
 CREATE TABLE IF NOT EXISTS trial_logs (
@@ -109,6 +110,19 @@ class MetaStore:
                     "ALTER TABLE trials ADD COLUMN heartbeat_at REAL")
             except sqlite3.OperationalError:
                 pass
+            try:
+                self._conn.execute(
+                    "ALTER TABLE trials ADD COLUMN error_class TEXT")
+            except sqlite3.OperationalError:
+                pass
+            else:
+                # column freshly added → pre-upgrade DB. Under the old
+                # semantics EVERY ERRORED row was resumable; backfill as
+                # preemption-class so recorded device losses keep their
+                # remaining budget instead of becoming unclaimable NULLs
+                self._conn.execute(
+                    "UPDATE trials SET error_class='preemption' "
+                    "WHERE status='ERRORED' AND error_class IS NULL")
             self._conn.commit()
 
     def close(self) -> None:
@@ -343,13 +357,23 @@ class MetaStore:
                 (score, int(params_saved), _now(), trial_id))
             return cur.rowcount == 1
 
-    def mark_trial_errored(self, trial_id: str, error: str) -> bool:
-        """Fenced like :meth:`mark_trial_completed`."""
+    def mark_trial_errored(self, trial_id: str, error: str,
+                           error_class: str = "deterministic") -> bool:
+        """Fenced like :meth:`mark_trial_completed`.
+
+        ``error_class`` records WHY the trial died, which decides whether
+        peers may resume it: ``"preemption"`` (infra fault — device loss,
+        OOM-kill, connection reset; worth re-running elsewhere) vs
+        ``"deterministic"`` (code/knob bug recorded by a live worker —
+        re-running it anywhere yields the same crash, so resume is
+        forbidden and only the advisor's trial_errored accounting runs).
+        """
         with self._lock, self._conn:
             cur = self._conn.execute(
-                "UPDATE trials SET status='ERRORED', error=?, stopped_at=? "
+                "UPDATE trials SET status='ERRORED', error=?, "
+                "error_class=?, stopped_at=? "
                 "WHERE id=? AND status='RUNNING'",
-                (error[:4000], _now(), trial_id))
+                (error[:4000], error_class, _now(), trial_id))
             return cur.rowcount == 1
 
     def heartbeat_trial(self, trial_id: str) -> None:
@@ -362,13 +386,19 @@ class MetaStore:
                                stale_after_s: float = 60.0) -> bool:
         """Atomically take ownership of an orphaned trial for resume.
 
-        Eligible: status ERRORED (crash already recorded), or RUNNING
-        with no heartbeat for ``stale_after_s`` — a live peer heartbeats
-        every few seconds, so a fresh heartbeat means the trial is NOT
-        orphaned and the claim loses. The staleness condition sits inside
-        the UPDATE itself, so exactly one concurrent claimant can win and
-        a revived heartbeat between scan and claim voids the claim. The
-        original error text is preserved (pointer appended)."""
+        Eligible: status ERRORED with ``error_class='preemption'`` (an
+        infra fault a live worker managed to record — device loss, OOM —
+        worth re-running on healthy hardware), or RUNNING with no
+        heartbeat for ``stale_after_s`` — a live peer heartbeats every
+        few seconds, so a fresh heartbeat means the trial is NOT orphaned
+        and the claim loses. Deterministic ERRORED rows (code/knob bugs)
+        are NEVER claimable: re-running them anywhere reproduces the
+        crash, and N workers would otherwise re-run one bad trial up to
+        N*max_resumes times (ADVICE r3). The staleness condition sits
+        inside the UPDATE itself, so exactly one concurrent claimant can
+        win and a revived heartbeat between scan and claim voids the
+        claim. The original error text is preserved (pointer appended).
+        """
         cutoff = _now() - stale_after_s
         marker = f"resumed by {worker_id}"
         with self._lock, self._conn:
@@ -376,7 +406,8 @@ class MetaStore:
                 "UPDATE trials SET status='TERMINATED', stopped_at=?, "
                 "error=(CASE WHEN error IS NULL OR error='' THEN ? "
                 "ELSE error || ? END) "
-                "WHERE id=? AND (status='ERRORED' OR (status='RUNNING' "
+                "WHERE id=? AND ((status='ERRORED' AND "
+                "error_class='preemption') OR (status='RUNNING' "
                 "AND COALESCE(heartbeat_at, started_at, 0) < ?))",
                 (_now(), marker, f" | {marker}", trial_id, cutoff))
             return cur.rowcount == 1

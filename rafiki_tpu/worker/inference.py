@@ -32,7 +32,8 @@ from typing import Any, List, Optional, Type
 import numpy as np
 
 from ..model.base import BaseModel
-from ..serving.queues import QueueHub, pack_message, unpack_message
+from ..serving.queues import (EXPIRY_SKEW_TOLERANCE_S, QueueHub,
+                              pack_message, unpack_message)
 from ..store.param_store import ParamStore
 
 
@@ -45,6 +46,11 @@ class InferenceWorker:
         self.worker_id = worker_id
         self.hub = hub
         self.max_batch_msgs = max_batch_msgs
+        #: visible drop accounting: silent expiry drops look identical to
+        #: gather timeouts from the predictor side, so the worker keeps
+        #: its own count (and logs) — the first diagnostic to check when
+        #: "the predictor only sees timeouts" (clock skew, ADVICE r3)
+        self.stats = {"dropped_expired": 0}
         self._stop = threading.Event()
         self.model = model_class(**knobs)
         params = param_store.load(trial_id)
@@ -99,6 +105,22 @@ class InferenceWorker:
     def stop(self) -> None:
         self._stop.set()
 
+    def _count_dropped(self, n: int) -> None:
+        if n <= 0:
+            return
+        import logging
+
+        total = self.stats["dropped_expired"] = \
+            self.stats["dropped_expired"] + n
+        # log the first drop and then every 100th: one line is enough to
+        # diagnose skew, a line per query would flood under overload
+        if total == n or total % 100 < n:
+            logging.getLogger(__name__).warning(
+                "%s dropped %d expired quer%s (%d total) — if the "
+                "predictor only reports timeouts, check clock skew "
+                "between predictor and worker hosts",
+                self.worker_id, n, "y" if n == 1 else "ies", total)
+
     # ---- the loop ----
     def run(self, poll_timeout: float = 0.5,
             max_iterations: Optional[int] = None) -> None:
@@ -118,9 +140,10 @@ class InferenceWorker:
                 if more is None:
                     break
                 messages.append(unpack_message(more))
-            messages = [m for m in messages if not _expired(m)]
-            if messages:
-                self._serve_batch(messages)
+            live = [m for m in messages if not _expired(m)]
+            self._count_dropped(len(messages) - len(live))
+            if live:
+                self._serve_batch(live)
 
     def _run_decode_loop(self, poll_timeout: float,
                          max_iterations: Optional[int]) -> None:
@@ -143,6 +166,7 @@ class InferenceWorker:
             while raw is not None:
                 m = unpack_message(raw)
                 if _expired(m):
+                    self._count_dropped(1)
                     raw = self.hub.pop_query(self.worker_id, 0.0)
                     continue
                 qs = m["queries"]
@@ -212,15 +236,20 @@ class InferenceWorker:
             self.hub.push_prediction(m["id"], pack_message(reply))
 
 
-def _expired(msg: dict) -> bool:
+def _expired(msg: dict, skew_s: float = EXPIRY_SKEW_TOLERANCE_S) -> bool:
     """The predictor stamps each query with its gather deadline; a
     worker that pops it too late must drop it — the answer would land
     in a discarded reply queue and leak there forever (and the forward
-    pass would be wasted compute)."""
+    pass would be wasted compute). ``skew_s`` pads the test because
+    deadline_ts is the PREDICTOR's wall clock (ADVICE r3): without the
+    margin, cross-machine clock skew beyond the gather timeout makes a
+    worker silently drop every query while the predictor only sees
+    timeouts. The cost is at most one wasted forward per truly-late
+    query; reply-queue TTLs are padded against the same constant."""
     import time
 
     ts = msg.get("deadline_ts")
-    return ts is not None and time.time() > float(ts)
+    return ts is not None and time.time() > float(ts) + skew_s
 
 
 def _to_plain(preds: List[Any]) -> List[Any]:

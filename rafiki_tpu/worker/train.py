@@ -24,6 +24,32 @@ from ..model.base import BaseModel, TrainContext
 from ..model.log import ModelLogger
 from ..store.param_store import ParamStore
 
+#: substrings marking infra-class failures in exception text. The gRPC/XLA
+#: status names cover the TPU runtime's device-loss vocabulary
+#: (jaxlib raises XlaRuntimeError with "UNAVAILABLE: ..."-style messages);
+#: "preempt" covers scheduler/maintenance-event wording.
+_PREEMPTION_MARKERS = ("UNAVAILABLE", "RESOURCE_EXHAUSTED",
+                       "DEADLINE_EXCEEDED", "DATA_LOSS", "ABORTED",
+                       "preempt")
+
+
+def classify_trial_error(e: BaseException) -> str:
+    """``"preemption"`` (infra fault — resumable on healthy hardware) vs
+    ``"deterministic"`` (code/knob bug — resume would reproduce the
+    crash). Drives :meth:`MetaStore.claim_trial_for_resume` eligibility:
+    only preemption-class ERRORED rows may be claimed by peers."""
+    if isinstance(e, (FileNotFoundError, IsADirectoryError,
+                      NotADirectoryError, PermissionError)):
+        # path-shaped OSErrors are config bugs (wrong dataset path,
+        # missing blob) — every peer would hit the identical error
+        return "deterministic"
+    if isinstance(e, (OSError, MemoryError, EOFError)):
+        return "preemption"
+    msg = f"{type(e).__name__}: {e}"
+    if any(m in msg for m in _PREEMPTION_MARKERS):
+        return "preemption"
+    return "deterministic"
+
 
 class TrainWorker:
     """Runs trials against an advisor (in-proc object or HTTP client —
@@ -185,7 +211,8 @@ class TrainWorker:
                 fenced_out = False
                 if self.meta_store is not None:
                     fenced_out = not self.meta_store.mark_trial_errored(
-                        trial_id, f"{e}\n{traceback.format_exc()}")
+                        trial_id, f"{e}\n{traceback.format_exc()}",
+                        error_class=classify_trial_error(e))
                 if not fenced_out:
                     try:
                         self.advisor.trial_errored(proposal.trial_no)
@@ -264,14 +291,18 @@ class TrainWorker:
     def resume_orphaned_trials(self) -> int:
         """Finish trials a dead worker left behind (SURVEY.md §5.3).
 
-        Orphan = status ERRORED, or RUNNING with a stale heartbeat (a
-        live owner stamps every ``heartbeat_interval_s``; the staleness
-        test is enforced INSIDE the atomic claim, so a live peer's trial
-        cannot be hijacked and exactly one claimant wins). With a
-        ``ckpt-<id>`` blob the trial resumes warm under the same knobs
-        and trial_no, training only the remaining budget recorded at
-        checkpoint time; without one (killed before the first throttled
-        save) it re-runs cold — either way no zombie RUNNING rows remain.
+        Orphan = status ERRORED with ``error_class='preemption'`` (infra
+        fault recorded by a live worker — device loss, OOM), or RUNNING
+        with a stale heartbeat, i.e. process death (a live owner stamps
+        every ``heartbeat_interval_s``; the staleness test is enforced
+        INSIDE the atomic claim, so a live peer's trial cannot be
+        hijacked and exactly one claimant wins). Deterministic ERRORED
+        rows — a code/knob crash — are never resumed: re-running them
+        reproduces the crash (ADVICE r3 medium). With a ``ckpt-<id>``
+        blob the trial resumes warm under the same knobs and trial_no,
+        training only the remaining budget recorded at checkpoint time;
+        without one (killed before the first throttled save) it re-runs
+        cold — either way no zombie RUNNING rows remain.
         """
         if self.meta_store is None or self._resumes_done >= self.max_resumes:
             return 0
@@ -284,6 +315,10 @@ class TrainWorker:
                 self.sub_train_job_id):
             if t["status"] not in ("RUNNING", "ERRORED"):
                 continue
+            if t["status"] == "ERRORED" and \
+                    t.get("error_class") != "preemption":
+                continue  # deterministic crash — the claim would refuse
+                # anyway; skip the doomed UPDATE round-trip
             if t["id"] in self._own_trial_ids:
                 # trials from THIS process's lifetime: own failures are
                 # code errors, not preemption, and a worker must never

@@ -67,3 +67,27 @@ def test_vit_bf16_compute_keeps_f32_params():
     assert block_out.dtype == jnp.bfloat16, block_out.dtype
     # logits head stays f32 for a stable softmax/loss
     assert out.dtype == jnp.float32
+
+
+def test_vit_v1_checkpoint_prep_compat():
+    """A v1 checkpoint (trained on [0,1] inputs) must keep seeing [0,1]
+    inputs at serving time — the version follows the weights, not the
+    build (ADVICE r3)."""
+    m = ViTBase16(**TINY)
+    assert m._prep_version == 2  # fresh models train under v2
+    m._n_classes = 3
+    m._image_shape = [8, 8, 3]
+    white = np.full((1, 8, 8, 3), 255, np.uint8)
+    assert np.isclose(m._prep(white).max(), 1.0)
+    assert np.isclose(m._prep(np.zeros((1, 8, 8, 3), np.uint8)).min(), -1.0)
+
+    # v1 load: normalization switches to [0, 1] and survives a re-dump
+    m2 = ViTBase16(**TINY)
+    m2.load_parameters({
+        "params": {"w": np.zeros((1,), np.float32)},
+        "meta": {"n_classes": 3, "image_shape": [8, 8, 3]},  # no version
+    })
+    assert m2._prep_version == 1
+    assert np.isclose(m2._prep(white).max(), 1.0)
+    assert np.isclose(m2._prep(np.zeros((1, 8, 8, 3), np.uint8)).min(), 0.0)
+    assert m2.dump_parameters()["meta"]["prep_version"] == 1

@@ -101,3 +101,25 @@ def test_param_store_kv_backend(server):
     assert "trial-1" in store2.keys()
     store2.delete("trial-1")
     assert store2.load("trial-1") is None
+
+
+def test_expire_collects_key(client):
+    client.set("mortal", b"v")
+    client.expire("mortal", 0.15)
+    assert client.get("mortal") == b"v"  # not yet
+    time.sleep(0.35)  # past TTL + the 50ms purge throttle
+    client.ping()  # any command triggers the purge scan
+    assert client.get("mortal") is None
+
+
+def test_expire_survives_del_and_recreate(client):
+    """kvd delta vs Redis (deliberate): a reply queue's TTL outlives
+    discard, so a worker's LATE push after the predictor's DEL is still
+    collected instead of leaking forever (ADVICE r3)."""
+    client.expire("q:preds:q1", 0.15)  # armed before the key exists
+    client.lpush("q:preds:q1", b"late reply")  # straggler recreates it
+    assert client.llen("q:preds:q1") == 1
+    time.sleep(0.35)
+    client.ping()
+    assert client.llen("q:preds:q1") == 0
+    assert not client.exists("q:preds:q1")

@@ -139,6 +139,62 @@ def test_completed_trials_never_resumed(stores):
     assert w2.resume_orphaned_trials() == 0
 
 
+def test_deterministic_failure_never_resumed(stores):
+    """ADVICE r3 (medium): a code/knob crash recorded by a live worker is
+    NOT an orphan — peers re-running it would reproduce the crash (and
+    double-feed the advisor when a resume completes)."""
+    meta, store, sub_id = stores
+
+    class BuggyModel(ToyModel):
+        def train(self, dataset_path, ctx=None):
+            raise ValueError("bad knob combination")  # deterministic
+
+    _worker(BuggyModel, meta, store, sub_id, "w0", 1).run(max_trials=1)
+    t = meta.get_trials_of_sub_train_job(sub_id)[0]
+    assert t["status"] == "ERRORED"
+    assert t["error_class"] == "deterministic"
+
+    w2 = _worker(ToyModel, meta, store, sub_id, "w1", trials=0)
+    assert w2.resume_orphaned_trials() == 0
+    # even a direct claim refuses a deterministic ERRORED row
+    assert meta.claim_trial_for_resume(t["id"], "w1") is False
+    assert meta.get_trial(t["id"])["status"] == "ERRORED"
+
+
+def test_error_classification():
+    from rafiki_tpu.worker.train import classify_trial_error
+
+    # infra-class: resumable elsewhere
+    assert classify_trial_error(OSError("connection reset")) == "preemption"
+    assert classify_trial_error(MemoryError()) == "preemption"
+    assert classify_trial_error(
+        RuntimeError("UNAVAILABLE: TPU device lost")) == "preemption"
+    assert classify_trial_error(
+        RuntimeError("worker preempted by scheduler")) == "preemption"
+    # code bugs: deterministic, never resumed
+    assert classify_trial_error(ValueError("bad knob")) == "deterministic"
+    assert classify_trial_error(KeyError("params")) == "deterministic"
+    assert classify_trial_error(
+        ZeroDivisionError()) == "deterministic"
+
+
+def test_preemption_class_errored_is_resumed(stores):
+    """FlakyToyModel's 'simulated preemption' classifies as infra-class,
+    so the recorded ERRORED row stays claimable (the round-3 behavior,
+    now opt-in via error_class)."""
+    meta, store, sub_id = stores
+    _worker(FlakyToyModel, meta, store, sub_id, "w0", 1).run(max_trials=1)
+    t = meta.get_trials_of_sub_train_job(sub_id)[0]
+    assert t["status"] == "ERRORED" and t["error_class"] == "preemption"
+    # and it IS claimable/resumable by a peer — guards the claim SQL's
+    # error_class gate, not just the recorded label
+    w2 = _worker(ToyModel, meta, store, sub_id, "w1", trials=0)
+    assert w2.resume_orphaned_trials() == 1
+    done = [x for x in meta.get_trials_of_sub_train_job(sub_id)
+            if x["status"] == "COMPLETED"]
+    assert len(done) == 1 and done[0]["score"] == 5.0
+
+
 def test_worker_never_resumes_own_failure(stores):
     meta, store, sub_id = stores
     w = _worker(FlakyToyModel, meta, store, sub_id, "w0", trials=2)

@@ -277,9 +277,12 @@ def test_worker_drops_expired_queries():
     from rafiki_tpu.worker.inference import InferenceWorker
 
     hub = InProcQueueHub()
+    from rafiki_tpu.worker.inference import EXPIRY_SKEW_TOLERANCE_S
+
     hub.push_query("w0", pack_message(
         {"id": "dead", "queries": [[0.0]],
-         "deadline_ts": time.time() - 1.0}))  # already expired
+         # expired beyond the clock-skew margin
+         "deadline_ts": time.time() - EXPIRY_SKEW_TOLERANCE_S - 1.0}))
     hub.push_query("w0", pack_message(
         {"id": "live", "queries": [[0.0]],
          "deadline_ts": time.time() + 30.0}))
@@ -333,3 +336,26 @@ def test_worker_warms_serving_path_at_boot(trained):
     hub = InProcQueueHub()
     InferenceWorker(Spy, best["id"], best["knobs"], params, hub, "w-warm")
     assert calls == [1]
+
+
+def test_expiry_skew_tolerance():
+    """Workers tolerate a few seconds of predictor↔worker clock skew
+    before dropping a query as expired (ADVICE r3)."""
+    import time
+
+    from rafiki_tpu.worker.inference import (EXPIRY_SKEW_TOLERANCE_S,
+                                             _expired)
+
+    now = time.time()
+    assert not _expired({"deadline_ts": now - 1.0})  # inside the margin
+    assert _expired({"deadline_ts": now - EXPIRY_SKEW_TOLERANCE_S - 1.0})
+    assert not _expired({})  # unstamped queries never expire
+
+
+def test_dropped_expired_counter():
+    w = InferenceWorker.__new__(InferenceWorker)  # no model boot needed
+    w.worker_id = "w0"
+    w.stats = {"dropped_expired": 0}
+    w._count_dropped(3)
+    w._count_dropped(0)
+    assert w.stats["dropped_expired"] == 3
