@@ -298,8 +298,14 @@ def _flash_attention_fwd_impl(q, k, v, kv_lens, sm_scale: float,
 
 def _flash_attention_bwd_impl(q, k, v, kv_lens, o, lse, g, sm_scale: float,
                               causal: bool, block_q: int, block_k: int,
-                              interpret: Optional[bool]):
-    """Fused dq/dk/dv. ``lse`` is the (b*h, sq_padded, LANES) residual."""
+                              interpret: Optional[bool], g_lse=None):
+    """Fused dq/dk/dv. ``lse`` is the (b*h, sq_padded, LANES) residual.
+
+    ``g_lse`` (optional, (b, h, s_q) f32) is the cotangent of the LSE
+    output when the caller consumed :func:`flash_attention_lse`. It folds
+    into the existing kernels for free: with p = exp(s − lse),
+    ∂lse/∂s = p, so ds = p·(dp − delta + g_lse) — i.e. the kernels run
+    unchanged with delta' = delta − g_lse. (dV has no lse term.)"""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -325,6 +331,10 @@ def _flash_attention_bwd_impl(q, k, v, kv_lens, o, lse, g, sm_scale: float,
     # delta_i = Σ_d dO_id · O_id, lane-replicated like the LSE
     delta = jnp.sum(gp.astype(jnp.float32) * op.astype(jnp.float32),
                     axis=-1, keepdims=True)
+    if g_lse is not None:
+        glp = _pad_to(g_lse.astype(jnp.float32).reshape(b * h, s_q, 1),
+                      1, block_q)
+        delta = delta - glp
     delta = jnp.broadcast_to(delta, (b * h, sq_p, LANES))
 
     dq_kernel = functools.partial(
@@ -395,6 +405,9 @@ def _attention_reference(q, k, v, sm_scale: float, causal: bool,
     gradient, like the kernels' ``LSE_MASKED`` path — not softmax's
     uniform-weights answer.
     """
+    if kv_lens is None:  # one oracle: the lse twin owns the shared math
+        out, _ = _attention_reference_lse(q, k, v, sm_scale, causal)
+        return out
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * sm_scale
     s_q, s_k = s.shape[-2], s.shape[-1]
@@ -402,14 +415,12 @@ def _attention_reference(q, k, v, sm_scale: float, causal: bool,
         mask = (jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0)
                 >= jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1))
         s = jnp.where(mask, s, NEG_INF)
-    if kv_lens is not None:
-        k_pos = jnp.arange(s_k)[None, None, None, :]
-        s = jnp.where(k_pos < jnp.asarray(kv_lens)[:, None, None, None],
-                      s, NEG_INF)
+    k_pos = jnp.arange(s_k)[None, None, None, :]
+    s = jnp.where(k_pos < jnp.asarray(kv_lens)[:, None, None, None],
+                  s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    if kv_lens is not None:
-        nonempty = (jnp.asarray(kv_lens) > 0)[:, None, None, None]
-        p = jnp.where(nonempty, p, 0.0)
+    nonempty = (jnp.asarray(kv_lens) > 0)[:, None, None, None]
+    p = jnp.where(nonempty, p, 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", p,
                       v.astype(jnp.float32)).astype(q.dtype)
 
@@ -466,6 +477,117 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, residuals, g):
 
 
 _flash_attention_full.defvjp(_fwd, _bwd)
+
+
+def _attention_reference_lse(q, k, v, sm_scale: float, causal: bool):
+    """XLA twin of :func:`flash_attention_lse` (off-TPU dispatch). Plain
+    jnp math, so autodiff handles the LSE cotangent natively."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        s_q, s_k = s.shape[-2], s.shape[-1]
+        mask = (jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0)
+                >= jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1))
+        s = jnp.where(mask, s, NEG_INF)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None])
+    out = jnp.einsum("bhqk,bhkd->bhqd", p,
+                     v.astype(jnp.float32)).astype(q.dtype)
+    return out, lse
+
+
+def _lse_rows(lse_pad, q_shape):
+    """(b*h, sq_padded, LANES) lane-replicated residual → (b, h, s_q)."""
+    b, h, s_q, _ = q_shape
+    return lse_pad[:, :s_q, 0].reshape(b, h, s_q)
+
+
+def flash_attention_lse(q, k, v, sm_scale: Optional[float] = None,
+                        causal: bool = False, block_q: int = 128,
+                        block_k: int = 128,
+                        interpret: Optional[bool] = None):
+    """Like :func:`flash_attention` but returns ``(out, lse)`` where
+    ``lse[b, h, i]`` is the log-sum-exp of row i's (scaled, masked)
+    scores — the residual blockwise consumers (ring attention) need to
+    combine per-block outputs exactly: out = Σ_blocks e^{lse_s − m}·out_s
+    normalized. Differentiable in ``out`` AND ``lse``; same dispatch
+    rule as :func:`flash_attention` (Pallas on TPU, XLA twin off-TPU).
+    No ``kv_lens`` support: a fully-masked row's LSE sentinel
+    (+``LSE_MASKED``) would poison a cross-block max-combine."""
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if use_xla_fallback(interpret):
+        return _attention_reference_lse(q, k, v, scale, causal)
+    return _flash_attention_full_lse(q, k, v, scale, causal, block_q,
+                                     block_k, interpret)
+
+
+def flash_attention_block_bwd(q, k, v, o, lse, g, sm_scale: float,
+                              causal: bool = False, block_q: int = 128,
+                              block_k: int = 128,
+                              interpret: Optional[bool] = None):
+    """One block's contribution to the GLOBAL attention backward.
+
+    For blockwise/ring consumers: given this block's q/k/v, the globally
+    combined output ``o`` and row log-sum-exp ``lse`` (b, h, s_q) over
+    ALL blocks, and the output cotangent ``g``, returns (dq, dk, dv) for
+    this block — ``p = exp(s − lse)`` are the block's columns of the
+    global attention matrix, so summing dq over blocks and routing each
+    dk/dv to its block reconstructs the exact full backward. Same
+    dispatch rule as :func:`flash_attention` (Pallas kernels on TPU, XLA
+    twin off-TPU). f32 outputs (callers accumulate across blocks)."""
+    if use_xla_fallback(interpret):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * sm_scale
+        if causal:
+            s_q, s_k = s.shape[-2], s.shape[-1]
+            mask = (jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0)
+                    >= jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1))
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse[..., None])
+        gf = g.astype(jnp.float32)
+        dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, v.astype(jnp.float32))
+        delta = jnp.sum(gf * o.astype(jnp.float32), axis=-1)
+        ds = p * (dp - delta[..., None]) * sm_scale
+        dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k.astype(jnp.float32))
+        dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32))
+        return dq, dk, dv
+    b, h, s_q, _ = q.shape
+    lse_pad = _pad_to(
+        jnp.broadcast_to(lse.astype(jnp.float32).reshape(b * h, s_q, 1),
+                         (b * h, s_q, LANES)), 1, block_q)
+    dq, dk, dv = _flash_attention_bwd_impl(
+        q, k, v, None, o, lse_pad, g, sm_scale, causal, block_q, block_k,
+        interpret)
+    return (dq.astype(jnp.float32), dk.astype(jnp.float32),
+            dv.astype(jnp.float32))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_full_lse(q, k, v, sm_scale, causal, block_q, block_k,
+                              interpret):
+    out, lse_pad = _flash_attention_fwd_impl(
+        q, k, v, None, sm_scale, causal, block_q, block_k, interpret,
+        with_lse=True)
+    return out, _lse_rows(lse_pad, q.shape)
+
+
+def _lse_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, lse_pad = _flash_attention_fwd_impl(
+        q, k, v, None, sm_scale, causal, block_q, block_k, interpret,
+        with_lse=True)
+    return (out, _lse_rows(lse_pad, q.shape)), (q, k, v, out, lse_pad)
+
+
+def _lse_bwd(sm_scale, causal, block_q, block_k, interpret, residuals, gs):
+    q, k, v, o, lse_pad = residuals
+    g_out, g_lse = gs
+    return _flash_attention_bwd_impl(q, k, v, None, o, lse_pad, g_out,
+                                     sm_scale, causal, block_q, block_k,
+                                     interpret, g_lse=g_lse)
+
+
+_flash_attention_full_lse.defvjp(_lse_fwd, _lse_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))

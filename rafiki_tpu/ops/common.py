@@ -20,3 +20,19 @@ def use_xla_fallback(interpret: Optional[bool]) -> bool:
     ``interpret=True``). On TPU, ``None`` means real Mosaic lowering.
     """
     return interpret is None and jax.default_backend() != "tpu"
+
+
+def shard_map_kernels(f, mesh, in_specs, out_specs):
+    """``jax.shard_map`` configured for bodies that may issue Pallas
+    calls. The varying-manual-axes checker cannot type a ``pallas_call``'s
+    outputs (jax requires an explicit ``vma`` on every out ShapeDtypeStruct
+    it cannot infer), so kernel-bearing maps disable it; correctness of
+    the replication/varying structure is covered by the oracle-equivalence
+    tests instead. Falls back to the pre-vma ``check_rep`` keyword on
+    older jax."""
+    try:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    except TypeError:  # older jax spells it check_rep
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_rep=False)

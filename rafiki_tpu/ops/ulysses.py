@@ -45,6 +45,7 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from rafiki_tpu.ops.attention import flash_attention
+    from rafiki_tpu.ops.common import shard_map_kernels
 
     n_par = mesh.shape[axis]
     h = q.shape[1]
@@ -57,7 +58,7 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     seq_spec = P(batch_axis, None, axis, None)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map_kernels, mesh=mesh,
         in_specs=(seq_spec, seq_spec, seq_spec),
         out_specs=seq_spec)
     def _ulysses(ql, kl, vl):
