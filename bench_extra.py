@@ -228,9 +228,41 @@ def _bench_generation(out_path: str, duration: float) -> None:
         "queries_per_s": counts["q"] / dt,
         "tokens_per_s": eng["tokens_generated"] / dt,
         "max_concurrent_slots": eng["max_concurrent"],
+        "prefill_calls": eng["prefill_calls"],
+        "prefill_tokens": eng["prefill_tokens"],
         "p50_ms": stats["latency_p50_s"] * 1e3,
         "max_new": max_new,
         "model": "llama_512x8" if on_accel else "llama_64x2",
+    })
+
+    # prompt-ingestion speedup: time a long prompt through chunked
+    # prefill (C-token compiled calls) vs the token-wise decode scan
+    from rafiki_tpu.serving.decode_engine import DecodeEngine
+
+    plen = 96 if on_accel else 24
+    prompt = np.arange(1, plen + 1, dtype=np.int32) % knobs["vocab_size"]
+
+    def ingest_time(chunk: int) -> float:
+        eng2 = DecodeEngine(module, model._params, max_slots=8,
+                            max_len=knobs["max_len"],
+                            prefill_chunk=chunk)
+        eng2.submit("warm", prompt[:4], 1)     # pay both compiles
+        while eng2.busy:
+            eng2.step()
+        eng2.poll()
+        t0 = time.perf_counter()
+        eng2.submit("p", prompt, 1)            # 1 new token: time ≈ prefill
+        while eng2.busy:
+            eng2.step()
+        eng2.poll()
+        return time.perf_counter() - t0
+
+    tokenwise_s = ingest_time(1)
+    chunked_s = ingest_time(32)
+    _record(out_path, {
+        "stage": "prefill", "backend": backend, "prompt_tokens": plen,
+        "tokenwise_ms": tokenwise_s * 1e3, "chunked_ms": chunked_s * 1e3,
+        "prefill_speedup": tokenwise_s / max(chunked_s, 1e-9),
     })
 
 
@@ -315,6 +347,15 @@ def main() -> None:
     pred = next((r for r in records if r.get("stage") == "predictor"), None)
     gen = next((r for r in records if r.get("stage") == "generation"), None)
     adv = next((r for r in records if r.get("stage") == "advisor"), None)
+    pre = next((r for r in records if r.get("stage") == "prefill"), None)
+    if pre:
+        print(json.dumps({
+            "metric": "prefill_speedup_chunked_vs_tokenwise",
+            "value": round(pre["prefill_speedup"], 2), "unit": "x",
+            "backend": pre["backend"],
+            "prompt_tokens": pre["prompt_tokens"],
+            "tokenwise_ms": round(pre["tokenwise_ms"], 1),
+            "chunked_ms": round(pre["chunked_ms"], 1)}))
     if gen:
         print(json.dumps({
             "metric": f"generation_req_per_s_{gen['model']}",

@@ -139,10 +139,15 @@ class Client:
 
     # ---- online prediction ----
     def predict(self, predictor_url: str, queries: Sequence[Any],
-                timeout: Optional[float] = None) -> List[Any]:
+                timeout: Optional[float] = None,
+                sampling: Optional[Dict[str, Any]] = None) -> List[Any]:
+        """``sampling`` (generation jobs): {temperature, top_k, top_p,
+        seed} forwarded to the decode loop; omit for greedy."""
         body: Dict[str, Any] = {"queries": _jsonable(queries)}
         if timeout is not None:
             body["timeout"] = timeout
+        if sampling:
+            body["sampling"] = sampling
         # the socket must outlive the server-side gather deadline, or a
         # slow-but-working predictor (first-request compile) looks dead
         sock_timeout = self.timeout if timeout is None else \

@@ -152,16 +152,24 @@ class _DecoderAttention(nn.Module):
                 probs = jax.nn.softmax(scores.astype(jnp.float32), -1)
                 o = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(x.dtype), vv)
             else:
-                assert s == 1, "decode mode steps one token per slot"
-                t = positions[:, 0]  # (b,) — per-slot write index
-                rows = jnp.arange(b)
-                ck.value = ck.value.at[rows, t].set(k[:, 0])
-                cv.value = cv.value.at[rows, t].set(v[:, 0])
+                # s >= 1: single-token generation AND chunked prefill ride
+                # the same branch — write the chunk's k/v at each slot's
+                # own positions (vectorized scatter), then mask each
+                # QUERY token to keys at-or-before its own position.
+                # Within-chunk causality falls out of the position mask:
+                # the whole chunk is written before attention, and query
+                # p only sees k_pos <= p. Duplicate positions in a row
+                # (idle slots re-fed their current token) rewrite
+                # identical values — harmless by construction.
+                t = positions  # (b, s) — per-slot, per-token write index
+                rows = jnp.arange(b)[:, None]
+                ck.value = ck.value.at[rows, t].set(k)
+                cv.value = cv.value.at[rows, t].set(v)
                 kk = jnp.repeat(ck.value, rep, axis=2)
                 vv = jnp.repeat(cv.value, rep, axis=2)
                 scores = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / np.sqrt(dh)
                 k_pos = jnp.arange(self.max_len)[None, None, None, :]
-                scores = jnp.where(k_pos <= t[:, None, None, None],
+                scores = jnp.where(k_pos <= t[:, None, :, None],
                                    scores, -1e30)
                 probs = jax.nn.softmax(scores.astype(jnp.float32), -1)
                 o = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(x.dtype),
@@ -561,7 +569,8 @@ class LlamaLoRA(BaseModel):
 
     def make_decode_engine(self, max_slots: int = 8,
                            max_new_tokens: int = 8,
-                           steps_per_sync: int = 4):
+                           steps_per_sync: int = 4,
+                           prefill_chunk: int = 32):
         """Continuous-batching serving engine over this model's weights
         (BASELINE.md config #5). The inference worker drives it when
         running in decode-loop mode; see ``serving/decode_engine.py``."""
@@ -577,7 +586,8 @@ class LlamaLoRA(BaseModel):
 
         core = DecodeEngine(self._module(), self._params,
                             max_slots=max_slots, max_len=max_len,
-                            steps_per_sync=steps_per_sync)
+                            steps_per_sync=steps_per_sync,
+                            prefill_chunk=prefill_chunk)
         return TextDecodeEngine(core, encode, self._detok,
                                 max_new=min(max_new_tokens, max_len - 1))
 

@@ -46,6 +46,10 @@ class _Slot:
     request_id: Any
     prompt: np.ndarray          # (p,) int32, valid tokens only
     max_new: int
+    temperature: float = 0.0    # <= 0 → greedy
+    top_k: int = 0              # <= 0 → no top-k cut
+    top_p: float = 1.0          # >= 1 → no nucleus cut
+    seed: int = 0               # with (position) → the sample's PRNG key
     n_consumed: int = 0         # tokens fed to the model so far
     generated: List[int] = field(default_factory=list)
 
@@ -65,12 +69,19 @@ class DecodeEngine:
     """
 
     def __init__(self, module: Any, params: Any, max_slots: int,
-                 max_len: int, steps_per_sync: int = 4) -> None:
+                 max_len: int, steps_per_sync: int = 4,
+                 prefill_chunk: int = 32) -> None:
         self.module = module
         self.params = params
         self.B = int(max_slots)
         self.L = int(max_len)
         self.K = max(1, int(steps_per_sync))
+        #: prompt tokens ingested per fused prefill call (1 disables the
+        #: separate prefill program — prompts then stream token-by-token
+        #: through the decode scan like round-3 did). C-token prefill
+        #: turns B (1, d)-matvec steps into (C, d) matmuls the MXU can
+        #: tile, and pays 1/C as many dispatches for prompt ingestion.
+        self.C = max(1, min(int(prefill_chunk), self.L))
         self._slots: List[Optional[_Slot]] = [None] * self.B
         self._queue: List[_Slot] = []
         self._done: List[Tuple[Any, List[int]]] = []
@@ -82,27 +93,52 @@ class DecodeEngine:
         self._prompt_buf = np.zeros((self.B, self.L), np.int32)
         self._prompt_len = np.ones((self.B,), np.int32)
         self._stop_pos = np.zeros((self.B,), np.int32)
+        # per-slot sampling config (device operands every fused step)
+        self._temp = np.zeros((self.B,), np.float32)
+        self._topk = np.zeros((self.B,), np.int32)
+        self._topp = np.ones((self.B,), np.float32)
+        self._seed = np.zeros((self.B,), np.int32)
         #: device-resident prompt copy, refreshed only on admission — the
         #: (B, L) buffer must not ride host→device on every dispatch
         self._prompt_dev: Optional[jnp.ndarray] = None
         self._cache = module.init(
             jax.random.PRNGKey(0), jnp.zeros((self.B, 1), jnp.int32),
             decode=True)["cache"]
-        self._step_fn = _make_step(module, self.B, self.K)
+        # two compiled step programs: greedy-only traffic must not pay
+        # the sampler's (B, vocab) sort per token (measured 18x slower
+        # generation on CPU when it rode every step). The host picks per
+        # fused call based on the live slots' temperatures.
+        self._step_fns = {False: _make_step(module, self.B, self.K, False),
+                          True: _make_step(module, self.B, self.K, True)}
+        self._prefill_fn = (_make_prefill(module, self.B, self.C)
+                            if self.C > 1 else None)
         self.stats: Dict[str, int] = {
             "steps": 0, "tokens_generated": 0, "requests_done": 0,
-            "max_concurrent": 0}
+            "max_concurrent": 0, "prefill_calls": 0,
+            "prefill_tokens": 0}
 
     # ---- submission / results (thread-safe: worker loop vs callers) ----
     def submit(self, request_id: Any, prompt_ids: np.ndarray,
-               max_new: int) -> None:
+               max_new: int, temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0, seed: int = 0) -> None:
         """Queue a request. ``prompt_ids``: 1-D valid tokens (≥1); the
-        prompt + generation must fit the cache (truncated to fit)."""
+        prompt + generation must fit the cache (truncated to fit).
+
+        Sampling is per-request and fully seeded: ``temperature <= 0``
+        is greedy; otherwise top-k/top-p-filtered categorical sampling
+        whose PRNG key is ``fold_in(PRNGKey(seed), position)`` — the
+        draw at each position is a pure function of (seed, position),
+        independent of batch composition, slot index, or
+        ``steps_per_sync``, so generations are reproducible under any
+        serving load."""
         prompt = np.asarray(prompt_ids, np.int32).ravel()
         max_new = max(1, min(int(max_new), self.L - 1))
         prompt = prompt[:max(1, self.L - max_new)]
         with self._lock:
-            self._queue.append(_Slot(request_id, prompt, max_new))
+            self._queue.append(_Slot(
+                request_id, prompt, max_new,
+                temperature=float(temperature), top_k=int(top_k),
+                top_p=float(top_p), seed=int(seed)))
 
     def poll(self) -> List[Tuple[Any, List[int]]]:
         """Completed (request_id, generated ids) since the last poll."""
@@ -129,10 +165,55 @@ class DecodeEngine:
         self._prompt_buf[:] = 0
         self._prompt_len[:] = 1
         self._stop_pos[:] = 0  # empty slots must be device-inactive
+        self._temp[:] = 0.0
+        self._topk[:] = 0
+        self._topp[:] = 1.0
+        self._seed[:] = 0
         self._prompt_dev = None
         self._cache = self.module.init(
             jax.random.PRNGKey(0), jnp.zeros((self.B, 1), jnp.int32),
             decode=True)["cache"]
+
+    def _chunked_prefill(self) -> None:
+        """Ingest admitted prompts C tokens per compiled call before they
+        join the decode scan (positions 0..plen−2; the scan then starts
+        at the LAST prompt token, whose step emits the first generated
+        token). Slots not prefilling re-feed their current input — an
+        identical rewrite of a cache entry, harmless by construction —
+        so one fixed-shape program serves any admission mix."""
+        occupied = np.array([s is not None for s in self._slots])
+        while True:
+            rem = np.where(occupied,
+                           np.maximum(0, (self._prompt_len - 1)
+                                      - self._pos), 0)
+            if rem.max() == 0:
+                break
+            adv = np.minimum(rem, self.C)
+            tok_chunk = np.empty((self.B, self.C), np.int32)
+            pos_chunk = np.empty((self.B, self.C), np.int32)
+            for i in range(self.B):
+                a = int(adv[i])
+                if a > 0:
+                    p0 = int(self._pos[i])
+                    tok_chunk[i, :a] = self._prompt_buf[i, p0:p0 + a]
+                    pos_chunk[i, :a] = np.arange(p0, p0 + a)
+                    # pad by repeating the chunk's last real entry —
+                    # rewrites a just-written cache slot identically
+                    tok_chunk[i, a:] = tok_chunk[i, a - 1]
+                    pos_chunk[i, a:] = pos_chunk[i, a - 1]
+                else:
+                    tok_chunk[i, :] = self._tok[i]
+                    pos_chunk[i, :] = self._pos[i]
+            self._cache = self._prefill_fn(
+                self.params, self._cache, jnp.asarray(tok_chunk),
+                jnp.asarray(pos_chunk))
+            self.stats["prefill_calls"] += 1
+            self.stats["prefill_tokens"] += int(adv.sum())
+            for i in range(self.B):
+                if adv[i] > 0:
+                    self._pos[i] += int(adv[i])
+                    self._slots[i].n_consumed += int(adv[i])
+                    self._tok[i] = self._prompt_buf[i, int(self._pos[i])]
 
     # ---- the loop body ----
     def step(self) -> int:
@@ -155,20 +236,31 @@ class DecodeEngine:
                     # iff p >= plen - 1)
                     self._stop_pos[i] = min(
                         len(slot.prompt) - 1 + slot.max_new, self.L)
+                    self._temp[i] = slot.temperature
+                    self._topk[i] = slot.top_k
+                    self._topp[i] = slot.top_p
+                    self._seed[i] = np.int32(slot.seed & 0x7FFFFFFF)
                     admitted = True
             live = [i for i in range(self.B) if self._slots[i] is not None]
             self.stats["max_concurrent"] = max(self.stats["max_concurrent"],
                                                len(live))
         if not live:
             return 0
+        if admitted and self._prefill_fn is not None:
+            self._chunked_prefill()
         if admitted or self._prompt_dev is None:
             # refresh the device-resident prompts only when they changed
             self._prompt_dev = jnp.asarray(self._prompt_buf)
 
-        self._cache, emitted = self._step_fn(
+        any_sampling = bool(any(
+            self._slots[i] is not None and self._slots[i].temperature > 0
+            for i in range(self.B)))
+        self._cache, emitted = self._step_fns[any_sampling](
             self.params, self._cache, jnp.asarray(self._tok),
             jnp.asarray(self._pos), self._prompt_dev,
-            jnp.asarray(self._prompt_len), jnp.asarray(self._stop_pos))
+            jnp.asarray(self._prompt_len), jnp.asarray(self._stop_pos),
+            jnp.asarray(self._temp), jnp.asarray(self._topk),
+            jnp.asarray(self._topp), jnp.asarray(self._seed))
         emitted = np.asarray(emitted)  # (K, B) — the per-token sync
         self.stats["steps"] += self.K
 
@@ -209,19 +301,56 @@ class DecodeEngine:
         return len(live)
 
 
+def _select_next(logits, temp, top_k, top_p, seed, pos):
+    """Per-slot token selection on device: greedy when ``temp <= 0``,
+    else temperature-scaled categorical over the top-k/top-p-filtered
+    distribution. Both filters reduce to a per-row LOGIT THRESHOLD on
+    the descending sort (k-th largest for top-k; the smallest logit of
+    the minimal nucleus for top-p), so one sort serves both and the
+    masked sample needs no gather back through sort order. The PRNG key
+    is ``fold_in(fold_in(base, seed), position)`` — a pure function of
+    (seed, position), so draws are reproducible under any batch
+    composition, slot placement, or step fusion."""
+    v = logits.shape[-1]
+    greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+    lg = logits / jnp.maximum(temp, 1e-6)[:, None]
+    sorted_lg = jnp.sort(lg, axis=-1)[:, ::-1]  # descending
+    kk = jnp.clip(jnp.where(top_k <= 0, v, top_k), 1, v)
+    k_thresh = jnp.take_along_axis(
+        sorted_lg, (kk - 1)[:, None].astype(jnp.int32), axis=-1)
+    probs = jax.nn.softmax(sorted_lg, -1)
+    cum = jnp.cumsum(probs, -1)
+    # keep the minimal prefix whose mass reaches top_p (the first token
+    # is always kept: its "mass before" is 0 < top_p)
+    keep = (cum - probs) < jnp.maximum(top_p, 1e-6)[:, None]
+    p_thresh = jnp.min(jnp.where(keep, sorted_lg, jnp.inf), -1,
+                       keepdims=True)
+    masked = jnp.where(lg >= jnp.maximum(k_thresh, p_thresh), lg, -1e30)
+    base = jax.random.PRNGKey(0)
+    keys = jax.vmap(lambda s, p: jax.random.fold_in(
+        jax.random.fold_in(base, s), p))(seed, pos)
+    sampled = jax.vmap(jax.random.categorical)(keys,
+                                               masked).astype(jnp.int32)
+    return jnp.where(temp <= 0.0, greedy, sampled)
+
+
 @functools.lru_cache(maxsize=8)
-def _make_step(module: Any, n_slots: int, k: int) -> Callable:
+def _make_step(module: Any, n_slots: int, k: int,
+               sampling: bool) -> Callable:
     """K fused decode steps over all slots (cache donated in-place).
 
     On-device input selection between steps: while a slot's next
     position is still inside its prompt, the next input is the next
     prompt token (device-resident prompt buffer); afterwards it is the
-    slot's own argmax. Slots whose next position reaches ``stop_pos``
-    freeze (their tok/pos stop advancing) so a finished slot idles
-    harmlessly for the remainder of the scan."""
+    slot's own sampled/greedy token (``_select_next`` when ``sampling``,
+    plain argmax otherwise — the greedy program never compiles the
+    sampler's per-token vocab sort). Slots whose next position reaches
+    ``stop_pos`` freeze (their tok/pos stop advancing) so a finished
+    slot idles harmlessly for the remainder of the scan."""
 
     @functools.partial(jax.jit, donate_argnums=(1,))
-    def step_fn(params, cache, tok, pos, prompt_buf, prompt_len, stop_pos):
+    def step_fn(params, cache, tok, pos, prompt_buf, prompt_len, stop_pos,
+                temp, top_k, top_p, seed):
         rows = jnp.arange(n_slots)
 
         def body(carry, _):
@@ -229,8 +358,11 @@ def _make_step(module: Any, n_slots: int, k: int) -> Callable:
             logits, muts = module.apply(
                 {"params": params, "cache": cache}, tok[:, None],
                 positions=pos[:, None], decode=True, mutable=["cache"])
-            nxt = jnp.argmax(logits[:, -1].astype(jnp.float32),
-                             -1).astype(jnp.int32)
+            lg = logits[:, -1].astype(jnp.float32)
+            if sampling:
+                nxt = _select_next(lg, temp, top_k, top_p, seed, pos)
+            else:
+                nxt = jnp.argmax(lg, -1).astype(jnp.int32)
             new_pos = pos + 1
             is_prefill = new_pos < prompt_len
             nxt_prompt = prompt_buf[
@@ -246,6 +378,24 @@ def _make_step(module: Any, n_slots: int, k: int) -> Callable:
         return cache, emitted  # (K, n_slots)
 
     return step_fn
+
+
+@functools.lru_cache(maxsize=8)
+def _make_prefill(module: Any, n_slots: int, chunk: int) -> Callable:
+    """One C-token prefill call: feed (B, C) tokens at their per-slot
+    positions through the decode-cache path. The lm_head output is
+    discarded (prefill emits nothing), so XLA dead-code-eliminates the
+    (B, C, vocab) projection — the call is pure KV-cache population at
+    matmul (not matvec) arithmetic intensity."""
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def prefill_fn(params, cache, tok_chunk, pos_chunk):
+        _, muts = module.apply(
+            {"params": params, "cache": cache}, tok_chunk,
+            positions=pos_chunk, decode=True, mutable=["cache"])
+        return muts["cache"]
+
+    return prefill_fn
 
 
 class TextDecodeEngine:
@@ -265,9 +415,12 @@ class TextDecodeEngine:
         self.max_new = int(max_new)
 
     def submit(self, request_id: Any, text: str,
-               max_new: Optional[int] = None) -> None:
+               max_new: Optional[int] = None, temperature: float = 0.0,
+               top_k: int = 0, top_p: float = 1.0, seed: int = 0) -> None:
         self.engine.submit(request_id, self._encode(text),
-                           self.max_new if max_new is None else max_new)
+                           self.max_new if max_new is None else max_new,
+                           temperature=temperature, top_k=top_k,
+                           top_p=top_p, seed=seed)
 
     def poll(self) -> List[Tuple[Any, str]]:
         return [(rid, self._decode(ids)) for rid, ids in self.engine.poll()]

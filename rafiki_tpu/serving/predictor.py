@@ -73,8 +73,12 @@ class Predictor:
         self._lock = threading.Lock()
 
     def predict(self, queries: Sequence[Any],
-                timeout: Optional[float] = None) -> Tuple[List[Any], Dict]:
-        """Returns (ensembled predictions, info dict)."""
+                timeout: Optional[float] = None,
+                sampling: Optional[Dict] = None) -> Tuple[List[Any], Dict]:
+        """Returns (ensembled predictions, info dict). ``sampling``
+        (generation jobs only) rides with the message to the decode
+        loop: {temperature, top_k, top_p, seed} — seeded draws are
+        reproducible per (seed, position) regardless of serving load."""
         t0 = time.monotonic()
         timeout = self.gather_timeout if timeout is None else timeout
         qid = uuid.uuid4().hex
@@ -82,8 +86,11 @@ class Predictor:
         # the wall-clock deadline rides with the query: a worker that
         # pops it too late drops it instead of computing an answer
         # nobody will read (and recreating a discarded reply queue)
-        msg = pack_message({"id": qid, "queries": _stack(queries),
-                            "deadline_ts": time.time() + timeout})
+        payload = {"id": qid, "queries": _stack(queries),
+                   "deadline_ts": time.time() + timeout}
+        if sampling:
+            payload["sampling"] = dict(sampling)
+        msg = pack_message(payload)
         # condemn the reply queue up front: a worker inside its expiry
         # skew tolerance may answer after our discard below, recreating
         # the queue in the kv store — the pre-armed TTL collects it
@@ -188,8 +195,10 @@ class PredictorService:
         if not isinstance(queries, list) or not queries:
             return 400, {"error": "body must be {queries: [...]}"}
         timeout = (body or {}).get("timeout")
+        sampling = (body or {}).get("sampling")
         preds, info = self.predictor.predict(
-            queries, timeout=float(timeout) if timeout else None)
+            queries, timeout=float(timeout) if timeout else None,
+            sampling=sampling if isinstance(sampling, dict) else None)
         if info["workers_answered"] == 0:
             return 504, {"error": "no worker answered in time",
                          "info": info}

@@ -178,8 +178,10 @@ class InferenceWorker:
                          "predictions": []}))
                 else:
                     inflight[m["id"]] = [len(qs), {}]
+                    samp = _safe_sampling(m.get("sampling"))
                     for qi, text in enumerate(qs):
-                        self.engine.submit((m["id"], qi), str(text))
+                        self.engine.submit((m["id"], qi), str(text),
+                                           **samp)
                 raw = self.hub.pop_query(self.worker_id, 0.0)
             if not self.engine.busy:
                 continue
@@ -234,6 +236,27 @@ class InferenceWorker:
             if err:
                 reply["error"] = err
             self.hub.push_prediction(m["id"], pack_message(reply))
+
+
+def _safe_sampling(samp: Any) -> dict:
+    """Client-supplied sampling params, coerced defensively: a malformed
+    value (e.g. {"temperature": "hot"}) must degrade that request to the
+    nearest valid config — never raise inside the decode loop, where an
+    escaped exception kills the worker thread and every later request
+    times out (one bad request = persistent denial of service)."""
+    if not isinstance(samp, dict):
+        samp = {}
+
+    def num(key: str, cast, default):
+        try:
+            return cast(samp.get(key, default))
+        except (TypeError, ValueError):
+            return default
+
+    return {"temperature": num("temperature", float, 0.0),
+            "top_k": num("top_k", int, 0),
+            "top_p": num("top_p", float, 1.0),
+            "seed": num("seed", int, 0)}
 
 
 def _expired(msg: dict, skew_s: float = EXPIRY_SKEW_TOLERANCE_S) -> bool:

@@ -91,9 +91,11 @@ def test_engine_mid_flight_admission(trained):
 
     ref1, ref2 = solo(p1), solo(p2)
 
-    # K=1: the test reasons about exact single-token step boundaries
+    # K=1 AND C=1: the test reasons about exact single-token step
+    # boundaries, so chunked prefill (which ingests the whole prompt at
+    # admission) must be off
     eng = DecodeEngine(module, params, max_slots=4, max_len=32,
-                       steps_per_sync=1)
+                       steps_per_sync=1, prefill_chunk=1)
     eng.submit("r1", p1, max_new)
     # run r1 past its prefill and into generation
     for _ in range(len(p1) + 2):
@@ -264,3 +266,110 @@ def test_fused_mid_flight_admission_and_slot_reuse(trained):
     assert not eng.busy
     for i, ref in enumerate(refs):
         assert done[i] == list(ref), (i, done[i], ref)
+
+
+def _run_engine(eng, reqs):
+    for r in reqs:
+        eng.submit(*r[:2], **r[2] if len(r) > 2 else {})
+    done = {}
+    for _ in range(128):
+        eng.step()
+        done.update(dict(eng.poll()))
+        if len(done) == len(reqs):
+            return done
+    raise AssertionError(f"engine did not finish: {done.keys()}")
+
+
+def test_chunked_prefill_matches_tokenwise(trained):
+    """prefill_chunk > 1 must produce byte-identical generations to the
+    token-by-token path (VERDICT r4 item: chunked-vs-tokenwise
+    equivalence) — the chunk is pure KV population, same math."""
+    module, params = _module_and_params(trained)
+    prompts = [np.arange(1, 20, dtype=np.int32),      # 19-token prompt
+               np.asarray([3, 1, 4, 1, 5], np.int32),
+               np.asarray([7], np.int32)]             # no prefill at all
+    reqs = [(f"r{i}", p, {"max_new": 5}) for i, p in enumerate(prompts)]
+
+    tokenwise = _run_engine(DecodeEngine(module, params, max_slots=4,
+                                         max_len=32, prefill_chunk=1),
+                            reqs)
+    chunked = DecodeEngine(module, params, max_slots=4, max_len=32,
+                           prefill_chunk=8)
+    got = _run_engine(chunked, reqs)
+    for rid in tokenwise:
+        np.testing.assert_array_equal(np.asarray(got[rid]),
+                                      np.asarray(tokenwise[rid]))
+    # the chunked engine actually took the prefill path, and paid far
+    # fewer program dispatches for the 19-token prompt
+    assert chunked.stats["prefill_calls"] >= 1
+    assert chunked.stats["prefill_tokens"] >= 18
+
+
+def test_sampling_determinism_and_knobs(trained):
+    """Seeded sampling is a pure function of (seed, position): identical
+    across runs, across steps_per_sync, and across batch composition;
+    temp<=0 is greedy; top_k=1 collapses to greedy even at high temp."""
+    module, params = _module_and_params(trained)
+    p = np.asarray([1, 5, 9], np.int32)
+    samp = {"max_new": 6, "temperature": 0.9, "top_k": 50,
+            "top_p": 0.95, "seed": 1234}
+
+    def run(steps_per_sync, extra_reqs=()):
+        eng = DecodeEngine(module, params, max_slots=4, max_len=32,
+                           steps_per_sync=steps_per_sync)
+        done = _run_engine(eng, [("x", p, samp), *extra_reqs])
+        return np.asarray(done["x"])
+
+    a = run(4)
+    b = run(4)
+    np.testing.assert_array_equal(a, b)          # same run twice
+    c = run(1)
+    np.testing.assert_array_equal(a, c)          # K-fusion invariant
+    d = run(4, extra_reqs=[("y", np.asarray([2, 8], np.int32),
+                            {"max_new": 4, "temperature": 0.7,
+                             "seed": 7})])
+    np.testing.assert_array_equal(a, d)          # batch-mix invariant
+
+    # different seed → (with overwhelming probability) different draws
+    e = run_diff = DecodeEngine(module, params, max_slots=4, max_len=32)
+    done = _run_engine(e, [("x", p, {**samp, "seed": 4321})])
+    assert len(done["x"]) == 6
+
+    # greedy flag and degenerate filters reduce to argmax
+    greedy = _run_engine(DecodeEngine(module, params, max_slots=4,
+                                      max_len=32),
+                         [("x", p, {"max_new": 6})])["x"]
+    k1 = _run_engine(DecodeEngine(module, params, max_slots=4,
+                                  max_len=32),
+                     [("x", p, {"max_new": 6, "temperature": 2.0,
+                                "top_k": 1})])["x"]
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(greedy))
+    tiny_p = _run_engine(DecodeEngine(module, params, max_slots=4,
+                                      max_len=32),
+                         [("x", p, {"max_new": 6, "temperature": 2.0,
+                                    "top_p": 1e-6})])["x"]
+    np.testing.assert_array_equal(np.asarray(tiny_p), np.asarray(greedy))
+
+
+def test_sampled_tokens_respect_top_k(trained):
+    """With top_k=2 every sampled token must be one of the two highest-
+    probability tokens at its step (checked by replaying the model)."""
+    import jax
+    import jax.numpy as jnp
+
+    module, params = _module_and_params(trained)
+    p = np.asarray([1, 5, 9], np.int32)
+    done = _run_engine(DecodeEngine(module, params, max_slots=2,
+                                    max_len=32),
+                       [("x", p, {"max_new": 5, "temperature": 1.5,
+                                  "top_k": 2, "seed": 99})])
+    gen = list(done["x"])
+    # replay: teacher-force prompt+generated, check each sampled token
+    # is in that step's top-2 logits
+    ids = np.concatenate([p, np.asarray(gen[:-1], np.int32)])[None, :]
+    logits = module.apply({"params": params}, jnp.asarray(ids))
+    logits = np.asarray(logits[0], np.float32)  # (T, V)
+    for j, tok in enumerate(gen):
+        step_logits = logits[len(p) - 1 + j]
+        top2 = np.argsort(step_logits)[-2:]
+        assert tok in top2, (j, tok, top2)

@@ -359,3 +359,17 @@ def test_dropped_expired_counter():
     w._count_dropped(3)
     w._count_dropped(0)
     assert w.stats["dropped_expired"] == 3
+
+
+def test_malformed_sampling_degrades_not_crashes():
+    """A bad sampling dict from a client must coerce to defaults, not
+    raise inside the decode loop (which would kill the worker thread)."""
+    from rafiki_tpu.worker.inference import _safe_sampling
+
+    assert _safe_sampling(None) == {"temperature": 0.0, "top_k": 0,
+                                    "top_p": 1.0, "seed": 0}
+    assert _safe_sampling("garbage")["temperature"] == 0.0
+    out = _safe_sampling({"temperature": "hot", "top_k": 5,
+                          "top_p": None, "seed": 2.0})
+    assert out == {"temperature": 0.0, "top_k": 5, "top_p": 1.0,
+                   "seed": 2}
