@@ -1,5 +1,6 @@
 """Dataset formats, loaders, synthetic generators, device prefetch."""
 
+from .bpe import ByteBPETokenizer
 from .dataset import (CorpusDataset, ImageClassificationDataset,
                       TabularDataset, TextClassificationDataset,
                       generate_corpus_dataset,
@@ -12,6 +13,7 @@ from .dataset import (CorpusDataset, ImageClassificationDataset,
 from .loader import batch_iterator, bucket_pad, prefetch_to_device
 
 __all__ = [
+    "ByteBPETokenizer",
     "CorpusDataset", "ImageClassificationDataset", "TabularDataset",
     "TextClassificationDataset", "generate_corpus_dataset",
     "generate_image_classification_dataset", "generate_tabular_dataset",

@@ -1,0 +1,196 @@
+"""Trainable byte-level BPE tokenizer — the real-tokenizer leg of
+BASELINE.md config #5.
+
+The reference stack gets its tokenizers from upstream model hubs; this
+environment has zero egress, so the framework ships a self-contained
+byte-level BPE (the GPT-2/Llama family's algorithm): train on any local
+corpus, save the merge table as a JSON artifact, load it anywhere. The
+``HashTokenizer`` (models/bert.py) remains the zero-setup default for
+tuning runs; BPE is what serving-quality LM work (and pretrained-weight
+import, models/convert.py) plugs in via the ``tokenizer_path`` knob.
+
+Design points:
+- **Byte-level, lossless.** The base vocabulary is all 256 bytes;
+  arbitrary unicode round-trips exactly (``decode(encode(s)) == s``)
+  with no unknown-token escape hatch needed.
+- **Pre-tokenization** splits text into chunks of "optional single
+  leading space + non-space run" or whitespace runs; merges never cross
+  chunk boundaries (the standard trick that keeps merge statistics
+  word-shaped and encoding parallelizable).
+- **Id layout**: 0..N_SPECIAL-1 specials (PAD=0, BOS=1, EOS=2 — PAD/BOS
+  match the HashTokenizer contract so templates swap tokenizers without
+  re-learning id conventions), then the 256 byte tokens, then one id
+  per merge in training order.
+- Training is the classic greedy loop (count adjacent pairs over the
+  word histogram, merge the most frequent, repeat) — O(merges × unique
+  words), plenty for corpus files in the tens of MB this framework
+  trains on locally.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from functools import lru_cache
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+import numpy as np
+
+PAD_ID = 0
+BOS_ID = 1
+EOS_ID = 2
+N_SPECIAL = 3
+_N_BYTES = 256
+
+#: chunker: a word keeps one leading space; other whitespace runs stand
+#: alone. Chunks partition the text, so concatenating decoded chunks
+#: reproduces it byte-for-byte.
+_CHUNK_RE = re.compile(r" ?[^\s]+|\s+")
+
+
+class ByteBPETokenizer:
+    """Byte-level BPE with a JSON-artifact merge table.
+
+    Mirrors the ``HashTokenizer`` call surface (``encode(text, max_len)
+    -> (row, n)`` with a leading BOS, ``encode_batch``, ``vocab_size``)
+    and adds what hashing can't do: exact ``decode``.
+    """
+
+    def __init__(self, merges: Sequence[Tuple[int, int]]) -> None:
+        #: merge table in training order; merge i creates token id
+        #: N_SPECIAL + 256 + i from its (left, right) pair
+        self.merges: List[Tuple[int, int]] = [tuple(m) for m in merges]
+        self._rank: Dict[Tuple[int, int], int] = {
+            m: i for i, m in enumerate(self.merges)}
+        #: id → byte string (specials decode to b"")
+        self._bytes: List[bytes] = [b""] * N_SPECIAL + [
+            bytes([i]) for i in range(_N_BYTES)]
+        for left, right in self.merges:
+            self._bytes.append(self._bytes[left] + self._bytes[right])
+        self._encode_chunk = lru_cache(maxsize=65536)(self._bpe_chunk)
+
+    @property
+    def vocab_size(self) -> int:
+        return N_SPECIAL + _N_BYTES + len(self.merges)
+
+    # ---- encoding ----
+    def _bpe_chunk(self, chunk: bytes) -> Tuple[int, ...]:
+        ids = [N_SPECIAL + b for b in chunk]
+        while len(ids) > 1:
+            best, best_rank = None, None
+            for pair in zip(ids, ids[1:]):
+                r = self._rank.get(pair)
+                if r is not None and (best_rank is None or r < best_rank):
+                    best, best_rank = pair, r
+            if best is None:
+                break
+            merged = N_SPECIAL + _N_BYTES + best_rank
+            out: List[int] = []
+            i = 0
+            while i < len(ids):
+                if i + 1 < len(ids) and (ids[i], ids[i + 1]) == best:
+                    out.append(merged)
+                    i += 2
+                else:
+                    out.append(ids[i])
+                    i += 1
+            ids = out
+        return tuple(ids)
+
+    def encode_ids(self, text: str) -> List[int]:
+        """Token ids for ``text`` (no BOS, no padding)."""
+        out: List[int] = []
+        for chunk in _CHUNK_RE.findall(text):
+            out.extend(self._encode_chunk(chunk.encode("utf-8")))
+        return out
+
+    def encode(self, text: str, max_len: int) -> Tuple[List[int], int]:
+        """HashTokenizer-compatible: (ids padded to ``max_len`` with a
+        leading BOS, true length including BOS)."""
+        ids = [BOS_ID] + self.encode_ids(text)[:max_len - 1]
+        length = len(ids)
+        return ids + [PAD_ID] * (max_len - length), length
+
+    def encode_batch(self, texts: Sequence[str],
+                     max_len: int) -> Tuple[np.ndarray, np.ndarray]:
+        ids = np.zeros((len(texts), max_len), np.int32)
+        lens = np.zeros((len(texts),), np.int32)
+        for i, t in enumerate(texts):
+            row, n = self.encode(t, max_len)
+            ids[i], lens[i] = row, n
+        return ids, lens
+
+    def decode(self, ids: Iterable[int]) -> str:
+        """Exact inverse of ``encode_ids`` (specials vanish; invalid
+        UTF-8 from truncated multi-byte tokens is replaced)."""
+        data = b"".join(self._bytes[i] for i in ids
+                        if 0 <= int(i) < len(self._bytes))
+        return data.decode("utf-8", errors="replace")
+
+    # ---- artifact ----
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({"format": "rafiki-bpe-v1",
+                       "merges": [list(m) for m in self.merges]}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "ByteBPETokenizer":
+        with open(path) as f:
+            blob = json.load(f)
+        if blob.get("format") != "rafiki-bpe-v1":
+            raise ValueError(f"{path}: not a rafiki-bpe-v1 artifact")
+        return cls([tuple(m) for m in blob["merges"]])
+
+    # ---- training ----
+    @classmethod
+    def train(cls, corpus: Iterable[str],
+              vocab_size: int) -> "ByteBPETokenizer":
+        """Learn merges from text lines until ``vocab_size`` is reached
+        (or no pair repeats). Deterministic: ties break on the
+        lexicographically smallest pair."""
+        n_merges = vocab_size - N_SPECIAL - _N_BYTES
+        if n_merges < 0:
+            raise ValueError(
+                f"vocab_size must be ≥ {N_SPECIAL + _N_BYTES}")
+        # word histogram: merge statistics over unique chunks
+        words: Dict[Tuple[int, ...], int] = {}
+        for line in corpus:
+            for chunk in _CHUNK_RE.findall(line):
+                key = tuple(N_SPECIAL + b for b in chunk.encode("utf-8"))
+                if key:
+                    words[key] = words.get(key, 0) + 1
+        merges: List[Tuple[int, int]] = []
+        for _ in range(n_merges):
+            counts: Dict[Tuple[int, int], int] = {}
+            for word, freq in words.items():
+                for pair in zip(word, word[1:]):
+                    counts[pair] = counts.get(pair, 0) + freq
+            if not counts:
+                break
+            best = max(counts, key=lambda p: (counts[p], (-p[0], -p[1])))
+            if counts[best] < 2:
+                break  # nothing repeats — more merges would memorize
+            new_id = N_SPECIAL + _N_BYTES + len(merges)
+            merges.append(best)
+            new_words: Dict[Tuple[int, ...], int] = {}
+            for word, freq in words.items():
+                out: List[int] = []
+                i = 0
+                while i < len(word):
+                    if i + 1 < len(word) and \
+                            (word[i], word[i + 1]) == best:
+                        out.append(new_id)
+                        i += 2
+                    else:
+                        out.append(word[i])
+                        i += 1
+                key = tuple(out)
+                new_words[key] = new_words.get(key, 0) + freq
+            words = new_words
+        return cls(merges)
+
+    @classmethod
+    def train_file(cls, corpus_path: str,
+                   vocab_size: int) -> "ByteBPETokenizer":
+        with open(corpus_path, encoding="utf-8") as f:
+            return cls.train(f, vocab_size)

@@ -1,0 +1,223 @@
+"""Pretrained-weight ingestion: HF-style Llama safetensors → sharded params.
+
+BASELINE.md config #5 names "Llama-3 8B LoRA fine-tune"; without a
+checkpoint-import path the template could only ever train a Llama-shaped
+module from random init (VERDICT r3 missing #3). This module maps
+HuggingFace-convention checkpoint names/layouts onto this framework's
+flax tree and materializes each weight DIRECTLY into its 2-D
+(fsdp × tensor-parallel) sharding:
+
+- Name map: ``model.layers.{i}.self_attn.q_proj.weight`` →
+  ``block_{i}/attn/wq/kernel`` etc. HF ``nn.Linear`` stores (out, in);
+  flax Dense kernels are (in, out), so projection matrices transpose on
+  the way through. Embeddings ((vocab, dim) both sides) and RMSNorm
+  scales pass straight. Rotary layout needs no permutation: both sides
+  use the half-split rotate-half convention.
+- Sharded load: with a mesh, each target leaf is built via
+  ``jax.make_array_from_callback`` over its ``NamedSharding`` — the
+  callback reads ONLY the requested shard's slice from the (mmap'd)
+  safetensors file (``safe_open().get_slice()``), so no host ever
+  materializes a full 8B tensor, let alone the full tree. fsdp specs
+  from ``parallel/sharding.py`` decide the slicing.
+- Leaves absent from the checkpoint (``lora_a``/``lora_b`` adapters —
+  LoRA state is ours, not HF's) keep their initialized values.
+
+``export_llama_safetensors`` writes the inverse mapping — round-trip
+tested (export → sharded import → identical generation), and the
+practical path for shipping fine-tuned weights back out.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+_BLOCK_RE = re.compile(r"^block_(\d+)$")
+
+
+def _flatten(tree: Any, prefix: Tuple[str, ...] = ()) -> Dict[Tuple[str, ...], Any]:
+    if isinstance(tree, dict):
+        out: Dict[Tuple[str, ...], Any] = {}
+        for k, v in tree.items():
+            out.update(_flatten(v, prefix + (str(k),)))
+        return out
+    return {prefix: tree}
+
+
+def _set_path(tree: dict, path: Tuple[str, ...], value: Any) -> None:
+    node = tree
+    for k in path[:-1]:
+        node = node[k]
+    node[path[-1]] = value
+
+
+def hf_name_for(path: Tuple[str, ...]) -> Optional[Tuple[str, bool]]:
+    """(HF tensor name, needs_transpose) for one of our param paths, or
+    None for leaves that have no checkpoint counterpart (LoRA adapters).
+    Raises on paths that look importable but match no rule — silent
+    drops would load a half-initialized model."""
+    if path[-1] in ("lora_a", "lora_b"):
+        return None
+    joined = "/".join(path)
+    if joined == "tok_embed/embedding":
+        return "model.embed_tokens.weight", False
+    if joined == "final_norm/scale":
+        return "model.norm.weight", False
+    if joined == "lm_head/kernel":
+        return "lm_head.weight", True
+    m = _BLOCK_RE.match(path[0])
+    if m:
+        i = int(m.group(1))
+        rest = "/".join(path[1:])
+        proj = {"attn/wq/kernel": "self_attn.q_proj",
+                "attn/wk/kernel": "self_attn.k_proj",
+                "attn/wv/kernel": "self_attn.v_proj",
+                "attn/wo/kernel": "self_attn.o_proj",
+                "gate/kernel": "mlp.gate_proj",
+                "up/kernel": "mlp.up_proj",
+                "down/kernel": "mlp.down_proj"}.get(rest)
+        if proj:
+            return f"model.layers.{i}.{proj}.weight", True
+        norm = {"RMSNorm_0/scale": "input_layernorm",
+                "RMSNorm_1/scale": "post_attention_layernorm"}.get(rest)
+        if norm:
+            return f"model.layers.{i}.{norm}.weight", False
+    raise KeyError(f"no HF mapping for param path {joined!r}")
+
+
+def _resolve_checkpoint(path: str) -> Dict[str, str]:
+    """Tensor name → safetensors file for every layout HF ships:
+    a single ``.safetensors`` file, a ``*.index.json`` (sharded
+    multi-file checkpoints — how Llama-3 8B actually downloads), or a
+    directory containing either."""
+    import glob
+    import json
+    import os
+
+    from safetensors import safe_open
+
+    def from_index(idx_path: str) -> Dict[str, str]:
+        with open(idx_path) as f:
+            index = json.load(f)
+        base = os.path.dirname(os.path.abspath(idx_path))
+        return {name: os.path.join(base, fname)
+                for name, fname in index["weight_map"].items()}
+
+    def from_files(files) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        for fp in files:
+            with safe_open(fp, framework="np") as f:
+                for name in f.keys():
+                    out[name] = fp
+        return out
+
+    if os.path.isdir(path):
+        idx = os.path.join(path, "model.safetensors.index.json")
+        if os.path.exists(idx):
+            return from_index(idx)
+        files = sorted(glob.glob(os.path.join(path, "*.safetensors")))
+        if not files:
+            raise FileNotFoundError(
+                f"{path}: no .safetensors or index.json found")
+        return from_files(files)
+    if path.endswith(".index.json"):
+        return from_index(path)
+    return from_files([path])
+
+
+def import_llama_safetensors(path: str, params: Any, mesh=None,
+                             tp_rules: Optional[Dict[str, int]] = None,
+                             fsdp: bool = True,
+                             min_size: int = 2 ** 16) -> Any:
+    """Load an HF-convention Llama checkpoint into ``params``' structure.
+
+    ``path``: a ``.safetensors`` file, a ``*.index.json``, or a
+    checkpoint directory (sharded multi-file checkpoints supported —
+    see :func:`_resolve_checkpoint`). ``params``: an initialized tree
+    (shapes define what to read; leaves missing from the checkpoint
+    keep their values). With ``mesh``, every imported leaf lands
+    directly in its ``param_shardings`` placement via shard-sized file
+    reads; without one, plain host arrays.
+    """
+    import contextlib
+
+    import jax
+
+    from safetensors import safe_open
+
+    from rafiki_tpu.parallel.sharding import param_shardings
+
+    name_to_file = _resolve_checkpoint(path)
+    shardings = None
+    if mesh is not None:
+        shardings = _flatten(param_shardings(
+            params, mesh, tp_rules=tp_rules, fsdp=fsdp,
+            min_size=min_size))
+    flat = _flatten(params)
+    out = jax.tree_util.tree_map(lambda x: x, params)  # fresh structure
+
+    with contextlib.ExitStack() as stack:
+        handles: Dict[str, Any] = {}  # file → safe_open handle (mmap)
+
+        def handle(fp: str):
+            if fp not in handles:
+                handles[fp] = stack.enter_context(
+                    safe_open(fp, framework="np"))
+            return handles[fp]
+
+        for p, leaf in flat.items():
+            mapped = hf_name_for(p)
+            if mapped is None:
+                continue
+            name, transpose = mapped
+            if name not in name_to_file:
+                raise KeyError(
+                    f"checkpoint {path!r} is missing {name!r} "
+                    f"(for param {'/'.join(p)})")
+            target_dtype = np.dtype(getattr(leaf, "dtype", np.float32))
+            shape = tuple(leaf.shape)
+            src = handle(name_to_file[name]).get_slice(name)
+            src_shape = tuple(src.get_shape())
+            want = tuple(reversed(shape)) if transpose else shape
+            if src_shape != want:
+                raise ValueError(
+                    f"{name}: checkpoint shape {src_shape} != expected "
+                    f"{want} for param {'/'.join(p)}")
+
+            def read(idx, src=src, transpose=transpose,
+                     dt=target_dtype):
+                # idx: per-dim slices of the TARGET; a transposed weight
+                # reads the mirrored source slice then transposes — only
+                # the shard's bytes leave the (mmap'd) file
+                if transpose:
+                    block = src[idx[1], idx[0]]
+                    return np.ascontiguousarray(
+                        np.asarray(block).T).astype(dt, copy=False)
+                return np.asarray(src[idx]).astype(dt, copy=False)
+
+            if shardings is not None:
+                arr = jax.make_array_from_callback(
+                    shape, shardings[p], read)
+            else:
+                full = (slice(None),) * len(shape)
+                arr = jax.numpy.asarray(read(full))
+            _set_path(out, p, arr)
+    return out
+
+
+def export_llama_safetensors(params: Any, path: str) -> None:
+    """Write ``params`` as an HF-convention Llama checkpoint (LoRA
+    adapters are skipped — merge or ship them separately)."""
+    from safetensors.numpy import save_file
+
+    tensors: Dict[str, np.ndarray] = {}
+    for p, leaf in _flatten(params).items():
+        mapped = hf_name_for(p)
+        if mapped is None:
+            continue
+        name, transpose = mapped
+        arr = np.asarray(leaf)
+        tensors[name] = np.ascontiguousarray(arr.T if transpose else arr)
+    save_file(tensors, path)

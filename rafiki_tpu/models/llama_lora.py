@@ -352,6 +352,12 @@ class LlamaLoRA(BaseModel):
             "bf16": CategoricalKnob([True, False]),
             "quick_train": PolicyKnob("QUICK_TRAIN"),
             "share_params": PolicyKnob("SHARE_PARAMS"),
+            # serving-quality runs: a trained byte-BPE artifact
+            # (data/bpe.py) replaces the hash tokenizer, and an
+            # HF-convention safetensors checkpoint (models/convert.py)
+            # replaces random base weights. Empty = round-3 behavior.
+            "tokenizer_path": FixedKnob(""),
+            "pretrained_path": FixedKnob(""),
         }
 
     def __init__(self, **knobs: Any) -> None:
@@ -359,8 +365,16 @@ class LlamaLoRA(BaseModel):
         self._params: Optional[Any] = None
         self._id2tok: Dict[int, str] = {}
         self._fwd: Optional[Any] = None
-        self.tokenizer = HashTokenizer(int(self.knobs.get("vocab_size",
-                                                          1 << 14)))
+        tok_path = str(self.knobs.get("tokenizer_path") or "")
+        if tok_path:
+            from rafiki_tpu.data.bpe import ByteBPETokenizer
+
+            # vocab_size follows the artifact — the embedding must match
+            # the merge table, not the knob default
+            self.tokenizer: Any = ByteBPETokenizer.load(tok_path)
+        else:
+            self.tokenizer = HashTokenizer(int(self.knobs.get("vocab_size",
+                                                              1 << 14)))
 
     # ---- internals ----
     def _module(self) -> Llama:
@@ -380,20 +394,28 @@ class LlamaLoRA(BaseModel):
         # (params stay f32; the matmul-heavy layers run in this dtype)
         return jnp.bfloat16 if self.knobs.get("bf16", True) else None
 
+    @property
+    def _bpe(self) -> bool:
+        """True when a real (invertible) tokenizer is active."""
+        return hasattr(self.tokenizer, "decode")
+
     def _encode_lm(self, texts: Sequence[str]) -> Tuple[np.ndarray,
                                                         np.ndarray]:
-        """BOS-prefixed hashed token rows; also grows the id→token table
-        used to detokenize generations (hashing is one-way)."""
+        """BOS-prefixed token rows. With the hash tokenizer this also
+        grows the id→token table used to detokenize generations (hashing
+        is one-way); BPE decodes exactly and needs no table."""
         max_len = int(self.knobs["max_len"])
         ids = np.zeros((len(texts), max_len), np.int32)
         lens = np.zeros((len(texts),), np.int32)
         for i, t in enumerate(texts):
             row, n = self.tokenizer.encode(t, max_len)  # CLS slot = BOS
             ids[i], lens[i] = row, n
-            # mirror the tokenizer's own splitting so ids align with words
-            for tok_str, tok_id in zip(_TOKEN_RE.findall(t.lower()),
-                                       row[1:n]):
-                self._id2tok[int(tok_id)] = tok_str
+            if not self._bpe:
+                # mirror the tokenizer's own splitting so ids align
+                # with words
+                for tok_str, tok_id in zip(_TOKEN_RE.findall(t.lower()),
+                                           row[1:n]):
+                    self._id2tok[int(tok_id)] = tok_str
         return ids, lens
 
     def _mesh(self, devices):
@@ -419,23 +441,40 @@ class LlamaLoRA(BaseModel):
         batch_size = int(self.knobs["batch_size"])
         batch_size = max(n_data, batch_size - batch_size % n_data)
 
-        if self._params is None:
+        pretrained = str(self.knobs.get("pretrained_path") or "")
+        fresh = self._params is None
+        if fresh:
             params = module.init(jax.random.PRNGKey(0),
                                  jnp.zeros((1, ids.shape[1]),
                                            jnp.int32))["params"]
         else:
             params = self._params
+        warm = False
         if ctx.shared_params is not None and self.knobs.get("share_params"):
             shared = ctx.shared_params.get("params")
             if shared is not None and same_tree_shapes(params, shared):
                 params = jax.tree_util.tree_map(jnp.asarray, shared)
+                warm = True
 
+        if pretrained and fresh and not warm:
+            # base weights from an HF-convention checkpoint, loaded
+            # DIRECTLY into their 2-D shardings (shard-sized file reads;
+            # LoRA adapters keep their init) — config #5's real base.
+            # A warm start / re-train already carries trained state and
+            # must not be clobbered back to the checkpoint.
+            from rafiki_tpu.models.convert import import_llama_safetensors
+
+            params = import_llama_safetensors(
+                pretrained, params, mesh=mesh, tp_rules=TP_RULES,
+                fsdp=True, min_size=2 ** 12)
         # 2-D sharding: tensor-parallel per TP_RULES over `model`, fsdp
         # over `data` for everything of >=4k elements — smaller tensors
         # (and test-scale params) are replicated, where fsdp's gather
         # traffic outweighs the memory it saves. The fsdp code path at
         # tiny shapes is covered by __graft_entry__.dryrun_multichip
-        # (min_size=0 there).
+        # (min_size=0 there). Imported leaves already sit in these
+        # shardings (device_put is then a no-op); the put places the
+        # rest (LoRA adapters, fresh/warm trees).
         p_shard = param_shardings(params, mesh, tp_rules=TP_RULES,
                                   fsdp=True, min_size=2 ** 12)
         params = jax.tree_util.tree_map(jax.device_put, params, p_shard)
@@ -555,8 +594,11 @@ class LlamaLoRA(BaseModel):
         return [self._detok(row) for row in out]
 
     def _detok(self, ids: Sequence[Any]) -> str:
-        """Render generated ids via the learned id→token table (hashing
-        is one-way; unknown ids render as ``<id>``)."""
+        """Render generated ids: exact BPE decode when a real tokenizer
+        is active, else the learned id→token table (hashing is one-way;
+        unknown ids render as ``<id>``)."""
+        if self._bpe:
+            return self.tokenizer.decode(int(t) for t in ids).lstrip()
         return " ".join(self._id2tok.get(int(t), f"<{int(t)}>")
                         for t in ids)
 
@@ -593,15 +635,28 @@ class LlamaLoRA(BaseModel):
 
     def dump_parameters(self) -> Dict[str, Any]:
         assert self._params is not None, "model is not trained"
+        meta: Dict[str, Any] = {"id2tok": {str(k): v
+                                           for k, v in
+                                           self._id2tok.items()}}
+        if self._bpe:
+            # the merge table travels WITH the weights: a serving host
+            # can reconstruct the exact tokenizer without the artifact
+            # file (tokenizer_path may not exist there)
+            meta["bpe_merges"] = [list(m) for m in self.tokenizer.merges]
         return {
             "params": jax.tree_util.tree_map(np.asarray, self._params),
-            "meta": {"id2tok": {str(k): v
-                                for k, v in self._id2tok.items()}},
+            "meta": meta,
         }
 
     def load_parameters(self, params: Dict[str, Any]) -> None:
         self._id2tok = {int(k): v
                         for k, v in params["meta"]["id2tok"].items()}
+        merges = params["meta"].get("bpe_merges")
+        if merges is not None:
+            from rafiki_tpu.data.bpe import ByteBPETokenizer
+
+            self.tokenizer = ByteBPETokenizer(
+                [tuple(int(x) for x in m) for m in merges])
         self._params = jax.tree_util.tree_map(jnp.asarray, params["params"])
         self._fwd = None
 

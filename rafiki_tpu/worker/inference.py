@@ -247,11 +247,18 @@ def _safe_sampling(samp: Any) -> dict:
     if not isinstance(samp, dict):
         samp = {}
 
+    import math
+
     def num(key: str, cast, default):
         try:
-            return cast(samp.get(key, default))
+            v = cast(samp.get(key, default))
         except (TypeError, ValueError):
             return default
+        # NaN/inf would split behavior between the host's greedy-vs-
+        # sampling program gate (NaN > 0 is False) and the device's
+        # where(temp <= 0) select (also False) — same request, different
+        # path depending on batch mix. Finite or default.
+        return v if math.isfinite(v) else default
 
     return {"temperature": num("temperature", float, 0.0),
             "top_k": num("top_k", int, 0),

@@ -1,0 +1,73 @@
+"""Byte-level BPE tokenizer: losslessness, compression, artifact
+round-trip, determinism, and the HashTokenizer-compatible surface."""
+
+import numpy as np
+import pytest
+
+from rafiki_tpu.data.bpe import (BOS_ID, N_SPECIAL, PAD_ID,
+                                 ByteBPETokenizer)
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog\n",
+    "the quicker the fox the lazier the dog\n",
+    "pack my box with five dozen liquor jugs\n",
+    "sphinx of black quartz judge my vow\n",
+] * 8
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return ByteBPETokenizer.train(CORPUS, vocab_size=320)
+
+
+def test_lossless_roundtrip(tok):
+    for text in ["the quick brown fox", "Hello, WORLD!  spaces\tand\nnl",
+                 "unicode: déjà vu — 東京 🙂", "", "   ", "a"]:
+        assert tok.decode(tok.encode_ids(text)) == text
+
+
+def test_merges_compress(tok):
+    text = "the quick brown fox jumps over the lazy dog"
+    ids = tok.encode_ids(text)
+    assert len(ids) < len(text.encode("utf-8"))  # merges learned
+    # frequent whole words became single tokens
+    assert len(tok.encode_ids("the")) == 1
+
+
+def test_training_is_deterministic():
+    a = ByteBPETokenizer.train(CORPUS, vocab_size=300)
+    b = ByteBPETokenizer.train(CORPUS, vocab_size=300)
+    assert a.merges == b.merges
+
+
+def test_artifact_roundtrip(tok, tmp_path):
+    path = str(tmp_path / "bpe.json")
+    tok.save(path)
+    loaded = ByteBPETokenizer.load(path)
+    assert loaded.merges == tok.merges
+    assert loaded.vocab_size == tok.vocab_size
+    text = "the quick brown fox"
+    assert loaded.encode_ids(text) == tok.encode_ids(text)
+
+
+def test_hash_tokenizer_compatible_surface(tok):
+    row, n = tok.encode("the fox", max_len=16)
+    assert row[0] == BOS_ID and n >= 2 and len(row) == 16
+    assert all(t == PAD_ID for t in row[n:])
+    ids, lens = tok.encode_batch(["the fox", "dog"], max_len=16)
+    assert ids.shape == (2, 16) and ids.dtype == np.int32
+    assert lens[0] >= 2
+    # truncation respects max_len
+    long_row, ln = tok.encode("x" * 500, max_len=8)
+    assert len(long_row) == 8 and ln == 8
+
+
+def test_unknown_bytes_never_fail(tok):
+    # bytes never seen in training still encode (byte-level base vocab)
+    text = "\x00\x01\xff weird"
+    assert tok.decode(tok.encode_ids(text)) == text
+
+
+def test_vocab_floor():
+    with pytest.raises(ValueError):
+        ByteBPETokenizer.train(CORPUS, vocab_size=N_SPECIAL + 255)

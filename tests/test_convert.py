@@ -1,0 +1,225 @@
+"""Pretrained-weight ingestion (models/convert.py): HF-name mapping,
+sharded import on the 8-device mesh, and the VERDICT round-trip —
+synthetic safetensors → 2-D sharded Llama → generation matches the
+dense-load oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rafiki_tpu.models.convert import (export_llama_safetensors,
+                                       hf_name_for,
+                                       import_llama_safetensors)
+from rafiki_tpu.models.llama_lora import TP_RULES, Llama, greedy_generate
+from rafiki_tpu.parallel.sharding import make_mesh, param_shardings
+
+CFG = dict(vocab_size=512, max_len=32, hidden_dim=64, depth=2,
+           n_heads=4, n_kv_heads=2, mlp_dim=128, lora_rank=4)
+
+
+@pytest.fixture(scope="module")
+def module_params():
+    module = Llama(**CFG)
+    params = module.init(jax.random.PRNGKey(7),
+                         jnp.zeros((1, 8), jnp.int32))["params"]
+    return module, params
+
+
+def test_hf_name_mapping(module_params):
+    _, params = module_params
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    names = set()
+    for kp, _ in flat:
+        path = tuple(str(getattr(k, "key", k)) for k in kp)
+        mapped = hf_name_for(path)
+        if path[-1] in ("lora_a", "lora_b"):
+            assert mapped is None
+        else:
+            name, _t = mapped
+            assert name not in names  # bijective
+            names.add(name)
+    assert "model.embed_tokens.weight" in names
+    assert "model.layers.1.self_attn.q_proj.weight" in names
+    assert "model.layers.0.mlp.down_proj.weight" in names
+    assert "lm_head.weight" in names
+    with pytest.raises(KeyError):
+        hf_name_for(("block_0", "mystery", "kernel"))
+
+
+def test_export_import_roundtrip_dense(module_params, tmp_path):
+    module, params = module_params
+    path = str(tmp_path / "ckpt.safetensors")
+    export_llama_safetensors(params, path)
+    loaded = import_llama_safetensors(path, params, mesh=None)
+    for (kp, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(params)[0],
+            jax.tree_util.tree_flatten_with_path(loaded)[0]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=str(kp))
+
+
+def test_sharded_import_matches_and_places(module_params, tmp_path):
+    """Import onto the 2-D (data=4, model=2) mesh: every leaf lands in
+    its param_shardings placement AND is bitwise equal to the source."""
+    module, params = module_params
+    path = str(tmp_path / "ckpt.safetensors")
+    export_llama_safetensors(params, path)
+    mesh = make_mesh(jax.devices()[:8], model=2)
+    loaded = import_llama_safetensors(path, params, mesh=mesh,
+                                      tp_rules=TP_RULES, fsdp=True,
+                                      min_size=2 ** 10)
+    expected = param_shardings(params, mesh, tp_rules=TP_RULES,
+                               fsdp=True, min_size=2 ** 10)
+    flat_l = jax.tree_util.tree_flatten_with_path(loaded)[0]
+    flat_p = {tuple(str(getattr(k, "key", k)) for k in kp): v
+              for kp, v in jax.tree_util.tree_flatten_with_path(params)[0]}
+    flat_e = {tuple(str(getattr(k, "key", k)) for k in kp): v
+              for kp, v in
+              jax.tree_util.tree_flatten_with_path(expected)[0]}
+    n_sharded = 0
+    for kp, arr in flat_l:
+        path_t = tuple(str(getattr(k, "key", k)) for k in kp)
+        np.testing.assert_array_equal(np.asarray(arr),
+                                      np.asarray(flat_p[path_t]),
+                                      err_msg=str(path_t))
+        if path_t[-1] in ("lora_a", "lora_b"):
+            continue  # kept from template, placed by the caller
+        assert arr.sharding == flat_e[path_t], path_t
+        if any(s is not None for s in arr.sharding.spec):
+            n_sharded += 1
+    assert n_sharded >= 5  # the big projections actually sharded
+
+
+def test_roundtrip_generation_matches_dense_oracle(module_params,
+                                                   tmp_path):
+    """The VERDICT acceptance test: synthetic safetensors → 2-D sharded
+    import → generation identical to the dense in-memory weights."""
+    module, params = module_params
+    path = str(tmp_path / "ckpt.safetensors")
+    export_llama_safetensors(params, path)
+    mesh = make_mesh(jax.devices()[:8], model=2)
+    loaded = import_llama_safetensors(path, params, mesh=mesh,
+                                      tp_rules=TP_RULES, fsdp=True,
+                                      min_size=2 ** 10)
+    prompts = np.asarray([[1, 5, 9, 13], [1, 7, 0, 0]], np.int32)
+    lens = np.asarray([4, 2], np.int32)
+    ref = np.asarray(greedy_generate(module, params, prompts, lens, 6))
+    got = np.asarray(greedy_generate(module, loaded, prompts, lens, 6))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_sharded_multifile_checkpoint(module_params, tmp_path):
+    """HF Llama-3 8B ships as model-0000X-of-0000Y.safetensors + an
+    index.json — the import must resolve names across shard files, via
+    the directory OR the index path."""
+    import json
+
+    from safetensors.numpy import save_file
+
+    module, params = module_params
+    tensors = {}
+    for p, leaf in [
+            (tuple(str(getattr(k, "key", k)) for k in kp), v)
+            for kp, v in jax.tree_util.tree_flatten_with_path(params)[0]]:
+        mapped = hf_name_for(p)
+        if mapped:
+            name, t = mapped
+            arr = np.asarray(leaf)
+            tensors[name] = np.ascontiguousarray(arr.T if t else arr)
+    names = sorted(tensors)
+    half = len(names) // 2
+    d = tmp_path / "ckpt"
+    d.mkdir()
+    save_file({n: tensors[n] for n in names[:half]},
+              str(d / "model-00001-of-00002.safetensors"))
+    save_file({n: tensors[n] for n in names[half:]},
+              str(d / "model-00002-of-00002.safetensors"))
+    weight_map = {n: ("model-00001-of-00002.safetensors" if i < half
+                      else "model-00002-of-00002.safetensors")
+                  for i, n in enumerate(names)}
+    with open(d / "model.safetensors.index.json", "w") as f:
+        json.dump({"weight_map": weight_map}, f)
+
+    for path in (str(d), str(d / "model.safetensors.index.json")):
+        loaded = import_llama_safetensors(path, params)
+        np.testing.assert_array_equal(
+            np.asarray(loaded["tok_embed"]["embedding"]),
+            np.asarray(params["tok_embed"]["embedding"]))
+        np.testing.assert_array_equal(
+            np.asarray(loaded["block_1"]["down"]["kernel"]),
+            np.asarray(params["block_1"]["down"]["kernel"]))
+
+
+def test_missing_tensor_is_loud(module_params, tmp_path):
+    from safetensors.numpy import save_file
+
+    module, params = module_params
+    path = str(tmp_path / "partial.safetensors")
+    save_file({"model.embed_tokens.weight":
+               np.zeros((CFG["vocab_size"], CFG["hidden_dim"]),
+                        np.float32)}, path)
+    with pytest.raises(KeyError, match="missing"):
+        import_llama_safetensors(path, params)
+
+
+def test_shape_mismatch_is_loud(module_params, tmp_path):
+    module, params = module_params
+    path = str(tmp_path / "ckpt.safetensors")
+    export_llama_safetensors(params, path)
+    wrong = Llama(**{**CFG, "hidden_dim": 128})
+    wrong_params = wrong.init(jax.random.PRNGKey(0),
+                              jnp.zeros((1, 8), jnp.int32))["params"]
+    with pytest.raises(ValueError, match="shape"):
+        import_llama_safetensors(path, wrong_params)
+
+
+def test_llama_template_trains_with_bpe_and_pretrained(tmp_path):
+    """End-to-end config #5 slice: BPE artifact + pretrained base →
+    LlamaLoRA.train fine-tunes LoRA on top and serves with exact
+    detokenization (no id→token table)."""
+    from rafiki_tpu.data import generate_text_classification_dataset
+    from rafiki_tpu.data.bpe import ByteBPETokenizer
+    from rafiki_tpu.models.llama_lora import LlamaLoRA
+
+    ds_path = str(tmp_path / "corpus.jsonl")
+    generate_text_classification_dataset(ds_path, 48, seed=0)
+
+    # train the tokenizer on the same corpus file contents
+    import json as _json
+    texts = [rec["text"] for line in open(ds_path) if line.strip()
+             for rec in [_json.loads(line)] if "text" in rec]
+    tok = ByteBPETokenizer.train(texts, vocab_size=300)
+    tok_path = str(tmp_path / "bpe.json")
+    tok.save(tok_path)
+
+    knobs = {"max_epochs": 1, "vocab_size": 0,  # follows the artifact
+             "hidden_dim": 64, "depth": 2, "n_heads": 4, "kv_ratio": 2,
+             "lora_rank": 4, "max_len": 32, "model_parallel": 1,
+             "learning_rate": 1e-2, "batch_size": 8, "bf16": False,
+             "quick_train": True, "share_params": False,
+             "tokenizer_path": tok_path}
+
+    # build the "pretrained" base from a throwaway instance's shapes
+    base = LlamaLoRA(**knobs)
+    module = base._module()
+    assert module.vocab_size == tok.vocab_size
+    params = module.init(jax.random.PRNGKey(3),
+                         jnp.zeros((1, 8), jnp.int32))["params"]
+    ckpt = str(tmp_path / "base.safetensors")
+    export_llama_safetensors(params, ckpt)
+
+    model = LlamaLoRA(**{**knobs, "pretrained_path": ckpt})
+    model.train(ds_path)
+    # base weights came from the checkpoint, not random reinit
+    got_embed = np.asarray(model._params["tok_embed"]["embedding"])
+    np.testing.assert_array_equal(
+        got_embed, np.asarray(params["tok_embed"]["embedding"]))
+    # serving round-trip with REAL detokenization via dump/load
+    blob = model.dump_parameters()
+    assert blob["meta"].get("bpe_merges")
+    fresh = LlamaLoRA(**knobs)
+    fresh.load_parameters(blob)
+    out = fresh.predict(["the quick"])
+    assert isinstance(out[0], str)
+    assert "<" not in out[0]  # no unknown-id placeholders — exact decode
