@@ -63,7 +63,12 @@ class TrainContext:
     #: throttles by wall clock and only then materializes the blob (host
     #: copy) and saves it. ``frac_done`` records training progress so a
     #: resumed trial trains only the REMAINING budget, keeping scores
-    #: comparable to un-preempted trials.
+    #: comparable to un-preempted trials. Big-model templates may also
+    #: pass ``tree=<live sharded pytree>``: sharded-capable stores then
+    #: save per-shard and asynchronously (SURVEY §5.4) instead of
+    #: calling the whole-tree blob factory, and the later warm start
+    #: arrives as a lazy handle with ``.restore(template)`` in
+    #: ``shared_params`` instead of a host tree.
     checkpoint: Optional[Any] = None
 
 

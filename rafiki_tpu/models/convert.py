@@ -34,23 +34,9 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from ..utils.pytree import flatten_paths as _flatten, set_path as _set_path
+
 _BLOCK_RE = re.compile(r"^block_(\d+)$")
-
-
-def _flatten(tree: Any, prefix: Tuple[str, ...] = ()) -> Dict[Tuple[str, ...], Any]:
-    if isinstance(tree, dict):
-        out: Dict[Tuple[str, ...], Any] = {}
-        for k, v in tree.items():
-            out.update(_flatten(v, prefix + (str(k),)))
-        return out
-    return {prefix: tree}
-
-
-def _set_path(tree: dict, path: Tuple[str, ...], value: Any) -> None:
-    node = tree
-    for k in path[:-1]:
-        node = node[k]
-    node[path[-1]] = value
 
 
 def hf_name_for(path: Tuple[str, ...]) -> Optional[Tuple[str, bool]]:

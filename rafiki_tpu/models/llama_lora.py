@@ -450,11 +450,23 @@ class LlamaLoRA(BaseModel):
         else:
             params = self._params
         warm = False
+        shared_ref = None
         if ctx.shared_params is not None and self.knobs.get("share_params"):
-            shared = ctx.shared_params.get("params")
-            if shared is not None and same_tree_shapes(params, shared):
-                params = jax.tree_util.tree_map(jnp.asarray, shared)
-                warm = True
+            if hasattr(ctx.shared_params, "restore"):
+                # sharded-checkpoint handle (store/sharded_ckpt.py):
+                # gate on the manifest-only shape probe (the sharded
+                # twin of same_tree_shapes — a mismatched donor must
+                # leave warm=False so a pretrained base still loads),
+                # then restore AFTER placement, straight into the 2-D
+                # shardings: the warm tree never assembles on a host
+                if ctx.shared_params.matches({"params": params}):
+                    shared_ref = ctx.shared_params
+                    warm = True
+            else:
+                shared = ctx.shared_params.get("params")
+                if shared is not None and same_tree_shapes(params, shared):
+                    params = jax.tree_util.tree_map(jnp.asarray, shared)
+                    warm = True
 
         if pretrained and fresh and not warm:
             # base weights from an HF-convention checkpoint, loaded
@@ -478,6 +490,17 @@ class LlamaLoRA(BaseModel):
         p_shard = param_shardings(params, mesh, tp_rules=TP_RULES,
                                   fsdp=True, min_size=2 ** 12)
         params = jax.tree_util.tree_map(jax.device_put, params, p_shard)
+        if shared_ref is not None:
+            try:
+                params = shared_ref.restore({"params": params})["params"]
+            except (KeyError, ValueError):
+                import logging
+
+                # shape/structure mismatch (different knobs) — cold
+                # start, mirroring the same_tree_shapes guard above
+                logging.getLogger(__name__).warning(
+                    "sharded warm-start checkpoint does not match this "
+                    "parameterization; training cold", exc_info=True)
 
         lr = float(self.knobs["learning_rate"])
         # multi_transform (not optax.masked): masked leaves pass raw
@@ -526,10 +549,14 @@ class LlamaLoRA(BaseModel):
                     sharding=b_shard)
                 ctx.logger.log(epoch=epoch, loss=mean_loss)
                 if ctx.checkpoint is not None:
-                    # preemption safety: worker throttles + persists
+                    # preemption safety: worker throttles + persists.
+                    # The live (sharded device) tree rides along so a
+                    # sharded-capable store saves per-shard + async —
+                    # the blob factory only runs on fallback backends
                     self._params = params
                     ctx.checkpoint(self.dump_parameters,
-                                   frac_done=(epoch + 1) / epochs)
+                                   frac_done=(epoch + 1) / epochs,
+                                   tree={"params": params})
                 if ctx.should_continue is not None and \
                         not ctx.should_continue(epoch, -mean_loss):
                     break

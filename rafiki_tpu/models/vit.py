@@ -236,7 +236,8 @@ class ViTBase16(BaseModel):
                 jnp.zeros((1, *x.shape[1:]), dtype))["params"]
         else:
             params = self._params
-        if ctx.shared_params is not None and self.knobs.get("share_params"):
+        if ctx.shared_params is not None and self.knobs.get("share_params") \
+                and hasattr(ctx.shared_params, "get"):
             shared = ctx.shared_params.get("params")
             donor_prep = int(ctx.shared_params.get("meta", {})
                              .get("prep_version", 1))

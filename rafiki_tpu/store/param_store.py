@@ -247,6 +247,68 @@ class ParamStore:
         self.backend.delete(trial_id)
         with self._lock:
             self._cache.pop(trial_id, None)
+        # a key's sharded checkpoint (if any) is the same logical object
+        # — every existing cleanup path (trial completion, job sweep)
+        # stays leak-free without learning a second delete call
+        ckptr = self.sharded_checkpointer()
+        if ckptr is not None and ckptr.exists(trial_id):
+            ckptr.delete(trial_id)
+
+    # ---- sharded checkpoints (SURVEY §5.4) ----
+    def sharded_checkpointer(self):
+        """The sharded (per-shard files, no full-tree blob) checkpoint
+        store co-located with a file backend; None for mem/kv backends
+        (callers fall back to whole-tree blobs there).
+
+        msgpack blobs serialize the WHOLE pytree through one host buffer
+        — unusable for fsdp-sharded big models; the sharded store writes
+        one file per device shard instead (store/sharded_ckpt.py)."""
+        if getattr(self, "_sharded", None) is None:
+            if not isinstance(self.backend, FileBackend):
+                return None
+            from .sharded_ckpt import ShardedCheckpointer
+
+            self._sharded = ShardedCheckpointer(
+                str(self.backend.root / "sharded"))
+        return self._sharded
+
+    def save_sharded_async(self, key: str, tree: Any) -> bool:
+        """Donation-safe async sharded save; False if the backend has no
+        sharded store (caller should blob-save instead)."""
+        ckptr = self.sharded_checkpointer()
+        if ckptr is None:
+            return False
+        ckptr.save_async(key, tree)
+        return True
+
+    def sharded_ref(self, key: str):
+        """Lazy restore handle for ``key``'s sharded checkpoint, or None
+        if absent."""
+        ckptr = self.sharded_checkpointer()
+        if ckptr is None:
+            return None
+        # quiet wait: an in-flight async save must land first, but a
+        # stale failure from SOME EARLIER trial's save must not detonate
+        # this unrelated code path (trial fault isolation) — log only
+        ckptr.wait(reraise=False, log=True)
+        if not ckptr.exists(key):
+            return None
+        from .sharded_ckpt import ShardedCheckpointRef
+
+        return ShardedCheckpointRef(ckptr, key)
+
+    def copy_sharded(self, src: str, dst: str) -> bool:
+        ckptr = self.sharded_checkpointer()
+        if ckptr is None:
+            return False
+        return ckptr.copy(src, dst)  # waits internally (quiet)
+
+    def exists_sharded(self, key: str) -> bool:
+        ckptr = self.sharded_checkpointer()
+        if ckptr is None:
+            return False
+        ckptr.wait(reraise=False, log=True)
+        return ckptr.exists(key)
 
     def keys(self) -> List[str]:
         return self.backend.keys()

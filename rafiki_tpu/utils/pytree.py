@@ -1,0 +1,34 @@
+"""Shared nested-dict pytree helpers (checkpoint + weight-import use).
+
+One implementation so the safetensors importer (models/convert.py) and
+the sharded checkpointer (store/sharded_ckpt.py) can never drift on
+traversal order or container support: plain dicts (and flax FrozenDict,
+which duck-types as a Mapping) in sorted-key order.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Tuple
+
+
+def leaf_paths(tree: Any,
+               prefix: Tuple[str, ...] = ()) -> Iterator[
+                   Tuple[Tuple[str, ...], Any]]:
+    """Yield (path, leaf) in deterministic sorted-key order."""
+    if hasattr(tree, "items"):  # dict / FrozenDict
+        for k in sorted(tree):
+            yield from leaf_paths(tree[k], prefix + (str(k),))
+    else:
+        yield prefix, tree
+
+
+def flatten_paths(tree: Any) -> dict:
+    return dict(leaf_paths(tree))
+
+
+def set_path(tree: Any, path: Tuple[str, ...], value: Any) -> None:
+    """In-place assignment at ``path`` (the tree must be mutable dicts)."""
+    node = tree
+    for k in path[:-1]:
+        node = node[k]
+    node[path[-1]] = value

@@ -145,6 +145,12 @@ class TrainWorker:
                 if proposal.warm_start_trial_id:
                     shared = self.param_store.load(
                         proposal.warm_start_trial_id)
+                    if shared is None:
+                        # big-model trials checkpoint SHARDED (SURVEY
+                        # §5.4) — hand the template a lazy restore
+                        # handle instead of assembling the tree here
+                        shared = self.param_store.sharded_ref(
+                            proposal.warm_start_trial_id)
                 trial_profile_dir = None
                 if self.profile_dir:
                     import os
@@ -244,19 +250,31 @@ class TrainWorker:
 
         if proposal.meta.get("resumed_from") and shared is not None:
             # bytes-level copy: no msgpack re-encode of a possibly
-            # multi-GB tree that was deserialized moments ago
-            self.param_store.copy(proposal.warm_start_trial_id, ckpt_key)
+            # multi-GB tree that was deserialized moments ago (sharded
+            # checkpoints copy at the directory level)
+            if not self.param_store.copy(proposal.warm_start_trial_id,
+                                         ckpt_key):
+                self.param_store.copy_sharded(
+                    proposal.warm_start_trial_id, ckpt_key)
             if base_frac > 0:
                 self.param_store.save(f"{ckpt_key}-meta",
                                       {"frac_done": base_frac})
 
         last_save = [_time.monotonic()]
 
-        def save_checkpoint(make_blob, frac_done=None) -> None:
+        def save_checkpoint(make_blob, frac_done=None, tree=None) -> None:
+            """``tree`` (optional): the template's LIVE (sharded device)
+            pytree — saved per-shard + async when the store supports it,
+            so no host materializes the full tree (SURVEY §5.4); without
+            it (or on mem/kv backends) the zero-arg ``make_blob``
+            whole-tree path runs as before."""
             now = _time.monotonic()
             if now - last_save[0] < self.checkpoint_interval_s:
                 return
-            self.param_store.save(ckpt_key, make_blob())
+            if tree is None or \
+                    not self.param_store.save_sharded_async(ckpt_key,
+                                                            tree):
+                self.param_store.save(ckpt_key, make_blob())
             if frac_done is not None:
                 global_frac = base_frac + float(frac_done) * (1 - base_frac)
                 self.param_store.save(f"{ckpt_key}-meta",
@@ -334,7 +352,8 @@ class TrainWorker:
                     stale_after_s=self.orphan_stale_s):
                 continue  # live heartbeat, or another worker won
             ckpt_key = f"ckpt-{t['id']}"
-            has_ckpt = self.param_store.exists(ckpt_key)
+            has_ckpt = self.param_store.exists(ckpt_key) or \
+                self.param_store.exists_sharded(ckpt_key)
             frac = 0.0
             if has_ckpt:
                 meta = self.param_store.load(f"{ckpt_key}-meta")

@@ -1,0 +1,313 @@
+"""Sharded checkpointing (SURVEY §5.4): per-shard files, per-process
+write bounds, async donation-safe saves, cross-topology restore, and
+resume parity with the whole-blob path."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from rafiki_tpu.store.param_store import ParamStore
+from rafiki_tpu.store.sharded_ckpt import (ShardedCheckpointer,
+                                           ShardedCheckpointRef)
+
+
+def _mesh(shape=(4, 2)):
+    devs = np.array(jax.devices()[:shape[0] * shape[1]],
+                    dtype=object).reshape(shape)
+    return Mesh(devs, ("data", "model"))
+
+
+def _tree(mesh):
+    """A mixed tree: 2-D sharded, 1-D sharded, replicated, plain numpy."""
+    w = jax.device_put(
+        jnp.arange(64 * 32, dtype=jnp.float32).reshape(64, 32),
+        NamedSharding(mesh, P("data", "model")))
+    e = jax.device_put(jnp.arange(128, dtype=jnp.float32).reshape(16, 8),
+                       NamedSharding(mesh, P("data")))
+    r = jax.device_put(jnp.ones((8,), jnp.float32),
+                       NamedSharding(mesh, P()))
+    return {"a": {"w": w, "e": e}, "r": r,
+            "host": np.arange(6, dtype=np.int32)}
+
+
+def test_roundtrip_same_topology(tmp_path):
+    mesh = _mesh()
+    tree = _tree(mesh)
+    ck = ShardedCheckpointer(str(tmp_path))
+    ck.save("t0", tree)
+    out = ck.restore("t0", tree)
+    for (kp, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(tree)[0],
+            jax.tree_util.tree_flatten_with_path(out)[0]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=str(kp))
+    # sharded leaves restore INTO their shardings
+    assert out["a"]["w"].sharding == tree["a"]["w"].sharding
+    # the big leaf is stored as multiple per-shard files, not one blob
+    d = ck._dir("t0")
+    import os
+    w_files = [f for f in os.listdir(d) if f.startswith("L")]
+    assert len(w_files) > 4
+
+
+def test_per_process_write_bound(tmp_path):
+    """The disjoint-writer rule: simulate 4 processes each owning 2 of
+    the 8 devices — every process writes < full-tree/4 bytes, the union
+    reassembles exactly (the VERDICT r3 acceptance criterion)."""
+    mesh = _mesh()
+    tree = _tree(mesh)
+    full_bytes = sum(np.asarray(x).nbytes
+                     for x in jax.tree_util.tree_leaves(tree))
+    ck = ShardedCheckpointer(str(tmp_path))
+    devs = jax.devices()[:8]
+    written = []
+    for proc in range(4):
+        mine = set(devs[2 * proc: 2 * proc + 2])
+
+        def owns(shard, mine=mine):
+            return shard.replica_id == 0 and shard.device in mine
+
+        # all processes plan identical manifests; files accumulate
+        written.append(ck.save("t0", tree, owns=owns,
+                               process_index=proc))
+    # each simulated process stayed under a quarter of the tree
+    for w in written[1:]:  # process 0 also writes the replicated+host
+        assert 0 < w < full_bytes / 4, (w, full_bytes)
+    out = ck.restore("t0", tree)
+    for a, b in zip(jax.tree_util.tree_leaves(tree),
+                    jax.tree_util.tree_leaves(out)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_cross_topology_restore(tmp_path):
+    """Save on a (4,2) mesh, restore onto (2,4) and onto plain host
+    arrays — shard files are assembled by overlap, not by matching."""
+    mesh_a = _mesh((4, 2))
+    tree = _tree(mesh_a)
+    ck = ShardedCheckpointer(str(tmp_path))
+    ck.save("t0", tree)
+
+    mesh_b = _mesh((2, 4))
+    tmpl_b = _tree(mesh_b)
+    out_b = ck.restore("t0", tmpl_b)
+    for a, b in zip(jax.tree_util.tree_leaves(tree),
+                    jax.tree_util.tree_leaves(out_b)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert out_b["a"]["w"].sharding == tmpl_b["a"]["w"].sharding
+
+    host_tmpl = jax.tree_util.tree_map(np.asarray, tree)
+    out_h = ck.restore("t0", host_tmpl)
+    np.testing.assert_array_equal(np.asarray(out_h["a"]["w"]),
+                                  np.asarray(tree["a"]["w"]))
+
+
+def test_async_save_and_error_surfacing(tmp_path):
+    mesh = _mesh()
+    tree = _tree(mesh)
+    ck = ShardedCheckpointer(str(tmp_path))
+    ck.save_async("t0", tree)
+    ck.wait()
+    out = ck.restore("t0", tree)
+    np.testing.assert_array_equal(np.asarray(out["a"]["w"]),
+                                  np.asarray(tree["a"]["w"]))
+    # ref handle waits for in-flight saves via ParamStore.sharded_ref
+    ck.save_async("t1", tree)
+    ref = ShardedCheckpointRef(ck, "t1")
+    ck.wait()
+    assert ref.exists()
+
+
+def test_param_store_integration(tmp_path):
+    store = ParamStore.from_uri(f"file://{tmp_path}/params")
+    mesh = _mesh()
+    tree = _tree(mesh)
+    assert store.save_sharded_async("ckpt-x", tree) is True
+    ref = store.sharded_ref("ckpt-x")
+    assert ref is not None and store.exists_sharded("ckpt-x")
+    out = ref.restore(tree)
+    np.testing.assert_array_equal(np.asarray(out["a"]["w"]),
+                                  np.asarray(tree["a"]["w"]))
+    # copy (the resume pre-seed) and unified delete
+    assert store.copy_sharded("ckpt-x", "ckpt-y")
+    assert store.exists_sharded("ckpt-y")
+    store.delete("ckpt-x")
+    assert not store.exists_sharded("ckpt-x")
+    # mem backend: cleanly reports no sharded support
+    mem = ParamStore.from_uri("mem://")
+    assert mem.save_sharded_async("k", tree) is False
+    assert mem.sharded_ref("k") is None
+
+
+def test_partial_checkpoint_is_loud(tmp_path):
+    import os
+
+    mesh = _mesh()
+    tree = _tree(mesh)
+    ck = ShardedCheckpointer(str(tmp_path))
+    ck.save("t0", tree)
+    d = ck._dir("t0")
+    victim = next(f for f in sorted(os.listdir(d))
+                  if f.startswith("L0000"))
+    os.unlink(os.path.join(d, victim))
+    with pytest.raises((ValueError, FileNotFoundError)):
+        ck.restore("t0", tree)
+
+
+
+
+def test_trial_resume_sharded_matches_blob(tmp_path):
+    """A preempted trial that checkpoints SHARDED resumes to the exact
+    same result as the whole-blob path (VERDICT r3 item 3)."""
+    from typing import Optional
+
+    from rafiki_tpu.advisor.base import make_advisor
+    from rafiki_tpu.model.base import BaseModel, TrainContext
+    from rafiki_tpu.model.knob import FixedKnob, PolicyKnob
+    from rafiki_tpu.store.meta_store import MetaStore
+    from rafiki_tpu.worker.train import TrainWorker
+
+    mesh = _mesh((8, 1))
+
+    class ShardedToy(BaseModel):
+        """w += 1 per epoch over a SHARDED device array; checkpoints
+        pass the live tree so sharded-capable stores use it."""
+
+        TASKS = ("IMAGE_CLASSIFICATION",)
+        FAIL_AT: Optional[int] = None
+
+        @staticmethod
+        def get_knob_config():
+            return {"max_epochs": FixedKnob(5),
+                    "share_params": PolicyKnob("SHARE_PARAMS")}
+
+        def __init__(self, **knobs):
+            super().__init__(**knobs)
+            self._w = None
+
+        def train(self, dataset_path, ctx=None):
+            ctx = ctx or TrainContext()
+            w = jax.device_put(jnp.zeros((16, 8), jnp.float32),
+                               NamedSharding(mesh, P("data")))
+            if ctx.shared_params is not None and \
+                    self.knobs.get("share_params"):
+                if hasattr(ctx.shared_params, "restore"):
+                    w = ctx.shared_params.restore({"w": w})["w"]
+                else:
+                    w = jnp.asarray(ctx.shared_params["w"])
+            epochs = max(1, round(5 * float(ctx.budget_scale)))
+            for epoch in range(epochs):
+                w = w + 1.0
+                self._w = w  # blob fallback calls dump_parameters()
+                if ctx.checkpoint is not None:
+                    ctx.checkpoint(self.dump_parameters,
+                                   frac_done=(epoch + 1) / epochs,
+                                   tree={"w": w})
+                if self.FAIL_AT is not None and epoch >= self.FAIL_AT:
+                    raise OSError("simulated preemption")
+
+        def evaluate(self, dataset_path):
+            return float(np.asarray(self._w).mean())
+
+        def predict(self, queries):
+            return [0 for _ in queries]
+
+        def dump_parameters(self):
+            return {"w": np.asarray(self._w)}
+
+        def load_parameters(self, params):
+            self._w = jnp.asarray(params["w"])
+
+    class Flaky(ShardedToy):
+        FAIL_AT = 2
+
+    def run_scenario(store):
+        meta = MetaStore(":memory:")
+        user = meta.create_user("u@x", "pw", "ADMIN")
+        model = meta.create_model(user["id"], "toy",
+                                  "IMAGE_CLASSIFICATION", "T", b"")
+        job = meta.create_train_job(user["id"], "app", 1,
+                                    "IMAGE_CLASSIFICATION",
+                                    {"TRIAL_COUNT": 1}, "tr", "va")
+        sub = meta.create_sub_train_job(job["id"], model["id"])
+
+        def worker(model_class, wid, trials):
+            return TrainWorker(
+                model_class=model_class,
+                advisor=make_advisor(model_class.get_knob_config(),
+                                     "random", total_trials=trials),
+                train_dataset_path="u", val_dataset_path="u",
+                param_store=store, meta_store=meta,
+                sub_train_job_id=sub["id"], model_id=model["id"],
+                worker_id=wid, checkpoint_interval_s=1e-9)
+
+        worker(Flaky, "w0", 1).run(max_trials=1)
+        w2 = worker(ShardedToy, "w1", 0)
+        assert w2.resume_orphaned_trials() == 1
+        done = [t for t in meta.get_trials_of_sub_train_job(sub["id"])
+                if t["status"] == "COMPLETED"]
+        assert len(done) == 1
+        return done[0]["score"]
+
+    blob_score = run_scenario(ParamStore.from_uri("mem://"))
+    sharded_store = ParamStore.from_uri(f"file://{tmp_path}/ps")
+    sharded_score = run_scenario(sharded_store)
+    assert sharded_score == blob_score == 5.0
+    # and the sharded path actually used the sharded store
+    root = sharded_store.sharded_checkpointer().root
+    import os
+    assert os.path.isdir(root)
+
+def test_manifests_identical_across_processes(tmp_path):
+    """File names come from the GLOBAL sharding, so every process plans
+    the identical manifest — no cross-host name collisions or
+    under-described shards (the multi-host disjoint-writer rule)."""
+    mesh = _mesh()
+    tree = _tree(mesh)
+    ck = ShardedCheckpointer(str(tmp_path))
+    plans = [ck._plan(tree) for _ in range(3)]
+    assert plans[0] == plans[1] == plans[2]
+    # the 2-D-sharded leaf enumerates all 8 global shards
+    w_entry = next(e for e in plans[0]["leaves"]
+                   if e["path"] == ["a", "w"])
+    assert len(w_entry["shards"]) == 8
+    files = [s["file"] for s in w_entry["shards"]]
+    assert len(set(files)) == 8  # unique, content-addressed names
+
+
+def test_ref_matches_probe(tmp_path):
+    mesh = _mesh()
+    tree = _tree(mesh)
+    ck = ShardedCheckpointer(str(tmp_path))
+    ck.save("t0", tree)
+    ref = ShardedCheckpointRef(ck, "t0")
+    assert ref.matches(tree)
+    wrong = dict(tree)
+    wrong["a"] = {"w": np.zeros((4, 4), np.float32), "e": tree["a"]["e"]}
+    assert not ref.matches(wrong)
+    assert not ShardedCheckpointRef(ck, "absent").matches(tree)
+
+
+def test_stale_async_error_does_not_escape_probes(tmp_path):
+    """A failed async save surfaces in wait() but NOT in presence
+    probes/cleanup (trial fault isolation: an earlier trial's disk
+    error must not kill an unrelated resume scan)."""
+    mesh = _mesh()
+    tree = _tree(mesh)
+    ck = ShardedCheckpointer(str(tmp_path))
+    ck._pending_error = OSError("disk full (parked)")
+    # quiet paths: no raise
+    assert ck.exists("whatever") is False
+    ck.delete("whatever")
+    assert ck.copy("a", "b") is False
+    store = ParamStore.from_uri(f"file://{tmp_path}/ps")
+    store.sharded_checkpointer()._pending_error = OSError("parked")
+    assert store.exists_sharded("x") is False
+    assert store.sharded_ref("x") is None
+    store.delete("x")  # must not raise
+    # the loud path still reports (fresh error)
+    ck._pending_error = OSError("disk full again")
+    with pytest.raises(OSError):
+        ck.wait()
