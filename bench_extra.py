@@ -325,7 +325,78 @@ def _child(out_path: str, budget: float, use_kv: bool) -> None:
         except Exception as e:  # noqa: BLE001
             _record(out_path, {"stage": "advisor_error",
                                "error": repr(e)[:300]})
+
+    if budget - (time.monotonic() - t_start) > 120:
+        try:
+            _bench_stream_search(out_path)
+        except Exception as e:  # noqa: BLE001
+            _record(out_path, {"stage": "stream_error",
+                               "error": repr(e)[:300]})
     _record(out_path, {"stage": "done"})
+
+
+def _bench_stream_search(out_path: str) -> None:
+    """BASELINE config #2 slice: BOHB search over ResNet shapes fed by
+    the STREAMING loader (constant-memory zip reads + augmentation) —
+    loader throughput and search outcome in one stage."""
+    import os
+    import tempfile
+
+    import jax
+
+    from rafiki_tpu.data.stream import (StreamingImageDataset,
+                                        generate_streaming_image_zip)
+    from rafiki_tpu.model import tune_model
+    from rafiki_tpu.models.resnet import ResNetClassifier
+
+    from rafiki_tpu.advisor import make_advisor
+    from rafiki_tpu.worker.train import TrainWorker
+
+    backend = jax.default_backend()
+    on_accel = backend not in ("cpu",)
+    n_imgs = 4096 if on_accel else 768
+    with tempfile.TemporaryDirectory() as d:
+        tr = f"{d}/train.zip"
+        va = f"{d}/val.zip"
+        generate_streaming_image_zip(tr, n_imgs, image_shape=(32, 32, 3),
+                                     n_classes=4, seed=0)
+        generate_streaming_image_zip(va, 256, image_shape=(32, 32, 3),
+                                     n_classes=4, seed=1)
+
+        # raw loader throughput first (decode + augment, 4 workers)
+        sds = StreamingImageDataset(tr)
+        t0 = time.monotonic()
+        n = sum(int(b["mask"].sum())
+                for b in sds.iter_batches(128, augment=True))
+        img_per_s = n / (time.monotonic() - t0)
+
+        # BOHB over ResNet with the shape knobs pinned to the bench
+        # budget (knob_overrides — the job-level pin mechanism); rung
+        # scheduling and the streaming feed are what's measured
+        n_trials = 3
+        advisor = make_advisor(ResNetClassifier.get_knob_config(),
+                               "bohb", total_trials=n_trials, seed=0)
+        worker = TrainWorker(
+            ResNetClassifier, advisor, tr, va,
+            knob_overrides={
+                "variant": "resnet18",
+                "width_mult": 1.0 if on_accel else 0.25,
+                "batch_size": 64 if on_accel else 32},
+            checkpoint_interval_s=0)
+        os.environ["RAFIKI_FORCE_STREAMING"] = "1"
+        try:
+            t0 = time.monotonic()
+            done = worker.run(max_trials=n_trials)
+            dt = time.monotonic() - t0
+        finally:
+            os.environ.pop("RAFIKI_FORCE_STREAMING", None)
+        best = advisor.best_effort
+        _record(out_path, {
+            "stage": "stream_search", "backend": backend,
+            "loader_img_per_s": img_per_s, "n_images": n_imgs,
+            "n_trials": done, "search_s": dt,
+            "trials_per_hour": done / dt * 3600.0,
+            "best_score": float(best.score) if best else -1.0})
 
 
 # ---------------------------------------------------------------- parent
@@ -348,6 +419,15 @@ def main() -> None:
     gen = next((r for r in records if r.get("stage") == "generation"), None)
     adv = next((r for r in records if r.get("stage") == "advisor"), None)
     pre = next((r for r in records if r.get("stage") == "prefill"), None)
+    ss = next((r for r in records if r.get("stage") == "stream_search"),
+              None)
+    if ss:
+        print(json.dumps({
+            "metric": "stream_bohb_trials_per_hour",
+            "value": round(ss["trials_per_hour"], 1),
+            "unit": "trials/hour", "backend": ss["backend"],
+            "loader_img_per_s": round(ss["loader_img_per_s"], 0),
+            "best_score": ss["best_score"]}))
     if pre:
         print(json.dumps({
             "metric": "prefill_speedup_chunked_vs_tokenwise",

@@ -61,11 +61,27 @@ class BatchNormCNNTemplate(BaseModel):
     def train(self, dataset_path: str,
               ctx: Optional[TrainContext] = None) -> None:
         ctx = ctx or TrainContext()
-        ds = load_image_classification_dataset(dataset_path)
-        self._n_classes = ds.n_classes
-        self._image_shape = ds.image_shape
-        x = self._prep(ds.images)
-        y = ds.labels
+        from rafiki_tpu.data.stream import (StreamingImageDataset,
+                                            should_stream)
+
+        # ImageNet-scale archives stream (constant host memory, worker-
+        # thread decode + crop/flip augmentation — BASELINE config #2);
+        # tuning-trial datasets keep the whole-array fast path
+        stream = (StreamingImageDataset.is_streamable(dataset_path)
+                  and should_stream(dataset_path))
+        if stream:
+            sds = StreamingImageDataset(dataset_path)
+            self._n_classes = sds.n_classes
+            self._image_shape = list(sds.image_shape)
+            n_samples = sds.n
+            x = np.zeros((1, *sds.image_shape), np.float32)  # shape probe
+        else:
+            ds = load_image_classification_dataset(dataset_path)
+            self._n_classes = ds.n_classes
+            self._image_shape = ds.image_shape
+            x = self._prep(ds.images)
+            y = ds.labels
+            n_samples = len(x)
 
         module = self._module()
         devices = ctx.devices or jax.local_devices()
@@ -101,7 +117,7 @@ class BatchNormCNNTemplate(BaseModel):
                               * float(ctx.budget_scale)))
         if self.knobs.get("quick_train"):
             epochs = min(epochs, 2)
-        steps_per_epoch = max(1, (len(x) + batch_size - 1) // batch_size)
+        steps_per_epoch = max(1, (n_samples + batch_size - 1) // batch_size)
         schedule = optax.cosine_decay_schedule(
             float(self.knobs["learning_rate"]), epochs * steps_per_epoch)
 
@@ -146,6 +162,21 @@ class BatchNormCNNTemplate(BaseModel):
                 params, batch_stats, opt_state, b["x"], b["y"], b["m"])
             return (params, batch_stats, opt_state), loss
 
+        def epoch_batches(epoch: int):
+            if stream:
+                # decode/augment on worker threads, prep per batch —
+                # host memory stays constant in dataset size
+                for b in sds.iter_batches(batch_size, epoch=epoch,
+                                          shuffle=True, seed=0,
+                                          augment=True):
+                    yield {"x": self._prep(b["x"]), "y": b["y"],
+                           "m": b["mask"].astype(np.float32)}
+            else:
+                for b in batch_iterator({"x": x, "y": y}, batch_size,
+                                        seed=epoch):
+                    yield {"x": b["x"], "y": b["y"],
+                           "m": b["mask"].astype(np.float32)}
+
         ctx.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
         # donation invalidates buffers that may alias self._vars (warm
         # start / re-train): drop the stale reference first
@@ -154,12 +185,7 @@ class BatchNormCNNTemplate(BaseModel):
             for epoch in range(epochs):
                 state = (params, batch_stats, opt_state)
                 (params, batch_stats, opt_state), mean_loss = train_epoch(
-                    step, state,
-                    ({"x": b["x"], "y": b["y"],
-                      "m": b["mask"].astype(np.float32)}
-                     for b in batch_iterator({"x": x, "y": y}, batch_size,
-                                             seed=epoch)),
-                    sharding=b_shard)
+                    step, state, epoch_batches(epoch), sharding=b_shard)
                 ctx.logger.log(epoch=epoch, loss=mean_loss)
                 if ctx.checkpoint is not None:
                     # preemption safety: worker throttles + persists
