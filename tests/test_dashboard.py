@@ -80,3 +80,98 @@ def test_dashboard_panels_and_endpoints(tmp_path):
         assert [d["name"] for d in datasets] == ["d1"]
     finally:
         app.stop()
+
+
+def test_dashboard_write_paths(tmp_path):
+    """VERDICT r3 item 9: model upload, dataset registration, train-job
+    create/stop, inference deploy/stop — the page's forms/buttons exist
+    AND the exact endpoints they call work end-to-end over HTTP."""
+    import base64
+
+    from rafiki_tpu.data import generate_image_classification_dataset
+
+    meta = MetaStore(str(tmp_path / "meta.db"))
+    manager = ServicesManager(meta, str(tmp_path), slot_size=1,
+                              platform="cpu",
+                              devices=[DeviceSpec(id=0)])
+    admin = Admin(meta, manager)
+    app = AdminApp(admin)
+    host, port = app.start()
+    base = f"http://{host}:{port}"
+    try:
+        with urllib.request.urlopen(base + "/", timeout=10) as resp:
+            html = resp.read().decode()
+        # the write-path UI is wired: forms + the endpoints they POST to
+        for control in ("nmUpload", "ndRegister", "njCreate", "niDeploy",
+                        "+ upload model", "+ register dataset",
+                        "+ new train job", "+ deploy inference job"):
+            assert control in html, control
+        for call in ('api("POST", "/models"', 'api("POST", "/datasets"',
+                     'api("POST", "/train_jobs"',
+                     'api("POST", "/inference_jobs"',
+                     "/stop`"):
+            assert call in html, call
+
+        token = json_request("POST", base + "/tokens",
+                             {"email": "superadmin@rafiki",
+                              "password": "rafiki"})["token"]
+        hdrs = {"Authorization": f"Bearer {token}"}
+
+        # 1) model upload — exactly the page's payload shape (b64 source)
+        src = (
+            "from rafiki_tpu.models.mlp import JaxFeedForward\n"
+            "class MyMLP(JaxFeedForward):\n"
+            "    pass\n")
+        model = json_request("POST", base + "/models", {
+            "name": "my-mlp", "task": "IMAGE_CLASSIFICATION",
+            "model_class": "MyMLP",
+            "model_bytes": base64.b64encode(src.encode()).decode()},
+            headers=hdrs)
+        assert model["name"] == "my-mlp"
+        assert [m["name"] for m in json_request(
+            "GET", base + "/models", headers=hdrs)] == ["my-mlp"]
+
+        # 2) dataset registration (train + val)
+        tr = str(tmp_path / "tr.npz")
+        va = str(tmp_path / "va.npz")
+        generate_image_classification_dataset(tr, 96, seed=0)
+        generate_image_classification_dataset(va, 32, seed=1)
+        ds_tr = json_request("POST", base + "/datasets",
+                             {"name": "tr", "task": "IMAGE_CLASSIFICATION",
+                              "uri": tr}, headers=hdrs)
+        ds_va = json_request("POST", base + "/datasets",
+                             {"name": "va", "task": "IMAGE_CLASSIFICATION",
+                              "uri": va}, headers=hdrs)
+
+        # 3) train job create (page body shape) … then stop from the UI
+        job = json_request("POST", base + "/train_jobs", {
+            "app": "ui-app", "task": "IMAGE_CLASSIFICATION",
+            "train_dataset_id": ds_tr["id"],
+            "val_dataset_id": ds_va["id"],
+            "budget": {"TRIAL_COUNT": 1},
+            "model_ids": [model["id"]]}, headers=hdrs)
+        assert job["status"] in ("RUNNING", "STARTED")
+        assert json_request("POST",
+                            base + f"/train_jobs/{job['id']}/stop",
+                            {}, headers=hdrs)["ok"]
+        stopped = json_request("GET", base + f"/train_jobs/{job['id']}",
+                               headers=hdrs)
+        assert stopped["status"] == "STOPPED"
+
+        # 4) inference deploy against a job with no completed trials
+        # answers with a structured error, not a 500 (the UI shows it)
+        try:
+            json_request("POST", base + "/inference_jobs",
+                         {"train_job_id": job["id"]}, headers=hdrs)
+            deployed = True
+        except RuntimeError as e:  # json_request wraps HTTP errors
+            deployed = False
+            assert "409" in str(e) or "400" in str(e), e
+        if deployed:  # (a trial may have completed before the stop)
+            ij = json_request("GET", base + "/inference_jobs",
+                              headers=hdrs)[0]
+            json_request("POST",
+                         base + f"/inference_jobs/{ij['id']}/stop",
+                         {}, headers=hdrs)
+    finally:
+        app.stop()
