@@ -1,0 +1,225 @@
+"""Database adapters: the MetaStore's SQL dialect seam.
+
+SURVEY.md §7 step 3 planned "SQLite first (swap to PostgreSQL...)";
+this module is that swap point (VERDICT r3 missing #6). The MetaStore
+writes ONE dialect of SQL — qmark (``?``) placeholders, SQLite-flavored
+DDL — and an adapter owns everything engine-specific: connections,
+placeholder style, DDL translation, duplicate-column detection for
+migrations, and row→dict conversion.
+
+``SqliteAdapter`` is the embedded default (single-host control plane on
+the TPU-VM — SURVEY §5.8(b)). ``PostgresAdapter`` carries the server-DB
+deployment: it translates placeholders/DDL and drives psycopg2, which
+is NOT in this image — constructing it without psycopg2 raises with
+install instructions, and its pure-string translation logic is unit
+tested without a server. New engines = one subclass.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence
+
+
+class Cursor:
+    """Uniform cursor result: mapping rows + rowcount."""
+
+    def __init__(self, rows: Optional[List[Dict[str, Any]]],
+                 rowcount: int) -> None:
+        self._rows = rows or []
+        self.rowcount = rowcount
+
+    def fetchone(self) -> Optional[Dict[str, Any]]:
+        return self._rows[0] if self._rows else None
+
+    def fetchall(self) -> List[Dict[str, Any]]:
+        return list(self._rows)
+
+
+class DatabaseAdapter:
+    """Engine-specific half of the MetaStore. The MetaStore calls only
+    these methods plus ``execute``; SQL it passes is qmark-style with
+    SQLite-flavored DDL, which each adapter translates as needed."""
+
+    def connect(self):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def execute(self, conn, sql: str, args: Sequence[Any] = (),
+                max_rows: Optional[int] = None) -> Cursor:
+        """Run one statement; ``max_rows`` bounds how many result rows
+        are materialized (None = all)."""
+        raise NotImplementedError
+
+    def commit(self, conn) -> None:
+        conn.commit()
+
+    def rollback(self, conn) -> None:
+        """Discard the open transaction after a FAILED statement —
+        without it the error leaks into the next caller's commit (and on
+        engines with strict transactions, poisons the connection)."""
+        try:
+            conn.rollback()
+        except Exception:  # noqa: BLE001 — a dead connection can't
+            pass           # rollback; the next execute reports it
+
+    def close(self, conn) -> None:
+        conn.close()
+
+    def init_schema(self, conn, schema_sql: str) -> None:
+        """Create tables (idempotent) + engine session setup."""
+        raise NotImplementedError
+
+    def try_migration(self, conn, ddl: str) -> bool:
+        """Run an ``ALTER TABLE ... ADD COLUMN``; False when the column
+        already exists (the no-op re-run), raise on anything else."""
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------- sqlite
+
+class SqliteAdapter(DatabaseAdapter):
+    def __init__(self, path: str) -> None:
+        self.path = path
+
+    def connect(self):
+        import sqlite3
+
+        conn = sqlite3.connect(self.path, check_same_thread=False)
+        conn.row_factory = sqlite3.Row
+        return conn
+
+    def execute(self, conn, sql: str, args: Sequence[Any] = (),
+                max_rows: Optional[int] = None) -> Cursor:
+        cur = conn.execute(sql, tuple(args))
+        if cur.description is None:
+            rows = None
+        elif max_rows is not None:
+            rows = [dict(r) for r in cur.fetchmany(max_rows)]
+        else:
+            rows = [dict(r) for r in cur.fetchall()]
+        return Cursor(rows, cur.rowcount)
+
+    def init_schema(self, conn, schema_sql: str) -> None:
+        if self.path != ":memory:":
+            conn.execute("PRAGMA journal_mode=WAL")
+        # cross-process writers: wait instead of instant 'database is
+        # locked' (the MetaStore lock only serializes one process)
+        conn.execute("PRAGMA busy_timeout=10000")
+        conn.execute("PRAGMA foreign_keys=ON")
+        conn.executescript(schema_sql)
+
+    def try_migration(self, conn, ddl: str) -> bool:
+        import sqlite3
+
+        try:
+            conn.execute(ddl)
+            return True
+        except sqlite3.OperationalError as e:
+            if "duplicate column" in str(e).lower():
+                return False  # already migrated — the no-op re-run
+            raise  # locked DB / real DDL failure must not be silent:
+            # running without the column breaks every later write
+
+
+# -------------------------------------------------------------- postgres
+
+def qmark_to_format(sql: str) -> str:
+    """``?`` placeholders → ``%s`` (psycopg2 paramstyle), leaving quoted
+    literals untouched."""
+    out: List[str] = []
+    in_str = False
+    for ch in sql:
+        if ch == "'":
+            in_str = not in_str
+            out.append(ch)
+        elif ch == "?" and not in_str:
+            out.append("%s")
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def sqlite_ddl_to_postgres(schema_sql: str) -> str:
+    """SQLite-flavored DDL → PostgreSQL: AUTOINCREMENT ids become
+    BIGSERIAL, BLOB becomes BYTEA, REAL becomes DOUBLE PRECISION."""
+    sql = re.sub(r"INTEGER PRIMARY KEY AUTOINCREMENT",
+                 "BIGSERIAL PRIMARY KEY", schema_sql)
+    sql = re.sub(r"\bBLOB\b", "BYTEA", sql)
+    sql = re.sub(r"\bREAL\b", "DOUBLE PRECISION", sql)
+    return sql
+
+
+class PostgresAdapter(DatabaseAdapter):
+    """MetaStore on a PostgreSQL server (multi-host control planes).
+
+    Translation is pure string work (unit-tested without a server); the
+    driver is psycopg2, imported lazily so the sqlite-only image never
+    needs it."""
+
+    def __init__(self, url: str) -> None:
+        try:
+            import psycopg2  # noqa: F401
+            import psycopg2.extras  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "PostgresAdapter needs psycopg2 (pip install "
+                "psycopg2-binary); this image ships sqlite-only — use a "
+                "path/sqlite:// url, or install the driver on the "
+                "control-plane host") from e
+        self.url = url
+
+    def connect(self):
+        import psycopg2
+        import psycopg2.extras
+
+        conn = psycopg2.connect(
+            self.url, cursor_factory=psycopg2.extras.RealDictCursor)
+        # autocommit: every MetaStore write is a single fenced statement
+        # (atomic on its own), reads must not pin an idle-in-transaction
+        # snapshot, and a failed statement must not abort a shared
+        # transaction that poisons every later call on this connection
+        conn.autocommit = True
+        return conn
+
+    def execute(self, conn, sql: str, args: Sequence[Any] = (),
+                max_rows: Optional[int] = None) -> Cursor:
+        with conn.cursor() as cur:
+            cur.execute(qmark_to_format(sql), tuple(args))
+            if cur.description is None:
+                rows = None
+            elif max_rows is not None:
+                rows = [dict(r) for r in cur.fetchmany(max_rows)]
+            else:
+                rows = [dict(r) for r in cur.fetchall()]
+            return Cursor(rows, cur.rowcount)
+
+    def commit(self, conn) -> None:
+        pass  # autocommit — see connect()
+
+    def rollback(self, conn) -> None:
+        pass  # autocommit: failed statements leave no open transaction
+
+    def init_schema(self, conn, schema_sql: str) -> None:
+        with conn.cursor() as cur:
+            cur.execute(sqlite_ddl_to_postgres(schema_sql))
+
+    def try_migration(self, conn, ddl: str) -> bool:
+        import psycopg2
+
+        try:
+            with conn.cursor() as cur:
+                cur.execute(sqlite_ddl_to_postgres(ddl))
+            return True
+        except psycopg2.errors.DuplicateColumn:
+            return False
+
+
+def adapter_for(url_or_path: str) -> DatabaseAdapter:
+    """``:memory:`` / a filesystem path / ``sqlite:///path`` → SQLite;
+    ``postgresql://...`` (or ``postgres://``) → PostgreSQL."""
+    u = str(url_or_path)
+    if u.startswith(("postgresql://", "postgres://")):
+        return PostgresAdapter(u)
+    if u.startswith("sqlite:///"):
+        return SqliteAdapter(u[len("sqlite:///"):] or ":memory:")
+    return SqliteAdapter(u)

@@ -16,7 +16,6 @@ import hashlib
 import hmac
 import json
 import os
-import sqlite3
 import threading
 import time
 import uuid
@@ -86,68 +85,78 @@ def _uid() -> str:
 class MetaStore:
     """Thread-safe CRUD over the system schema.
 
-    SQLite connections are per-instance with a process-wide write lock;
-    WAL mode keeps readers unblocked during writes.
+    The SQL here is one dialect (qmark placeholders, SQLite-flavored
+    DDL); everything engine-specific lives behind a
+    :class:`rafiki_tpu.store.db.DatabaseAdapter` — the swap point SURVEY
+    §7 planned ("SQLite first, swap to PostgreSQL"). ``db_path`` takes a
+    filesystem path / ``:memory:`` (embedded SQLite, the single-host
+    default — WAL mode keeps readers unblocked during writes) or a
+    ``postgresql://`` url for a server-DB control plane. One connection
+    per instance with a process-wide write lock.
     """
 
     def __init__(self, db_path: str = ":memory:") -> None:
+        from .db import adapter_for
+
         self._db_path = db_path
-        self._conn = sqlite3.connect(db_path, check_same_thread=False)
-        self._conn.row_factory = sqlite3.Row
+        self._adapter = adapter_for(db_path)
+        self._conn = self._adapter.connect()
         self._lock = threading.RLock()
         with self._lock:
-            if db_path != ":memory:":
-                self._conn.execute("PRAGMA journal_mode=WAL")
-            # cross-process writers: wait instead of instant 'database is
-            # locked' (the RLock only serializes writers in this instance)
-            self._conn.execute("PRAGMA busy_timeout=10000")
-            self._conn.execute("PRAGMA foreign_keys=ON")
-            self._conn.executescript(_SCHEMA)
+            self._adapter.init_schema(self._conn, _SCHEMA)
             # migrate pre-heartbeat databases (column added for
             # preemption-safe trials; no-op once present)
-            try:
-                self._conn.execute(
-                    "ALTER TABLE trials ADD COLUMN heartbeat_at REAL")
-            except sqlite3.OperationalError:
-                pass
-            try:
-                self._conn.execute(
-                    "ALTER TABLE trials ADD COLUMN error_class TEXT")
-            except sqlite3.OperationalError:
-                pass
-            else:
+            self._adapter.try_migration(
+                self._conn, "ALTER TABLE trials ADD COLUMN heartbeat_at "
+                            "REAL")
+            if self._adapter.try_migration(
+                    self._conn,
+                    "ALTER TABLE trials ADD COLUMN error_class TEXT"):
                 # column freshly added → pre-upgrade DB. Under the old
                 # semantics EVERY ERRORED row was resumable; backfill as
                 # preemption-class so recorded device losses keep their
                 # remaining budget instead of becoming unclaimable NULLs
-                self._conn.execute(
+                self._exec(
                     "UPDATE trials SET error_class='preemption' "
                     "WHERE status='ERRORED' AND error_class IS NULL")
-            self._conn.commit()
+            self._adapter.commit(self._conn)
 
     def close(self) -> None:
         with self._lock:
-            self._conn.close()
+            self._adapter.close(self._conn)
 
     # ---- low-level helpers ----
+    def _exec(self, sql: str, args: tuple = (), max_rows=None):
+        """Adapter-dispatched execute (caller holds the lock or is in
+        __init__); returns a uniform mapping-row cursor. A failed
+        statement rolls back so the error cannot leak into the next
+        caller's commit (or poison strict-transaction engines)."""
+        try:
+            return self._adapter.execute(self._conn, sql, args,
+                                         max_rows=max_rows)
+        except Exception:
+            self._adapter.rollback(self._conn)
+            raise
+
     def _insert(self, table: str, row: Dict[str, Any]) -> None:
         cols = ", ".join(row)
         ph = ", ".join("?" for _ in row)
         with self._lock:
-            self._conn.execute(
-                f"INSERT INTO {table} ({cols}) VALUES ({ph})",
-                tuple(row.values()))
-            self._conn.commit()
+            self._exec(f"INSERT INTO {table} ({cols}) VALUES ({ph})",
+                       tuple(row.values()))
+            self._adapter.commit(self._conn)
 
     def _update(self, table: str, row_id: str, fields: Dict[str, Any]) -> None:
         sets = ", ".join(f"{k}=?" for k in fields)
         with self._lock:
-            cur = self._conn.execute(
-                f"UPDATE {table} SET {sets} WHERE id=?",
-                (*fields.values(), row_id))
-            self._conn.commit()
+            cur = self._exec(f"UPDATE {table} SET {sets} WHERE id=?",
+                             (*fields.values(), row_id))
             if cur.rowcount == 0:
+                # nothing matched: discard rather than commit, so the
+                # KeyError contract implies nothing was written
+                self._adapter.rollback(self._conn)
                 raise KeyError(f"no {table} row {row_id!r}")
+            self._adapter.commit(self._conn)
 
     #: columns stored as JSON text, decoded on every read
     _JSON_COLS = ("knobs", "budget", "train_args", "config")
@@ -164,14 +173,13 @@ class MetaStore:
 
     def _one(self, sql: str, args: tuple = ()) -> Optional[Dict[str, Any]]:
         with self._lock:
-            cur = self._conn.execute(sql, args)
-            row = cur.fetchone()
+            row = self._exec(sql, args, max_rows=1).fetchone()
         return self._decode(dict(row)) if row else None
 
     def _all(self, sql: str, args: tuple = ()) -> List[Dict[str, Any]]:
         with self._lock:
-            cur = self._conn.execute(sql, args)
-            return [self._decode(dict(r)) for r in cur.fetchall()]
+            rows = self._exec(sql, args).fetchall()
+        return [self._decode(dict(r)) for r in rows]
 
     # ---- users ----
     def create_user(self, email: str, password: str,
@@ -349,12 +357,13 @@ class MetaStore:
         (this worker was presumed dead, e.g. a long VM suspend) — the
         caller must then NOT feed the score back to the advisor, or one
         trial_no gets double feedback."""
-        with self._lock, self._conn:
-            cur = self._conn.execute(
+        with self._lock:
+            cur = self._exec(
                 "UPDATE trials SET status='COMPLETED', score=?, "
                 "params_saved=?, stopped_at=? WHERE id=? "
                 "AND status='RUNNING'",
                 (score, int(params_saved), _now(), trial_id))
+            self._adapter.commit(self._conn)
             return cur.rowcount == 1
 
     def mark_trial_errored(self, trial_id: str, error: str,
@@ -368,12 +377,13 @@ class MetaStore:
         re-running it anywhere yields the same crash, so resume is
         forbidden and only the advisor's trial_errored accounting runs).
         """
-        with self._lock, self._conn:
-            cur = self._conn.execute(
+        with self._lock:
+            cur = self._exec(
                 "UPDATE trials SET status='ERRORED', error=?, "
                 "error_class=?, stopped_at=? "
                 "WHERE id=? AND status='RUNNING'",
                 (error[:4000], error_class, _now(), trial_id))
+            self._adapter.commit(self._conn)
             return cur.rowcount == 1
 
     def heartbeat_trial(self, trial_id: str) -> None:
@@ -401,8 +411,8 @@ class MetaStore:
         """
         cutoff = _now() - stale_after_s
         marker = f"resumed by {worker_id}"
-        with self._lock, self._conn:
-            cur = self._conn.execute(
+        with self._lock:
+            cur = self._exec(
                 "UPDATE trials SET status='TERMINATED', stopped_at=?, "
                 "error=(CASE WHEN error IS NULL OR error='' THEN ? "
                 "ELSE error || ? END) "
@@ -410,6 +420,7 @@ class MetaStore:
                 "error_class='preemption') OR (status='RUNNING' "
                 "AND COALESCE(heartbeat_at, started_at, 0) < ?))",
                 (_now(), marker, f" | {marker}", trial_id, cutoff))
+            self._adapter.commit(self._conn)
             return cur.rowcount == 1
 
     def get_trials_of_sub_train_job(
@@ -441,12 +452,12 @@ class MetaStore:
     def add_trial_log(self, trial_id: str, kind: str, data: Dict[str, Any],
                       t: Optional[float] = None) -> None:
         with self._lock:
-            self._conn.execute(
+            self._exec(
                 "INSERT INTO trial_logs (trial_id, time, kind, data) "
                 "VALUES (?,?,?,?)",
                 (trial_id, t if t is not None else _now(), kind,
                  json.dumps(data)))
-            self._conn.commit()
+            self._adapter.commit(self._conn)
 
     def get_trial_logs(self, trial_id: str) -> List[Dict[str, Any]]:
         rows = self._all(

@@ -153,3 +153,49 @@ def test_param_store_lru_cache_eviction():
     assert len(store._cache) == 2
     # evicted entries still load through the backend
     assert_params_equal(sample_params(), store.load("t0"))
+
+
+# ---- database adapter seam (SURVEY §7 "swap to PostgreSQL") ----
+
+def test_adapter_url_dispatch():
+    from rafiki_tpu.store.db import SqliteAdapter, adapter_for
+
+    assert isinstance(adapter_for(":memory:"), SqliteAdapter)
+    assert isinstance(adapter_for("/tmp/x.db"), SqliteAdapter)
+    a = adapter_for("sqlite:///tmp/y.db")
+    assert isinstance(a, SqliteAdapter) and a.path == "tmp/y.db"
+    # postgres urls route to the postgres adapter, which on this
+    # psycopg2-less image must fail LOUDLY with install guidance
+    import pytest as _pytest
+
+    with _pytest.raises(ImportError, match="psycopg2"):
+        adapter_for("postgresql://u:p@host/db")
+
+
+def test_postgres_sql_translation():
+    from rafiki_tpu.store.db import qmark_to_format, sqlite_ddl_to_postgres
+    from rafiki_tpu.store.meta_store import _SCHEMA
+
+    assert qmark_to_format("UPDATE t SET a=? WHERE id=?") == \
+        "UPDATE t SET a=%s WHERE id=%s"
+    # quoted literals keep their question marks
+    assert qmark_to_format("SELECT '?' , a FROM t WHERE b=?") == \
+        "SELECT '?' , a FROM t WHERE b=%s"
+    ddl = sqlite_ddl_to_postgres(_SCHEMA)
+    assert "AUTOINCREMENT" not in ddl
+    assert "BIGSERIAL PRIMARY KEY" in ddl
+    assert "BLOB" not in ddl and "BYTEA" in ddl
+    assert " REAL" not in ddl
+
+
+def test_meta_store_accepts_sqlite_url(tmp_path):
+    from rafiki_tpu.store.meta_store import MetaStore
+
+    m = MetaStore(f"sqlite:///{tmp_path}/via_url.db")
+    u = m.create_user("a@b", "pw", "ADMIN")
+    assert m.get_user(u["id"])["email"] == "a@b"
+    m.close()
+    # file landed where the url said
+    import os
+
+    assert os.path.exists(f"{tmp_path}/via_url.db")
