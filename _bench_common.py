@@ -44,17 +44,57 @@ def read_records(out_path: str) -> List[dict]:
 
 
 def run_child(script: str, out_path: str, budget: float,
-              env: dict, extra_args: Optional[List[str]] = None) -> None:
+              env: dict, extra_args: Optional[List[str]] = None,
+              kill_on_timeout: bool = True) -> None:
     """Run ``script --child out_path <child_budget> [extra]`` with a hard
     wall-clock timeout; the child's own soft budget is a bit shorter so
-    it can skip late stages instead of being killed mid-stage."""
+    it can skip late stages instead of being killed mid-stage.
+
+    ``kill_on_timeout=False`` ABANDONS an overdue child instead of
+    killing it: a child blocked claiming the TPU tunnel must never be
+    SIGKILLed — a killed claimant leaves a stale server-side lease that
+    can poison the tunnel for the NEXT claimant (observed: ~25-min
+    blocked claims ending UNAVAILABLE for the rest of a session). The
+    orphan exits on its own when its claim resolves or fails; its stage
+    file is disposable."""
     args = [sys.executable, os.path.abspath(script), "--child", out_path,
             str(max(10.0, budget - 15.0))] + list(extra_args or ())
+    proc = subprocess.Popen(args, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
     try:
-        subprocess.run(args, timeout=budget, env=env,
-                       stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        proc.wait(timeout=budget)
     except subprocess.TimeoutExpired:
-        pass
+        if kill_on_timeout:
+            proc.kill()
+            proc.wait()
+        else:
+            # deprioritize the orphan: if its claim later resolves it
+            # would otherwise run the full accel bench concurrently with
+            # the CPU fallback on this 1-core box and depress the
+            # fallback's measured throughput. (A skewed-low fallback is
+            # still preferred over SIGKILLing a claimant.)
+            try:
+                os.setpriority(os.PRIO_PROCESS, proc.pid, 19)
+            except (OSError, AttributeError):
+                pass
+    return proc  # caller may poll() to reap an abandoned child
+
+
+def _sweep_stale_stage_files(out_path: str) -> None:
+    """Abandoned accelerator children may recreate their stage files
+    after the parent exits; collect day-old leftovers of the same
+    naming scheme so they never accumulate."""
+    import glob
+    import time
+
+    base = os.path.dirname(os.path.abspath(out_path)) or "."
+    prefix = os.path.basename(out_path).split("_stages_")[0]
+    for f in glob.glob(os.path.join(base, f"{prefix}_stages_*.jsonl*")):
+        try:
+            if time.time() - os.path.getmtime(f) > 86400:
+                os.unlink(f)
+        except OSError:
+            pass
 
 
 def run_with_cpu_fallback(script: str, out_path: str, deadline: float,
@@ -64,13 +104,19 @@ def run_with_cpu_fallback(script: str, out_path: str, deadline: float,
                           extra_args: Optional[List[str]] = None,
                           ) -> tuple:
     """Accelerator child first, CPU-pinned rerun if it produced nothing
-    useful. Returns (records, fallback_used)."""
-    try:
-        os.unlink(out_path)
-    except OSError:
-        pass
-    run_child(script, out_path, max(30.0, deadline - fallback_reserve),
-              dict(os.environ), extra_args)
+    useful. Returns (records, fallback_used). The accelerator child is
+    abandoned (not killed) on timeout — see :func:`run_child` — so the
+    CPU rerun writes to its own file; records merge from both."""
+    _sweep_stale_stage_files(out_path)
+    cpu_path = out_path + ".cpu"
+    for p in (out_path, cpu_path):
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+    accel = run_child(script, out_path,
+                      max(30.0, deadline - fallback_reserve),
+                      dict(os.environ), extra_args, kill_on_timeout=False)
     records = read_records(out_path)
     fallback_used = False
     if need_rerun(records):
@@ -79,12 +125,14 @@ def run_with_cpu_fallback(script: str, out_path: str, deadline: float,
             fallback_used = True
             env = dict(os.environ)
             env["RAFIKI_JAX_PLATFORM"] = "cpu"
-            run_child(script, out_path, left, env, extra_args)
-            records = read_records(out_path)
-    try:
-        os.unlink(out_path)
-    except OSError:
-        pass
+            run_child(script, cpu_path, left, env, extra_args)
+            records = records + read_records(cpu_path)
+    for p in (out_path, cpu_path):
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+    accel.poll()  # reap if the abandoned child exited meanwhile
     return records, fallback_used
 
 

@@ -1,0 +1,42 @@
+"""Bench harness: the deadline parent must ABANDON an overdue
+accelerator child, never kill it (a SIGKILLed TPU claimant leaves a
+stale lease that poisons the tunnel for later claimants)."""
+
+import os
+import sys
+import textwrap
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from _bench_common import read_records, run_child  # noqa: E402
+
+
+def test_overdue_child_is_abandoned_not_killed(tmp_path):
+    script = tmp_path / "fake_bench.py"
+    script.write_text(textwrap.dedent("""
+        import json, sys, time
+        if sys.argv[1] == "--child":
+            with open(sys.argv[2], "a") as f:
+                f.write(json.dumps({"stage": "probe"}) + "\\n")
+            time.sleep(60)  # a blocked tunnel claim
+            with open(sys.argv[2], "a") as f:
+                f.write(json.dumps({"stage": "late"}) + "\\n")
+    """))
+    out = str(tmp_path / "stages.jsonl")
+    proc = run_child(str(script), out, budget=6.0, env=dict(os.environ),
+                     kill_on_timeout=False)
+    # the parent's wait returned, but the child is STILL RUNNING
+    assert proc.poll() is None, "abandoned child was killed"
+    records = read_records(out)
+    assert [r["stage"] for r in records] == ["probe"]
+    proc.kill()  # test cleanup only — not a TPU claimant
+    proc.wait()
+
+
+def test_kill_on_timeout_still_available(tmp_path):
+    script = tmp_path / "fake_bench.py"
+    script.write_text("import time, sys; time.sleep(20)")
+    proc = run_child(str(script), str(tmp_path / "o.jsonl"), budget=1.0,
+                     env=dict(os.environ), kill_on_timeout=True)
+    assert proc.poll() is not None  # killed and reaped

@@ -2,6 +2,8 @@
 
 import urllib.request
 
+import pytest
+
 from rafiki_tpu.admin.admin import Admin
 from rafiki_tpu.admin.app import AdminApp
 from rafiki_tpu.admin.services_manager import ServicesManager
@@ -82,6 +84,7 @@ def test_dashboard_panels_and_endpoints(tmp_path):
         app.stop()
 
 
+@pytest.mark.slow
 def test_dashboard_write_paths(tmp_path):
     """VERDICT r3 item 9: model upload, dataset registration, train-job
     create/stop, inference deploy/stop — the page's forms/buttons exist

@@ -305,6 +305,7 @@ def test_chunked_prefill_matches_tokenwise(trained):
     assert chunked.stats["prefill_tokens"] >= 18
 
 
+@pytest.mark.slow
 def test_sampling_determinism_and_knobs(trained):
     """Seeded sampling is a pure function of (seed, position): identical
     across runs, across steps_per_sync, and across batch composition;
@@ -360,6 +361,7 @@ def test_sampling_determinism_and_knobs(trained):
     np.testing.assert_array_equal(np.asarray(tiny_p), np.asarray(greedy))
 
 
+@pytest.mark.slow
 def test_sampled_tokens_respect_top_k(trained):
     """With top_k=2 every sampled token must be one of the two highest-
     probability tokens at its step (checked by replaying the model)."""

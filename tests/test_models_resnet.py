@@ -16,6 +16,7 @@ TINY = {"variant": "resnet18", "width_mult": 0.25, "batch_size": 32,
         "bf16": False, "quick_train": False, "share_params": False}
 
 
+@pytest.mark.slow
 def test_resnet_module_shapes_bottleneck():
     m = ResNet(stage_sizes=(1, 1, 1, 1), bottleneck=True, width=8,
                n_classes=7, small_inputs=True)

@@ -17,6 +17,7 @@ TINY = {"patch_size": 4, "hidden_dim": 96, "depth": 2, "n_heads": 4,
         "quick_train": False, "share_params": False}
 
 
+@pytest.mark.slow
 def test_vit_module_shapes():
     m = ViT(patch_size=4, hidden_dim=64, depth=2, n_heads=4, mlp_dim=128,
             n_classes=7)

@@ -195,6 +195,7 @@ def test_patch_embed_grads():
                                    atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_flash_attention_grads_causal_multiblock():
     # >1 query and key block so the bwd kernels' causal start/stop logic
     # and cross-block accumulation are exercised
@@ -216,6 +217,7 @@ def test_flash_attention_grads_causal_multiblock():
                                    atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_flash_attention_varlen_grads_multiblock_and_empty():
     # kv_lens spanning block boundaries plus a zero-length example: the
     # LSE_MASKED path must produce exactly-zero grads, never NaN

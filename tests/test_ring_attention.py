@@ -23,8 +23,11 @@ def _mesh(n):
     return Mesh(np.array(jax.devices()[:n]), ("sp",))
 
 
-@pytest.mark.parametrize("n_ring,causal", [(1, False), (4, False),
-                                           (4, True), (8, True)])
+@pytest.mark.parametrize("n_ring,causal", [
+    (1, False),  # the quick default-suite exactness check
+    pytest.param(4, False, marks=pytest.mark.slow),
+    pytest.param(4, True, marks=pytest.mark.slow),
+    pytest.param(8, True, marks=pytest.mark.slow)])
 def test_ring_matches_dense(n_ring, causal):
     s = 64  # global sequence, divides every ring size
     q = _rand(2, 2, s, 16, key=0)
@@ -39,6 +42,7 @@ def test_ring_matches_dense(n_ring, causal):
     assert tuple(out.sharding.spec) == (None, None, "sp", None)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_grads_match_dense(causal):
     # ring size 2: the VJP's reverse ring is fully exercised at any ring
@@ -87,6 +91,7 @@ def test_ring_bf16_long_sequence_under_jit():
                                np.asarray(ref), atol=3e-2, rtol=3e-2)
 
 
+@pytest.mark.slow
 def test_ring_2d_mesh_dp_times_sp():
     """dp × sp: batch sharded over 'data', sequence over 'sp' — the
     2-D long-context layout. Output keeps both shardings."""
@@ -128,6 +133,7 @@ def test_ring_local_block_is_streamed_not_materialized():
         "ring backward materializes an (L/P)^2 score block"
 
 
+@pytest.mark.slow
 def test_ring_backward_residuals_are_o_seq_over_p():
     """The training backward must NOT retain the rotated K/V of every
     ring step (P copies = the whole global K/V per device — the naive

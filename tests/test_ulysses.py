@@ -38,6 +38,7 @@ def test_ulysses_matches_dense(n_par, causal):
     assert tuple(out.sharding.spec) == (None, None, "sp", None)
 
 
+@pytest.mark.slow
 def test_ulysses_grads_match_dense():
     s, h = 32, 8
     q = _rand(1, h, s, 16, key=3)

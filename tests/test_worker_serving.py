@@ -127,6 +127,7 @@ def test_predict_end_to_end_inproc(trained, datasets):
             th.join(timeout=5)
 
 
+@pytest.mark.slow
 def test_predict_end_to_end_kv(trained, datasets):
     from rafiki_tpu.native import KVServer
 
