@@ -45,7 +45,7 @@ def read_records(out_path: str) -> List[dict]:
 
 def run_child(script: str, out_path: str, budget: float,
               env: dict, extra_args: Optional[List[str]] = None,
-              kill_on_timeout: bool = True) -> None:
+              kill_on_timeout: bool = True) -> "subprocess.Popen":
     """Run ``script --child out_path <child_budget> [extra]`` with a hard
     wall-clock timeout; the child's own soft budget is a bit shorter so
     it can skip late stages instead of being killed mid-stage.
@@ -56,7 +56,8 @@ def run_child(script: str, out_path: str, budget: float,
     can poison the tunnel for the NEXT claimant (observed: ~25-min
     blocked claims ending UNAVAILABLE for the rest of a session). The
     orphan exits on its own when its claim resolves or fails; its stage
-    file is disposable."""
+    file is disposable. Returns the child's Popen either way — callers
+    of the abandon path poll() it later to reap."""
     args = [sys.executable, os.path.abspath(script), "--child", out_path,
             str(max(10.0, budget - 15.0))] + list(extra_args or ())
     proc = subprocess.Popen(args, env=env, stdout=subprocess.DEVNULL,

@@ -221,6 +221,11 @@ class Llama(nn.Module):
     # f32 compute; templates pass bf16 on TPU (f32 matmuls lower to
     # ~3x-cost multi-pass bf16 on the MXU).
     dtype: Any = None
+    # gradient checkpointing per decoder block (train path only — the
+    # decode path carries a mutable cache and recomputation would
+    # double-write it): ~1/3 more FLOPs for O(depth) less activation
+    # HBM. Identical math.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, ids: jnp.ndarray, lens: Optional[jnp.ndarray] = None,
@@ -235,10 +240,16 @@ class Llama(nn.Module):
                      name="tok_embed")(ids)
         if self.dtype is not None:
             x = x.astype(self.dtype)
+        block_cls = _DecoderBlock
+        if self.remat and not decode:
+            # decode stays static under remat (python-level branch in
+            # the attention), so mark it non-traced — flax passes the
+            # module itself as arg 0, putting decode at index 4
+            block_cls = nn.remat(_DecoderBlock, static_argnums=(4,))
         for i in range(self.depth):
-            x = _DecoderBlock(self.n_heads, self.n_kv_heads, self.mlp_dim,
-                              self.max_len, self.lora_rank,
-                              name=f"block_{i}")(x, lens, positions, decode)
+            x = block_cls(self.n_heads, self.n_kv_heads, self.mlp_dim,
+                          self.max_len, self.lora_rank,
+                          name=f"block_{i}")(x, lens, positions, decode)
         x = RMSNorm(name="final_norm")(x)
         return LoRADense(self.vocab_size, 0, name="lm_head")(x)
 
@@ -350,6 +361,9 @@ class LlamaLoRA(BaseModel):
             "learning_rate": FloatKnob(1e-4, 3e-2, is_exp=True),
             "batch_size": CategoricalKnob([8, 16, 32], shape_relevant=True),
             "bf16": CategoricalKnob([True, False]),
+            # gradient checkpointing (train path): bigger batches for
+            # ~1/3 extra FLOPs when activations are HBM-bound
+            "remat": FixedKnob(False),
             "quick_train": PolicyKnob("QUICK_TRAIN"),
             "share_params": PolicyKnob("SHARE_PARAMS"),
             # serving-quality runs: a trained byte-BPE artifact
@@ -387,7 +401,8 @@ class LlamaLoRA(BaseModel):
                      depth=int(k["depth"]), n_heads=heads,
                      n_kv_heads=kv_heads, mlp_dim=4 * hd,
                      lora_rank=int(k["lora_rank"]),
-                     dtype=self._dtype())
+                     dtype=self._dtype(),
+                     remat=bool(k.get("remat", False)))
 
     def _dtype(self):
         # single source of truth for the bf16 knob → compute dtype

@@ -30,7 +30,8 @@ STEP_GFLOP_PER_SAMPLE = 52.8
 PEAK_TFLOPS_BF16 = 197.0  # v5e
 
 
-def time_step(bs: int, dtype, attn: str, iters: int = 20) -> dict:
+def time_step(bs: int, dtype, attn: str, iters: int = 20,
+              remat: bool = False) -> dict:
     if attn == "xla":
         orig = vit_mod.flash_attention
         vit_mod.flash_attention = (
@@ -39,7 +40,7 @@ def time_step(bs: int, dtype, attn: str, iters: int = 20) -> dict:
     try:
         module = vit_mod.ViT(patch_size=16, hidden_dim=768, depth=12,
                              n_heads=12, mlp_dim=3072, n_classes=1000,
-                             dtype=dtype)
+                             dtype=dtype, remat=remat)
         tx = optax.adam(1e-3)
         img = jnp.zeros((bs, 224, 224, 3), jnp.bfloat16)
         lbl = jnp.zeros((bs,), jnp.int32)
@@ -70,6 +71,7 @@ def time_step(bs: int, dtype, attn: str, iters: int = 20) -> dict:
         sps = bs * iters / dt
         mfu = sps * STEP_GFLOP_PER_SAMPLE / 1e3 / PEAK_TFLOPS_BF16
         return {"bs": bs, "dtype": str(dtype), "attn": attn,
+                "remat": remat,
                 "samples_per_s": round(sps, 1), "mfu_pct": round(100 * mfu, 1),
                 "compile_s": round(compile_s, 1)}
     finally:
@@ -82,10 +84,16 @@ def main() -> None:
     sizes = [int(a) for a in sys.argv[1:]] or [64]
     out = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), ".tune_vit_tpu.jsonl")
+    configs = [(jnp.bfloat16, "pallas", False), (jnp.bfloat16, "xla", False),
+               (None, "pallas", False)]
     for bs in sizes:
-        for dtype, attn in ((jnp.bfloat16, "pallas"), (jnp.bfloat16, "xla"),
-                            (None, "pallas")):
-            r = time_step(bs, dtype, attn)
+        cfgs = list(configs)
+        if bs == max(sizes):
+            # remat at the biggest batch: where activation HBM binds,
+            # rematerialization may net out faster via utilization
+            cfgs.append((jnp.bfloat16, "pallas", True))
+        for dtype, attn, remat in cfgs:
+            r = time_step(bs, dtype, attn, remat=remat)
             line = json.dumps(r)
             print(line, flush=True)
             with open(out, "a") as f:  # survive parent timeouts

@@ -6,12 +6,15 @@ import os
 import sys
 import textwrap
 
+import pytest
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from _bench_common import read_records, run_child  # noqa: E402
 
 
+@pytest.mark.slow  # pays a real multi-second abandonment deadline
 def test_overdue_child_is_abandoned_not_killed(tmp_path):
     script = tmp_path / "fake_bench.py"
     script.write_text(textwrap.dedent("""

@@ -16,7 +16,8 @@ from rafiki_tpu.models.llama_lora import (Llama, LlamaLoRA, greedy_generate,
 TINY = {"max_epochs": 6, "vocab_size": 1 << 14, "hidden_dim": 64,
         "depth": 2, "n_heads": 4, "kv_ratio": 2, "lora_rank": 4,
         "max_len": 32, "model_parallel": 2, "learning_rate": 1e-2,
-        "batch_size": 16, "bf16": False, "quick_train": False,
+        "batch_size": 16, "bf16": False, "remat": False,
+        "quick_train": False,
         "share_params": False, "tokenizer_path": "", "pretrained_path": ""}
 
 
@@ -217,3 +218,36 @@ def test_fsdp_bounds_per_device_memory_at_1b():
     assert worst <= total / 8 * 1.1, (worst, total)
     assert worst >= total / 8 * 0.9
     del params
+
+
+def test_remat_identical_math_and_decode_unaffected():
+    """Llama remat: identical train-path outputs/grads; the decode path
+    (mutable cache) never rematerializes and still generates the same
+    tokens."""
+    kw = dict(vocab_size=128, max_len=16, hidden_dim=32, depth=2,
+              n_heads=4, n_kv_heads=2, mlp_dim=64, lora_rank=2)
+    plain = Llama(**kw)
+    remat = Llama(**kw, remat=True)
+    ids = np.ones((2, 8), np.int32)
+    params = plain.init(jax.random.PRNGKey(0), ids)["params"]
+
+    np.testing.assert_allclose(
+        np.asarray(plain.apply({"params": params}, ids)),
+        np.asarray(remat.apply({"params": params}, ids)),
+        atol=1e-6, rtol=1e-6)
+
+    def loss(m):
+        return lambda p: jnp.sum(
+            m.apply({"params": p}, ids).astype(jnp.float32) ** 2)
+
+    for a, b in zip(
+            jax.tree_util.tree_leaves(jax.grad(loss(plain))(params)),
+            jax.tree_util.tree_leaves(jax.grad(loss(remat))(params))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+
+    prompts = np.asarray([[1, 5, 9], [1, 7, 0]], np.int32)
+    lens = np.asarray([3, 2], np.int32)
+    np.testing.assert_array_equal(
+        np.asarray(greedy_generate(plain, params, prompts, lens, 4)),
+        np.asarray(greedy_generate(remat, params, prompts, lens, 4)))

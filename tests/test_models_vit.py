@@ -14,6 +14,7 @@ from rafiki_tpu.models.vit import ViT, ViTBase16
 TINY = {"patch_size": 4, "hidden_dim": 96, "depth": 2, "n_heads": 4,
         "batch_size": 32, "max_epochs": 5, "learning_rate": 1e-3,
         "weight_decay": 1e-4, "warmup_frac": 0.1, "bf16": False,
+        "remat": False,
         "quick_train": False, "share_params": False}
 
 
@@ -92,3 +93,37 @@ def test_vit_v1_checkpoint_prep_compat():
     assert np.isclose(m2._prep(white).max(), 1.0)
     assert np.isclose(m2._prep(np.zeros((1, 8, 8, 3), np.uint8)).min(), 0.0)
     assert m2.dump_parameters()["meta"]["prep_version"] == 1
+
+
+def test_remat_identical_math_smaller_residuals():
+    """remat=True must change NOTHING numerically (same outputs, same
+    grads from the same params) while rematerializing block activations
+    in the backward instead of saving them."""
+    import jax.numpy as jnp
+
+    kw = dict(patch_size=4, hidden_dim=64, depth=3, n_heads=4,
+              mlp_dim=128, n_classes=5)
+    plain = ViT(**kw)
+    remat = ViT(**kw, remat=True)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 16, 16, 3))
+    params = plain.init(jax.random.PRNGKey(1), x)["params"]
+
+    def loss(m):
+        return lambda p: jnp.sum(
+            m.apply({"params": p}, x).astype(jnp.float32) ** 2)
+
+    np.testing.assert_allclose(
+        np.asarray(plain.apply({"params": params}, x)),
+        np.asarray(remat.apply({"params": params}, x)),
+        atol=1e-6, rtol=1e-6)
+    g_plain = jax.grad(loss(plain))(params)
+    g_remat = jax.grad(loss(remat))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_plain),
+                    jax.tree_util.tree_leaves(g_remat)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+    # the rematerialized backward actually carries checkpoint markers
+    jaxpr = str(jax.make_jaxpr(jax.grad(loss(remat)))(params))
+    assert "remat" in jaxpr or "checkpoint" in jaxpr
+    assert "remat" not in str(
+        jax.make_jaxpr(jax.grad(loss(plain)))(params))
