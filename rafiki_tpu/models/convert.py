@@ -44,7 +44,10 @@ def hf_name_for(path: Tuple[str, ...]) -> Optional[Tuple[str, bool]]:
     None for leaves that have no checkpoint counterpart (LoRA adapters).
     Raises on paths that look importable but match no rule — silent
     drops would load a half-initialized model."""
-    if path[-1] in ("lora_a", "lora_b"):
+    if path[-1] in ("lora_a", "lora_b") or "moe" in path:
+        # LoRA adapters and MoE routers/experts have no counterpart in
+        # an HF dense-Llama checkpoint — they keep their init (and the
+        # trainable mask trains them)
         return None
     joined = "/".join(path)
     if joined == "tok_embed/embedding":

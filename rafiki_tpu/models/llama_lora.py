@@ -54,7 +54,13 @@ BOS_ID = 1  # reuse bert's CLS slot as BOS
 #: Megatron-style tensor-parallel rules: column-parallel projections split
 #: the output dim, row-parallel ones the input dim → one all-reduce per
 #: attention/MLP block. Keys match LoRADense instance names below.
-TP_RULES = {"wq": -1, "wk": -1, "wv": -1, "gate": -1, "up": -1,
+#: "experts" shards stacked MoE expert weights on their EXPERT dim —
+#: expert parallelism: each model-axis device owns E/mp experts and XLA
+#: schedules the token all-to-all around them (ops/moe.py).
+#: NOTE: first matching rule wins and "gate"/"up"/"down" are substrings
+#: of the stacked expert names — "experts" must stay first.
+TP_RULES = {"experts": 0,
+            "wq": -1, "wk": -1, "wv": -1, "gate": -1, "up": -1,
             "wo": 0, "down": 0, "lm_head": -1, "tok_embed": -1}
 
 
@@ -192,6 +198,7 @@ class _DecoderBlock(nn.Module):
     mlp_dim: int
     max_len: int
     lora_rank: int
+    n_experts: int = 0  # >0 → MoE FFN (expert-parallel, ops/moe.py)
 
     @nn.compact
     def __call__(self, x, lens, positions, decode):
@@ -199,6 +206,11 @@ class _DecoderBlock(nn.Module):
             self.n_heads, self.n_kv_heads, self.max_len, self.lora_rank,
             name="attn")(RMSNorm()(x), lens, positions, decode)
         y = RMSNorm()(x)
+        if self.n_experts > 0:
+            from rafiki_tpu.ops.moe import MoEFeedForward
+
+            return x + MoEFeedForward(self.n_experts, self.mlp_dim,
+                                      name="moe")(y)
         gate = LoRADense(self.mlp_dim, self.lora_rank, name="gate")(y)
         up = LoRADense(self.mlp_dim, self.lora_rank, name="up")(y)
         y = nn.silu(gate) * up  # SwiGLU
@@ -226,6 +238,11 @@ class Llama(nn.Module):
     # double-write it): ~1/3 more FLOPs for O(depth) less activation
     # HBM. Identical math.
     remat: bool = False
+    # >0 replaces every block's dense FFN with a top-1-routed MoE of
+    # this many experts (ops/moe.py); expert weights shard over the
+    # mesh's `model` axis (expert parallelism). The train step picks up
+    # the load-balancing aux via mutable=["losses"].
+    n_experts: int = 0
 
     @nn.compact
     def __call__(self, ids: jnp.ndarray, lens: Optional[jnp.ndarray] = None,
@@ -249,6 +266,7 @@ class Llama(nn.Module):
         for i in range(self.depth):
             x = block_cls(self.n_heads, self.n_kv_heads, self.mlp_dim,
                           self.max_len, self.lora_rank,
+                          n_experts=self.n_experts,
                           name=f"block_{i}")(x, lens, positions, decode)
         x = RMSNorm(name="final_norm")(x)
         return LoRADense(self.vocab_size, 0, name="lm_head")(x)
@@ -275,13 +293,17 @@ def lm_loss_terms(logits: jnp.ndarray, ids: jnp.ndarray,
 
 
 def lora_trainable_mask(params: Any) -> Any:
-    """True for LoRA adapters, norms and the LM head; False (frozen) for
-    base kernels and the embedding — the LoRA fine-tuning recipe."""
+    """True for LoRA adapters, norms, the LM head, and MoE layers;
+    False (frozen) for base kernels and the embedding — the LoRA
+    fine-tuning recipe. MoE routers/experts have no pretrained base (no
+    HF Llama checkpoint carries them — convert.py leaves them at init),
+    so freezing them would inject a random frozen transform into every
+    residual stream; they always train."""
 
     def trainable(kp, _) -> bool:
         path = "/".join(str(getattr(k, "key", k)) for k in kp).lower()
         # lower(): flax auto-names unnamed instances "RMSNorm_0" etc.
-        return ("lora_" in path or "norm" in path
+        return ("lora_" in path or "norm" in path or "/moe/" in path
                 or path.startswith("lm_head"))
 
     return jax.tree_util.tree_map_with_path(trainable, params)
@@ -364,6 +386,9 @@ class LlamaLoRA(BaseModel):
             # gradient checkpointing (train path): bigger batches for
             # ~1/3 extra FLOPs when activations are HBM-bound
             "remat": FixedKnob(False),
+            # >0 → MoE FFN with this many experts per block (expert
+            # parallelism over the mesh's model axis; ops/moe.py)
+            "moe_experts": FixedKnob(0),
             "quick_train": PolicyKnob("QUICK_TRAIN"),
             "share_params": PolicyKnob("SHARE_PARAMS"),
             # serving-quality runs: a trained byte-BPE artifact
@@ -402,7 +427,8 @@ class LlamaLoRA(BaseModel):
                      n_kv_heads=kv_heads, mlp_dim=4 * hd,
                      lora_rank=int(k["lora_rank"]),
                      dtype=self._dtype(),
-                     remat=bool(k.get("remat", False)))
+                     remat=bool(k.get("remat", False)),
+                     n_experts=int(k.get("moe_experts", 0)))
 
     def _dtype(self):
         # single source of truth for the bf16 knob → compute dtype
@@ -450,6 +476,15 @@ class LlamaLoRA(BaseModel):
         module = self._module()
         devices = ctx.devices or jax.local_devices()
         mesh = self._mesh(devices)
+        n_experts = int(self.knobs.get("moe_experts", 0))
+        if n_experts and n_experts % mesh.shape[MODEL_AXIS]:
+            # fail fast: an indivisible expert count would silently fall
+            # through the "experts" TP rule to the dense gate/up/down
+            # rules — a mixed tensor-parallel regime instead of expert
+            # parallelism, with a different collective/memory profile
+            raise ValueError(
+                f"moe_experts={n_experts} must be divisible by the "
+                f"mesh's model axis ({mesh.shape[MODEL_AXIS]})")
         b_shard = batch_sharding(mesh)
 
         n_data = mesh.shape[DATA_AXIS]
@@ -528,12 +563,18 @@ class LlamaLoRA(BaseModel):
         opt_state = tx.init(params)
 
         # donate the param/opt trees: in-place update, no per-step copies
+        from rafiki_tpu.ops.moe import MOE_AUX_COEF, moe_aux_loss
+
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def train_step(params, opt_state, ib, lb, mask):
             def loss_fn(p):
-                logits = module.apply({"params": p}, ib, lens=lb)
+                # mutable=["losses"]: MoE blocks sow their load-balance
+                # aux there; dense models sow nothing and aux is 0
+                logits, muts = module.apply({"params": p}, ib, lens=lb,
+                                            mutable=["losses"])
                 total, count = lm_loss_terms(logits, ib, lb, mask)
-                return total / jnp.maximum(count, 1.0)
+                return (total / jnp.maximum(count, 1.0)
+                        + MOE_AUX_COEF * moe_aux_loss(muts))
 
             loss, grads = jax.value_and_grad(loss_fn)(params)
             updates, opt_state = tx.update(grads, opt_state, params)

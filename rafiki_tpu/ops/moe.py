@@ -1,0 +1,156 @@
+"""Mixture-of-Experts layer with expert parallelism — the ``ep`` leg.
+
+The reference stack has no MoE; a TPU framework needs one because
+expert parallelism is how modern LMs scale parameter count without
+scaling per-token FLOPs, and its sharding story is TPU-shaped: experts
+live sharded across the mesh and tokens travel to their experts over
+ICI. Design (the Shazeer/GShard recipe, XLA-first):
+
+- **Static shapes via capacity.** Each expert processes exactly
+  ``capacity = ceil(tokens/E · capacity_factor)`` slots per batch.
+  Routing builds DISPATCH/COMBINE tensors (one-hot over (expert,
+  slot)), so expert selection is two einsums on the MXU — no gather/
+  scatter, no dynamic shapes, nothing XLA can't tile. Overflowing
+  tokens are dropped (combine weight 0 → they pass through the
+  residual stream untouched), the standard capacity trade.
+- **Top-1 (switch) routing** with the load-balancing auxiliary loss
+  from the Switch Transformer: ``E · Σ_e fraction_e · prob_e``,
+  minimized at uniform routing. The aux loss is returned via a flax
+  ``"losses"`` collection so any host module can pick it up with
+  ``mutable=["losses"]``.
+- **Expert parallelism by annotation:** expert weights are stacked
+  ``(E, …)`` arrays; shard dim 0 over the mesh's ``model`` axis
+  (``TP_RULES``-style rules match ``"experts"``) and XLA partitions
+  the dispatch einsums into the all-to-all + local-expert-compute
+  schedule — the same "annotate, let the compiler insert collectives"
+  contract every other layer here uses.
+- Router math in f32 regardless of compute dtype (softmax over logits
+  is precision-sensitive; standard practice).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+#: standard weight on the load-balancing aux loss in the train
+#: objective (the Switch Transformer default) — one definition so the
+#: template, dryrun, and benches can't drift
+MOE_AUX_COEF = 0.01
+
+
+def router_dispatch(logits: jnp.ndarray, capacity: int
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Top-1 capacity routing from ``(T, E)`` router logits.
+
+    Returns ``(dispatch, combine, aux)``:
+    - ``dispatch``: (T, E, C) one-hot — token t occupies slot c of
+      expert e (0 rows for dropped/overflow tokens);
+    - ``combine``: (T, E, C) — dispatch scaled by the token's router
+      probability (the gradient path back into the router);
+    - ``aux``: scalar load-balancing loss (Switch Transformer form).
+
+    Position within an expert's capacity is assigned by ARRIVAL ORDER
+    (cumsum over the token axis), the deterministic static-shape
+    classic. All math is one-hot matmul/cumsum — MXU/VPU friendly,
+    no sorts, no dynamic shapes.
+    """
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # (T, E)
+    expert = jnp.argmax(probs, axis=-1)                          # (T,)
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)        # (T, E)
+
+    # slot index of each token within its expert = how many earlier
+    # tokens chose the same expert
+    position = jnp.cumsum(onehot, axis=0) * onehot - onehot      # (T, E)
+    keep = position < capacity                                   # (T, E)
+    onehot_kept = onehot * keep
+    pos_idx = position.astype(jnp.int32)                         # (T, E)
+    slot = jax.nn.one_hot(pos_idx, capacity,
+                          dtype=jnp.float32)                     # (T,E,C)
+    dispatch = onehot_kept[..., None] * slot                     # (T,E,C)
+
+    gate = jnp.sum(probs * onehot_kept, axis=-1)                 # (T,)
+    combine = dispatch * gate[:, None, None]
+
+    # load balance: fraction of tokens routed to e × mean router prob
+    # for e, scaled by E — equals 1 at perfectly uniform routing
+    fraction = jnp.mean(onehot, axis=0)
+    prob_mean = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(fraction * prob_mean)
+    return dispatch, combine, aux
+
+
+class MoEFeedForward(nn.Module):
+    """Switch-style MoE FFN: top-1 routed SwiGLU experts.
+
+    Drop-in for a dense FFN over ``(B, S, D)`` activations. Expert
+    weights are stacked ``(E, ...)``; shard dim 0 over the mesh's
+    ``model`` axis for expert parallelism (``"experts"`` matches the
+    Llama ``TP_RULES`` naming contract). Aux loss lands in the
+    ``"losses"`` collection under ``"moe_aux"``.
+    """
+
+    n_experts: int
+    mlp_dim: int
+    capacity_factor: float = 1.25
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, s, d = x.shape
+        e, h = self.n_experts, self.mlp_dim
+        t = b * s
+        capacity = max(1, int(-(-t * self.capacity_factor // e)))
+        xf = x.reshape(t, d)
+
+        # router in f32 (precision-sensitive softmax over logits)
+        wr = self.param("router", nn.initializers.normal(0.02), (d, e))
+        logits = xf.astype(jnp.float32) @ wr.astype(jnp.float32)
+        dispatch, combine, aux = router_dispatch(logits, capacity)
+        self.sow("losses", "moe_aux", aux)
+
+        # stacked expert SwiGLU weights — dim 0 is the EXPERT axis the
+        # mesh shards (expert parallelism): XLA turns the dispatch
+        # einsums into all-to-all + per-device expert compute
+        init = nn.initializers.lecun_normal()
+        w_gate = self.param("experts_gate", init, (e, d, h))
+        w_up = self.param("experts_up", init, (e, d, h))
+        w_down = self.param("experts_down", init, (e, h, d))
+
+        cdt = x.dtype if self.dtype is None else self.dtype
+        # tokens → expert slots (one-hot matmul, not scatter)
+        slots = jnp.einsum("td,tec->ecd", xf.astype(jnp.float32),
+                           dispatch).astype(cdt)          # (E, C, D)
+        gate = jnp.einsum("ecd,edh->ech", slots, w_gate.astype(cdt))
+        up = jnp.einsum("ecd,edh->ech", slots, w_up.astype(cdt))
+        out = jnp.einsum("ech,ehd->ecd", nn.silu(gate) * up,
+                         w_down.astype(cdt))              # (E, C, D)
+        # expert slots → tokens, weighted by router prob; dropped
+        # tokens get exact zeros (residual stream carries them)
+        y = jnp.einsum("ecd,tec->td", out.astype(jnp.float32),
+                       combine)
+        return y.reshape(b, s, d).astype(x.dtype)
+
+
+def moe_aux_loss(mutated_collections: dict) -> jnp.ndarray:
+    """Sum every sown ``moe_aux`` scalar from a ``mutable=["losses"]``
+    apply — the term the train loss adds (scaled by ~1e-2)."""
+    total = jnp.asarray(0.0, jnp.float32)
+    losses = mutated_collections.get("losses", {})
+
+    def visit(node):
+        nonlocal total
+        if isinstance(node, dict):
+            for k, v in node.items():
+                if k == "moe_aux":
+                    for leaf in jax.tree_util.tree_leaves(v):
+                        total = total + jnp.asarray(leaf, jnp.float32)
+                else:
+                    visit(v)
+
+    visit(losses)
+    return total
