@@ -2,12 +2,15 @@
 
 from .mesh import (SubMesh, SubMeshAllocator, partition_devices,
                    submesh_env_vars)
+from .pipeline import (PIPE_AXIS, pipeline_apply, pipeline_oracle,
+                       stack_stage_params)
 from .sharding import (DATA_AXIS, MODEL_AXIS, batch_sharding, make_mesh,
                        param_shardings, replicate_tree, replicated,
                        shard_batch)
 
 __all__ = [
     "SubMesh", "SubMeshAllocator", "partition_devices", "submesh_env_vars",
+    "PIPE_AXIS", "pipeline_apply", "pipeline_oracle", "stack_stage_params",
     "DATA_AXIS", "MODEL_AXIS", "batch_sharding", "make_mesh",
     "param_shardings", "replicate_tree", "replicated", "shard_batch",
 ]

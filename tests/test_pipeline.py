@@ -1,0 +1,141 @@
+"""Pipeline parallelism (parallel/pipeline.py): exactness vs the
+sequential oracle, gradients through the reverse pipeline, transformer-
+block stages, remat, and composition with a data axis."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from rafiki_tpu.parallel.pipeline import (pipeline_apply, pipeline_oracle,
+                                          stack_stage_params)
+
+
+def _mesh(n, name="pipe"):
+    return Mesh(np.array(jax.devices()[:n]), (name,))
+
+
+def _dense_stage(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _dense_stack(n_stages, d, key=0):
+    ks = jax.random.split(jax.random.PRNGKey(key), n_stages)
+    per_stage = [{"w": jax.random.normal(k, (d, d)) / np.sqrt(d),
+                  "b": jnp.zeros((d,))} for k in ks]
+    return per_stage, stack_stage_params(per_stage)
+
+
+@pytest.mark.parametrize("n_stages,n_micro", [(2, 4), (4, 4), (4, 8),
+                                              (8, 8), (4, 1)])
+def test_pipeline_matches_sequential(n_stages, n_micro):
+    d = 16
+    per_stage, stacked = _dense_stack(n_stages, d)
+    x = jax.random.normal(jax.random.PRNGKey(9), (n_micro, 4, d))
+    mesh = _mesh(n_stages)
+    out = pipeline_apply(_dense_stage, stacked, x, mesh)
+    ref = pipeline_oracle(_dense_stage, per_stage, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("remat", [False, True])
+def test_pipeline_grads_match_sequential(remat):
+    n_stages, n_micro, d = 4, 4, 8
+    per_stage, stacked = _dense_stack(n_stages, d, key=3)
+    x = jax.random.normal(jax.random.PRNGKey(4), (n_micro, 2, d))
+    mesh = _mesh(n_stages)
+
+    def loss_pipe(stacked):
+        y = pipeline_apply(_dense_stage, stacked, x, mesh, remat=remat)
+        return jnp.sum(y.astype(jnp.float32) ** 2)
+
+    def loss_ref(stacked):
+        per = [jax.tree_util.tree_map(lambda a: a[i], stacked)
+               for i in range(n_stages)]
+        y = pipeline_oracle(_dense_stage, per, x)
+        return jnp.sum(y.astype(jnp.float32) ** 2)
+
+    g_pipe = jax.grad(loss_pipe)(stacked)
+    g_ref = jax.grad(loss_ref)(stacked)
+    for a, b in zip(jax.tree_util.tree_leaves(g_pipe),
+                    jax.tree_util.tree_leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_pipeline_transformer_stages():
+    """Stages can be real transformer blocks: per-stage flax params,
+    stacked, pipelined — output equals running the blocks in order."""
+    from flax import linen as nn
+
+    class Block(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            y = nn.LayerNorm()(x)
+            y = nn.Dense(x.shape[-1] * 2)(y)
+            y = nn.gelu(y)
+            return x + nn.Dense(x.shape[-1])(y)
+
+    block = Block()
+    d, n_stages, n_micro = 8, 4, 4
+    x = jax.random.normal(jax.random.PRNGKey(0), (n_micro, 2, 6, d))
+    per_stage = [block.init(jax.random.PRNGKey(i), x[0])["params"]
+                 for i in range(n_stages)]
+    stacked = stack_stage_params(per_stage)
+
+    def stage_fn(p, h):
+        return block.apply({"params": p}, h)
+
+    mesh = _mesh(n_stages)
+    out = pipeline_apply(stage_fn, stacked, x, mesh)
+    ref = pipeline_oracle(stage_fn, per_stage, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_composes_with_data_axis():
+    """pipe × data 2-D mesh: each microbatch's BATCH dim sharded over
+    `data` (batch_axis), stages over `pipe` — both shardings at once,
+    same math, and the output keeps the data sharding."""
+    devs = np.array(jax.devices()[:8], dtype=object).reshape(4, 2)
+    mesh = Mesh(devs, ("pipe", "data"))
+    d, n_micro = 8, 4
+    per_stage, stacked = _dense_stack(4, d, key=7)
+    x = jax.random.normal(jax.random.PRNGKey(8), (n_micro, 4, d))
+    out = pipeline_apply(_dense_stage, stacked, x, mesh, axis="pipe",
+                         batch_axis="data")
+    ref = pipeline_oracle(_dense_stage, per_stage, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+    assert tuple(out.sharding.spec)[:2] == (None, "data")
+
+
+def test_pipeline_rejects_wrong_stage_count():
+    per_stage, stacked = _dense_stack(8, 8)  # 8 stages, 4-device axis
+    mesh = _mesh(4)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 2, 8))
+    with pytest.raises(ValueError, match="leading dim"):
+        pipeline_apply(_dense_stage, stacked, x, mesh)
+
+
+def test_stage_params_actually_sharded():
+    """Each pipe device holds only its stage's weights (dim-0 sharding),
+    not a replica of the whole stack."""
+    n_stages, d = 4, 16
+    _, stacked = _dense_stack(n_stages, d)
+    mesh = _mesh(n_stages)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 2, d))
+    # pipeline_apply device_puts internally; replicate that placement
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    w = jax.device_put(stacked["w"], NamedSharding(mesh, P("pipe")))
+    shard_bytes = {sh.device: sh.data.nbytes
+                   for sh in w.addressable_shards}
+    total = np.asarray(w).nbytes
+    assert all(b == total // n_stages for b in shard_bytes.values())
+    # and the pipelined result is still correct under that placement
+    out = pipeline_apply(_dense_stage, stacked, x, mesh)
+    assert np.isfinite(np.asarray(out)).all()
