@@ -183,6 +183,11 @@ class KVClient:
         DEL/recreation until it fires — see kv_server.cc."""
         self._cmd("EXPIRE", key, seconds)
 
+    def ttl(self, key: str) -> int:
+        """Redis semantics: -2 missing key, -1 no expiry, else whole
+        seconds remaining."""
+        return int(self._cmd("TTL", key))
+
     def brpop(self, keys, timeout: float
               ) -> Optional[Tuple[str, bytes]]:
         """Blocking tail-pop across ``keys``; None on timeout."""

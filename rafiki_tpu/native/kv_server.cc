@@ -10,7 +10,7 @@
 //
 // Commands: PING, SET, GET, DEL, EXISTS, KEYS <glob>, INCR,
 //           LPUSH, RPUSH, BRPOP <key...> <timeout_s>, LPOP, LLEN,
-//           EXPIRE <key> <seconds>, FLUSHALL, SHUTDOWN.
+//           EXPIRE <key> <seconds>, TTL <key>, FLUSHALL, SHUTDOWN.
 //
 // EXPIRE delta vs Redis: the TTL survives key deletion/recreation until
 // it fires. That is deliberate — the predictor sets a TTL on each
@@ -158,6 +158,21 @@ std::string Execute(std::vector<std::string>& args) {
     g_store.lists.clear();
     g_store.ttl.clear();
     return "+OK\r\n";
+  }
+  if (cmd == "TTL" && args.size() == 2) {
+    // redis semantics: -2 missing key, -1 no expiry, else seconds left
+    // (rounded UP, like redis). A key DEL'd while its TTL survives
+    // (the kvd reply-queue deviation) reports -2 here — the armed TTL
+    // is an internal condemnation, not key liveness.
+    std::lock_guard<std::mutex> l(g_store.mu);
+    bool exists = g_store.kv.count(args[1]) || g_store.lists.count(args[1]);
+    if (!exists) return Int(-2);
+    auto it = g_store.ttl.find(args[1]);
+    if (it == g_store.ttl.end()) return Int(-1);
+    auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                  it->second - std::chrono::steady_clock::now())
+                  .count();
+    return Int(ms <= 0 ? 0 : (ms + 999) / 1000);
   }
   if (cmd == "EXPIRE" && args.size() == 3) {
     double secs = strtod(args[2].c_str(), nullptr);

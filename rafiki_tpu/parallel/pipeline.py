@@ -47,24 +47,31 @@ def stack_stage_params(per_stage: Sequence[Any]) -> Any:
         lambda *leaves: jnp.stack(leaves), *per_stage)
 
 
-def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
-                   stacked_params: Any, x_micro: jnp.ndarray, mesh,
+def pipeline_apply(stage_fn: Callable[[Any, Any], Any],
+                   stacked_params: Any, x_micro: Any, mesh,
                    axis: str = PIPE_AXIS, batch_axis: str = None,
-                   remat: bool = False) -> jnp.ndarray:
+                   remat: bool = False) -> Any:
     """Run ``y_m = stage_{S-1}(… stage_0(x_m))`` for every microbatch.
 
-    ``stage_fn(params_slice, x) -> y`` is one stage (activation shapes
-    preserved); ``stacked_params`` has leading dim S == the ``axis``
-    size on every leaf (one stage per pipe device); ``x_micro`` is
-    ``(M, batch, …)`` microbatched input. ``batch_axis`` names a second
-    mesh axis to shard each microbatch's batch dim over (pipe × data).
-    Returns ``(M, batch, …)`` outputs with the input's shardings.
-    Differentiable end-to-end.
+    ``stage_fn(params_slice, x) -> y`` is one stage; ``stacked_params``
+    has leading dim S == the ``axis`` size on every leaf (one stage per
+    pipe device); ``x_micro`` is a PYTREE whose every leaf has leading
+    microbatch dim M — real models thread (hidden, positions, mask, …)
+    through the pipe as a tuple/dict activation. The activation
+    structure must be preserved by every stage (shapes too).
+    ``batch_axis`` names a second mesh axis to shard each leaf's dim 1
+    (the batch dim) over (pipe × data). Returns the ``(M, …)`` output
+    pytree with the input's shardings. Differentiable end-to-end.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     n_stages = mesh.shape[axis]
-    m_micro = x_micro.shape[0]
+    x_leaves = jax.tree_util.tree_leaves(x_micro)
+    m_micro = x_leaves[0].shape[0]
+    for leaf in x_leaves:
+        if leaf.shape[0] != m_micro:
+            raise ValueError("all activation leaves must share the "
+                             "leading microbatch dim")
     for leaf in jax.tree_util.tree_leaves(stacked_params):
         if leaf.shape[0] != n_stages:
             # the per-device strip below keeps exactly ONE stage slice;
@@ -74,22 +81,32 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
                 f"mesh[{axis!r}] size {n_stages} (one stage per pipe "
                 "device)")
     body = jax.checkpoint(stage_fn) if remat else stage_fn
+    tmap = jax.tree_util.tree_map
 
     def stage_spec(leaf):
         return P(axis, *([None] * (leaf.ndim - 1)))
 
-    param_specs = jax.tree_util.tree_map(stage_spec, stacked_params)
-    x_spec = P(None, batch_axis, *([None] * (x_micro.ndim - 2)))
+    param_specs = tmap(stage_spec, stacked_params)
+
+    def act_spec(leaf):
+        # dim 0 = microbatch (never sharded), dim 1 = batch (sharded
+        # over batch_axis when present); rank-1 leaves (per-microbatch
+        # scalars/masks) have no batch dim to shard
+        if leaf.ndim < 2:
+            return P(*([None] * leaf.ndim))
+        return P(None, batch_axis, *([None] * (leaf.ndim - 2)))
+
+    x_specs = tmap(act_spec, x_micro)
 
     @functools.partial(
         shard_map_kernels, mesh=mesh,
-        in_specs=(param_specs, x_spec), out_specs=x_spec)
+        in_specs=(param_specs, x_specs), out_specs=x_specs)
     def _pipeline(params_local, x_all):
         s = jax.lax.axis_index(axis)
         # local stage weights: strip the sharded singleton stage dim
-        p_stage = jax.tree_util.tree_map(lambda a: a[0], params_local)
-        act0 = jnp.zeros_like(x_all[0])
-        out0 = jnp.zeros_like(x_all)
+        p_stage = tmap(lambda a: a[0], params_local)
+        act0 = tmap(lambda a: jnp.zeros_like(a[0]), x_all)
+        out0 = tmap(jnp.zeros_like, x_all)
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
         def tick(carry, t):
@@ -97,37 +114,45 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
             # previous stage's activation arrives over the ring; stage 0
             # injects the t-th microbatch instead (clip: bubble ticks
             # recompute a stale microbatch whose result is never used)
-            inbound = jax.lax.ppermute(act, axis, perm)
+            inbound = tmap(lambda a: jax.lax.ppermute(a, axis, perm),
+                           act)
             feed_idx = jnp.clip(t, 0, m_micro - 1)
-            feed = jnp.where(
-                s == 0,
-                jax.lax.dynamic_index_in_dim(x_all, feed_idx, 0,
-                                             keepdims=False),
-                inbound)
+            feed = tmap(
+                lambda xs, inb: jnp.where(
+                    s == 0,
+                    jax.lax.dynamic_index_in_dim(xs, feed_idx, 0,
+                                                 keepdims=False),
+                    inb), x_all, inbound)
             y = body(p_stage, feed)
             # the LAST stage finishes microbatch t-(S-1) at tick t
             emit = t - (n_stages - 1)
             idx = jnp.clip(emit, 0, m_micro - 1)
-            cur = jax.lax.dynamic_index_in_dim(out, idx, 0,
-                                               keepdims=False)
-            val = jnp.where((emit >= 0) & (s == n_stages - 1), y, cur)
-            out = jax.lax.dynamic_update_index_in_dim(out, val, idx, 0)
+            is_emit = (emit >= 0) & (s == n_stages - 1)
+
+            def emit_leaf(o, yl):
+                cur = jax.lax.dynamic_index_in_dim(o, idx, 0,
+                                                   keepdims=False)
+                val = jnp.where(is_emit, yl, cur)
+                return jax.lax.dynamic_update_index_in_dim(o, val, idx,
+                                                           0)
+
+            out = tmap(emit_leaf, out, y)
             return (y, out), None
 
         (_, out), _ = jax.lax.scan(tick, (act0, out0),
                                    jnp.arange(m_micro + n_stages - 1))
         # result lives on the last stage; the masked psum replicates it
         # (every other stage contributes zeros)
-        return jax.lax.psum(
-            jnp.where(s == n_stages - 1, out, jnp.zeros_like(out)),
-            axis)
+        return tmap(
+            lambda o: jax.lax.psum(
+                jnp.where(s == n_stages - 1, o, jnp.zeros_like(o)),
+                axis), out)
 
-    shard = NamedSharding(mesh, x_spec)
-    p_shard = jax.tree_util.tree_map(
-        lambda spec: NamedSharding(mesh, spec), param_specs)
-    stacked_params = jax.tree_util.tree_map(jax.device_put,
-                                            stacked_params, p_shard)
-    return _pipeline(stacked_params, jax.device_put(x_micro, shard))
+    x_shard = tmap(lambda spec: NamedSharding(mesh, spec), x_specs)
+    p_shard = tmap(lambda spec: NamedSharding(mesh, spec), param_specs)
+    stacked_params = tmap(jax.device_put, stacked_params, p_shard)
+    x_micro = tmap(jax.device_put, x_micro, x_shard)
+    return _pipeline(stacked_params, x_micro)
 
 
 def pipeline_oracle(stage_fn, per_stage_params: Sequence[Any],

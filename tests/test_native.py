@@ -123,3 +123,17 @@ def test_expire_survives_del_and_recreate(client):
     client.ping()
     assert client.llen("q:preds:q1") == 0
     assert not client.exists("q:preds:q1")
+
+
+def test_ttl_introspection(client):
+    assert client.ttl("nope") == -2          # missing key
+    client.set("immortal", b"v")
+    assert client.ttl("immortal") == -1      # no expiry
+    client.expire("immortal", 30)
+    assert client.ttl("immortal") == 30      # rounds UP, like redis
+    # a DEL'd key reports missing even while its TTL survives
+    # internally (the reply-queue condemnation deviation)
+    client.set("gone", b"v")
+    client.expire("gone", 100)
+    client.delete("gone")
+    assert client.ttl("gone") == -2
