@@ -30,6 +30,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_tune.add_argument("--profile", metavar="DIR", default=None,
                         help="write a jax.profiler trace per trial to DIR")
 
+    p_bpe = sub.add_parser(
+        "bpe-train",
+        help="train a byte-level BPE tokenizer artifact from a corpus "
+             "(for LlamaLoRA's tokenizer_path knob)")
+    p_bpe.add_argument("corpus", help="UTF-8 text file (or .jsonl with "
+                                      "'text' fields) to learn merges from")
+    p_bpe.add_argument("out", help="artifact path, e.g. bpe.json")
+    p_bpe.add_argument("--vocab", type=int, default=8192,
+                       help="target vocab size (specials + 256 bytes + "
+                            "merges)")
+
     _register_service_commands(sub)
 
     args = parser.parse_args(argv)
@@ -58,6 +69,37 @@ def main(argv: Optional[List[str]] = None) -> int:
                             profile_dir=args.profile)
         print(f"best_score={result.best_score:.4f} "
               f"best_knobs={result.best_knobs}")
+        return 0
+    if args.cmd == "bpe-train":
+        import json
+
+        from .data.bpe import ByteBPETokenizer
+
+        is_jsonl = args.corpus.endswith(".jsonl")
+
+        def lines():
+            # format by EXTENSION, not per-line sniffing: a plain-text
+            # corpus may legitimately contain JSON-looking lines, and a
+            # .jsonl metadata row must not leak '{"'-style punctuation
+            # into the merge table
+            with open(args.corpus, encoding="utf-8") as f:
+                for line in f:
+                    if not is_jsonl:
+                        yield line
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    text = rec.get("text") if isinstance(rec, dict) \
+                        else None
+                    if isinstance(text, str):  # skip metadata/null rows
+                        yield text
+
+        tok = ByteBPETokenizer.train(lines(), vocab_size=args.vocab)
+        tok.save(args.out)
+        print(f"vocab_size={tok.vocab_size} merges={len(tok.merges)} "
+              f"-> {args.out}")
         return 0
     return _run_service_command(args)
 

@@ -71,3 +71,45 @@ def test_unknown_bytes_never_fail(tok):
 def test_vocab_floor():
     with pytest.raises(ValueError):
         ByteBPETokenizer.train(CORPUS, vocab_size=N_SPECIAL + 255)
+
+
+def test_cli_bpe_train(tmp_path):
+    """`rafiki-tpu bpe-train` produces a loadable artifact from a plain
+    corpus AND from .jsonl (text fields)."""
+    from rafiki_tpu.cli import main
+
+    plain = tmp_path / "c.txt"
+    plain.write_text("\n".join(CORPUS))
+    out = str(tmp_path / "bpe.json")
+    assert main(["bpe-train", str(plain), out, "--vocab", "300"]) == 0
+    tok = ByteBPETokenizer.load(out)
+    assert tok.vocab_size <= 300 and len(tok.merges) > 0
+    assert tok.decode(tok.encode_ids("the fox")) == "the fox"
+
+    jl = tmp_path / "c.jsonl"
+    jl.write_text('{"n_classes": 2}\n'
+                  '{"text": "alpha beta gamma", "label": 0}\n'
+                  '{"text": "beta gamma delta", "label": 1}\n')
+    out2 = str(tmp_path / "bpe2.json")
+    assert main(["bpe-train", str(jl), out2, "--vocab", "280"]) == 0
+    tok2 = ByteBPETokenizer.load(out2)
+    assert tok2.decode(tok2.encode_ids("alpha beta")) == "alpha beta"
+
+
+def test_cli_bpe_train_jsonl_skips_metadata(tmp_path):
+    """.jsonl training must not learn merges from metadata rows' JSON
+    punctuation or from null text fields."""
+    from rafiki_tpu.cli import main
+
+    jl = tmp_path / "c.jsonl"
+    jl.write_text('{"n_classes": 2}\n'
+                  '{"text": null, "label": 0}\n'
+                  + "".join('{"text": "aaaa bbbb cccc", "label": 1}\n'
+                            for _ in range(8)))
+    out = str(tmp_path / "bpe.json")
+    assert main(["bpe-train", str(jl), out, "--vocab", "280"]) == 0
+    tok = ByteBPETokenizer.load(out)
+    joined = "|".join(tok.decode([i])
+                      for i in range(259, tok.vocab_size))
+    assert "{" not in joined and "None" not in joined
+    assert "aaaa" in joined  # real text was learned
