@@ -166,21 +166,37 @@ class ShardedCheckpointer:
 
     def save(self, name: str, tree: Any,
              owns: Optional[Callable[[Any], bool]] = None,
-             process_index: Optional[int] = None) -> int:
+             process_index: Optional[int] = None,
+             sync_fn: Optional[Callable[[str], None]] = None) -> int:
         """Write ``tree`` streaming (one shard on host at a time);
         returns bytes written BY THIS PROCESS.
 
         ``owns(shard) -> bool`` selects which device shards this process
         writes (default: addressable replica-0 shards). ``process_index``
         defaults to ``jax.process_index()``; only process 0 writes
-        host-array leaves and the manifest."""
+        host-array leaves and the manifest.
+
+        In a MULTI-PROCESS runtime the save self-fences: ``sync_fn(tag)``
+        defaults to ``jax.experimental.multihost_utils.
+        sync_global_devices`` (pass your own to override). Three
+        barriers: (1) process 0's directory prep before other hosts'
+        shard writes (prep deletes stale files), (2) all shard writes
+        before the manifest commit (a reader who sees the manifest sees
+        every shard), (3) the commit before ANY process returns — so a
+        returned ``save`` means the checkpoint exists everywhere."""
         import jax
 
         if process_index is None:
             process_index = jax.process_index()
+        if sync_fn is None and jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            sync_fn = multihost_utils.sync_global_devices
         self.wait(reraise=False)
         manifest = self._plan(tree)
         d = self._prepare_dir(name, process_index)
+        if sync_fn is not None:
+            sync_fn(f"sharded-ckpt-prepared-{name}")
         written = 0
         for fname, thunk in self._owned_blocks(tree, manifest, owns,
                                                process_index):
@@ -188,7 +204,11 @@ class ShardedCheckpointer:
             with open(os.path.join(d, fname), "wb") as f:
                 f.write(data.tobytes())
             written += data.nbytes
+        if sync_fn is not None:
+            sync_fn(f"sharded-ckpt-written-{name}")
         self._commit(d, manifest, process_index)
+        if sync_fn is not None:
+            sync_fn(f"sharded-ckpt-committed-{name}")
         return written
 
     def save_async(self, name: str, tree: Any) -> None:
@@ -196,9 +216,19 @@ class ShardedCheckpointer:
         the caller's training loop will invalidate the device buffers),
         write files on a background thread (one in flight; a new save
         joins the previous). A failed async save is raised by the next
-        ``wait()`` and logged by quiet waiters."""
+        ``wait()`` and logged by quiet waiters.
+
+        In a MULTI-PROCESS runtime this degrades to the synchronous,
+        barrier-fenced :meth:`save`: the cross-host fences must run on
+        the main thread (collectives may not race the training step
+        from a background thread), and an unfenced async write would
+        let one host's directory prep delete another's in-flight
+        shards."""
         import jax
 
+        if jax.process_count() > 1:
+            self.save(name, tree)
+            return
         self.wait(reraise=False, log=True)
         process_index = jax.process_index()
         manifest = self._plan(tree)

@@ -59,8 +59,11 @@ CHILD = textwrap.dedent("""
 """)
 
 
-@pytest.mark.slow
-def test_two_process_global_mesh_allreduce(tmp_path):
+
+
+def _run_two_procs(child_src, tmp_extra_env=None, timeout=600):
+    """Spawn two rendezvous processes running ``child_src``; returns
+    [(returncode, combined output), ...] (kills both on timeout)."""
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -74,24 +77,29 @@ def test_two_process_global_mesh_allreduce(tmp_path):
             "RAFIKI_PROCESS_ID": str(pid),
             "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
         })
-        env.pop("JAX_PLATFORMS", None)  # child pins cpu itself
+        env.update(tmp_extra_env or {})
+        env.pop("JAX_PLATFORMS", None)  # children pin cpu themselves
         procs.append(subprocess.Popen(
-            [sys.executable, "-c", CHILD], env=env,
+            [sys.executable, "-c", child_src], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-
-    outs = []
+    results = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=600)
+            out, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
             raise
-        outs.append(out)
-    for pid, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"proc{pid} failed:\n{out}"
+        results.append((p.returncode, out))
+    return results
+
+@pytest.mark.slow
+def test_two_process_global_mesh_allreduce(tmp_path):
+    results = _run_two_procs(CHILD)
+    for pid, (rc, out) in enumerate(results):
+        assert rc == 0, f"proc{pid} failed:\n{out}"
         assert f"proc{pid} ok mean=7.5" in out, out
-    assert "coordinator=True" in outs[0]
+    assert "coordinator=True" in results[0][1]
 
 
 class _FakeDev:
@@ -123,3 +131,64 @@ def test_initialize_from_env_rejects_partial_env(monkeypatch):
     monkeypatch.delenv(multihost.PROC_ID_ENV, raising=False)
     with pytest.raises(ValueError, match="RAFIKI_NUM_PROCESSES"):
         multihost.initialize_from_env()
+
+
+CKPT_CHILD = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from rafiki_tpu.parallel.multihost import (global_batch, global_mesh,
+                                               initialize_from_env)
+
+    assert initialize_from_env(timeout_s=300)
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    from rafiki_tpu.store.sharded_ckpt import ShardedCheckpointer
+
+    pid = jax.process_index()
+    mesh = global_mesh(data=8, model=1)
+    # each "host" contributes its half of a known global array
+    local = (np.arange(32, dtype=np.float32).reshape(8, 4)
+             + 100 * pid)
+    batch = global_batch({"x": local}, mesh)   # (16, 4) over 8 devices
+
+    ck = ShardedCheckpointer(os.environ["CKPT_DIR"])
+    # no explicit sync_fn: multi-process saves self-fence by default
+    written = ck.save("t0", {"x": batch["x"]})
+    total = 16 * 4 * 4  # f32 bytes of the global array
+    # the disjoint-writer rule, for real: each process wrote only the
+    # shards of ITS devices — half the array each
+    assert written == total // 2, (written, total)
+
+    # both processes restore into the SAME global sharding and see the
+    # full array (each reads the shard files its devices need)
+    out = ck.restore("t0", {"x": batch["x"]})
+    got = multihost_utils.process_allgather(out["x"], tiled=True)
+    # expected: proc0 contributed rows 0..7, proc1 rows 8..15
+    want = np.concatenate([
+        np.arange(32, dtype=np.float32).reshape(8, 4),
+        np.arange(32, dtype=np.float32).reshape(8, 4) + 100])
+    np.testing.assert_array_equal(np.asarray(got).reshape(16, 4), want)
+    multihost_utils.sync_global_devices("restored")
+    print(f"proc{pid} ckpt ok written={written}", flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_two_process_sharded_checkpoint_disjoint_writers(tmp_path):
+    """The sharded checkpointer's multi-host contract, with two REAL
+    processes: identical manifests, each process writes only its own
+    devices' shards (bytes == total/2 each), self-fencing barriers
+    (prep / commit / return), and both restore the full global array."""
+    results = _run_two_procs(
+        CKPT_CHILD, tmp_extra_env={"CKPT_DIR": str(tmp_path / "ck")})
+    for pid, (rc, out) in enumerate(results):
+        assert rc == 0, f"proc{pid} failed:\n{out}"
+        assert f"proc{pid} ckpt ok" in out, out
