@@ -384,3 +384,39 @@ def test_sampled_tokens_respect_top_k(trained):
         step_logits = logits[len(p) - 1 + j]
         top2 = np.argsort(step_logits)[-2:]
         assert tok in top2, (j, tok, top2)
+
+
+@pytest.mark.slow
+def test_sampling_flows_through_serving_stack(trained):
+    """sampling={} rides the message from Predictor.predict to the
+    decode loop: same seed → identical generations through the whole
+    scatter/gather path, different seed → (with high probability)
+    different ones."""
+    store = ParamStore.from_uri("mem://")
+    store.save("t0", trained.dump_parameters())
+    hub = InProcQueueHub()
+    worker = InferenceWorker(LlamaLoRA, "t0", KNOBS, store, hub, "w0",
+                             decode_loop=True, max_slots=4,
+                             max_new_tokens=8)
+    wt = threading.Thread(target=worker.run, daemon=True)
+    wt.start()
+    try:
+        pred = Predictor(hub, ["w0"], gather_timeout=120.0)
+        samp = {"temperature": 0.9, "top_k": 50, "seed": 1234}
+        a, info = pred.predict(["tok1 tok2 tok3"], sampling=samp)
+        assert info["workers_answered"] == 1
+        b, _ = pred.predict(["tok1 tok2 tok3"], sampling=samp)
+        assert a == b  # seeded: reproducible across requests
+        outs = {tuple(a)}
+        for seed in (7, 99, 31337):
+            o, _ = pred.predict(["tok1 tok2 tok3"],
+                                sampling={**samp, "seed": seed})
+            outs.add(tuple(o))
+        assert len(outs) > 1, "seed ignored through the stack"
+        # malformed sampling degrades, never kills the loop
+        c, info_c = pred.predict(["tok1 tok2 tok3"],
+                                 sampling={"temperature": "hot"})
+        assert info_c["workers_answered"] == 1 and c
+    finally:
+        worker.stop()
+        wt.join(timeout=10)
