@@ -292,6 +292,81 @@ def lm_loss_terms(logits: jnp.ndarray, ids: jnp.ndarray,
     return jnp.sum(losses * valid), jnp.sum(valid)
 
 
+def stack_block_params(params: Any, depth: int, n_stages: int) -> Any:
+    """Canonical ``block_i`` params → (S, k, …) pipeline stacks (stage
+    s owns layers [s·k, (s+1)·k), k = depth/S)."""
+    from rafiki_tpu.parallel.pipeline import stack_stage_params
+
+    k = depth // n_stages
+    blocks = [params[f"block_{i}"] for i in range(depth)]
+    # one stacking convention everywhere: layers within a stage AND
+    # stages themselves stack via the same helper
+    stages = [stack_stage_params(blocks[s * k:(s + 1) * k])
+              for s in range(n_stages)]
+    return stack_stage_params(stages)
+
+
+def pipelined_lm_forward(module: Llama, params: Any, ids: jnp.ndarray,
+                         lens: jnp.ndarray, mesh, n_micro: int,
+                         remat: bool = False,
+                         batch_axis: Optional[str] = None) -> jnp.ndarray:
+    """``module.apply({"params": params}, ids, lens=lens)`` with the
+    decoder blocks PIPELINED over the mesh's ``pipe`` axis.
+
+    Identical math to the canonical forward (tested logits- and
+    grads-equal): embedding and head run outside the pipe; the blocks
+    restack to (S, k, …) and each stage scans its k layers; microbatches
+    stream through ``parallel.pipeline.pipeline_apply`` carrying
+    (hidden, lens, positions) as the activation pytree. Train-path only
+    (no KV cache). MoE blocks are rejected — their aux loss cannot sow
+    through the pipeline scan yet, and silently training without load
+    balancing would be wrong.
+    """
+    from rafiki_tpu.parallel.pipeline import pipeline_apply
+
+    if module.n_experts > 0:
+        raise ValueError("pipelined training does not support MoE "
+                         "blocks yet (aux loss cannot sow through the "
+                         "pipeline scan)")
+    n_stages = mesh.shape["pipe"]
+    if module.depth % n_stages:
+        raise ValueError(f"depth {module.depth} must be divisible by "
+                         f"pipeline stages {n_stages}")
+    b, s = ids.shape
+    if b % n_micro:
+        raise ValueError(f"batch {b} must be divisible by "
+                         f"n_micro {n_micro}")
+    x = nn.Embed(module.vocab_size, module.hidden_dim).apply(
+        {"params": params["tok_embed"]}, ids)
+    if module.dtype is not None:
+        x = x.astype(module.dtype)
+    pos = jnp.broadcast_to(jnp.arange(s), (b, s))
+    stacked = stack_block_params(params, module.depth, n_stages)
+    mb = b // n_micro
+    act = {"h": x.reshape(n_micro, mb, s, module.hidden_dim),
+           "lens": lens.reshape(n_micro, mb),
+           "pos": pos.reshape(n_micro, mb, s)}
+    block = _DecoderBlock(module.n_heads, module.n_kv_heads,
+                          module.mlp_dim, module.max_len,
+                          module.lora_rank, n_experts=0)
+
+    def stage_fn(p_stage, a):
+        def layer(h, p_layer):
+            return block.apply({"params": p_layer}, h, a["lens"],
+                               a["pos"], False), None
+
+        h, _ = jax.lax.scan(layer, a["h"], p_stage)
+        return {"h": h, "lens": a["lens"], "pos": a["pos"]}
+
+    out = pipeline_apply(stage_fn, stacked, act, mesh, axis="pipe",
+                         batch_axis=batch_axis, remat=remat)
+    h = out["h"].reshape(b, s, module.hidden_dim)
+    h = RMSNorm(name="final_norm").apply({"params": params["final_norm"]},
+                                         h)
+    return LoRADense(module.vocab_size, 0, name="lm_head").apply(
+        {"params": params["lm_head"]}, h)
+
+
 def lora_trainable_mask(params: Any) -> Any:
     """True for LoRA adapters, norms, the LM head, and MoE layers;
     False (frozen) for base kernels and the embedding — the LoRA
@@ -386,6 +461,18 @@ class LlamaLoRA(BaseModel):
             # gradient checkpointing (train path): bigger batches for
             # ~1/3 extra FLOPs when activations are HBM-bound
             "remat": FixedKnob(False),
+            # >1 pipelines the decoder blocks over this many devices
+            # (GPipe microbatching, parallel/pipeline.py); depth must
+            # divide by it; mutually exclusive with model_parallel>1.
+            # Train path only — serving is unchanged. NOTE: pp mode
+            # currently keeps params REPLICATED per device (right when
+            # ACTIVATIONS, not weights, are the memory bound; weight-
+            # sharded pipeline storage is future work).
+            "pipeline_stages": FixedKnob(1),
+            # microbatches per batch in pipeline mode (0 → one per
+            # stage). GPipe's bubble fraction is (S-1)/(M+S-1): raise M
+            # well above pipeline_stages to amortize it.
+            "pipeline_microbatches": FixedKnob(0),
             # >0 → MoE FFN with this many experts per block (expert
             # parallelism over the mesh's model axis; ops/moe.py)
             "moe_experts": FixedKnob(0),
@@ -476,7 +563,44 @@ class LlamaLoRA(BaseModel):
         module = self._module()
         devices = ctx.devices or jax.local_devices()
         mesh = self._mesh(devices)
+        pp_stages = int(self.knobs.get("pipeline_stages", 1) or 1)
+        n_micro = int(self.knobs.get("pipeline_microbatches", 0)
+                      or 0) or pp_stages
+        mesh_pp = None
+        if pp_stages > 1:
+            from jax.sharding import Mesh
+
+            if int(self.knobs.get("model_parallel", 1)) > 1:
+                # fail fast: the pipe×data mesh consumes every device,
+                # so a requested TP regime would be silently dropped
+                raise ValueError(
+                    "pipeline_stages>1 is mutually exclusive with "
+                    "model_parallel>1 (pick pp×dp or tp×fsdp)")
+            if len(devices) % pp_stages:
+                raise ValueError(
+                    f"pipeline_stages={pp_stages} must divide the "
+                    f"trial's {len(devices)} devices")
+            if int(self.knobs["depth"]) % pp_stages:
+                raise ValueError(
+                    f"depth {self.knobs['depth']} must divide by "
+                    f"pipeline_stages={pp_stages}")
+            if n_micro % pp_stages:
+                raise ValueError(
+                    f"pipeline_microbatches={n_micro} must be a "
+                    f"multiple of pipeline_stages={pp_stages}")
+            # pipe × data over ALL trial devices (one device set for the
+            # whole train step — params/batches live on this mesh too):
+            # stages down one axis, each microbatch's batch dim sharded
+            # over the other
+            mesh_pp = Mesh(
+                np.array(devices, dtype=object).reshape(
+                    pp_stages, len(devices) // pp_stages),
+                ("pipe", "data"))
         n_experts = int(self.knobs.get("moe_experts", 0))
+        if n_experts and pp_stages > 1:
+            raise ValueError("pipeline_stages>1 does not support MoE "
+                             "blocks yet (aux loss cannot sow through "
+                             "the pipeline scan)")
         if n_experts and n_experts % mesh.shape[MODEL_AXIS]:
             # fail fast: an indivisible expert count would silently fall
             # through the "experts" TP rule to the dense gate/up/down
@@ -490,6 +614,11 @@ class LlamaLoRA(BaseModel):
         n_data = mesh.shape[DATA_AXIS]
         batch_size = int(self.knobs["batch_size"])
         batch_size = max(n_data, batch_size - batch_size % n_data)
+        if mesh_pp is not None:
+            # n_micro microbatches, each batch-sharded over `data`
+            # (size devices/pp) → batch must divide by both
+            q = int(np.lcm(n_micro, len(devices)))
+            batch_size = max(q, batch_size - batch_size % q)
 
         pretrained = str(self.knobs.get("pretrained_path") or "")
         fresh = self._params is None
@@ -537,9 +666,31 @@ class LlamaLoRA(BaseModel):
         # (min_size=0 there). Imported leaves already sit in these
         # shardings (device_put is then a no-op); the put places the
         # rest (LoRA adapters, fresh/warm trees).
-        p_shard = param_shardings(params, mesh, tp_rules=TP_RULES,
-                                  fsdp=True, min_size=2 ** 12)
-        params = jax.tree_util.tree_map(jax.device_put, params, p_shard)
+        if mesh_pp is not None:
+            # pipeline mode: params live replicated on the pipe×data
+            # mesh (ONE device set for the jitted step); the pipeline
+            # re-annotates the block stacks onto their stages in-jit.
+            # This is the activations-bound regime; a pretrained base
+            # imported sharded above gets gathered here — weight-
+            # sharded pipeline storage is future work, so flag it
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            if pretrained:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "pipeline mode replicates the pretrained base on "
+                    "every device; use tp×fsdp (pipeline_stages=1) "
+                    "when WEIGHTS are the memory bound")
+            rep_pp = NamedSharding(mesh_pp, PartitionSpec())
+            params = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, rep_pp), params)
+            b_shard = rep_pp
+        else:
+            p_shard = param_shardings(params, mesh, tp_rules=TP_RULES,
+                                      fsdp=True, min_size=2 ** 12)
+            params = jax.tree_util.tree_map(jax.device_put, params,
+                                            p_shard)
         if shared_ref is not None:
             try:
                 params = shared_ref.restore({"params": params})["params"]
@@ -565,16 +716,28 @@ class LlamaLoRA(BaseModel):
         # donate the param/opt trees: in-place update, no per-step copies
         from rafiki_tpu.ops.moe import MOE_AUX_COEF, moe_aux_loss
 
+        use_remat = bool(self.knobs.get("remat", False))
+
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def train_step(params, opt_state, ib, lb, mask):
             def loss_fn(p):
-                # mutable=["losses"]: MoE blocks sow their load-balance
-                # aux there; dense models sow nothing and aux is 0
-                logits, muts = module.apply({"params": p}, ib, lens=lb,
-                                            mutable=["losses"])
+                if mesh_pp is not None:
+                    # decoder blocks pipelined over the `pipe` axis —
+                    # identical math to the canonical forward (proven by
+                    # tests/test_pipeline.py); MoE rejected upstream
+                    logits = pipelined_lm_forward(
+                        module, p, ib, lb, mesh_pp, n_micro=n_micro,
+                        remat=use_remat, batch_axis="data")
+                    aux = jnp.asarray(0.0, jnp.float32)
+                else:
+                    # mutable=["losses"]: MoE blocks sow their load-
+                    # balance aux there; dense models sow nothing
+                    logits, muts = module.apply(
+                        {"params": p}, ib, lens=lb, mutable=["losses"])
+                    aux = moe_aux_loss(muts)
                 total, count = lm_loss_terms(logits, ib, lb, mask)
                 return (total / jnp.maximum(count, 1.0)
-                        + MOE_AUX_COEF * moe_aux_loss(muts))
+                        + MOE_AUX_COEF * aux)
 
             loss, grads = jax.value_and_grad(loss_fn)(params)
             updates, opt_state = tx.update(grads, opt_state, params)

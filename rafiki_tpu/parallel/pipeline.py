@@ -152,7 +152,13 @@ def pipeline_apply(stage_fn: Callable[[Any, Any], Any],
     p_shard = tmap(lambda spec: NamedSharding(mesh, spec), param_specs)
     stacked_params = tmap(jax.device_put, stacked_params, p_shard)
     x_micro = tmap(jax.device_put, x_micro, x_shard)
-    return _pipeline(stacked_params, x_micro)
+    # jit the shard_map: required for stage bodies that contain inner
+    # calls (flax apply under lax.scan — eager shard_map cannot host
+    # closed_call). NOTE for EAGER repeat-callers: this closure is
+    # fresh per call, so back-to-back eager pipeline_apply calls
+    # retrace — put your training step under jax.jit (the templates
+    # do), which traces this whole function once
+    return jax.jit(_pipeline)(stacked_params, x_micro)
 
 
 def pipeline_oracle(stage_fn, per_stage_params: Sequence[Any],

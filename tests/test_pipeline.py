@@ -207,3 +207,61 @@ def test_pipeline_rank1_activation_leaves():
     np.testing.assert_allclose(np.asarray(out["h"]),
                                np.asarray(jnp.stack(ref)),
                                atol=1e-5, rtol=1e-5)
+
+
+def test_pipelined_llama_forward_matches_canonical():
+    """pipelined_lm_forward == Llama.apply for identical params: logits
+    AND gradients (the flagship-LM pipeline-parallel integration)."""
+    from rafiki_tpu.models.llama_lora import (Llama, pipelined_lm_forward)
+
+    module = Llama(vocab_size=128, max_len=16, hidden_dim=32, depth=4,
+                   n_heads=4, n_kv_heads=2, mlp_dim=64, lora_rank=2)
+    ids = np.ones((8, 12), np.int32)
+    ids[:, 3:] = (np.arange(8 * 9).reshape(8, 9) % 120) + 2
+    lens = np.asarray([12, 10, 12, 8, 12, 12, 9, 12], np.int32)
+    params = module.init(jax.random.PRNGKey(0),
+                         jnp.asarray(ids))["params"]
+    ref = module.apply({"params": params}, jnp.asarray(ids),
+                       lens=jnp.asarray(lens))
+
+    for n_stages, n_micro in ((2, 4), (4, 2)):
+        mesh = _mesh(n_stages)
+        got = pipelined_lm_forward(module, params, jnp.asarray(ids),
+                                   jnp.asarray(lens), mesh, n_micro)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4)
+
+    mesh = _mesh(4)
+
+    def loss_pipe(p):
+        logits = pipelined_lm_forward(module, p, jnp.asarray(ids),
+                                      jnp.asarray(lens), mesh, 2,
+                                      remat=True)
+        return jnp.mean(logits.astype(jnp.float32) ** 2)
+
+    def loss_ref(p):
+        logits = module.apply({"params": p}, jnp.asarray(ids),
+                              lens=jnp.asarray(lens))
+        return jnp.mean(logits.astype(jnp.float32) ** 2)
+
+    g_pipe = jax.grad(loss_pipe)(params)
+    g_ref = jax.grad(loss_ref)(params)
+    for (kp, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(g_pipe)[0],
+            jax.tree_util.tree_flatten_with_path(g_ref)[0]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4,
+                                   err_msg=str(kp))
+
+
+def test_pipelined_llama_forward_rejects_moe():
+    from rafiki_tpu.models.llama_lora import (Llama, pipelined_lm_forward)
+
+    module = Llama(vocab_size=64, max_len=16, hidden_dim=32, depth=2,
+                   n_heads=4, n_kv_heads=2, mlp_dim=64, lora_rank=0,
+                   n_experts=2)
+    ids = jnp.ones((4, 8), jnp.int32)
+    params = module.init(jax.random.PRNGKey(0), ids)["params"]
+    with pytest.raises(ValueError, match="MoE"):
+        pipelined_lm_forward(module, params, ids,
+                             jnp.full((4,), 8, jnp.int32), _mesh(2), 2)
