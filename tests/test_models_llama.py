@@ -222,6 +222,7 @@ def test_fsdp_bounds_per_device_memory_at_1b():
     del params
 
 
+@pytest.mark.slow
 def test_remat_identical_math_and_decode_unaffected():
     """Llama remat: identical train-path outputs/grads; the decode path
     (mutable cache) never rematerializes and still generates the same

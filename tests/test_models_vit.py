@@ -95,6 +95,7 @@ def test_vit_v1_checkpoint_prep_compat():
     assert m2.dump_parameters()["meta"]["prep_version"] == 1
 
 
+@pytest.mark.slow
 def test_remat_identical_math_smaller_residuals():
     """remat=True must change NOTHING numerically (same outputs, same
     grads from the same params) while rematerializing block activations

@@ -95,6 +95,7 @@ def test_moe_grads_flow_to_router_and_experts():
         assert np.isfinite(total) and total > 0, name
 
 
+@pytest.mark.slow
 def test_expert_parallel_sharding_matches_single_device():
     """Experts sharded over the model axis (TP_RULES 'experts' rule):
     same outputs as replicated execution, expert dim actually split."""

@@ -28,8 +28,12 @@ def _dense_stack(n_stages, d, key=0):
     return per_stage, stack_stage_params(per_stage)
 
 
-@pytest.mark.parametrize("n_stages,n_micro", [(2, 4), (4, 4), (4, 8),
-                                              (8, 8), (4, 1)])
+@pytest.mark.parametrize("n_stages,n_micro", [
+    (2, 4),  # the quick default-leg exactness check
+    pytest.param(4, 4, marks=pytest.mark.slow),
+    pytest.param(4, 8, marks=pytest.mark.slow),
+    pytest.param(8, 8, marks=pytest.mark.slow),
+    pytest.param(4, 1, marks=pytest.mark.slow)])
 def test_pipeline_matches_sequential(n_stages, n_micro):
     d = 16
     per_stage, stacked = _dense_stack(n_stages, d)
@@ -42,6 +46,7 @@ def test_pipeline_matches_sequential(n_stages, n_micro):
 
 
 @pytest.mark.parametrize("remat", [False, True])
+@pytest.mark.slow
 def test_pipeline_grads_match_sequential(remat):
     n_stages, n_micro, d = 4, 4, 8
     per_stage, stacked = _dense_stack(n_stages, d, key=3)
@@ -66,6 +71,7 @@ def test_pipeline_grads_match_sequential(remat):
                                    atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_pipeline_transformer_stages():
     """Stages can be real transformer blocks: per-stage flax params,
     stacked, pipelined — output equals running the blocks in order."""
@@ -96,6 +102,7 @@ def test_pipeline_transformer_stages():
                                atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_composes_with_data_axis():
     """pipe × data 2-D mesh: each microbatch's BATCH dim sharded over
     `data` (batch_axis), stages over `pipe` — both shardings at once,
@@ -141,6 +148,7 @@ def test_stage_params_actually_sharded():
     assert np.isfinite(np.asarray(out)).all()
 
 
+@pytest.mark.slow
 def test_pipeline_pytree_activations_with_positions():
     """Real-model shape: the activation is a (hidden, positions) pytree
     — attention-style stages need positions/masks alongside hidden
@@ -209,6 +217,7 @@ def test_pipeline_rank1_activation_leaves():
                                atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipelined_llama_forward_matches_canonical():
     """pipelined_lm_forward == Llama.apply for identical params: logits
     AND gradients (the flagship-LM pipeline-parallel integration)."""
