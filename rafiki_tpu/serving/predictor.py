@@ -152,10 +152,22 @@ class Predictor:
             return lat[max(0, min(len(lat) - 1,
                                   math.ceil(p * len(lat)) - 1))]
 
+        workers: Dict[str, Any] = {}
+        for wid in self.worker_ids:
+            try:
+                s = self.hub.get_worker_stats(wid)
+            except Exception:  # noqa: BLE001 — health must not 500 on
+                s = None       # a hub hiccup
+            if s is not None:
+                workers[wid] = s
         return {"queries_served": n_q, "requests_served": n_req,
                 "latency_sum_s": lat_sum, "latency_window_n": len(lat),
                 "latency_p50_s": pct(0.50), "latency_p95_s": pct(0.95),
-                "latency_p99_s": pct(0.99)}
+                "latency_p99_s": pct(0.99),
+                # per-worker published counters (drop accounting, decode-
+                # engine stats): a worker silently dropping expired
+                # queries shows up HERE, not as mystery timeouts
+                "workers": workers}
 
 
 def _stack(queries: Sequence[Any]) -> Any:

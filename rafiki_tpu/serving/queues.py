@@ -73,6 +73,17 @@ class QueueHub:
         the queue, recreating it — the pre-armed TTL collects that
         straggler. Backends with their own sweep may no-op."""
 
+    def put_worker_stats(self, worker_id: str, stats: Dict[str, Any]
+                         ) -> None:
+        """Workers publish their counters (dropped-expired queries,
+        decode-engine stats) here; the predictor's /health aggregates
+        them — the first diagnostic when 'the predictor only sees
+        timeouts' (ADVICE r3: silent drops were invisible)."""
+        raise NotImplementedError
+
+    def get_worker_stats(self, worker_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
 
 class _KeyQueue:
     """One deque + its OWN condvar. A shared hub-wide condition would
@@ -102,6 +113,7 @@ class InProcQueueHub(QueueHub):
         self._queues: Dict[str, _KeyQueue] = {}
         self._meta = threading.Lock()  # guards the key → queue dict
         self._ops = 0
+        self._stats: Dict[str, Dict[str, Any]] = {}  # worker counters
 
     def _get(self, key: str, *, as_waiter: bool = False) -> _KeyQueue:
         import time
@@ -173,6 +185,14 @@ class InProcQueueHub(QueueHub):
             if q is not None and not q.waiters:
                 del self._queues[f"p:{query_id}"]
 
+    def put_worker_stats(self, worker_id: str, stats) -> None:
+        with self._meta:
+            self._stats[worker_id] = dict(stats)
+
+    def get_worker_stats(self, worker_id: str):
+        with self._meta:
+            return self._stats.get(worker_id)
+
 
 class KVQueueHub(QueueHub):
     """Queues on the native kv server. Blocking pops hold a socket, so each
@@ -223,6 +243,20 @@ class KVQueueHub(QueueHub):
 
     def discard_prediction_queue(self, query_id: str) -> None:
         self._client().delete(f"q:preds:{query_id}")
+
+    #: stats keys expire so a DEAD worker's last counters cannot pose
+    #: as current health forever (live workers republish well inside
+    #: this window)
+    STATS_TTL_S = 120.0
+
+    def put_worker_stats(self, worker_id: str, stats) -> None:
+        c = self._client()
+        c.set(f"stats:{worker_id}", pack_message(dict(stats)))
+        c.expire(f"stats:{worker_id}", self.STATS_TTL_S)
+
+    def get_worker_stats(self, worker_id: str):
+        raw = self._client().get(f"stats:{worker_id}")
+        return None if raw is None else unpack_message(raw)
 
     def arm_reply_ttl(self, query_id: str, ttl_s: float) -> None:
         # kvd TTLs deliberately survive deletion/recreation (see

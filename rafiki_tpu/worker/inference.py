@@ -105,6 +105,25 @@ class InferenceWorker:
     def stop(self) -> None:
         self._stop.set()
 
+    #: loop iterations between stats publications to the hub
+    STATS_EVERY = 50
+
+    def _publish_stats(self) -> None:
+        """Push this worker's counters to the hub so the predictor's
+        /health can surface them (silent expiry drops are otherwise
+        indistinguishable from gather timeouts on the predictor side)."""
+        import time
+
+        stats = dict(self.stats)
+        stats["published_at"] = time.time()  # staleness signal for ops
+        if self.engine is not None:
+            stats.update({f"engine_{k}": v
+                          for k, v in self.engine.stats.items()})
+        try:
+            self.hub.put_worker_stats(self.worker_id, stats)
+        except Exception:  # noqa: BLE001 — observability must never
+            pass           # kill the serving loop
+
     def _count_dropped(self, n: int) -> None:
         if n <= 0:
             return
@@ -131,6 +150,8 @@ class InferenceWorker:
             if max_iterations is not None and n >= max_iterations:
                 break
             n += 1
+            if n % self.STATS_EVERY == 1:  # incl. first iteration:
+                self._publish_stats()      # fresh boots appear at once
             first = self.hub.pop_query(self.worker_id, poll_timeout)
             if first is None:
                 continue
@@ -144,6 +165,7 @@ class InferenceWorker:
             self._count_dropped(len(messages) - len(live))
             if live:
                 self._serve_batch(live)
+        self._publish_stats()  # final counters visible after stop
 
     def _run_decode_loop(self, poll_timeout: float,
                          max_iterations: Optional[int]) -> None:
@@ -160,6 +182,8 @@ class InferenceWorker:
             if max_iterations is not None and n >= max_iterations:
                 break
             n += 1
+            if n % self.STATS_EVERY == 1:  # incl. first iteration
+                self._publish_stats()
             busy = self.engine.busy
             raw = self.hub.pop_query(self.worker_id,
                                      0.0 if busy else poll_timeout)
@@ -210,6 +234,7 @@ class InferenceWorker:
                         {"id": mid, "worker_id": self.worker_id,
                          "predictions": preds}))
                     del inflight[mid]
+        self._publish_stats()  # final counters visible after stop
 
     def _serve_batch(self, messages: List[dict]) -> None:
         # flatten all messages' queries into one forward pass
