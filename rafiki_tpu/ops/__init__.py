@@ -6,13 +6,17 @@ Pallas interpreter is test-only — see ``ops/common.py``). Ring attention
 shards the sequence axis over a mesh and rotates K/V via ppermute
 (long-context support; ``ops/ring_attention.py``); Ulysses swaps the
 sharded axis head↔sequence with two all-to-alls and runs the ordinary
-kernel per head group (``ops/ulysses.py``).
+kernel per head group (``ops/ulysses.py``); the MoE feed-forward routes
+tokens to experts sharded over the mesh (``ops/moe.py``).
 """
 
-from .attention import flash_attention, mha
+from .attention import flash_attention, flash_attention_lse, mha
+from .moe import MoEFeedForward, moe_aux_loss
 from .patch_embed import extract_patches, matmul_bias, patch_embed
 from .ring_attention import ring_attention
 from .ulysses import ulysses_attention
 
-__all__ = ["flash_attention", "mha", "patch_embed", "matmul_bias",
+__all__ = ["flash_attention", "flash_attention_lse", "mha",
+           "MoEFeedForward", "moe_aux_loss",
+           "patch_embed", "matmul_bias",
            "extract_patches", "ring_attention", "ulysses_attention"]
