@@ -199,6 +199,7 @@ class _DecoderBlock(nn.Module):
     max_len: int
     lora_rank: int
     n_experts: int = 0  # >0 → MoE FFN (expert-parallel, ops/moe.py)
+    moe_top_k: int = 1  # experts per token (1 Switch, 2 Mixtral-style)
 
     @nn.compact
     def __call__(self, x, lens, positions, decode):
@@ -210,6 +211,7 @@ class _DecoderBlock(nn.Module):
             from rafiki_tpu.ops.moe import MoEFeedForward
 
             return x + MoEFeedForward(self.n_experts, self.mlp_dim,
+                                      router_top_k=self.moe_top_k,
                                       name="moe")(y)
         gate = LoRADense(self.mlp_dim, self.lora_rank, name="gate")(y)
         up = LoRADense(self.mlp_dim, self.lora_rank, name="up")(y)
@@ -238,11 +240,13 @@ class Llama(nn.Module):
     # double-write it): ~1/3 more FLOPs for O(depth) less activation
     # HBM. Identical math.
     remat: bool = False
-    # >0 replaces every block's dense FFN with a top-1-routed MoE of
+    # >0 replaces every block's dense FFN with a top-k-routed MoE of
     # this many experts (ops/moe.py); expert weights shard over the
     # mesh's `model` axis (expert parallelism). The train step picks up
     # the load-balancing aux via mutable=["losses"].
     n_experts: int = 0
+    # experts per token when n_experts > 0 (1 Switch, 2 Mixtral-style)
+    moe_top_k: int = 1
 
     @nn.compact
     def __call__(self, ids: jnp.ndarray, lens: Optional[jnp.ndarray] = None,
@@ -267,6 +271,7 @@ class Llama(nn.Module):
             x = block_cls(self.n_heads, self.n_kv_heads, self.mlp_dim,
                           self.max_len, self.lora_rank,
                           n_experts=self.n_experts,
+                          moe_top_k=self.moe_top_k,
                           name=f"block_{i}")(x, lens, positions, decode)
         x = RMSNorm(name="final_norm")(x)
         return LoRADense(self.vocab_size, 0, name="lm_head")(x)
@@ -476,6 +481,8 @@ class LlamaLoRA(BaseModel):
             # >0 → MoE FFN with this many experts per block (expert
             # parallelism over the mesh's model axis; ops/moe.py)
             "moe_experts": FixedKnob(0),
+            # experts per token (1 Switch, 2 Mixtral-style)
+            "moe_top_k": FixedKnob(1),
             "quick_train": PolicyKnob("QUICK_TRAIN"),
             "share_params": PolicyKnob("SHARE_PARAMS"),
             # serving-quality runs: a trained byte-BPE artifact
@@ -515,7 +522,8 @@ class LlamaLoRA(BaseModel):
                      lora_rank=int(k["lora_rank"]),
                      dtype=self._dtype(),
                      remat=bool(k.get("remat", False)),
-                     n_experts=int(k.get("moe_experts", 0)))
+                     n_experts=int(k.get("moe_experts", 0)),
+                     moe_top_k=int(k.get("moe_top_k", 1) or 1))
 
     def _dtype(self):
         # single source of truth for the bf16 knob → compute dtype

@@ -13,9 +13,10 @@ ICI. Design (the Shazeer/GShard recipe, XLA-first):
   scatter, no dynamic shapes, nothing XLA can't tile. Overflowing
   tokens are dropped (combine weight 0 → they pass through the
   residual stream untouched), the standard capacity trade.
-- **Top-1 (switch) routing** with the load-balancing auxiliary loss
-  from the Switch Transformer: ``E · Σ_e fraction_e · prob_e``,
-  minimized at uniform routing. The aux loss is returned via a flax
+- **Top-k routing** (k=1 Switch default, k=2 GShard/Mixtral-style with
+  pair-renormalized gates) with the load-balancing auxiliary loss from
+  the Switch Transformer: ``E · Σ_e fraction_e · prob_e``, minimized at
+  uniform routing. The aux loss is returned via a flax
   ``"losses"`` collection so any host module can pick it up with
   ``mutable=["losses"]``.
 - **Expert parallelism by annotation:** expert weights are stacked
@@ -42,50 +43,65 @@ from flax import linen as nn
 MOE_AUX_COEF = 0.01
 
 
-def router_dispatch(logits: jnp.ndarray, capacity: int
+def router_dispatch(logits: jnp.ndarray, capacity: int, top_k: int = 1
                     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Top-1 capacity routing from ``(T, E)`` router logits.
+    """Top-k capacity routing from ``(T, E)`` router logits
+    (``top_k=1`` = Switch, ``top_k=2`` = GShard/Mixtral-style).
 
     Returns ``(dispatch, combine, aux)``:
     - ``dispatch``: (T, E, C) one-hot — token t occupies slot c of
-      expert e (0 rows for dropped/overflow tokens);
-    - ``combine``: (T, E, C) — dispatch scaled by the token's router
-      probability (the gradient path back into the router);
-    - ``aux``: scalar load-balancing loss (Switch Transformer form).
+      expert e (0 rows for dropped/overflow choices);
+    - ``combine``: (T, E, C) — dispatch scaled by the token's gate for
+      that expert (router probs renormalized over its top-k choices —
+      the gradient path back into the router);
+    - ``aux``: scalar load-balancing loss (Switch form, over top-1
+      assignments).
 
-    Position within an expert's capacity is assigned by ARRIVAL ORDER
-    (cumsum over the token axis), the deterministic static-shape
-    classic. All math is one-hot matmul/cumsum — MXU/VPU friendly,
-    no sorts, no dynamic shapes.
+    Choices fill capacity in priority order (all first choices, then
+    all second choices), each within arrival order — deterministic,
+    static shapes, one-hot matmul/cumsum math only (MXU/VPU friendly:
+    no sorts over the vocab of experts, no dynamic shapes).
     """
     t, e = logits.shape
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # (T, E)
-    expert = jnp.argmax(probs, axis=-1)                          # (T,)
-    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)        # (T, E)
+    top_vals, top_idx = jax.lax.top_k(probs, top_k)              # (T, k)
+    # gates: Switch (k=1) uses the RAW router prob — renormalizing a
+    # single choice would always give 1.0 and cut the router's gradient
+    # signal; GShard-style k>1 renormalizes over the chosen set
+    if top_k == 1:
+        gates = top_vals                                         # (T, 1)
+    else:
+        gates = top_vals / jnp.maximum(
+            jnp.sum(top_vals, axis=-1, keepdims=True), 1e-9)
 
-    # slot index of each token within its expert = how many earlier
-    # tokens chose the same expert
-    position = jnp.cumsum(onehot, axis=0) * onehot - onehot      # (T, E)
-    keep = position < capacity                                   # (T, E)
-    onehot_kept = onehot * keep
-    pos_idx = position.astype(jnp.int32)                         # (T, E)
-    slot = jax.nn.one_hot(pos_idx, capacity,
-                          dtype=jnp.float32)                     # (T,E,C)
-    dispatch = onehot_kept[..., None] * slot                     # (T,E,C)
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    filled = jnp.zeros((e,), jnp.float32)  # slots consumed per expert
+    for j in range(top_k):  # static, tiny
+        onehot = jax.nn.one_hot(top_idx[:, j], e, dtype=jnp.float32)
+        # slot index = earlier same-choice tokens + slots already
+        # consumed by higher-priority choices
+        position = (jnp.cumsum(onehot, axis=0) - onehot
+                    + filled[None, :]) * onehot
+        keep = (position < capacity)
+        kept = onehot * keep
+        slot = jax.nn.one_hot(position.astype(jnp.int32), capacity,
+                              dtype=jnp.float32)                 # (T,E,C)
+        d_j = kept[..., None] * slot
+        dispatch = dispatch + d_j
+        combine = combine + d_j * gates[:, j, None, None]
+        filled = filled + jnp.sum(kept, axis=0)
 
-    gate = jnp.sum(probs * onehot_kept, axis=-1)                 # (T,)
-    combine = dispatch * gate[:, None, None]
-
-    # load balance: fraction of tokens routed to e × mean router prob
-    # for e, scaled by E — equals 1 at perfectly uniform routing
-    fraction = jnp.mean(onehot, axis=0)
-    prob_mean = jnp.mean(probs, axis=0)
-    aux = e * jnp.sum(fraction * prob_mean)
+    # load balance: fraction of tokens whose TOP choice is e × mean
+    # router prob for e, scaled by E — 1 at perfectly uniform routing
+    top1 = jax.nn.one_hot(top_idx[:, 0], e, dtype=jnp.float32)
+    aux = e * jnp.sum(jnp.mean(top1, axis=0) * jnp.mean(probs, axis=0))
     return dispatch, combine, aux
 
 
 class MoEFeedForward(nn.Module):
-    """Switch-style MoE FFN: top-1 routed SwiGLU experts.
+    """MoE FFN: top-k routed SwiGLU experts (``router_top_k``: 1 =
+    Switch, 2 = GShard/Mixtral-style).
 
     Drop-in for a dense FFN over ``(B, S, D)`` activations. Expert
     weights are stacked ``(E, ...)``; shard dim 0 over the mesh's
@@ -97,6 +113,9 @@ class MoEFeedForward(nn.Module):
     n_experts: int
     mlp_dim: int
     capacity_factor: float = 1.25
+    #: experts per token: 1 = Switch, 2 = GShard/Mixtral-style (gates
+    #: renormalized over the chosen pair)
+    router_top_k: int = 1
     dtype: Any = None
 
     @nn.compact
@@ -104,13 +123,15 @@ class MoEFeedForward(nn.Module):
         b, s, d = x.shape
         e, h = self.n_experts, self.mlp_dim
         t = b * s
-        capacity = max(1, int(-(-t * self.capacity_factor // e)))
+        capacity = max(1, int(-(-t * self.router_top_k
+                                * self.capacity_factor // e)))
         xf = x.reshape(t, d)
 
         # router in f32 (precision-sensitive softmax over logits)
         wr = self.param("router", nn.initializers.normal(0.02), (d, e))
         logits = xf.astype(jnp.float32) @ wr.astype(jnp.float32)
-        dispatch, combine, aux = router_dispatch(logits, capacity)
+        dispatch, combine, aux = router_dispatch(
+            logits, capacity, top_k=self.router_top_k)
         self.sow("losses", "moe_aux", aux)
 
         # stacked expert SwiGLU weights — dim 0 is the EXPERT axis the

@@ -17,7 +17,7 @@ TINY = {"max_epochs": 6, "vocab_size": 1 << 14, "hidden_dim": 64,
         "depth": 2, "n_heads": 4, "kv_ratio": 2, "lora_rank": 4,
         "max_len": 32, "model_parallel": 2, "learning_rate": 1e-2,
         "batch_size": 16, "bf16": False, "remat": False,
-        "moe_experts": 0, "pipeline_stages": 1,
+        "moe_experts": 0, "moe_top_k": 1, "pipeline_stages": 1,
         "pipeline_microbatches": 0,
         "quick_train": False,
         "share_params": False, "tokenizer_path": "", "pretrained_path": ""}

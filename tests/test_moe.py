@@ -193,3 +193,63 @@ def test_moe_expert_count_must_divide_model_axis(tmp_path):
     with pytest.raises(ValueError, match="divisible"):
         LlamaLoRA(**knobs).train(
             tr, TrainContext(devices=list(jax.devices())))
+
+
+def test_top2_routing_matches_manual():
+    """top_k=2: each token's output is the gate-weighted sum of its two
+    best experts' SwiGLU outputs (gates renormalized over the pair)."""
+    m = MoEFeedForward(n_experts=4, mlp_dim=8, capacity_factor=4.0,
+                       router_top_k=2)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 8))
+    params = m.init(jax.random.PRNGKey(1), x)["params"]
+    y, _ = m.apply({"params": params}, x, mutable=["losses"])
+
+    xf = np.asarray(x, np.float32).reshape(-1, 8)
+    logits = xf @ np.asarray(params["router"], np.float32)
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), -1))
+    want = np.zeros_like(xf)
+    for t in range(xf.shape[0]):
+        top2 = np.argsort(probs[t])[-2:][::-1]
+        gsum = probs[t, top2].sum()
+        for e_idx in top2:
+            g = xf[t] @ np.asarray(params["experts_gate"][e_idx])
+            u = xf[t] @ np.asarray(params["experts_up"][e_idx])
+            silu = g / (1 + np.exp(-g)) * u
+            want[t] += (probs[t, e_idx] / gsum) * (
+                silu @ np.asarray(params["experts_down"][e_idx]))
+    np.testing.assert_allclose(np.asarray(y).reshape(-1, 8), want,
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_top2_overflow_drops_second_choice_first():
+    """First choices fill capacity before any second choice gets a
+    slot (priority order), and gates still sum ≤ 1 per token."""
+    # 3 tokens, 2 experts: everyone's 1st choice = expert 0, 2nd = e1
+    logits = jnp.asarray([[5.0, 2.0]] * 3, jnp.float32)
+    dispatch, combine, _ = router_dispatch(logits, capacity=2, top_k=2)
+    d = np.asarray(dispatch)
+    # expert 0: tokens 0,1 keep their FIRST choice; token 2's dropped
+    assert d[0, 0].sum() == 1 and d[1, 0].sum() == 1
+    assert d[2, 0].sum() == 0
+    # expert 1 (capacity 2 as well): first two second-choices land,
+    # token 2 is dropped from BOTH experts
+    assert d[0, 1].sum() == 1 and d[1, 1].sum() == 1
+    assert d[2].sum() == 0
+    c = np.asarray(combine)
+    token_gates = c.sum(axis=(1, 2))
+    assert (token_gates <= 1.0 + 1e-6).all()
+
+
+def test_llama_moe_top_k_plumbed():
+    """The moe_top_k field reaches MoEFeedForward (top-2 capacity is
+    larger, param shapes identical, forward runs)."""
+    from rafiki_tpu.models.llama_lora import Llama
+
+    m = Llama(vocab_size=64, max_len=16, hidden_dim=32, depth=1,
+              n_heads=4, n_kv_heads=2, mlp_dim=64, lora_rank=0,
+              n_experts=2, moe_top_k=2)
+    ids = jnp.ones((2, 8), jnp.int32)
+    params = m.init(jax.random.PRNGKey(0), ids)["params"]
+    out, muts = m.apply({"params": params}, ids, mutable=["losses"])
+    assert out.shape == (2, 8, 64)
+    assert float(moe_aux_loss(muts)) > 0
