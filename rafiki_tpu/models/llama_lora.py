@@ -251,7 +251,8 @@ class Llama(nn.Module):
     @nn.compact
     def __call__(self, ids: jnp.ndarray, lens: Optional[jnp.ndarray] = None,
                  positions: Optional[jnp.ndarray] = None,
-                 decode: bool = False) -> jnp.ndarray:
+                 decode: bool = False,
+                 return_hidden: bool = False) -> jnp.ndarray:
         b, s = ids.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(s), (b, s))
@@ -274,6 +275,13 @@ class Llama(nn.Module):
                           moe_top_k=self.moe_top_k,
                           name=f"block_{i}")(x, lens, positions, decode)
         x = RMSNorm(name="final_norm")(x)
+        if return_hidden:
+            # chunked-loss path (chunked_lm_loss_terms): hand back the
+            # final-norm activations so the caller can stream the
+            # lm_head projection chunk-by-chunk instead of ever holding
+            # (B, L, vocab) logits. lm_head params still initialize via
+            # the default trace.
+            return x
         return LoRADense(self.vocab_size, 0, name="lm_head")(x)
 
 
@@ -295,6 +303,66 @@ def lm_loss_terms(logits: jnp.ndarray, ids: jnp.ndarray,
     losses = optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(jnp.float32), targets)
     return jnp.sum(losses * valid), jnp.sum(valid)
+
+
+def chunked_lm_loss_terms(hidden: jnp.ndarray, head_kernel: jnp.ndarray,
+                          ids: jnp.ndarray, lens: jnp.ndarray,
+                          example_mask: Optional[jnp.ndarray] = None,
+                          chunk: int = 256
+                          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``lm_loss_terms`` without ever materializing (B, L, vocab) logits.
+
+    The full-logits tensor is the largest activation in LM training by
+    far — Llama-3's 128k vocab at (8, 2048) is ~16 GB in f32, several
+    times the model's entire activation footprint. This streams the
+    lm_head projection over sequence chunks with ``lax.scan``: each step
+    projects one (B, chunk, D) slice of the final-norm activations,
+    reduces straight to summed cross-entropy, and discards the chunk's
+    logits. ``jax.checkpoint`` on the chunk body keeps the BACKWARD pass
+    at one chunk of logits too (recomputed per step), so peak logits
+    memory drops from O(L·V) to O(chunk·V) in both passes.
+
+    Same math as ``lm_loss_terms`` up to f32 summation order (the scan
+    folds per-chunk partial sums sequentially, so low bits differ from
+    the dense path's single reduction): the projection runs in
+    ``hidden.dtype`` (matching ``LoRADense``) and the softmax in f32.
+    Sequence pads introduced to reach a chunk multiple are masked out of
+    both the sum and the count.
+    """
+    b, length, d = hidden.shape
+    targets = jnp.pad(ids[:, 1:], ((0, 0), (0, 1)))
+    pos = jnp.arange(length)[None, :]
+    valid = pos < (lens[:, None] - 1)
+    if example_mask is not None:
+        valid = valid & (example_mask[:, None] > 0)
+    count = jnp.sum(valid)
+
+    chunk = max(1, min(int(chunk), length))
+    pad = (-length) % chunk
+    if pad:
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        valid = jnp.pad(valid, ((0, 0), (0, pad)))
+    n_chunks = (length + pad) // chunk
+    # scan carries the running sum; xs walk the chunk axis
+    hs = hidden.reshape(b, n_chunks, chunk, d).transpose(1, 0, 2, 3)
+    ts = targets.reshape(b, n_chunks, chunk).transpose(1, 0, 2)
+    vs = valid.reshape(b, n_chunks, chunk).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def _chunk_sum(h, t, v):
+        logits = h @ head_kernel.astype(h.dtype)  # (B, chunk, V) — local
+        losses = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), t)
+        return jnp.sum(losses * v)
+
+    def body(total, xs):
+        h, t, v = xs
+        return total + _chunk_sum(h, t, v), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                            (hs, ts, vs))
+    return total, count
 
 
 def stack_block_params(params: Any, depth: int, n_stages: int) -> Any:
@@ -478,6 +546,12 @@ class LlamaLoRA(BaseModel):
             # stage). GPipe's bubble fraction is (S-1)/(M+S-1): raise M
             # well above pipeline_stages to amortize it.
             "pipeline_microbatches": FixedKnob(0),
+            # >0 → stream the lm_head projection + cross-entropy over
+            # sequence chunks of this size in the train step instead of
+            # materializing (B, L, vocab) logits — the dominant
+            # activation at large vocab (chunked_lm_loss_terms). 0 keeps
+            # the dense loss. Identical math either way.
+            "loss_chunk": FixedKnob(0),
             # >0 → MoE FFN with this many experts per block (expert
             # parallelism over the mesh's model axis; ops/moe.py)
             "moe_experts": FixedKnob(0),
@@ -725,6 +799,13 @@ class LlamaLoRA(BaseModel):
         from rafiki_tpu.ops.moe import MOE_AUX_COEF, moe_aux_loss
 
         use_remat = bool(self.knobs.get("remat", False))
+        loss_chunk = int(self.knobs.get("loss_chunk", 0) or 0)
+        if loss_chunk and mesh_pp is not None:
+            # the pipelined forward assembles logits stage-wise; wiring
+            # the streamed loss through it is a separate change — fail
+            # fast rather than silently ignore the knob
+            raise ValueError("loss_chunk>0 is not supported with "
+                             "pipeline_stages>1")
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def train_step(params, opt_state, ib, lb, mask):
@@ -737,13 +818,25 @@ class LlamaLoRA(BaseModel):
                         module, p, ib, lb, mesh_pp, n_micro=n_micro,
                         remat=use_remat, batch_axis="data")
                     aux = jnp.asarray(0.0, jnp.float32)
+                    total, count = lm_loss_terms(logits, ib, lb, mask)
+                elif loss_chunk:
+                    # streamed loss: forward stops at the final norm;
+                    # the lm_head projection + CE run chunk-by-chunk so
+                    # (B, L, vocab) logits never exist in HBM
+                    hidden, muts = module.apply(
+                        {"params": p}, ib, lens=lb, mutable=["losses"],
+                        return_hidden=True)
+                    aux = moe_aux_loss(muts)
+                    total, count = chunked_lm_loss_terms(
+                        hidden, p["lm_head"]["kernel"], ib, lb, mask,
+                        chunk=loss_chunk)
                 else:
                     # mutable=["losses"]: MoE blocks sow their load-
                     # balance aux there; dense models sow nothing
                     logits, muts = module.apply(
                         {"params": p}, ib, lens=lb, mutable=["losses"])
                     aux = moe_aux_loss(muts)
-                total, count = lm_loss_terms(logits, ib, lb, mask)
+                    total, count = lm_loss_terms(logits, ib, lb, mask)
                 return (total / jnp.maximum(count, 1.0)
                         + MOE_AUX_COEF * aux)
 
@@ -797,9 +890,19 @@ class LlamaLoRA(BaseModel):
         ids, lens = self._encode_lm(ds.texts)
         if self._fwd is None:  # cache: jit memoizes by function identity
             module = self._module()
+            loss_chunk = int(self.knobs.get("loss_chunk", 0) or 0)
 
             @jax.jit
             def nll(params, ib, lb):
+                if loss_chunk:
+                    # a config that NEEDS the streamed loss to train
+                    # (vocab·L logits over HBM) would OOM right here at
+                    # eval otherwise — same chunking, same math
+                    hidden = module.apply({"params": params}, ib, lens=lb,
+                                          return_hidden=True)
+                    return chunked_lm_loss_terms(
+                        hidden, params["lm_head"]["kernel"], ib, lb,
+                        chunk=loss_chunk)
                 logits = module.apply({"params": params}, ib, lens=lb)
                 return lm_loss_terms(logits, ib, lb)
 
