@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional
 
 import jax
@@ -44,6 +45,18 @@ LSE_MASKED = 1e30
 # Per-row scalars are replicated across this many lanes (one f32 vreg lane
 # dim) so kernels only ever see (sublane, lane)-tiled 2-D blocks.
 LANES = 128
+# Auto-dispatch (interpret=None) routes sequences at or below this length
+# to the pure-XLA path EVEN ON TPU: measured on a v5e chip (ViT-B/16
+# train step, seq 197 → padded 256, bs 64), XLA's fused attention beats
+# the Pallas kernels 811 vs 578 samples/s — at short seq the O(S²) score
+# matrix the flash recurrence exists to avoid fits easily in
+# VMEM-friendly fusions, and the kernel's grid/loop overhead dominates.
+# The default stays at the measured crossover region (256); above it the
+# kernels run, since the XLA path materializes (B, H, S, S) f32
+# scores and an unmeasured win is not worth an OOM regression. Override
+# with RAFIKI_XLA_SHORT_SEQ (0 disables the short-seq route entirely);
+# explicit interpret=False always forces Mosaic lowering.
+XLA_SHORT_SEQ = int(os.environ.get("RAFIKI_XLA_SHORT_SEQ", "256"))
 
 
 def _attn_fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *lse_refs,
@@ -437,15 +450,18 @@ def flash_attention(q, k, v, sm_scale: Optional[float] = None,
     Pallas backward kernels.
 
     Dispatch: with ``interpret=None`` (the default used by every model
-    template) the Pallas kernels run only on a real TPU backend; off-TPU
-    the call routes to the mathematically identical pure-XLA path, which
-    is orders of magnitude faster than the Pallas interpreter on CPU.
-    Pass ``interpret=True`` to force the kernels through the interpreter
-    (the kernel-equivalence tests do), or ``interpret=False`` for Mosaic
-    lowering.
+    template) the Pallas kernels run only on a real TPU backend AND at
+    sequence lengths above ``XLA_SHORT_SEQ`` — short sequences measure
+    faster through XLA's own fusions even on TPU (see the constant's
+    note), and off-TPU the pure-XLA path is orders of magnitude faster
+    than the Pallas interpreter. Pass ``interpret=True`` to force the
+    kernels through the interpreter (the kernel-equivalence tests do),
+    or ``interpret=False`` for Mosaic lowering.
     """
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    if use_xla_fallback(interpret):
+    short = (interpret is None
+             and max(q.shape[2], k.shape[2]) <= XLA_SHORT_SEQ)
+    if short or use_xla_fallback(interpret):
         lens = None if kv_lens is None else jnp.asarray(kv_lens, jnp.int32)
         return _attention_reference(q, k, v, scale, causal, lens)
     if kv_lens is None:
@@ -510,8 +526,11 @@ def flash_attention_lse(q, k, v, sm_scale: Optional[float] = None,
     ``lse[b, h, i]`` is the log-sum-exp of row i's (scaled, masked)
     scores — the residual blockwise consumers (ring attention) need to
     combine per-block outputs exactly: out = Σ_blocks e^{lse_s − m}·out_s
-    normalized. Differentiable in ``out`` AND ``lse``; same dispatch
-    rule as :func:`flash_attention` (Pallas on TPU, XLA twin off-TPU).
+    normalized. Differentiable in ``out`` AND ``lse``. Dispatch: Pallas
+    on TPU at ANY length, XLA twin off-TPU — unlike
+    :func:`flash_attention` there is NO short-seq XLA routing here: the
+    callers (ring attention) hold long sequences by construction, and
+    their per-block lse/combine math must come from one code path.
     No ``kv_lens`` support: a fully-masked row's LSE sentinel
     (+``LSE_MASKED``) would poison a cross-block max-combine."""
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
@@ -532,9 +551,11 @@ def flash_attention_block_bwd(q, k, v, o, lse, g, sm_scale: float,
     ALL blocks, and the output cotangent ``g``, returns (dq, dk, dv) for
     this block — ``p = exp(s − lse)`` are the block's columns of the
     global attention matrix, so summing dq over blocks and routing each
-    dk/dv to its block reconstructs the exact full backward. Same
-    dispatch rule as :func:`flash_attention` (Pallas kernels on TPU, XLA
-    twin off-TPU). f32 outputs (callers accumulate across blocks)."""
+    dk/dv to its block reconstructs the exact full backward. Dispatch
+    matches :func:`flash_attention_lse` (Pallas on TPU at any length,
+    XLA twin off-TPU — no short-seq routing; the lse/combine math must
+    come from one code path). f32 outputs (callers accumulate across
+    blocks)."""
     if use_xla_fallback(interpret):
         s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                        k.astype(jnp.float32)) * sm_scale

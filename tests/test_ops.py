@@ -314,3 +314,36 @@ def test_xla_fallback_empty_row_matches_kernels():
     ker = flash_attention(q, k, v, kv_lens=lens, interpret=True)
     np.testing.assert_allclose(np.asarray(out[0]), np.asarray(ker[0]),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_short_seq_dispatch_routes_to_xla(monkeypatch):
+    """Auto dispatch (interpret=None) must use the XLA path at or below
+    XLA_SHORT_SEQ even when the backend looks like a TPU (measured
+    faster on silicon at short seq), and the kernels above it."""
+    import rafiki_tpu.ops.attention as attn_mod
+    from rafiki_tpu.ops.attention import XLA_SHORT_SEQ
+
+    calls = []
+    real_ref = attn_mod._attention_reference
+    real_full = attn_mod._flash_attention_full
+
+    monkeypatch.setattr(
+        attn_mod, "_attention_reference",
+        lambda *a, **kw: (calls.append("xla"), real_ref(*a, **kw))[1])
+    monkeypatch.setattr(
+        attn_mod, "_flash_attention_full",
+        lambda *a, **kw: (calls.append("pallas"),
+                          real_full(*a[:3], *a[3:7], True))[1])
+    # pretend the backend is a TPU so use_xla_fallback(None) is False
+    monkeypatch.setattr(attn_mod, "use_xla_fallback",
+                        lambda interpret: False)
+
+    q = jnp.ones((1, 2, 8, 16), jnp.float32)
+    attn_mod.flash_attention(q, q, q)  # seq 8 <= threshold
+    assert calls == ["xla"]
+
+    calls.clear()
+    long_len = XLA_SHORT_SEQ + 8
+    ql = jnp.ones((1, 1, long_len, 16), jnp.float32)
+    attn_mod.flash_attention(ql, ql, ql)  # above threshold -> kernels
+    assert calls == ["pallas"]
