@@ -969,7 +969,8 @@ class LlamaLoRA(BaseModel):
     def make_decode_engine(self, max_slots: int = 8,
                            max_new_tokens: int = 8,
                            steps_per_sync: int = 4,
-                           prefill_chunk: int = 32):
+                           prefill_chunk: int = 32,
+                           speculate_k: int = 0):
         """Continuous-batching serving engine over this model's weights
         (BASELINE.md config #5). The inference worker drives it when
         running in decode-loop mode; see ``serving/decode_engine.py``."""
@@ -986,7 +987,8 @@ class LlamaLoRA(BaseModel):
         core = DecodeEngine(self._module(), self._params,
                             max_slots=max_slots, max_len=max_len,
                             steps_per_sync=steps_per_sync,
-                            prefill_chunk=prefill_chunk)
+                            prefill_chunk=prefill_chunk,
+                            speculate_k=speculate_k)
         return TextDecodeEngine(core, encode, self._detok,
                                 max_new=min(max_new_tokens, max_len - 1))
 

@@ -40,6 +40,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# Speculation break-even (tokens per verify call) and how many scan
+# calls to wait before re-probing a gated-off speculator. ~1.5 means a
+# draft window must beat single-token decoding by 50% to keep the
+# verify path; re-probing is cheap (one call) and content can change.
+SPEC_MIN_TOKENS_PER_CALL = 1.5
+SPEC_REPROBE_CALLS = 32
+
 
 @dataclass
 class _Slot:
@@ -70,12 +77,31 @@ class DecodeEngine:
 
     def __init__(self, module: Any, params: Any, max_slots: int,
                  max_len: int, steps_per_sync: int = 4,
-                 prefill_chunk: int = 32) -> None:
+                 prefill_chunk: int = 32, speculate_k: int = 0) -> None:
         self.module = module
         self.params = params
         self.B = int(max_slots)
         self.L = int(max_len)
         self.K = max(1, int(steps_per_sync))
+        #: >=2 enables greedy speculative decoding (prompt-lookup
+        #: drafting, no draft model): each fused call verifies
+        #: ``speculate_k - 1`` host-drafted tokens plus the model's own
+        #: next token in ONE multi-token cache step, emitting 1..k
+        #: tokens per call. Greedy-lossless: every emitted token is the
+        #: model's argmax given its prefix, so outputs are identical to
+        #: plain decoding — speculation only changes how many argmaxes
+        #: one dispatch retires. Sampling slots fall back to the scan.
+        self.spec_k = 0 if int(speculate_k) < 2 else min(int(speculate_k),
+                                                         self.L)
+        # acceptance gating: a verify call emits 1..k tokens for ONE
+        # dispatch, while the fused scan emits K for one dispatch — at
+        # low draft acceptance speculation would pay up to K× the
+        # dispatch overhead it is meant to save. Track an EMA of tokens
+        # emitted per speculative call; below the break-even floor the
+        # engine falls back to the scan and re-probes periodically
+        # (drafting quality is content-dependent and can recover).
+        self._spec_ema = float(self.spec_k)  # optimistic start
+        self._spec_idle = 0  # scan calls since the last spec attempt
         #: prompt tokens ingested per fused prefill call (1 disables the
         #: separate prefill program — prompts then stream token-by-token
         #: through the decode scan like round-3 did). C-token prefill
@@ -112,10 +138,13 @@ class DecodeEngine:
                           True: _make_step(module, self.B, self.K, True)}
         self._prefill_fn = (_make_prefill(module, self.B, self.C)
                             if self.C > 1 else None)
+        self._verify_fn = (_make_verify(module, self.B, self.spec_k)
+                           if self.spec_k else None)
         self.stats: Dict[str, int] = {
             "steps": 0, "tokens_generated": 0, "requests_done": 0,
             "max_concurrent": 0, "prefill_calls": 0,
-            "prefill_tokens": 0}
+            "prefill_tokens": 0, "spec_calls": 0, "spec_drafted": 0,
+            "spec_accepted": 0}
 
     # ---- submission / results (thread-safe: worker loop vs callers) ----
     def submit(self, request_id: Any, prompt_ids: np.ndarray,
@@ -170,6 +199,8 @@ class DecodeEngine:
         self._topp[:] = 1.0
         self._seed[:] = 0
         self._prompt_dev = None
+        self._spec_ema = float(self.spec_k)
+        self._spec_idle = 0
         self._cache = self.module.init(
             jax.random.PRNGKey(0), jnp.zeros((self.B, 1), jnp.int32),
             decode=True)["cache"]
@@ -255,6 +286,20 @@ class DecodeEngine:
         any_sampling = bool(any(
             self._slots[i] is not None and self._slots[i].temperature > 0
             for i in range(self.B)))
+        # speculative path: all live slots greedy, past their prompts,
+        # room for a full draft window in the cache, and recent
+        # acceptance above break-even (or a periodic re-probe) —
+        # otherwise this fused call runs the plain scan (the paths
+        # interleave freely call-to-call; both emit exact argmax tokens)
+        if (self._verify_fn is not None and not any_sampling
+                and (self._spec_ema >= SPEC_MIN_TOKENS_PER_CALL
+                     or self._spec_idle >= SPEC_REPROBE_CALLS)
+                and all(self._pos[i] >= len(self._slots[i].prompt) - 1
+                        and int(self._pos[i]) + self.spec_k <= self.L
+                        for i in live)):
+            return self._speculative_step(live)
+        if self._verify_fn is not None:
+            self._spec_idle += 1
         self._cache, emitted = self._step_fns[any_sampling](
             self.params, self._cache, jnp.asarray(self._tok),
             jnp.asarray(self._pos), self._prompt_dev,
@@ -299,6 +344,86 @@ class DecodeEngine:
                 self._done.extend(finished)
                 self.stats["requests_done"] += len(finished)
         return len(live)
+
+    def _speculative_step(self, live: List[int]) -> int:
+        """One verify call: host-drafted continuations for every live
+        slot ride through a single multi-token cache step; each slot
+        emits its accepted prefix plus the model's own token at the
+        first mismatch (1..spec_k tokens). Rejected drafts leave stale
+        KV rows ABOVE the slot's new position — unreachable by the
+        position mask, and rewritten in place when generation reaches
+        them (the admission-reuse invariant already relies on this)."""
+        k = self.spec_k
+        drafts = np.zeros((self.B, k - 1), np.int32)
+        for i in live:
+            s = self._slots[i]
+            ctx = np.concatenate(
+                [s.prompt, np.asarray(s.generated, np.int32)])
+            drafts[i] = _ngram_draft(ctx, k - 1)
+        self._cache, g, n_emit = self._verify_fn(
+            self.params, self._cache, jnp.asarray(self._tok),
+            jnp.asarray(self._pos), jnp.asarray(drafts),
+            jnp.asarray(self._stop_pos))
+        g = np.asarray(g)            # (B, k) model argmax per position
+        n_emit = np.asarray(n_emit)  # (B,) 1 + accepted draft prefix
+        self.stats["steps"] += 1
+        self.stats["spec_calls"] += 1
+        self._spec_idle = 0
+        self._spec_ema = (0.8 * self._spec_ema
+                          + 0.2 * float(np.mean(n_emit[live])))
+
+        finished: List[Tuple[Any, List[int]]] = []
+        for i in live:
+            slot = self._slots[i]
+            pos0 = int(self._pos[i])
+            take = max(1, min(int(n_emit[i]),
+                              int(self._stop_pos[i]) - pos0,
+                              self.L - pos0))
+            slot.generated.extend(int(t) for t in g[i, :take])
+            slot.n_consumed += take
+            self._pos[i] = pos0 + take
+            self.stats["tokens_generated"] += take
+            self.stats["spec_drafted"] += k - 1
+            self.stats["spec_accepted"] += take - 1
+            if (len(slot.generated) >= slot.max_new
+                    or int(self._pos[i]) >= self.L):
+                finished.append((slot.request_id, slot.generated))
+                self._slots[i] = None
+                self._tok[i] = 0
+                self._pos[i] = 0
+                self._prompt_len[i] = 1
+                self._stop_pos[i] = 0
+            else:
+                self._tok[i] = slot.generated[-1]
+        if finished:
+            with self._lock:
+                self._done.extend(finished)
+                self.stats["requests_done"] += len(finished)
+        return len(live)
+
+
+def _ngram_draft(context: np.ndarray, k: int, max_n: int = 3) -> np.ndarray:
+    """Prompt-lookup drafting: find the longest (≤ ``max_n``) suffix
+    n-gram of ``context`` with an earlier occurrence and propose the
+    ``k`` tokens that followed its most recent match; repeat-last when
+    nothing matches. Pure host-side numpy — drafting costs no device
+    time, and a bad draft costs nothing but its rejected verify lanes."""
+    ctx = np.asarray(context, np.int32).ravel()
+    n_ctx = len(ctx)
+    for n in range(min(max_n, n_ctx - 1), 0, -1):
+        suffix = ctx[n_ctx - n:]
+        # windows over ctx[:-1]: every start whose n-gram ends before
+        # the suffix's own final token
+        windows = np.lib.stride_tricks.sliding_window_view(ctx[:-1], n)
+        hits = np.nonzero(np.all(windows == suffix, axis=1))[0]
+        if len(hits):
+            j = int(hits[-1]) + n  # continuation of the latest match
+            cont = ctx[j:j + k]
+            if len(cont) < k:
+                cont = np.concatenate(
+                    [cont, np.full(k - len(cont), ctx[-1], np.int32)])
+            return cont.astype(np.int32)
+    return np.full(k, ctx[-1], np.int32)
 
 
 def _select_next(logits, temp, top_k, top_p, seed, pos):
@@ -378,6 +503,37 @@ def _make_step(module: Any, n_slots: int, k: int,
         return cache, emitted  # (K, n_slots)
 
     return step_fn
+
+
+@functools.lru_cache(maxsize=8)
+def _make_verify(module: Any, n_slots: int, k: int) -> Callable:
+    """One speculative verify step: feed each slot's current token plus
+    its k-1 drafted continuations at positions pos..pos+k-1 through the
+    decode-cache path (the chunked-prefill machinery — KV for the whole
+    window is written before attention, and each query only sees keys
+    at-or-before its own position). ``g[:, j]`` is the model's argmax
+    AFTER input j, so draft j+1 is correct iff it equals ``g[:, j]``;
+    ``n_emit`` = 1 + the length of the all-correct draft prefix — every
+    emitted token is conditioned only on accepted history, which is what
+    makes greedy speculation lossless. Free/finished slots re-feed their
+    current token at their current position (an idempotent rewrite)."""
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def verify_fn(params, cache, tok, pos, drafts, stop_pos):
+        active = (pos < stop_pos)[:, None]
+        offs = jnp.arange(k)[None, :]
+        seq = jnp.concatenate([tok[:, None], drafts], axis=1)
+        seq = jnp.where(active, seq, tok[:, None])
+        positions = jnp.where(active, pos[:, None] + offs, pos[:, None])
+        logits, muts = module.apply(
+            {"params": params, "cache": cache}, seq,
+            positions=positions, decode=True, mutable=["cache"])
+        g = jnp.argmax(logits.astype(jnp.float32), -1).astype(jnp.int32)
+        ok = jnp.cumprod((drafts == g[:, :-1]).astype(jnp.int32), axis=1)
+        n_emit = 1 + jnp.sum(ok, axis=1).astype(jnp.int32)
+        return muts["cache"], g, n_emit
+
+    return verify_fn
 
 
 @functools.lru_cache(maxsize=8)

@@ -42,7 +42,8 @@ class InferenceWorker:
                  knobs: dict, param_store: ParamStore, hub: QueueHub,
                  worker_id: str, max_batch_msgs: int = 16,
                  decode_loop: bool = False, max_slots: int = 8,
-                 max_new_tokens: int = 8, steps_per_sync: int = 4) -> None:
+                 max_new_tokens: int = 8, steps_per_sync: int = 4,
+                 speculate_k: int = 0) -> None:
         self.worker_id = worker_id
         self.hub = hub
         self.max_batch_msgs = max_batch_msgs
@@ -60,9 +61,12 @@ class InferenceWorker:
         self.engine = None
         if decode_loop:
             if hasattr(self.model, "make_decode_engine"):
+                # speculate_k only rides when set: user templates that
+                # predate the kwarg keep working at the default
+                extra = {"speculate_k": speculate_k} if speculate_k else {}
                 self.engine = self.model.make_decode_engine(
                     max_slots=max_slots, max_new_tokens=max_new_tokens,
-                    steps_per_sync=steps_per_sync)
+                    steps_per_sync=steps_per_sync, **extra)
             else:
                 # the stack enables decode_loop for every LM-task model;
                 # a template without an engine still serves fine through
@@ -353,7 +357,8 @@ def main(argv: Optional[list] = None) -> int:
         decode_loop=bool(cfg.get("decode_loop")),
         max_slots=int(cfg.get("max_slots", 8)),
         steps_per_sync=int(cfg.get("steps_per_sync", 4)),
-        max_new_tokens=int(cfg.get("max_new_tokens", 8)))
+        max_new_tokens=int(cfg.get("max_new_tokens", 8)),
+        speculate_k=int(cfg.get("speculate_k", 0)))
     print(f"inference worker {worker.worker_id} serving", flush=True)
     worker.run()
     return 0

@@ -420,3 +420,117 @@ def test_sampling_flows_through_serving_stack(trained):
     finally:
         worker.stop()
         wt.join(timeout=10)
+
+
+def test_ngram_draft():
+    from rafiki_tpu.serving.decode_engine import _ngram_draft
+
+    # suffix [7, 8] occurred earlier, followed by 9, 3 — draft those
+    ctx = np.asarray([5, 7, 8, 9, 3, 1, 7, 8], np.int32)
+    np.testing.assert_array_equal(_ngram_draft(ctx, 2), [9, 3])
+    # continuation shorter than k pads with the last context token
+    np.testing.assert_array_equal(_ngram_draft(ctx, 5), [9, 3, 1, 7, 8])
+    # no n-gram recurrence -> repeat-last fallback
+    np.testing.assert_array_equal(
+        _ngram_draft(np.asarray([4, 6, 2], np.int32), 3), [2, 2, 2])
+    # degenerate single-token context
+    np.testing.assert_array_equal(
+        _ngram_draft(np.asarray([9], np.int32), 2), [9, 9])
+
+
+def test_speculative_engine_matches_plain_greedy(trained):
+    """Speculation must be lossless: identical tokens to the plain
+    engine whether drafts hit (repetitive prompts) or miss (arbitrary
+    prompts), across mid-flight admission and slot reuse."""
+    module, params = _module_and_params(trained)
+    prompts = [
+        np.asarray([1, 5, 9, 13], np.int32),              # arbitrary
+        np.asarray([1, 7, 2, 7, 2, 7, 2], np.int32),      # repetitive
+        np.asarray([1, 3], np.int32),
+    ]
+    max_new = 10
+
+    def run(spec_k):
+        eng = DecodeEngine(module, params, max_slots=2, max_len=32,
+                           speculate_k=spec_k)
+        for i, p in enumerate(prompts):   # 3 requests, 2 slots: reuse
+            eng.submit(i, p, max_new)
+        done = {}
+        for _ in range(200):
+            eng.step()
+            done.update(dict(eng.poll()))
+            if len(done) == len(prompts):
+                return done, eng.stats
+        raise AssertionError(f"undrained: {sorted(done)}")
+
+    plain, _ = run(0)
+    spec, stats = run(4)
+    assert stats["spec_calls"] > 0
+    assert stats["spec_drafted"] > 0
+    for i in range(len(prompts)):
+        np.testing.assert_array_equal(np.asarray(spec[i]),
+                                      np.asarray(plain[i]))
+    # the model was trained on repetitive synthetic text: at least one
+    # draft must have been accepted across these runs (the speedup
+    # exists), and acceptances never exceed drafts
+    assert 0 <= stats["spec_accepted"] <= stats["spec_drafted"]
+
+
+def test_speculative_engine_sampling_falls_back(trained):
+    """A sampling request in the batch must force the exact sampler
+    path — outputs identical to a non-speculative engine under the same
+    seeds."""
+    module, params = _module_and_params(trained)
+    p = np.asarray([1, 5, 9], np.int32)
+
+    def run(spec_k):
+        eng = DecodeEngine(module, params, max_slots=2, max_len=32,
+                           speculate_k=spec_k)
+        eng.submit("g", p, 6)  # greedy
+        eng.submit("s", p, 6, temperature=0.8, top_k=5, seed=7)
+        done = {}
+        for _ in range(100):
+            eng.step()
+            done.update(dict(eng.poll()))
+            if len(done) == 2:
+                return done, eng.stats
+        raise AssertionError("undrained")
+
+    plain, _ = run(0)
+    spec, stats = run(4)
+    np.testing.assert_array_equal(np.asarray(spec["g"]),
+                                  np.asarray(plain["g"]))
+    np.testing.assert_array_equal(np.asarray(spec["s"]),
+                                  np.asarray(plain["s"]))
+    assert stats["spec_calls"] == 0  # sampling present -> scan path
+
+
+def test_speculation_gates_off_at_low_acceptance(trained):
+    """When drafts rarely hit, the EMA gate must return traffic to the
+    amortized scan (and re-probe later) rather than paying one dispatch
+    per token forever."""
+    from rafiki_tpu.serving import decode_engine as de
+
+    module, params = _module_and_params(trained)
+    eng = DecodeEngine(module, params, max_slots=2, max_len=32,
+                       speculate_k=4)
+    # force the worst case: pretend every verify call emitted 1 token
+    eng._spec_ema = 1.0
+    eng.submit("x", np.asarray([1, 5, 9], np.int32), 8)
+    eng.step()
+    calls_before = eng.stats["spec_calls"]
+    for _ in range(4):
+        eng.step()
+    # gated: the scan path served these calls
+    assert eng.stats["spec_calls"] == calls_before
+    assert eng._spec_idle > 0
+    # re-probe fires once the idle budget is spent
+    eng._spec_idle = de.SPEC_REPROBE_CALLS
+    eng.submit("y", np.asarray([1, 7, 2, 7, 2], np.int32), 8)
+    drained = 0
+    for _ in range(50):
+        eng.step()
+        drained += len(eng.poll())
+        if drained >= 2 and eng.stats["spec_calls"] > calls_before:
+            break
+    assert eng.stats["spec_calls"] > calls_before
