@@ -91,20 +91,47 @@ class RMSNorm(nn.Module):
 
 
 class LoRADense(nn.Module):
-    """Frozen base kernel + trainable low-rank adapter (classic LoRA)."""
+    """Frozen base kernel + trainable low-rank adapter (classic LoRA).
+
+    ``quantized=True`` swaps the f32 base kernel for an int8 tensor plus
+    per-output-channel f32 scales (symmetric absmax — see
+    :func:`quantize_llama_params`). Serving-only post-training
+    quantization: persistent weight HBM drops 4x and the decode loop —
+    HBM-bandwidth-bound at batch 1..slots — reads a quarter of the
+    bytes per step. Most kernels are the frozen LoRA bases (their
+    trained signal lives in the f32 adapters); the trained ``lm_head``
+    kernel is quantized too, with per-channel error ≤ absmax/254 —
+    standard W8 PTQ, logits-closeness covered by tests. The int8
+    operand feeds the matmul directly (one convert, the most fusable
+    form) and the channel scale applies to the OUTPUT, never
+    materializing a dequantized kernel; adapters/norms/embeddings stay
+    full precision.
+    """
 
     features: int
     rank: int = 0
     alpha: float = 16.0
+    quantized: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         d_in = x.shape[-1]
-        kernel = self.param("kernel", nn.initializers.lecun_normal(),
-                            (d_in, self.features))
-        # compute in x's dtype (params stay f32): a bf16 activation must
-        # not promote the matmul to f32, which costs ~3x on the MXU
-        y = x @ kernel.astype(x.dtype)
+        if self.quantized:
+            qk = self.param("qkernel", nn.initializers.zeros,
+                            (d_in, self.features), jnp.int8)
+            qs = self.param("qscale", nn.initializers.ones,
+                            (self.features,))
+            # scale on the small (…, features) output, not the kernel:
+            # (x @ q) * s == x @ (q * s) with b·f elementwise work
+            # instead of d_in·f, and the dot consumes a bare int8→dtype
+            # convert (fuses; no dequantized kernel ever materializes)
+            y = (x @ qk.astype(x.dtype)) * qs.astype(x.dtype)
+        else:
+            kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                                (d_in, self.features))
+            # compute in x's dtype (params stay f32): a bf16 activation
+            # must not promote the matmul to f32 (~3x cost on the MXU)
+            y = x @ kernel.astype(x.dtype)
         if self.rank > 0:
             a = self.param("lora_a", nn.initializers.normal(0.02),
                            (d_in, self.rank))
@@ -120,15 +147,18 @@ class _DecoderAttention(nn.Module):
     n_kv_heads: int
     max_len: int
     lora_rank: int
+    quantized: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, lens: jnp.ndarray,
                  positions: jnp.ndarray, decode: bool) -> jnp.ndarray:
         b, s, d = x.shape
         dh = d // self.n_heads
-        q = LoRADense(self.n_heads * dh, self.lora_rank, name="wq")(x)
-        k = LoRADense(self.n_kv_heads * dh, self.lora_rank, name="wk")(x)
-        v = LoRADense(self.n_kv_heads * dh, self.lora_rank, name="wv")(x)
+        dense = functools.partial(LoRADense, rank=self.lora_rank,
+                                  quantized=self.quantized)
+        q = dense(self.n_heads * dh, name="wq")(x)
+        k = dense(self.n_kv_heads * dh, name="wk")(x)
+        v = dense(self.n_kv_heads * dh, name="wv")(x)
         q = rope(q.reshape(b, s, self.n_heads, dh), positions)
         k = rope(k.reshape(b, s, self.n_kv_heads, dh), positions)
         v = v.reshape(b, s, self.n_kv_heads, dh)
@@ -189,7 +219,7 @@ class _DecoderAttention(nn.Module):
                                 causal=True, kv_lens=lens)
             o = o.transpose(0, 2, 1, 3)
         o = o.reshape(b, s, self.n_heads * dh)
-        return LoRADense(d, self.lora_rank, name="wo")(o)
+        return dense(d, name="wo")(o)
 
 
 class _DecoderBlock(nn.Module):
@@ -200,11 +230,13 @@ class _DecoderBlock(nn.Module):
     lora_rank: int
     n_experts: int = 0  # >0 → MoE FFN (expert-parallel, ops/moe.py)
     moe_top_k: int = 1  # experts per token (1 Switch, 2 Mixtral-style)
+    quantized: bool = False  # int8 base kernels (MoE experts stay f32)
 
     @nn.compact
     def __call__(self, x, lens, positions, decode):
         x = x + _DecoderAttention(
             self.n_heads, self.n_kv_heads, self.max_len, self.lora_rank,
+            quantized=self.quantized,
             name="attn")(RMSNorm()(x), lens, positions, decode)
         y = RMSNorm()(x)
         if self.n_experts > 0:
@@ -213,10 +245,12 @@ class _DecoderBlock(nn.Module):
             return x + MoEFeedForward(self.n_experts, self.mlp_dim,
                                       router_top_k=self.moe_top_k,
                                       name="moe")(y)
-        gate = LoRADense(self.mlp_dim, self.lora_rank, name="gate")(y)
-        up = LoRADense(self.mlp_dim, self.lora_rank, name="up")(y)
+        dense = functools.partial(LoRADense, rank=self.lora_rank,
+                                  quantized=self.quantized)
+        gate = dense(self.mlp_dim, name="gate")(y)
+        up = dense(self.mlp_dim, name="up")(y)
         y = nn.silu(gate) * up  # SwiGLU
-        return x + LoRADense(x.shape[-1], self.lora_rank, name="down")(y)
+        return x + dense(x.shape[-1], name="down")(y)
 
 
 class Llama(nn.Module):
@@ -247,6 +281,9 @@ class Llama(nn.Module):
     n_experts: int = 0
     # experts per token when n_experts > 0 (1 Switch, 2 Mixtral-style)
     moe_top_k: int = 1
+    # serving-only int8 weight quantization of the LoRADense base
+    # kernels (see LoRADense.quantized / quantize_llama_params)
+    quantized: bool = False
 
     @nn.compact
     def __call__(self, ids: jnp.ndarray, lens: Optional[jnp.ndarray] = None,
@@ -273,6 +310,7 @@ class Llama(nn.Module):
                           self.max_len, self.lora_rank,
                           n_experts=self.n_experts,
                           moe_top_k=self.moe_top_k,
+                          quantized=self.quantized,
                           name=f"block_{i}")(x, lens, positions, decode)
         x = RMSNorm(name="final_norm")(x)
         if return_hidden:
@@ -282,7 +320,8 @@ class Llama(nn.Module):
             # (B, L, vocab) logits. lm_head params still initialize via
             # the default trace.
             return x
-        return LoRADense(self.vocab_size, 0, name="lm_head")(x)
+        return LoRADense(self.vocab_size, 0, quantized=self.quantized,
+                         name="lm_head")(x)
 
 
 def lm_loss_terms(logits: jnp.ndarray, ids: jnp.ndarray,
@@ -363,6 +402,42 @@ def chunked_lm_loss_terms(hidden: jnp.ndarray, head_kernel: jnp.ndarray,
     total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
                             (hs, ts, vs))
     return total, count
+
+
+def quantize_llama_params(params: Any) -> Any:
+    """f32 param tree → the ``quantized=True`` module's tree: every
+    LoRADense base ``kernel`` becomes int8 ``qkernel`` + per-output-
+    channel f32 ``qscale`` (symmetric absmax: scale = max|col| / 127);
+    adapters, norms, embeddings, and MoE experts pass through unchanged.
+
+    Weight-only post-training quantization for SERVING: persistent
+    weight HBM drops 4x and the bandwidth-bound decode loop reads a
+    quarter of the bytes. Most kernels are LoRA-frozen bases whose
+    trained signal lives in the untouched f32 adapters; the trained
+    ``lm_head`` kernel is quantized too (standard W8 PTQ — its
+    per-element error is bounded like the rest). Reconstruction error
+    is bounded by scale/2 per element (≤ ~0.4% of each channel's
+    absmax); training and evaluate() keep the f32 originals.
+    """
+    def walk(tree: Any) -> Any:
+        if not isinstance(tree, dict):
+            return tree
+        out = {}
+        for name, sub in tree.items():
+            if (isinstance(sub, dict) and "kernel" in sub
+                    and getattr(sub["kernel"], "ndim", 0) == 2):
+                k = jnp.asarray(sub["kernel"], jnp.float32)
+                scale = jnp.maximum(jnp.max(jnp.abs(k), axis=0), 1e-8) / 127.0
+                q = jnp.clip(jnp.round(k / scale[None, :]),
+                             -127, 127).astype(jnp.int8)
+                out[name] = {"qkernel": q, "qscale": scale,
+                             **{kk: vv for kk, vv in sub.items()
+                                if kk != "kernel"}}
+            else:
+                out[name] = walk(sub)
+        return out
+
+    return walk(params)
 
 
 def stack_block_params(params: Any, depth: int, n_stages: int) -> Any:
@@ -559,6 +634,12 @@ class LlamaLoRA(BaseModel):
             "moe_top_k": FixedKnob(1),
             "quick_train": PolicyKnob("QUICK_TRAIN"),
             "share_params": PolicyKnob("SHARE_PARAMS"),
+            # serve with int8 weight-only-quantized base kernels
+            # (quantize_llama_params): 4x less weight HBM for the
+            # bandwidth-bound decode loop. predict()/make_decode_engine
+            # only — training and evaluate() (the tuning objective)
+            # stay full precision.
+            "quantize_int8": FixedKnob(False),
             # serving-quality runs: a trained byte-BPE artifact
             # (data/bpe.py) replaces the hash tokenizer, and an
             # HF-convention safetensors checkpoint (models/convert.py)
@@ -570,6 +651,7 @@ class LlamaLoRA(BaseModel):
     def __init__(self, **knobs: Any) -> None:
         super().__init__(**knobs)
         self._params: Optional[Any] = None
+        self._qparams: Optional[Any] = None  # lazy int8 serving tree
         self._id2tok: Dict[int, str] = {}
         self._fwd: Optional[Any] = None
         tok_path = str(self.knobs.get("tokenizer_path") or "")
@@ -584,7 +666,7 @@ class LlamaLoRA(BaseModel):
                                                               1 << 14)))
 
     # ---- internals ----
-    def _module(self) -> Llama:
+    def _module(self, quantized: bool = False) -> Llama:
         k = self.knobs
         hd = int(k["hidden_dim"])
         heads = int(k["n_heads"])
@@ -597,7 +679,18 @@ class LlamaLoRA(BaseModel):
                      dtype=self._dtype(),
                      remat=bool(k.get("remat", False)),
                      n_experts=int(k.get("moe_experts", 0)),
-                     moe_top_k=int(k.get("moe_top_k", 1) or 1))
+                     moe_top_k=int(k.get("moe_top_k", 1) or 1),
+                     quantized=quantized)
+
+    def _serving_module_params(self) -> Tuple[Llama, Any]:
+        """(module, params) for predict()/make_decode_engine — the int8
+        pair when the quantize_int8 knob is set (quantized once per
+        trained tree, then cached)."""
+        if not self.knobs.get("quantize_int8"):
+            return self._module(), self._params
+        if self._qparams is None:
+            self._qparams = quantize_llama_params(self._params)
+        return self._module(quantized=True), self._qparams
 
     def _dtype(self):
         # single source of truth for the bf16 knob → compute dtype
@@ -856,8 +949,9 @@ class LlamaLoRA(BaseModel):
 
         ctx.logger.define_plot("LM loss", ["loss"], x_axis="epoch")
         # donation invalidates buffers that may alias self._params (warm
-        # start / re-train): drop the stale reference first
+        # start / re-train): drop the stale references first
         self._params = None
+        self._qparams = None
         with mesh:
             for epoch in range(epochs):
                 (params, opt_state), mean_loss = train_epoch(
@@ -881,6 +975,7 @@ class LlamaLoRA(BaseModel):
                         not ctx.should_continue(epoch, -mean_loss):
                     break
         self._params = params
+        self._qparams = None
         self._fwd = None
 
     def evaluate(self, dataset_path: str) -> float:
@@ -945,8 +1040,8 @@ class LlamaLoRA(BaseModel):
             ids[n:, 0] = BOS_ID
             lens = np.concatenate(
                 [lens, np.ones((bucket - n,), lens.dtype)])
-        module = self._module()
-        out = np.asarray(greedy_generate(module, self._params, ids, lens,
+        module, params = self._serving_module_params()
+        out = np.asarray(greedy_generate(module, params, ids, lens,
                                          max_new))[:n]
         return [self._detok(row) for row in out]
 
@@ -984,7 +1079,8 @@ class LlamaLoRA(BaseModel):
             row, n = self.tokenizer.encode(str(text), max_len)
             return row[:max(1, int(n))]
 
-        core = DecodeEngine(self._module(), self._params,
+        module, params = self._serving_module_params()
+        core = DecodeEngine(module, params,
                             max_slots=max_slots, max_len=max_len,
                             steps_per_sync=steps_per_sync,
                             prefill_chunk=prefill_chunk,
@@ -1017,6 +1113,7 @@ class LlamaLoRA(BaseModel):
             self.tokenizer = ByteBPETokenizer(
                 [tuple(int(x) for x in m) for m in merges])
         self._params = jax.tree_util.tree_map(jnp.asarray, params["params"])
+        self._qparams = None
         self._fwd = None
 
 
