@@ -50,6 +50,7 @@ def test_vit_trains_data_parallel(tmp_path):
     assert len(losses) >= 2 and losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_vit_bf16_compute_keeps_f32_params():
     """The bf16 knob must give bf16 ACTIVATIONS with f32 params — a
     promotion regression would silently triple MXU cost on TPU."""

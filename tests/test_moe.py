@@ -79,6 +79,7 @@ def test_moe_matches_manual_expert_compute():
                                atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_moe_grads_flow_to_router_and_experts():
     m = MoEFeedForward(n_experts=2, mlp_dim=8, capacity_factor=2.0)
     x = jax.random.normal(jax.random.PRNGKey(2), (1, 8, 4))
@@ -240,6 +241,7 @@ def test_top2_overflow_drops_second_choice_first():
     assert (token_gates <= 1.0 + 1e-6).all()
 
 
+@pytest.mark.slow
 def test_llama_moe_top_k_plumbed():
     """The moe_top_k field reaches MoEFeedForward (top-2 capacity is
     larger, param shapes identical, forward runs)."""

@@ -155,6 +155,7 @@ def test_ring_backward_residuals_are_o_seq_over_p():
         "backward is retaining per-step K/V copies"
 
 
+@pytest.mark.slow
 def test_flash_attention_lse_matches_xla_twin():
     """flash_attention_lse through the Pallas interpreter == XLA twin,
     for out, lse, AND gradients through a loss that consumes BOTH (the
