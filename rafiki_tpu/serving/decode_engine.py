@@ -46,6 +46,10 @@ import numpy as np
 # verify path; re-probing is cheap (one call) and content can change.
 SPEC_MIN_TOKENS_PER_CALL = 1.5
 SPEC_REPROBE_CALLS = 32
+# EMA decay for tokens-per-verify-call: 0.7 gates hopeless content off
+# after ~2 zero-acceptance calls (start is just above the floor) while
+# a healthy acceptance stream keeps the path on indefinitely
+SPEC_EMA_DECAY = 0.7
 
 
 @dataclass
@@ -100,7 +104,9 @@ class DecodeEngine:
         # emitted per speculative call; below the break-even floor the
         # engine falls back to the scan and re-probes periodically
         # (drafting quality is content-dependent and can recover).
-        self._spec_ema = float(self.spec_k)  # optimistic start
+        #: start just above the floor: good content proves itself on
+        #: call 1; bad content is gated after ~2 calls
+        self._spec_ema = SPEC_MIN_TOKENS_PER_CALL + 0.5
         self._spec_idle = 0  # scan calls since the last spec attempt
         #: prompt tokens ingested per fused prefill call (1 disables the
         #: separate prefill program — prompts then stream token-by-token
@@ -199,7 +205,7 @@ class DecodeEngine:
         self._topp[:] = 1.0
         self._seed[:] = 0
         self._prompt_dev = None
-        self._spec_ema = float(self.spec_k)
+        self._spec_ema = SPEC_MIN_TOKENS_PER_CALL + 0.5
         self._spec_idle = 0
         self._cache = self.module.init(
             jax.random.PRNGKey(0), jnp.zeros((self.B, 1), jnp.int32),
@@ -369,8 +375,9 @@ class DecodeEngine:
         self.stats["steps"] += 1
         self.stats["spec_calls"] += 1
         self._spec_idle = 0
-        self._spec_ema = (0.8 * self._spec_ema
-                          + 0.2 * float(np.mean(n_emit[live])))
+        self._spec_ema = (SPEC_EMA_DECAY * self._spec_ema
+                          + (1 - SPEC_EMA_DECAY)
+                          * float(np.mean(n_emit[live])))
 
         finished: List[Tuple[Any, List[int]]] = []
         for i in live:

@@ -1,5 +1,7 @@
 """ViT template: contract conformance + DP sharding on the virtual mesh."""
 
+import functools
+
 import pytest
 
 import jax
@@ -129,3 +131,24 @@ def test_remat_identical_math_smaller_residuals():
     assert "remat" in jaxpr or "checkpoint" in jaxpr
     assert "remat" not in str(
         jax.make_jaxpr(jax.grad(loss(plain)))(params))
+
+
+def test_vit_bf16_dtype_invariants_shape_level():
+    """Fast-leg twin of test_vit_bf16_compute_keeps_f32_params (slow):
+    the same bf16-activations / f32-params / f32-logits invariant via
+    jax.eval_shape — no compute, so a dtype-promotion regression is
+    still caught by the default test run."""
+    import jax.numpy as jnp
+
+    m = ViT(patch_size=4, hidden_dim=64, depth=1, n_heads=4, mlp_dim=128,
+            n_classes=5, dtype=jnp.bfloat16)
+    x = jax.ShapeDtypeStruct((2, 16, 16, 3), jnp.bfloat16)
+    variables = jax.eval_shape(m.init, jax.random.PRNGKey(0), x)
+    assert all(p.dtype == jnp.float32
+               for p in jax.tree_util.tree_leaves(variables["params"]))
+    out, state = jax.eval_shape(
+        functools.partial(m.apply, capture_intermediates=True),
+        {"params": variables["params"]}, x)
+    block_out = state["intermediates"]["block_0"]["__call__"][0]
+    assert block_out.dtype == jnp.bfloat16, block_out.dtype
+    assert out.dtype == jnp.float32

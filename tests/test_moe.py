@@ -255,3 +255,25 @@ def test_llama_moe_top_k_plumbed():
     out, muts = m.apply({"params": params}, ids, mutable=["losses"])
     assert out.shape == (2, 8, 64)
     assert float(moe_aux_loss(muts)) > 0
+
+
+def test_moe_layer_grads_flow_fast():
+    """Fast-leg twin of test_moe_grads_flow_to_router_and_experts
+    (slow): nonzero router + expert gradients at LAYER level — cheap
+    enough for the default run."""
+    moe = MoEFeedForward(n_experts=2, mlp_dim=16, capacity_factor=2.0)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 8, 8))
+    variables = moe.init(jax.random.PRNGKey(1), x)
+
+    def loss(params):
+        out, muts = moe.apply({"params": params}, x, mutable=["losses"])
+        return jnp.sum(out ** 2) + moe_aux_loss(muts)
+
+    g = jax.grad(loss)(variables["params"])
+    leaves = {"/".join(str(getattr(k, "key", k)) for k in kp):
+              np.abs(np.asarray(v)).max()
+              for kp, v in jax.tree_util.tree_flatten_with_path(g)[0]}
+    router = [v for n, v in leaves.items() if "router" in n]
+    experts = [v for n, v in leaves.items() if "experts" in n]
+    assert router and max(router) > 0
+    assert experts and max(experts) > 0
