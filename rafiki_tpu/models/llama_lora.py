@@ -1065,7 +1065,8 @@ class LlamaLoRA(BaseModel):
                            max_new_tokens: int = 8,
                            steps_per_sync: int = 4,
                            prefill_chunk: int = 32,
-                           speculate_k: int = 0):
+                           speculate_k: int = 0,
+                           system_prefix: str = ""):
         """Continuous-batching serving engine over this model's weights
         (BASELINE.md config #5). The inference worker drives it when
         running in decode-loop mode; see ``serving/decode_engine.py``."""
@@ -1085,8 +1086,12 @@ class LlamaLoRA(BaseModel):
                             steps_per_sync=steps_per_sync,
                             prefill_chunk=prefill_chunk,
                             speculate_k=speculate_k)
-        return TextDecodeEngine(core, encode, self._detok,
-                                max_new=min(max_new_tokens, max_len - 1))
+        text_engine = TextDecodeEngine(
+            core, encode, self._detok,
+            max_new=min(max_new_tokens, max_len - 1))
+        if system_prefix:
+            text_engine.register_prefix(system_prefix)
+        return text_engine
 
     def dump_parameters(self) -> Dict[str, Any]:
         assert self._params is not None, "model is not trained"

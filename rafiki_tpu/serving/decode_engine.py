@@ -146,11 +146,16 @@ class DecodeEngine:
                             if self.C > 1 else None)
         self._verify_fn = (_make_verify(module, self.B, self.spec_k)
                            if self.spec_k else None)
+        #: registered shared prefix (system prompt): token ids, its
+        #: precomputed 1-row KV cache, and its length. Requests whose
+        #: prompt extends it skip its prefill — admission copies the
+        #: snapshot rows into the slot's cache (bandwidth, not compute).
+        self._prefix: Optional[Dict[str, Any]] = None
         self.stats: Dict[str, int] = {
             "steps": 0, "tokens_generated": 0, "requests_done": 0,
             "max_concurrent": 0, "prefill_calls": 0,
             "prefill_tokens": 0, "spec_calls": 0, "spec_drafted": 0,
-            "spec_accepted": 0}
+            "spec_accepted": 0, "prefix_hits": 0, "prefix_tokens": 0}
 
     # ---- submission / results (thread-safe: worker loop vs callers) ----
     def submit(self, request_id: Any, prompt_ids: np.ndarray,
@@ -180,6 +185,53 @@ class DecodeEngine:
         with self._lock:
             done, self._done = self._done, []
         return done
+
+    def register_prefix(self, prefix_ids: np.ndarray) -> int:
+        """Precompute the KV cache of a shared prompt prefix (system
+        prompt). Any later request whose prompt strictly extends these
+        tokens skips their prefill: admission copies the snapshot's KV
+        rows into the slot's cache — a device copy at HBM bandwidth
+        instead of ``len(prefix)`` of model forward compute. Exact by
+        construction (the copied KV is the same math prefill would
+        produce); one prefix at a time (re-register to replace).
+        Returns the registered length (truncated to leave room for at
+        least one prompt token + one generated token). Not safe to call
+        concurrently with ``step`` (register before serving traffic, or
+        between steps)."""
+        prefix = np.asarray(prefix_ids, np.int32).ravel()[:self.L - 2]
+        if len(prefix) == 0:
+            self._prefix = None
+            return 0
+        cache1 = self.module.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32),
+            decode=True)["cache"]
+        # one multi-token cache pass over the prefix (same program shape
+        # as chunked prefill, batch 1, chunk = len(prefix))
+        fill = _make_prefill(self.module, 1, len(prefix))
+        snap = fill(self.params, cache1, jnp.asarray(prefix[None, :]),
+                    jnp.arange(len(prefix), dtype=jnp.int32)[None, :])
+        plen = len(prefix)
+
+        # jitted once per registration (compile cache keys on the rows
+        # count, bounded by max_slots); donate: in-place cache update
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def install(cache, pre, rws):
+            return jax.tree_util.tree_map(
+                lambda c, p: c.at[rws, :plen].set(
+                    p[:, :plen].astype(c.dtype)), cache, pre)
+
+        self._prefix = {"ids": prefix, "cache": jax.block_until_ready(snap),
+                        "len": plen, "install": install}
+        return plen
+
+    def _install_prefix(self, rows: List[int],
+                        pre: Dict[str, Any]) -> None:
+        """Copy prefix ``pre``'s KV rows into the given slots (the
+        same snapshot admission matched/fast-forwarded against)."""
+        self._cache = pre["install"](
+            self._cache, pre["cache"], jnp.asarray(rows, jnp.int32))
+        self.stats["prefix_hits"] += len(rows)
+        self.stats["prefix_tokens"] += pre["len"] * len(rows)
 
     @property
     def busy(self) -> bool:
@@ -259,6 +311,8 @@ class DecodeEngine:
         count (at admission time)."""
         with self._lock:
             admitted = False
+            prefix_rows: List[int] = []
+            pre = self._prefix
             for i in range(self.B):
                 if self._slots[i] is None and self._queue:
                     slot = self._queue.pop(0)
@@ -268,6 +322,16 @@ class DecodeEngine:
                     self._prompt_buf[i, :] = 0
                     self._prompt_buf[i, :len(slot.prompt)] = slot.prompt
                     self._prompt_len[i] = len(slot.prompt)
+                    if (pre is not None and len(slot.prompt) > pre["len"]
+                            and np.array_equal(slot.prompt[:pre["len"]],
+                                               pre["ids"])):
+                        # shared-prefix hit: skip its prefill — the KV
+                        # copy below makes positions 0..plen-1 as if
+                        # prefilled, and the prompt walk resumes at plen
+                        prefix_rows.append(i)
+                        self._pos[i] = pre["len"]
+                        slot.n_consumed = pre["len"]
+                        self._tok[i] = slot.prompt[pre["len"]]
                     # finish once pos reaches plen - 1 + max_new (the
                     # step at input position p emits a GENERATED token
                     # iff p >= plen - 1)
@@ -283,6 +347,11 @@ class DecodeEngine:
                                                len(live))
         if not live:
             return 0
+        if prefix_rows:
+            # the snapshot admission matched against, NOT self._prefix:
+            # a concurrent register_prefix must not swap the tree under
+            # rows whose positions were advanced by pre["len"]
+            self._install_prefix(prefix_rows, pre)
         if admitted and self._prefill_fn is not None:
             self._chunked_prefill()
         if admitted or self._prompt_dev is None:
@@ -587,6 +656,12 @@ class TextDecodeEngine:
 
     def poll(self) -> List[Tuple[Any, str]]:
         return [(rid, self._decode(ids)) for rid, ids in self.engine.poll()]
+
+    def register_prefix(self, text: str) -> int:
+        """Precompute KV for a shared prompt prefix (system prompt);
+        see :meth:`DecodeEngine.register_prefix`. Call before serving
+        traffic (not concurrently with ``step``)."""
+        return self.engine.register_prefix(self._encode(text))
 
     def step(self) -> int:
         return self.engine.step()

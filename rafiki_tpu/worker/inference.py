@@ -43,7 +43,7 @@ class InferenceWorker:
                  worker_id: str, max_batch_msgs: int = 16,
                  decode_loop: bool = False, max_slots: int = 8,
                  max_new_tokens: int = 8, steps_per_sync: int = 4,
-                 speculate_k: int = 0) -> None:
+                 speculate_k: int = 0, system_prefix: str = "") -> None:
         self.worker_id = worker_id
         self.hub = hub
         self.max_batch_msgs = max_batch_msgs
@@ -61,9 +61,13 @@ class InferenceWorker:
         self.engine = None
         if decode_loop:
             if hasattr(self.model, "make_decode_engine"):
-                # speculate_k only rides when set: user templates that
-                # predate the kwarg keep working at the default
-                extra = {"speculate_k": speculate_k} if speculate_k else {}
+                # optional kwargs only ride when set: user templates
+                # that predate them keep working at the defaults
+                extra = {}
+                if speculate_k:
+                    extra["speculate_k"] = speculate_k
+                if system_prefix:
+                    extra["system_prefix"] = system_prefix
                 self.engine = self.model.make_decode_engine(
                     max_slots=max_slots, max_new_tokens=max_new_tokens,
                     steps_per_sync=steps_per_sync, **extra)
@@ -358,7 +362,8 @@ def main(argv: Optional[list] = None) -> int:
         max_slots=int(cfg.get("max_slots", 8)),
         steps_per_sync=int(cfg.get("steps_per_sync", 4)),
         max_new_tokens=int(cfg.get("max_new_tokens", 8)),
-        speculate_k=int(cfg.get("speculate_k", 0)))
+        speculate_k=int(cfg.get("speculate_k", 0)),
+        system_prefix=str(cfg.get("system_prefix", "")))
     print(f"inference worker {worker.worker_id} serving", flush=True)
     worker.run()
     return 0

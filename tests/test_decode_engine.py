@@ -534,3 +534,86 @@ def test_speculation_gates_off_at_low_acceptance(trained):
         if drained >= 2 and eng.stats["spec_calls"] > calls_before:
             break
     assert eng.stats["spec_calls"] > calls_before
+
+
+def test_prefix_cache_matches_plain(trained):
+    """A registered shared prefix must not change a single output token
+    — for prompts that extend it (KV-copy path), equal it, miss it, or
+    are shorter than it — while skipping the prefix's prefill."""
+    module, params = _module_and_params(trained)
+    prefix = np.asarray([1, 5, 9, 13, 2], np.int32)
+    prompts = {
+        "hit": np.concatenate([prefix, [7, 4]]).astype(np.int32),
+        "hit2": np.concatenate([prefix, [3]]).astype(np.int32),
+        "exact": prefix.copy(),                  # not strictly longer
+        "miss": np.asarray([2, 5, 9, 13, 2, 7], np.int32),
+        "short": np.asarray([1, 5], np.int32),
+    }
+
+    def run(register):
+        eng = DecodeEngine(module, params, max_slots=3, max_len=32)
+        if register:
+            assert eng.register_prefix(prefix) == len(prefix)
+        for name, p in prompts.items():
+            eng.submit(name, p, 6)
+        done = {}
+        for _ in range(200):
+            eng.step()
+            done.update(dict(eng.poll()))
+            if len(done) == len(prompts):
+                return done, eng.stats
+        raise AssertionError(f"undrained: {sorted(done)}")
+
+    plain, _ = run(False)
+    cached, stats = run(True)
+    for name in prompts:
+        np.testing.assert_array_equal(np.asarray(cached[name]),
+                                      np.asarray(plain[name]), name)
+    assert stats["prefix_hits"] == 2          # hit + hit2 only
+    assert stats["prefix_tokens"] == 2 * len(prefix)
+
+
+def test_prefix_cache_with_tokenwise_prefill(trained):
+    """Prefix install must compose with prefill_chunk=1 (the remaining
+    prompt streams through the decode scan from the prefix boundary)."""
+    module, params = _module_and_params(trained)
+    prefix = np.asarray([1, 7, 2, 9], np.int32)
+    prompt = np.concatenate([prefix, [5, 3]]).astype(np.int32)
+
+    def run(register):
+        eng = DecodeEngine(module, params, max_slots=2, max_len=32,
+                           prefill_chunk=1)
+        if register:
+            eng.register_prefix(prefix)
+        eng.submit("x", prompt, 5)
+        done = {}
+        for _ in range(100):
+            eng.step()
+            done.update(dict(eng.poll()))
+            if done:
+                return done["x"]
+        raise AssertionError("undrained")
+
+    np.testing.assert_array_equal(run(True), run(False))
+
+
+def test_system_prefix_through_template(trained):
+    """make_decode_engine(system_prefix=...) registers the prefix and
+    serving text that starts with it produces identical completions."""
+    plain = trained.make_decode_engine(max_slots=2, max_new_tokens=6)
+    sys_text = "tok1 tok5"
+    pref = trained.make_decode_engine(max_slots=2, max_new_tokens=6,
+                                      system_prefix=sys_text)
+    query = sys_text + " tok9 tok13"
+    outs = {}
+    for name, eng in (("plain", plain), ("pref", pref)):
+        eng.submit("q", query)
+        done = {}
+        for _ in range(100):
+            eng.step()
+            done.update(dict(eng.poll()))
+            if done:
+                break
+        outs[name] = done["q"]
+    assert outs["plain"] == outs["pref"]
+    assert pref.engine.stats["prefix_hits"] == 1
