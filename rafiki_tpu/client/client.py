@@ -142,7 +142,7 @@ class Client:
                 timeout: Optional[float] = None,
                 sampling: Optional[Dict[str, Any]] = None) -> List[Any]:
         """``sampling`` (generation jobs): {temperature, top_k, top_p,
-        seed} forwarded to the decode loop; omit for greedy."""
+        seed, eos_id} forwarded to the decode loop; omit for greedy."""
         body: Dict[str, Any] = {"queries": _jsonable(queries)}
         if timeout is not None:
             body["timeout"] = timeout

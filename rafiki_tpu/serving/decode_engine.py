@@ -61,6 +61,7 @@ class _Slot:
     top_k: int = 0              # <= 0 → no top-k cut
     top_p: float = 1.0          # >= 1 → no nucleus cut
     seed: int = 0               # with (position) → the sample's PRNG key
+    eos_id: Optional[int] = None  # emitting this token ends the request
     n_consumed: int = 0         # tokens fed to the model so far
     generated: List[int] = field(default_factory=list)
 
@@ -160,7 +161,8 @@ class DecodeEngine:
     # ---- submission / results (thread-safe: worker loop vs callers) ----
     def submit(self, request_id: Any, prompt_ids: np.ndarray,
                max_new: int, temperature: float = 0.0, top_k: int = 0,
-               top_p: float = 1.0, seed: int = 0) -> None:
+               top_p: float = 1.0, seed: int = 0,
+               eos_id: Optional[int] = None) -> None:
         """Queue a request. ``prompt_ids``: 1-D valid tokens (≥1); the
         prompt + generation must fit the cache (truncated to fit).
 
@@ -170,7 +172,13 @@ class DecodeEngine:
         draw at each position is a pure function of (seed, position),
         independent of batch composition, slot index, or
         ``steps_per_sync``, so generations are reproducible under any
-        serving load."""
+        serving load.
+
+        ``eos_id``: emitting this token finishes the request early (the
+        EOS itself is dropped from the reply; tokens a fused call
+        computed past it are discarded host-side and their cache rows
+        are unreachable-then-rewritten, the standard slot-reuse
+        invariant)."""
         prompt = np.asarray(prompt_ids, np.int32).ravel()
         max_new = max(1, min(int(max_new), self.L - 1))
         prompt = prompt[:max(1, self.L - max_new)]
@@ -178,7 +186,8 @@ class DecodeEngine:
             self._queue.append(_Slot(
                 request_id, prompt, max_new,
                 temperature=float(temperature), top_k=int(top_k),
-                top_p=float(top_p), seed=int(seed)))
+                top_p=float(top_p), seed=int(seed),
+                eos_id=None if eos_id is None else int(eos_id)))
 
     def poll(self) -> List[Tuple[Any, List[int]]]:
         """Completed (request_id, generated ids) since the last poll."""
@@ -393,13 +402,20 @@ class DecodeEngine:
             # (slots that hit their stop mid-scan idle for the rest)
             n_real = max(0, min(self.K, int(self._stop_pos[i]) - pos0,
                                 self.L - pos0))
+            eos_hit = False
             for j in range(n_real):
                 if pos0 + j >= plen - 1:  # emission at a generated pos
-                    slot.generated.append(int(emitted[j, i]))
+                    t = int(emitted[j, i])
+                    if slot.eos_id is not None and t == slot.eos_id:
+                        # EOS ends the request; drop it and whatever the
+                        # fused call computed past it
+                        eos_hit = True
+                        break
+                    slot.generated.append(t)
                     self.stats["tokens_generated"] += 1
             slot.n_consumed += n_real
             self._pos[i] = pos0 + n_real
-            if (len(slot.generated) >= slot.max_new
+            if (eos_hit or len(slot.generated) >= slot.max_new
                     or int(self._pos[i]) >= self.L):
                 finished.append((slot.request_id, slot.generated))
                 self._slots[i] = None
@@ -455,13 +471,17 @@ class DecodeEngine:
             take = max(1, min(int(n_emit[i]),
                               int(self._stop_pos[i]) - pos0,
                               self.L - pos0))
-            slot.generated.extend(int(t) for t in g[i, :take])
+            toks = [int(t) for t in g[i, :take]]
+            eos_hit = slot.eos_id is not None and slot.eos_id in toks
+            if eos_hit:  # drop the EOS and anything verified past it
+                toks = toks[:toks.index(slot.eos_id)]
+            slot.generated.extend(toks)
             slot.n_consumed += take
             self._pos[i] = pos0 + take
-            self.stats["tokens_generated"] += take
+            self.stats["tokens_generated"] += len(toks)
             self.stats["spec_drafted"] += k - 1
             self.stats["spec_accepted"] += take - 1
-            if (len(slot.generated) >= slot.max_new
+            if (eos_hit or len(slot.generated) >= slot.max_new
                     or int(self._pos[i]) >= self.L):
                 finished.append((slot.request_id, slot.generated))
                 self._slots[i] = None
@@ -648,11 +668,12 @@ class TextDecodeEngine:
 
     def submit(self, request_id: Any, text: str,
                max_new: Optional[int] = None, temperature: float = 0.0,
-               top_k: int = 0, top_p: float = 1.0, seed: int = 0) -> None:
+               top_k: int = 0, top_p: float = 1.0, seed: int = 0,
+               eos_id: Optional[int] = None) -> None:
         self.engine.submit(request_id, self._encode(text),
                            self.max_new if max_new is None else max_new,
                            temperature=temperature, top_k=top_k,
-                           top_p=top_p, seed=seed)
+                           top_p=top_p, seed=seed, eos_id=eos_id)
 
     def poll(self) -> List[Tuple[Any, str]]:
         return [(rid, self._decode(ids)) for rid, ids in self.engine.poll()]

@@ -77,7 +77,7 @@ class Predictor:
                 sampling: Optional[Dict] = None) -> Tuple[List[Any], Dict]:
         """Returns (ensembled predictions, info dict). ``sampling``
         (generation jobs only) rides with the message to the decode
-        loop: {temperature, top_k, top_p, seed} — seeded draws are
+        loop: {temperature, top_k, top_p, seed, eos_id} — seeded draws are
         reproducible per (seed, position) regardless of serving load."""
         t0 = time.monotonic()
         timeout = self.gather_timeout if timeout is None else timeout

@@ -285,7 +285,9 @@ def _safe_sampling(samp: Any) -> dict:
     def num(key: str, cast, default):
         try:
             v = cast(samp.get(key, default))
-        except (TypeError, ValueError):
+        except (TypeError, ValueError, OverflowError):
+            # OverflowError: int(float("inf")) — inf is legal msgpack,
+            # and an escaped exception here kills the serve thread
             return default
         # NaN/inf would split behavior between the host's greedy-vs-
         # sampling program gate (NaN > 0 is False) and the device's
@@ -293,10 +295,14 @@ def _safe_sampling(samp: Any) -> dict:
         # path depending on batch mix. Finite or default.
         return v if math.isfinite(v) else default
 
-    return {"temperature": num("temperature", float, 0.0),
-            "top_k": num("top_k", int, 0),
-            "top_p": num("top_p", float, 1.0),
-            "seed": num("seed", int, 0)}
+    out = {"temperature": num("temperature", float, 0.0),
+           "top_k": num("top_k", int, 0),
+           "top_p": num("top_p", float, 1.0),
+           "seed": num("seed", int, 0)}
+    eos = num("eos_id", int, None)  # absent/malformed → None
+    if eos is not None and eos >= 0:
+        out["eos_id"] = eos
+    return out
 
 
 def _expired(msg: dict, skew_s: float = EXPIRY_SKEW_TOLERANCE_S) -> bool:

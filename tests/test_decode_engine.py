@@ -617,3 +617,45 @@ def test_system_prefix_through_template(trained):
         outs[name] = done["q"]
     assert outs["plain"] == outs["pref"]
     assert pref.engine.stats["prefix_hits"] == 1
+
+
+def test_eos_early_stop(trained):
+    """An emitted eos_id must end the request early — EOS dropped from
+    the reply, later fused-call tokens discarded — on both the scan and
+    speculative paths, and the freed slot must serve a new request."""
+    module, params = _module_and_params(trained)
+    p = np.asarray([1, 5, 9, 13], np.int32)
+
+    # discover the plain greedy stream, pick its 3rd token as "EOS"
+    ref_eng = DecodeEngine(module, params, max_slots=2, max_len=32)
+    ref_eng.submit("ref", p, 10)
+    done = {}
+    while not done:
+        ref_eng.step()
+        done.update(dict(ref_eng.poll()))
+    ref = done["ref"]
+    assert len(ref) == 10
+    eos = ref[2]
+
+    for spec_k in (0, 4):
+        eng = DecodeEngine(module, params, max_slots=2, max_len=32,
+                           speculate_k=spec_k)
+        eng.submit("a", p, 10, eos_id=eos)
+        done = {}
+        for _ in range(60):
+            eng.step()
+            done.update(dict(eng.poll()))
+            if done:
+                break
+        got = done["a"]
+        # everything before the first EOS occurrence, EOS excluded
+        assert got == ref[:ref.index(eos)], (spec_k, got, ref)
+        # the freed slot still serves: a follow-up without eos matches
+        eng.submit("b", p, 10)
+        done = {}
+        for _ in range(60):
+            eng.step()
+            done.update(dict(eng.poll()))
+            if done:
+                break
+        assert done["b"] == ref, spec_k
