@@ -156,6 +156,29 @@ class Client:
                            body, timeout=sock_timeout)
         return out["predictions"]
 
+    def predict_stream(self, predictor_url: str, queries: Sequence[Any],
+                       timeout: Optional[float] = None,
+                       sampling: Optional[Dict[str, Any]] = None):
+        """Streaming generation: yields the predictor's SSE events —
+        ``{"delta": {qi: text}}`` per new-token batch (append to query
+        qi's output), rarely ``{"replace": {qi: text}}`` (authoritative
+        text diverged from the streamed prefix — overwrite, don't
+        append), then one ``{"done": True, "predictions": [...]}`` (or
+        done+error). Every stream ends with a done event. Only
+        meaningful against generation (decode-loop) inference jobs."""
+        from ..utils.http import sse_request
+
+        body: Dict[str, Any] = {"queries": _jsonable(queries)}
+        if timeout is not None:
+            body["timeout"] = timeout
+        if sampling:
+            body["sampling"] = sampling
+        sock_timeout = self.timeout if timeout is None else \
+            max(self.timeout, timeout + 30.0)
+        yield from sse_request(
+            "POST", f"{predictor_url.rstrip('/')}/predict_stream",
+            body, timeout=sock_timeout)
+
 
 def _jsonable(queries: Sequence[Any]) -> List[Any]:
     import numpy as np

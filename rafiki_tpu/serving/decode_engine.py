@@ -64,6 +64,7 @@ class _Slot:
     eos_id: Optional[int] = None  # emitting this token ends the request
     n_consumed: int = 0         # tokens fed to the model so far
     generated: List[int] = field(default_factory=list)
+    n_streamed: int = 0         # generated tokens already poll_partial'd
 
 
 class DecodeEngine:
@@ -194,6 +195,22 @@ class DecodeEngine:
         with self._lock:
             done, self._done = self._done, []
         return done
+
+    def poll_partial(self) -> List[Tuple[Any, List[int]]]:
+        """(request_id, generated-so-far) for STILL-LIVE slots that
+        produced new tokens since the last ``poll_partial``. Cumulative
+        snapshots (copies), not deltas — the text layer re-detokenizes
+        the whole sequence per event, which is what makes streaming
+        byte-level BPE safe (a token boundary can split a multi-byte
+        character; only the cumulative decode is well-formed). Call
+        from the loop thread that drives ``step`` (same discipline as
+        ``step`` itself); finished requests surface via ``poll``."""
+        out: List[Tuple[Any, List[int]]] = []
+        for slot in self._slots:
+            if slot is not None and len(slot.generated) > slot.n_streamed:
+                out.append((slot.request_id, list(slot.generated)))
+                slot.n_streamed = len(slot.generated)
+        return out
 
     def register_prefix(self, prefix_ids: np.ndarray) -> int:
         """Precompute the KV cache of a shared prompt prefix (system
@@ -665,6 +682,7 @@ class TextDecodeEngine:
         self._encode = encode
         self._decode = decode
         self.max_new = int(max_new)
+        self._stream_sent: Dict[Any, str] = {}  # rid -> text delivered
 
     def submit(self, request_id: Any, text: str,
                max_new: Optional[int] = None, temperature: float = 0.0,
@@ -676,7 +694,33 @@ class TextDecodeEngine:
                            top_p=top_p, seed=seed, eos_id=eos_id)
 
     def poll(self) -> List[Tuple[Any, str]]:
-        return [(rid, self._decode(ids)) for rid, ids in self.engine.poll()]
+        done = [(rid, self._decode(ids)) for rid, ids in self.engine.poll()]
+        for rid, _ in done:  # a finished request stops streaming state
+            self._stream_sent.pop(rid, None)
+        return done
+
+    def poll_partial(self) -> List[Tuple[Any, str]]:
+        """(request_id, new text) for live requests since the last call.
+
+        Each event re-detokenizes the cumulative ids and emits the text
+        suffix past what was already delivered — cumulative decoding is
+        the only well-formed view under byte-level BPE (a token boundary
+        may split a multi-byte character, so per-token decodes are not
+        concatenation-safe). Trailing replacement characters (U+FFFD —
+        an incomplete UTF-8 sequence whose remaining bytes are still
+        being generated) are WITHHELD until a later decode resolves
+        them: emitted text comes only from byte-complete prefixes, so
+        the delivered stream is append-only and deltas concatenate
+        correctly. Genuinely invalid bytes (never completed) surface in
+        the final text instead. Suffix-empty events are dropped."""
+        out: List[Tuple[Any, str]] = []
+        for rid, ids in self.engine.poll_partial():
+            text = self._decode(ids).rstrip("�")
+            sent = self._stream_sent.get(rid, "")
+            if len(text) > len(sent) and text.startswith(sent):
+                out.append((rid, text[len(sent):]))
+                self._stream_sent[rid] = text
+        return out
 
     def register_prefix(self, text: str) -> int:
         """Precompute KV for a shared prompt prefix (system prompt);
@@ -688,6 +732,7 @@ class TextDecodeEngine:
         return self.engine.step()
 
     def reset(self) -> None:
+        self._stream_sent.clear()
         self.engine.reset()
 
     @property

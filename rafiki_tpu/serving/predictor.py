@@ -20,7 +20,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..utils.http import JsonHttpService
+from ..utils.http import JsonHttpService, StreamResponse
 from .queues import (EXPIRY_SKEW_TOLERANCE_S, QueueHub, pack_message,
                      unpack_message)
 
@@ -59,6 +59,9 @@ class Predictor:
     #: bounded reservoir of recent request latencies; big enough for
     #: stable p50/p95/p99, small enough to sort on every stats() call
     LATENCY_WINDOW = 2048
+    #: default whole-stream deadline for predict_stream — generations
+    #: run for minutes; gather_timeout is a unary-RPC bound
+    STREAM_TIMEOUT = 300.0
 
     def __init__(self, hub: QueueHub, worker_ids: Sequence[str],
                  gather_timeout: float = 10.0) -> None:
@@ -70,6 +73,7 @@ class Predictor:
         self._latency_sum = 0.0
         self._latencies: "collections.deque[float]" = collections.deque(
             maxlen=self.LATENCY_WINDOW)
+        self._rr = 0  # round-robin cursor for single-worker streams
         self._lock = threading.Lock()
 
     def predict(self, queries: Sequence[Any],
@@ -135,6 +139,114 @@ class Predictor:
                 "latency_s": latency, "errors": errors}
         return ensemble_predictions(per_worker), info
 
+    def predict_stream(self, queries: Sequence[Any],
+                       timeout: Optional[float] = None,
+                       sampling: Optional[Dict] = None):
+        """Streaming generation: yield per-query text deltas as the
+        decode loop produces them, then a final event.
+
+        Events, in order: zero or more ``{"delta": {qi: text}}`` (append
+        ``text`` to query ``qi``'s output), at most one ``{"replace":
+        {qi: text}}`` (the authoritative final text diverged from the
+        streamed prefix — replace, don't append), then exactly one of
+        ``{"done": True, "predictions": [...], "info"}`` or ``{"done":
+        True, "error": ...}``. Every stream ends with a done event,
+        including on hub failures mid-stream. Unlike :meth:`predict`,
+        the request goes to ONE worker (round-robin): an ensemble over
+        replicas has no meaningful token stream — mid-generation the
+        replicas disagree, and averaging text deltas is nonsense. The
+        reference has no streaming path at all (SURVEY.md §3.3 is
+        strictly request/response); this is the continuous-batching
+        engine's ``poll_partial`` surfaced end to end.
+
+        ``timeout`` bounds the WHOLE stream; default
+        ``STREAM_TIMEOUT`` (not ``gather_timeout``, which is sized for
+        unary request/response — a generation legitimately runs for
+        minutes)."""
+        t0 = time.monotonic()
+        timeout = self.STREAM_TIMEOUT if timeout is None else timeout
+        qid = uuid.uuid4().hex
+        deadline = t0 + timeout
+        with self._lock:
+            wid = self.worker_ids[self._rr % len(self.worker_ids)]
+            self._rr += 1
+        payload = {"id": qid, "queries": _stack(queries), "stream": True,
+                   "deadline_ts": time.time() + timeout}
+        if sampling:
+            payload["sampling"] = dict(sampling)
+        # accumulated text per query index — the final predictions
+        # message may carry tokens never sent as deltas (the request
+        # finished mid-fused-step); the tail is emitted before "done"
+        acc: Dict[int, str] = {}
+        final: Optional[Dict[str, Any]] = None
+        try:
+            try:
+                self.hub.arm_reply_ttl(
+                    qid, timeout + EXPIRY_SKEW_TOLERANCE_S + 30.0)
+            except Exception:  # noqa: BLE001 — TTL is defense-in-depth
+                pass
+            self.hub.push_query(wid, pack_message(payload))
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    final = {"done": True, "error": "stream timed out",
+                             "partial": [acc.get(i)
+                                         for i in range(len(queries))]}
+                    break
+                reply_bytes = self.hub.pop_prediction(qid, remaining)
+                if reply_bytes is None:
+                    continue  # pop_prediction timed out early; re-check
+                reply = unpack_message(reply_bytes)
+                if reply.get("error"):
+                    final = {"done": True, "error": str(reply["error"])}
+                    break
+                if "delta" in reply:
+                    d = {int(k): str(v)
+                         for k, v in dict(reply["delta"]).items()}
+                    for k, v in d.items():
+                        acc[k] = acc.get(k, "") + v
+                    yield {"delta": {str(k): v for k, v in d.items()}}
+                    continue
+                preds = list(reply.get("predictions") or [])
+                tail: Dict[str, str] = {}
+                replace: Dict[str, str] = {}
+                for qi, full in enumerate(preds):
+                    sent = acc.get(qi, "")
+                    if not isinstance(full, str) or full == sent:
+                        continue
+                    if full.startswith(sent):
+                        tail[str(qi)] = full[len(sent):]
+                    else:  # streamed prefix diverged (shouldn't happen
+                        # with append-only poll_partial; authoritative
+                        # text wins, flagged as replace — NOT a delta a
+                        # concatenating client would double-count)
+                        replace[str(qi)] = full
+                if tail:
+                    yield {"delta": tail}
+                if replace:
+                    yield {"replace": replace}
+                latency = time.monotonic() - t0
+                final = {"done": True, "predictions": preds,
+                         "info": {"worker_id": reply.get("worker_id"),
+                                  "latency_s": latency}}
+                with self._lock:
+                    self._n_queries += len(queries)
+                    self._n_requests += 1
+                    self._latency_sum += latency
+                    self._latencies.append(latency)
+                break
+        except Exception as e:  # noqa: BLE001 — the SSE response is
+            # already committed (200 + headers) when this generator
+            # runs, so errors can't become an HTTP status: every
+            # failure mode must surface as a terminal done event
+            final = {"done": True, "error": f"{type(e).__name__}: {e}"}
+        finally:
+            try:
+                self.hub.discard_prediction_queue(qid)
+            except Exception:  # noqa: BLE001 — cleanup is best-effort
+                pass
+        yield final
+
     def stats(self) -> Dict[str, Any]:
         """Counters + latency percentiles over the recent-request window
         (the BASELINE p50 metric; surfaced in ``GET /health``)."""
@@ -194,6 +306,7 @@ class PredictorService:
         self.predictor = predictor
         self.http = JsonHttpService(host, port)
         self.http.route("POST", "/predict", self._predict)
+        self.http.route("POST", "/predict_stream", self._predict_stream)
         self.http.route("GET", "/health", self._health)
 
     def start(self) -> Tuple[str, int]:
@@ -215,6 +328,25 @@ class PredictorService:
             return 504, {"error": "no worker answered in time",
                          "info": info}
         return 200, {"predictions": preds, "info": info}
+
+    def _predict_stream(self, _m, body, _h) -> Tuple[int, Any]:
+        """SSE: one ``data: <json>\\n\\n`` event per generator yield
+        (token deltas, then the final done/error event)."""
+        queries = (body or {}).get("queries")
+        if not isinstance(queries, list) or not queries:
+            return 400, {"error": "body must be {queries: [...]}"}
+        timeout = (body or {}).get("timeout")
+        sampling = (body or {}).get("sampling")
+        events = self.predictor.predict_stream(
+            queries, timeout=float(timeout) if timeout else None,
+            sampling=sampling if isinstance(sampling, dict) else None)
+
+        def sse():
+            import json as _json
+            for ev in events:
+                yield b"data: " + _json.dumps(ev).encode("utf-8") + b"\n\n"
+
+        return 200, StreamResponse(sse())
 
     def _health(self, _m, _b, _h) -> Tuple[int, Any]:
         return 200, {"ok": True, **self.predictor.stats()}

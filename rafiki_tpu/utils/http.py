@@ -29,6 +29,19 @@ class RawResponse:
         self.content_type = content_type
 
 
+class StreamResponse:
+    """Incremental handler payload: an iterator of already-encoded
+    chunks written to the socket as they are produced (server-sent
+    events for streaming generation). The connection closes when the
+    iterator ends — ``Connection: close`` instead of chunked framing
+    keeps the client side a dumb line reader."""
+
+    def __init__(self, chunks: Any,
+                 content_type: str = "text/event-stream") -> None:
+        self.chunks = chunks  # iterator of bytes
+        self.content_type = content_type
+
+
 def _compile(pattern: str) -> re.Pattern:
     regex = re.sub(r"<([a-zA-Z_][a-zA-Z0-9_]*)>", r"(?P<\1>[^/]+)", pattern)
     return re.compile("^" + regex + "$")
@@ -87,6 +100,20 @@ class JsonHttpService:
                 self._reply(404, {"error": f"no route {method} {path}"})
 
             def _reply(self, status: int, payload: Any) -> None:
+                if isinstance(payload, StreamResponse):
+                    self.send_response(status)
+                    self.send_header("Content-Type", payload.content_type)
+                    self.send_header("Cache-Control", "no-store")
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    self.close_connection = True
+                    try:
+                        for chunk in payload.chunks:
+                            self.wfile.write(chunk)
+                            self.wfile.flush()
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass  # client went away mid-stream
+                    return
                 if isinstance(payload, RawResponse):  # e.g. dashboard HTML
                     data, ctype = payload.data, payload.content_type
                 else:
@@ -149,21 +176,24 @@ def http_error(status: int, message: str) -> _HttpError:
 
 # ---- client side -----------------------------------------------------------
 
-def json_request(method: str, url: str, body: Any = None,
-                 headers: Optional[Dict[str, str]] = None,
-                 timeout: float = 30.0) -> Any:
-    """Tiny JSON HTTP client (urllib; no external deps in the hot path)."""
+def _open_request(method: str, url: str, body: Any,
+                  headers: Optional[Dict[str, str]], timeout: float,
+                  accept: Optional[str] = None):
+    """Open a JSON-bodied request, translating HTTPError into the
+    RuntimeError convention shared by every client in this repo.
+    Returns the live response object (caller closes)."""
     import urllib.error
     import urllib.request
 
     data = json.dumps(body).encode("utf-8") if body is not None else None
     req = urllib.request.Request(url, data=data, method=method.upper())
     req.add_header("Content-Type", "application/json")
+    if accept:
+        req.add_header("Accept", accept)
     for k, v in (headers or {}).items():
         req.add_header(k, v)
     try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            raw = resp.read()
+        return urllib.request.urlopen(req, timeout=timeout)
     except urllib.error.HTTPError as e:
         raw = e.read()
         try:
@@ -173,4 +203,29 @@ def json_request(method: str, url: str, body: Any = None,
         raise RuntimeError(
             f"{method} {url} -> {e.code}: {payload.get('error', payload)}"
         ) from None
+
+
+def json_request(method: str, url: str, body: Any = None,
+                 headers: Optional[Dict[str, str]] = None,
+                 timeout: float = 30.0) -> Any:
+    """Tiny JSON HTTP client (urllib; no external deps in the hot path)."""
+    with _open_request(method, url, body, headers, timeout) as resp:
+        raw = resp.read()
     return json.loads(raw) if raw else None
+
+
+def sse_request(method: str, url: str, body: Any = None,
+                headers: Optional[Dict[str, str]] = None,
+                timeout: float = 30.0):
+    """Yield decoded JSON payloads from a server-sent-events endpoint.
+
+    Matches the minimal SSE dialect :class:`StreamResponse` producers
+    emit: ``data: <json>\\n\\n`` per event, connection close = end of
+    stream. ``timeout`` bounds the wait for EACH event, not the whole
+    stream (a generation may legitimately run for minutes)."""
+    with _open_request(method, url, body, headers, timeout,
+                       accept="text/event-stream") as resp:
+        for line in resp:  # socket timeout applies per readline
+            line = line.strip()
+            if line.startswith(b"data:"):
+                yield json.loads(line[5:].strip().decode("utf-8"))

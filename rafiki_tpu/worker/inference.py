@@ -185,6 +185,7 @@ class InferenceWorker:
         so decoding never stalls on an empty queue."""
         # message id -> [n_pending, {query_index: text}]
         inflight: dict = {}
+        streaming: set = set()  # message ids that asked for token deltas
         n = 0
         while not self._stop.is_set():
             if max_iterations is not None and n >= max_iterations:
@@ -210,6 +211,8 @@ class InferenceWorker:
                          "predictions": []}))
                 else:
                     inflight[m["id"]] = [len(qs), {}]
+                    if m.get("stream"):
+                        streaming.add(m["id"])
                     samp = _safe_sampling(m.get("sampling"))
                     for qi, text in enumerate(qs):
                         self.engine.submit((m["id"], qi), str(text),
@@ -226,11 +229,24 @@ class InferenceWorker:
                         {"id": mid, "worker_id": self.worker_id,
                          "predictions": [], "error": err}))
                     del inflight[mid]
+                streaming.clear()
                 # a failed step may have consumed the donated cache:
                 # drop every occupant and rebuild device state, or the
                 # loop hot-spins on a permanently broken engine
                 self.engine.reset()
                 continue
+            if streaming and hasattr(self.engine, "poll_partial"):
+                # per-message delta events between steps: the reply
+                # queue carries them ahead of the final predictions
+                # message (pushes are FIFO per query id)
+                deltas: dict = {}
+                for (mid, qi), delta in self.engine.poll_partial():
+                    if mid in streaming:
+                        deltas.setdefault(mid, {})[str(qi)] = delta
+                for mid, d in deltas.items():
+                    self.hub.push_prediction(mid, pack_message(
+                        {"id": mid, "worker_id": self.worker_id,
+                         "delta": d}))
             for (mid, qi), text in self.engine.poll():
                 entry = inflight.get(mid)
                 if entry is None:
@@ -242,6 +258,7 @@ class InferenceWorker:
                         {"id": mid, "worker_id": self.worker_id,
                          "predictions": preds}))
                     del inflight[mid]
+                    streaming.discard(mid)
         self._publish_stats()  # final counters visible after stop
 
     def _serve_batch(self, messages: List[dict]) -> None:

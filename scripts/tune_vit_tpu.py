@@ -84,14 +84,18 @@ def main() -> None:
     sizes = [int(a) for a in sys.argv[1:]] or [64]
     out = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), ".tune_vit_tpu.jsonl")
-    configs = [(jnp.bfloat16, "pallas", False), (jnp.bfloat16, "xla", False),
-               (None, "pallas", False)]
+    configs = [(jnp.bfloat16, "xla", False), (jnp.bfloat16, "pallas", False)]
+    if not os.environ.get("RAFIKI_TUNE_BF16_ONLY"):
+        # the f32 Pallas compile wedged a 51-min remote-compile RPC on
+        # 2026-07-31; retry chains skip it so a flaky tunnel window is
+        # spent on the configs that decide the headline number
+        configs.append((None, "pallas", False))
     for bs in sizes:
         cfgs = list(configs)
         if bs == max(sizes):
             # remat at the biggest batch: where activation HBM binds,
             # rematerialization may net out faster via utilization
-            cfgs.append((jnp.bfloat16, "pallas", True))
+            cfgs.append((jnp.bfloat16, "xla", True))
         for dtype, attn, remat in cfgs:
             r = time_step(bs, dtype, attn, remat=remat)
             line = json.dumps(r)

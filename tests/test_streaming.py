@@ -1,0 +1,166 @@
+"""Streaming generation end to end (engine → worker → predictor → SSE).
+
+The reference predictor is strictly request/response (SURVEY.md §3.3);
+token streaming is a beyond-reference serving capability: the
+continuous-batching engine's ``poll_partial`` deltas ride the ordinary
+reply queue ahead of the final predictions message, the predictor
+re-exposes them as ``predict_stream`` events, and ``PredictorService``
+serves them as server-sent events consumed by ``Client.predict_stream``.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from rafiki_tpu.models.llama_lora import LlamaLoRA
+from rafiki_tpu.serving.predictor import Predictor, PredictorService
+from rafiki_tpu.serving.queues import InProcQueueHub
+from rafiki_tpu.store.param_store import ParamStore
+from rafiki_tpu.worker.inference import InferenceWorker
+
+from test_decode_engine import KNOBS, trained  # noqa: F401 — fixture
+
+
+def test_engine_poll_partial_streams_exact_prefixes(trained):  # noqa: F811
+    """Deltas collected while a request is live concatenate to a prefix
+    of the final text, and the final text extends it exactly (the tail
+    may finish inside the completing fused step, which never produces a
+    partial event). steps_per_sync=1 guarantees at least one partial
+    for a multi-token generation."""
+    eng = trained.make_decode_engine(max_slots=2, max_new_tokens=6,
+                                    steps_per_sync=1, prefill_chunk=1)
+    eng.submit("a", "tok1 tok2 tok3")
+    eng.submit("b", "tok4 tok5")
+    deltas = {"a": [], "b": []}
+    finals = {}
+    for _ in range(200):
+        if not eng.busy:
+            break
+        eng.step()
+        for rid, d in eng.poll_partial():
+            assert d, "empty deltas must be dropped"
+            deltas[rid].append(d)
+        for rid, text in eng.poll():
+            finals[rid] = text
+    assert set(finals) == {"a", "b"}
+    for rid in ("a", "b"):
+        streamed = "".join(deltas[rid])
+        assert finals[rid].startswith(streamed)
+        assert deltas[rid], "no partial events for a 6-token generation"
+    # streaming state is cleaned up with the finished requests
+    assert eng._stream_sent == {}
+
+
+def test_text_stream_withholds_incomplete_utf8():
+    """A token boundary that splits a multi-byte character must not
+    leak U+FFFD into the stream: the trailing replacement char is
+    withheld until a later decode completes the byte sequence, keeping
+    the delivered stream append-only (deltas concatenate exactly)."""
+    from rafiki_tpu.serving.decode_engine import TextDecodeEngine
+
+    eur = "€".encode("utf-8")  # 3 bytes
+
+    class StubEngine:
+        def __init__(self):
+            self.partials = []
+
+        def poll_partial(self):
+            p, self.partials = self.partials, []
+            return p
+
+        def poll(self):
+            return []
+
+    def decode(ids):  # ids are raw utf-8 byte values here
+        return bytes(ids).decode("utf-8", errors="replace")
+
+    stub = StubEngine()
+    eng = TextDecodeEngine(stub, lambda t: np.zeros(1, np.int32), decode)
+
+    # "a" + first 2 bytes of € → trailing U+FFFD withheld
+    stub.partials = [("r", [ord("a"), eur[0], eur[1]])]
+    out = eng.poll_partial()
+    assert out == [("r", "a")]
+    # € completes, plus 'b': the delta starts where delivery stopped
+    stub.partials = [("r", [ord("a"), eur[0], eur[1], eur[2], ord("b")])]
+    out = eng.poll_partial()
+    assert out == [("r", "a€b"[1:])]  # "€b"
+    # nothing new → no event
+    stub.partials = [("r", [ord("a"), eur[0], eur[1], eur[2], ord("b")])]
+    assert eng.poll_partial() == []
+
+
+@pytest.mark.slow
+def test_predict_stream_through_stack(trained):  # noqa: F811
+    """predict_stream events through the real worker decode loop: delta
+    events accumulate to exactly the final predictions, and the final
+    text equals what the non-streaming path returns for the same greedy
+    request."""
+    store = ParamStore.from_uri("mem://")
+    store.save("t0", trained.dump_parameters())
+    hub = InProcQueueHub()
+    worker = InferenceWorker(LlamaLoRA, "t0", KNOBS, store, hub, "w0",
+                             decode_loop=True, max_slots=4,
+                             max_new_tokens=6)
+    wt = threading.Thread(target=worker.run, daemon=True)
+    wt.start()
+    try:
+        pred = Predictor(hub, ["w0"], gather_timeout=120.0)
+        events = list(pred.predict_stream(["tok1 tok2 tok3", "tok4"]))
+        assert events and events[-1].get("done") is True
+        final = events[-1]
+        assert "error" not in final
+        preds = final["predictions"]
+        assert len(preds) == 2 and all(isinstance(p, str) for p in preds)
+        acc = {0: "", 1: ""}
+        n_delta = 0
+        for ev in events[:-1]:
+            assert set(ev) == {"delta"}
+            for k, v in ev["delta"].items():
+                acc[int(k)] += v
+                n_delta += 1
+        assert n_delta >= 1, "stream produced no delta events"
+        assert [acc[0], acc[1]] == preds
+        # greedy: the streamed text equals the request/response answer
+        plain, info = pred.predict(["tok1 tok2 tok3", "tok4"])
+        assert info["workers_answered"] == 1
+        assert plain == preds
+    finally:
+        worker.stop()
+        wt.join(timeout=10)
+
+
+@pytest.mark.slow
+def test_predict_stream_sse_http_and_client(trained):  # noqa: F811
+    """The SSE endpoint over a real socket, consumed by the client SDK
+    generator: same delta-accumulation invariant, served as
+    text/event-stream with connection-close framing."""
+    from rafiki_tpu.client.client import Client
+
+    store = ParamStore.from_uri("mem://")
+    store.save("t0", trained.dump_parameters())
+    hub = InProcQueueHub()
+    worker = InferenceWorker(LlamaLoRA, "t0", KNOBS, store, hub, "w0",
+                             decode_loop=True, max_slots=4,
+                             max_new_tokens=6)
+    wt = threading.Thread(target=worker.run, daemon=True)
+    wt.start()
+    svc = PredictorService(Predictor(hub, ["w0"], gather_timeout=120.0))
+    host, port = svc.start()
+    try:
+        client = Client.__new__(Client)  # predictor-only use: no admin
+        client.timeout = 120.0
+        events = list(client.predict_stream(
+            f"http://{host}:{port}", ["tok1 tok2 tok3"], timeout=120.0))
+        assert events and events[-1].get("done") is True
+        preds = events[-1]["predictions"]
+        acc = ""
+        for ev in events[:-1]:
+            acc += "".join(ev["delta"].values())
+        assert acc == preds[0]
+        assert isinstance(preds[0], str) and preds[0]
+    finally:
+        svc.stop()
+        worker.stop()
+        wt.join(timeout=10)
