@@ -112,9 +112,18 @@ class LoRADense(nn.Module):
     rank: int = 0
     alpha: float = 16.0
     quantized: bool = False
+    #: >0 — multi-adapter serving (S-LoRA-style): ``lora_a``/``lora_b``
+    #: carry a leading adapter axis and every batch row applies ITS OWN
+    #: adapter, selected by the per-row ``adapter_ids`` operand. The
+    #: base matmul runs once for the whole batch (that's the point:
+    #: N fine-tunes share one base's HBM and one MXU pass); only the
+    #: rank-r correction is per-row, as two batched einsums over
+    #: gathered (B, d, r)/(B, r, f) adapter slices — tiny vs the base.
+    n_adapters: int = 0
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray,
+                 adapter_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         d_in = x.shape[-1]
         if self.quantized:
             qk = self.param("qkernel", nn.initializers.zeros,
@@ -133,12 +142,26 @@ class LoRADense(nn.Module):
             # must not promote the matmul to f32 (~3x cost on the MXU)
             y = x @ kernel.astype(x.dtype)
         if self.rank > 0:
-            a = self.param("lora_a", nn.initializers.normal(0.02),
-                           (d_in, self.rank))
-            b = self.param("lora_b", nn.initializers.zeros,
-                           (self.rank, self.features))
-            y = y + ((x @ a.astype(x.dtype)) @ b.astype(x.dtype)) * (
-                self.alpha / self.rank)
+            if self.n_adapters > 0:
+                a = self.param("lora_a", nn.initializers.normal(0.02),
+                               (self.n_adapters, d_in, self.rank))
+                b = self.param("lora_b", nn.initializers.zeros,
+                               (self.n_adapters, self.rank, self.features))
+                if adapter_ids is None:  # init trace / unselected call
+                    adapter_ids = jnp.zeros((x.shape[0],), jnp.int32)
+                asel = jnp.take(a, adapter_ids, axis=0).astype(x.dtype)
+                bsel = jnp.take(b, adapter_ids, axis=0).astype(x.dtype)
+                y = y + jnp.einsum(
+                    "bsr,brf->bsf",
+                    jnp.einsum("bsd,bdr->bsr", x, asel), bsel) * (
+                        self.alpha / self.rank)
+            else:
+                a = self.param("lora_a", nn.initializers.normal(0.02),
+                               (d_in, self.rank))
+                b = self.param("lora_b", nn.initializers.zeros,
+                               (self.rank, self.features))
+                y = y + ((x @ a.astype(x.dtype)) @ b.astype(x.dtype)) * (
+                    self.alpha / self.rank)
         return y
 
 
@@ -148,17 +171,20 @@ class _DecoderAttention(nn.Module):
     max_len: int
     lora_rank: int
     quantized: bool = False
+    n_adapters: int = 0
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, lens: jnp.ndarray,
-                 positions: jnp.ndarray, decode: bool) -> jnp.ndarray:
+                 positions: jnp.ndarray, decode: bool,
+                 adapter_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         b, s, d = x.shape
         dh = d // self.n_heads
         dense = functools.partial(LoRADense, rank=self.lora_rank,
-                                  quantized=self.quantized)
-        q = dense(self.n_heads * dh, name="wq")(x)
-        k = dense(self.n_kv_heads * dh, name="wk")(x)
-        v = dense(self.n_kv_heads * dh, name="wv")(x)
+                                  quantized=self.quantized,
+                                  n_adapters=self.n_adapters)
+        q = dense(self.n_heads * dh, name="wq")(x, adapter_ids)
+        k = dense(self.n_kv_heads * dh, name="wk")(x, adapter_ids)
+        v = dense(self.n_kv_heads * dh, name="wv")(x, adapter_ids)
         q = rope(q.reshape(b, s, self.n_heads, dh), positions)
         k = rope(k.reshape(b, s, self.n_kv_heads, dh), positions)
         v = v.reshape(b, s, self.n_kv_heads, dh)
@@ -219,7 +245,7 @@ class _DecoderAttention(nn.Module):
                                 causal=True, kv_lens=lens)
             o = o.transpose(0, 2, 1, 3)
         o = o.reshape(b, s, self.n_heads * dh)
-        return dense(d, name="wo")(o)
+        return dense(d, name="wo")(o, adapter_ids)
 
 
 class _DecoderBlock(nn.Module):
@@ -231,13 +257,15 @@ class _DecoderBlock(nn.Module):
     n_experts: int = 0  # >0 → MoE FFN (expert-parallel, ops/moe.py)
     moe_top_k: int = 1  # experts per token (1 Switch, 2 Mixtral-style)
     quantized: bool = False  # int8 base kernels (MoE experts stay f32)
+    n_adapters: int = 0  # >0 → per-row stacked adapters (serving)
 
     @nn.compact
-    def __call__(self, x, lens, positions, decode):
+    def __call__(self, x, lens, positions, decode, adapter_ids=None):
         x = x + _DecoderAttention(
             self.n_heads, self.n_kv_heads, self.max_len, self.lora_rank,
-            quantized=self.quantized,
-            name="attn")(RMSNorm()(x), lens, positions, decode)
+            quantized=self.quantized, n_adapters=self.n_adapters,
+            name="attn")(RMSNorm()(x), lens, positions, decode,
+                         adapter_ids)
         y = RMSNorm()(x)
         if self.n_experts > 0:
             from rafiki_tpu.ops.moe import MoEFeedForward
@@ -246,11 +274,12 @@ class _DecoderBlock(nn.Module):
                                       router_top_k=self.moe_top_k,
                                       name="moe")(y)
         dense = functools.partial(LoRADense, rank=self.lora_rank,
-                                  quantized=self.quantized)
-        gate = dense(self.mlp_dim, name="gate")(y)
-        up = dense(self.mlp_dim, name="up")(y)
+                                  quantized=self.quantized,
+                                  n_adapters=self.n_adapters)
+        gate = dense(self.mlp_dim, name="gate")(y, adapter_ids)
+        up = dense(self.mlp_dim, name="up")(y, adapter_ids)
         y = nn.silu(gate) * up  # SwiGLU
-        return x + dense(x.shape[-1], name="down")(y)
+        return x + dense(x.shape[-1], name="down")(y, adapter_ids)
 
 
 class Llama(nn.Module):
@@ -284,12 +313,18 @@ class Llama(nn.Module):
     # serving-only int8 weight quantization of the LoRADense base
     # kernels (see LoRADense.quantized / quantize_llama_params)
     quantized: bool = False
+    # >0 — multi-adapter serving: every LoRA site carries N stacked
+    # adapters and each batch row applies the one named by the
+    # ``adapter_ids`` call operand (see LoRADense.n_adapters). Build
+    # the stacked params with :func:`stack_lora_adapters`.
+    n_adapters: int = 0
 
     @nn.compact
     def __call__(self, ids: jnp.ndarray, lens: Optional[jnp.ndarray] = None,
                  positions: Optional[jnp.ndarray] = None,
                  decode: bool = False,
-                 return_hidden: bool = False) -> jnp.ndarray:
+                 return_hidden: bool = False,
+                 adapter_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         b, s = ids.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(s), (b, s))
@@ -311,7 +346,9 @@ class Llama(nn.Module):
                           n_experts=self.n_experts,
                           moe_top_k=self.moe_top_k,
                           quantized=self.quantized,
-                          name=f"block_{i}")(x, lens, positions, decode)
+                          n_adapters=self.n_adapters,
+                          name=f"block_{i}")(x, lens, positions, decode,
+                                             adapter_ids)
         x = RMSNorm(name="final_norm")(x)
         if return_hidden:
             # chunked-loss path (chunked_lm_loss_terms): hand back the
@@ -532,6 +569,52 @@ def lora_trainable_mask(params: Any) -> Any:
     return jax.tree_util.tree_map_with_path(trainable, params)
 
 
+def adapter_only_mask(params: Any) -> Any:
+    """True ONLY for ``lora_a``/``lora_b`` leaves — the strict LoRA
+    recipe (norms, lm_head, embeddings all frozen). Trials trained
+    under this mask differ exclusively in their adapters, which is the
+    contract :func:`stack_lora_adapters` / multi-adapter serving
+    enforces."""
+
+    def trainable(kp, _) -> bool:
+        path = "/".join(str(getattr(k, "key", k)) for k in kp).lower()
+        return "lora_a" in path or "lora_b" in path
+
+    return jax.tree_util.tree_map_with_path(trainable, params)
+
+
+def stack_lora_adapters(trees: List[Any], validate: bool = True) -> Any:
+    """Merge N adapter-only fine-tunes of one base into a single
+    multi-adapter param tree for ``Llama(n_adapters=N)``.
+
+    ``lora_a``/``lora_b`` leaves are stacked along a new leading
+    adapter axis; every other leaf is taken from ``trees[0]`` and (when
+    ``validate``) checked byte-identical across inputs — a mismatch
+    means the trials were NOT trained with ``adapters_only`` and
+    cannot share one serving engine (their norms/lm_head diverged).
+    ``validate=False`` skips the scan for huge trees whose provenance
+    is already known."""
+    if not trees:
+        raise ValueError("need at least one adapter tree")
+
+    def merge(kp, *leaves):
+        path = "/".join(str(getattr(k, "key", k)) for k in kp).lower()
+        if "lora_a" in path or "lora_b" in path:
+            return jnp.stack([jnp.asarray(lf) for lf in leaves], axis=0)
+        if validate:
+            first = np.asarray(leaves[0])
+            for i, lf in enumerate(leaves[1:], start=1):
+                if not np.array_equal(first, np.asarray(lf)):
+                    raise ValueError(
+                        f"non-adapter leaf {path!r} differs between "
+                        f"adapter 0 and {i}: multi-adapter serving "
+                        "requires trials trained with adapters_only=True "
+                        "(shared base/norms/lm_head)")
+        return leaves[0]
+
+    return jax.tree_util.tree_map_with_path(merge, trees[0], *trees[1:])
+
+
 @functools.partial(jax.jit, static_argnums=(0, 4))
 def _greedy_generate_impl(module: Llama, params: Any, prompt: jnp.ndarray,
                           plens: jnp.ndarray, max_new: int) -> jnp.ndarray:
@@ -609,6 +692,11 @@ class LlamaLoRA(BaseModel):
             # gradient checkpointing (train path): bigger batches for
             # ~1/3 extra FLOPs when activations are HBM-bound
             "remat": FixedKnob(False),
+            # train ONLY the lora_a/lora_b leaves (norms/lm_head frozen
+            # too): the contract multi-adapter serving needs — N trials
+            # that differ ONLY in adapters can then share one engine
+            # (make_multi_adapter_engine / stack_lora_adapters)
+            "adapters_only": FixedKnob(False),
             # >1 pipelines the decoder blocks over this many devices
             # (GPipe microbatching, parallel/pipeline.py); depth must
             # divide by it; mutually exclusive with model_parallel>1.
@@ -666,7 +754,8 @@ class LlamaLoRA(BaseModel):
                                                               1 << 14)))
 
     # ---- internals ----
-    def _module(self, quantized: bool = False) -> Llama:
+    def _module(self, quantized: bool = False,
+                n_adapters: int = 0) -> Llama:
         k = self.knobs
         hd = int(k["hidden_dim"])
         heads = int(k["n_heads"])
@@ -680,7 +769,7 @@ class LlamaLoRA(BaseModel):
                      remat=bool(k.get("remat", False)),
                      n_experts=int(k.get("moe_experts", 0)),
                      moe_top_k=int(k.get("moe_top_k", 1) or 1),
-                     quantized=quantized)
+                     quantized=quantized, n_adapters=n_adapters)
 
     def _serving_module_params(self) -> Tuple[Llama, Any]:
         """(module, params) for predict()/make_decode_engine — the int8
@@ -881,11 +970,13 @@ class LlamaLoRA(BaseModel):
         lr = float(self.knobs["learning_rate"])
         # multi_transform (not optax.masked): masked leaves pass raw
         # gradients through as updates, set_to_zero actually freezes
+        mask_fn = (adapter_only_mask
+                   if bool(self.knobs.get("adapters_only", False))
+                   else lora_trainable_mask)
         tx = optax.multi_transform(
             {"train": optax.adamw(lr), "freeze": optax.set_to_zero()},
             lambda p: jax.tree_util.tree_map(
-                lambda t: "train" if t else "freeze",
-                lora_trainable_mask(p)))
+                lambda t: "train" if t else "freeze", mask_fn(p)))
         opt_state = tx.init(params)
 
         # donate the param/opt trees: in-place update, no per-step copies
@@ -1070,28 +1161,73 @@ class LlamaLoRA(BaseModel):
         """Continuous-batching serving engine over this model's weights
         (BASELINE.md config #5). The inference worker drives it when
         running in decode-loop mode; see ``serving/decode_engine.py``."""
+        assert self._params is not None, "model is not trained/loaded"
+        module, params = self._serving_module_params()
+        text_engine = self._build_text_engine(
+            module, params, max_slots, max_new_tokens, steps_per_sync,
+            prefill_chunk, speculate_k)
+        if system_prefix:
+            text_engine.register_prefix(system_prefix)
+        return text_engine
+
+    def _build_text_engine(self, module, params, max_slots,
+                           max_new_tokens, steps_per_sync, prefill_chunk,
+                           speculate_k):
+        """Common engine wiring for the single- and multi-adapter
+        flavors: this model's tokenizer around a DecodeEngine."""
         from rafiki_tpu.serving.decode_engine import (DecodeEngine,
                                                       TextDecodeEngine)
 
-        assert self._params is not None, "model is not trained/loaded"
         max_len = int(self.knobs["max_len"])
 
         def encode(text: str) -> np.ndarray:
             row, n = self.tokenizer.encode(str(text), max_len)
             return row[:max(1, int(n))]
 
-        module, params = self._serving_module_params()
         core = DecodeEngine(module, params,
                             max_slots=max_slots, max_len=max_len,
                             steps_per_sync=steps_per_sync,
                             prefill_chunk=prefill_chunk,
                             speculate_k=speculate_k)
-        text_engine = TextDecodeEngine(
+        return TextDecodeEngine(
             core, encode, self._detok,
             max_new=min(max_new_tokens, max_len - 1))
-        if system_prefix:
-            text_engine.register_prefix(system_prefix)
-        return text_engine
+
+    def make_multi_adapter_engine(self, adapter_params: Sequence[Any],
+                                  max_slots: int = 8,
+                                  max_new_tokens: int = 8,
+                                  steps_per_sync: int = 4,
+                                  prefill_chunk: int = 32,
+                                  speculate_k: int = 0,
+                                  validate: bool = True):
+        """ONE continuous-batching engine serving N adapter-only
+        fine-tunes of one base (S-LoRA-style multi-adapter serving).
+
+        The reference deploys its best-N trials as N independent worker
+        replicas, each holding a full model (SURVEY.md §3.3). When the
+        trials are LoRA fine-tunes trained with ``adapters_only=True``,
+        they differ only in their (tiny) adapter matrices — so all N
+        can share one base model's HBM and one compiled decode step,
+        with each request selecting its fine-tune via
+        ``submit(..., adapter_id=i)``. Requests against different
+        adapters batch together in the same fused step: the base matmul
+        runs once for the whole batch; only the rank-r correction is
+        per-row (see ``LoRADense.n_adapters``).
+
+        ``adapter_params``: param trees in adapter-id order (e.g.
+        ``[trial_a.params, trial_b.params]``); non-adapter leaves must
+        be identical across trees (validated unless ``validate=False``)
+        and the engine serves with ``adapter_params[0]``'s base.
+        Tokenization comes from THIS model. Int8 quantized serving is
+        not composed here (the single-adapter engine's path)."""
+        trees = list(adapter_params)
+        if not trees:
+            raise ValueError("adapter_params must name >= 1 trees")
+        stacked = stack_lora_adapters(trees, validate=validate)
+        module = self._module(n_adapters=len(trees))
+        return self._build_text_engine(
+            module, stacked, max_slots, max_new_tokens, steps_per_sync,
+            prefill_chunk, speculate_k)
 
     def dump_parameters(self) -> Dict[str, Any]:
         assert self._params is not None, "model is not trained"

@@ -62,6 +62,7 @@ class _Slot:
     top_p: float = 1.0          # >= 1 → no nucleus cut
     seed: int = 0               # with (position) → the sample's PRNG key
     eos_id: Optional[int] = None  # emitting this token ends the request
+    adapter_id: int = 0         # multi-adapter engines: which fine-tune
     n_consumed: int = 0         # tokens fed to the model so far
     generated: List[int] = field(default_factory=list)
     n_streamed: int = 0         # generated tokens already poll_partial'd
@@ -132,6 +133,10 @@ class DecodeEngine:
         self._topk = np.zeros((self.B,), np.int32)
         self._topp = np.ones((self.B,), np.float32)
         self._seed = np.zeros((self.B,), np.int32)
+        #: multi-adapter serving (module.n_adapters > 0): per-slot
+        #: adapter selection, a device operand like the sampling knobs
+        self.n_adapters = int(getattr(module, "n_adapters", 0) or 0)
+        self._aid = np.zeros((self.B,), np.int32)
         #: device-resident prompt copy, refreshed only on admission — the
         #: (B, L) buffer must not ride host→device on every dispatch
         self._prompt_dev: Optional[jnp.ndarray] = None
@@ -163,7 +168,8 @@ class DecodeEngine:
     def submit(self, request_id: Any, prompt_ids: np.ndarray,
                max_new: int, temperature: float = 0.0, top_k: int = 0,
                top_p: float = 1.0, seed: int = 0,
-               eos_id: Optional[int] = None) -> None:
+               eos_id: Optional[int] = None,
+               adapter_id: int = 0) -> None:
         """Queue a request. ``prompt_ids``: 1-D valid tokens (≥1); the
         prompt + generation must fit the cache (truncated to fit).
 
@@ -179,16 +185,30 @@ class DecodeEngine:
         EOS itself is dropped from the reply; tokens a fused call
         computed past it are discarded host-side and their cache rows
         are unreachable-then-rewritten, the standard slot-reuse
-        invariant)."""
+        invariant).
+
+        ``adapter_id`` (multi-adapter engines only): which stacked
+        fine-tune this request decodes under. Out-of-range ids raise
+        ``ValueError`` — silently serving a DIFFERENT fine-tune would
+        be a correct-looking wrong answer (each adapter is a different
+        trial/tenant). Ignored on single-adapter engines."""
         prompt = np.asarray(prompt_ids, np.int32).ravel()
         max_new = max(1, min(int(max_new), self.L - 1))
         prompt = prompt[:max(1, self.L - max_new)]
+        aid = 0
+        if self.n_adapters > 0:
+            aid = int(adapter_id)
+            if not 0 <= aid < self.n_adapters:
+                raise ValueError(
+                    f"adapter_id {aid} out of range for "
+                    f"{self.n_adapters}-adapter engine")
         with self._lock:
             self._queue.append(_Slot(
                 request_id, prompt, max_new,
                 temperature=float(temperature), top_k=int(top_k),
                 top_p=float(top_p), seed=int(seed),
-                eos_id=None if eos_id is None else int(eos_id)))
+                eos_id=None if eos_id is None else int(eos_id),
+                adapter_id=aid))
 
     def poll(self) -> List[Tuple[Any, List[int]]]:
         """Completed (request_id, generated ids) since the last poll."""
@@ -212,7 +232,8 @@ class DecodeEngine:
                 slot.n_streamed = len(slot.generated)
         return out
 
-    def register_prefix(self, prefix_ids: np.ndarray) -> int:
+    def register_prefix(self, prefix_ids: np.ndarray,
+                        adapter_id: int = 0) -> int:
         """Precompute the KV cache of a shared prompt prefix (system
         prompt). Any later request whose prompt strictly extends these
         tokens skips their prefill: admission copies the snapshot's KV
@@ -223,11 +244,22 @@ class DecodeEngine:
         Returns the registered length (truncated to leave room for at
         least one prompt token + one generated token). Not safe to call
         concurrently with ``step`` (register before serving traffic, or
-        between steps)."""
+        between steps).
+
+        ``adapter_id`` (multi-adapter engines): the prefix KV is a
+        function of the adapter that computed it, so hits are gated on
+        the requesting slot's adapter matching this one."""
         prefix = np.asarray(prefix_ids, np.int32).ravel()[:self.L - 2]
         if len(prefix) == 0:
             self._prefix = None
             return 0
+        aid = 0
+        if self.n_adapters > 0:
+            aid = int(adapter_id)
+            if not 0 <= aid < self.n_adapters:
+                raise ValueError(
+                    f"adapter_id {aid} out of range for "
+                    f"{self.n_adapters}-adapter engine")
         cache1 = self.module.init(
             jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32),
             decode=True)["cache"]
@@ -235,7 +267,8 @@ class DecodeEngine:
         # as chunked prefill, batch 1, chunk = len(prefix))
         fill = _make_prefill(self.module, 1, len(prefix))
         snap = fill(self.params, cache1, jnp.asarray(prefix[None, :]),
-                    jnp.arange(len(prefix), dtype=jnp.int32)[None, :])
+                    jnp.arange(len(prefix), dtype=jnp.int32)[None, :],
+                    jnp.asarray([aid], jnp.int32))
         plen = len(prefix)
 
         # jitted once per registration (compile cache keys on the rows
@@ -247,7 +280,7 @@ class DecodeEngine:
                     p[:, :plen].astype(c.dtype)), cache, pre)
 
         self._prefix = {"ids": prefix, "cache": jax.block_until_ready(snap),
-                        "len": plen, "install": install}
+                        "len": plen, "install": install, "aid": aid}
         return plen
 
     def _install_prefix(self, rows: List[int],
@@ -282,6 +315,7 @@ class DecodeEngine:
         self._topk[:] = 0
         self._topp[:] = 1.0
         self._seed[:] = 0
+        self._aid[:] = 0
         self._prompt_dev = None
         self._spec_ema = SPEC_MIN_TOKENS_PER_CALL + 0.5
         self._spec_idle = 0
@@ -321,7 +355,7 @@ class DecodeEngine:
                     pos_chunk[i, :] = self._pos[i]
             self._cache = self._prefill_fn(
                 self.params, self._cache, jnp.asarray(tok_chunk),
-                jnp.asarray(pos_chunk))
+                jnp.asarray(pos_chunk), jnp.asarray(self._aid))
             self.stats["prefill_calls"] += 1
             self.stats["prefill_tokens"] += int(adv.sum())
             for i in range(self.B):
@@ -349,6 +383,7 @@ class DecodeEngine:
                     self._prompt_buf[i, :len(slot.prompt)] = slot.prompt
                     self._prompt_len[i] = len(slot.prompt)
                     if (pre is not None and len(slot.prompt) > pre["len"]
+                            and slot.adapter_id == pre.get("aid", 0)
                             and np.array_equal(slot.prompt[:pre["len"]],
                                                pre["ids"])):
                         # shared-prefix hit: skip its prefill — the KV
@@ -367,6 +402,7 @@ class DecodeEngine:
                     self._topk[i] = slot.top_k
                     self._topp[i] = slot.top_p
                     self._seed[i] = np.int32(slot.seed & 0x7FFFFFFF)
+                    self._aid[i] = slot.adapter_id
                     admitted = True
             live = [i for i in range(self.B) if self._slots[i] is not None]
             self.stats["max_concurrent"] = max(self.stats["max_concurrent"],
@@ -406,7 +442,8 @@ class DecodeEngine:
             jnp.asarray(self._pos), self._prompt_dev,
             jnp.asarray(self._prompt_len), jnp.asarray(self._stop_pos),
             jnp.asarray(self._temp), jnp.asarray(self._topk),
-            jnp.asarray(self._topp), jnp.asarray(self._seed))
+            jnp.asarray(self._topp), jnp.asarray(self._seed),
+            jnp.asarray(self._aid))
         emitted = np.asarray(emitted)  # (K, B) — the per-token sync
         self.stats["steps"] += self.K
 
@@ -471,7 +508,7 @@ class DecodeEngine:
         self._cache, g, n_emit = self._verify_fn(
             self.params, self._cache, jnp.asarray(self._tok),
             jnp.asarray(self._pos), jnp.asarray(drafts),
-            jnp.asarray(self._stop_pos))
+            jnp.asarray(self._stop_pos), jnp.asarray(self._aid))
         g = np.asarray(g)            # (B, k) model argmax per position
         n_emit = np.asarray(n_emit)  # (B,) 1 + accepted draft prefix
         self.stats["steps"] += 1
@@ -584,18 +621,23 @@ def _make_step(module: Any, n_slots: int, k: int,
     plain argmax otherwise — the greedy program never compiles the
     sampler's per-token vocab sort). Slots whose next position reaches
     ``stop_pos`` freeze (their tok/pos stop advancing) so a finished
-    slot idles harmlessly for the remainder of the scan."""
+    slot idles harmlessly for the remainder of the scan.
+
+    Multi-adapter modules additionally consume the per-slot ``aid``
+    operand (which stacked fine-tune each row decodes under)."""
+    multi = int(getattr(module, "n_adapters", 0) or 0) > 0
 
     @functools.partial(jax.jit, donate_argnums=(1,))
     def step_fn(params, cache, tok, pos, prompt_buf, prompt_len, stop_pos,
-                temp, top_k, top_p, seed):
+                temp, top_k, top_p, seed, aid):
         rows = jnp.arange(n_slots)
 
         def body(carry, _):
             cache, tok, pos = carry
             logits, muts = module.apply(
                 {"params": params, "cache": cache}, tok[:, None],
-                positions=pos[:, None], decode=True, mutable=["cache"])
+                positions=pos[:, None], decode=True, mutable=["cache"],
+                **({"adapter_ids": aid} if multi else {}))
             lg = logits[:, -1].astype(jnp.float32)
             if sampling:
                 nxt = _select_next(lg, temp, top_k, top_p, seed, pos)
@@ -631,8 +673,10 @@ def _make_verify(module: Any, n_slots: int, k: int) -> Callable:
     makes greedy speculation lossless. Free/finished slots re-feed their
     current token at their current position (an idempotent rewrite)."""
 
+    multi = int(getattr(module, "n_adapters", 0) or 0) > 0
+
     @functools.partial(jax.jit, donate_argnums=(1,))
-    def verify_fn(params, cache, tok, pos, drafts, stop_pos):
+    def verify_fn(params, cache, tok, pos, drafts, stop_pos, aid):
         active = (pos < stop_pos)[:, None]
         offs = jnp.arange(k)[None, :]
         seq = jnp.concatenate([tok[:, None], drafts], axis=1)
@@ -640,7 +684,8 @@ def _make_verify(module: Any, n_slots: int, k: int) -> Callable:
         positions = jnp.where(active, pos[:, None] + offs, pos[:, None])
         logits, muts = module.apply(
             {"params": params, "cache": cache}, seq,
-            positions=positions, decode=True, mutable=["cache"])
+            positions=positions, decode=True, mutable=["cache"],
+            **({"adapter_ids": aid} if multi else {}))
         g = jnp.argmax(logits.astype(jnp.float32), -1).astype(jnp.int32)
         ok = jnp.cumprod((drafts == g[:, :-1]).astype(jnp.int32), axis=1)
         n_emit = 1 + jnp.sum(ok, axis=1).astype(jnp.int32)
@@ -656,12 +701,14 @@ def _make_prefill(module: Any, n_slots: int, chunk: int) -> Callable:
     discarded (prefill emits nothing), so XLA dead-code-eliminates the
     (B, C, vocab) projection — the call is pure KV-cache population at
     matmul (not matvec) arithmetic intensity."""
+    multi = int(getattr(module, "n_adapters", 0) or 0) > 0
 
     @functools.partial(jax.jit, donate_argnums=(1,))
-    def prefill_fn(params, cache, tok_chunk, pos_chunk):
+    def prefill_fn(params, cache, tok_chunk, pos_chunk, aid):
         _, muts = module.apply(
             {"params": params, "cache": cache}, tok_chunk,
-            positions=pos_chunk, decode=True, mutable=["cache"])
+            positions=pos_chunk, decode=True, mutable=["cache"],
+            **({"adapter_ids": aid} if multi else {}))
         return muts["cache"]
 
     return prefill_fn
@@ -687,11 +734,12 @@ class TextDecodeEngine:
     def submit(self, request_id: Any, text: str,
                max_new: Optional[int] = None, temperature: float = 0.0,
                top_k: int = 0, top_p: float = 1.0, seed: int = 0,
-               eos_id: Optional[int] = None) -> None:
+               eos_id: Optional[int] = None, adapter_id: int = 0) -> None:
         self.engine.submit(request_id, self._encode(text),
                            self.max_new if max_new is None else max_new,
                            temperature=temperature, top_k=top_k,
-                           top_p=top_p, seed=seed, eos_id=eos_id)
+                           top_p=top_p, seed=seed, eos_id=eos_id,
+                           adapter_id=adapter_id)
 
     def poll(self) -> List[Tuple[Any, str]]:
         done = [(rid, self._decode(ids)) for rid, ids in self.engine.poll()]
@@ -722,11 +770,12 @@ class TextDecodeEngine:
                 self._stream_sent[rid] = text
         return out
 
-    def register_prefix(self, text: str) -> int:
+    def register_prefix(self, text: str, adapter_id: int = 0) -> int:
         """Precompute KV for a shared prompt prefix (system prompt);
         see :meth:`DecodeEngine.register_prefix`. Call before serving
         traffic (not concurrently with ``step``)."""
-        return self.engine.register_prefix(self._encode(text))
+        return self.engine.register_prefix(self._encode(text),
+                                           adapter_id=adapter_id)
 
     def step(self) -> int:
         return self.engine.step()

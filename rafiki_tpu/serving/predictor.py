@@ -198,7 +198,11 @@ class Predictor:
                     continue  # pop_prediction timed out early; re-check
                 reply = unpack_message(reply_bytes)
                 if reply.get("error"):
-                    final = {"done": True, "error": str(reply["error"])}
+                    # same terminal contract as the timeout branch: the
+                    # client learns what streamed text is authoritative
+                    final = {"done": True, "error": str(reply["error"]),
+                             "partial": [acc.get(i)
+                                         for i in range(len(queries))]}
                     break
                 if "delta" in reply:
                     d = {int(k): str(v)

@@ -114,6 +114,11 @@ class InProcQueueHub(QueueHub):
         self._meta = threading.Lock()  # guards the key → queue dict
         self._ops = 0
         self._stats: Dict[str, Dict[str, Any]] = {}  # worker counters
+        #: armed reply-queue TTLs (key → monotonic deadline): unlike the
+        #: idle sweep, an armed TTL fires even while late pushes keep
+        #: refreshing last_used (an abandoned STREAM's worker keeps
+        #: pushing deltas long after the client went away)
+        self._ttls: Dict[str, float] = {}
 
     def _get(self, key: str, *, as_waiter: bool = False) -> _KeyQueue:
         import time
@@ -132,7 +137,8 @@ class InProcQueueHub(QueueHub):
             q.last_used = time.monotonic()
             self._ops += 1
             if self._ops % _SWEEP_EVERY == 0:
-                cutoff = q.last_used - _IDLE_TTL_S
+                now = q.last_used  # just-refreshed monotonic time
+                cutoff = now - _IDLE_TTL_S
                 dead = [k for k, v in self._queues.items()
                         if not v.waiters and v.last_used < cutoff
                         # reply queues (p:*) expire even NON-empty: a
@@ -141,6 +147,14 @@ class InProcQueueHub(QueueHub):
                         and (not v.dq or k.startswith("p:"))]
                 for k in dead:  # e.g. replies that arrived after their
                     del self._queues[k]  # query's gather deadline
+                # armed TTLs fire regardless of last_used (a worker
+                # still streaming deltas into an abandoned queue keeps
+                # it perpetually 'fresh' for the idle sweep above)
+                for k in [k for k, dl in self._ttls.items() if dl < now]:
+                    del self._ttls[k]
+                    v = self._queues.get(k)
+                    if v is not None and not v.waiters:
+                        del self._queues[k]
             return q
 
     def _push(self, key: str, data: bytes) -> None:
@@ -178,6 +192,12 @@ class InProcQueueHub(QueueHub):
         with self._meta:
             q = self._queues.get(f"q:{worker_id}")
         return len(q.dq) if q is not None else 0
+
+    def arm_reply_ttl(self, query_id: str, ttl_s: float) -> None:
+        import time
+
+        with self._meta:
+            self._ttls[f"p:{query_id}"] = time.monotonic() + float(ttl_s)
 
     def discard_prediction_queue(self, query_id: str) -> None:
         with self._meta:

@@ -210,13 +210,24 @@ class InferenceWorker:
                         {"id": m["id"], "worker_id": self.worker_id,
                          "predictions": []}))
                 else:
-                    inflight[m["id"]] = [len(qs), {}]
-                    if m.get("stream"):
-                        streaming.add(m["id"])
                     samp = _safe_sampling(m.get("sampling"))
-                    for qi, text in enumerate(qs):
-                        self.engine.submit((m["id"], qi), str(text),
-                                           **samp)
+                    try:
+                        for qi, text in enumerate(qs):
+                            self.engine.submit((m["id"], qi), str(text),
+                                               **samp)
+                    except ValueError as e:
+                        # e.g. adapter_id out of range on a multi-
+                        # adapter engine: reject the whole message —
+                        # serving a different fine-tune than requested
+                        # would be a correct-looking wrong answer
+                        self.hub.push_prediction(m["id"], pack_message(
+                            {"id": m["id"],
+                             "worker_id": self.worker_id,
+                             "predictions": [], "error": str(e)}))
+                    else:
+                        inflight[m["id"]] = [len(qs), {}]
+                        if m.get("stream"):
+                            streaming.add(m["id"])
                 raw = self.hub.pop_query(self.worker_id, 0.0)
             if not self.engine.busy:
                 continue
@@ -319,7 +330,10 @@ def _safe_sampling(samp: Any) -> dict:
     eos = num("eos_id", int, None)  # absent/malformed → None
     if eos is not None and eos >= 0:
         out["eos_id"] = eos
-    return out
+    aid = num("adapter_id", int, 0)  # multi-adapter engines: which
+    if aid and aid > 0:              # fine-tune serves this request
+        out["adapter_id"] = aid      # (out-of-range ids are REJECTED
+    return out                       # by the engine → error reply)
 
 
 def _expired(msg: dict, skew_s: float = EXPIRY_SKEW_TOLERANCE_S) -> bool:
