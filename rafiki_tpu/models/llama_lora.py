@@ -172,6 +172,14 @@ class _DecoderAttention(nn.Module):
     lora_rank: int
     quantized: bool = False
     n_adapters: int = 0
+    #: sequence parallelism (train path): run the causal attention via
+    #: ulysses all-to-alls over mesh[seq_axis], with the sequence dim of
+    #: every activation sharded on that axis. Loss-exact WITHOUT kv_lens
+    #: masking: causal attention means padded keys (beyond an example's
+    #: length) are only visible to queries AT padded positions, whose
+    #: loss terms are masked — valid positions' logits are untouched.
+    seq_mesh: Any = None
+    seq_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, lens: jnp.ndarray,
@@ -239,10 +247,19 @@ class _DecoderAttention(nn.Module):
         else:
             kk = jnp.repeat(k, rep, axis=2)
             vv = jnp.repeat(v, rep, axis=2)
-            o = flash_attention(q.transpose(0, 2, 1, 3),
-                                kk.transpose(0, 2, 1, 3),
-                                vv.transpose(0, 2, 1, 3),
-                                causal=True, kv_lens=lens)
+            if self.seq_axis is not None:
+                from rafiki_tpu.ops.ulysses import ulysses_attention
+
+                o = ulysses_attention(
+                    q.transpose(0, 2, 1, 3), kk.transpose(0, 2, 1, 3),
+                    vv.transpose(0, 2, 1, 3), self.seq_mesh,
+                    self.seq_axis, causal=True,
+                    batch_axis=DATA_AXIS)
+            else:
+                o = flash_attention(q.transpose(0, 2, 1, 3),
+                                    kk.transpose(0, 2, 1, 3),
+                                    vv.transpose(0, 2, 1, 3),
+                                    causal=True, kv_lens=lens)
             o = o.transpose(0, 2, 1, 3)
         o = o.reshape(b, s, self.n_heads * dh)
         return dense(d, name="wo")(o, adapter_ids)
@@ -258,12 +275,15 @@ class _DecoderBlock(nn.Module):
     moe_top_k: int = 1  # experts per token (1 Switch, 2 Mixtral-style)
     quantized: bool = False  # int8 base kernels (MoE experts stay f32)
     n_adapters: int = 0  # >0 → per-row stacked adapters (serving)
+    seq_mesh: Any = None  # sequence parallelism (see _DecoderAttention)
+    seq_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, lens, positions, decode, adapter_ids=None):
         x = x + _DecoderAttention(
             self.n_heads, self.n_kv_heads, self.max_len, self.lora_rank,
             quantized=self.quantized, n_adapters=self.n_adapters,
+            seq_mesh=self.seq_mesh, seq_axis=self.seq_axis,
             name="attn")(RMSNorm()(x), lens, positions, decode,
                          adapter_ids)
         y = RMSNorm()(x)
@@ -318,6 +338,14 @@ class Llama(nn.Module):
     # ``adapter_ids`` call operand (see LoRADense.n_adapters). Build
     # the stacked params with :func:`stack_lora_adapters`.
     n_adapters: int = 0
+    # sequence parallelism (train path): with seq_axis set, the causal
+    # attention runs via ulysses all-to-alls over mesh[seq_axis] and
+    # callers shard every (B, L) operand's L on that axis — long
+    # sequences whose activations exceed one device's HBM train with
+    # each device holding L/P of every activation. Static module
+    # config, like dtype/remat (Mesh is hashable).
+    seq_mesh: Any = None
+    seq_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, ids: jnp.ndarray, lens: Optional[jnp.ndarray] = None,
@@ -347,6 +375,7 @@ class Llama(nn.Module):
                           moe_top_k=self.moe_top_k,
                           quantized=self.quantized,
                           n_adapters=self.n_adapters,
+                          seq_mesh=self.seq_mesh, seq_axis=self.seq_axis,
                           name=f"block_{i}")(x, lens, positions, decode,
                                              adapter_ids)
         x = RMSNorm(name="final_norm")(x)
@@ -552,6 +581,13 @@ def pipelined_lm_forward(module: Llama, params: Any, ids: jnp.ndarray,
         {"params": params["lm_head"]}, h)
 
 
+def _kp_path(kp) -> str:
+    """Render a tree_map_with_path key path as a lowercase '/'-joined
+    string. lower(): flax auto-names unnamed instances "RMSNorm_0"
+    etc."""
+    return "/".join(str(getattr(k, "key", k)) for k in kp).lower()
+
+
 def lora_trainable_mask(params: Any) -> Any:
     """True for LoRA adapters, norms, the LM head, and MoE layers;
     False (frozen) for base kernels and the embedding — the LoRA
@@ -561,8 +597,7 @@ def lora_trainable_mask(params: Any) -> Any:
     residual stream; they always train."""
 
     def trainable(kp, _) -> bool:
-        path = "/".join(str(getattr(k, "key", k)) for k in kp).lower()
-        # lower(): flax auto-names unnamed instances "RMSNorm_0" etc.
+        path = _kp_path(kp)
         return ("lora_" in path or "norm" in path or "/moe/" in path
                 or path.startswith("lm_head"))
 
@@ -577,7 +612,7 @@ def adapter_only_mask(params: Any) -> Any:
     enforces."""
 
     def trainable(kp, _) -> bool:
-        path = "/".join(str(getattr(k, "key", k)) for k in kp).lower()
+        path = _kp_path(kp)
         return "lora_a" in path or "lora_b" in path
 
     return jax.tree_util.tree_map_with_path(trainable, params)
@@ -598,7 +633,7 @@ def stack_lora_adapters(trees: List[Any], validate: bool = True) -> Any:
         raise ValueError("need at least one adapter tree")
 
     def merge(kp, *leaves):
-        path = "/".join(str(getattr(k, "key", k)) for k in kp).lower()
+        path = _kp_path(kp)
         if "lora_a" in path or "lora_b" in path:
             return jnp.stack([jnp.asarray(lf) for lf in leaves], axis=0)
         if validate:
@@ -697,6 +732,13 @@ class LlamaLoRA(BaseModel):
             # that differ ONLY in adapters can then share one engine
             # (make_multi_adapter_engine / stack_lora_adapters)
             "adapters_only": FixedKnob(False),
+            # >1 shards the SEQUENCE dim of every train activation over
+            # this many devices, attention via ulysses all-to-alls
+            # (ops/ulysses.py) — the long-context train path. Composes
+            # with data parallelism ((data, sp) mesh); heads and
+            # max_len must divide by it; mutually exclusive with
+            # model_parallel/pipeline_stages>1 and loss_chunk.
+            "sequence_parallel": FixedKnob(1),
             # >1 pipelines the decoder blocks over this many devices
             # (GPipe microbatching, parallel/pipeline.py); depth must
             # divide by it; mutually exclusive with model_parallel>1.
@@ -754,8 +796,9 @@ class LlamaLoRA(BaseModel):
                                                               1 << 14)))
 
     # ---- internals ----
-    def _module(self, quantized: bool = False,
-                n_adapters: int = 0) -> Llama:
+    def _module(self, quantized: bool = False, n_adapters: int = 0,
+                seq_mesh: Any = None,
+                seq_axis: Optional[str] = None) -> Llama:
         k = self.knobs
         hd = int(k["hidden_dim"])
         heads = int(k["n_heads"])
@@ -769,7 +812,8 @@ class LlamaLoRA(BaseModel):
                      remat=bool(k.get("remat", False)),
                      n_experts=int(k.get("moe_experts", 0)),
                      moe_top_k=int(k.get("moe_top_k", 1) or 1),
-                     quantized=quantized, n_adapters=n_adapters)
+                     quantized=quantized, n_adapters=n_adapters,
+                     seq_mesh=seq_mesh, seq_axis=seq_axis)
 
     def _serving_module_params(self) -> Tuple[Llama, Any]:
         """(module, params) for predict()/make_decode_engine — the int8
@@ -827,6 +871,43 @@ class LlamaLoRA(BaseModel):
         module = self._module()
         devices = ctx.devices or jax.local_devices()
         mesh = self._mesh(devices)
+        sp = int(self.knobs.get("sequence_parallel", 1) or 1)
+        if sp > 1:
+            # sequence parallelism: (data, sp) mesh, every (B, L)
+            # operand's L sharded over `sp`, attention via ulysses
+            # all-to-alls (module seq_mesh/seq_axis). Long-context
+            # regime — each device holds L/sp of every activation.
+            from jax.sharding import Mesh
+
+            if int(self.knobs.get("model_parallel", 1)) > 1 or \
+                    int(self.knobs.get("pipeline_stages", 1) or 1) > 1:
+                raise ValueError(
+                    "sequence_parallel>1 is mutually exclusive with "
+                    "model_parallel/pipeline_stages>1 (the sp mesh "
+                    "pairs with data parallelism only)")
+            if int(self.knobs.get("moe_experts", 0)):
+                raise ValueError("sequence_parallel>1 does not support "
+                                 "MoE blocks (experts shard over the "
+                                 "model axis the sp mesh lacks)")
+            if int(self.knobs.get("loss_chunk", 0) or 0):
+                raise ValueError(
+                    "sequence_parallel>1 is incompatible with "
+                    "loss_chunk (chunk slicing would re-gather the "
+                    "sp-sharded sequence every chunk)")
+            if len(devices) % sp:
+                raise ValueError(f"sequence_parallel={sp} must divide "
+                                 f"the trial's {len(devices)} devices")
+            if int(self.knobs["n_heads"]) % sp:
+                raise ValueError(f"n_heads {self.knobs['n_heads']} must "
+                                 f"divide by sequence_parallel={sp} "
+                                 "(ulysses splits heads; use ring "
+                                 "attention otherwise)")
+            if int(self.knobs["max_len"]) % sp:
+                raise ValueError(f"max_len {self.knobs['max_len']} must "
+                                 f"divide by sequence_parallel={sp}")
+            mesh = Mesh(np.array(devices, dtype=object).reshape(-1, sp),
+                        (DATA_AXIS, "sp"))
+            module = self._module(seq_mesh=mesh, seq_axis="sp")
         pp_stages = int(self.knobs.get("pipeline_stages", 1) or 1)
         n_micro = int(self.knobs.get("pipeline_microbatches", 0)
                       or 0) or pp_stages
@@ -874,6 +955,15 @@ class LlamaLoRA(BaseModel):
                 f"moe_experts={n_experts} must be divisible by the "
                 f"mesh's model axis ({mesh.shape[MODEL_AXIS]})")
         b_shard = batch_sharding(mesh)
+        if sp > 1:
+            # per-leaf shardings: (B, L) operands shard L over `sp`
+            # (ids and the loss mask); per-example lens shard batch only
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            batch1d = NamedSharding(mesh, PartitionSpec(DATA_AXIS))
+            b_shard = {"ids": NamedSharding(
+                mesh, PartitionSpec(DATA_AXIS, "sp")),
+                "lens": batch1d, "m": batch1d}  # lens/mask: per-example
 
         n_data = mesh.shape[DATA_AXIS]
         batch_size = int(self.knobs["batch_size"])
@@ -887,9 +977,13 @@ class LlamaLoRA(BaseModel):
         pretrained = str(self.knobs.get("pretrained_path") or "")
         fresh = self._params is None
         if fresh:
-            params = module.init(jax.random.PRNGKey(0),
-                                 jnp.zeros((1, ids.shape[1]),
-                                           jnp.int32))["params"]
+            # init through the PLAIN module even in sp mode: ulysses
+            # adds no params, and its shard_map would reject the
+            # single-row init trace (batch 1 can't shard over `data`)
+            init_module = self._module() if sp > 1 else module
+            params = init_module.init(jax.random.PRNGKey(0),
+                                      jnp.zeros((1, ids.shape[1]),
+                                                jnp.int32))["params"]
         else:
             params = self._params
         warm = False
@@ -920,7 +1014,8 @@ class LlamaLoRA(BaseModel):
             from rafiki_tpu.models.convert import import_llama_safetensors
 
             params = import_llama_safetensors(
-                pretrained, params, mesh=mesh, tp_rules=TP_RULES,
+                pretrained, params, mesh=mesh,
+                tp_rules=None if sp > 1 else TP_RULES,
                 fsdp=True, min_size=2 ** 12)
         # 2-D sharding: tensor-parallel per TP_RULES over `model`, fsdp
         # over `data` for everything of >=4k elements — smaller tensors
@@ -951,8 +1046,11 @@ class LlamaLoRA(BaseModel):
                 lambda x: jax.device_put(x, rep_pp), params)
             b_shard = rep_pp
         else:
-            p_shard = param_shardings(params, mesh, tp_rules=TP_RULES,
-                                      fsdp=True, min_size=2 ** 12)
+            # sp mesh has no `model` axis: fsdp-over-data only (the sp
+            # regime is activations-bound; adapters are tiny anyway)
+            p_shard = param_shardings(
+                params, mesh, tp_rules=None if sp > 1 else TP_RULES,
+                fsdp=True, min_size=2 ** 12)
             params = jax.tree_util.tree_map(jax.device_put, params,
                                             p_shard)
         if shared_ref is not None:

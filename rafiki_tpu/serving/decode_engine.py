@@ -195,13 +195,7 @@ class DecodeEngine:
         prompt = np.asarray(prompt_ids, np.int32).ravel()
         max_new = max(1, min(int(max_new), self.L - 1))
         prompt = prompt[:max(1, self.L - max_new)]
-        aid = 0
-        if self.n_adapters > 0:
-            aid = int(adapter_id)
-            if not 0 <= aid < self.n_adapters:
-                raise ValueError(
-                    f"adapter_id {aid} out of range for "
-                    f"{self.n_adapters}-adapter engine")
+        aid = self._check_adapter_id(adapter_id)
         with self._lock:
             self._queue.append(_Slot(
                 request_id, prompt, max_new,
@@ -209,6 +203,19 @@ class DecodeEngine:
                 top_p=float(top_p), seed=int(seed),
                 eos_id=None if eos_id is None else int(eos_id),
                 adapter_id=aid))
+
+    def _check_adapter_id(self, adapter_id: int) -> int:
+        """Validate a request's adapter selection. Out-of-range ids
+        raise — silently serving a DIFFERENT fine-tune would be a
+        correct-looking wrong answer (each adapter is a different
+        trial/tenant). Single-adapter engines ignore the field."""
+        if self.n_adapters <= 0:
+            return 0
+        aid = int(adapter_id)
+        if not 0 <= aid < self.n_adapters:
+            raise ValueError(f"adapter_id {aid} out of range for "
+                             f"{self.n_adapters}-adapter engine")
+        return aid
 
     def poll(self) -> List[Tuple[Any, List[int]]]:
         """Completed (request_id, generated ids) since the last poll."""
@@ -253,13 +260,7 @@ class DecodeEngine:
         if len(prefix) == 0:
             self._prefix = None
             return 0
-        aid = 0
-        if self.n_adapters > 0:
-            aid = int(adapter_id)
-            if not 0 <= aid < self.n_adapters:
-                raise ValueError(
-                    f"adapter_id {aid} out of range for "
-                    f"{self.n_adapters}-adapter engine")
+        aid = self._check_adapter_id(adapter_id)
         cache1 = self.module.init(
             jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32),
             decode=True)["cache"]

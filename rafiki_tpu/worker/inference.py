@@ -331,9 +331,12 @@ def _safe_sampling(samp: Any) -> dict:
     if eos is not None and eos >= 0:
         out["eos_id"] = eos
     aid = num("adapter_id", int, 0)  # multi-adapter engines: which
-    if aid and aid > 0:              # fine-tune serves this request
-        out["adapter_id"] = aid      # (out-of-range ids are REJECTED
-    return out                       # by the engine → error reply)
+    if aid:  # forward any non-default id, INCLUDING negatives — the
+        # engine rejects out-of-range values and the caller gets an
+        # error reply; silently mapping -1 to adapter 0 would be the
+        # correct-looking wrong-tenant answer the validation exists for
+        out["adapter_id"] = aid
+    return out
 
 
 def _expired(msg: dict, skew_s: float = EXPIRY_SKEW_TOLERANCE_S) -> bool:

@@ -212,6 +212,13 @@ def test_multi_adapter_through_serving_stack(trained):  # noqa: F811
                                sampling={"adapter_id": 0})
         out1, _ = pred.predict(["tok1 tok2 tok3"],
                                sampling={"adapter_id": 1})
+        # negative ids must be REJECTED (error reply), not silently
+        # served by adapter 0 — wrong-tenant answers are the failure
+        # mode the validation exists for
+        _, info_neg = pred.predict(["tok1 tok2 tok3"],
+                                   sampling={"adapter_id": -1})
+        assert info_neg["errors"] and \
+            "out of range" in info_neg["errors"][0]
         # solo engines as oracles, through the same tokenizer
         solo0 = trained.make_decode_engine(max_slots=1, max_new_tokens=6)
         solo0.submit("s", "tok1 tok2 tok3")
