@@ -272,8 +272,14 @@ class Admin:
 
     # ---- inference jobs ----
     def create_inference_job(self, user_id: str, train_job_id: str,
-                             max_workers: int = 2) -> Dict[str, Any]:
-        job = self.meta.create_inference_job(user_id, train_job_id)
+                             max_workers: int = 2,
+                             budget: Optional[Dict[str, Any]] = None
+                             ) -> Dict[str, Any]:
+        """``budget`` options: ``STEPS_PER_SYNC`` (decode-loop dispatch
+        amortization), ``MULTI_ADAPTER`` (serve the best-N LM trials as
+        one stacked-adapter worker instead of N replicas)."""
+        job = self.meta.create_inference_job(user_id, train_job_id,
+                                             budget=budget)
         self.services.create_inference_services(job["id"],
                                                 max_workers=max_workers)
         return self.get_inference_job(job["id"])

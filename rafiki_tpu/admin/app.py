@@ -158,9 +158,11 @@ class AdminApp:
 
     def _create_inference_job(self, _m, body, user) -> Tuple[int, Any]:
         try:
+            budget = body.get("budget")
             return 200, self.admin.create_inference_job(
                 user["id"], body["train_job_id"],
-                max_workers=int(body.get("max_workers", 2)))
+                max_workers=int(body.get("max_workers", 2)),
+                budget=budget if isinstance(budget, dict) else None)
         except RuntimeError as e:
             return 409, {"error": str(e)}
 

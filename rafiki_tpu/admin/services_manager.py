@@ -375,6 +375,60 @@ class ServicesManager:
             ijob["train_job_id"], max_count=max_workers)
         if not best:
             raise RuntimeError("no completed trials to deploy")
+        # MULTI_ADAPTER budget flag: deploy the best-N LM trials as ONE
+        # worker serving N stacked LoRA adapters (adapter 0 = best
+        # trial, i = i-th best; requests route via sampling
+        # {"adapter_id": i}) instead of N full replicas — one base
+        # model's HBM, one device slot. Requires adapters_only trials;
+        # a mismatched base fails the worker boot loudly. Best trials
+        # can span MODELS (a train job tunes every registered template
+        # for its task), so extras are filtered to the primary trial's
+        # model — a foreign trial's dump can't stack onto its base.
+        budget = ijob.get("budget") or {}
+        multi_adapter = False
+        if bool(budget.get("MULTI_ADAPTER")) and len(best) > 1:
+            import logging
+
+            log = logging.getLogger(__name__)
+
+            def model_of(trial):
+                sub = self.meta.get_sub_train_job(
+                    trial["sub_train_job_id"])
+                return self.meta.get_model(sub["model_id"])
+
+            primary_model = model_of(best[0])
+            if primary_model["task"] != TaskType.LANGUAGE_MODELING:
+                log.warning(
+                    "MULTI_ADAPTER ignored: task %s is not a language-"
+                    "modeling job; deploying plain replicas",
+                    primary_model["task"])
+            else:
+                # stackable = same model AND same shape signature as
+                # the primary (shape-relevant knobs are advisor-
+                # searched, so same-model trials can still disagree on
+                # hidden_dim/rank/...; shipping those to one engine
+                # would be a guaranteed crash-looping worker boot)
+                sig0 = best[0].get("shape_signature")
+                same = [best[0]] + [
+                    t for t in best[1:]
+                    if model_of(t)["id"] == primary_model["id"]
+                    and t.get("shape_signature") == sig0]
+                if len(same) > 1:
+                    if len(same) < len(best):
+                        log.warning(
+                            "MULTI_ADAPTER: dropping %d best trial(s) "
+                            "with a different model or shape; stacking "
+                            "%d trials of model %s",
+                            len(best) - len(same), len(same),
+                            primary_model["id"])
+                    best = same
+                    multi_adapter = True
+                else:
+                    log.warning(
+                        "MULTI_ADAPTER ignored: no other best trial "
+                        "shares model %s and shape %r; deploying "
+                        "plain replicas", primary_model["id"], sig0)
+        n_services = 1 if multi_adapter else len(best)
 
         # A replica MUST own a device slot: quietly pinning it to host CPU
         # would serve at CPU speed — a perf cliff, never a default. Acquire
@@ -382,7 +436,7 @@ class ServicesManager:
         # stop_service) need that lock, so blocking on the allocator while
         # holding it could never be satisfied by a concurrent release.
         slots: List[SubMesh] = []
-        for i in range(len(best)):
+        for i in range(n_services):
             slot = self.allocator.acquire(timeout=self.slot_timeout)
             if slot is None:
                 for s in slots:
@@ -399,7 +453,8 @@ class ServicesManager:
         with self.op_lock:
             try:
                 return self._create_inference_services(
-                    inference_job_id, best, slots)
+                    inference_job_id, best, slots,
+                    multi_adapter=multi_adapter)
             except BaseException:
                 # slots not yet handed to a spawned service stay ours —
                 # give them back (spawned services release via _poll/stop)
@@ -417,7 +472,8 @@ class ServicesManager:
 
     def _create_inference_services(self, inference_job_id: str,
                                    best: List[Dict[str, Any]],
-                                   slots: List["SubMesh"]
+                                   slots: List["SubMesh"],
+                                   multi_adapter: bool = False
                                    ) -> List[ManagedService]:
         if not self.kv_port:
             self.start_data_plane()
@@ -426,7 +482,8 @@ class ServicesManager:
         budget = ijob.get("budget") or {}
         spawned: List[ManagedService] = []
         worker_ids: List[str] = []
-        for i, trial in enumerate(best):
+        services = [best[0]] if multi_adapter else best
+        for i, trial in enumerate(services):
             sub = self.meta.get_sub_train_job(trial["sub_train_job_id"])
             model = self.meta.get_model(sub["model_id"])
             model_file = self.workdir / f"model-{model['id']}.py"
@@ -437,17 +494,22 @@ class ServicesManager:
             # decode loop (slot-based KV admission) instead of the
             # classification micro-batcher
             decode_loop = model["task"] == TaskType.LANGUAGE_MODELING
+            cfg = {"model_file": str(model_file),
+                   "model_class": model["model_class"],
+                   "trial_id": trial["id"], "knobs": trial["knobs"],
+                   "param_store_uri": self.param_store_uri,
+                   "kv_host": self.kv_host, "kv_port": self.kv_port,
+                   "worker_id": wid, "decode_loop": decode_loop,
+                   # decode-loop dispatch amortization (ops guide): K
+                   # fused steps per device program, tunable per job
+                   "steps_per_sync": int(budget.get("STEPS_PER_SYNC",
+                                                    4))}
+            if multi_adapter:
+                # the other best trials ride as stacked adapters 1..N
+                cfg["extra_adapter_trials"] = [t["id"]
+                                               for t in best[1:]]
             svc = self._spawn(
-                "rafiki_tpu.worker.inference",
-                {"model_file": str(model_file),
-                 "model_class": model["model_class"],
-                 "trial_id": trial["id"], "knobs": trial["knobs"],
-                 "param_store_uri": self.param_store_uri,
-                 "kv_host": self.kv_host, "kv_port": self.kv_port,
-                 "worker_id": wid, "decode_loop": decode_loop,
-                 # decode-loop dispatch amortization (ops guide): K fused
-                 # steps per device program, operator-tunable per job
-                 "steps_per_sync": int(budget.get("STEPS_PER_SYNC", 4))},
+                "rafiki_tpu.worker.inference", cfg,
                 ServiceType.INFERENCE_WORKER, slot=slot,
                 inference_job_id=inference_job_id)
             spawned.append(svc)

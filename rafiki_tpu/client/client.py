@@ -126,10 +126,18 @@ class Client:
 
     # ---- inference jobs ----
     def create_inference_job(self, train_job_id: str,
-                             max_workers: int = 2) -> Dict[str, Any]:
-        return self._call("POST", "/inference_jobs",
-                          {"train_job_id": train_job_id,
-                           "max_workers": max_workers})
+                             max_workers: int = 2,
+                             budget: Optional[Dict[str, Any]] = None
+                             ) -> Dict[str, Any]:
+        """``budget={"MULTI_ADAPTER": 1}`` deploys the best-N LM trials
+        as ONE stacked-adapter worker (route requests with
+        ``sampling={"adapter_id": i}``, i = i-th best trial) instead of
+        N full replicas."""
+        body: Dict[str, Any] = {"train_job_id": train_job_id,
+                                "max_workers": max_workers}
+        if budget:
+            body["budget"] = budget
+        return self._call("POST", "/inference_jobs", body)
 
     def get_inference_job(self, job_id: str) -> Dict[str, Any]:
         return self._call("GET", f"/inference_jobs/{job_id}")

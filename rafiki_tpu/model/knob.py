@@ -284,6 +284,7 @@ class PolicyKnob(BaseKnob):
         "SKIP_TRAIN",          # evaluate loaded params only
         "QUICK_EVAL",          # subsample eval set
         "DOWNSCALE",           # reduced model for low rungs
+        "ADAPTERS_ONLY",       # strict-LoRA training (multi-adapter serving)
     )
 
     def __init__(self, policy: str, shape_relevant: bool = False) -> None:

@@ -730,8 +730,10 @@ class LlamaLoRA(BaseModel):
             # train ONLY the lora_a/lora_b leaves (norms/lm_head frozen
             # too): the contract multi-adapter serving needs — N trials
             # that differ ONLY in adapters can then share one engine
-            # (make_multi_adapter_engine / stack_lora_adapters)
-            "adapters_only": FixedKnob(False),
+            # (make_multi_adapter_engine / stack_lora_adapters). A
+            # policy, not a search dimension: defaults off, the
+            # operator enables it per job via knob_overrides
+            "adapters_only": PolicyKnob("ADAPTERS_ONLY"),
             # >1 shards the SEQUENCE dim of every train activation over
             # this many devices, attention via ulysses all-to-alls
             # (ops/ulysses.py) — the long-context train path. Composes

@@ -43,7 +43,8 @@ class InferenceWorker:
                  worker_id: str, max_batch_msgs: int = 16,
                  decode_loop: bool = False, max_slots: int = 8,
                  max_new_tokens: int = 8, steps_per_sync: int = 4,
-                 speculate_k: int = 0, system_prefix: str = "") -> None:
+                 speculate_k: int = 0, system_prefix: str = "",
+                 extra_adapter_trials: Optional[List[str]] = None) -> None:
         self.worker_id = worker_id
         self.hub = hub
         self.max_batch_msgs = max_batch_msgs
@@ -59,7 +60,50 @@ class InferenceWorker:
             raise KeyError(f"no parameters for trial {trial_id!r}")
         self.model.load_parameters(params)
         self.engine = None
-        if decode_loop:
+        if decode_loop and extra_adapter_trials:
+            if not hasattr(self.model, "make_multi_adapter_engine"):
+                # fail LOUDLY: falling back to a single-adapter engine
+                # would route every adapter_id to the primary trial —
+                # the wrong-tenant answer multi-adapter validation
+                # exists to prevent
+                raise RuntimeError(
+                    f"{model_class.__name__} does not support "
+                    "multi-adapter serving (no make_multi_adapter_"
+                    "engine); deploy plain replicas instead")
+            # multi-adapter deployment: this worker serves the PRIMARY
+            # trial as adapter 0 and each extra trial as adapter 1..N —
+            # one base model's HBM, one compiled step, requests routed
+            # by sampling={"adapter_id": i}. The trials must share
+            # every non-adapter leaf (adapters_only training); the
+            # stacking validation below fails the boot loudly otherwise
+            trees = [getattr(self.model, "_params")]
+            for tid in extra_adapter_trials:
+                dump = param_store.load(tid)
+                if dump is None:
+                    raise KeyError(
+                        f"no parameters for adapter trial {tid!r}")
+                peer = model_class(**knobs)
+                peer.load_parameters(dump)
+                trees.append(getattr(peer, "_params"))
+            try:
+                self.engine = self.model.make_multi_adapter_engine(
+                    trees, max_slots=max_slots,
+                    max_new_tokens=max_new_tokens,
+                    steps_per_sync=steps_per_sync,
+                    speculate_k=speculate_k)
+            except ValueError as e:
+                raise RuntimeError(
+                    "multi-adapter deployment requires trials that "
+                    "share one base (train them with adapters_only=True"
+                    " and identical shape-relevant knobs); deploy as "
+                    f"plain replicas instead: {e}") from e
+            if system_prefix:
+                # one snapshot at a time (engine limitation): the
+                # prefix KV is adapter-specific, so register it for the
+                # PRIMARY adapter — other adapters' requests stay
+                # correct, they just prefill the prefix themselves
+                self.engine.register_prefix(system_prefix, adapter_id=0)
+        elif decode_loop:
             if hasattr(self.model, "make_decode_engine"):
                 # optional kwargs only ride when set: user templates
                 # that predate them keep working at the defaults
@@ -403,7 +447,8 @@ def main(argv: Optional[list] = None) -> int:
         steps_per_sync=int(cfg.get("steps_per_sync", 4)),
         max_new_tokens=int(cfg.get("max_new_tokens", 8)),
         speculate_k=int(cfg.get("speculate_k", 0)),
-        system_prefix=str(cfg.get("system_prefix", "")))
+        system_prefix=str(cfg.get("system_prefix", "")),
+        extra_adapter_trials=list(cfg.get("extra_adapter_trials") or []))
     print(f"inference worker {worker.worker_id} serving", flush=True)
     worker.run()
     return 0

@@ -182,6 +182,61 @@ def test_prefix_cache_gated_by_adapter(trained):  # noqa: F811
 
 
 @pytest.mark.slow
+def test_worker_boots_multi_adapter_from_store(trained):  # noqa: F811
+    """The deployment path: a worker handed extra_adapter_trials loads
+    each trial's dump from the ParamStore and boots ONE stacked engine —
+    adapter 0 the primary trial, adapter 1 the extra — exactly what the
+    services manager's MULTI_ADAPTER budget flag spawns."""
+    from rafiki_tpu.serving.predictor import Predictor
+    from rafiki_tpu.serving.queues import InProcQueueHub
+    from rafiki_tpu.store.param_store import ParamStore
+    from rafiki_tpu.worker.inference import InferenceWorker
+
+    store = ParamStore.from_uri("mem://")
+    dump_a = trained.dump_parameters()
+    store.save("t-best", dump_a)
+    dump_b = dict(dump_a)
+    dump_b["params"] = jax.tree_util.tree_map(
+        np.asarray, _lora_variant(trained._params))
+    store.save("t-second", dump_b)
+
+    worker = InferenceWorker(LlamaLoRA, "t-best", KNOBS, store,
+                             InProcQueueHub(), "w0", decode_loop=True,
+                             max_slots=4, max_new_tokens=6,
+                             extra_adapter_trials=["t-second"])
+    assert worker.engine is not None
+    assert worker.engine.engine.n_adapters == 2
+    hub = worker.hub
+    wt = threading.Thread(target=worker.run, daemon=True)
+    wt.start()
+    try:
+        pred = Predictor(hub, ["w0"], gather_timeout=120.0)
+        out0, _ = pred.predict(["tok1 tok2 tok3"],
+                               sampling={"adapter_id": 0})
+        out1, _ = pred.predict(["tok1 tok2 tok3"],
+                               sampling={"adapter_id": 1})
+        assert out0 != out1
+    finally:
+        worker.stop()
+        wt.join(timeout=10)
+
+    # a mismatched base fails the boot LOUDLY, naming the remedy
+    def bump(kp, x):
+        path = "/".join(str(getattr(k, "key", k)) for k in kp).lower()
+        return x + 1e-3 if "final_norm" in path else x
+
+    dump_bad = dict(dump_a)
+    dump_bad["params"] = jax.tree_util.tree_map(
+        np.asarray,
+        jax.tree_util.tree_map_with_path(bump, trained._params))
+    store.save("t-bad", dump_bad)
+    with pytest.raises(RuntimeError, match="adapters_only"):
+        InferenceWorker(LlamaLoRA, "t-best", KNOBS, store,
+                        InProcQueueHub(), "w1", decode_loop=True,
+                        extra_adapter_trials=["t-bad"])
+
+
+@pytest.mark.slow
 def test_multi_adapter_through_serving_stack(trained):  # noqa: F811
     """adapter_id rides the sampling dict through Predictor → worker →
     engine: the same prompt served under adapter 0 vs 1 gives the two
