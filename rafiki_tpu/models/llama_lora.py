@@ -248,13 +248,30 @@ class _DecoderAttention(nn.Module):
             kk = jnp.repeat(k, rep, axis=2)
             vv = jnp.repeat(v, rep, axis=2)
             if self.seq_axis is not None:
-                from rafiki_tpu.ops.ulysses import ulysses_attention
+                qt = q.transpose(0, 2, 1, 3)
+                kt = kk.transpose(0, 2, 1, 3)
+                vt = vv.transpose(0, 2, 1, 3)
+                if self.n_heads % self.seq_mesh.shape[self.seq_axis]:
+                    # heads don't split over the axis: rotate K/V blocks
+                    # around the ring instead of swapping heads<->seq.
+                    # KNOWN HEADROOM: kk/vv are GQA-repeated above, so
+                    # each ring hop moves n_heads/n_kv_heads x the
+                    # necessary K/V bytes; rotating n_kv_heads and
+                    # repeating per resident block needs a GQA-aware
+                    # ring backward (the hand-written reverse ring
+                    # accumulates dK/dV per rotated head) — future work
+                    from rafiki_tpu.ops.ring_attention import \
+                        ring_attention
 
-                o = ulysses_attention(
-                    q.transpose(0, 2, 1, 3), kk.transpose(0, 2, 1, 3),
-                    vv.transpose(0, 2, 1, 3), self.seq_mesh,
-                    self.seq_axis, causal=True,
-                    batch_axis=DATA_AXIS)
+                    o = ring_attention(qt, kt, vt, self.seq_mesh,
+                                       self.seq_axis, causal=True,
+                                       batch_axis=DATA_AXIS)
+                else:
+                    from rafiki_tpu.ops.ulysses import ulysses_attention
+
+                    o = ulysses_attention(qt, kt, vt, self.seq_mesh,
+                                          self.seq_axis, causal=True,
+                                          batch_axis=DATA_AXIS)
             else:
                 o = flash_attention(q.transpose(0, 2, 1, 3),
                                     kk.transpose(0, 2, 1, 3),
@@ -735,11 +752,12 @@ class LlamaLoRA(BaseModel):
             # operator enables it per job via knob_overrides
             "adapters_only": PolicyKnob("ADAPTERS_ONLY"),
             # >1 shards the SEQUENCE dim of every train activation over
-            # this many devices, attention via ulysses all-to-alls
-            # (ops/ulysses.py) — the long-context train path. Composes
-            # with data parallelism ((data, sp) mesh); heads and
-            # max_len must divide by it; mutually exclusive with
-            # model_parallel/pipeline_stages>1 and loss_chunk.
+            # this many devices — the long-context train path:
+            # ulysses all-to-alls when n_heads divides it, ring K/V
+            # rotation otherwise (both exact). Composes with data
+            # parallelism ((data, sp) mesh); max_len must divide by
+            # it; mutually exclusive with model_parallel/
+            # pipeline_stages>1 and loss_chunk.
             "sequence_parallel": FixedKnob(1),
             # >1 pipelines the decoder blocks over this many devices
             # (GPipe microbatching, parallel/pipeline.py); depth must
@@ -877,8 +895,10 @@ class LlamaLoRA(BaseModel):
         if sp > 1:
             # sequence parallelism: (data, sp) mesh, every (B, L)
             # operand's L sharded over `sp`, attention via ulysses
-            # all-to-alls (module seq_mesh/seq_axis). Long-context
-            # regime — each device holds L/sp of every activation.
+            # all-to-alls — or ring K/V rotation when n_heads doesn't
+            # divide sp (module seq_mesh/seq_axis; dispatch in
+            # _DecoderAttention). Long-context regime — each device
+            # holds L/sp of every activation.
             from jax.sharding import Mesh
 
             if int(self.knobs.get("model_parallel", 1)) > 1 or \
@@ -899,11 +919,9 @@ class LlamaLoRA(BaseModel):
             if len(devices) % sp:
                 raise ValueError(f"sequence_parallel={sp} must divide "
                                  f"the trial's {len(devices)} devices")
-            if int(self.knobs["n_heads"]) % sp:
-                raise ValueError(f"n_heads {self.knobs['n_heads']} must "
-                                 f"divide by sequence_parallel={sp} "
-                                 "(ulysses splits heads; use ring "
-                                 "attention otherwise)")
+            # n_heads % sp == 0 -> ulysses (2 all-to-alls); otherwise
+            # the attention auto-falls-back to ring rotation (P
+            # ppermutes) — see _DecoderAttention. Both are exact.
             if int(self.knobs["max_len"]) % sp:
                 raise ValueError(f"max_len {self.knobs['max_len']} must "
                                  f"divide by sequence_parallel={sp}")

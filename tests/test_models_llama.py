@@ -500,14 +500,33 @@ def test_llama_serves_quantized(tmp_path):
     assert float(model.evaluate(tr)) > 0  # f32 eval path still works
 
 
+def _assert_sp_forward_matches_plain(model, mesh_shape, batch, seed):
+    """The sp forward IS the plain forward: same params, same logits
+    (shared parity protocol for the ulysses and ring dispatch paths)."""
+    from jax.sharding import Mesh
+
+    devs = list(jax.devices())
+    mesh = Mesh(np.array(devs, dtype=object).reshape(*mesh_shape),
+                ("data", "sp"))
+    sp_mod = model._module(seq_mesh=mesh, seq_axis="sp")
+    plain = model._module()
+    params = jax.tree_util.tree_map(np.asarray, model._params)
+    ids = np.random.RandomState(seed).randint(
+        1, 200, size=(batch, TINY["max_len"])).astype(np.int32)
+    lens = np.full((batch,), TINY["max_len"], np.int32)
+    ref = np.asarray(plain.apply({"params": params}, ids, lens=lens),
+                     np.float32)
+    got = np.asarray(sp_mod.apply({"params": params}, ids, lens=lens),
+                     np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
 def test_llama_trains_sequence_parallel(tmp_path):
     """sequence_parallel=4 over a (data=2, sp=4) mesh: every (B, L)
     train activation's sequence dim is sharded and attention runs via
     ulysses all-to-alls. Loss decreases, the frozen base stays frozen,
     the sp forward is numerically the plain forward, and the result
     serves through the unchanged decode path."""
-    from jax.sharding import Mesh
-
     tr = str(tmp_path / "t.jsonl")
     generate_text_classification_dataset(tr, 128, seed=0)
     knobs = {**TINY, "model_parallel": 1, "sequence_parallel": 4,
@@ -526,21 +545,7 @@ def test_llama_trains_sequence_parallel(tmp_path):
     assert float(np.abs(np.asarray(
         model._params["block_0"]["attn"]["wq"]["lora_b"])).sum()) > 0
 
-    # the sp forward IS the plain forward: same params, same logits
-    devs = list(jax.devices())
-    mesh = Mesh(np.array(devs, dtype=object).reshape(2, 4),
-                ("data", "sp"))
-    sp_mod = model._module(seq_mesh=mesh, seq_axis="sp")
-    plain = model._module()
-    params = jax.tree_util.tree_map(np.asarray, model._params)
-    ids = np.random.RandomState(0).randint(
-        1, 200, size=(4, TINY["max_len"])).astype(np.int32)
-    lens = np.full((4,), TINY["max_len"], np.int32)
-    ref = np.asarray(plain.apply({"params": params}, ids, lens=lens),
-                     np.float32)
-    got = np.asarray(sp_mod.apply({"params": params}, ids, lens=lens),
-                     np.float32)
-    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+    _assert_sp_forward_matches_plain(model, (2, 4), batch=4, seed=0)
 
     out = model.predict(["tok1 tok2 tok3"])
     assert isinstance(out[0], str) and out[0]
@@ -553,13 +558,30 @@ def test_llama_sequence_parallel_knob_validation(tmp_path):
     with pytest.raises(ValueError, match="mutually exclusive"):
         LlamaLoRA(**{**TINY, "sequence_parallel": 2,
                      "model_parallel": 2}).train(tr, ctx())
-    with pytest.raises(ValueError, match="n_heads"):
-        LlamaLoRA(**{**TINY, "model_parallel": 1, "n_heads": 4,
-                     "kv_ratio": 2,
-                     "sequence_parallel": 8}).train(tr, ctx())
+    with pytest.raises(ValueError, match="devices"):
+        LlamaLoRA(**{**TINY, "model_parallel": 1,
+                     "sequence_parallel": 3}).train(tr, ctx())
     with pytest.raises(ValueError, match="MoE"):
         LlamaLoRA(**{**TINY, "model_parallel": 1, "moe_experts": 2,
                      "sequence_parallel": 2}).train(tr, ctx())
     with pytest.raises(ValueError, match="loss_chunk"):
         LlamaLoRA(**{**TINY, "model_parallel": 1, "loss_chunk": 8,
                      "sequence_parallel": 2}).train(tr, ctx())
+
+
+def test_llama_sequence_parallel_ring_fallback(tmp_path):
+    """sp=8 with n_heads=4: heads don't split over the axis, so the
+    decoder's attention auto-falls-back from ulysses to ring K/V
+    rotation — training still works and the sp forward still equals
+    the plain forward."""
+    tr = str(tmp_path / "t.jsonl")
+    generate_text_classification_dataset(tr, 64, seed=0)
+    knobs = {**TINY, "model_parallel": 1, "sequence_parallel": 8,
+             "max_epochs": 2, "quick_train": True}
+    model = LlamaLoRA(**knobs)
+    ctx = TrainContext(devices=list(jax.devices()))
+    model.train(tr, ctx)
+    losses = ctx.logger.get_values("loss")
+    assert losses and np.isfinite(losses[-1])
+
+    _assert_sp_forward_matches_plain(model, (1, 8), batch=2, seed=1)
