@@ -521,6 +521,20 @@ def _assert_sp_forward_matches_plain(model, mesh_shape, batch, seed):
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
 
 
+def test_sp_forward_parity_untrained():
+    """Default-leg sp correctness without a train loop: on random-init
+    params, the sp forward equals the plain forward through BOTH
+    dispatch paths — ulysses ((2, 4) mesh, heads divide) and ring
+    ((1, 8) mesh, heads don't)."""
+    model = LlamaLoRA(**{**TINY, "model_parallel": 1})
+    model._params = model._module().init(
+        jax.random.PRNGKey(3),
+        jnp.zeros((1, TINY["max_len"]), jnp.int32))["params"]
+    _assert_sp_forward_matches_plain(model, (2, 4), batch=4, seed=0)
+    _assert_sp_forward_matches_plain(model, (1, 8), batch=2, seed=1)
+
+
+@pytest.mark.slow
 def test_llama_trains_sequence_parallel(tmp_path):
     """sequence_parallel=4 over a (data=2, sp=4) mesh: every (B, L)
     train activation's sequence dim is sharded and attention runs via
@@ -569,6 +583,7 @@ def test_llama_sequence_parallel_knob_validation(tmp_path):
                      "sequence_parallel": 2}).train(tr, ctx())
 
 
+@pytest.mark.slow
 def test_llama_sequence_parallel_ring_fallback(tmp_path):
     """sp=8 with n_heads=4: heads don't split over the axis, so the
     decoder's attention auto-falls-back from ulysses to ring K/V

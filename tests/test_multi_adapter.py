@@ -182,6 +182,38 @@ def test_prefix_cache_gated_by_adapter(trained):  # noqa: F811
 
 
 @pytest.mark.slow
+def test_multi_adapter_composes_with_int8(trained):  # noqa: F811
+    """quantize_int8 + multi-adapter: the shared base serves int8
+    (quantized ONCE for all tenants) while the stacked f32 adapters
+    still route per request — each adapter's generations equal its own
+    solo QUANTIZED oracle."""
+    from rafiki_tpu.models.llama_lora import quantize_llama_params
+
+    tree_a = trained._params
+    tree_b = _lora_variant(tree_a)
+    mq = LlamaLoRA(**{**KNOBS, "quantize_int8": True})
+    mq.load_parameters(trained.dump_parameters())
+    eng = mq.make_multi_adapter_engine([tree_a, tree_b], max_slots=2,
+                                       max_new_tokens=5)
+    assert eng.engine.module.quantized and eng.engine.module.n_adapters == 2
+
+    prompt = np.asarray([1, 5, 9], np.int32)
+    eng.engine.submit("a", prompt, 5, adapter_id=0)
+    eng.engine.submit("b", prompt, 5, adapter_id=1)
+    got = {}
+    for _ in range(200):
+        if not eng.busy:
+            break
+        eng.step()
+        for rid, ids in eng.engine.poll():
+            got[rid] = ids
+    module_q = trained._module(quantized=True)
+    for rid, tree in (("a", tree_a), ("b", tree_b)):
+        assert got[rid] == _oracle(module_q, quantize_llama_params(tree),
+                                   prompt, 5), rid
+
+
+@pytest.mark.slow
 def test_worker_boots_multi_adapter_from_store(trained):  # noqa: F811
     """The deployment path: a worker handed extra_adapter_trials loads
     each trial's dump from the ParamStore and boots ONE stacked engine —
