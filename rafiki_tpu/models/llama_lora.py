@@ -245,38 +245,39 @@ class _DecoderAttention(nn.Module):
                 o = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(x.dtype),
                                vv)
         else:
-            kk = jnp.repeat(k, rep, axis=2)
-            vv = jnp.repeat(v, rep, axis=2)
             if self.seq_axis is not None:
                 qt = q.transpose(0, 2, 1, 3)
-                kt = kk.transpose(0, 2, 1, 3)
-                vt = vv.transpose(0, 2, 1, 3)
                 if self.n_heads % self.seq_mesh.shape[self.seq_axis]:
                     # heads don't split over the axis: rotate K/V blocks
                     # around the ring instead of swapping heads<->seq.
-                    # KNOWN HEADROOM: kk/vv are GQA-repeated above, so
-                    # each ring hop moves n_heads/n_kv_heads x the
-                    # necessary K/V bytes; rotating n_kv_heads and
-                    # repeating per resident block needs a GQA-aware
-                    # ring backward (the hand-written reverse ring
-                    # accumulates dK/dV per rotated head) — future work
+                    # The ring is GQA-aware: pass the UN-repeated
+                    # n_kv_heads K/V so each hop moves only the real
+                    # bytes (repeat happens per resident block inside)
                     from rafiki_tpu.ops.ring_attention import \
                         ring_attention
 
-                    o = ring_attention(qt, kt, vt, self.seq_mesh,
-                                       self.seq_axis, causal=True,
+                    o = ring_attention(qt, k.transpose(0, 2, 1, 3),
+                                       v.transpose(0, 2, 1, 3),
+                                       self.seq_mesh, self.seq_axis,
+                                       causal=True,
                                        batch_axis=DATA_AXIS)
                 else:
                     from rafiki_tpu.ops.ulysses import ulysses_attention
 
-                    o = ulysses_attention(qt, kt, vt, self.seq_mesh,
-                                          self.seq_axis, causal=True,
-                                          batch_axis=DATA_AXIS)
+                    # ulysses splits q-heads over the axis, so K/V must
+                    # be repeated to q-head count before the swap
+                    o = ulysses_attention(
+                        qt, jnp.repeat(k, rep, axis=2).transpose(
+                            0, 2, 1, 3),
+                        jnp.repeat(v, rep, axis=2).transpose(0, 2, 1, 3),
+                        self.seq_mesh, self.seq_axis, causal=True,
+                        batch_axis=DATA_AXIS)
             else:
-                o = flash_attention(q.transpose(0, 2, 1, 3),
-                                    kk.transpose(0, 2, 1, 3),
-                                    vv.transpose(0, 2, 1, 3),
-                                    causal=True, kv_lens=lens)
+                o = flash_attention(
+                    q.transpose(0, 2, 1, 3),
+                    jnp.repeat(k, rep, axis=2).transpose(0, 2, 1, 3),
+                    jnp.repeat(v, rep, axis=2).transpose(0, 2, 1, 3),
+                    causal=True, kv_lens=lens)
             o = o.transpose(0, 2, 1, 3)
         o = o.reshape(b, s, self.n_heads * dh)
         return dense(d, name="wo")(o, adapter_ids)

@@ -68,11 +68,38 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     (``interpret=None`` → Pallas on TPU, XLA twin elsewhere).
     Returns the attention output with the same sharding as the inputs
     were placed to. Differentiable end-to-end via the reverse ring.
+
+    GQA-aware: ``k``/``v`` may carry ``kv_heads = heads / rep`` heads
+    (query group g attends kv head ``g // rep`` — the ``jnp.repeat``
+    convention). Only the SMALL ``kv_heads`` tensors rotate around the
+    ring (and their dK/dV accumulators on the reverse ring — ``rep``×
+    less neighbor-link traffic both ways); each resident block repeats
+    locally before its kernel, and the block backward's dK/dV group-
+    reduce back to ``kv_heads`` before accumulating. ``rep = 1``
+    degenerates to plain multi-head exactly.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     scale = (sm_scale if sm_scale is not None
              else 1.0 / math.sqrt(q.shape[-1]))
+    h, h_kv = q.shape[1], k.shape[1]
+    if h % h_kv:
+        raise ValueError(f"q heads {h} must be a multiple of kv heads "
+                         f"{h_kv}")
+    rep = h // h_kv
+
+    def expand(t):
+        # GQA: repeat a resident K/V block to q-head count — local
+        # compute-side work; the ring never carries the copies
+        return jnp.repeat(t, rep, axis=1) if rep > 1 else t
+
+    def reduce_groups(t):
+        # (b, h, l, d) block dK/dV → (b, h_kv, l, d): each kv head's
+        # grad sums over its rep query heads (the VJP of expand)
+        if rep == 1:
+            return t
+        bb, _, ll, dd = t.shape
+        return jnp.sum(t.reshape(bb, h_kv, rep, ll, dd), axis=2)
     n_ring = mesh.shape[axis]
     seq_spec = P(batch_axis, None, axis, None)
     lse_spec = P(batch_axis, None, axis)
@@ -87,7 +114,8 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         in_specs=(seq_spec, seq_spec, seq_spec),
         out_specs=(seq_spec, lse_spec))
     def _ring_fwd(ql, kl, vl):
-        # ql/kl/vl: the local (b, h, L/P, d) shards
+        # ql: (b, h, L/P, d); kl/vl: (b, h_kv, L/P, d) — only the
+        # small kv tensors ride the ring; blocks repeat locally
         idx = jax.lax.axis_index(axis)
 
         def skipped(ql):
@@ -120,12 +148,14 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         for s in range(n_ring):
             if not causal:
                 out_s, lse_s = flash_attention_lse(
-                    ql, kb, vb, scale, False, block_q, block_k, interpret)
+                    ql, expand(kb), expand(vb), scale, False, block_q,
+                    block_k, interpret)
             elif s == 0:
                 # resident block IS the diagonal: plain causal flash
                 # (q and k share their origin, no offset bookkeeping)
                 out_s, lse_s = flash_attention_lse(
-                    ql, kb, vb, scale, True, block_q, block_k, interpret)
+                    ql, expand(kb), expand(vb), scale, True, block_q,
+                    block_k, interpret)
             else:
                 # block originated on (idx - s) mod P: strictly past
                 # blocks are fully visible, strictly future ones are
@@ -134,8 +164,8 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     (idx - s) % n_ring > idx,
                     lambda kb, vb: skipped(ql),
                     lambda kb, vb: flash_attention_lse(
-                        ql, kb, vb, scale, False, block_q, block_k,
-                        interpret),
+                        ql, expand(kb), expand(vb), scale, False,
+                        block_q, block_k, interpret),
                     kb, vb)
             carry = combine(carry, out_s, lse_s)
             if s + 1 < n_ring:
@@ -157,6 +187,13 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     def _ring_bwd(ql, kl, vl, ol, lsel, gl):
         idx = jax.lax.axis_index(axis)
 
+        def grads(kb, vb, diag=False):
+            # diag=True: the resident block IS the causal diagonal
+            dq_s, dk_s, dv_s = flash_attention_block_bwd(
+                ql, expand(kb), expand(vb), ol, lsel, gl, scale,
+                diag, block_q, block_k, interpret)
+            return dq_s, reduce_groups(dk_s), reduce_groups(dv_s)
+
         def zero_grads(ql, kb):
             return (jnp.zeros(ql.shape, jnp.float32),
                     jnp.zeros(kb.shape, jnp.float32),
@@ -168,20 +205,14 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         dvb = jnp.zeros(vl.shape, jnp.float32)
         for s in range(n_ring):
             if not causal:
-                dq_s, dk_s, dv_s = flash_attention_block_bwd(
-                    ql, kb, vb, ol, lsel, gl, scale, False, block_q,
-                    block_k, interpret)
+                dq_s, dk_s, dv_s = grads(kb, vb)
             elif s == 0:
-                dq_s, dk_s, dv_s = flash_attention_block_bwd(
-                    ql, kb, vb, ol, lsel, gl, scale, True, block_q,
-                    block_k, interpret)
+                dq_s, dk_s, dv_s = grads(kb, vb, diag=True)
             else:
                 dq_s, dk_s, dv_s = jax.lax.cond(
                     (idx - s) % n_ring > idx,
                     lambda kb, vb: zero_grads(ql, kb),
-                    lambda kb, vb: flash_attention_block_bwd(
-                        ql, kb, vb, ol, lsel, gl, scale, False, block_q,
-                        block_k, interpret),
+                    lambda kb, vb: grads(kb, vb),
                     kb, vb)
             dq = dq + dq_s
             dkb = dkb + dk_s

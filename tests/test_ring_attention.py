@@ -194,3 +194,40 @@ def test_flash_attention_lse_matches_xla_twin():
         for a, b in zip(gk, gr):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=3e-5, rtol=3e-5)
+
+
+@pytest.mark.parametrize("causal", [
+    False, pytest.param(True, marks=pytest.mark.slow)])
+def test_ring_gqa_matches_dense(causal):
+    """GQA ring (kv_heads < heads): only the small K/V rotate; forward
+    AND grads must equal the dense oracle over jnp.repeat'ed K/V —
+    including dK/dV group-reduced back to the kv heads."""
+    s, h, h_kv = 32, 4, 2
+    rep = h // h_kv
+    q = _rand(1, h, s, 8, key=10)
+    k = _rand(1, h_kv, s, 8, key=11)
+    v = _rand(1, h_kv, s, 8, key=12)
+    mesh = _mesh(2)
+
+    def f(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh, "sp",
+                                      causal=causal) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(_attention_reference(
+            q, jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1),
+            1.0 / np.sqrt(8), causal) ** 2)
+
+    np.testing.assert_allclose(
+        np.asarray(ring_attention(q, k, v, mesh, "sp", causal=causal)),
+        np.asarray(_attention_reference(
+            q, jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1),
+            1.0 / np.sqrt(8), causal)), atol=2e-5, rtol=2e-5)
+    g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
+    with pytest.raises(ValueError, match="multiple"):
+        ring_attention(q, _rand(1, 3, s, 8, key=13), v, mesh, "sp")
