@@ -264,12 +264,12 @@ class _DecoderAttention(nn.Module):
                 else:
                     from rafiki_tpu.ops.ulysses import ulysses_attention
 
-                    # ulysses splits q-heads over the axis, so K/V must
-                    # be repeated to q-head count before the swap
+                    # GQA-aware: un-repeated K/V — ulysses all-to-alls
+                    # the small tensors when kv heads also divide the
+                    # axis, and repeats before the swap otherwise
                     o = ulysses_attention(
-                        qt, jnp.repeat(k, rep, axis=2).transpose(
-                            0, 2, 1, 3),
-                        jnp.repeat(v, rep, axis=2).transpose(0, 2, 1, 3),
+                        qt, k.transpose(0, 2, 1, 3),
+                        v.transpose(0, 2, 1, 3),
                         self.seq_mesh, self.seq_axis, causal=True,
                         batch_axis=DATA_AXIS)
             else:

@@ -36,3 +36,13 @@ def shard_map_kernels(f, mesh, in_specs, out_specs):
     except TypeError:  # older jax spells it check_rep
         return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
                              out_specs=out_specs, check_rep=False)
+
+
+def gqa_repeat_factor(n_heads: int, n_kv_heads: int) -> int:
+    """Validate the GQA head pairing (q head i ↔ kv head ``i // rep``,
+    the ``jnp.repeat`` convention shared by the sequence-parallel
+    attention ops) and return ``rep = n_heads / n_kv_heads``."""
+    if n_heads % n_kv_heads:
+        raise ValueError(f"q heads {n_heads} must be a multiple of kv "
+                         f"heads {n_kv_heads}")
+    return n_heads // n_kv_heads

@@ -82,11 +82,10 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     scale = (sm_scale if sm_scale is not None
              else 1.0 / math.sqrt(q.shape[-1]))
+    from rafiki_tpu.ops.common import gqa_repeat_factor
+
     h, h_kv = q.shape[1], k.shape[1]
-    if h % h_kv:
-        raise ValueError(f"q heads {h} must be a multiple of kv heads "
-                         f"{h_kv}")
-    rep = h // h_kv
+    rep = gqa_repeat_factor(h, h_kv)
 
     def expand(t):
         # GQA: repeat a resident K/V block to q-head count — local

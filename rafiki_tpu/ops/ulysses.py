@@ -41,18 +41,31 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     pure XLA elsewhere, via :func:`rafiki_tpu.ops.attention
     .flash_attention`), then the inverse all-to-all. Output sharding
     matches the inputs'. Differentiable end-to-end.
+
+    GQA-aware: ``k``/``v`` may carry ``kv_heads = heads / rep`` heads
+    (query group g attends kv head ``g // rep``, the ``jnp.repeat``
+    convention). When ``kv_heads`` also divides the axis, the SMALL
+    K/V ride the all-to-alls (``rep``× less collective volume) and
+    each device repeats its landed kv chunk locally — exact, because
+    contiguous head tiling sends q heads ``[p·h/P, (p+1)·h/P)`` and kv
+    heads ``[p·h_kv/P, (p+1)·h_kv/P)`` to the same device p, and
+    ``h/P = rep · h_kv/P`` makes the local repeat the right pairing.
+    Otherwise K/V repeat before the swap (plain behavior).
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from rafiki_tpu.ops.attention import flash_attention
-    from rafiki_tpu.ops.common import shard_map_kernels
+    from rafiki_tpu.ops.common import (gqa_repeat_factor,
+                                       shard_map_kernels)
 
     n_par = mesh.shape[axis]
-    h = q.shape[1]
+    h, h_kv = q.shape[1], k.shape[1]
+    rep = gqa_repeat_factor(h, h_kv)
     if h % n_par:
         raise ValueError(
             f"ulysses needs heads % mesh[{axis!r}] == 0; got {h} heads "
             f"over {n_par} devices (use ring_attention instead)")
+    small_swap = rep > 1 and h_kv % n_par == 0
     scale = (sm_scale if sm_scale is not None
              else 1.0 / math.sqrt(q.shape[-1]))
     seq_spec = P(batch_axis, None, axis, None)
@@ -67,7 +80,12 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             return jax.lax.all_to_all(t, axis, split_axis=1,
                                       concat_axis=2, tiled=True)
 
-        qh, kh, vh = swap(ql), swap(kl), swap(vl)
+        def kv(t):
+            if small_swap:  # all-to-all the small tensor, repeat after
+                return jnp.repeat(swap(t), rep, axis=1)
+            return swap(jnp.repeat(t, rep, axis=1) if rep > 1 else t)
+
+        qh, kh, vh = swap(ql), kv(kl), kv(vl)
         # full-sequence attention on this device's head group — the
         # ordinary kernel, so causal masks need no offset bookkeeping
         oh = flash_attention(qh, kh, vh, sm_scale=scale, causal=causal)

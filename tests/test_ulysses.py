@@ -69,3 +69,42 @@ def test_ulysses_refuses_indivisible_heads():
     q = _rand(1, 6, 32, 16)  # 6 heads over 8 devices
     with pytest.raises(ValueError, match="ring_attention instead"):
         ulysses_attention(q, q, q, mesh, "sp")
+
+
+@pytest.mark.parametrize("n_par,h_kv", [
+    (2, 4),   # small-swap path: kv heads divide the axis
+    (4, 2),   # repeat-before-swap path: kv heads don't divide (2 % 4)
+])
+def test_ulysses_gqa_matches_dense(n_par, h_kv):
+    """GQA ulysses: K/V carry h_kv < h heads. When h_kv divides the
+    axis the SMALL tensors ride the all-to-alls and devices repeat
+    their landed chunk locally; otherwise K/V repeat before the swap.
+    Both paths must equal the dense oracle over repeated K/V — fwd and
+    grads (incl. dK/dV group-reduced by autodiff)."""
+    s, h = 32, 8
+    rep = h // h_kv
+    q = _rand(1, h, s, 8, key=20)
+    k = _rand(1, h_kv, s, 8, key=21)
+    v = _rand(1, h_kv, s, 8, key=22)
+    mesh = _mesh(n_par)
+
+    def f(q, k, v):
+        return jnp.sum(ulysses_attention(q, k, v, mesh, "sp",
+                                         causal=True) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(_attention_reference(
+            q, jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1),
+            1.0 / np.sqrt(8), True) ** 2)
+
+    np.testing.assert_allclose(
+        np.asarray(ulysses_attention(q, k, v, mesh, "sp", causal=True)),
+        np.asarray(_attention_reference(
+            q, jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1),
+            1.0 / np.sqrt(8), True)), atol=2e-5, rtol=2e-5)
+    g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
