@@ -157,7 +157,10 @@ class DecodeEngine:
         #: precomputed 1-row KV cache, and its length. Requests whose
         #: prompt extends it skip its prefill — admission copies the
         #: snapshot rows into the slot's cache (bandwidth, not compute).
-        self._prefix: Optional[Dict[str, Any]] = None
+        #: one registered prefix PER ADAPTER (multi-tenant system
+        #: prompts — a prefix's KV is a function of the adapter that
+        #: computed it); single-adapter engines use key 0
+        self._prefixes: Dict[int, Dict[str, Any]] = {}
         self.stats: Dict[str, int] = {
             "steps": 0, "tokens_generated": 0, "requests_done": 0,
             "max_concurrent": 0, "prefill_calls": 0,
@@ -247,20 +250,22 @@ class DecodeEngine:
         rows into the slot's cache — a device copy at HBM bandwidth
         instead of ``len(prefix)`` of model forward compute. Exact by
         construction (the copied KV is the same math prefill would
-        produce); one prefix at a time (re-register to replace).
+        produce); one prefix PER ADAPTER (re-register to replace, empty
+        ids to clear).
         Returns the registered length (truncated to leave room for at
         least one prompt token + one generated token). Not safe to call
         concurrently with ``step`` (register before serving traffic, or
         between steps).
 
         ``adapter_id`` (multi-adapter engines): the prefix KV is a
-        function of the adapter that computed it, so hits are gated on
-        the requesting slot's adapter matching this one."""
+        function of the adapter that computed it, so each adapter keeps
+        its OWN registered prefix (multi-tenant system prompts) and
+        hits are gated on the requesting slot's adapter."""
+        aid = self._check_adapter_id(adapter_id)
         prefix = np.asarray(prefix_ids, np.int32).ravel()[:self.L - 2]
         if len(prefix) == 0:
-            self._prefix = None
+            self._prefixes.pop(aid, None)
             return 0
-        aid = self._check_adapter_id(adapter_id)
         cache1 = self.module.init(
             jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32),
             decode=True)["cache"]
@@ -280,8 +285,13 @@ class DecodeEngine:
                 lambda c, p: c.at[rws, :plen].set(
                     p[:, :plen].astype(c.dtype)), cache, pre)
 
-        self._prefix = {"ids": prefix, "cache": jax.block_until_ready(snap),
-                        "len": plen, "install": install, "aid": aid}
+        # store only the populated rows: the snapshot allocates at
+        # max_len but install() reads [:plen] — trimming cuts the
+        # per-adapter resident HBM by max_len/plen
+        snap = jax.tree_util.tree_map(lambda p: p[:, :plen], snap)
+        self._prefixes[aid] = {
+            "ids": prefix, "cache": jax.block_until_ready(snap),
+            "len": plen, "install": install, "aid": aid}
         return plen
 
     def _install_prefix(self, rows: List[int],
@@ -372,8 +382,11 @@ class DecodeEngine:
         count (at admission time)."""
         with self._lock:
             admitted = False
-            prefix_rows: List[int] = []
-            pre = self._prefix
+            # rows grouped by adapter id with the SNAPSHOT each matched
+            # (one install per distinct snapshot; register_prefix is
+            # documented as not concurrent with step, so within one
+            # admission an adapter maps to exactly one snapshot)
+            prefix_hits: Dict[int, Tuple[Dict[str, Any], List[int]]] = {}
             for i in range(self.B):
                 if self._slots[i] is None and self._queue:
                     slot = self._queue.pop(0)
@@ -383,14 +396,15 @@ class DecodeEngine:
                     self._prompt_buf[i, :] = 0
                     self._prompt_buf[i, :len(slot.prompt)] = slot.prompt
                     self._prompt_len[i] = len(slot.prompt)
+                    pre = self._prefixes.get(slot.adapter_id)
                     if (pre is not None and len(slot.prompt) > pre["len"]
-                            and slot.adapter_id == pre.get("aid", 0)
                             and np.array_equal(slot.prompt[:pre["len"]],
                                                pre["ids"])):
                         # shared-prefix hit: skip its prefill — the KV
                         # copy below makes positions 0..plen-1 as if
                         # prefilled, and the prompt walk resumes at plen
-                        prefix_rows.append(i)
+                        prefix_hits.setdefault(
+                            slot.adapter_id, (pre, []))[1].append(i)
                         self._pos[i] = pre["len"]
                         slot.n_consumed = pre["len"]
                         self._tok[i] = slot.prompt[pre["len"]]
@@ -410,11 +424,12 @@ class DecodeEngine:
                                                len(live))
         if not live:
             return 0
-        if prefix_rows:
-            # the snapshot admission matched against, NOT self._prefix:
-            # a concurrent register_prefix must not swap the tree under
-            # rows whose positions were advanced by pre["len"]
-            self._install_prefix(prefix_rows, pre)
+        for pre, rows in prefix_hits.values():
+            # the snapshot each row matched against, NOT a fresh
+            # self._prefixes lookup: a concurrent register_prefix must
+            # not swap the tree under rows whose positions were
+            # advanced by pre["len"]
+            self._install_prefix(rows, pre)
         if admitted and self._prefill_fn is not None:
             self._chunked_prefill()
         if admitted or self._prompt_dev is None:

@@ -98,11 +98,12 @@ class InferenceWorker:
                     " and identical shape-relevant knobs); deploy as "
                     f"plain replicas instead: {e}") from e
             if system_prefix:
-                # one snapshot at a time (engine limitation): the
-                # prefix KV is adapter-specific, so register it for the
-                # PRIMARY adapter — other adapters' requests stay
-                # correct, they just prefill the prefix themselves
-                self.engine.register_prefix(system_prefix, adapter_id=0)
+                # per-adapter snapshots: the prefix KV is a function of
+                # the adapter that computed it, so every tenant gets
+                # its own (same text, N different KV caches)
+                for aid in range(len(trees)):
+                    self.engine.register_prefix(system_prefix,
+                                                adapter_id=aid)
         elif decode_loop:
             if hasattr(self.model, "make_decode_engine"):
                 # optional kwargs only ride when set: user templates

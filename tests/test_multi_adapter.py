@@ -180,6 +180,33 @@ def test_prefix_cache_gated_by_adapter(trained):  # noqa: F811
     assert got["hit"] == _oracle(module0, tree_b, prompt, max_new)
     assert got["miss"] == _oracle(module0, tree_a, prompt, max_new)
 
+    # PER-ADAPTER registry: give adapter 0 its own prefix too — both
+    # tenants now hit, each against its own snapshot, both exact
+    assert eng.register_prefix(prefix, adapter_id=0) == len(prefix)
+    eng.submit("hit0", prompt, max_new, adapter_id=0)
+    eng.submit("hit1", prompt, max_new, adapter_id=1)
+    got2 = {}
+    for _ in range(300):
+        if not eng.busy:
+            break
+        eng.step()
+        for rid, ids in eng.poll():
+            got2[rid] = ids
+    assert eng.stats["prefix_hits"] == 3
+    assert got2["hit0"] == _oracle(module0, tree_a, prompt, max_new)
+    assert got2["hit1"] == _oracle(module0, tree_b, prompt, max_new)
+    # empty ids clear ONE adapter's prefix, not the other's
+    eng.register_prefix(np.zeros((0,), np.int32), adapter_id=1)
+    eng.submit("cleared", prompt, max_new, adapter_id=1)
+    eng.submit("kept", prompt, max_new, adapter_id=0)
+    for _ in range(300):
+        if not eng.busy:
+            break
+        eng.step()
+        eng.poll()
+    assert not eng.busy, "engine failed to drain"
+    assert eng.stats["prefix_hits"] == 4  # only the adapter-0 request
+
 
 @pytest.mark.slow
 def test_multi_adapter_composes_with_int8(trained):  # noqa: F811
