@@ -276,15 +276,7 @@ class DecodeEngine:
                     jnp.arange(len(prefix), dtype=jnp.int32)[None, :],
                     jnp.asarray([aid], jnp.int32))
         plen = len(prefix)
-
-        # jitted once per registration (compile cache keys on the rows
-        # count, bounded by max_slots); donate: in-place cache update
-        @functools.partial(jax.jit, donate_argnums=(0,))
-        def install(cache, pre, rws):
-            return jax.tree_util.tree_map(
-                lambda c, p: c.at[rws, :plen].set(
-                    p[:, :plen].astype(c.dtype)), cache, pre)
-
+        install = _make_prefix_install(plen)
         # store only the populated rows: the snapshot allocates at
         # max_len but install() reads [:plen] — trimming cuts the
         # per-adapter resident HBM by max_len/plen
@@ -708,6 +700,22 @@ def _make_verify(module: Any, n_slots: int, k: int) -> Callable:
         return muts["cache"], g, n_emit
 
     return verify_fn
+
+
+@functools.lru_cache(maxsize=32)
+def _make_prefix_install(plen: int) -> Callable:
+    """Scatter a trimmed prefix snapshot into slot rows. Cached by
+    prefix length so N same-text registrations (one per adapter in a
+    multi-tenant boot) share ONE compiled program — only the forward
+    prefill execution is genuinely per-adapter."""
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def install(cache, pre, rws):
+        return jax.tree_util.tree_map(
+            lambda c, p: c.at[rws, :plen].set(
+                p[:, :plen].astype(c.dtype)), cache, pre)
+
+    return install
 
 
 @functools.lru_cache(maxsize=8)
