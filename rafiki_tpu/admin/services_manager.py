@@ -518,7 +518,12 @@ class ServicesManager:
         predictor = self._spawn(
             "rafiki_tpu.serving.predictor",
             {"worker_ids": worker_ids, "kv_host": self.kv_host,
-             "kv_port": self.kv_port, "host": "127.0.0.1", "port": 0},
+             "kv_port": self.kv_port, "host": "127.0.0.1", "port": 0,
+             # the serving latency/accuracy controller (paper's
+             # batching/wait tradeoff): gather deadline tracks the
+             # fleet's observed reply latencies instead of always
+             # waiting full timeout for stragglers
+             "adaptive_gather": bool(budget.get("ADAPTIVE_GATHER"))},
             ServiceType.PREDICTOR, wait_port_file=True,
             inference_job_id=inference_job_id)
         spawned.append(predictor)

@@ -25,6 +25,17 @@ from .queues import (EXPIRY_SKEW_TOLERANCE_S, QueueHub, pack_message,
                      unpack_message)
 
 
+def nearest_rank(sorted_vals: Sequence[float], p: float) -> float:
+    """Nearest-rank quantile over pre-sorted values: ``ceil(p·n)-1``,
+    so p95 of 20 samples is the 19th-smallest, not the max. Shared by
+    the /health percentiles and the adaptive-gather controller (they
+    must agree). Empty input → 0.0."""
+    if not sorted_vals:
+        return 0.0
+    n = len(sorted_vals)
+    return sorted_vals[max(0, min(n - 1, math.ceil(p * n) - 1))]
+
+
 def ensemble_predictions(per_worker: List[List[Any]]) -> List[Any]:
     """Combine replicas' per-query predictions.
 
@@ -64,10 +75,34 @@ class Predictor:
     STREAM_TIMEOUT = 300.0
 
     def __init__(self, hub: QueueHub, worker_ids: Sequence[str],
-                 gather_timeout: float = 10.0) -> None:
+                 gather_timeout: float = 10.0,
+                 adaptive_gather: bool = False,
+                 target_answer_frac: float = 0.95,
+                 gather_margin: float = 1.5,
+                 min_gather_timeout: float = 0.05) -> None:
+        """``adaptive_gather`` enables the serving latency/accuracy
+        controller (the reference paper's batching/wait tradeoff,
+        SURVEY.md §3.3 note): instead of always waiting
+        ``gather_timeout`` for stragglers, the gather deadline tracks
+        the observed per-reply latency distribution — the
+        ``target_answer_frac`` quantile times ``gather_margin``,
+        clamped to [``min_gather_timeout``, ``gather_timeout``]. A
+        persistently slow replica stops taxing every request's p50
+        (its answers are dropped from the ensemble: slightly less
+        accuracy, much less latency), while a healthy fleet keeps full
+        ensembles because the quantile tracks its real speed. Explicit
+        per-request ``timeout`` always wins."""
         self.hub = hub
         self.worker_ids = list(worker_ids)
         self.gather_timeout = gather_timeout
+        self.adaptive_gather = bool(adaptive_gather)
+        self.target_answer_frac = min(1.0, max(0.0, target_answer_frac))
+        self.gather_margin = max(1.0, gather_margin)
+        self.min_gather_timeout = max(0.0, min_gather_timeout)
+        #: observed scatter→reply latencies per ANSWER (not request):
+        #: the controller's signal
+        self._reply_lat: "collections.deque[float]" = collections.deque(
+            maxlen=self.LATENCY_WINDOW)
         self._n_queries = 0
         self._n_requests = 0
         self._latency_sum = 0.0
@@ -75,6 +110,19 @@ class Predictor:
             maxlen=self.LATENCY_WINDOW)
         self._rr = 0  # round-robin cursor for single-worker streams
         self._lock = threading.Lock()
+
+    def _gather_deadline_s(self) -> float:
+        """The adaptive controller's current gather budget."""
+        if not self.adaptive_gather:
+            return self.gather_timeout
+        with self._lock:
+            lat = sorted(self._reply_lat)
+        if len(lat) < 2 * len(self.worker_ids):
+            return self.gather_timeout  # warmup: no signal yet
+        return max(self.min_gather_timeout,
+                   min(self.gather_timeout,
+                       nearest_rank(lat, self.target_answer_frac)
+                       * self.gather_margin))
 
     def predict(self, queries: Sequence[Any],
                 timeout: Optional[float] = None,
@@ -84,7 +132,8 @@ class Predictor:
         loop: {temperature, top_k, top_p, seed, eos_id} — seeded draws are
         reproducible per (seed, position) regardless of serving load."""
         t0 = time.monotonic()
-        timeout = self.gather_timeout if timeout is None else timeout
+        adaptive = timeout is None and self.adaptive_gather
+        timeout = self._gather_deadline_s() if timeout is None else timeout
         qid = uuid.uuid4().hex
         deadline = t0 + timeout
         # the wall-clock deadline rides with the query: a worker that
@@ -118,8 +167,15 @@ class Predictor:
                     break
                 reply = unpack_message(reply_bytes)
                 if reply.get("error"):
+                    # error replies are NOT controller answers: a
+                    # fast-failing replica must not drag the learned
+                    # budget down to its ~ms error latency (healthy-
+                    # but-slower replicas would get shed while requests
+                    # 504 on a 'fully answering' fleet)
                     errors.append(str(reply["error"]))
                     continue
+                with self._lock:  # controller signal: scatter→ANSWER
+                    self._reply_lat.append(time.monotonic() - t0)
                 per_worker.append(list(reply["predictions"]))
         finally:
             # drop the reply queue even on a gather error: late answers
@@ -134,6 +190,17 @@ class Predictor:
             self._n_requests += 1
             self._latency_sum += latency
             self._latencies.append(latency)
+            if adaptive and not per_worker:
+                # anti-death-spiral: a zero-ANSWER gather under the
+                # ADAPTIVE budget means the whole fleet got slower (or
+                # error-only) under the learned quantile — with no
+                # answers recorded the budget would freeze low and
+                # every request would 504 forever. Record a penalty
+                # sample (4x the failed budget, capped at the static
+                # timeout) so repeated misses push the quantile — and
+                # the budget — back up.
+                self._reply_lat.append(
+                    min(self.gather_timeout, max(timeout, 1e-3) * 4.0))
         info = {"workers_answered": len(per_worker),
                 "workers_asked": len(self.worker_ids),
                 "latency_s": latency, "errors": errors}
@@ -261,12 +328,7 @@ class Predictor:
             lat_sum = self._latency_sum
 
         def pct(p: float) -> float:
-            # nearest-rank: ceil(p*n)-1, so p95 of 20 samples is the
-            # 19th-smallest, not the max
-            if not lat:
-                return 0.0
-            return lat[max(0, min(len(lat) - 1,
-                                  math.ceil(p * len(lat)) - 1))]
+            return nearest_rank(lat, p)
 
         workers: Dict[str, Any] = {}
         for wid in self.worker_ids:
@@ -280,6 +342,10 @@ class Predictor:
                 "latency_sum_s": lat_sum, "latency_window_n": len(lat),
                 "latency_p50_s": pct(0.50), "latency_p95_s": pct(0.95),
                 "latency_p99_s": pct(0.99),
+                # the latency/accuracy controller's live budget (equals
+                # gather_timeout when adaptive gathering is off/warming)
+                "gather_deadline_s": self._gather_deadline_s(),
+                "adaptive_gather": self.adaptive_gather,
                 # per-worker published counters (drop accounting, decode-
                 # engine stats): a worker silently dropping expired
                 # queries shows up HERE, not as mystery timeouts
@@ -377,7 +443,9 @@ def main(argv: Optional[list] = None) -> int:
     hub = KVQueueHub(cfg["kv_host"], int(cfg["kv_port"]))
     predictor = Predictor(hub, cfg["worker_ids"],
                           gather_timeout=float(cfg.get("gather_timeout",
-                                                       30.0)))
+                                                       30.0)),
+                          adaptive_gather=bool(
+                              cfg.get("adaptive_gather")))
     svc = PredictorService(predictor, cfg.get("host", "127.0.0.1"),
                            int(cfg.get("port", 0)))
     host, port = svc.start()

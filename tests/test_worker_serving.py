@@ -404,3 +404,127 @@ def test_worker_stats_surface_in_predictor_health(trained, datasets):
             w.stop()
         for th in threads:
             th.join(timeout=5)
+
+
+def test_adaptive_gather_sheds_straggler():
+    """The latency/accuracy controller (paper's serving tradeoff): with
+    adaptive gathering, the gather deadline tracks observed reply
+    latencies, so a persistently slow replica stops taxing every
+    request — later requests answer with the fast replica only, far
+    under the static timeout."""
+    import threading
+    import time as _time
+
+    from rafiki_tpu.serving.predictor import Predictor
+    from rafiki_tpu.serving.queues import (InProcQueueHub, pack_message,
+                                           unpack_message)
+
+    hub = InProcQueueHub()
+    # target_answer_frac picks the accuracy/latency point: with half
+    # of all replies coming from the straggler, capturing >50% of
+    # replies would NECESSARILY wait for it — target 45% to trade that
+    # replica's votes away for its latency
+    pred = Predictor(hub, ["fast", "slow"], gather_timeout=5.0,
+                     adaptive_gather=True, target_answer_frac=0.45,
+                     gather_margin=1.5, min_gather_timeout=0.02)
+    stop = threading.Event()
+
+    def worker(wid, delay):
+        while not stop.is_set():
+            raw = hub.pop_query(wid, timeout=0.2)
+            if raw is None:
+                continue
+            msg = unpack_message(raw)
+            _time.sleep(delay)
+            hub.push_prediction(msg["id"], pack_message(
+                {"id": msg["id"], "worker_id": wid,
+                 "predictions": [[1.0]]}))
+
+    threads = [threading.Thread(target=worker, args=("fast", 0.005),
+                                daemon=True),
+               threading.Thread(target=worker, args=("slow", 0.4),
+                                daemon=True)]
+    for t in threads:
+        t.start()
+    try:
+        # warmup: seed the latency pool until the 45th percentile
+        # settles onto the fast replica's latencies (thread-startup
+        # noise in the first samples washes out as fast entries
+        # accumulate below the straggler's). The controller may start
+        # shedding MID-warmup — that's it working; the first requests
+        # must still see both replicas (static-timeout warmup phase)
+        answered = []
+        for _ in range(12):
+            _, info = pred.predict([[0.0]])
+            answered.append(info["workers_answered"])
+        assert answered[0] == 2, answered  # warmup phase waits for all
+        # the 45th-percentile reply latency is the fast worker's, so
+        # the budget collapses to ~fast*margin — far below the slow
+        # worker's 0.4s
+        budget = pred._gather_deadline_s()
+        assert budget < 0.3, budget
+        t0 = __import__("time").monotonic()
+        preds, info = pred.predict([[0.0]])
+        dt = __import__("time").monotonic() - t0
+        assert info["workers_answered"] == 1  # straggler shed
+        assert preds == [[1.0]]
+        assert dt < 0.38, dt  # didn't wait for the slow replica
+        # the controller is visible in /health (the window mutated
+        # since `budget` was read, so assert the regime, not equality)
+        s = pred.stats()
+        assert s["adaptive_gather"] and s["gather_deadline_s"] < 0.3
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+
+
+def test_adaptive_gather_recovers_from_fleet_slowdown():
+    """Anti-death-spiral: after the budget has collapsed onto a fast
+    fleet, the WHOLE fleet slowing past the budget yields zero-answer
+    gathers — penalty samples must push the budget back up until
+    answers flow again (instead of 504ing forever on a frozen low
+    budget)."""
+    import threading
+    import time as _time
+
+    from rafiki_tpu.serving.predictor import Predictor
+    from rafiki_tpu.serving.queues import (InProcQueueHub, pack_message,
+                                           unpack_message)
+
+    hub = InProcQueueHub()
+    pred = Predictor(hub, ["w0"], gather_timeout=2.0,
+                     adaptive_gather=True, target_answer_frac=0.9,
+                     gather_margin=1.2, min_gather_timeout=0.01)
+    delay = [0.005]
+    stop = threading.Event()
+
+    def worker():
+        while not stop.is_set():
+            raw = hub.pop_query("w0", timeout=0.2)
+            if raw is None:
+                continue
+            msg = unpack_message(raw)
+            _time.sleep(delay[0])
+            hub.push_prediction(msg["id"], pack_message(
+                {"id": msg["id"], "worker_id": "w0",
+                 "predictions": [[1.0]]}))
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        for _ in range(6):  # converge onto the fast latency
+            pred.predict([[0.0]])
+        assert pred._gather_deadline_s() < 0.2
+        delay[0] = 0.25  # fleet slows past the learned budget
+        answered = []
+        for _ in range(10):
+            _, info = pred.predict([[0.0]])
+            answered.append(info["workers_answered"])
+        # early requests miss, penalties raise the budget, answers
+        # return before the loop ends
+        assert answered[-1] == 1, answered
+        assert 0 in answered, answered  # the slowdown really bit first
+    finally:
+        stop.set()
+        t.join(timeout=5)
