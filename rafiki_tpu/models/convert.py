@@ -116,6 +116,37 @@ def _resolve_checkpoint(path: str) -> Dict[str, str]:
     return from_files([path])
 
 
+def read_hf_rope_config(path: str
+                        ) -> Tuple[Optional[float], Optional[Dict]]:
+    """``(rope_theta, rope_scaling)`` from the ``config.json`` next to
+    an HF checkpoint (file, index, or directory); (None, None) when
+    absent/unreadable. Llama-3 uses theta 500000 vs the Llama-1/2
+    default 10000, and Llama-3.1+ additionally applies ``rope_scaling``
+    — BOTH load cleanly and generate garbage when not honored, so
+    callers cross-check the knob and warn on scaling they can't
+    apply."""
+    import json
+    import os
+
+    d = path if os.path.isdir(path) else os.path.dirname(
+        os.path.abspath(path))
+    cfg = os.path.join(d, "config.json")
+    try:
+        with open(cfg) as f:
+            c = json.load(f)
+        theta = c.get("rope_theta")
+        scaling = c.get("rope_scaling")
+        return (float(theta) if theta is not None else None,
+                dict(scaling) if isinstance(scaling, dict) else None)
+    except (OSError, ValueError, TypeError, json.JSONDecodeError):
+        return None, None
+
+
+def read_hf_rope_theta(path: str) -> Optional[float]:
+    """Back-compat shim over :func:`read_hf_rope_config`."""
+    return read_hf_rope_config(path)[0]
+
+
 def import_llama_safetensors(path: str, params: Any, mesh=None,
                              tp_rules: Optional[Dict[str, int]] = None,
                              fsdp: bool = True,

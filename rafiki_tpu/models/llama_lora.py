@@ -180,6 +180,7 @@ class _DecoderAttention(nn.Module):
     #: loss terms are masked — valid positions' logits are untouched.
     seq_mesh: Any = None
     seq_axis: Optional[str] = None
+    rope_theta: float = 10000.0
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, lens: jnp.ndarray,
@@ -193,8 +194,10 @@ class _DecoderAttention(nn.Module):
         q = dense(self.n_heads * dh, name="wq")(x, adapter_ids)
         k = dense(self.n_kv_heads * dh, name="wk")(x, adapter_ids)
         v = dense(self.n_kv_heads * dh, name="wv")(x, adapter_ids)
-        q = rope(q.reshape(b, s, self.n_heads, dh), positions)
-        k = rope(k.reshape(b, s, self.n_kv_heads, dh), positions)
+        q = rope(q.reshape(b, s, self.n_heads, dh), positions,
+                 theta=self.rope_theta)
+        k = rope(k.reshape(b, s, self.n_kv_heads, dh), positions,
+                 theta=self.rope_theta)
         v = v.reshape(b, s, self.n_kv_heads, dh)
         rep = self.n_heads // self.n_kv_heads
 
@@ -295,6 +298,7 @@ class _DecoderBlock(nn.Module):
     n_adapters: int = 0  # >0 → per-row stacked adapters (serving)
     seq_mesh: Any = None  # sequence parallelism (see _DecoderAttention)
     seq_axis: Optional[str] = None
+    rope_theta: float = 10000.0
 
     @nn.compact
     def __call__(self, x, lens, positions, decode, adapter_ids=None):
@@ -302,6 +306,7 @@ class _DecoderBlock(nn.Module):
             self.n_heads, self.n_kv_heads, self.max_len, self.lora_rank,
             quantized=self.quantized, n_adapters=self.n_adapters,
             seq_mesh=self.seq_mesh, seq_axis=self.seq_axis,
+            rope_theta=self.rope_theta,
             name="attn")(RMSNorm()(x), lens, positions, decode,
                          adapter_ids)
         y = RMSNorm()(x)
@@ -364,6 +369,10 @@ class Llama(nn.Module):
     # config, like dtype/remat (Mesh is hashable).
     seq_mesh: Any = None
     seq_axis: Optional[str] = None
+    # RoPE base frequency: 10000 is the Llama-1/2 default; Llama-3
+    # checkpoints use 500000 — a mismatched theta loads cleanly but
+    # generates garbage, so the template threads the knob through
+    rope_theta: float = 10000.0
 
     @nn.compact
     def __call__(self, ids: jnp.ndarray, lens: Optional[jnp.ndarray] = None,
@@ -394,6 +403,7 @@ class Llama(nn.Module):
                           quantized=self.quantized,
                           n_adapters=self.n_adapters,
                           seq_mesh=self.seq_mesh, seq_axis=self.seq_axis,
+                          rope_theta=self.rope_theta,
                           name=f"block_{i}")(x, lens, positions, decode,
                                              adapter_ids)
         x = RMSNorm(name="final_norm")(x)
@@ -791,6 +801,10 @@ class LlamaLoRA(BaseModel):
             # only — training and evaluate() (the tuning objective)
             # stay full precision.
             "quantize_int8": FixedKnob(False),
+            # RoPE base frequency; match the pretrained checkpoint
+            # (Llama-1/2: 10000, Llama-3: 500000). A wrong theta loads
+            # cleanly but generates garbage.
+            "rope_theta": FixedKnob(10000.0),
             # serving-quality runs: a trained byte-BPE artifact
             # (data/bpe.py) replaces the hash tokenizer, and an
             # HF-convention safetensors checkpoint (models/convert.py)
@@ -834,7 +848,9 @@ class LlamaLoRA(BaseModel):
                      n_experts=int(k.get("moe_experts", 0)),
                      moe_top_k=int(k.get("moe_top_k", 1) or 1),
                      quantized=quantized, n_adapters=n_adapters,
-                     seq_mesh=seq_mesh, seq_axis=seq_axis)
+                     seq_mesh=seq_mesh, seq_axis=seq_axis,
+                     rope_theta=float(k.get("rope_theta", 10000.0)
+                                      or 10000.0))
 
     def _serving_module_params(self) -> Tuple[Llama, Any]:
         """(module, params) for predict()/make_decode_engine — the int8
@@ -1032,8 +1048,31 @@ class LlamaLoRA(BaseModel):
             # LoRA adapters keep their init) — config #5's real base.
             # A warm start / re-train already carries trained state and
             # must not be clobbered back to the checkpoint.
-            from rafiki_tpu.models.convert import import_llama_safetensors
+            from rafiki_tpu.models.convert import (import_llama_safetensors,
+                                                   read_hf_rope_config)
 
+            cfg_theta, cfg_scaling = read_hf_rope_config(pretrained)
+            # the theta the model ACTUALLY uses (single source of
+            # truth: _module's resolution), not a re-derivation
+            knob_theta = module.rope_theta
+            if cfg_theta is not None and \
+                    abs(cfg_theta - knob_theta) > 1e-6:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "checkpoint config.json says rope_theta=%s but the "
+                    "rope_theta knob is %s — a mismatched theta loads "
+                    "cleanly and generates GARBAGE; set the knob to "
+                    "match the checkpoint", cfg_theta, knob_theta)
+            if cfg_scaling:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "checkpoint config.json declares rope_scaling=%r, "
+                    "which this model does not apply — long-context "
+                    "generations will silently degrade (Llama-3.1+ "
+                    "checkpoints need RoPE scaling support)",
+                    cfg_scaling)
             params = import_llama_safetensors(
                 pretrained, params, mesh=mesh,
                 tp_rules=None if sp > 1 else TP_RULES,

@@ -223,3 +223,34 @@ def test_llama_template_trains_with_bpe_and_pretrained(tmp_path):
     out = fresh.predict(["the quick"])
     assert isinstance(out[0], str)
     assert "<" not in out[0]  # no unknown-id placeholders — exact decode
+
+
+def test_read_hf_rope_theta(tmp_path):
+    import json
+
+    from rafiki_tpu.models.convert import read_hf_rope_theta
+
+    # absent config → None (no crash)
+    assert read_hf_rope_theta(str(tmp_path)) is None
+    (tmp_path / "config.json").write_text(
+        json.dumps({"rope_theta": 500000.0}))
+    assert read_hf_rope_theta(str(tmp_path)) == 500000.0
+    # a checkpoint FILE resolves its sibling config
+    (tmp_path / "model.safetensors").write_bytes(b"")
+    assert read_hf_rope_theta(
+        str(tmp_path / "model.safetensors")) == 500000.0
+    (tmp_path / "config.json").write_text("{not json")
+    assert read_hf_rope_theta(str(tmp_path)) is None
+
+
+def test_read_hf_rope_config_scaling(tmp_path):
+    import json
+
+    from rafiki_tpu.models.convert import read_hf_rope_config
+
+    (tmp_path / "config.json").write_text(json.dumps(
+        {"rope_theta": 500000.0,
+         "rope_scaling": {"rope_type": "llama3", "factor": 8}}))
+    theta, scaling = read_hf_rope_config(str(tmp_path))
+    assert theta == 500000.0
+    assert scaling == {"rope_type": "llama3", "factor": 8}

@@ -600,3 +600,28 @@ def test_llama_sequence_parallel_ring_fallback(tmp_path):
     assert losses and np.isfinite(losses[-1])
 
     _assert_sp_forward_matches_plain(model, (1, 8), batch=2, seed=1)
+
+
+def test_rope_theta_knob_changes_positions_not_params():
+    """rope_theta must alter long-range position handling (different
+    logits at distant positions) without touching the param tree —
+    Llama-3 checkpoints (theta=500000) load into the same structure as
+    Llama-2 (10000), and a mismatched theta is a silent quality bug
+    the knob exists to prevent."""
+    m1 = Llama(vocab_size=128, max_len=32, hidden_dim=32, depth=1,
+               n_heads=4, n_kv_heads=2, mlp_dim=64, lora_rank=2)
+    m3 = Llama(vocab_size=128, max_len=32, hidden_dim=32, depth=1,
+               n_heads=4, n_kv_heads=2, mlp_dim=64, lora_rank=2,
+               rope_theta=500000.0)
+    ids = np.arange(1, 25, dtype=np.int32)[None, :]
+    params = m1.init(jax.random.PRNGKey(0), ids)["params"]
+    # identical tree: theta is positional math, not a parameter
+    jax.tree_util.tree_all(jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(a, b), params,
+        m3.init(jax.random.PRNGKey(0), ids)["params"]))
+    o1 = np.asarray(m1.apply({"params": params}, ids), np.float32)
+    o3 = np.asarray(m3.apply({"params": params}, ids), np.float32)
+    assert not np.allclose(o1, o3), "theta had no effect"
+    # the template threads the knob through
+    model = LlamaLoRA(**{**TINY, "rope_theta": 500000.0})
+    assert model._module().rope_theta == 500000.0
