@@ -65,10 +65,33 @@ TP_RULES = {"experts": 0,
 
 
 def rope(x: jnp.ndarray, positions: jnp.ndarray,
-         theta: float = 10000.0) -> jnp.ndarray:
-    """Rotary embedding over (b, s, heads, head_dim) with (b, s) positions."""
+         theta: float = 10000.0,
+         scaling: Optional[Tuple[float, float, float, float]] = None
+         ) -> jnp.ndarray:
+    """Rotary embedding over (b, s, heads, head_dim) with (b, s)
+    positions.
+
+    ``scaling`` applies Llama-3.1-style frequency-dependent NTK
+    scaling: ``(factor, low_freq_factor, high_freq_factor,
+    original_max_position_embeddings)``. High-frequency components
+    (wavelength ≪ the original context) keep their frequency, very
+    low-frequency ones divide by ``factor``, and the band between
+    interpolates smoothly — the published recipe for stretching a
+    pretrained context window without retraining the short-range
+    geometry."""
     half = x.shape[-1] // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    if scaling is not None:
+        factor, low_f, high_f, orig_len = scaling
+        # ratio = original_context / wavelength (wavelength = 2π/freq)
+        ratio = orig_len * freqs / (2.0 * np.pi)
+        smooth = jnp.clip((ratio - low_f) / max(high_f - low_f, 1e-9),
+                          0.0, 1.0)
+        scaled = freqs / factor
+        freqs = jnp.where(
+            ratio < low_f, scaled,
+            jnp.where(ratio > high_f, freqs,
+                      (1.0 - smooth) * scaled + smooth * freqs))
     angles = positions[..., None].astype(jnp.float32) * freqs  # (b, s, half)
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
@@ -76,6 +99,33 @@ def rope(x: jnp.ndarray, positions: jnp.ndarray,
     return jnp.concatenate(
         [x1 * cos - x2 * sin, x1 * sin + x2 * cos],
         axis=-1).astype(x.dtype)
+
+
+def _parse_rope_scaling(value: Any
+                        ) -> Optional[Tuple[float, float, float, float]]:
+    """Knob value (JSON object string, dict, or "") → the static
+    scaling tuple :func:`rope` consumes. HF config key names are
+    accepted directly, with the published Llama-3.1 defaults for the
+    optional band parameters."""
+    if not value:
+        return None
+    if isinstance(value, str):
+        import json as _json
+
+        value = _json.loads(value)
+    c = dict(value)
+    kind = str(c.get("rope_type", c.get("type", "llama3"))).lower()
+    if kind not in ("llama3", "default"):
+        # linear/dynamic/yarn use DIFFERENT position geometry;
+        # applying the llama3 NTK-by-parts formula to them would be
+        # silently wrong — refuse loudly instead
+        raise ValueError(
+            f"unsupported rope_scaling type {kind!r} (only 'llama3' "
+            "frequency-dependent scaling is implemented)")
+    return (float(c["factor"]),
+            float(c.get("low_freq_factor", 1.0)),
+            float(c.get("high_freq_factor", 4.0)),
+            float(c.get("original_max_position_embeddings", 8192)))
 
 
 class RMSNorm(nn.Module):
@@ -181,6 +231,7 @@ class _DecoderAttention(nn.Module):
     seq_mesh: Any = None
     seq_axis: Optional[str] = None
     rope_theta: float = 10000.0
+    rope_scaling: Optional[Tuple[float, float, float, float]] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, lens: jnp.ndarray,
@@ -195,9 +246,9 @@ class _DecoderAttention(nn.Module):
         k = dense(self.n_kv_heads * dh, name="wk")(x, adapter_ids)
         v = dense(self.n_kv_heads * dh, name="wv")(x, adapter_ids)
         q = rope(q.reshape(b, s, self.n_heads, dh), positions,
-                 theta=self.rope_theta)
+                 theta=self.rope_theta, scaling=self.rope_scaling)
         k = rope(k.reshape(b, s, self.n_kv_heads, dh), positions,
-                 theta=self.rope_theta)
+                 theta=self.rope_theta, scaling=self.rope_scaling)
         v = v.reshape(b, s, self.n_kv_heads, dh)
         rep = self.n_heads // self.n_kv_heads
 
@@ -299,6 +350,7 @@ class _DecoderBlock(nn.Module):
     seq_mesh: Any = None  # sequence parallelism (see _DecoderAttention)
     seq_axis: Optional[str] = None
     rope_theta: float = 10000.0
+    rope_scaling: Optional[Tuple[float, float, float, float]] = None
 
     @nn.compact
     def __call__(self, x, lens, positions, decode, adapter_ids=None):
@@ -306,7 +358,7 @@ class _DecoderBlock(nn.Module):
             self.n_heads, self.n_kv_heads, self.max_len, self.lora_rank,
             quantized=self.quantized, n_adapters=self.n_adapters,
             seq_mesh=self.seq_mesh, seq_axis=self.seq_axis,
-            rope_theta=self.rope_theta,
+            rope_theta=self.rope_theta, rope_scaling=self.rope_scaling,
             name="attn")(RMSNorm()(x), lens, positions, decode,
                          adapter_ids)
         y = RMSNorm()(x)
@@ -373,6 +425,11 @@ class Llama(nn.Module):
     # checkpoints use 500000 — a mismatched theta loads cleanly but
     # generates garbage, so the template threads the knob through
     rope_theta: float = 10000.0
+    # Llama-3.1-style frequency-dependent context scaling as a STATIC
+    # tuple (factor, low_freq_factor, high_freq_factor,
+    # original_max_position_embeddings); None = unscaled (hashable —
+    # dicts can't be flax module fields)
+    rope_scaling: Optional[Tuple[float, float, float, float]] = None
 
     @nn.compact
     def __call__(self, ids: jnp.ndarray, lens: Optional[jnp.ndarray] = None,
@@ -404,6 +461,7 @@ class Llama(nn.Module):
                           n_adapters=self.n_adapters,
                           seq_mesh=self.seq_mesh, seq_axis=self.seq_axis,
                           rope_theta=self.rope_theta,
+                          rope_scaling=self.rope_scaling,
                           name=f"block_{i}")(x, lens, positions, decode,
                                              adapter_ids)
         x = RMSNorm(name="final_norm")(x)
@@ -805,6 +863,12 @@ class LlamaLoRA(BaseModel):
             # (Llama-1/2: 10000, Llama-3: 500000). A wrong theta loads
             # cleanly but generates garbage.
             "rope_theta": FixedKnob(10000.0),
+            # Llama-3.1-style frequency-dependent context scaling: a
+            # JSON object string (or dict at construction) with
+            # factor / low_freq_factor / high_freq_factor /
+            # original_max_position_embeddings; "" = unscaled. Match
+            # the checkpoint's config.json rope_scaling.
+            "rope_scaling": FixedKnob(""),
             # serving-quality runs: a trained byte-BPE artifact
             # (data/bpe.py) replaces the hash tokenizer, and an
             # HF-convention safetensors checkpoint (models/convert.py)
@@ -850,7 +914,9 @@ class LlamaLoRA(BaseModel):
                      quantized=quantized, n_adapters=n_adapters,
                      seq_mesh=seq_mesh, seq_axis=seq_axis,
                      rope_theta=float(k.get("rope_theta", 10000.0)
-                                      or 10000.0))
+                                      or 10000.0),
+                     rope_scaling=_parse_rope_scaling(
+                         k.get("rope_scaling", "")))
 
     def _serving_module_params(self) -> Tuple[Llama, Any]:
         """(module, params) for predict()/make_decode_engine — the int8
@@ -1064,15 +1130,29 @@ class LlamaLoRA(BaseModel):
                     "rope_theta knob is %s — a mismatched theta loads "
                     "cleanly and generates GARBAGE; set the knob to "
                     "match the checkpoint", cfg_theta, knob_theta)
-            if cfg_scaling:
-                import logging
+            have = module.rope_scaling
+            if cfg_scaling or have is not None:
+                # symmetric check: scaling declared but not applied,
+                # applied but not declared, or mismatched — all three
+                # are the same silent-degradation class
+                want = None
+                if cfg_scaling:
+                    try:
+                        want = _parse_rope_scaling(cfg_scaling)
+                    except (KeyError, ValueError, TypeError):
+                        pass
+                if (have is None) != (want is None) or (
+                        have is not None and want is not None and any(
+                            abs(a - b) > 1e-6
+                            for a, b in zip(have, want))):
+                    import logging
 
-                logging.getLogger(__name__).warning(
-                    "checkpoint config.json declares rope_scaling=%r, "
-                    "which this model does not apply — long-context "
-                    "generations will silently degrade (Llama-3.1+ "
-                    "checkpoints need RoPE scaling support)",
-                    cfg_scaling)
+                    logging.getLogger(__name__).warning(
+                        "checkpoint config.json rope_scaling=%r but "
+                        "the rope_scaling knob resolves to %r — set "
+                        "the knob to the checkpoint's values (or clear "
+                        "it) or long-context generations silently "
+                        "degrade", cfg_scaling, have)
             params = import_llama_safetensors(
                 pretrained, params, mesh=mesh,
                 tp_rules=None if sp > 1 else TP_RULES,

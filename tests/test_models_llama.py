@@ -625,3 +625,62 @@ def test_rope_theta_knob_changes_positions_not_params():
     # the template threads the knob through
     model = LlamaLoRA(**{**TINY, "rope_theta": 500000.0})
     assert model._module().rope_theta == 500000.0
+
+
+def test_rope_scaling_llama31_formula():
+    """rope() with Llama-3.1 scaling matches the published recipe:
+    high-frequency components unchanged, very low frequencies divided
+    by factor, smooth interpolation between — verified against a
+    direct numpy implementation, plus knob-string parsing."""
+    from rafiki_tpu.models.llama_lora import _parse_rope_scaling, rope
+
+    scaling = (8.0, 1.0, 4.0, 8192.0)
+    theta = 500000.0
+    d = 64
+    x = np.random.RandomState(0).randn(1, 4, 2, d).astype(np.float32)
+    pos = np.asarray([[0, 1000, 4000, 7000]], np.int32)
+
+    got = np.asarray(rope(jnp.asarray(x), jnp.asarray(pos),
+                          theta=theta, scaling=scaling))
+
+    # direct reference implementation of the published recipe
+    half = d // 2
+    freqs = theta ** (-np.arange(0, half, dtype=np.float64) / half)
+    factor, lo, hi, orig = scaling
+    wavelen = 2 * np.pi / freqs
+    ratio = orig / wavelen
+    smooth = np.clip((ratio - lo) / (hi - lo), 0.0, 1.0)
+    new = np.where(ratio < lo, freqs / factor,
+                   np.where(ratio > hi, freqs,
+                            (1 - smooth) * freqs / factor
+                            + smooth * freqs))
+    ang = pos[..., None].astype(np.float64) * new
+    cos, sin = np.cos(ang)[:, :, None, :], np.sin(ang)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    ref = np.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                         axis=-1)
+    # rope computes angles in f32, the reference in f64: at position
+    # 7000 the rounding shows up at ~3e-4 after the trig
+    np.testing.assert_allclose(got, ref, atol=1e-3, rtol=1e-3)
+    # the highest-frequency component is untouched; the lowest scaled
+    assert new[0] == freqs[0] and abs(new[-1] - freqs[-1] / 8) < 1e-12
+
+    # knob plumbing: JSON string and dict both parse; template threads
+    assert _parse_rope_scaling(
+        '{"factor": 8, "original_max_position_embeddings": 8192}'
+    ) == (8.0, 1.0, 4.0, 8192.0)
+    model = LlamaLoRA(**{**TINY, "rope_theta": 500000.0,
+                         "rope_scaling": '{"factor": 8}'})
+    assert model._module().rope_scaling == (8.0, 1.0, 4.0, 8192.0)
+
+
+def test_rope_scaling_rejects_unsupported_types():
+    from rafiki_tpu.models.llama_lora import _parse_rope_scaling
+
+    with pytest.raises(ValueError, match="unsupported"):
+        _parse_rope_scaling('{"type": "linear", "factor": 4}')
+    with pytest.raises(ValueError, match="unsupported"):
+        _parse_rope_scaling({"rope_type": "yarn", "factor": 8})
+    # llama3 / default pass
+    assert _parse_rope_scaling(
+        {"rope_type": "llama3", "factor": 8}) is not None
