@@ -115,13 +115,18 @@ def _parse_rope_scaling(value: Any
         value = _json.loads(value)
     c = dict(value)
     kind = str(c.get("rope_type", c.get("type", "llama3"))).lower()
-    if kind not in ("llama3", "default"):
+    if kind == "default":
+        return None  # HF semantics: explicit 'default' = unscaled
+    if kind != "llama3":
         # linear/dynamic/yarn use DIFFERENT position geometry;
         # applying the llama3 NTK-by-parts formula to them would be
         # silently wrong — refuse loudly instead
         raise ValueError(
             f"unsupported rope_scaling type {kind!r} (only 'llama3' "
             "frequency-dependent scaling is implemented)")
+    if "factor" not in c:
+        raise ValueError("rope_scaling requires a 'factor' key "
+                         f"(got {sorted(c)})")
     return (float(c["factor"]),
             float(c.get("low_freq_factor", 1.0)),
             float(c.get("high_freq_factor", 4.0)),
@@ -1133,15 +1138,17 @@ class LlamaLoRA(BaseModel):
             have = module.rope_scaling
             if cfg_scaling or have is not None:
                 # symmetric check: scaling declared but not applied,
-                # applied but not declared, or mismatched — all three
-                # are the same silent-degradation class
+                # applied but not declared, mismatched, or of a TYPE
+                # this model can't honor (yarn/linear/...) — all the
+                # same silent-degradation class
                 want = None
+                unsupported = False
                 if cfg_scaling:
                     try:
                         want = _parse_rope_scaling(cfg_scaling)
-                    except (KeyError, ValueError, TypeError):
-                        pass
-                if (have is None) != (want is None) or (
+                    except (ValueError, TypeError):
+                        unsupported = True
+                if unsupported or (have is None) != (want is None) or (
                         have is not None and want is not None and any(
                             abs(a - b) > 1e-6
                             for a, b in zip(have, want))):

@@ -1,0 +1,55 @@
+"""Orbax interop for parameter trees.
+
+SURVEY.md §5.4 names "Orbax checkpoints as the blob format" for the
+rebuild's checkpoint story. The repo's native formats are the msgpack
+blob (``param_store.py`` — small trees, any backend) and the
+per-shard multi-host format (``sharded_ckpt.py`` — scale); this module
+bridges to the ECOSYSTEM format so rafiki-tpu checkpoints interoperate
+with the rest of the JAX world: export any trained tree as a standard
+Orbax checkpoint (loadable by plain ``orbax.checkpoint`` anywhere),
+and import Orbax checkpoints produced elsewhere — directly into
+shardings when a mesh template is given (Orbax restores each leaf
+against the template's sharding, so no host materializes a full tree
+it can't hold).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+
+def save_orbax(path: str, tree: Any) -> str:
+    """Write ``tree`` as a standard Orbax checkpoint directory at
+    ``path`` (created; must not already contain one). Returns the
+    absolute path. The result is plain Orbax — any JAX project can
+    ``StandardCheckpointer().restore(path)`` it."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, tree)
+    return path
+
+
+def load_orbax(path: str, template: Optional[Any] = None) -> Any:
+    """Restore an Orbax checkpoint.
+
+    ``template`` (optional): a pytree of arrays OR ShapeDtypeStructs
+    with shardings — when given, each leaf restores against it (shape/
+    dtype checked; sharded leaves land directly in their placements,
+    the multi-host-friendly path). Without one, the checkpoint's own
+    metadata drives the restore onto host/default devices."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        if template is None:
+            return ckptr.restore(path)
+        abstract = jax.tree_util.tree_map(
+            lambda x: x if isinstance(x, jax.ShapeDtypeStruct)
+            else jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=getattr(x, "sharding", None)),
+            template)
+        return ckptr.restore(path, abstract)

@@ -18,7 +18,10 @@ TINY = {"max_epochs": 6, "vocab_size": 1 << 14, "hidden_dim": 64,
         "max_len": 32, "model_parallel": 2, "learning_rate": 1e-2,
         "batch_size": 16, "bf16": False, "remat": False,
         "moe_experts": 0, "moe_top_k": 1, "pipeline_stages": 1,
-        "pipeline_microbatches": 0,
+        "pipeline_microbatches": 0, "loss_chunk": 0,
+        "quantize_int8": False, "sequence_parallel": 1,
+        "adapters_only": False, "rope_theta": 10000.0,
+        "rope_scaling": "",
         "quick_train": False,
         "share_params": False, "tokenizer_path": "", "pretrained_path": ""}
 
@@ -681,6 +684,10 @@ def test_rope_scaling_rejects_unsupported_types():
         _parse_rope_scaling('{"type": "linear", "factor": 4}')
     with pytest.raises(ValueError, match="unsupported"):
         _parse_rope_scaling({"rope_type": "yarn", "factor": 8})
-    # llama3 / default pass
+    with pytest.raises(ValueError, match="factor"):
+        _parse_rope_scaling({"rope_type": "llama3"})
+    # llama3 passes; explicit 'default' means UNSCALED (HF semantics)
     assert _parse_rope_scaling(
         {"rope_type": "llama3", "factor": 8}) is not None
+    assert _parse_rope_scaling(
+        {"rope_type": "default", "factor": 8}) is None
