@@ -48,6 +48,75 @@ _N_BYTES = 256
 _CHUNK_RE = re.compile(r" ?[^\s]+|\s+")
 
 
+class _NativeBPE:
+    """ctypes handle over ``native/bpe_encoder.cc`` (built on demand by
+    the native Makefile, like ``rafiki-kvd``). Holds the library AND
+    the encoder handle so lifetime is tied to the tokenizer."""
+
+    _lib = None       # process-wide loaded library (single slot)
+    _lib_key = None   # (path, mtime_ns) the slot was loaded from —
+    #                   a rebuild (atomic rename → new inode/mtime)
+    #                   forces a fresh CDLL instead of stale code
+
+    def __init__(self, lib, handle) -> None:
+        self._l = lib
+        self._h = handle
+
+    def __del__(self) -> None:  # best-effort; process exit also frees
+        try:
+            self._l.rbpe_free(self._h)
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+    def encode_chunk(self, chunk: bytes) -> Tuple[int, ...]:
+        import ctypes
+
+        n = len(chunk)
+        out = (ctypes.c_int32 * max(n, 1))()
+        got = self._l.rbpe_encode_chunk(
+            self._h, ctypes.c_char_p(chunk), n, out, max(n, 1))
+        if got < 0:  # cannot happen (merges only shrink) — but never
+            raise RuntimeError("native bpe buffer overflow")  # corrupt
+        return tuple(out[:got])
+
+
+def _native_encoder(merges) -> "_NativeBPE | None":
+    """Load (building if needed) the native chunk encoder, or None when
+    disabled/unbuildable — the Python loop is always a valid twin."""
+    import os
+
+    if os.environ.get("RAFIKI_NATIVE_BPE", "").lower() in ("off", "0"):
+        return None
+    try:
+        import ctypes
+
+        from rafiki_tpu.native.client import ensure_built
+
+        lib_path = ensure_built(target="librbpe.so")
+        key = (str(lib_path), lib_path.stat().st_mtime_ns)
+        if _NativeBPE._lib is None or _NativeBPE._lib_key != key:
+            lib = ctypes.CDLL(str(lib_path))
+            lib.rbpe_create.restype = ctypes.c_void_p
+            lib.rbpe_create.argtypes = [
+                ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
+            lib.rbpe_free.argtypes = [ctypes.c_void_p]
+            lib.rbpe_encode_chunk.restype = ctypes.c_int32
+            lib.rbpe_encode_chunk.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
+            _NativeBPE._lib, _NativeBPE._lib_key = lib, key
+        lib = _NativeBPE._lib
+        flat = [x for pair in merges for x in pair]
+        arr = (ctypes.c_int32 * len(flat))(*flat) if flat else \
+            (ctypes.c_int32 * 1)()
+        handle = lib.rbpe_create(arr, len(merges))
+        if not handle:
+            return None
+        return _NativeBPE(lib, ctypes.c_void_p(handle))
+    except Exception:  # noqa: BLE001 — no toolchain/lib: Python twin
+        return None
+
+
 class ByteBPETokenizer:
     """Byte-level BPE with a JSON-artifact merge table.
 
@@ -67,7 +136,15 @@ class ByteBPETokenizer:
             bytes([i]) for i in range(_N_BYTES)]
         for left, right in self.merges:
             self._bytes.append(self._bytes[left] + self._bytes[right])
-        self._encode_chunk = lru_cache(maxsize=65536)(self._bpe_chunk)
+        #: native chunk encoder (ctypes over native/bpe_encoder.cc) —
+        #: the merge loop is the serving host path's CPU hotspot; the
+        #: C++ twin is algorithm-identical (tests assert id-for-id
+        #: parity) and the Python loop remains the fallback.
+        #: RAFIKI_NATIVE_BPE=off disables.
+        self._native = _native_encoder(self.merges)
+        impl = (self._native.encode_chunk if self._native is not None
+                else self._bpe_chunk)
+        self._encode_chunk = lru_cache(maxsize=65536)(impl)
 
     @property
     def vocab_size(self) -> int:

@@ -22,18 +22,29 @@ _NATIVE_DIR = Path(__file__).resolve().parent
 _BINARY = _NATIVE_DIR / "build" / "rafiki-kvd"
 
 
-def ensure_built(force: bool = False) -> Path:
-    """Compile the server if needed; returns the binary path."""
-    src = _NATIVE_DIR / "kv_server.cc"
-    if not force and _BINARY.exists() and \
-            _BINARY.stat().st_mtime >= src.stat().st_mtime:
-        return _BINARY
+#: buildable native artifacts and their sources (Makefile targets)
+_SOURCES = {"rafiki-kvd": "kv_server.cc", "librbpe.so": "bpe_encoder.cc"}
+
+
+def ensure_built(force: bool = False,
+                 target: str = "rafiki-kvd") -> Path:
+    """Compile a native artifact if missing/stale; returns its path.
+
+    Builds ONLY the named Makefile target (a broken sibling source
+    must not disable this one), and the Makefile installs via
+    temp-file + atomic rename so processes holding the old artifact
+    keep a valid inode."""
+    out = _NATIVE_DIR / "build" / target
+    src = _NATIVE_DIR / _SOURCES[target]
+    if not force and out.exists() and \
+            out.stat().st_mtime >= src.stat().st_mtime:
+        return out
     make = shutil.which("make")
     if make is None:
-        raise RuntimeError("`make` not found; cannot build rafiki-kvd")
-    subprocess.run([make, "-C", str(_NATIVE_DIR)], check=True,
+        raise RuntimeError(f"`make` not found; cannot build {target}")
+    subprocess.run([make, "-C", str(_NATIVE_DIR), str(out)], check=True,
                    capture_output=True)
-    return _BINARY
+    return out
 
 
 class KVServer:
