@@ -113,7 +113,18 @@ def _native_encoder(merges) -> "_NativeBPE | None":
         if not handle:
             return None
         return _NativeBPE(lib, ctypes.c_void_p(handle))
-    except Exception:  # noqa: BLE001 — no toolchain/lib: Python twin
+    except Exception as e:  # noqa: BLE001 — Python twin is always valid
+        import logging
+        import subprocess
+
+        detail = ""
+        if isinstance(e, subprocess.CalledProcessError) and e.stderr:
+            detail = ": " + e.stderr.decode("utf-8", "replace")[-400:]
+        # observable, not fatal: silent fallback would show up only as
+        # unexplained serving-host latency
+        logging.getLogger(__name__).warning(
+            "native BPE encoder unavailable (%s%s); using the Python "
+            "merge loop", e, detail)
         return None
 
 

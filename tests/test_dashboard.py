@@ -106,6 +106,9 @@ def test_dashboard_write_paths(tmp_path):
             html = resp.read().decode()
         # the write-path UI is wired: forms + the endpoints they POST to
         for control in ("nmUpload", "ndRegister", "njCreate", "niDeploy",
+                        "niMulti", "niAdaptive",  # budget-flag options
+                        "MULTI_ADAPTER", "ADAPTIVE_GATHER",
+                        "gather_deadline_s",  # controller in health
                         "+ upload model", "+ register dataset",
                         "+ new train job", "+ deploy inference job"):
             assert control in html, control
