@@ -101,6 +101,18 @@ def rope(x: jnp.ndarray, positions: jnp.ndarray,
         axis=-1).astype(x.dtype)
 
 
+def _same_tokenizer(a: Any, b: Any) -> bool:
+    """Do two tokenizers map ids to the same text? BPE tokenizers
+    compare merge tables; otherwise same type + vocab (HashTokenizer
+    is fully determined by its vocab size)."""
+    if type(a) is not type(b):
+        return False
+    am, bm = getattr(a, "merges", None), getattr(b, "merges", None)
+    if am is not None or bm is not None:
+        return am == bm
+    return a.vocab_size == b.vocab_size
+
+
 def _parse_rope_scaling(value: Any
                         ) -> Optional[Tuple[float, float, float, float]]:
     """Knob value (JSON object string, dict, or "") → the static
@@ -1402,22 +1414,29 @@ class LlamaLoRA(BaseModel):
                            steps_per_sync: int = 4,
                            prefill_chunk: int = 32,
                            speculate_k: int = 0,
-                           system_prefix: str = ""):
+                           system_prefix: str = "",
+                           draft_model: Optional["LlamaLoRA"] = None):
         """Continuous-batching serving engine over this model's weights
         (BASELINE.md config #5). The inference worker drives it when
-        running in decode-loop mode; see ``serving/decode_engine.py``."""
+        running in decode-loop mode; see ``serving/decode_engine.py``.
+
+        ``draft_model`` (with ``speculate_k >= 2``): a SMALLER trained
+        LlamaLoRA sharing this model's vocabulary drafts the
+        speculative continuations instead of prompt-lookup n-grams —
+        real draft-model speculation, still greedy-lossless (the
+        target's verify step is authoritative either way)."""
         assert self._params is not None, "model is not trained/loaded"
         module, params = self._serving_module_params()
         text_engine = self._build_text_engine(
             module, params, max_slots, max_new_tokens, steps_per_sync,
-            prefill_chunk, speculate_k)
+            prefill_chunk, speculate_k, draft_model=draft_model)
         if system_prefix:
             text_engine.register_prefix(system_prefix)
         return text_engine
 
     def _build_text_engine(self, module, params, max_slots,
                            max_new_tokens, steps_per_sync, prefill_chunk,
-                           speculate_k):
+                           speculate_k, draft_model=None):
         """Common engine wiring for the single- and multi-adapter
         flavors: this model's tokenizer around a DecodeEngine."""
         from rafiki_tpu.serving.decode_engine import (DecodeEngine,
@@ -1429,11 +1448,42 @@ class LlamaLoRA(BaseModel):
             row, n = self.tokenizer.encode(str(text), max_len)
             return row[:max(1, int(n))]
 
+        draft = None
+        if draft_model is not None:
+            assert draft_model._params is not None, \
+                "draft model is not trained/loaded"
+            d_module, d_params = draft_model._serving_module_params()
+            if not _same_tokenizer(self.tokenizer,
+                                   draft_model.tokenizer):
+                # equal vocab_size is NOT 'same tokenizer': different
+                # BPE merge tables map the same ids to different text,
+                # so drafts would never match and speculation silently
+                # gates off — fail loudly instead
+                raise ValueError(
+                    "draft and target tokenize differently (merge "
+                    "tables / vocab mismatch): speculation compares "
+                    "token ids, so the models must share a tokenizer")
+            if int(draft_model.knobs["max_len"]) < max_len:
+                raise ValueError(
+                    "draft max_len must cover the target's (the draft "
+                    "cache walks the same positions)")
+            # the params must actually fit the draft's knobs: a
+            # mis-set draft_knobs would otherwise surface as an opaque
+            # XLA shape error at the first dispatch
+            abstract = jax.eval_shape(lambda: d_module.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((1, 8), jnp.int32))["params"])
+            if not same_tree_shapes(abstract, d_params):
+                raise ValueError(
+                    "draft parameters do not match the draft model's "
+                    "knobs (pass the draft trial's own knobs, e.g. "
+                    "the worker config's draft_knobs)")
+            draft = (d_module, d_params)
         core = DecodeEngine(module, params,
                             max_slots=max_slots, max_len=max_len,
                             steps_per_sync=steps_per_sync,
                             prefill_chunk=prefill_chunk,
-                            speculate_k=speculate_k)
+                            speculate_k=speculate_k, draft=draft)
         return TextDecodeEngine(
             core, encode, self._detok,
             max_new=min(max_new_tokens, max_len - 1))

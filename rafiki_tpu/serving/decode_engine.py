@@ -45,6 +45,10 @@ import numpy as np
 # draft window must beat single-token decoding by 50% to keep the
 # verify path; re-probing is cheap (one call) and content can change.
 SPEC_MIN_TOKENS_PER_CALL = 1.5
+# draft-MODEL speculation pays two extra device dispatches per verify
+# (draft scan + verify mirror) plus a mirror per plain scan, so its
+# break-even floor sits higher than free host-side n-gram drafting
+SPEC_MIN_TOKENS_PER_CALL_DRAFT = 2.2
 SPEC_REPROBE_CALLS = 32
 # EMA decay for tokens-per-verify-call: 0.7 gates hopeless content off
 # after ~2 zero-acceptance calls (start is just above the floor) while
@@ -84,7 +88,8 @@ class DecodeEngine:
 
     def __init__(self, module: Any, params: Any, max_slots: int,
                  max_len: int, steps_per_sync: int = 4,
-                 prefill_chunk: int = 32, speculate_k: int = 0) -> None:
+                 prefill_chunk: int = 32, speculate_k: int = 0,
+                 draft: Optional[Tuple[Any, Any]] = None) -> None:
         self.module = module
         self.params = params
         self.B = int(max_slots)
@@ -109,7 +114,7 @@ class DecodeEngine:
         # (drafting quality is content-dependent and can recover).
         #: start just above the floor: good content proves itself on
         #: call 1; bad content is gated after ~2 calls
-        self._spec_ema = SPEC_MIN_TOKENS_PER_CALL + 0.5
+        self._spec_ema = SPEC_MIN_TOKENS_PER_CALL_DRAFT + 0.5
         self._spec_idle = 0  # scan calls since the last spec attempt
         #: prompt tokens ingested per fused prefill call (1 disables the
         #: separate prefill program — prompts then stream token-by-token
@@ -153,6 +158,50 @@ class DecodeEngine:
                             if self.C > 1 else None)
         self._verify_fn = (_make_verify(module, self.B, self.spec_k)
                            if self.spec_k else None)
+        #: draft-MODEL speculation (``draft=(module, params)``, a
+        #: smaller model sharing the vocab): replaces prompt-lookup
+        #: drafting with real draft-model continuations. The draft
+        #: keeps a slot-parallel KV cache synced by construction —
+        #: every target cache advance (chunked prefill, fused scan,
+        #: verify) is mirrored with one multi-token draft pass over
+        #: the ACTUALLY-CONSUMED tokens, and accepted draft rows are
+        #: definitionally the accepted tokens' KV (greedy acceptance
+        #: means draft prediction == accepted token), so rejected rows
+        #: are the standard unreachable-then-rewritten case. Greedy-
+        #: lossless like prompt-lookup: the verify step is target-
+        #: authoritative either way.
+        self.draft_module, self.draft_params = draft or (None, None)
+        self._draft_cache = None
+        if self.draft_module is not None and self.spec_k:
+            self._draft_cache = self.draft_module.init(
+                jax.random.PRNGKey(0), jnp.zeros((self.B, 1), jnp.int32),
+                decode=True)["cache"]
+            # draft phase: k-1 greedy steps with argmax feedback
+            self._draft_scan = _make_step(self.draft_module, self.B,
+                                          self.spec_k - 1, False)
+            # mirror passes: multi-token KV population (prefill-shaped)
+            self._draft_sync_k = _make_prefill(self.draft_module,
+                                               self.B, self.K)
+            self._draft_sync_c = (_make_prefill(self.draft_module,
+                                                self.B, self.C)
+                                  if self.C > 1 else None)
+            # verify mirror (chunk = spec_k): writes the verify call's
+            # consumed inputs [tok, drafts] into the draft cache —
+            # idempotent for rows the draft scan already wrote, and it
+            # adds the final row the scan stops short of (needed when
+            # a window is FULLY accepted: that row's KV must exist for
+            # the draft's later attention)
+            self._draft_sync_v = _make_prefill(self.draft_module,
+                                               self.B, self.spec_k)
+        #: draft-cost-aware break-even floor for the acceptance gate
+        self._spec_floor = (SPEC_MIN_TOKENS_PER_CALL_DRAFT
+                            if self._draft_cache is not None
+                            else SPEC_MIN_TOKENS_PER_CALL)
+        self._spec_ema = self._spec_floor + 0.5
+        #: False while the gate is off and scan mirrors are skipped —
+        #: a re-probe first rebuilds the draft cache from the slots'
+        #: accepted contexts (cheaper than mirroring every gated scan)
+        self._draft_synced = True
         #: registered shared prefix (system prompt): token ids, its
         #: precomputed 1-row KV cache, and its length. Requests whose
         #: prompt extends it skip its prefill — admission copies the
@@ -165,7 +214,8 @@ class DecodeEngine:
             "steps": 0, "tokens_generated": 0, "requests_done": 0,
             "max_concurrent": 0, "prefill_calls": 0,
             "prefill_tokens": 0, "spec_calls": 0, "spec_drafted": 0,
-            "spec_accepted": 0, "prefix_hits": 0, "prefix_tokens": 0}
+            "spec_accepted": 0, "prefix_hits": 0, "prefix_tokens": 0,
+            "spec_draft_model_calls": 0, "draft_resyncs": 0}
 
     # ---- submission / results (thread-safe: worker loop vs callers) ----
     def submit(self, request_id: Any, prompt_ids: np.ndarray,
@@ -281,17 +331,36 @@ class DecodeEngine:
         # max_len but install() reads [:plen] — trimming cuts the
         # per-adapter resident HBM by max_len/plen
         snap = jax.tree_util.tree_map(lambda p: p[:, :plen], snap)
-        self._prefixes[aid] = {
-            "ids": prefix, "cache": jax.block_until_ready(snap),
-            "len": plen, "install": install, "aid": aid}
+        entry = {"ids": prefix, "cache": jax.block_until_ready(snap),
+                 "len": plen, "install": install, "aid": aid}
+        if self._draft_cache is not None:
+            # the draft attends the same positions: without its own
+            # snapshot a prefix-hit slot would draft over zero KV for
+            # 0..plen-1 (still lossless, but acceptance collapses and
+            # the draft's cost is pure waste)
+            d1 = self.draft_module.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32),
+                decode=True)["cache"]
+            d_fill = _make_prefill(self.draft_module, 1, plen)
+            d_snap = d_fill(self.draft_params, d1,
+                            jnp.asarray(prefix[None, :]),
+                            jnp.arange(plen, dtype=jnp.int32)[None, :],
+                            jnp.asarray([aid], jnp.int32))
+            d_snap = jax.tree_util.tree_map(lambda p: p[:, :plen],
+                                            d_snap)
+            entry["draft_cache"] = jax.block_until_ready(d_snap)
+        self._prefixes[aid] = entry
         return plen
 
     def _install_prefix(self, rows: List[int],
                         pre: Dict[str, Any]) -> None:
         """Copy prefix ``pre``'s KV rows into the given slots (the
         same snapshot admission matched/fast-forwarded against)."""
-        self._cache = pre["install"](
-            self._cache, pre["cache"], jnp.asarray(rows, jnp.int32))
+        rws = jnp.asarray(rows, jnp.int32)
+        self._cache = pre["install"](self._cache, pre["cache"], rws)
+        if self._draft_cache is not None and "draft_cache" in pre:
+            self._draft_cache = pre["install"](
+                self._draft_cache, pre["draft_cache"], rws)
         self.stats["prefix_hits"] += len(rows)
         self.stats["prefix_tokens"] += pre["len"] * len(rows)
 
@@ -320,11 +389,16 @@ class DecodeEngine:
         self._seed[:] = 0
         self._aid[:] = 0
         self._prompt_dev = None
-        self._spec_ema = SPEC_MIN_TOKENS_PER_CALL + 0.5
+        self._spec_ema = self._spec_floor + 0.5
         self._spec_idle = 0
+        self._draft_synced = True
         self._cache = self.module.init(
             jax.random.PRNGKey(0), jnp.zeros((self.B, 1), jnp.int32),
             decode=True)["cache"]
+        if self.draft_module is not None and self.spec_k:
+            self._draft_cache = self.draft_module.init(
+                jax.random.PRNGKey(0), jnp.zeros((self.B, 1), jnp.int32),
+                decode=True)["cache"]
 
     def _chunked_prefill(self) -> None:
         """Ingest admitted prompts C tokens per compiled call before they
@@ -356,9 +430,16 @@ class DecodeEngine:
                 else:
                     tok_chunk[i, :] = self._tok[i]
                     pos_chunk[i, :] = self._pos[i]
+            tok_dev = jnp.asarray(tok_chunk)
+            pos_dev = jnp.asarray(pos_chunk)
+            aid_dev = jnp.asarray(self._aid)
             self._cache = self._prefill_fn(
-                self.params, self._cache, jnp.asarray(tok_chunk),
-                jnp.asarray(pos_chunk), jnp.asarray(self._aid))
+                self.params, self._cache, tok_dev, pos_dev, aid_dev)
+            if self._draft_cache is not None:
+                # keep the draft's KV in lockstep with the prompt walk
+                self._draft_cache = self._draft_sync_c(
+                    self.draft_params, self._draft_cache, tok_dev,
+                    pos_dev, aid_dev)
             self.stats["prefill_calls"] += 1
             self.stats["prefill_tokens"] += int(adv.sum())
             for i in range(self.B):
@@ -437,7 +518,7 @@ class DecodeEngine:
         # otherwise this fused call runs the plain scan (the paths
         # interleave freely call-to-call; both emit exact argmax tokens)
         if (self._verify_fn is not None and not any_sampling
-                and (self._spec_ema >= SPEC_MIN_TOKENS_PER_CALL
+                and (self._spec_ema >= self._spec_floor
                      or self._spec_idle >= SPEC_REPROBE_CALLS)
                 and all(self._pos[i] >= len(self._slots[i].prompt) - 1
                         and int(self._pos[i]) + self.spec_k <= self.L
@@ -454,6 +535,18 @@ class DecodeEngine:
             jnp.asarray(self._aid))
         emitted = np.asarray(emitted)  # (K, B) — the per-token sync
         self.stats["steps"] += self.K
+        if self._draft_cache is not None:
+            if self._spec_ema >= self._spec_floor or \
+                    self._spec_idle >= SPEC_REPROBE_CALLS - 1:
+                if not self._draft_synced:
+                    self._resync_draft()
+                self._mirror_scan_onto_draft(emitted)
+            else:
+                # gate is off: skip the per-scan mirror (a gated-off
+                # draft engine must not be slower than no draft); the
+                # next re-probe rebuilds the cache from accepted
+                # contexts via _resync_draft
+                self._draft_synced = False
 
         finished: List[Tuple[Any, List[int]]] = []
         for i in live:
@@ -498,6 +591,85 @@ class DecodeEngine:
                 self.stats["requests_done"] += len(finished)
         return len(live)
 
+    def _resync_draft(self) -> None:
+        """Rebuild the draft cache from every live slot's ACCEPTED
+        context (prompt + generated, positions 0..pos-1). Runs when a
+        re-probe follows a gated-off stretch during which scan mirrors
+        were skipped — a bounded number of K-chunk passes instead of a
+        mirror on every gated scan."""
+        self._draft_cache = self.draft_module.init(
+            jax.random.PRNGKey(0), jnp.zeros((self.B, 1), jnp.int32),
+            decode=True)["cache"]
+        ctxs = {}
+        maxp = 0
+        for i in range(self.B):
+            s = self._slots[i]
+            if s is None:
+                continue
+            ctx = np.concatenate(
+                [s.prompt, np.asarray(s.generated, np.int32)])
+            ctxs[i] = ctx[:int(self._pos[i])]
+            maxp = max(maxp, len(ctxs[i]))
+        for c0 in range(0, maxp, self.K):
+            tok_m = np.zeros((self.B, self.K), np.int32)
+            pos_m = np.zeros((self.B, self.K), np.int32)
+            for i in range(self.B):
+                ctx = ctxs.get(i)
+                if ctx is None or len(ctx) <= c0:
+                    # nothing (left) for this lane: idempotent rewrite
+                    # of its current token at its current position
+                    tok_m[i, :] = self._tok[i]
+                    pos_m[i, :] = self._pos[i]
+                    continue
+                n = min(self.K, len(ctx) - c0)
+                tok_m[i, :n] = ctx[c0:c0 + n]
+                pos_m[i, :n] = np.arange(c0, c0 + n)
+                tok_m[i, n:] = tok_m[i, n - 1]
+                pos_m[i, n:] = pos_m[i, n - 1]
+            self._draft_cache = self._draft_sync_k(
+                self.draft_params, self._draft_cache,
+                jnp.asarray(tok_m), jnp.asarray(pos_m),
+                jnp.asarray(self._aid))
+        self._draft_synced = True
+        self.stats["draft_resyncs"] += 1
+
+    def _mirror_scan_onto_draft(self, emitted: np.ndarray) -> None:
+        """Write the fused scan's ACTUALLY-CONSUMED inputs into the
+        draft cache (one multi-token KV pass) so the draft stays
+        token-for-token synced with the target through prompts,
+        generation, and mixed admission — the invariant draft-model
+        speculation relies on. Idle lanes re-write their current token
+        at their current position (idempotent)."""
+        tok_m = np.empty((self.B, self.K), np.int32)
+        pos_m = np.empty((self.B, self.K), np.int32)
+        for i in range(self.B):
+            s = self._slots[i]
+            p0 = int(self._pos[i])
+            cur = int(self._tok[i])
+            if s is None:
+                tok_m[i, :] = cur
+                pos_m[i, :] = p0
+                continue
+            plen = len(s.prompt)
+            n_real = max(0, min(self.K, int(self._stop_pos[i]) - p0,
+                                self.L - p0))
+            for j in range(self.K):
+                if j < n_real:
+                    p = p0 + j
+                    if j == 0:
+                        t = cur
+                    elif p < plen:
+                        t = int(s.prompt[p])
+                    else:  # generated region: the previous step's token
+                        t = int(emitted[j - 1, i])
+                    tok_m[i, j], pos_m[i, j] = t, p
+                else:  # idle remainder: idempotent rewrite of the last
+                    tok_m[i, j] = tok_m[i, j - 1] if j else cur
+                    pos_m[i, j] = pos_m[i, j - 1] if j else p0
+        self._draft_cache = self._draft_sync_k(
+            self.draft_params, self._draft_cache, jnp.asarray(tok_m),
+            jnp.asarray(pos_m), jnp.asarray(self._aid))
+
     def _speculative_step(self, live: List[int]) -> int:
         """One verify call: host-drafted continuations for every live
         slot ride through a single multi-token cache step; each slot
@@ -507,12 +679,37 @@ class DecodeEngine:
         position mask, and rewritten in place when generation reaches
         them (the admission-reuse invariant already relies on this)."""
         k = self.spec_k
-        drafts = np.zeros((self.B, k - 1), np.int32)
-        for i in live:
-            s = self._slots[i]
-            ctx = np.concatenate(
-                [s.prompt, np.asarray(s.generated, np.int32)])
-            drafts[i] = _ngram_draft(ctx, k - 1)
+        if self._draft_cache is not None:
+            if not self._draft_synced:  # re-probe after a gated-off
+                self._resync_draft()    # stretch with skipped mirrors
+            # draft phase: k-1 fused greedy steps on the DRAFT model
+            # (argmax feedback), advancing its synced cache; then the
+            # verify mirror writes the window's inputs [tok, drafts]
+            # so the final row exists for fully-accepted windows
+            self._draft_cache, d_emit = self._draft_scan(
+                self.draft_params, self._draft_cache,
+                jnp.asarray(self._tok), jnp.asarray(self._pos),
+                self._prompt_dev, jnp.asarray(self._prompt_len),
+                jnp.asarray(self._stop_pos), jnp.asarray(self._temp),
+                jnp.asarray(self._topk), jnp.asarray(self._topp),
+                jnp.asarray(self._seed), jnp.asarray(self._aid))
+            drafts = np.asarray(d_emit).T.astype(np.int32)  # (B, k-1)
+            offs = np.arange(k, dtype=np.int32)[None, :]
+            self._draft_cache = self._draft_sync_v(
+                self.draft_params, self._draft_cache,
+                jnp.asarray(np.concatenate(
+                    [self._tok[:, None], drafts], axis=1)),
+                jnp.asarray(self._pos[:, None] + offs),
+                jnp.asarray(self._aid))
+            self.stats["spec_draft_model_calls"] = \
+                self.stats.get("spec_draft_model_calls", 0) + 1
+        else:
+            drafts = np.zeros((self.B, k - 1), np.int32)
+            for i in live:
+                s = self._slots[i]
+                ctx = np.concatenate(
+                    [s.prompt, np.asarray(s.generated, np.int32)])
+                drafts[i] = _ngram_draft(ctx, k - 1)
         self._cache, g, n_emit = self._verify_fn(
             self.params, self._cache, jnp.asarray(self._tok),
             jnp.asarray(self._pos), jnp.asarray(drafts),

@@ -44,7 +44,9 @@ class InferenceWorker:
                  decode_loop: bool = False, max_slots: int = 8,
                  max_new_tokens: int = 8, steps_per_sync: int = 4,
                  speculate_k: int = 0, system_prefix: str = "",
-                 extra_adapter_trials: Optional[List[str]] = None) -> None:
+                 extra_adapter_trials: Optional[List[str]] = None,
+                 draft_trial_id: str = "",
+                 draft_knobs: Optional[dict] = None) -> None:
         self.worker_id = worker_id
         self.hub = hub
         self.max_batch_msgs = max_batch_msgs
@@ -60,6 +62,17 @@ class InferenceWorker:
             raise KeyError(f"no parameters for trial {trial_id!r}")
         self.model.load_parameters(params)
         self.engine = None
+        if draft_trial_id and (not decode_loop or speculate_k < 2):
+            # fail loudly, like the multi-adapter misconfigurations: an
+            # operator who named a draft trial believes speculation is
+            # live — silently serving without it hides the mistake
+            raise ValueError(
+                "draft_trial_id requires decode_loop and "
+                f"speculate_k >= 2 (got speculate_k={speculate_k})")
+        if draft_trial_id and extra_adapter_trials:
+            raise ValueError(
+                "draft_trial_id is not supported with multi-adapter "
+                "deployment (the stacked engine has no draft path)")
         if decode_loop and extra_adapter_trials:
             if not hasattr(self.model, "make_multi_adapter_engine"):
                 # fail LOUDLY: falling back to a single-adapter engine
@@ -113,6 +126,17 @@ class InferenceWorker:
                     extra["speculate_k"] = speculate_k
                 if system_prefix:
                     extra["system_prefix"] = system_prefix
+                if draft_trial_id and speculate_k:
+                    # draft-MODEL speculation: a second (smaller) trial
+                    # drafts; its own knobs shape it (same tokenizer
+                    # family enforced by the engine's vocab check)
+                    d_dump = param_store.load(draft_trial_id)
+                    if d_dump is None:
+                        raise KeyError("no parameters for draft trial "
+                                       f"{draft_trial_id!r}")
+                    d_model = model_class(**(draft_knobs or knobs))
+                    d_model.load_parameters(d_dump)
+                    extra["draft_model"] = d_model
                 self.engine = self.model.make_decode_engine(
                     max_slots=max_slots, max_new_tokens=max_new_tokens,
                     steps_per_sync=steps_per_sync, **extra)
@@ -344,6 +368,18 @@ class InferenceWorker:
             self.hub.push_prediction(m["id"], pack_message(reply))
 
 
+def _require_dict_or_none(value: Any, name: str) -> Optional[dict]:
+    """Config values that must be a JSON object when present: silently
+    coercing a malformed one would hide an operator mistake until an
+    opaque shape error at first dispatch."""
+    if value is None:
+        return None
+    if not isinstance(value, dict):
+        raise ValueError(f"{name} must be a JSON object, got "
+                         f"{type(value).__name__}")
+    return value
+
+
 def _safe_sampling(samp: Any) -> dict:
     """Client-supplied sampling params, coerced defensively: a malformed
     value (e.g. {"temperature": "hot"}) must degrade that request to the
@@ -449,7 +485,10 @@ def main(argv: Optional[list] = None) -> int:
         max_new_tokens=int(cfg.get("max_new_tokens", 8)),
         speculate_k=int(cfg.get("speculate_k", 0)),
         system_prefix=str(cfg.get("system_prefix", "")),
-        extra_adapter_trials=list(cfg.get("extra_adapter_trials") or []))
+        extra_adapter_trials=list(cfg.get("extra_adapter_trials") or []),
+        draft_trial_id=str(cfg.get("draft_trial_id", "")),
+        draft_knobs=_require_dict_or_none(cfg.get("draft_knobs"),
+                                          "draft_knobs"))
     print(f"inference worker {worker.worker_id} serving", flush=True)
     worker.run()
     return 0

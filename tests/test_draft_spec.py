@@ -1,0 +1,157 @@
+"""Draft-MODEL speculative decoding: a smaller model drafts, the
+target verifies — greedy-lossless by construction, with the draft's
+KV cache synced through prompts/scan/verify by mirrored multi-token
+passes (serving/decode_engine.py ``draft=``)."""
+
+import numpy as np
+import pytest
+
+from rafiki_tpu.models.llama_lora import LlamaLoRA
+
+from test_decode_engine import KNOBS, trained  # noqa: F401 — fixture
+from test_multi_adapter import _lora_variant  # noqa: F401
+
+
+def _drain(eng):
+    got = {}
+    for _ in range(400):
+        if not eng.busy:
+            break
+        eng.step()
+        for rid, text in eng.poll():
+            got[rid] = text
+    assert not eng.busy, "engine failed to drain"
+    return got
+
+
+def _serve(trained, reqs, **engine_kwargs):  # noqa: F811
+    eng = trained.make_decode_engine(max_slots=4, max_new_tokens=8,
+                                     **engine_kwargs)
+    for rid, text in reqs:
+        eng.submit(rid, text)
+    return _drain(eng), eng
+
+
+def test_draft_model_speculation_is_lossless(trained):  # noqa: F811
+    """Outputs are token-identical to plain greedy decoding whether
+    the draft is PERFECT (the target itself — near-total acceptance)
+    or BAD (perturbed adapters — low acceptance): the verify step is
+    target-authoritative either way."""
+    reqs = [("a", "tok1 tok2 tok3"), ("b", "tok4 tok5"),
+            ("c", "tok6 tok7 tok8")]
+    plain, _ = _serve(trained, reqs)
+
+    # perfect draft: a sibling carrying the same params
+    perfect = LlamaLoRA(**KNOBS)
+    perfect.load_parameters(trained.dump_parameters())
+    out_p, eng_p = _serve(trained, reqs, speculate_k=4,
+                          draft_model=perfect)
+    assert out_p == plain
+    s = eng_p.stats
+    assert s.get("spec_draft_model_calls", 0) > 0, s
+    assert s["spec_accepted"] > 0
+    # a perfect draft should accept nearly everything it drafts
+    assert s["spec_accepted"] >= 0.9 * s["spec_drafted"], s
+
+    # bad draft: same base, perturbed adapters — still lossless
+    bad = LlamaLoRA(**KNOBS)
+    dump = trained.dump_parameters()
+    dump = dict(dump)
+    dump["params"] = _lora_variant(trained._params, scale=0.5)
+    bad.load_parameters(dump)
+    out_b, eng_b = _serve(trained, reqs, speculate_k=4, draft_model=bad)
+    assert out_b == plain
+    assert eng_b.stats["requests_done"] == len(reqs)
+
+
+def test_draft_model_mid_flight_admission(trained):  # noqa: F811
+    """Requests admitted while others are mid-generation keep the
+    draft cache synced (the scan/prefill mirrors): outputs still match
+    solo plain decoding per request."""
+    perfect = LlamaLoRA(**KNOBS)
+    perfect.load_parameters(trained.dump_parameters())
+    eng = trained.make_decode_engine(max_slots=2, max_new_tokens=6,
+                                     speculate_k=3,
+                                     draft_model=perfect)
+    plain_eng = trained.make_decode_engine(max_slots=2,
+                                           max_new_tokens=6)
+    for rid, text in [("a", "tok1 tok2 tok3"), ("b", "tok4 tok5"),
+                      ("c", "tok6 tok7")]:
+        plain_eng.submit(rid, text)
+    plain = _drain(plain_eng)
+    eng.submit("a", "tok1 tok2 tok3")
+    got = {}
+    stepped = 0
+    while eng.busy or stepped == 0:
+        eng.step()
+        stepped += 1
+        if stepped == 2:  # admit mid-flight
+            eng.submit("b", "tok4 tok5")
+        if stepped == 4:
+            eng.submit("c", "tok6 tok7")
+        for rid, text in eng.poll():
+            got[rid] = text
+        if stepped > 400:
+            raise AssertionError("no drain")
+    assert got == plain
+
+
+def test_draft_model_vocab_mismatch_rejected(trained):  # noqa: F811
+    other = LlamaLoRA(**{**KNOBS, "vocab_size": 1 << 9})
+    other._params = other._module().init(
+        __import__("jax").random.PRNGKey(0),
+        np.zeros((1, int(KNOBS["max_len"])), np.int32))["params"]
+    with pytest.raises(ValueError, match="vocab"):
+        trained.make_decode_engine(speculate_k=3, draft_model=other)
+
+
+def test_draft_with_prefix_cache_stays_accepted(trained):  # noqa: F811
+    """system_prefix + draft_model: the prefix KV installs into BOTH
+    caches, so prefix-hit requests keep near-total acceptance with a
+    perfect draft (and stay lossless)."""
+    perfect = LlamaLoRA(**KNOBS)
+    perfect.load_parameters(trained.dump_parameters())
+    prefix = "tok1 tok2 tok3"
+    plain = trained.make_decode_engine(max_slots=2, max_new_tokens=6,
+                                       system_prefix=prefix)
+    # spec_k=3 divides max_new: no stop-boundary clamp, so acceptance
+    # measures draft quality alone
+    eng = trained.make_decode_engine(max_slots=2, max_new_tokens=6,
+                                     speculate_k=3, draft_model=perfect,
+                                     system_prefix=prefix)
+    reqs = [("a", prefix + " tok4 tok5"), ("b", prefix + " tok6")]
+    for rid, text in reqs:
+        plain.submit(rid, text)
+    ref = _drain(plain)
+    for rid, text in reqs:
+        eng.submit(rid, text)
+    got = _drain(eng)
+    assert got == ref
+    s = eng.stats
+    assert s["prefix_hits"] == 2
+    assert s["spec_accepted"] >= 0.9 * s["spec_drafted"], s
+
+
+def test_draft_resync_after_gated_stretch(trained):  # noqa: F811
+    """Force the gate off (sampling traffic skips spec and the mirror),
+    then greedy traffic re-probes: the engine resyncs the draft cache
+    from accepted contexts and keeps outputs lossless."""
+    perfect = LlamaLoRA(**KNOBS)
+    perfect.load_parameters(trained.dump_parameters())
+    eng = trained.make_decode_engine(max_slots=2, max_new_tokens=6,
+                                     speculate_k=3, draft_model=perfect)
+    # sampling requests ride the scan path; the engine skips mirrors
+    # while the spec path is unavailable only if gated — force the gate
+    # down artificially to exercise resync deterministically
+    eng.engine._spec_ema = 0.0
+    eng.submit("warm", "tok1 tok2")
+    _drain(eng)
+    assert eng.engine._draft_synced is False
+    eng.engine._spec_ema = eng.engine._spec_floor + 1.0  # re-open
+    plain = trained.make_decode_engine(max_slots=2, max_new_tokens=6)
+    plain.submit("x", "tok1 tok2 tok3")
+    ref = _drain(plain)
+    eng.submit("x", "tok1 tok2 tok3")
+    got = _drain(eng)
+    assert got == ref
+    assert eng.engine.stats["draft_resyncs"] >= 1
