@@ -41,6 +41,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                        help="target vocab size (specials + 256 bytes + "
                             "merges)")
 
+    sub.add_parser(
+        "doctor",
+        help="check the environment (backend, devices, native "
+             "artifacts, compile cache) and print a health report")
+
     _register_service_commands(sub)
 
     args = parser.parse_args(argv)
@@ -101,7 +106,78 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"vocab_size={tok.vocab_size} merges={len(tok.merges)} "
               f"-> {args.out}")
         return 0
+    if args.cmd == "doctor":
+        return _doctor()
     return _run_service_command(args)
+
+
+def _doctor() -> int:
+    """Operator health report: every row is a check with a pass/fail
+    mark; exit 0 iff all load-bearing checks pass. Never claims the
+    accelerator beyond a tiny matmul (a doctor must not wedge on a
+    flaky tunnel longer than one probe)."""
+    ok = True
+
+    def row(good: bool, label: str, detail: str = "",
+            fatal: bool = True) -> None:
+        nonlocal ok
+        mark = "ok " if good else ("FAIL" if fatal else "warn")
+        print(f"[{mark}] {label}" + (f": {detail}" if detail else ""))
+        if fatal and not good:
+            ok = False
+
+    from . import __version__
+
+    row(True, "rafiki-tpu", __version__)
+    try:
+        import jax
+
+        backend = jax.default_backend()
+        devs = jax.devices()
+        row(True, "jax backend", f"{backend}, {len(devs)} device(s)")
+        import time
+
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        x = jnp.ones((256, 256), jnp.bfloat16)
+        (x @ x).block_until_ready()
+        row(True, "device matmul",
+            f"bf16 256x256 in {time.perf_counter() - t0:.2f}s "
+            "(first call includes compile)")
+    except Exception as e:  # noqa: BLE001 — the report IS the product
+        row(False, "jax backend", str(e))
+    try:
+        from .native.client import ensure_built
+
+        row(True, "native kv server", str(ensure_built()))
+        row(True, "native bpe encoder",
+            str(ensure_built(target="librbpe.so")))
+    except Exception as e:  # noqa: BLE001
+        row(False, "native build", str(e), fatal=False)
+    try:
+        from .data.bpe import ByteBPETokenizer
+
+        tok = ByteBPETokenizer.train(["doctor check"] * 4,
+                                     vocab_size=270)
+        row(tok.decode(tok.encode_ids("doctor")) == "doctor",
+            "bpe round-trip",
+            "native" if tok._native is not None else "python fallback")
+    except Exception as e:  # noqa: BLE001
+        row(False, "bpe round-trip", str(e))
+    import os
+
+    from .utils.platform import CACHE_ENV, compile_cache_path
+
+    path = compile_cache_path()
+    if path is None:
+        row(True, "compile cache", f"disabled by {CACHE_ENV}")
+    else:
+        row(os.path.isdir(path) or os.access(
+            os.path.dirname(path) or ".", os.W_OK),
+            "compile cache", path, fatal=False)
+    print("all checks passed" if ok else "SOME CHECKS FAILED")
+    return 0 if ok else 1
 
 
 def _register_service_commands(sub: argparse._SubParsersAction) -> None:

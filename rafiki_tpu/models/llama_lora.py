@@ -1450,6 +1450,12 @@ class LlamaLoRA(BaseModel):
 
         draft = None
         if draft_model is not None:
+            if int(speculate_k) < 2:
+                # fail loudly, like the worker's config guard: a caller
+                # who handed over a draft believes speculation is live
+                raise ValueError(
+                    "draft_model requires speculate_k >= 2 "
+                    f"(got {speculate_k})")
             assert draft_model._params is not None, \
                 "draft model is not trained/loaded"
             d_module, d_params = draft_model._serving_module_params()

@@ -112,9 +112,9 @@ class DecodeEngine:
         # emitted per speculative call; below the break-even floor the
         # engine falls back to the scan and re-probes periodically
         # (drafting quality is content-dependent and can recover).
-        #: start just above the floor: good content proves itself on
-        #: call 1; bad content is gated after ~2 calls
-        self._spec_ema = SPEC_MIN_TOKENS_PER_CALL_DRAFT + 0.5
+        #: the EMA seeds just above the applicable floor AFTER the
+        #: draft setup below (good content proves itself on call 1;
+        #: bad content is gated after ~2 calls)
         self._spec_idle = 0  # scan calls since the last spec attempt
         #: prompt tokens ingested per fused prefill call (1 disables the
         #: separate prefill program — prompts then stream token-by-token
@@ -435,8 +435,9 @@ class DecodeEngine:
             aid_dev = jnp.asarray(self._aid)
             self._cache = self._prefill_fn(
                 self.params, self._cache, tok_dev, pos_dev, aid_dev)
-            if self._draft_cache is not None:
+            if self._draft_cache is not None and self._draft_synced:
                 # keep the draft's KV in lockstep with the prompt walk
+                # (while desynced, resync rebuilds prompts anyway)
                 self._draft_cache = self._draft_sync_c(
                     self.draft_params, self._draft_cache, tok_dev,
                     pos_dev, aid_dev)
@@ -536,16 +537,18 @@ class DecodeEngine:
         emitted = np.asarray(emitted)  # (K, B) — the per-token sync
         self.stats["steps"] += self.K
         if self._draft_cache is not None:
-            if self._spec_ema >= self._spec_floor or \
-                    self._spec_idle >= SPEC_REPROBE_CALLS - 1:
+            if not any_sampling and (
+                    self._spec_ema >= self._spec_floor
+                    or self._spec_idle >= SPEC_REPROBE_CALLS - 1):
                 if not self._draft_synced:
                     self._resync_draft()
                 self._mirror_scan_onto_draft(emitted)
             else:
-                # gate is off: skip the per-scan mirror (a gated-off
-                # draft engine must not be slower than no draft); the
-                # next re-probe rebuilds the cache from accepted
-                # contexts via _resync_draft
+                # speculation can't pay off right now (gate off, or
+                # sampling slots block the all-greedy precondition):
+                # skip the per-scan mirror — a draft engine must not be
+                # slower than no draft — and let the next re-probe
+                # rebuild the cache from accepted contexts
                 self._draft_synced = False
 
         finished: List[Tuple[Any, List[int]]] = []

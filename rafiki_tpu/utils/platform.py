@@ -10,6 +10,7 @@ initializes. Service entrypoints call :func:`apply_platform_env` first.
 from __future__ import annotations
 
 import os
+from typing import Optional
 
 #: set by the ServicesManager on children: "cpu" | "tpu" | "" (inherit)
 PLATFORM_ENV = "RAFIKI_JAX_PLATFORM"
@@ -23,6 +24,18 @@ PLATFORM_ENV = "RAFIKI_JAX_PLATFORM"
 CACHE_ENV = "RAFIKI_COMPILE_CACHE"
 
 
+def compile_cache_path() -> Optional[str]:
+    """The resolved persistent-compile-cache directory, or None when
+    disabled via ``RAFIKI_COMPILE_CACHE=off``. Single source of truth
+    for the env name and the default path (``apply_platform_env`` and
+    the doctor both resolve through here)."""
+    cache = os.environ.get(CACHE_ENV, "")
+    if cache == "off":
+        return None
+    return os.path.expanduser(cache) if cache else os.path.join(
+        os.path.expanduser("~"), ".cache", "rafiki_tpu", "xla_cache")
+
+
 def apply_platform_env() -> str:
     """Apply platform + compile-cache config before jax backends init.
 
@@ -34,10 +47,8 @@ def apply_platform_env() -> str:
         import jax
 
         jax.config.update("jax_platforms", platform)
-    cache = os.environ.get(CACHE_ENV, "")
-    if cache != "off":
-        cache = os.path.expanduser(cache) if cache else os.path.join(
-            os.path.expanduser("~"), ".cache", "rafiki_tpu", "xla_cache")
+    cache = compile_cache_path()
+    if cache is not None:
         try:
             os.makedirs(cache, exist_ok=True)
         except OSError:

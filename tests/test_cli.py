@@ -1,0 +1,11 @@
+"""CLI surface: the operator doctor report."""
+
+
+def test_cli_doctor(capsys):
+    from rafiki_tpu.cli import main
+
+    rc = main(["doctor"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "jax backend" in out and "bpe round-trip" in out
+    assert "all checks passed" in out
