@@ -72,6 +72,27 @@ def main() -> None:
             t.start()
         for t in threads:
             t.join()
+
+        # seeded sampling: reproducible under any serving load
+        samp = {"temperature": 0.8, "top_k": 40, "seed": 1234}
+        a = client.predict(url, ["tok1 tok2"], timeout=180,
+                           sampling=samp)
+        b = client.predict(url, ["tok1 tok2"], timeout=180,
+                           sampling=samp)
+        print("seeded sampling reproducible:", a == b)
+
+        # token streaming: SSE deltas as the decode loop produces them
+        print("streaming:", end="", flush=True)
+        for ev in client.predict_stream(url, ["tok1 tok2 tok3"],
+                                        timeout=180):
+            if "delta" in ev:
+                print(" +", "".join(ev["delta"].values()),
+                      end="", flush=True)
+            elif ev.get("done") and ev.get("error"):
+                print(f"\nstream failed: {ev['error']} "
+                      f"(partial: {ev.get('partial')})")
+            elif ev.get("done"):
+                print("\nfinal:", (ev.get("predictions") or [""])[0])
         client.stop_inference_job(ijob["id"])
 
 

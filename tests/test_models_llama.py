@@ -526,14 +526,25 @@ def _assert_sp_forward_matches_plain(model, mesh_shape, batch, seed):
 
 def test_sp_forward_parity_untrained():
     """Default-leg sp correctness without a train loop: on random-init
-    params, the sp forward equals the plain forward through BOTH
-    dispatch paths — ulysses ((2, 4) mesh, heads divide) and ring
-    ((1, 8) mesh, heads don't)."""
+    params, the sp forward equals the plain forward through the
+    ulysses dispatch path ((2, 4) mesh, heads divide). The ring path's
+    module-level parity rides the slow ring-fallback train test plus
+    the default ops-level GQA oracle (test_ring_gqa_matches_dense)."""
     model = LlamaLoRA(**{**TINY, "model_parallel": 1})
     model._params = model._module().init(
         jax.random.PRNGKey(3),
         jnp.zeros((1, TINY["max_len"]), jnp.int32))["params"]
     _assert_sp_forward_matches_plain(model, (2, 4), batch=4, seed=0)
+
+
+@pytest.mark.slow
+def test_sp_forward_parity_ring_dispatch():
+    """The (1, 8) mesh forces the ring dispatch (heads=4 don't divide
+    8): module-level parity for that path."""
+    model = LlamaLoRA(**{**TINY, "model_parallel": 1})
+    model._params = model._module().init(
+        jax.random.PRNGKey(3),
+        jnp.zeros((1, TINY["max_len"]), jnp.int32))["params"]
     _assert_sp_forward_matches_plain(model, (1, 8), batch=2, seed=1)
 
 
