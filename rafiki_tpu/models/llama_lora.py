@@ -249,6 +249,12 @@ class _DecoderAttention(nn.Module):
     seq_axis: Optional[str] = None
     rope_theta: float = 10000.0
     rope_scaling: Optional[Tuple[float, float, float, float]] = None
+    #: serving-only int8 KV cache: K/V rows store as int8 with one f32
+    #: absmax scale per (slot, position, kv-head) vector — half the
+    #: decode cache's HBM at bf16 (4x at f32), bought with a bounded
+    #: per-element quantization error (<= absmax/254 per component).
+    #: Reads dequantize on the fly and fuse into the attention einsum.
+    kv_int8: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, lens: jnp.ndarray,
@@ -279,12 +285,20 @@ class _DecoderAttention(nn.Module):
             # this branch — guard with has_variable so initialization
             # only allocates zeros and never writes.
             is_live = self.has_variable("cache", "k")
+            kv_dtype = jnp.int8 if self.kv_int8 else x.dtype
             ck = self.variable("cache", "k", jnp.zeros,
                                (b, self.max_len, self.n_kv_heads, dh),
-                               x.dtype)
+                               kv_dtype)
             cv = self.variable("cache", "v", jnp.zeros,
                                (b, self.max_len, self.n_kv_heads, dh),
-                               x.dtype)
+                               kv_dtype)
+            if self.kv_int8:  # one absmax scale per stored K/V vector
+                sk = self.variable("cache", "k_scale", jnp.zeros,
+                                   (b, self.max_len, self.n_kv_heads),
+                                   jnp.float32)
+                sv = self.variable("cache", "v_scale", jnp.zeros,
+                                   (b, self.max_len, self.n_kv_heads),
+                                   jnp.float32)
             if not is_live:
                 # init trace: local attention for output shape only
                 kk = jnp.repeat(k, rep, axis=2)
@@ -304,10 +318,37 @@ class _DecoderAttention(nn.Module):
                 # identical values — harmless by construction.
                 t = positions  # (b, s) — per-slot, per-token write index
                 rows = jnp.arange(b)[:, None]
-                ck.value = ck.value.at[rows, t].set(k)
-                cv.value = cv.value.at[rows, t].set(v)
-                kk = jnp.repeat(ck.value, rep, axis=2)
-                vv = jnp.repeat(cv.value, rep, axis=2)
+                if self.kv_int8:
+                    def q8(u):
+                        scale = jnp.maximum(
+                            jnp.max(jnp.abs(u.astype(jnp.float32)), -1),
+                            1e-8) / 127.0
+                        qv = jnp.clip(jnp.round(
+                            u.astype(jnp.float32) / scale[..., None]),
+                            -127, 127).astype(jnp.int8)
+                        return qv, scale
+
+                    qk_, sk_ = q8(k)
+                    qv_, sv_ = q8(v)
+                    ck.value = ck.value.at[rows, t].set(qk_)
+                    cv.value = cv.value.at[rows, t].set(qv_)
+                    sk.value = sk.value.at[rows, t].set(sk_)
+                    sv.value = sv.value.at[rows, t].set(sv_)
+                    # multiply in f32 and cast the PRODUCT: casting the
+                    # scales to bf16 first would throw away the very
+                    # precision their f32 storage pays for (XLA fuses
+                    # this into the attention einsum either way)
+                    deq_k = (ck.value.astype(jnp.float32)
+                             * sk.value[..., None]).astype(x.dtype)
+                    deq_v = (cv.value.astype(jnp.float32)
+                             * sv.value[..., None]).astype(x.dtype)
+                    kk = jnp.repeat(deq_k, rep, axis=2)
+                    vv = jnp.repeat(deq_v, rep, axis=2)
+                else:
+                    ck.value = ck.value.at[rows, t].set(k)
+                    cv.value = cv.value.at[rows, t].set(v)
+                    kk = jnp.repeat(ck.value, rep, axis=2)
+                    vv = jnp.repeat(cv.value, rep, axis=2)
                 scores = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / np.sqrt(dh)
                 k_pos = jnp.arange(self.max_len)[None, None, None, :]
                 scores = jnp.where(k_pos <= t[:, None, :, None],
@@ -368,6 +409,7 @@ class _DecoderBlock(nn.Module):
     seq_axis: Optional[str] = None
     rope_theta: float = 10000.0
     rope_scaling: Optional[Tuple[float, float, float, float]] = None
+    kv_int8: bool = False  # serving-only int8 KV cache
 
     @nn.compact
     def __call__(self, x, lens, positions, decode, adapter_ids=None):
@@ -376,6 +418,7 @@ class _DecoderBlock(nn.Module):
             quantized=self.quantized, n_adapters=self.n_adapters,
             seq_mesh=self.seq_mesh, seq_axis=self.seq_axis,
             rope_theta=self.rope_theta, rope_scaling=self.rope_scaling,
+            kv_int8=self.kv_int8,
             name="attn")(RMSNorm()(x), lens, positions, decode,
                          adapter_ids)
         y = RMSNorm()(x)
@@ -447,6 +490,10 @@ class Llama(nn.Module):
     # original_max_position_embeddings); None = unscaled (hashable —
     # dicts can't be flax module fields)
     rope_scaling: Optional[Tuple[float, float, float, float]] = None
+    # serving-only int8 KV cache (decode path; see _DecoderAttention.
+    # kv_int8): half the decode cache's HBM at bf16, bounded
+    # quantization error. Training/eval never touch the decode branch.
+    kv_int8: bool = False
 
     @nn.compact
     def __call__(self, ids: jnp.ndarray, lens: Optional[jnp.ndarray] = None,
@@ -479,6 +526,7 @@ class Llama(nn.Module):
                           seq_mesh=self.seq_mesh, seq_axis=self.seq_axis,
                           rope_theta=self.rope_theta,
                           rope_scaling=self.rope_scaling,
+                          kv_int8=self.kv_int8,
                           name=f"block_{i}")(x, lens, positions, decode,
                                              adapter_ids)
         x = RMSNorm(name="final_norm")(x)
@@ -876,6 +924,11 @@ class LlamaLoRA(BaseModel):
             # only — training and evaluate() (the tuning objective)
             # stay full precision.
             "quantize_int8": FixedKnob(False),
+            # serving-only int8 KV cache: halves decode-cache HBM at
+            # bf16 (more slots / longer contexts per chip) for a
+            # bounded per-vector quantization error; generations are
+            # no longer bit-identical to the f32-cache engine
+            "kv_cache_int8": FixedKnob(False),
             # RoPE base frequency; match the pretrained checkpoint
             # (Llama-1/2: 10000, Llama-3: 500000). A wrong theta loads
             # cleanly but generates garbage.
@@ -933,7 +986,8 @@ class LlamaLoRA(BaseModel):
                      rope_theta=float(k.get("rope_theta", 10000.0)
                                       or 10000.0),
                      rope_scaling=_parse_rope_scaling(
-                         k.get("rope_scaling", "")))
+                         k.get("rope_scaling", "")),
+                     kv_int8=bool(k.get("kv_cache_int8", False)))
 
     def _serving_module_params(self) -> Tuple[Llama, Any]:
         """(module, params) for predict()/make_decode_engine — the int8

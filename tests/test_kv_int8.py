@@ -1,0 +1,152 @@
+"""Serving-only int8 KV cache (kv_cache_int8 knob): half the decode
+cache's HBM at bf16, bounded quantization error; engine-vs-oracle
+exactness holds WITHIN the quantized world (both run the same module)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rafiki_tpu.models.llama_lora import LlamaLoRA, greedy_generate
+
+from test_decode_engine import KNOBS, trained  # noqa: F401 — fixture
+
+
+def test_kv_int8_cache_dtype_and_size(trained):  # noqa: F811
+    m8 = LlamaLoRA(**{**KNOBS, "kv_cache_int8": True})
+    m8.load_parameters(trained.dump_parameters())
+    eng = m8.make_decode_engine(max_slots=4, max_new_tokens=4)
+    cache = eng.engine._cache
+    leaves = {"/".join(str(getattr(k, "key", k)) for k in kp): v
+              for kp, v in
+              jax.tree_util.tree_leaves_with_path(cache)}
+    k_leaves = [v for p, v in leaves.items() if p.endswith("/k")]
+    s_leaves = [v for p, v in leaves.items() if p.endswith("/k_scale")]
+    assert k_leaves and all(v.dtype == jnp.int8 for v in k_leaves)
+    assert s_leaves and all(v.dtype == jnp.float32 for v in s_leaves)
+    # per-layer KV bytes: int8 + scales < half of the f32 cache
+    f32 = trained.make_decode_engine(max_slots=4, max_new_tokens=4)
+    def nbytes(c):
+        return sum(int(np.prod(v.shape)) * v.dtype.itemsize
+                   for v in jax.tree_util.tree_leaves(c))
+    assert nbytes(cache) < 0.5 * nbytes(f32.engine._cache)
+
+
+def test_kv_int8_engine_matches_its_own_oracle(trained):  # noqa: F811
+    """The engine and greedy_generate run the SAME int8-cache module,
+    so serving must be token-identical to the oracle — exactness within
+    the quantized world."""
+    m8 = LlamaLoRA(**{**KNOBS, "kv_cache_int8": True})
+    m8.load_parameters(trained.dump_parameters())
+    module = m8._module()
+    assert module.kv_int8
+    prompts = [np.asarray([1, 5, 9, 13], np.int32),
+               np.asarray([2, 7], np.int32)]
+    width = max(len(p) for p in prompts)
+    ids = np.zeros((2, width), np.int32)
+    lens = np.zeros((2,), np.int32)
+    for i, p in enumerate(prompts):
+        ids[i, :len(p)] = p
+        lens[i] = len(p)
+    ref = np.asarray(greedy_generate(module, m8._params, ids, lens, 6))
+
+    eng = m8.make_decode_engine(max_slots=2, max_new_tokens=6,
+                                steps_per_sync=2, prefill_chunk=4)
+    for i, p in enumerate(prompts):
+        eng.engine.submit(("r", i), p, 6)
+    got = {}
+    for _ in range(300):
+        if not eng.busy:
+            break
+        eng.engine.step()
+        for rid, toks in eng.engine.poll():
+            got[rid] = toks
+    for i in range(2):
+        assert got[("r", i)] == [int(t) for t in ref[i]], i
+
+
+def test_kv_int8_logits_close_to_f32_cache(trained):  # noqa: F811
+    """Quantization error is bounded: next-token logits through the
+    int8 decode cache stay close to the f32-cache decode path on the
+    same weights (same inputs, short context)."""
+    m8 = LlamaLoRA(**{**KNOBS, "kv_cache_int8": True})
+    m8.load_parameters(trained.dump_parameters())
+    mod8 = m8._module()
+    mod32 = trained._module()
+    params = trained._params
+
+    ids = np.asarray([[3, 1, 4, 1, 5, 9, 2, 6]], np.int32)
+    pos = np.arange(8, dtype=np.int32)[None, :]
+
+    def decode_logits(module):
+        cache = module.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 1), jnp.int32),
+                            decode=True)["cache"]
+        out, _ = module.apply({"params": params, "cache": cache},
+                              jnp.asarray(ids),
+                              positions=jnp.asarray(pos), decode=True,
+                              mutable=["cache"])
+        return np.asarray(out[:, -1], np.float32)
+
+    l8, l32 = decode_logits(mod8), decode_logits(mod32)
+    denom = max(1e-6, float(np.max(np.abs(l32))))
+    assert float(np.max(np.abs(l8 - l32))) / denom < 0.05, \
+        np.max(np.abs(l8 - l32))
+
+
+def test_kv_int8_composes_with_prefix_cache(trained):  # noqa: F811
+    """Prefix snapshots trim/install per-leaf: the int8 cache's extra
+    scale leaves ride the same machinery, and hits stay exact vs the
+    no-prefix int8 engine."""
+    m8 = LlamaLoRA(**{**KNOBS, "kv_cache_int8": True})
+    m8.load_parameters(trained.dump_parameters())
+    prefix = np.asarray([3, 1, 4, 1], np.int32)
+    prompt = np.concatenate([prefix, np.asarray([5, 9], np.int32)])
+
+    def run(register):
+        eng = m8.make_decode_engine(max_slots=2, max_new_tokens=5,
+                                    prefill_chunk=2)
+        if register:
+            assert eng.engine.register_prefix(prefix) == len(prefix)
+        eng.engine.submit("r", prompt, 5)
+        for _ in range(300):
+            if not eng.busy:
+                break
+            eng.engine.step()
+            done = eng.engine.poll()
+            if done:
+                return done[0][1], eng.engine.stats
+        raise AssertionError("no drain")
+
+    plain, _ = run(False)
+    hit, stats = run(True)
+    assert stats["prefix_hits"] == 1
+    assert hit == plain
+
+
+def test_kv_int8_composes_with_weight_int8_and_speculation(trained):  # noqa: F811
+    """The doc-claimed compositions: kv_cache_int8 + quantize_int8
+    serve together (int8 weights AND int8 cache), and speculation on
+    an int8-cache engine stays exact vs the same engine without it."""
+    m = LlamaLoRA(**{**KNOBS, "kv_cache_int8": True,
+                     "quantize_int8": True})
+    m.load_parameters(trained.dump_parameters())
+    module, _ = m._serving_module_params()
+    assert module.quantized and module.kv_int8
+
+    def run(spec_k):
+        eng = m.make_decode_engine(max_slots=2, max_new_tokens=6,
+                                   speculate_k=spec_k)
+        eng.engine.submit("r", np.asarray([1, 5, 9, 1, 5], np.int32), 6)
+        for _ in range(300):
+            if not eng.busy:
+                break
+            eng.engine.step()
+            done = eng.engine.poll()
+            if done:
+                return done[0][1], dict(eng.engine.stats)
+        raise AssertionError("no drain")
+
+    plain, _ = run(0)
+    spec, stats = run(4)
+    assert spec == plain  # speculation lossless on the int8 engine
+    assert stats["spec_calls"] > 0
