@@ -541,6 +541,20 @@ class Llama(nn.Module):
                          name="lm_head")(x)
 
 
+def lm_valid_mask(seq_len: int, lens: jnp.ndarray,
+                  example_mask: Optional[jnp.ndarray] = None
+                  ) -> jnp.ndarray:
+    """(B, L) bool: positions whose next-token loss counts — before
+    each example's last real token, in unmasked examples. THE masking
+    rule: the loss terms, the chunked loss, and gradient accumulation's
+    global denominator must all agree on it."""
+    pos = jnp.arange(seq_len)[None, :]
+    valid = pos < (lens[:, None] - 1)
+    if example_mask is not None:
+        valid = valid & (example_mask[:, None] > 0)
+    return valid
+
+
 def lm_loss_terms(logits: jnp.ndarray, ids: jnp.ndarray,
                   lens: jnp.ndarray,
                   example_mask: Optional[jnp.ndarray] = None
@@ -552,10 +566,7 @@ def lm_loss_terms(logits: jnp.ndarray, ids: jnp.ndarray,
     excluded. One implementation shared by train/evaluate/dry-run.
     """
     targets = jnp.pad(ids[:, 1:], ((0, 0), (0, 1)))
-    pos = jnp.arange(ids.shape[1])[None, :]
-    valid = pos < (lens[:, None] - 1)
-    if example_mask is not None:
-        valid = valid & (example_mask[:, None] > 0)
+    valid = lm_valid_mask(ids.shape[1], lens, example_mask)
     losses = optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(jnp.float32), targets)
     return jnp.sum(losses * valid), jnp.sum(valid)
@@ -587,10 +598,7 @@ def chunked_lm_loss_terms(hidden: jnp.ndarray, head_kernel: jnp.ndarray,
     """
     b, length, d = hidden.shape
     targets = jnp.pad(ids[:, 1:], ((0, 0), (0, 1)))
-    pos = jnp.arange(length)[None, :]
-    valid = pos < (lens[:, None] - 1)
-    if example_mask is not None:
-        valid = valid & (example_mask[:, None] > 0)
+    valid = lm_valid_mask(length, lens, example_mask)
     count = jnp.sum(valid)
 
     chunk = max(1, min(int(chunk), length))
@@ -924,6 +932,12 @@ class LlamaLoRA(BaseModel):
             # only — training and evaluate() (the tuning objective)
             # stay full precision.
             "quantize_int8": FixedKnob(False),
+            # >1 accumulates gradients over this many micro-batches
+            # before each optimizer step (lax.scan) — big-batch math
+            # exactly, one micro-batch's activations in HBM at a time.
+            # Mutually exclusive with pipeline_stages>1 (GPipe already
+            # microbatches); batch_size rounds to a multiple.
+            "grad_accum": FixedKnob(1),
             # serving-only int8 KV cache: halves decode-cache HBM at
             # bf16 (more slots / longer contexts per chip) for a
             # bounded per-vector quantization error; generations are
@@ -1115,6 +1129,11 @@ class LlamaLoRA(BaseModel):
                 np.array(devices, dtype=object).reshape(
                     pp_stages, len(devices) // pp_stages),
                 ("pipe", "data"))
+        grad_accum = int(self.knobs.get("grad_accum", 1) or 1)
+        if grad_accum > 1 and pp_stages > 1:
+            raise ValueError(
+                "grad_accum>1 is redundant with pipeline_stages>1 "
+                "(GPipe already microbatches the step)")
         n_experts = int(self.knobs.get("moe_experts", 0))
         if n_experts and pp_stages > 1:
             raise ValueError("pipeline_stages>1 does not support MoE "
@@ -1146,6 +1165,10 @@ class LlamaLoRA(BaseModel):
             # n_micro microbatches, each batch-sharded over `data`
             # (size devices/pp) → batch must divide by both
             q = int(np.lcm(n_micro, len(devices)))
+            batch_size = max(q, batch_size - batch_size % q)
+        if grad_accum > 1:
+            # each micro-batch still batch-shards over `data`
+            q = grad_accum * n_data
             batch_size = max(q, batch_size - batch_size % q)
 
         pretrained = str(self.knobs.get("pretrained_path") or "")
@@ -1302,8 +1325,68 @@ class LlamaLoRA(BaseModel):
             raise ValueError("loss_chunk>0 is not supported with "
                              "pipeline_stages>1")
 
+        def micro_terms(p, ib, lb, mask):
+            # (loss-sum, valid-count, moe-aux) over one (micro)batch —
+            # shared by the plain step and gradient accumulation
+            if loss_chunk:
+                # streamed loss: forward stops at the final norm; the
+                # lm_head projection + CE run chunk-by-chunk so
+                # (B, L, vocab) logits never exist in HBM
+                hidden, muts = module.apply(
+                    {"params": p}, ib, lens=lb, mutable=["losses"],
+                    return_hidden=True)
+                aux = moe_aux_loss(muts)
+                total, count = chunked_lm_loss_terms(
+                    hidden, p["lm_head"]["kernel"], ib, lb, mask,
+                    chunk=loss_chunk)
+            else:
+                # mutable=["losses"]: MoE blocks sow their load-
+                # balance aux there; dense models sow nothing
+                logits, muts = module.apply(
+                    {"params": p}, ib, lens=lb, mutable=["losses"])
+                aux = moe_aux_loss(muts)
+                total, count = lm_loss_terms(logits, ib, lb, mask)
+            return total, count, aux
+
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def train_step(params, opt_state, ib, lb, mask):
+            if grad_accum > 1:
+                # gradient accumulation: scan grad_accum micro-batches,
+                # summing gradients before ONE optimizer step. The CE
+                # term is EXACTLY the big-batch math: the global valid-
+                # token count is model-independent, so each micro-
+                # batch's objective is total_i / global_count — summed
+                # grads == grads of the full-batch loss. The MoE aux
+                # (when moe_experts > 0) is computed per micro-batch
+                # and averaged — standard practice, but router capacity
+                # and load statistics then see T/grad_accum tokens, so
+                # that term is NOT bit-identical to one big-batch apply.
+                b, seq = ib.shape
+                denom = jnp.maximum(jnp.sum(
+                    lm_valid_mask(seq, lb, mask)).astype(jnp.float32),
+                    1.0)
+                mbs = (ib.reshape(grad_accum, b // grad_accum, seq),
+                       lb.reshape(grad_accum, b // grad_accum),
+                       mask.reshape(grad_accum, b // grad_accum))
+
+                def obj(p, i, l, m):
+                    total, _, aux = micro_terms(p, i, l, m)
+                    return (total / denom
+                            + MOE_AUX_COEF * aux / grad_accum)
+
+                def body(carry, xs):
+                    gacc, lacc = carry
+                    val, g = jax.value_and_grad(obj)(params, *xs)
+                    return (jax.tree_util.tree_map(jnp.add, gacc, g),
+                            lacc + val), None
+
+                zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+                (grads, loss), _ = jax.lax.scan(
+                    body, (zeros, jnp.asarray(0.0, jnp.float32)), mbs)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                return (optax.apply_updates(params, updates), opt_state,
+                        loss)
+
             def loss_fn(p):
                 if mesh_pp is not None:
                     # decoder blocks pipelined over the `pipe` axis —
@@ -1314,24 +1397,8 @@ class LlamaLoRA(BaseModel):
                         remat=use_remat, batch_axis="data")
                     aux = jnp.asarray(0.0, jnp.float32)
                     total, count = lm_loss_terms(logits, ib, lb, mask)
-                elif loss_chunk:
-                    # streamed loss: forward stops at the final norm;
-                    # the lm_head projection + CE run chunk-by-chunk so
-                    # (B, L, vocab) logits never exist in HBM
-                    hidden, muts = module.apply(
-                        {"params": p}, ib, lens=lb, mutable=["losses"],
-                        return_hidden=True)
-                    aux = moe_aux_loss(muts)
-                    total, count = chunked_lm_loss_terms(
-                        hidden, p["lm_head"]["kernel"], ib, lb, mask,
-                        chunk=loss_chunk)
                 else:
-                    # mutable=["losses"]: MoE blocks sow their load-
-                    # balance aux there; dense models sow nothing
-                    logits, muts = module.apply(
-                        {"params": p}, ib, lens=lb, mutable=["losses"])
-                    aux = moe_aux_loss(muts)
-                    total, count = lm_loss_terms(logits, ib, lb, mask)
+                    total, count, aux = micro_terms(p, ib, lb, mask)
                 return (total / jnp.maximum(count, 1.0)
                         + MOE_AUX_COEF * aux)
 
