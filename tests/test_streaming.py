@@ -91,15 +91,12 @@ def test_text_stream_withholds_incomplete_utf8():
     assert eng.poll_partial() == []
 
 
-@pytest.mark.slow
-def test_predict_stream_through_stack(trained):  # noqa: F811
-    """predict_stream events through the real worker decode loop: delta
-    events accumulate to exactly the final predictions, and the final
-    text equals what the non-streaming path returns for the same greedy
-    request."""
+def _stream_through_stack(trained, hub):
+    """Shared body: predict_stream over a real worker decode loop on
+    the given hub — deltas accumulate to exactly the final predictions,
+    which equal the non-streaming answer for the same greedy request."""
     store = ParamStore.from_uri("mem://")
     store.save("t0", trained.dump_parameters())
-    hub = InProcQueueHub()
     worker = InferenceWorker(LlamaLoRA, "t0", KNOBS, store, hub, "w0",
                              decode_loop=True, max_slots=4,
                              max_new_tokens=6)
@@ -129,6 +126,11 @@ def test_predict_stream_through_stack(trained):  # noqa: F811
     finally:
         worker.stop()
         wt.join(timeout=10)
+
+
+@pytest.mark.slow
+def test_predict_stream_through_stack(trained):  # noqa: F811
+    _stream_through_stack(trained, InProcQueueHub())
 
 
 @pytest.mark.slow
@@ -164,3 +166,16 @@ def test_predict_stream_sse_http_and_client(trained):  # noqa: F811
         svc.stop()
         worker.stop()
         wt.join(timeout=10)
+
+
+@pytest.mark.slow
+def test_predict_stream_over_native_kv_transport(trained):  # noqa: F811
+    """Same contract over the native rafiki-kvd RESP transport:
+    per-query FIFO holds and the armed TTL tolerates the extra partial
+    messages (one shared body with the in-proc leg)."""
+    from rafiki_tpu.native import KVServer
+    from rafiki_tpu.serving.queues import KVQueueHub
+
+    with KVServer() as server:
+        _stream_through_stack(trained, KVQueueHub(server.host,
+                                                  server.port))
