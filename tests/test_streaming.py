@@ -179,3 +179,75 @@ def test_predict_stream_over_native_kv_transport(trained):  # noqa: F811
     with KVServer() as server:
         _stream_through_stack(trained, KVQueueHub(server.host,
                                                   server.port))
+
+
+class _ScriptedHub:
+    """Minimal hub double: returns a scripted sequence of reply
+    payloads for pop_prediction; records pushes/discards."""
+
+    def __init__(self, replies):
+        from rafiki_tpu.serving.queues import pack_message
+
+        self._replies = [pack_message(r) for r in replies]
+        self.pushed = []
+        self.discarded = []
+
+    def arm_reply_ttl(self, qid, ttl):
+        pass
+
+    def push_query(self, wid, msg):
+        self.pushed.append((wid, msg))
+
+    def pop_prediction(self, qid, timeout):
+        if self._replies:
+            return self._replies.pop(0)
+        import time as _time
+
+        _time.sleep(min(timeout, 0.005))  # mirror real blocking pops
+        return None
+
+    def discard_prediction_queue(self, qid):
+        self.discarded.append(qid)
+
+
+def test_predict_stream_terminal_contract_replace_error_timeout():
+    """The documented event contract, exercised branch by branch:
+    a diverging final text arrives as a REPLACE event (never a delta a
+    concatenating client would double-count); worker errors and
+    timeouts both end in done events carrying the accumulated partial
+    text; the reply queue is discarded in every outcome."""
+    # replace: final text does NOT extend the streamed prefix
+    hub = _ScriptedHub([
+        {"id": "x", "worker_id": "w0", "delta": {"0": "abc"}},
+        {"id": "x", "worker_id": "w0", "predictions": ["zzz"]}])
+    pred = Predictor(hub, ["w0"], gather_timeout=5.0)
+    events = list(pred.predict_stream(["q"]))
+    kinds = [next(iter(e)) for e in events]
+    assert kinds == ["delta", "replace", "done"]
+    assert events[1]["replace"] == {"0": "zzz"}
+    assert events[-1]["predictions"] == ["zzz"]
+    assert hub.discarded, "reply queue must be discarded"
+
+    # worker error: done carries the error AND the partial text
+    hub = _ScriptedHub([
+        {"id": "x", "worker_id": "w0", "delta": {"0": "par"}},
+        {"id": "x", "worker_id": "w0", "predictions": [],
+         "error": "boom"}])
+    events = list(Predictor(hub, ["w0"],
+                            gather_timeout=5.0).predict_stream(["q"]))
+    final = events[-1]
+    assert final["done"] and final["error"] == "boom"
+    assert final["partial"] == ["par"]
+    assert hub.discarded
+
+    # timeout: same terminal shape. Streams default to STREAM_TIMEOUT
+    # (minutes — gather_timeout is a unary bound), so pass an explicit
+    # per-request deadline
+    hub = _ScriptedHub([
+        {"id": "x", "worker_id": "w0", "delta": {"0": "pa"}}])
+    events = list(Predictor(hub, ["w0"], gather_timeout=5.0)
+                  .predict_stream(["q"], timeout=0.05))
+    final = events[-1]
+    assert final["done"] and "timed out" in final["error"]
+    assert final["partial"] == ["pa"]
+    assert hub.discarded
