@@ -21,7 +21,7 @@ TINY = {"max_epochs": 6, "vocab_size": 1 << 14, "hidden_dim": 64,
         "pipeline_microbatches": 0, "loss_chunk": 0,
         "quantize_int8": False, "sequence_parallel": 1,
         "adapters_only": False, "rope_theta": 10000.0,
-        "rope_scaling": "",
+        "rope_scaling": "", "grad_accum": 1, "kv_cache_int8": False,
         "quick_train": False,
         "share_params": False, "tokenizer_path": "", "pretrained_path": ""}
 
@@ -29,6 +29,15 @@ TINY = {"max_epochs": 6, "vocab_size": 1 << 14, "hidden_dim": 64,
 def _tiny_module(vocab=256, max_len=16, rank=2):
     return Llama(vocab_size=vocab, max_len=max_len, hidden_dim=32, depth=2,
                  n_heads=4, n_kv_heads=2, mlp_dim=64, lora_rank=rank)
+
+
+def test_tiny_covers_every_knob():
+    """TINY must be a FULL knob assignment: the slow template-contract
+    test validates completeness, and a knob added without updating
+    TINY fails only there — this default-leg guard surfaces the gap
+    immediately instead."""
+    missing = set(LlamaLoRA.get_knob_config()) - set(TINY)
+    assert not missing, sorted(missing)
 
 
 def test_llama_module_shapes():
