@@ -50,6 +50,21 @@ def batch_sharding(mesh):
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
+def pad_batch_to_axis(x, mesh, axis=None):
+    """Tile/slice ``x``'s leading dim up to the next multiple of a mesh
+    axis size (default ``data``) so it can shard over it. One place for
+    the round-up arithmetic the dryrun legs and tests need."""
+    import jax.numpy as jnp
+
+    n = mesh.shape[DATA_AXIS if axis is None else axis]
+    b = x.shape[0]
+    if b % n == 0:
+        return x
+    target = -(-b // n) * n
+    reps = -(-target // b)
+    return jnp.concatenate([x] * reps, axis=0)[:target]
+
+
 def replicated(mesh):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
