@@ -210,3 +210,31 @@ def test_tile_shape_nd_boxes():
     assert max(t) <= 4
     with pytest.raises(ValueError):
         _tile_shape_nd((3, 5), 7)
+
+
+def test_pad_batch_to_axis():
+    """Leading-dim round-up to the mesh data axis: exact multiples pass
+    through untouched; everything else tiles up to the next multiple
+    with repeated rows."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from rafiki_tpu.parallel.sharding import pad_batch_to_axis
+
+    import numpy as np
+
+    mesh = Mesh(np.array(jax.devices()[:6]).reshape(3, 2),
+                ("data", "model"))
+    x = jnp.arange(8 * 2, dtype=jnp.float32).reshape(8, 2)
+    out = pad_batch_to_axis(x, mesh)
+    assert out.shape == (9, 2)  # next multiple of data=3
+    np.testing.assert_array_equal(np.asarray(out[:8]), np.asarray(x))
+    np.testing.assert_array_equal(np.asarray(out[8]), np.asarray(x[0]))
+    # exact multiple: identity
+    x6 = jnp.ones((6, 2))
+    assert pad_batch_to_axis(x6, mesh) is x6
+    # data axis larger than the batch
+    mesh8 = Mesh(np.array(jax.devices()[:8]).reshape(8, 1),
+                 ("data", "model"))
+    assert pad_batch_to_axis(x, mesh8).shape == (16, 2)
