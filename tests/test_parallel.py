@@ -234,7 +234,8 @@ def test_pad_batch_to_axis():
     # exact multiple: identity
     x6 = jnp.ones((6, 2))
     assert pad_batch_to_axis(x6, mesh) is x6
-    # data axis larger than the batch
+    # data axis larger than the batch: tile up to one full multiple
     mesh8 = Mesh(np.array(jax.devices()[:8]).reshape(8, 1),
                  ("data", "model"))
-    assert pad_batch_to_axis(x, mesh8).shape == (16, 2)
+    out8 = pad_batch_to_axis(jnp.ones((3, 2)), mesh8)
+    assert out8.shape == (8, 2)
