@@ -196,7 +196,8 @@ def test_full_stack_multi_adapter_deploy(stack):
     assert sum(t["status"] == "COMPLETED" for t in trials) >= 2, trials
 
     ijob = client.create_inference_job(
-        job["id"], max_workers=2, budget={"MULTI_ADAPTER": 1})
+        job["id"], max_workers=2,
+        budget={"MULTI_ADAPTER": 1, "ADAPTIVE_GATHER": 1})
     assert ijob["predictor_url"]
     p0 = client.predict(ijob["predictor_url"], ["tok1 tok2 tok3"],
                         timeout=180, sampling={"adapter_id": 0})
@@ -213,6 +214,9 @@ def test_full_stack_multi_adapter_deploy(stack):
             break
         _time.sleep(0.5)
     assert len(health.get("workers") or {}) == 1, health
+    # the ADAPTIVE_GATHER budget flag reached the spawned predictor
+    assert health.get("adaptive_gather") is True, health
+    assert "gather_deadline_s" in health
     # out-of-range tenant ids are rejected, not silently misrouted
     import pytest as _pytest
     with _pytest.raises(RuntimeError):
