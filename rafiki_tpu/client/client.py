@@ -150,7 +150,9 @@ class Client:
                 timeout: Optional[float] = None,
                 sampling: Optional[Dict[str, Any]] = None) -> List[Any]:
         """``sampling`` (generation jobs): {temperature, top_k, top_p,
-        seed, eos_id} forwarded to the decode loop; omit for greedy."""
+        seed, eos_id, max_new, adapter_id} forwarded to the decode
+        loop; omit for greedy defaults. ``max_new`` is clamped by the
+        worker's configured cap."""
         body: Dict[str, Any] = {"queries": _jsonable(queries)}
         if timeout is not None:
             body["timeout"] = timeout

@@ -129,8 +129,10 @@ class Predictor:
                 sampling: Optional[Dict] = None) -> Tuple[List[Any], Dict]:
         """Returns (ensembled predictions, info dict). ``sampling``
         (generation jobs only) rides with the message to the decode
-        loop: {temperature, top_k, top_p, seed, eos_id} — seeded draws are
-        reproducible per (seed, position) regardless of serving load."""
+        loop: {temperature, top_k, top_p, seed, eos_id, max_new,
+        adapter_id} — seeded draws are reproducible per
+        (seed, position) regardless of serving load; max_new is
+        clamped by the worker's configured cap."""
         t0 = time.monotonic()
         adaptive = timeout is None and self.adaptive_gather
         timeout = self._gather_deadline_s() if timeout is None else timeout

@@ -280,6 +280,17 @@ class InferenceWorker:
                          "predictions": []}))
                 else:
                     samp = _safe_sampling(m.get("sampling"))
+                    if "max_new" in samp:
+                        # per-request generation length, clamped by the
+                        # worker's configured cap: a client must not be
+                        # able to occupy a slot for longer than the
+                        # operator budgeted. getattr: duck-typed user
+                        # engines without a cap must not let a client
+                        # field kill the serve thread
+                        samp["max_new"] = min(
+                            samp["max_new"],
+                            getattr(self.engine, "max_new",
+                                    samp["max_new"]))
                     try:
                         for qi, text in enumerate(qs):
                             self.engine.submit((m["id"], qi), str(text),
@@ -417,6 +428,9 @@ def _safe_sampling(samp: Any) -> dict:
         # error reply; silently mapping -1 to adapter 0 would be the
         # correct-looking wrong-tenant answer the validation exists for
         out["adapter_id"] = aid
+    mn = num("max_new", int, 0)  # per-request generation length; the
+    if mn and mn > 0:            # worker clamps to its configured cap
+        out["max_new"] = mn      # (capacity protection) at submit time
     return out
 
 

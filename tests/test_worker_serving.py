@@ -528,3 +528,44 @@ def test_adaptive_gather_recovers_from_fleet_slowdown():
     finally:
         stop.set()
         t.join(timeout=5)
+
+
+def test_per_request_max_new_clamped():
+    """Clients control generation length via sampling.max_new, clamped
+    by the worker's configured cap (slot-occupancy protection)."""
+    import threading
+
+    from rafiki_tpu.models.llama_lora import LlamaLoRA
+    from rafiki_tpu.serving.queues import InProcQueueHub
+    from test_decode_engine import KNOBS as LM_KNOBS
+
+    from rafiki_tpu.data import generate_text_classification_dataset
+    import tempfile, os
+    d = tempfile.mkdtemp()
+    tr = os.path.join(d, "t.jsonl")
+    generate_text_classification_dataset(tr, 48, seed=0)
+    m = LlamaLoRA(**LM_KNOBS)
+    m.train(tr)
+    store = ParamStore.from_uri("mem://")
+    store.save("lm0", m.dump_parameters())
+    hub = InProcQueueHub()
+    worker = InferenceWorker(LlamaLoRA, "lm0", LM_KNOBS, store, hub,
+                             "w0", decode_loop=True, max_slots=4,
+                             max_new_tokens=6)
+    wt = threading.Thread(target=worker.run, daemon=True)
+    wt.start()
+    try:
+        pred = Predictor(hub, ["w0"], gather_timeout=120.0)
+        short, _ = pred.predict(["tok1 tok2 tok3"],
+                                sampling={"max_new": 2})
+        capped, _ = pred.predict(["tok1 tok2 tok3"],
+                                 sampling={"max_new": 50})
+        default, _ = pred.predict(["tok1 tok2 tok3"])
+        assert len(short[0].split()) == 2, short
+        assert len(capped[0].split()) == 6, capped  # clamped to cap
+        assert len(default[0].split()) == 6, default
+        # the short answer is a prefix of the greedy default
+        assert default[0].startswith(short[0])
+    finally:
+        worker.stop()
+        wt.join(timeout=10)
