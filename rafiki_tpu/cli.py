@@ -173,8 +173,21 @@ def _doctor() -> int:
     if path is None:
         row(True, "compile cache", f"disabled by {CACHE_ENV}")
     else:
-        row(os.path.isdir(path) or os.access(
-            os.path.dirname(path) or ".", os.W_OK),
+        # the dir (and its parents, e.g. ~/.cache/rafiki_tpu on a fresh
+        # host) may not exist yet — apply_platform_env's makedirs will
+        # create the whole chain, so test W_OK at the nearest EXISTING
+        # ancestor rather than warning spuriously
+        probe = path  # start at the path ITSELF: it may be a plain file
+        blocked = False  # a FILE at any level blocks makedirs
+        while probe and not os.path.isdir(probe):
+            if os.path.exists(probe):
+                blocked = True
+                break
+            parent = os.path.dirname(probe)
+            if parent == probe:
+                break
+            probe = parent
+        row(not blocked and os.access(probe or ".", os.W_OK),
             "compile cache", path, fatal=False)
     print("all checks passed" if ok else "SOME CHECKS FAILED")
     return 0 if ok else 1

@@ -176,18 +176,25 @@ class Client:
         append), then one ``{"done": True, "predictions": [...]}`` (or
         done+error). Every stream ends with a done event. Only
         meaningful against generation (decode-loop) inference jobs."""
-        from ..utils.http import sse_request
+        from ..utils.http import STREAM_BUDGET_S, sse_request
 
         body: Dict[str, Any] = {"queries": _jsonable(queries)}
         if timeout is not None:
             body["timeout"] = timeout
         if sampling:
             body["sampling"] = sampling
-        sock_timeout = self.timeout if timeout is None else \
-            max(self.timeout, timeout + 30.0)
+        # a request queued behind busy decode slots can legitimately
+        # produce no deltas until near the server's WHOLE-stream budget
+        # — so with no explicit timeout, size the per-EVENT wait to the
+        # server's stream budget (every stream ends with a terminal
+        # done event within it), not the unary self.timeout. Connection
+        # establishment keeps the short self.timeout: a down host must
+        # fail fast, not after the stream budget.
+        server_budget = STREAM_BUDGET_S if timeout is None else timeout
         yield from sse_request(
             "POST", f"{predictor_url.rstrip('/')}/predict_stream",
-            body, timeout=sock_timeout)
+            body, timeout=self.timeout,
+            read_timeout=max(self.timeout, server_budget + 30.0))
 
 
 def _jsonable(queries: Sequence[Any]) -> List[Any]:

@@ -20,7 +20,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..utils.http import JsonHttpService, StreamResponse
+from ..utils.http import STREAM_BUDGET_S, JsonHttpService, StreamResponse
 from .queues import (EXPIRY_SKEW_TOLERANCE_S, QueueHub, pack_message,
                      unpack_message)
 
@@ -71,8 +71,10 @@ class Predictor:
     #: stable p50/p95/p99, small enough to sort on every stats() call
     LATENCY_WINDOW = 2048
     #: default whole-stream deadline for predict_stream — generations
-    #: run for minutes; gather_timeout is a unary-RPC bound
-    STREAM_TIMEOUT = 300.0
+    #: run for minutes; gather_timeout is a unary-RPC bound. Shared
+    #: with the client SDK via utils.http (it sizes per-event socket
+    #: timeouts to this budget).
+    STREAM_TIMEOUT = STREAM_BUDGET_S
 
     def __init__(self, hub: QueueHub, worker_ids: Sequence[str],
                  gather_timeout: float = 10.0,
@@ -109,6 +111,12 @@ class Predictor:
         self._latencies: "collections.deque[float]" = collections.deque(
             maxlen=self.LATENCY_WINDOW)
         self._rr = 0  # round-robin cursor for single-worker streams
+        #: consecutive zero-answer adaptive gathers — drives the
+        #: escalating recovery below (a single penalty sample per miss
+        #: needs ~0.05·WINDOW misses to move the p95 past a window of
+        #: stale fast samples; a fleet-wide slowdown must relearn in a
+        #: few requests, not ~100)
+        self._gather_misses = 0
         self._lock = threading.Lock()
 
     def _gather_deadline_s(self) -> float:
@@ -197,12 +205,38 @@ class Predictor:
                 # ADAPTIVE budget means the whole fleet got slower (or
                 # error-only) under the learned quantile — with no
                 # answers recorded the budget would freeze low and
-                # every request would 504 forever. Record a penalty
-                # sample (4x the failed budget, capped at the static
-                # timeout) so repeated misses push the quantile — and
-                # the budget — back up.
-                self._reply_lat.append(
-                    min(self.gather_timeout, max(timeout, 1e-3) * 4.0))
+                # every request would 504 forever. Escalate: each
+                # consecutive miss doubles the penalty weight (4x the
+                # failed budget, capped at the static timeout), and
+                # after 3 straight misses the reservoir is flushed —
+                # the old latency distribution no longer describes the
+                # fleet, and an empty window drops the controller back
+                # to warmup (static budget) to relearn from scratch.
+                penalty = min(self.gather_timeout,
+                              max(timeout, 1e-3) * 4.0)
+                if latency < timeout:
+                    # the gather ended BEFORE the budget — every worker
+                    # error-replied fast, so the fleet is RESPONSIVE (a
+                    # bad request, not a slow fleet): keep the budget-
+                    # raising penalty sample, but never let a
+                    # misbehaving client escalate to the flush and wipe
+                    # a healthy learned distribution. A fleet that ran
+                    # the budget OUT (even with some fast errors mixed
+                    # in) counts as a real miss below.
+                    self._reply_lat.append(penalty)
+                else:
+                    self._gather_misses += 1
+                    if self._gather_misses >= 3:
+                        self._reply_lat.clear()
+                        self._gather_misses = 0
+                    else:
+                        self._reply_lat.extend(
+                            [penalty] * (1 << (self._gather_misses - 1)))
+            elif adaptive:
+                # only an answer under the ADAPTIVE budget proves the
+                # learned budget works again — explicit-timeout traffic
+                # answering must not starve the 3-miss flush
+                self._gather_misses = 0
         info = {"workers_answered": len(per_worker),
                 "workers_asked": len(self.worker_ids),
                 "latency_s": latency, "errors": errors}
@@ -370,6 +404,12 @@ def _stack(queries: Sequence[Any]) -> Any:
     return list(queries)
 
 
+#: hard ceiling on client-supplied request timeouts: generous for any
+#: legitimate generation (12x the default stream budget), small enough
+#: that a stuck request eventually releases its handler thread + slot
+MAX_REQUEST_TIMEOUT_S = 3600.0
+
+
 class PredictorService:
     """HTTP front: POST /predict {queries} → {predictions}."""
 
@@ -387,14 +427,44 @@ class PredictorService:
     def stop(self) -> None:
         self.http.stop()
 
+    @staticmethod
+    def _parse_timeout(body) -> Tuple[bool, Any]:
+        """(True, seconds-or-None) or (False, error). Absent/null means
+        "server default"; an explicit non-numeric or non-positive value
+        (e.g. 0) is a client error, not a silent fallback."""
+        timeout = (body or {}).get("timeout")
+        if timeout is None:
+            return True, None
+        if isinstance(timeout, bool):
+            # bool subclasses int: {"timeout": true} would silently
+            # become a 1-second deadline instead of a client error
+            return False, "timeout must be a number"
+        try:
+            t = float(timeout)
+        except (TypeError, ValueError):
+            return False, "timeout must be a number"
+        if not (t > 0.0) or not math.isfinite(t):
+            # rejects 0, negatives, NaN, and Infinity — json.loads
+            # accepts bare Infinity, and an inf deadline would pin a
+            # handler thread (and a decode slot) forever
+            return False, "timeout must be a finite number > 0"
+        if t > MAX_REQUEST_TIMEOUT_S:
+            # a huge FINITE deadline pins a handler thread (and a
+            # decode slot) as effectively as inf would
+            return False, (
+                f"timeout must be <= {MAX_REQUEST_TIMEOUT_S:.0f}s")
+        return True, t
+
     def _predict(self, _m, body, _h) -> Tuple[int, Any]:
         queries = (body or {}).get("queries")
         if not isinstance(queries, list) or not queries:
             return 400, {"error": "body must be {queries: [...]}"}
-        timeout = (body or {}).get("timeout")
+        ok, timeout = self._parse_timeout(body)
+        if not ok:
+            return 400, {"error": timeout}
         sampling = (body or {}).get("sampling")
         preds, info = self.predictor.predict(
-            queries, timeout=float(timeout) if timeout else None,
+            queries, timeout=timeout,
             sampling=sampling if isinstance(sampling, dict) else None)
         if info["workers_answered"] == 0:
             return 504, {"error": "no worker answered in time",
@@ -407,10 +477,12 @@ class PredictorService:
         queries = (body or {}).get("queries")
         if not isinstance(queries, list) or not queries:
             return 400, {"error": "body must be {queries: [...]}"}
-        timeout = (body or {}).get("timeout")
+        ok, timeout = self._parse_timeout(body)
+        if not ok:
+            return 400, {"error": timeout}
         sampling = (body or {}).get("sampling")
         events = self.predictor.predict_stream(
-            queries, timeout=float(timeout) if timeout else None,
+            queries, timeout=timeout,
             sampling=sampling if isinstance(sampling, dict) else None)
 
         def sse():

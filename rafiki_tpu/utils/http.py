@@ -214,17 +214,38 @@ def json_request(method: str, url: str, body: Any = None,
     return json.loads(raw) if raw else None
 
 
+#: default whole-stream budget for SSE generation streams. Lives here —
+#: next to the transport both ends use — so the client SDK can size its
+#: per-event socket timeout without importing the server-side predictor
+#: module (Predictor.STREAM_TIMEOUT aliases this).
+STREAM_BUDGET_S = 300.0
+
+
 def sse_request(method: str, url: str, body: Any = None,
                 headers: Optional[Dict[str, str]] = None,
-                timeout: float = 30.0):
+                timeout: float = 30.0,
+                read_timeout: Optional[float] = None):
     """Yield decoded JSON payloads from a server-sent-events endpoint.
 
     Matches the minimal SSE dialect :class:`StreamResponse` producers
     emit: ``data: <json>\\n\\n`` per event, connection close = end of
-    stream. ``timeout`` bounds the wait for EACH event, not the whole
-    stream (a generation may legitimately run for minutes)."""
+    stream. ``timeout`` bounds connection establishment (and each event
+    wait unless ``read_timeout`` is given); ``read_timeout`` bounds the
+    wait for EACH event once the stream is up — a generation may
+    legitimately idle near the server's whole-stream budget, but a down
+    host must still fail fast at connect time."""
     with _open_request(method, url, body, headers, timeout,
                        accept="text/event-stream") as resp:
+        if read_timeout is not None and read_timeout != timeout:
+            # the urlopen timeout rode onto the connected socket; now
+            # that the response is live, re-bound it for event reads.
+            # CPython: HTTPResponse.fp is a buffered reader over a
+            # SocketIO holding the raw socket — reach it defensively
+            # (a refactor of those internals just keeps the old bound)
+            sock = getattr(getattr(resp, "fp", None), "raw", None)
+            sock = getattr(sock, "_sock", None)
+            if hasattr(sock, "settimeout"):
+                sock.settimeout(read_timeout)
         for line in resp:  # socket timeout applies per readline
             line = line.strip()
             if line.startswith(b"data:"):

@@ -530,6 +530,70 @@ def test_adaptive_gather_recovers_from_fleet_slowdown():
         t.join(timeout=5)
 
 
+def test_adaptive_gather_recovers_fast_with_full_window():
+    """ADVICE r4: with a FULL 2048-sample window of stale fast
+    latencies, one penalty sample per zero-answer gather would take
+    ~100 failed requests to move the p95 — the escalate-then-flush
+    recovery must relearn within a handful instead."""
+    import threading
+    import time as _time
+
+    from rafiki_tpu.serving.predictor import Predictor
+    from rafiki_tpu.serving.queues import (InProcQueueHub, pack_message,
+                                           unpack_message)
+
+    hub = InProcQueueHub()
+    pred = Predictor(hub, ["w0"], gather_timeout=2.0,
+                     adaptive_gather=True, target_answer_frac=0.9,
+                     gather_margin=1.2, min_gather_timeout=0.01)
+    # a long steady-state: the reservoir is FULL of fast samples
+    pred._reply_lat.extend([0.01] * pred.LATENCY_WINDOW)
+    assert pred._gather_deadline_s() < 0.2
+    delay = [0.3]  # fleet is ALREADY slower than the learned budget
+    stop = threading.Event()
+
+    def worker():
+        while not stop.is_set():
+            raw = hub.pop_query("w0", timeout=0.2)
+            if raw is None:
+                continue
+            msg = unpack_message(raw)
+            _time.sleep(delay[0])
+            hub.push_prediction(msg["id"], pack_message(
+                {"id": msg["id"], "worker_id": "w0",
+                 "predictions": [[1.0]]}))
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        answered = []
+        for _ in range(5):
+            _, info = pred.predict([[0.0]])
+            answered.append(info["workers_answered"])
+        # 3 misses flush the stale window -> static budget -> answers
+        assert answered[-1] == 1, answered
+        assert 0 in answered, answered
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+def test_predict_rejects_nonpositive_timeout():
+    """ADVICE r4: an explicit degenerate timeout (0, negative, NaN,
+    non-numeric) must 400, not silently fall back to the default."""
+    from rafiki_tpu.serving.predictor import Predictor, PredictorService
+    from rafiki_tpu.serving.queues import InProcQueueHub
+
+    svc = PredictorService(Predictor(InProcQueueHub(), ["w0"]))
+    for handler in (svc._predict, svc._predict_stream):
+        for bad in (0, -1, "nope", float("nan"), float("inf"),
+                    True, 1e15):
+            code, body = handler(None, {"queries": [[0.0]],
+                                        "timeout": bad}, None)
+            assert code == 400, (handler, bad, code)
+            assert "timeout" in body["error"]
+
+
 def test_per_request_max_new_clamped():
     """Clients control generation length via sampling.max_new, clamped
     by the worker's configured cap (slot-occupancy protection)."""
