@@ -247,6 +247,10 @@ class _DecoderAttention(nn.Module):
     #: loss terms are masked — valid positions' logits are untouched.
     seq_mesh: Any = None
     seq_axis: Optional[str] = None
+    #: tensor-parallel composition: mesh axis the HEAD dim is sharded
+    #: over (Megatron TP). The sp collectives then run within each TP
+    #: head group — see ops/ulysses.py / ops/ring_attention.py.
+    head_axis: Optional[str] = None
     rope_theta: float = 10000.0
     rope_scaling: Optional[Tuple[float, float, float, float]] = None
     #: serving-only int8 KV cache: K/V rows store as int8 with one f32
@@ -359,7 +363,13 @@ class _DecoderAttention(nn.Module):
         else:
             if self.seq_axis is not None:
                 qt = q.transpose(0, 2, 1, 3)
-                if self.n_heads % self.seq_mesh.shape[self.seq_axis]:
+                # per-TP-shard head count decides the strategy: each
+                # model shard owns n_heads/tp whole heads (Megatron),
+                # and the sp swap happens within that group
+                tp = (self.seq_mesh.shape[self.head_axis]
+                      if self.head_axis is not None else 1)
+                if (self.n_heads // tp) % \
+                        self.seq_mesh.shape[self.seq_axis]:
                     # heads don't split over the axis: rotate K/V blocks
                     # around the ring instead of swapping heads<->seq.
                     # The ring is GQA-aware: pass the UN-repeated
@@ -372,7 +382,8 @@ class _DecoderAttention(nn.Module):
                                        v.transpose(0, 2, 1, 3),
                                        self.seq_mesh, self.seq_axis,
                                        causal=True,
-                                       batch_axis=DATA_AXIS)
+                                       batch_axis=DATA_AXIS,
+                                       head_axis=self.head_axis)
                 else:
                     from rafiki_tpu.ops.ulysses import ulysses_attention
 
@@ -383,7 +394,8 @@ class _DecoderAttention(nn.Module):
                         qt, k.transpose(0, 2, 1, 3),
                         v.transpose(0, 2, 1, 3),
                         self.seq_mesh, self.seq_axis, causal=True,
-                        batch_axis=DATA_AXIS)
+                        batch_axis=DATA_AXIS,
+                        head_axis=self.head_axis)
             else:
                 o = flash_attention(
                     q.transpose(0, 2, 1, 3),
@@ -407,6 +419,7 @@ class _DecoderBlock(nn.Module):
     n_adapters: int = 0  # >0 → per-row stacked adapters (serving)
     seq_mesh: Any = None  # sequence parallelism (see _DecoderAttention)
     seq_axis: Optional[str] = None
+    head_axis: Optional[str] = None  # sp×tp (see _DecoderAttention)
     rope_theta: float = 10000.0
     rope_scaling: Optional[Tuple[float, float, float, float]] = None
     kv_int8: bool = False  # serving-only int8 KV cache
@@ -417,6 +430,7 @@ class _DecoderBlock(nn.Module):
             self.n_heads, self.n_kv_heads, self.max_len, self.lora_rank,
             quantized=self.quantized, n_adapters=self.n_adapters,
             seq_mesh=self.seq_mesh, seq_axis=self.seq_axis,
+            head_axis=self.head_axis,
             rope_theta=self.rope_theta, rope_scaling=self.rope_scaling,
             kv_int8=self.kv_int8,
             name="attn")(RMSNorm()(x), lens, positions, decode,
@@ -481,6 +495,10 @@ class Llama(nn.Module):
     # config, like dtype/remat (Mesh is hashable).
     seq_mesh: Any = None
     seq_axis: Optional[str] = None
+    # sp×tp composition: mesh axis the head dim is tensor-parallel
+    # sharded over — the sp collectives then run within each TP head
+    # group (needs n_heads/tp % sp == 0 for ulysses; ring otherwise)
+    head_axis: Optional[str] = None
     # RoPE base frequency: 10000 is the Llama-1/2 default; Llama-3
     # checkpoints use 500000 — a mismatched theta loads cleanly but
     # generates garbage, so the template threads the knob through
@@ -524,6 +542,7 @@ class Llama(nn.Module):
                           quantized=self.quantized,
                           n_adapters=self.n_adapters,
                           seq_mesh=self.seq_mesh, seq_axis=self.seq_axis,
+                          head_axis=self.head_axis,
                           rope_theta=self.rope_theta,
                           rope_scaling=self.rope_scaling,
                           kv_int8=self.kv_int8,
@@ -895,11 +914,14 @@ class LlamaLoRA(BaseModel):
             "adapters_only": PolicyKnob("ADAPTERS_ONLY"),
             # >1 shards the SEQUENCE dim of every train activation over
             # this many devices — the long-context train path:
-            # ulysses all-to-alls when n_heads divides it, ring K/V
-            # rotation otherwise (both exact). Composes with data
-            # parallelism ((data, sp) mesh); max_len must divide by
-            # it; mutually exclusive with model_parallel/
-            # pipeline_stages>1 and loss_chunk.
+            # ulysses all-to-alls when per-TP-shard heads divide it,
+            # ring K/V rotation otherwise (both exact). Composes with
+            # data parallelism AND tensor parallelism: model_parallel>1
+            # builds a (data, sp, model) 3-axis mesh with the sp
+            # collectives running within each TP head group (needs
+            # n_heads and kv heads divisible by model_parallel).
+            # max_len must divide by it; mutually exclusive with
+            # pipeline_stages>1, MoE, and loss_chunk.
             "sequence_parallel": FixedKnob(1),
             # >1 pipelines the decoder blocks over this many devices
             # (GPipe microbatching, parallel/pipeline.py); depth must
@@ -981,7 +1003,8 @@ class LlamaLoRA(BaseModel):
     # ---- internals ----
     def _module(self, quantized: bool = False, n_adapters: int = 0,
                 seq_mesh: Any = None,
-                seq_axis: Optional[str] = None) -> Llama:
+                seq_axis: Optional[str] = None,
+                head_axis: Optional[str] = None) -> Llama:
         k = self.knobs
         hd = int(k["hidden_dim"])
         heads = int(k["n_heads"])
@@ -997,6 +1020,7 @@ class LlamaLoRA(BaseModel):
                      moe_top_k=int(k.get("moe_top_k", 1) or 1),
                      quantized=quantized, n_adapters=n_adapters,
                      seq_mesh=seq_mesh, seq_axis=seq_axis,
+                     head_axis=head_axis,
                      rope_theta=float(k.get("rope_theta", 10000.0)
                                       or 10000.0),
                      rope_scaling=_parse_rope_scaling(
@@ -1060,42 +1084,63 @@ class LlamaLoRA(BaseModel):
         devices = ctx.devices or jax.local_devices()
         mesh = self._mesh(devices)
         sp = int(self.knobs.get("sequence_parallel", 1) or 1)
+        sp_tp = 1  # model-parallel degree composed WITH sp (3-axis mesh)
         if sp > 1:
-            # sequence parallelism: (data, sp) mesh, every (B, L)
-            # operand's L sharded over `sp`, attention via ulysses
-            # all-to-alls — or ring K/V rotation when n_heads doesn't
-            # divide sp (module seq_mesh/seq_axis; dispatch in
-            # _DecoderAttention). Long-context regime — each device
-            # holds L/sp of every activation.
+            # sequence parallelism: (data, sp[, model]) mesh, every
+            # (B, L) operand's L sharded over `sp`, attention via
+            # ulysses all-to-alls — or ring K/V rotation when per-shard
+            # heads don't divide sp (module seq_mesh/seq_axis; dispatch
+            # in _DecoderAttention). Long-context regime — each device
+            # holds L/sp of every activation. With model_parallel>1 the
+            # mesh gains a third `model` axis: Megatron TP per TP_RULES
+            # shards the head dim, and the sp collectives run WITHIN
+            # each TP head group (SURVEY §2.2's v5e-16 stretch config —
+            # a long-context 8B job needs sp composed with tp).
             from jax.sharding import Mesh
 
-            if int(self.knobs.get("model_parallel", 1)) > 1 or \
-                    int(self.knobs.get("pipeline_stages", 1) or 1) > 1:
+            sp_tp = int(self.knobs.get("model_parallel", 1) or 1)
+            if int(self.knobs.get("pipeline_stages", 1) or 1) > 1:
                 raise ValueError(
                     "sequence_parallel>1 is mutually exclusive with "
-                    "model_parallel/pipeline_stages>1 (the sp mesh "
-                    "pairs with data parallelism only)")
+                    "pipeline_stages>1 (pick sp[×tp]×dp or pp×dp)")
             if int(self.knobs.get("moe_experts", 0)):
                 raise ValueError("sequence_parallel>1 does not support "
-                                 "MoE blocks (experts shard over the "
-                                 "model axis the sp mesh lacks)")
+                                 "MoE blocks (experts would contend "
+                                 "with the attention's sp collectives "
+                                 "for the model axis)")
             if int(self.knobs.get("loss_chunk", 0) or 0):
                 raise ValueError(
                     "sequence_parallel>1 is incompatible with "
                     "loss_chunk (chunk slicing would re-gather the "
                     "sp-sharded sequence every chunk)")
-            if len(devices) % sp:
-                raise ValueError(f"sequence_parallel={sp} must divide "
-                                 f"the trial's {len(devices)} devices")
-            # n_heads % sp == 0 -> ulysses (2 all-to-alls); otherwise
-            # the attention auto-falls-back to ring rotation (P
-            # ppermutes) — see _DecoderAttention. Both are exact.
+            if len(devices) % (sp * sp_tp):
+                raise ValueError(
+                    f"sequence_parallel={sp} x model_parallel={sp_tp} "
+                    f"must divide the trial's {len(devices)} devices")
+            # per-shard n_heads % sp == 0 -> ulysses (2 all-to-alls);
+            # otherwise the attention auto-falls-back to ring rotation
+            # (P ppermutes) — see _DecoderAttention. Both are exact.
             if int(self.knobs["max_len"]) % sp:
                 raise ValueError(f"max_len {self.knobs['max_len']} must "
                                  f"divide by sequence_parallel={sp}")
-            mesh = Mesh(np.array(devices, dtype=object).reshape(-1, sp),
-                        (DATA_AXIS, "sp"))
-            module = self._module(seq_mesh=mesh, seq_axis="sp")
+            heads = int(self.knobs["n_heads"])
+            kv_heads = max(1, heads // int(self.knobs["kv_ratio"]))
+            if sp_tp > 1 and (heads % sp_tp or kv_heads % sp_tp):
+                raise ValueError(
+                    f"sequence_parallel with model_parallel={sp_tp} "
+                    f"needs n_heads ({heads}) and kv heads ({kv_heads}) "
+                    "divisible by it (TP shards whole heads)")
+            if sp_tp > 1:
+                mesh = Mesh(
+                    np.array(devices, dtype=object).reshape(-1, sp, sp_tp),
+                    (DATA_AXIS, "sp", MODEL_AXIS))
+                module = self._module(seq_mesh=mesh, seq_axis="sp",
+                                      head_axis=MODEL_AXIS)
+            else:
+                mesh = Mesh(
+                    np.array(devices, dtype=object).reshape(-1, sp),
+                    (DATA_AXIS, "sp"))
+                module = self._module(seq_mesh=mesh, seq_axis="sp")
         pp_stages = int(self.knobs.get("pipeline_stages", 1) or 1)
         n_micro = int(self.knobs.get("pipeline_microbatches", 0)
                       or 0) or pp_stages
@@ -1251,7 +1296,7 @@ class LlamaLoRA(BaseModel):
                         "degrade", cfg_scaling, have)
             params = import_llama_safetensors(
                 pretrained, params, mesh=mesh,
-                tp_rules=None if sp > 1 else TP_RULES,
+                tp_rules=None if (sp > 1 and sp_tp == 1) else TP_RULES,
                 fsdp=True, min_size=2 ** 12)
         # 2-D sharding: tensor-parallel per TP_RULES over `model`, fsdp
         # over `data` for everything of >=4k elements — smaller tensors
@@ -1282,10 +1327,12 @@ class LlamaLoRA(BaseModel):
                 lambda x: jax.device_put(x, rep_pp), params)
             b_shard = rep_pp
         else:
-            # sp mesh has no `model` axis: fsdp-over-data only (the sp
-            # regime is activations-bound; adapters are tiny anyway)
+            # dp-only sp mesh has no `model` axis: fsdp-over-data only
+            # (the sp regime is activations-bound; adapters are tiny
+            # anyway). The sp×tp 3-axis mesh applies full TP_RULES.
             p_shard = param_shardings(
-                params, mesh, tp_rules=None if sp > 1 else TP_RULES,
+                params, mesh, tp_rules=None if (sp > 1 and sp_tp == 1)
+                else TP_RULES,
                 fsdp=True, min_size=2 ** 12)
             params = jax.tree_util.tree_map(jax.device_put, params,
                                             p_shard)

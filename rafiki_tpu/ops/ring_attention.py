@@ -56,6 +56,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    mesh, axis: str, sm_scale: Optional[float] = None,
                    causal: bool = False,
                    batch_axis: Optional[str] = None,
+                   head_axis: Optional[str] = None,
                    block_q: int = 128, block_k: int = 128,
                    interpret: Optional[bool] = None) -> jnp.ndarray:
     """Exact attention with Q/K/V sequence-sharded over ``mesh[axis]``.
@@ -77,6 +78,13 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     locally before its kernel, and the block backward's dK/dV group-
     reduce back to ``kv_heads`` before accumulating. ``rep = 1``
     degenerates to plain multi-head exactly.
+
+    Tensor-parallel composition: with ``head_axis`` set, the head dim
+    is additionally sharded over that mesh axis and each TP shard runs
+    its own independent ring over ``mesh[axis]`` (per-head attention
+    never mixes heads, so the rings are embarrassingly parallel across
+    head shards). Requires ``heads`` and ``kv_heads`` divisible by the
+    head-axis size.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -94,14 +102,21 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     def reduce_groups(t):
         # (b, h, l, d) block dK/dV → (b, h_kv, l, d): each kv head's
-        # grad sums over its rep query heads (the VJP of expand)
+        # grad sums over its rep query heads (the VJP of expand).
+        # Shapes here are LOCAL (head dim may be tp-sharded), so the
+        # kv-head count derives from the block itself, not the global
         if rep == 1:
             return t
-        bb, _, ll, dd = t.shape
-        return jnp.sum(t.reshape(bb, h_kv, rep, ll, dd), axis=2)
+        bb, hh, ll, dd = t.shape
+        return jnp.sum(t.reshape(bb, hh // rep, rep, ll, dd), axis=2)
+    tp = mesh.shape[head_axis] if head_axis is not None else 1
+    if h % tp or h_kv % tp:
+        raise ValueError(
+            f"ring_attention with head_axis needs heads ({h}) and "
+            f"kv_heads ({h_kv}) divisible by mesh[{head_axis!r}] ({tp})")
     n_ring = mesh.shape[axis]
-    seq_spec = P(batch_axis, None, axis, None)
-    lse_spec = P(batch_axis, None, axis)
+    seq_spec = P(batch_axis, head_axis, axis, None)
+    lse_spec = P(batch_axis, head_axis, axis)
     ring_perm = [(j, (j + 1) % n_ring) for j in range(n_ring)]
 
     def rotate(*ts):

@@ -32,7 +32,8 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       mesh, axis: str,
                       sm_scale: Optional[float] = None,
                       causal: bool = False,
-                      batch_axis: Optional[str] = None) -> jnp.ndarray:
+                      batch_axis: Optional[str] = None,
+                      head_axis: Optional[str] = None) -> jnp.ndarray:
     """Attention over (batch, heads, seq, head_dim) with ``seq`` sharded
     on ``mesh[axis]``; heads must be divisible by that axis size.
 
@@ -51,6 +52,14 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     heads ``[p·h_kv/P, (p+1)·h_kv/P)`` to the same device p, and
     ``h/P = rep · h_kv/P`` makes the local repeat the right pairing.
     Otherwise K/V repeat before the swap (plain behavior).
+
+    Tensor-parallel composition: with ``head_axis`` set, the HEAD dim
+    is additionally sharded over that mesh axis (Megatron TP keeps
+    each attention head whole on one model shard), and the ulysses
+    swap runs WITHIN each TP head group — the all-to-alls ride
+    ``mesh[axis]`` only, so sp and tp traffic never mix. Requires
+    ``heads/tp % sp == 0`` (and ``kv_heads % tp == 0`` so the GQA
+    pairing stays aligned per shard).
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -61,14 +70,21 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     n_par = mesh.shape[axis]
     h, h_kv = q.shape[1], k.shape[1]
     rep = gqa_repeat_factor(h, h_kv)
-    if h % n_par:
+    tp = mesh.shape[head_axis] if head_axis is not None else 1
+    if h % tp or h_kv % tp:
         raise ValueError(
-            f"ulysses needs heads % mesh[{axis!r}] == 0; got {h} heads "
-            f"over {n_par} devices (use ring_attention instead)")
-    small_swap = rep > 1 and h_kv % n_par == 0
+            f"ulysses with head_axis needs heads ({h}) and kv_heads "
+            f"({h_kv}) divisible by mesh[{head_axis!r}] ({tp})")
+    h_local, h_kv_local = h // tp, h_kv // tp
+    if h_local % n_par:
+        raise ValueError(
+            f"ulysses needs per-shard heads % mesh[{axis!r}] == 0; got "
+            f"{h_local} heads over {n_par} devices "
+            "(use ring_attention instead)")
+    small_swap = rep > 1 and h_kv_local % n_par == 0
     scale = (sm_scale if sm_scale is not None
              else 1.0 / math.sqrt(q.shape[-1]))
-    seq_spec = P(batch_axis, None, axis, None)
+    seq_spec = P(batch_axis, head_axis, axis, None)
 
     @functools.partial(
         shard_map_kernels, mesh=mesh,

@@ -246,6 +246,15 @@ def sse_request(method: str, url: str, body: Any = None,
             sock = getattr(sock, "_sock", None)
             if hasattr(sock, "settimeout"):
                 sock.settimeout(read_timeout)
+            else:  # loud, not latent: the stream then times out at the
+                # (shorter) connect bound mid-generation
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "sse_request could not re-bound the socket for "
+                    "event reads (HTTPResponse internals changed?); "
+                    "per-event waits stay at the %.0fs connect timeout",
+                    timeout)
         for line in resp:  # socket timeout applies per readline
             line = line.strip()
             if line.startswith(b"data:"):

@@ -71,6 +71,91 @@ def test_ulysses_refuses_indivisible_heads():
         ulysses_attention(q, q, q, mesh, "sp")
 
 
+@pytest.mark.parametrize("h_kv", [
+    4,   # small-swap×tp: per-shard kv heads 2 divide sp=2
+    2,   # repeat-before-swap×tp: per-shard kv heads 1 don't divide
+])
+def test_ulysses_gqa_with_head_axis_matches_dense(h_kv):
+    """sp×tp GQA oracle: heads additionally sharded over a `model`
+    mesh axis (head_axis), the ulysses swap running within each TP
+    head group. The GQA pairing must stay aligned PER SHARD — both
+    the small-swap path (kv heads divide sp within the shard) and the
+    repeat-before-swap fallback — fwd and grads vs the dense oracle."""
+    s, h = 32, 8
+    rep = h // h_kv
+    q = _rand(1, h, s, 8, key=30)
+    k = _rand(1, h_kv, s, 8, key=31)
+    v = _rand(1, h_kv, s, 8, key=32)
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                ("sp", "model"))
+
+    def uly(q, k, v):
+        return ulysses_attention(q, k, v, mesh, "sp", causal=True,
+                                 head_axis="model")
+
+    ref = _attention_reference(
+        q, jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1),
+        1.0 / np.sqrt(8), True)
+    np.testing.assert_allclose(np.asarray(uly(q, k, v)),
+                               np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def f(q, k, v):
+        return jnp.sum(uly(q, k, v).astype(jnp.float32) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(_attention_reference(
+            q, jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1),
+            1.0 / np.sqrt(8), True).astype(jnp.float32) ** 2)
+
+    g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_ring_gqa_with_head_axis_matches_dense():
+    """sp×tp ring oracle: head dim sharded over `model`, independent
+    K/V rings per TP shard, GQA group-reduce on LOCAL shapes — fwd and
+    grads vs the dense oracle (covers the reduce_groups local-shape
+    change in ops/ring_attention.py)."""
+    from rafiki_tpu.ops.ring_attention import ring_attention
+
+    s, h, h_kv = 32, 4, 2
+    rep = h // h_kv
+    q = _rand(1, h, s, 8, key=40)
+    k = _rand(1, h_kv, s, 8, key=41)
+    v = _rand(1, h_kv, s, 8, key=42)
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2),
+                ("sp", "model"))  # per-shard heads 2 < sp 4 -> ring
+
+    def ring(q, k, v):
+        return ring_attention(q, k, v, mesh, "sp", causal=True,
+                              head_axis="model")
+
+    ref = _attention_reference(
+        q, jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1),
+        1.0 / np.sqrt(8), True)
+    np.testing.assert_allclose(np.asarray(ring(q, k, v)),
+                               np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def f(q, k, v):
+        return jnp.sum(ring(q, k, v).astype(jnp.float32) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(_attention_reference(
+            q, jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1),
+            1.0 / np.sqrt(8), True).astype(jnp.float32) ** 2)
+
+    g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
+
+
 @pytest.mark.parametrize("n_par,h_kv", [
     (2, 4),   # small-swap path: kv heads divide the axis
     (4, 2),   # repeat-before-swap path: kv heads don't divide (2 % 4)
