@@ -796,6 +796,171 @@ def adapter_only_mask(params: Any) -> Any:
     return jax.tree_util.tree_map_with_path(trainable, params)
 
 
+def estimate_train_device_bytes(module: "Llama", *,
+                                batch_size: int,
+                                data_parallel: int = 1,
+                                model_parallel: int = 1,
+                                sequence_parallel: int = 1,
+                                grad_accum: int = 1,
+                                loss_chunk: int = 0,
+                                remat: bool = True,
+                                adapters_only: bool = False,
+                                pipeline_stages: int = 1,
+                                pipeline_microbatches: int = 0,
+                                fsdp_min_size: int = 2 ** 12
+                                ) -> Dict[str, int]:
+    """Per-device HBM budget for one train step, from real shape math.
+
+    The admission-control formula (SURVEY §2.2's v5e-16 stretch config
+    needs proof the 8B LoRA job FITS a 16GB chip before a worker
+    claims it — an OOM mid-trial wastes the whole slot):
+
+    - ``params`` / ``grads`` / ``opt`` are EXACT: the abstract param
+      tree (``jax.eval_shape`` of the real init — no allocation), the
+      template's ACTUAL sharding rules (``param_shardings`` with
+      ``TP_RULES`` + fsdp over an :class:`~jax.sharding.AbstractMesh`,
+      so a 16-chip budget computes on any host), and per-leaf
+      ``shard_shape`` byte counts. Grads are f32 and param-sharded
+      (``value_and_grad`` materializes the full tree; the frozen-leaf
+      mask applies at ``tx.update``, after the tree exists — and with
+      ``grad_accum>1`` the scan carries a second, accumulator copy).
+      Opt state is adamw mu+nu over TRAINABLE leaves only
+      (``optax.multi_transform`` + ``set_to_zero`` allocates nothing
+      for frozen leaves).
+    - ``activations`` is a documented UPPER BOUND (XLA frees/fuses
+      more than this): with remat, block-boundary residuals
+      (depth x tokens_dev x hidden) live through the backward, plus
+      one block's recompute working set — per token roughly
+      q,k,v,attn-out (~4 x hidden) + SwiGLU gate/up/down
+      (~3 x mlp_dim) doubled for their cotangents — plus the logits
+      chunk (f32 logits + cotangent, vocab tp-sharded; ``loss_chunk=0``
+      means full-sequence logits, the large-vocab danger case).
+      Without remat the working set multiplies by depth instead.
+    - ``transient``: the largest single weight's compute-dtype cast
+      (bf16 matmul operands are materialized per layer then freed).
+
+    tokens_dev = batch/(dp·grad_accum) x max_len/sp on each device;
+    dims follow the 3-axis (data, sp, model) train mesh exactly as
+    :meth:`LlamaLoRA.train` builds it. Returns a dict of byte counts
+    plus ``total``.
+    """
+    from jax.sharding import AbstractMesh, NamedSharding
+
+    from rafiki_tpu.parallel.sharding import (DATA_AXIS, MODEL_AXIS,
+                                              param_shardings)
+
+    dp, tp, sp = data_parallel, model_parallel, sequence_parallel
+    if pipeline_stages > 1:
+        return _estimate_pipeline_device_bytes(
+            module, batch_size=batch_size, data_parallel=dp,
+            pipeline_stages=pipeline_stages,
+            pipeline_microbatches=pipeline_microbatches,
+            adapters_only=adapters_only)
+    if sp > 1 and tp > 1:
+        mesh = AbstractMesh((dp, sp, tp), (DATA_AXIS, "sp", MODEL_AXIS))
+    elif sp > 1:
+        mesh = AbstractMesh((dp, sp), (DATA_AXIS, "sp"))
+    else:
+        mesh = AbstractMesh((dp, tp), (DATA_AXIS, MODEL_AXIS))
+    tp_rules = None if (sp > 1 and tp == 1) else TP_RULES
+
+    abstract = jax.eval_shape(
+        lambda: module.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, module.max_len),
+                                      jnp.int32)))["params"]
+    shardings = param_shardings(abstract, mesh, tp_rules=tp_rules,
+                                fsdp=True, min_size=fsdp_min_size)
+
+    def leaf_dev_bytes(leaf, sh: NamedSharding) -> int:
+        return int(np.prod(sh.shard_shape(leaf.shape))) * \
+            np.dtype(leaf.dtype).itemsize
+
+    flat_p = jax.tree_util.tree_leaves(abstract)
+    flat_s = jax.tree_util.tree_leaves(
+        shardings, is_leaf=lambda x: isinstance(x, NamedSharding))
+    params_dev = sum(leaf_dev_bytes(l, s) for l, s in zip(flat_p, flat_s))
+    # grads: full f32 tree, param shardings; accumulation carries a
+    # second copy through the scan
+    grads_dev = sum(
+        int(np.prod(s.shard_shape(l.shape))) * 4
+        for l, s in zip(flat_p, flat_s)) * (2 if grad_accum > 1 else 1)
+    # opt: adamw mu+nu for trainable leaves (f32, param-sharded)
+    mask = (adapter_only_mask if adapters_only
+            else lora_trainable_mask)(abstract)
+    flat_m = jax.tree_util.tree_leaves(mask)
+    opt_dev = 2 * sum(int(np.prod(s.shard_shape(l.shape))) * 4
+                      for l, s, m in zip(flat_p, flat_s, flat_m) if m)
+
+    act_bytes = 2 if module.dtype == jnp.bfloat16 else 4
+    tokens_dev = max(1, batch_size // (dp * max(1, grad_accum))) * \
+        max(1, module.max_len // sp)
+    h, mlp = module.hidden_dim, module.mlp_dim
+    per_block = tokens_dev * (4 * h + 3 * mlp) * act_bytes * 2  # +cotan
+    if remat:
+        acts_dev = module.depth * tokens_dev * h * act_bytes + per_block
+    else:
+        acts_dev = module.depth * per_block
+    chunk = loss_chunk or module.max_len // sp
+    logits_rows = max(1, batch_size // (dp * max(1, grad_accum)))
+    logits_dev = logits_rows * chunk * \
+        -(-module.vocab_size // (tp if tp_rules else 1)) * 4 * 2
+    transient = max(
+        (int(np.prod(s.shard_shape(l.shape))) for l, s in
+         zip(flat_p, flat_s)), default=0) * act_bytes
+
+    out = {"params": params_dev, "grads": grads_dev, "opt": opt_dev,
+           "activations": acts_dev + logits_dev, "transient": transient}
+    out["total"] = sum(out.values())
+    return out
+
+
+def _estimate_pipeline_device_bytes(module: "Llama", *, batch_size: int,
+                                    data_parallel: int,
+                                    pipeline_stages: int,
+                                    pipeline_microbatches: int,
+                                    adapters_only: bool) -> Dict[str, int]:
+    """Pipeline-mode budget: train() REPLICATES the param tree on every
+    device of the pipe x data mesh (the rep_pp device_put — weight-
+    sharded pipeline storage is future work), so params/grads/opt count
+    UNSHARDED here; admission control must see the replicated reality,
+    not the tp+fsdp layout pp mode doesn't use. Activations: GPipe
+    holds every in-flight microbatch's block-boundary activations for
+    this device's depth/pp stage through the backward, plus one
+    microbatch's within-block working set and the last stage's logits."""
+    abstract = jax.eval_shape(
+        lambda: module.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, module.max_len),
+                                      jnp.int32)))["params"]
+    flat_p = jax.tree_util.tree_leaves(abstract)
+    params_dev = sum(int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+                     for l in flat_p)
+    grads_dev = sum(int(np.prod(l.shape)) * 4 for l in flat_p)
+    mask = (adapter_only_mask if adapters_only
+            else lora_trainable_mask)(abstract)
+    opt_dev = 2 * sum(
+        int(np.prod(l.shape)) * 4 for l, m in
+        zip(flat_p, jax.tree_util.tree_leaves(mask)) if m)
+
+    act_bytes = 2 if module.dtype == jnp.bfloat16 else 4
+    pp = pipeline_stages
+    n_micro = pipeline_microbatches or pp
+    dp = max(1, data_parallel)
+    rows_dev = max(1, batch_size // dp)  # all microbatches' rows
+    micro_rows = max(1, batch_size // (dp * n_micro))
+    h, mlp = module.hidden_dim, module.mlp_dim
+    stage_depth = max(1, module.depth // pp)
+    acts_dev = (stage_depth * rows_dev * module.max_len * h * act_bytes
+                + micro_rows * module.max_len * (4 * h + 3 * mlp)
+                * act_bytes * 2)
+    logits_dev = micro_rows * module.max_len * module.vocab_size * 4 * 2
+    transient = max((int(np.prod(l.shape)) for l in flat_p),
+                    default=0) * act_bytes
+    out = {"params": params_dev, "grads": grads_dev, "opt": opt_dev,
+           "activations": acts_dev + logits_dev, "transient": transient}
+    out["total"] = sum(out.values())
+    return out
+
+
 def stack_lora_adapters(trees: List[Any], validate: bool = True) -> Any:
     """Merge N adapter-only fine-tunes of one base into a single
     multi-adapter param tree for ``Llama(n_adapters=N)``.
@@ -1026,6 +1191,40 @@ class LlamaLoRA(BaseModel):
                      rope_scaling=_parse_rope_scaling(
                          k.get("rope_scaling", "")),
                      kv_int8=bool(k.get("kv_cache_int8", False)))
+
+    def estimate_device_budget(self, n_devices: int) -> Dict[str, int]:
+        """Per-device train-step HBM budget for THIS parameterization on
+        an ``n_devices`` mesh — the knob-level front of
+        :func:`estimate_train_device_bytes` (admission control: a
+        worker can refuse a trial whose ``total`` exceeds its chips'
+        HBM instead of OOMing mid-step). Mesh factors derive exactly
+        as :meth:`train` builds them: sp and model_parallel consume
+        their factors, the rest is data parallelism."""
+        sp = int(self.knobs.get("sequence_parallel", 1) or 1)
+        mp = int(self.knobs.get("model_parallel", 1) or 1)
+        pp = int(self.knobs.get("pipeline_stages", 1) or 1)
+        if pp > 1:
+            # pipe x data mesh: batch shards over n/pp devices and
+            # params REPLICATE (modeled by the pipeline estimator)
+            sp, mp = 1, 1
+            dp = max(1, n_devices // pp)
+        else:
+            if sp == 1:
+                while n_devices % mp:
+                    mp //= 2
+                mp = max(1, mp)
+            dp = max(1, n_devices // (sp * mp))
+        return estimate_train_device_bytes(
+            self._module(),
+            batch_size=int(self.knobs["batch_size"]),
+            data_parallel=dp, model_parallel=mp, sequence_parallel=sp,
+            grad_accum=int(self.knobs.get("grad_accum", 1) or 1),
+            loss_chunk=int(self.knobs.get("loss_chunk", 0) or 0),
+            remat=bool(self.knobs.get("remat", False)),
+            adapters_only=bool(self.knobs.get("adapters_only", False)),
+            pipeline_stages=pp,
+            pipeline_microbatches=int(
+                self.knobs.get("pipeline_microbatches", 0) or 0))
 
     def _serving_module_params(self) -> Tuple[Llama, Any]:
         """(module, params) for predict()/make_decode_engine — the int8

@@ -141,6 +141,7 @@ class TrainWorker:
             try:
                 self.model_class.validate_knobs(proposal.knobs)
                 model = self.model_class(**proposal.knobs)
+                self._admission_check(model)
                 shared = None
                 if proposal.warm_start_trial_id:
                     shared = self.param_store.load(
@@ -229,6 +230,63 @@ class TrainWorker:
                 return None
         finally:
             hb_stop()
+
+    def _admission_check(self, model) -> None:
+        """Refuse a trial whose ESTIMATED per-device train footprint
+        exceeds the chips' HBM, before any compile/allocation — an OOM
+        mid-trial wastes the whole slot and reads as a mystery fault.
+
+        Templates opt in by exposing ``estimate_device_budget(n) ->
+        {..., "total": bytes}`` (the Llama template computes it from
+        real shape math — ``estimate_train_device_bytes``). The limit
+        comes from the accelerator's own ``memory_stats()["bytes_limit"]``
+        (TPU/GPU) or the ``RAFIKI_DEVICE_HBM_BYTES`` env override (CPU
+        runs have elastic host memory, so without the override the
+        check is skipped there). A refusal raises ValueError — a
+        deterministic-class trial error (resume would refuse again)."""
+        est = getattr(model, "estimate_device_budget", None)
+        if est is None:
+            return
+        import os
+
+        import jax
+
+        devs = self.devices or jax.local_devices()
+        limit = None
+        env = os.environ.get("RAFIKI_DEVICE_HBM_BYTES")
+        if env:
+            try:
+                limit = int(float(env))
+            except ValueError:
+                # a config typo must not fail every trial CLOSED: warn
+                # and fall through to the device's own stats (or skip)
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "RAFIKI_DEVICE_HBM_BYTES=%r is not a number; "
+                    "ignoring it for admission control", env)
+                env = None
+        if not limit and devs and \
+                getattr(devs[0], "platform", "cpu") != "cpu":
+            try:
+                limit = (devs[0].memory_stats() or {}).get("bytes_limit")
+            except Exception:  # noqa: BLE001 — stats are optional
+                limit = None
+        if not limit:
+            return
+        try:
+            budget = est(len(devs))
+            total = int(budget["total"])
+        except Exception:  # noqa: BLE001 — an estimator bug must never
+            return  # block an admissible trial
+        if total > limit:
+            raise ValueError(
+                "admission control: estimated "
+                f"{total / 2**30:.2f}GiB/device train footprint "
+                f"exceeds the {limit / 2**30:.2f}GiB device limit "
+                f"(breakdown: { {k: round(v / 2**30, 2) for k, v in budget.items()} } GiB); "
+                "shrink batch_size/max_len or enable remat/loss_chunk/"
+                "grad_accum/model_parallel")
 
     def _wire_checkpointing(self, ctx, ckpt_key: str, base_frac: float,
                             proposal, shared) -> None:

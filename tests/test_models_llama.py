@@ -196,6 +196,122 @@ def test_8b_parameterization_specs_divide_abstract():
         assert n_sharded >= 32 * 7  # all projections, every layer
 
 
+def test_8b_lora_byte_budget_fits_v5e16():
+    """VERDICT r4 item 6: per-device memory accounting for the Llama-3
+    8B LoRA config (#5) on a 16-chip v5e mesh (dp=2 x tp=8, remat,
+    loss_chunk, grad_accum, bf16 compute) from REAL shape math —
+    abstract init + the template's actual sharding rules over an
+    AbstractMesh, so no 16-device host (or allocation) is needed. The
+    total must clear a v5e chip's 16GB HBM with headroom; dropping the
+    memory knobs (no remat, dense loss) must blow the budget — proving
+    the formula actually discriminates."""
+    from rafiki_tpu.models.llama_lora import estimate_train_device_bytes
+
+    spec = dict(vocab_size=128256, max_len=4096, hidden_dim=4096,
+                depth=32, n_heads=32, n_kv_heads=8, mlp_dim=14336,
+                lora_rank=16, dtype=jnp.bfloat16)
+    budget = estimate_train_device_bytes(
+        Llama(**spec, remat=True), batch_size=16,
+        data_parallel=2, model_parallel=8, grad_accum=4,
+        loss_chunk=512, remat=True)
+    gib = 1 << 30
+    # params (f32, fully tp+fsdp sharded) ~ 32GB/16 ~ 2GiB/chip
+    assert 1.5 * gib < budget["params"] < 2.6 * gib, budget
+    # trainables are LoRA + norms + lm_head (the recipe tunes the
+    # head): adamw mu+nu for the 128k x 4096 head dominates, ~0.26GiB
+    # per chip once tp+fsdp sharded
+    assert budget["opt"] < 0.5 * gib, budget
+    assert budget["total"] < 12 * gib, budget  # fits 16GB w/ headroom
+
+    # the SAME job without the memory knobs must NOT fit — a formula
+    # that passes everything is not admission control
+    naive = estimate_train_device_bytes(
+        Llama(**spec, remat=False), batch_size=16,
+        data_parallel=2, model_parallel=8, grad_accum=1,
+        loss_chunk=0, remat=False)
+    assert naive["total"] > 16 * gib, naive
+
+
+def test_byte_budget_matches_measured_small_build():
+    """Grounding: the formula's EXACT terms (params, opt) must equal
+    the bytes actually resident per device on a real sharded build —
+    same rules, same mesh — so the 8B numbers are shape math over a
+    verified base, not a parallel implementation that can drift."""
+    import optax
+
+    from rafiki_tpu.models.llama_lora import (
+        TP_RULES, estimate_train_device_bytes, lora_trainable_mask)
+    from rafiki_tpu.parallel.sharding import make_mesh, param_shardings
+
+    module = Llama(vocab_size=2048, max_len=32, hidden_dim=128,
+                   depth=2, n_heads=4, n_kv_heads=2, mlp_dim=256,
+                   lora_rank=4)
+
+    def init_fn():
+        return module.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))["params"]
+
+    mesh = make_mesh(jax.devices()[:8], model=2)
+    shardings = param_shardings(jax.eval_shape(init_fn), mesh,
+                                tp_rules=TP_RULES, fsdp=True,
+                                min_size=2 ** 12)
+    params = jax.jit(init_fn, out_shardings=shardings)()
+    tx = optax.multi_transform(
+        {"train": optax.adamw(1e-3), "freeze": optax.set_to_zero()},
+        lambda p: jax.tree_util.tree_map(
+            lambda t: "train" if t else "freeze",
+            lora_trainable_mask(p)))
+    opt_state = tx.init(params)
+
+    def measured_dev0(tree):
+        dev = jax.devices()[0]
+        n = 0
+        for leaf in jax.tree_util.tree_leaves(tree):
+            for sh in getattr(leaf, "addressable_shards", []):
+                if sh.device == dev:
+                    n += sh.data.nbytes
+        return n
+
+    budget = estimate_train_device_bytes(
+        module, batch_size=8, data_parallel=4, model_parallel=2,
+        fsdp_min_size=2 ** 12)
+    assert budget["params"] == measured_dev0(params), budget
+    # opt: mu+nu for trainable leaves (count scalars et al. are noise)
+    meas_opt = measured_dev0(opt_state)
+    assert budget["opt"] <= meas_opt <= budget["opt"] + 4096, \
+        (budget["opt"], meas_opt)
+
+
+def test_byte_budget_pipeline_mode_counts_replicated_params():
+    """Pipeline mode replicates the param tree per device (train()'s
+    rep_pp layout) — the estimator must charge the FULL tree, not the
+    tp+fsdp shards pp mode doesn't use, or admission control would
+    green-light trials that OOM at replication."""
+    from rafiki_tpu.models.llama_lora import estimate_train_device_bytes
+
+    module = Llama(vocab_size=2048, max_len=32, hidden_dim=128,
+                   depth=4, n_heads=4, n_kv_heads=2, mlp_dim=256,
+                   lora_rank=4)
+    abstract = _abstract_params(module)
+    total = _tree_bytes(abstract)
+    pp = estimate_train_device_bytes(module, batch_size=8,
+                                     data_parallel=4,
+                                     pipeline_stages=2)
+    sharded = estimate_train_device_bytes(module, batch_size=8,
+                                          data_parallel=4,
+                                          model_parallel=2)
+    assert pp["params"] == total, (pp["params"], total)
+    assert pp["params"] > sharded["params"]
+    # the knob-level front routes pipeline_stages the same way
+    from rafiki_tpu.models.llama_lora import LlamaLoRA
+    model = LlamaLoRA(**{**TINY, "model_parallel": 1,
+                         "pipeline_stages": 2,
+                         "pipeline_microbatches": 4})
+    via_knobs = model.estimate_device_budget(8)
+    assert via_knobs["params"] == _tree_bytes(
+        _abstract_params(model._module()))
+
+
 @pytest.mark.slow
 def test_fsdp_bounds_per_device_memory_at_1b():
     """REAL ~1.3B-param build on the 8-device mesh, initialized straight
