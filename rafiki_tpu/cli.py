@@ -189,6 +189,45 @@ def _doctor() -> int:
             probe = parent
         row(not blocked and os.access(probe or ".", os.W_OK),
             "compile cache", path, fatal=False)
+    pg = os.environ.get("RAFIKI_PG_URL", "")
+    if not pg:
+        row(True, "postgres",
+            "not configured (RAFIKI_PG_URL unset; sqlite is the default "
+            "MetaStore backing)")
+    else:
+        from urllib.parse import urlsplit
+
+        def redact(text: str) -> str:
+            # structural redaction (not a regex over the URL — an
+            # unencoded '@' or '/' inside a password defeats those):
+            # every userinfo fragment is scrubbed from any output,
+            # including driver exception text that may echo the URL
+            try:
+                netloc = urlsplit(pg).netloc
+            except ValueError:
+                netloc = ""
+            userinfo, _, _hostport = netloc.rpartition("@")
+            if userinfo:
+                text = text.replace(userinfo, "***")
+                pw = userinfo.partition(":")[2]
+                if pw:
+                    text = text.replace(pw, "***")
+            return text
+
+        shown = redact(pg)
+        try:
+            from .store.db import PostgresAdapter
+
+            a = PostgresAdapter(pg)
+            conn = a.connect()
+            try:
+                one = a.execute(conn, "SELECT 1 AS ok").fetchone()
+            finally:
+                a.close(conn)
+            row(bool(one and one.get("ok") == 1), "postgres", shown,
+                fatal=False)
+        except Exception as e:  # noqa: BLE001 — the report IS the product
+            row(False, "postgres", redact(f"{shown}: {e}"), fatal=False)
     print("all checks passed" if ok else "SOME CHECKS FAILED")
     return 0 if ok else 1
 

@@ -64,6 +64,52 @@ def test_draft_model_speculation_is_lossless(trained):  # noqa: F811
     assert eng_b.stats["requests_done"] == len(reqs)
 
 
+@pytest.mark.slow
+def test_distilled_small_draft_partial_acceptance():
+    """VERDICT r4 item 5 contract (the bench_extra small-draft leg):
+    a genuinely smaller draft (depth 1, 1/4 width) distilled on the
+    target's own greedy continuations, evaluated 2 tokens past the
+    distillation horizon, must (a) land acceptance STRICTLY inside
+    (0, 1) — neither the degenerate self-draft 1.0 nor a gated-off 0 —
+    and (b) stay lossless: token-identical to plain greedy decode.
+    Builds from the bench's OWN recipe (build_small_draft_setup), so
+    this pins the exact configuration the bench measures."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench_extra import build_small_draft_setup
+
+    from rafiki_tpu.serving.decode_engine import DecodeEngine
+
+    (t_mod, t_params, d_mod, d_params, evs, max_new,
+     _loss) = build_small_draft_setup(on_accel=False)
+
+    def run(spec_k, draft=None):
+        eng = DecodeEngine(t_mod, t_params, max_slots=4,
+                           max_len=t_mod.max_len, speculate_k=spec_k,
+                           draft=draft)
+        for r, e in enumerate(evs):
+            eng.submit(("r", r), e, max_new)
+        got = {}
+        for _ in range(500):
+            if not eng.busy:
+                break
+            eng.step()
+            for rid, toks in eng.poll():
+                got[rid] = list(toks)
+        assert not eng.busy
+        return got, dict(eng.stats)
+
+    plain, _ = run(0)
+    spec, st = run(4, draft=(d_mod, d_params))
+    assert spec == plain  # lossless regardless of acceptance
+    acc = st["spec_accepted"] / max(1, st["spec_drafted"])
+    assert st["spec_drafted"] > 0, st
+    assert 0.0 < acc < 1.0, (acc, st)
+
+
 def test_draft_model_mid_flight_admission(trained):  # noqa: F811
     """Requests admitted while others are mid-generation keep the
     draft cache synced (the scan/prefill mirrors): outputs still match

@@ -188,6 +188,78 @@ def test_postgres_sql_translation():
     assert " REAL" not in ddl
 
 
+def test_postgres_metastore_live_roundtrip():
+    """VERDICT r4 item 8: the PostgresAdapter against a REAL wire —
+    placeholder translation under write args, DDL creation, bytea
+    blobs, fenced-UPDATE rowcount semantics (trial claim/completion),
+    migration duplicate-column no-op, and statement-failure isolation
+    under autocommit. Gated: runs wherever psycopg2 + a server are
+    available (``RAFIKI_PG_URL``), skips cleanly otherwise — this
+    image ships sqlite-only."""
+    import os
+    import uuid
+
+    import pytest as _pytest
+
+    url = os.environ.get("RAFIKI_PG_URL", "")
+    if not url:
+        _pytest.skip("RAFIKI_PG_URL not set (no postgres in this env)")
+    psycopg2 = _pytest.importorskip("psycopg2")
+    schema = f"rafiki_test_{uuid.uuid4().hex[:12]}"
+    try:
+        admin = psycopg2.connect(url, connect_timeout=5)
+    except Exception as e:  # noqa: BLE001
+        _pytest.skip(f"postgres unreachable: {e}")
+    admin.autocommit = True
+    sep = "&" if "?" in url else "?"
+    scoped_url = (f"{url}{sep}options=-csearch_path%3D{schema}")
+    try:
+        with admin.cursor() as cur:
+            cur.execute(f'CREATE SCHEMA "{schema}"')
+
+        from rafiki_tpu.store.meta_store import MetaStore
+
+        m = MetaStore(scoped_url)
+        try:
+            # users + auth (placeholder translation on INSERT/SELECT)
+            u = m.create_user("pg@test", "pw", "ADMIN")
+            assert m.authenticate_user("pg@test", "pw")["id"] == u["id"]
+            # model upload: bytea blob round-trip
+            blob = bytes(range(256)) * 4
+            mod = m.create_model(u["id"], "m1", "IMAGE_CLASSIFICATION",
+                                 "Model", blob, {})
+            assert bytes(m.get_model(mod["id"])["model_bytes"]) == blob
+            # trial state machine: fenced completion via rowcount
+            t = m.create_trial("sj1", 0, model_id=mod["id"],
+                               knobs={"lr": 0.1}, worker_id="w0",
+                               budget_scale=1.0, shape_sig="s")
+            m.heartbeat_trial(t["id"])
+            assert m.mark_trial_completed(t["id"], 0.9,
+                                          params_saved=True) is True
+            # second terminal mark must FENCE OUT (rowcount 0 on pg)
+            assert m.mark_trial_completed(t["id"], 0.1,
+                                          params_saved=True) is False
+            row = m.get_trial(t["id"])
+            assert row["status"] == "COMPLETED"
+            assert abs(float(row["score"]) - 0.9) < 1e-9
+            # migration re-run: DuplicateColumn maps to a clean no-op
+            assert m._adapter.try_migration(
+                m._conn, "ALTER TABLE trials ADD COLUMN error_class "
+                "TEXT") is False
+            # failed statement doesn't poison the connection
+            with _pytest.raises(Exception):
+                m._exec("SELECT * FROM does_not_exist")
+            assert m.get_user(u["id"])["email"] == "pg@test"
+        finally:
+            m.close()
+    finally:
+        try:
+            with admin.cursor() as cur:
+                cur.execute(f'DROP SCHEMA "{schema}" CASCADE')
+        finally:
+            admin.close()
+
+
 def test_meta_store_accepts_sqlite_url(tmp_path):
     from rafiki_tpu.store.meta_store import MetaStore
 
