@@ -1,9 +1,11 @@
 """Dataset formats, loaders, synthetic generators, device prefetch."""
 
 from .bpe import ByteBPETokenizer
-from .dataset import (CorpusDataset, ImageClassificationDataset,
+from .dataset import (FASHION_CLASSES, CorpusDataset,
+                      ImageClassificationDataset,
                       TabularDataset, TextClassificationDataset,
                       generate_corpus_dataset,
+                      generate_fashion_archive,
                       generate_image_classification_dataset,
                       generate_tabular_dataset,
                       generate_text_classification_dataset,
@@ -13,9 +15,10 @@ from .dataset import (CorpusDataset, ImageClassificationDataset,
 from .loader import batch_iterator, bucket_pad, prefetch_to_device
 
 __all__ = [
-    "ByteBPETokenizer",
+    "ByteBPETokenizer", "FASHION_CLASSES",
     "CorpusDataset", "ImageClassificationDataset", "TabularDataset",
     "TextClassificationDataset", "generate_corpus_dataset",
+    "generate_fashion_archive",
     "generate_image_classification_dataset", "generate_tabular_dataset",
     "generate_text_classification_dataset",
     "load_image_classification_dataset", "load_tabular_dataset",

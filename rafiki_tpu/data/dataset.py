@@ -331,6 +331,65 @@ def generate_image_classification_dataset(
     return ds
 
 
+#: FashionMNIST's published class names — the fixture below writes them
+#: into labels.csv so the archive reads like the real dataset's layout
+FASHION_CLASSES = ["t_shirt_top", "trouser", "pullover", "dress", "coat",
+                   "sandal", "shirt", "sneaker", "bag", "ankle_boot"]
+
+
+def generate_fashion_archive(path: str, n_examples: int = 512,
+                             seed: int = 0) -> ImageClassificationDataset:
+    """FashionMNIST-LAYOUT zip fixture with synthetic content: 28x28
+    grayscale PNG files under ``images/`` plus a ``labels.csv`` naming
+    the published fashion classes — the REAL archive byte format the
+    reference's quickstart downloads (SURVEY §4), generatable offline.
+
+    The pixel content comes from the learnable synthetic generator
+    (class templates + noise), so training outcomes carry signal; the
+    FORMAT — PNG encoding, zip packaging, csv labels — is what the real
+    FashionMNIST flow exercises and what the .npz generators skip.
+    Round-trips through :func:`load_image_classification_dataset`'s
+    zip loader. Returns the dataset for oracle use."""
+    from PIL import Image
+
+    if n_examples < len(FASHION_CLASSES):
+        raise ValueError(
+            f"n_examples={n_examples} cannot cover all "
+            f"{len(FASHION_CLASSES)} fashion classes — the zip loader "
+            "derives class ids from the classes PRESENT, so a missing "
+            "class would silently misalign the returned oracle")
+    s = seed
+    while True:
+        ds = generate_image_classification_dataset(
+            "", n_examples=n_examples, image_size=28, n_channels=1,
+            n_classes=len(FASHION_CLASSES), seed=s)
+        # guarantee every class appears: the loader sorts the classes
+        # it SEES, so full coverage is what keeps oracle label ids
+        # aligned with loaded ones. Deterministic per (n, seed); a
+        # re-draw is only ever taken at small n / unlucky seeds.
+        if len(set(ds.labels.tolist())) == len(FASHION_CLASSES):
+            break
+        s += 1000003
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED) as z:
+        rows = ["path,label"]
+        for i in range(n_examples):
+            im = Image.fromarray(ds.images[i, :, :, 0], mode="L")
+            buf = io.BytesIO()
+            im.save(buf, format="PNG")
+            rel = f"images/{i:05d}.png"
+            z.writestr(rel, buf.getvalue())
+            rows.append(f"{rel},{FASHION_CLASSES[int(ds.labels[i])]}")
+        z.writestr("labels.csv", "\n".join(rows) + "\n")
+    # the zip loader sorts classes by NAME: re-map the oracle's labels
+    # to that ordering so callers can compare predictions directly
+    order = {c: i for i, c in enumerate(sorted(FASHION_CLASSES))}
+    remapped = np.asarray([order[FASHION_CLASSES[int(l)]]
+                           for l in ds.labels], np.int64)
+    return ImageClassificationDataset(ds.images, remapped,
+                                      len(FASHION_CLASSES),
+                                      sorted(FASHION_CLASSES))
+
+
 def generate_corpus_dataset(path: str, n_sentences: int = 400,
                             vocab_size: int = 200, n_tags: int = 8,
                             max_len: int = 12, seed: int = 0,
