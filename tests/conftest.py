@@ -37,3 +37,23 @@ import pytest  # noqa: E402
 @pytest.fixture()
 def tmp_workdir(tmp_path):
     return tmp_path
+
+
+@pytest.fixture(scope="session")
+def trained(tmp_path_factory):
+    """ONE tiny trained LM shared by every serving-side test file
+    (decode engine, draft speculation, kv-int8, multi-adapter,
+    streaming) — previously each file's module-scoped copy re-ran the
+    same training, ~5s a pop on the default leg. Tests treat it as
+    read-only: engines and dumps never mutate ``_params``."""
+    from test_decode_engine import KNOBS
+
+    from rafiki_tpu.data import generate_text_classification_dataset
+    from rafiki_tpu.models.llama_lora import LlamaLoRA
+
+    d = tmp_path_factory.mktemp("lm_shared")
+    tr = str(d / "train.jsonl")
+    generate_text_classification_dataset(tr, 64, seed=0)
+    m = LlamaLoRA(**KNOBS)
+    m.train(tr)
+    return m

@@ -28,16 +28,8 @@ KNOBS = {"max_epochs": 1, "vocab_size": 1 << 10, "hidden_dim": 32,
          "share_params": False}
 
 
-@pytest.fixture(scope="module")
-def trained(tmp_path_factory):
-    from rafiki_tpu.data import generate_text_classification_dataset
-
-    d = tmp_path_factory.mktemp("lm")
-    tr = str(d / "train.jsonl")
-    generate_text_classification_dataset(tr, 64, seed=0)
-    m = LlamaLoRA(**KNOBS)
-    m.train(tr)
-    return m
+# the `trained` fixture lives in conftest.py (session scope): one tiny
+# trained LM shared across every serving-side test file
 
 
 def _module_and_params(model):

@@ -8,7 +8,7 @@ import pytest
 
 from rafiki_tpu.models.llama_lora import LlamaLoRA
 
-from test_decode_engine import KNOBS, trained  # noqa: F401 — fixture
+from test_decode_engine import KNOBS  # noqa: F401 — shared knobs
 from test_multi_adapter import _lora_variant  # noqa: F401
 
 

@@ -5,6 +5,7 @@ is model-independent, so each micro-batch's objective divides by it)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from rafiki_tpu.data import generate_text_classification_dataset
 from rafiki_tpu.model import TrainContext
@@ -27,6 +28,7 @@ def _train(tmp_path, **extra):
     return m, ctx.logger.get_values("loss")
 
 
+@pytest.mark.slow
 def test_grad_accum_matches_big_batch_exactly(tmp_path):
     """Same data order, same init: grad_accum=4 must reproduce the
     big-batch parameters numerically (identical math, different

@@ -8,7 +8,7 @@ import numpy as np
 
 from rafiki_tpu.models.llama_lora import LlamaLoRA, greedy_generate
 
-from test_decode_engine import KNOBS, trained  # noqa: F401 — fixture
+from test_decode_engine import KNOBS  # noqa: F401 — shared knobs
 
 
 def test_kv_int8_cache_dtype_and_size(trained):  # noqa: F811

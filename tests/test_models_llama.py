@@ -460,10 +460,13 @@ def _max_intermediate_elems(closed_jaxpr) -> int:
     return best_ref[0]
 
 
+@pytest.mark.slow
 def test_chunked_lm_loss_matches_dense():
     """Streamed lm_head+CE: identical value/count/grads to the dense
     loss, with no (B, L, vocab)-sized intermediate anywhere in the
-    backward jaxpr (the whole point of the chunking)."""
+    backward jaxpr (the whole point of the chunking). Slow leg: ~16s
+    of CPU compile; the default leg keeps chunked-loss coverage via
+    test_grad_accum_composes_with_chunked_loss."""
     from rafiki_tpu.models.llama_lora import (chunked_lm_loss_terms,
                                               lm_loss_terms)
 
@@ -603,9 +606,12 @@ def test_quantized_module_logits_close():
     assert np.abs(lg - lgq).max() < 0.05 * max(1.0, np.abs(lg).max())
 
 
+@pytest.mark.slow
 def test_llama_serves_quantized(tmp_path):
     """quantize_int8 knob: predict() and the decode engine run on the
-    int8 tree; evaluate() stays full precision."""
+    int8 tree; evaluate() stays full precision. Slow leg: trains then
+    serves twice (~14s); the int8 kernel math keeps default-leg
+    coverage in test_kv_int8 / the LoRADense quantization tests."""
     tr = str(tmp_path / "t.jsonl")
     generate_text_classification_dataset(tr, 24, seed=0)
     model = LlamaLoRA(**{**TINY, "max_epochs": 1, "model_parallel": 1,
@@ -683,6 +689,7 @@ def test_sp_tp_forward_parity_untrained():
     _assert_sp_forward_matches_plain(model, (2, 2, 2), batch=4, seed=2)
 
 
+@pytest.mark.slow
 def test_sp_tp_forward_parity_ring_dispatch():
     """sp×tp with per-shard heads NOT divisible by sp: (data=1, sp=4,
     model=2) leaves 2 heads per TP shard against sp=4, forcing the

@@ -21,7 +21,7 @@ from rafiki_tpu.models.llama_lora import (LlamaLoRA, greedy_generate,
                                           stack_lora_adapters)
 from rafiki_tpu.serving.decode_engine import DecodeEngine
 
-from test_decode_engine import KNOBS, trained  # noqa: F401 — fixture
+from test_decode_engine import KNOBS  # noqa: F401 — shared knobs
 
 
 def _lora_variant(params, seed=7, scale=0.05):

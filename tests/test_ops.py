@@ -53,7 +53,10 @@ def test_flash_attention_kv_lens_matches_reference():
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_flash_attention_kv_lens_grads():
+    # slow leg: default varlen-grad coverage rides
+    # test_flash_attention_varlen_grads_multiblock_and_empty
     q = _rand(2, 2, 40, 16, key=3)
     k = _rand(2, 2, 40, 16, key=4)
     v = _rand(2, 2, 40, 16, key=5)
@@ -92,7 +95,10 @@ def test_flash_attention_kv_lens_under_jit_and_causal():
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_flash_attention_grads():
+    # slow leg: default full-path grad coverage rides the causal
+    # multiblock and multihead-block grad oracles
     q = _rand(1, 2, 64, 32, key=0)
     k = _rand(1, 2, 64, 32, key=1)
     v = _rand(1, 2, 64, 32, key=2)
@@ -109,6 +115,59 @@ def test_flash_attention_grads():
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("block_h,causal", [(2, False), (4, True)])
+def test_flash_attention_multihead_block_matches_reference(block_h,
+                                                           causal):
+    """block_h > 1 (multi-head-per-program forward — the short-seq
+    grid-overhead lever, VERDICT r4 item 3): exact vs the reference,
+    fwd AND grads (the backward reuses the per-head kernels on the
+    mh-written LSE residual), through the Pallas interpreter."""
+    q = _rand(2, 4, 100, 32, key=0)
+    k = _rand(2, 4, 100, 32, key=1)
+    v = _rand(2, 4, 100, 32, key=2)
+    out = flash_attention(q, k, v, causal=causal, interpret=True,
+                          block_h=block_h, block_q=64, block_k=64)
+    ref = _attention_reference(q, k, v, 1.0 / np.sqrt(32), causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(
+            q, k, v, causal=causal, interpret=True, block_h=block_h,
+            block_q=64, block_k=64) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_attention_reference(
+            q, k, v, 1.0 / np.sqrt(32), causal) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_flash_attention_multihead_block_varlen():
+    """block_h with kv_lens: every head row in a tile shares its
+    example's length, including an empty (len 0) example."""
+    q = _rand(3, 4, 64, 32, key=3)
+    k = _rand(3, 4, 64, 32, key=4)
+    v = _rand(3, 4, 64, 32, key=5)
+    lens = jnp.asarray([64, 17, 0], jnp.int32)
+    out = flash_attention(q, k, v, kv_lens=lens, interpret=True,
+                          block_h=2, block_q=64, block_k=64)
+    ref = _attention_reference(q, k, v, 1.0 / np.sqrt(32), False,
+                               kv_lens=lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_attention_block_h_must_divide_heads():
+    q = _rand(1, 4, 64, 32, key=6)
+    with pytest.raises(ValueError, match="block_h"):
+        flash_attention(q, q, q, interpret=True, block_h=3)
 
 
 def test_flash_attention_bf16():
@@ -333,7 +392,7 @@ def test_short_seq_dispatch_routes_to_xla(monkeypatch):
     monkeypatch.setattr(
         attn_mod, "_flash_attention_full",
         lambda *a, **kw: (calls.append("pallas"),
-                          real_full(*a[:3], *a[3:7], True))[1])
+                          real_full(*a[:7], True, *a[8:]))[1])
     # pretend the backend is a TPU so use_xla_fallback(None) is False
     monkeypatch.setattr(attn_mod, "use_xla_fallback",
                         lambda interpret: False)

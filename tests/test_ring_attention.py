@@ -196,8 +196,28 @@ def test_flash_attention_lse_matches_xla_twin():
                                        atol=3e-5, rtol=3e-5)
 
 
-@pytest.mark.parametrize("causal", [
-    False, pytest.param(True, marks=pytest.mark.slow)])
+def test_ring_gqa_fwd_matches_dense():
+    """Default-leg GQA ring exactness WITHOUT the grad compile (the
+    reverse-ring VJP costs ~25s of CPU compile; its oracle rides the
+    slow leg below plus the sp×tp head_axis test): only the small K/V
+    rotate, forward equals the dense oracle over repeated K/V."""
+    s, h, h_kv = 32, 4, 2
+    rep = h // h_kv
+    q = _rand(1, h, s, 8, key=10)
+    k = _rand(1, h_kv, s, 8, key=11)
+    v = _rand(1, h_kv, s, 8, key=12)
+    mesh = _mesh(2)
+    np.testing.assert_allclose(
+        np.asarray(ring_attention(q, k, v, mesh, "sp", causal=False)),
+        np.asarray(_attention_reference(
+            q, jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1),
+            1.0 / np.sqrt(8), False)), atol=2e-5, rtol=2e-5)
+    with pytest.raises(ValueError, match="multiple"):
+        ring_attention(q, _rand(1, 3, s, 8, key=13), v, mesh, "sp")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("causal", [False, True])
 def test_ring_gqa_matches_dense(causal):
     """GQA ring (kv_heads < heads): only the small K/V rotate; forward
     AND grads must equal the dense oracle over jnp.repeat'ed K/V —

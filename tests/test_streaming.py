@@ -19,7 +19,7 @@ from rafiki_tpu.serving.queues import InProcQueueHub
 from rafiki_tpu.store.param_store import ParamStore
 from rafiki_tpu.worker.inference import InferenceWorker
 
-from test_decode_engine import KNOBS, trained  # noqa: F401 — fixture
+from test_decode_engine import KNOBS  # noqa: F401 — shared knobs
 
 
 def test_engine_poll_partial_streams_exact_prefixes(trained):  # noqa: F811

@@ -71,6 +71,28 @@ def test_ulysses_refuses_indivisible_heads():
         ulysses_attention(q, q, q, mesh, "sp")
 
 
+def test_ulysses_gqa_head_axis_fwd_matches_dense():
+    """Default-leg sp×tp GQA exactness WITHOUT the grad compile (the
+    all-to-all VJP costs ~14s of CPU compile; the full fwd+grad
+    oracles for both per-shard pairings ride the slow leg below):
+    the small-swap pairing must stay aligned per TP shard."""
+    s, h, h_kv = 32, 8, 4
+    rep = h // h_kv
+    q = _rand(1, h, s, 8, key=30)
+    k = _rand(1, h_kv, s, 8, key=31)
+    v = _rand(1, h_kv, s, 8, key=32)
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                ("sp", "model"))
+    out = ulysses_attention(q, k, v, mesh, "sp", causal=True,
+                            head_axis="model")
+    ref = _attention_reference(
+        q, jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1),
+        1.0 / np.sqrt(8), True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.slow
 @pytest.mark.parametrize("h_kv", [
     4,   # small-swap×tp: per-shard kv heads 2 divide sp=2
     2,   # repeat-before-swap×tp: per-shard kv heads 1 don't divide
@@ -115,6 +137,7 @@ def test_ulysses_gqa_with_head_axis_matches_dense(h_kv):
                                    atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_ring_gqa_with_head_axis_matches_dense():
     """sp×tp ring oracle: head dim sharded over `model`, independent
     K/V rings per TP shard, GQA group-reduce on LOCAL shapes — fwd and
@@ -157,7 +180,9 @@ def test_ring_gqa_with_head_axis_matches_dense():
 
 
 @pytest.mark.parametrize("n_par,h_kv", [
-    (2, 4),   # small-swap path: kv heads divide the axis
+    # small-swap rides the slow leg — the default leg covers it (and
+    # its grads) under tensor parallelism via the head_axis test above
+    pytest.param(2, 4, marks=pytest.mark.slow),
     (4, 2),   # repeat-before-swap path: kv heads don't divide (2 % 4)
 ])
 def test_ulysses_gqa_matches_dense(n_par, h_kv):
