@@ -45,7 +45,7 @@ run_capped() {  # run_capped <cap_s> <cmd...>: abandon (not kill) overdue child
 # blocked inside the tunnel claim — launching another claimant alongside
 # them invites contention. Wait (up to ~30 min) for them to drain.
 for _ in $(seq 1 90); do
-  pgrep -f "bench.py --child|bench_extra.py --child|tune_vit_tpu.py|tune_attention_tpu.py" >/dev/null || break
+  pgrep -f "bench.py --child|bench_extra.py --child|tune_vit_tpu.py|tune_attention_tpu.py|profile_vit_tpu.py" >/dev/null || break
   echo "--- waiting for orphan claimants to drain $(date -u +%T)" >>"$LOG"
   sleep 20
 done
@@ -76,6 +76,9 @@ for i in $(seq 1 40); do
     echo "=== -> tune_attention sweep ===" >>"$LOG"
     run_capped 2400 python scripts/tune_attention_tpu.py
     echo "--- tune_attention rc=$?" >>"$LOG"
+    echo "=== -> profile (cost analysis + trace) ===" >>"$LOG"
+    run_capped 1200 python scripts/profile_vit_tpu.py 64 128 256
+    echo "--- profile rc=$?" >>"$LOG"
     echo "=== chain complete $(date -u +%T) ===" >>"$LOG"
     date -u +%F' '%T >"$DONEFILE"
     exit 0

@@ -30,35 +30,53 @@ STEP_GFLOP_PER_SAMPLE = 52.8
 PEAK_TFLOPS_BF16 = 197.0  # v5e
 
 
-def time_step(bs: int, dtype, attn: str, iters: int = 20,
-              remat: bool = False) -> dict:
+def build_step(bs: int, dtype, attn: str, remat: bool = False):
+    """The ONE ViT-B/16 donated-buffer adam train step every hardware
+    experiment measures (this sweep AND scripts/profile_vit_tpu.py —
+    a profiled step that silently differs from the benchmarked one
+    misdirects the MFU work). ``attn='xla'`` swaps the module's
+    attention to the pure-XLA reference — the config that holds the
+    r4 throughput record. Returns ``(step, params, opt_state, img,
+    lbl, restore)``; call ``restore()`` when done (monkeypatch)."""
+    restore = lambda: None  # noqa: E731
     if attn == "xla":
         orig = vit_mod.flash_attention
         vit_mod.flash_attention = (
             lambda q, k, v, *a, **kw: _attention_reference(
                 q, k, v, 1.0 / (q.shape[-1] ** 0.5), False))
+
+        def restore():
+            vit_mod.flash_attention = orig
+
+    module = vit_mod.ViT(patch_size=16, hidden_dim=768, depth=12,
+                         n_heads=12, mlp_dim=3072, n_classes=1000,
+                         dtype=dtype, remat=remat)
+    tx = optax.adam(1e-3)
+    img = jnp.zeros((bs, 224, 224, 3), jnp.bfloat16)
+    lbl = jnp.zeros((bs,), jnp.int32)
+    params = module.init(jax.random.PRNGKey(0), img[:1])["params"]
+    opt_state = tx.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, xb, yb):
+        def loss_fn(p):
+            logits = module.apply({"params": p}, xb)
+            return jnp.mean(
+                optax.softmax_cross_entropy_with_integer_labels(
+                    logits.astype(jnp.float32), yb))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return step, params, opt_state, img, lbl, restore
+
+
+def time_step(bs: int, dtype, attn: str, iters: int = 20,
+              remat: bool = False) -> dict:
+    step, params, opt_state, img, lbl, restore = build_step(
+        bs, dtype, attn, remat)
     try:
-        module = vit_mod.ViT(patch_size=16, hidden_dim=768, depth=12,
-                             n_heads=12, mlp_dim=3072, n_classes=1000,
-                             dtype=dtype, remat=remat)
-        tx = optax.adam(1e-3)
-        img = jnp.zeros((bs, 224, 224, 3), jnp.bfloat16)
-        lbl = jnp.zeros((bs,), jnp.int32)
-        params = module.init(jax.random.PRNGKey(0), img[:1])["params"]
-        opt_state = tx.init(params)
-
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def step(params, opt_state, xb, yb):
-            def loss_fn(p):
-                logits = module.apply({"params": p}, xb)
-                return jnp.mean(
-                    optax.softmax_cross_entropy_with_integer_labels(
-                        logits.astype(jnp.float32), yb))
-
-            loss, grads = jax.value_and_grad(loss_fn)(params)
-            updates, opt_state = tx.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state, loss
-
         t_c0 = time.perf_counter()
         params, opt_state, loss = step(params, opt_state, img, lbl)
         float(loss)
@@ -75,8 +93,7 @@ def time_step(bs: int, dtype, attn: str, iters: int = 20,
                 "samples_per_s": round(sps, 1), "mfu_pct": round(100 * mfu, 1),
                 "compile_s": round(compile_s, 1)}
     finally:
-        if attn == "xla":
-            vit_mod.flash_attention = orig
+        restore()
 
 
 def main() -> None:

@@ -133,6 +133,58 @@ def test_remat_identical_math_smaller_residuals():
         jax.make_jaxpr(jax.grad(loss(plain)))(params))
 
 
+def test_vit_train_step_matmuls_are_bf16():
+    """VERDICT r4 item 2 (confirm bf16 end-to-end): every LARGE
+    dot_general in the full train step's jaxpr — forward, backward,
+    and optimizer — must take bf16 operands. An f32 matmul lowers to
+    ~3x-cost multi-pass bf16 on the MXU, and one silent promotion
+    anywhere in the backward erases the sweep's bf16 win; the
+    shape-level activation checks above can't see the BACKWARD's
+    dtypes, this jaxpr walk can."""
+    import jax.numpy as jnp
+    import optax
+
+    m = ViT(patch_size=4, hidden_dim=64, depth=1, n_heads=4, mlp_dim=128,
+            n_classes=5, dtype=jnp.bfloat16)
+    x = jnp.zeros((2, 16, 16, 3), jnp.bfloat16)
+    y = jnp.zeros((2,), jnp.int32)
+    params = m.init(jax.random.PRNGKey(0), x)["params"]
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+
+    def step(params, opt_state, xb, yb):
+        def loss_fn(p):
+            logits = m.apply({"params": p}, xb)
+            return jnp.mean(
+                optax.softmax_cross_entropy_with_integer_labels(
+                    logits.astype(jnp.float32), yb))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    jaxpr = jax.make_jaxpr(step)(params, opt_state, x, y)
+    big_f32 = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name in ("dot_general",
+                                      "conv_general_dilated"):
+                avals = [v.aval for v in eqn.invars]
+                # "large" = MXU-relevant: skip the tiny logits/loss
+                # projections whose f32 math is deliberate
+                if max(int(np.prod(a.shape)) for a in avals) >= 1 << 14 \
+                        and any(a.dtype == jnp.float32 for a in avals):
+                    big_f32.append([(a.dtype, a.shape) for a in avals])
+            for v in eqn.params.values():
+                if hasattr(v, "jaxpr"):  # ClosedJaxpr
+                    walk(v.jaxpr)
+                elif hasattr(v, "eqns"):
+                    walk(v)
+    walk(jaxpr.jaxpr)
+    assert not big_f32, f"f32 matmuls in the bf16 train step: {big_f32}"
+
+
 def test_vit_bf16_dtype_invariants_shape_level():
     """Fast-leg twin of test_vit_bf16_compute_keeps_f32_params (slow):
     the same bf16-activations / f32-params / f32-logits invariant via
