@@ -57,6 +57,14 @@ LANES = 128
 # with RAFIKI_XLA_SHORT_SEQ (0 disables the short-seq route entirely);
 # explicit interpret=False always forces Mosaic lowering.
 XLA_SHORT_SEQ = int(os.environ.get("RAFIKI_XLA_SHORT_SEQ", "256"))
+# Fleet-applicable default for flash_attention's block_h (multi-head-
+# per-program forward): callers that don't pass block_h explicitly pick
+# this up, so a hardware sweep win (scripts/tune_attention_tpu.py) can
+# be applied to every template without code edits — e.g.
+# RAFIKI_ATTN_BLOCK_H=4 flips ViT/BERT onto the mh kernels (and, per
+# the dispatch rule below, off the short-seq XLA route). Default 1 =
+# per-head programs, today's measured-best configuration.
+ATTN_BLOCK_H = max(1, int(os.environ.get("RAFIKI_ATTN_BLOCK_H", "1")))
 
 
 def _attn_fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *lse_refs,
@@ -328,6 +336,8 @@ def _flash_attention_fwd_impl(q, k, v, kv_lens, sm_scale: float,
     b, h, s_q, d = q.shape
     s_kv = k.shape[2]
     interpret = _resolve_interpret(interpret)
+    if block_h < 1:
+        raise ValueError(f"block_h={block_h} must be >= 1")
     if block_h > 1 and h % block_h:
         raise ValueError(
             f"block_h={block_h} must divide heads ({h}): a head tile "
@@ -521,7 +531,8 @@ def _attention_reference(q, k, v, sm_scale: float, causal: bool,
 def flash_attention(q, k, v, sm_scale: Optional[float] = None,
                     causal: bool = False, block_q: int = 128,
                     block_k: int = 128, interpret: Optional[bool] = None,
-                    kv_lens=None, block_h: int = 1) -> jnp.ndarray:
+                    kv_lens=None,
+                    block_h: Optional[int] = None) -> jnp.ndarray:
     """Fused attention over (batch, heads, seq, head_dim) tensors.
 
     ``kv_lens`` (optional int32 [batch]) masks each example's keys past its
@@ -551,6 +562,8 @@ def flash_attention(q, k, v, sm_scale: Optional[float] = None,
     or ``interpret=False`` for Mosaic lowering.
     """
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if block_h is None:  # env-tunable fleet default (RAFIKI_ATTN_BLOCK_H)
+        block_h = ATTN_BLOCK_H
     # an explicit block_h>1 is a deliberate kernel-tuning choice FOR the
     # short-seq regime — it must not be silently dropped by the
     # short-seq XLA route (off-TPU fallback still applies)

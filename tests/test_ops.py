@@ -170,6 +170,30 @@ def test_flash_attention_block_h_must_divide_heads():
         flash_attention(q, q, q, interpret=True, block_h=3)
 
 
+def test_attn_block_h_env_default(monkeypatch):
+    """RAFIKI_ATTN_BLOCK_H applies fleet-wide without code edits:
+    callers that don't pass block_h pick up the env default, and the
+    env-driven block_h>1 disables the short-seq XLA route exactly like
+    an explicit one (so the tuned kernels actually run on TPU)."""
+    import rafiki_tpu.ops.attention as attn_mod
+
+    calls = []
+    real = attn_mod._flash_attention_full
+    monkeypatch.setattr(attn_mod, "ATTN_BLOCK_H", 2)
+    monkeypatch.setattr(
+        attn_mod, "_flash_attention_full",
+        lambda *a, **kw: (calls.append(a[8]), real(*a[:7], True,
+                                                   *a[8:]))[1])
+    monkeypatch.setattr(attn_mod, "use_xla_fallback",
+                        lambda interpret: False)
+    q = _rand(1, 4, 32, 16, key=7)  # short seq: XLA route iff block_h=1
+    out = attn_mod.flash_attention(q, q, q)
+    assert calls == [2], calls  # kernel path, env block_h applied
+    ref = _attention_reference(q, q, q, 1.0 / np.sqrt(16), False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
 def test_flash_attention_bf16():
     q = _rand(1, 2, 128, 64, key=0, dtype=jnp.bfloat16)
     k = _rand(1, 2, 128, 64, key=1, dtype=jnp.bfloat16)
