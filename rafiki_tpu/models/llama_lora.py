@@ -1226,6 +1226,84 @@ class LlamaLoRA(BaseModel):
             pipeline_microbatches=int(
                 self.knobs.get("pipeline_microbatches", 0) or 0))
 
+    def estimate_serving_device_bytes(self, max_slots: int = 8,
+                                      n_extra_adapters: int = 0,
+                                      draft: Optional["LlamaLoRA"] = None
+                                      ) -> Dict[str, int]:
+        """Per-device HBM budget for the continuous-batching decode
+        engine — the serving twin of :func:`estimate_train_device_bytes`
+        (admission control: an inference worker can refuse a deployment
+        whose engine would OOM at boot instead of dying mid-warmup).
+
+        - ``params``: EXACT when the model is loaded — byte count of
+          the actual serving tree (the int8 tree when ``quantize_int8``
+          is set), else the abstract f32 init.
+        - ``kv_cache``: max_slots x max_len x kv_heads x head_dim x
+          2 (K and V) x depth, at int8+f32-scales when
+          ``kv_cache_int8`` else the compute dtype. Multi-adapter
+          serving shares ONE cache (the stacked engine batches
+          tenants into the same slots).
+        - ``adapters``: stacked LoRA tensors for extra tenants
+          (adapter dims scale linearly in tenant count).
+        - ``draft``: the draft model's params + its own KV cache when
+          draft-model speculation is configured.
+        - ``working``: prefill-chunk activations + one (slots, vocab)
+          f32 logits buffer — the decode scan's live set.
+        """
+        k = self.knobs
+        hd, heads = int(k["hidden_dim"]), int(k["n_heads"])
+        kv_heads = max(1, heads // int(k["kv_ratio"]))
+        dh = hd // heads
+        L, depth = int(k["max_len"]), int(k["depth"])
+        act_bytes = 2 if bool(k.get("bf16", False)) else 4
+
+        if self._params is not None:
+            module, params = self._serving_module_params()
+            params_dev = sum(
+                int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+                for l in jax.tree_util.tree_leaves(params))
+            vocab = module.vocab_size
+        else:
+            module = self._module()
+            abstract = jax.eval_shape(
+                lambda: module.init(jax.random.PRNGKey(0),
+                                    jnp.zeros((1, L), jnp.int32)))
+            params_dev = sum(
+                int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+                for l in jax.tree_util.tree_leaves(abstract["params"]))
+            vocab = module.vocab_size
+
+        per_pos = kv_heads * dh
+        if bool(k.get("kv_cache_int8", False)):
+            # int8 rows + one f32 absmax scale per (slot, pos, head)
+            kv_dev = max_slots * L * depth * 2 * (per_pos + 4 * kv_heads)
+        else:
+            kv_dev = max_slots * L * depth * 2 * per_pos * act_bytes
+        adapters_dev = 0
+        if n_extra_adapters:
+            rank = int(k.get("lora_rank", 0) or 0)
+            # per LoRA site: a (in, r) + b (r, out); 7 sites per block
+            # (wq/wk/wv/wo/gate/up/down) + lm_head — linear in tenants
+            # 7 LoRA sites per block (wq/wk/wv/wo/gate/up/down); the
+            # lm_head is built rank-0 (no adapters stack there)
+            site_in_out = [
+                (hd, heads * dh), (hd, kv_heads * dh), (hd, kv_heads * dh),
+                (heads * dh, hd), (hd, 4 * hd), (hd, 4 * hd), (4 * hd, hd)]
+            per_adapter = depth * sum(
+                (i * rank + rank * o) * 4 for i, o in site_in_out)
+            adapters_dev = n_extra_adapters * per_adapter
+        draft_dev = 0
+        if draft is not None:
+            d = draft.estimate_serving_device_bytes(max_slots=max_slots)
+            draft_dev = d["params"] + d["kv_cache"]
+        working = (max_slots * 32 * hd * act_bytes  # prefill chunk
+                   + max_slots * vocab * 4)         # logits buffer
+        out = {"params": params_dev, "kv_cache": kv_dev,
+               "adapters": adapters_dev, "draft": draft_dev,
+               "working": working}
+        out["total"] = sum(out.values())
+        return out
+
     def _serving_module_params(self) -> Tuple[Llama, Any]:
         """(module, params) for predict()/make_decode_engine — the int8
         pair when the quantize_int8 knob is set (quantized once per

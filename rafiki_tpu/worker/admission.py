@@ -1,0 +1,44 @@
+"""Shared admission-control plumbing for the train and inference
+workers: resolve the per-device memory limit a budget estimate is
+checked against. The estimators themselves live with the templates
+(e.g. ``models/llama_lora.py``'s ``estimate_train_device_bytes`` /
+``estimate_serving_device_bytes``); the workers own the refusal
+semantics."""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional, Sequence
+
+log = logging.getLogger(__name__)
+
+
+def resolve_device_limit(devices: Optional[Sequence[Any]] = None
+                         ) -> Optional[int]:
+    """Bytes of device memory one trial/deployment may plan against.
+
+    Order: the ``RAFIKI_DEVICE_HBM_BYTES`` env override (a malformed
+    value warns and falls through — a config typo must not fail every
+    trial closed), then the accelerator's own
+    ``memory_stats()["bytes_limit"]`` on non-CPU platforms. ``None``
+    means "no limit known" (CPU hosts have elastic memory) and callers
+    skip their check."""
+    env = os.environ.get("RAFIKI_DEVICE_HBM_BYTES")
+    if env:
+        try:
+            return int(float(env))
+        except ValueError:
+            log.warning(
+                "RAFIKI_DEVICE_HBM_BYTES=%r is not a number; ignoring "
+                "it for admission control", env)
+    if devices is None:
+        import jax
+
+        devices = jax.local_devices()
+    if devices and getattr(devices[0], "platform", "cpu") != "cpu":
+        try:
+            return (devices[0].memory_stats() or {}).get("bytes_limit")
+        except Exception:  # noqa: BLE001 — stats are optional
+            return None
+    return None

@@ -61,6 +61,16 @@ class InferenceWorker:
         if params is None:
             raise KeyError(f"no parameters for trial {trial_id!r}")
         self.model.load_parameters(params)
+        # an (unloaded) draft twin sized from its knobs: its params +
+        # cache count toward admission via the estimator's eval_shape
+        # path, BEFORE any blob loads or engine builds
+        draft_for_admission = None
+        if draft_trial_id and decode_loop and speculate_k >= 2:
+            draft_for_admission = model_class(**(draft_knobs or knobs))
+        self._admission_check(
+            max_slots if decode_loop else 0,
+            len(extra_adapter_trials or ()) if decode_loop else 0,
+            draft_for_admission)
         self.engine = None
         if draft_trial_id and (not decode_loop or speculate_k < 2):
             # fail loudly, like the multi-adapter misconfigurations: an
@@ -151,6 +161,42 @@ class InferenceWorker:
                     "predict() micro-batcher instead of the continuous-"
                     "batching decode loop", model_class.__name__)
         self._warmup()
+
+    def _admission_check(self, max_slots: int, n_extra_adapters: int,
+                         draft=None) -> None:
+        """Refuse a deployment whose serving footprint (params + KV
+        cache + stacked adapters + draft params/cache + working set)
+        exceeds the device's HBM, BEFORE any engine build/compile —
+        the serving twin of the train worker's check. Templates opt in
+        by exposing ``estimate_serving_device_bytes``; the limit
+        resolution is shared (``worker.admission``). Micro-batch
+        deployments (no decode loop) pass ``max_slots=0``: no engine
+        means no KV cache to charge."""
+        est = getattr(self.model, "estimate_serving_device_bytes", None)
+        if est is None:
+            return
+        from .admission import resolve_device_limit
+
+        limit = resolve_device_limit()
+        if not limit:
+            return
+        try:
+            kwargs = {"max_slots": max_slots,
+                      "n_extra_adapters": n_extra_adapters}
+            if draft is not None:
+                kwargs["draft"] = draft
+            budget = est(**kwargs)
+            total = int(budget["total"])
+        except Exception:  # noqa: BLE001 — an estimator bug must
+            return  # never block an admissible deployment
+        if total > limit:
+            raise ValueError(
+                "serving admission control: estimated "
+                f"{total / 2**30:.2f}GiB footprint exceeds the "
+                f"{limit / 2**30:.2f}GiB device limit (breakdown: "
+                f"{ {k: round(v / 2**30, 3) for k, v in budget.items()} }"
+                " GiB); lower max_slots/max_len or enable "
+                "quantize_int8/kv_cache_int8")
 
     def _warmup(self) -> None:
         """Pre-compile the serving path at boot so the FIRST request

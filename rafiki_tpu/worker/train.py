@@ -247,31 +247,12 @@ class TrainWorker:
         est = getattr(model, "estimate_device_budget", None)
         if est is None:
             return
-        import os
-
         import jax
 
-        devs = self.devices or jax.local_devices()
-        limit = None
-        env = os.environ.get("RAFIKI_DEVICE_HBM_BYTES")
-        if env:
-            try:
-                limit = int(float(env))
-            except ValueError:
-                # a config typo must not fail every trial CLOSED: warn
-                # and fall through to the device's own stats (or skip)
-                import logging
+        from .admission import resolve_device_limit
 
-                logging.getLogger(__name__).warning(
-                    "RAFIKI_DEVICE_HBM_BYTES=%r is not a number; "
-                    "ignoring it for admission control", env)
-                env = None
-        if not limit and devs and \
-                getattr(devs[0], "platform", "cpu") != "cpu":
-            try:
-                limit = (devs[0].memory_stats() or {}).get("bytes_limit")
-            except Exception:  # noqa: BLE001 — stats are optional
-                limit = None
+        devs = self.devices or jax.local_devices()
+        limit = resolve_device_limit(devs)
         if not limit:
             return
         try:

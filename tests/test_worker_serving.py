@@ -641,6 +641,80 @@ def test_admission_check_with_real_llama_budget(monkeypatch):
         w._admission_check(model)
 
 
+def test_serving_byte_budget_terms():
+    """estimate_serving_device_bytes: params term equals the ACTUAL
+    loaded tree's bytes; the int8 KV knob shrinks the cache term; the
+    adapters term grows linearly in tenant count."""
+    import jax
+    import jax.numpy as jnp
+
+    from rafiki_tpu.models.llama_lora import LlamaLoRA
+
+    knobs = dict(max_epochs=1, vocab_size=1 << 10, hidden_dim=64,
+                 depth=2, n_heads=4, kv_ratio=2, lora_rank=4,
+                 max_len=32, batch_size=8, learning_rate=1e-2)
+    m = LlamaLoRA(**knobs)
+    m._params = m._module().init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    b = m.estimate_serving_device_bytes(max_slots=4)
+    measured = sum(int(np.prod(l.shape)) * l.dtype.itemsize
+                   for l in jax.tree_util.tree_leaves(m._params))
+    assert b["params"] == measured, (b["params"], measured)
+    # kv cache math: slots*L*depth*2*kv_heads*dh*4 (f32 compute)
+    assert b["kv_cache"] == 4 * 32 * 2 * 2 * 2 * 16 * 4, b
+    m8 = LlamaLoRA(**{**knobs, "kv_cache_int8": True})
+    m8._params = m._params
+    b8 = m8.estimate_serving_device_bytes(max_slots=4)
+    assert b8["kv_cache"] < b["kv_cache"], (b8, b)
+    b1 = m.estimate_serving_device_bytes(max_slots=4,
+                                         n_extra_adapters=1)
+    b3 = m.estimate_serving_device_bytes(max_slots=4,
+                                         n_extra_adapters=3)
+    assert b1["adapters"] > 0
+    assert b3["adapters"] == 3 * b1["adapters"]
+    # a draft model adds its params + cache
+    bd = m.estimate_serving_device_bytes(max_slots=4, draft=m8)
+    assert bd["draft"] >= b8["params"] + b8["kv_cache"]
+    # micro-batch deployments (no decode engine) charge no cache: the
+    # worker passes max_slots=0 when decode_loop is off
+    b0 = m.estimate_serving_device_bytes(max_slots=0)
+    assert b0["kv_cache"] == 0 and b0["working"] == 0
+    assert b0["total"] == b0["params"]
+
+
+def test_serving_admission_refuses_oversized_engine(monkeypatch):
+    """The inference worker refuses a deployment whose serving
+    footprint exceeds the device limit BEFORE building the engine —
+    and admits it again under a sane limit."""
+    import jax
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from rafiki_tpu.models.llama_lora import LlamaLoRA
+    from rafiki_tpu.serving.queues import InProcQueueHub
+    from rafiki_tpu.store.param_store import ParamStore
+    from rafiki_tpu.worker.inference import InferenceWorker
+    from test_decode_engine import KNOBS
+
+    lm = LlamaLoRA(**KNOBS)
+    lm._params = lm._module().init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    store = ParamStore()
+    store.save("t-adm", lm.dump_parameters())
+
+    monkeypatch.setenv("RAFIKI_DEVICE_HBM_BYTES", "4096")  # 4KiB
+    with _pytest.raises(ValueError, match="serving admission"):
+        InferenceWorker(LlamaLoRA, "t-adm", KNOBS, store,
+                        InProcQueueHub(), worker_id="w-adm",
+                        decode_loop=True, max_slots=4)
+    monkeypatch.setenv("RAFIKI_DEVICE_HBM_BYTES", str(64 << 30))
+    w = InferenceWorker(LlamaLoRA, "t-adm", KNOBS, store,
+                        InProcQueueHub(), worker_id="w-adm",
+                        decode_loop=True, max_slots=4)
+    assert w.engine is not None
+    w.stop()
+
+
 def test_per_request_max_new_clamped():
     """Clients control generation length via sampling.max_new, clamped
     by the worker's configured cap (slot-occupancy protection)."""
