@@ -277,7 +277,13 @@ class Admin:
                              ) -> Dict[str, Any]:
         """``budget`` options: ``STEPS_PER_SYNC`` (decode-loop dispatch
         amortization), ``MULTI_ADAPTER`` (serve the best-N LM trials as
-        one stacked-adapter worker instead of N replicas)."""
+        one stacked-adapter worker instead of N replicas),
+        ``ADAPTIVE_GATHER`` (latency/accuracy gather controller),
+        ``MAX_NEW_TOKENS`` / ``SYSTEM_PREFIX`` (decode-loop generation
+        cap / shared-prefix KV cache), ``SPECULATE_K`` (speculative
+        decoding: prompt-lookup drafting at depth K) and
+        ``DRAFT_TRIAL_ID`` (a completed same-template trial to use as
+        the draft MODEL instead of prompt lookup)."""
         job = self.meta.create_inference_job(user_id, train_job_id,
                                              budget=budget)
         self.services.create_inference_services(job["id"],

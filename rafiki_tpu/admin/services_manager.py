@@ -504,6 +504,53 @@ class ServicesManager:
                    # fused steps per device program, tunable per job
                    "steps_per_sync": int(budget.get("STEPS_PER_SYNC",
                                                     4))}
+            if budget.get("MAX_NEW_TOKENS"):
+                cfg["max_new_tokens"] = int(budget["MAX_NEW_TOKENS"])
+            if budget.get("SYSTEM_PREFIX"):
+                cfg["system_prefix"] = str(budget["SYSTEM_PREFIX"])
+            if decode_loop and budget.get("SPECULATE_K"):
+                # speculative decoding at the DEPLOYMENT surface:
+                # SPECULATE_K alone enables prompt-lookup drafting;
+                # DRAFT_TRIAL_ID names a (smaller) completed trial as
+                # the draft MODEL. The draft must be the same template
+                # (the engine's vocab check guards the rest); its own
+                # trial knobs shape it. Misconfigurations fail HERE at
+                # the API call, not as a crash-looping worker boot.
+                spec_k = int(budget["SPECULATE_K"])
+                if spec_k < 2:
+                    raise ValueError(
+                        f"SPECULATE_K={spec_k} must be >= 2 (draft "
+                        "window depth; 1 would verify nothing)")
+                cfg["speculate_k"] = spec_k
+                draft_id = str(budget.get("DRAFT_TRIAL_ID") or "")
+                if draft_id:
+                    d_trial = self.meta.get_trial(draft_id)
+                    if d_trial is None:
+                        raise KeyError(
+                            f"DRAFT_TRIAL_ID {draft_id!r} names no "
+                            "trial")
+                    d_sub = self.meta.get_sub_train_job(
+                        d_trial["sub_train_job_id"])
+                    if d_sub and d_sub["model_id"] != model["id"]:
+                        raise ValueError(
+                            f"DRAFT_TRIAL_ID {draft_id!r} is a "
+                            f"different model ({d_sub['model_id']}) "
+                            f"than the deployed {model['id']} — the "
+                            "draft must share the target's template/"
+                            "tokenizer")
+                    cfg["draft_trial_id"] = draft_id
+                    cfg["draft_knobs"] = d_trial["knobs"]
+            elif budget.get("DRAFT_TRIAL_ID") or budget.get(
+                    "SPECULATE_K"):
+                if not decode_loop:
+                    raise ValueError(
+                        "SPECULATE_K/DRAFT_TRIAL_ID require a "
+                        "language-modeling deployment (the decode "
+                        f"loop); task {model['task']} serves through "
+                        "the micro-batcher")
+                raise ValueError(
+                    "DRAFT_TRIAL_ID requires SPECULATE_K >= 2 (the "
+                    "draft window depth) in the same budget")
             if multi_adapter:
                 # the other best trials ride as stacked adapters 1..N
                 cfg["extra_adapter_trials"] = [t["id"]

@@ -142,6 +142,12 @@ class Client:
     def get_inference_job(self, job_id: str) -> Dict[str, Any]:
         return self._call("GET", f"/inference_jobs/{job_id}")
 
+    def get_inference_job_health(self, job_id: str) -> Dict[str, Any]:
+        """The predictor's live ``/health`` (req/s, latency
+        percentiles, per-worker engine/drop counters), proxied through
+        the admin — the dashboard's data source, usable from scripts."""
+        return self._call("GET", f"/inference_jobs/{job_id}/health")
+
     def stop_inference_job(self, job_id: str) -> None:
         self._call("POST", f"/inference_jobs/{job_id}/stop")
 

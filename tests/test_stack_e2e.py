@@ -270,3 +270,75 @@ def test_quickstart_fashion_archive_end_to_end(stack, tmp_path_factory):
                    for i, p in enumerate(preds)])
     assert acc >= 0.5, acc
     client.stop_inference_job(ijob["id"])
+
+
+@pytest.mark.slow
+def test_full_stack_speculative_deploy(stack):
+    """SPECULATE_K + DRAFT_TRIAL_ID through the REST stack: an LM job
+    trains two trials; the best deploys with the other completed trial
+    as its draft MODEL. The engine must actually run the speculative
+    path (stats counter) and still serve text. Misconfigurations
+    (DRAFT_TRIAL_ID without SPECULATE_K, SPECULATE_K < 2) must fail
+    the API call loudly, not crash-loop a worker."""
+    from rafiki_tpu.data import generate_text_classification_dataset
+    from rafiki_tpu.models.llama_lora import LlamaLoRA
+
+    client, work = stack
+    d = work / "spec_ds"
+    d.mkdir(exist_ok=True)
+    tr, va = str(d / "train.jsonl"), str(d / "val.jsonl")
+    generate_text_classification_dataset(tr, 64, seed=0)
+    generate_text_classification_dataset(va, 24, seed=1)
+
+    client.login("superadmin@rafiki", "rafiki")
+    model = client.create_model("llama-spec", "LANGUAGE_MODELING",
+                                LlamaLoRA)
+    job = client.create_train_job(
+        app="spec-app", task="LANGUAGE_MODELING",
+        train_dataset_id=tr, val_dataset_id=va,
+        budget={"TRIAL_COUNT": 2, "WORKER_COUNT": 1},
+        model_ids=[model["id"]],
+        train_args={"advisor": "random", "knob_overrides": {
+            "hidden_dim": 64, "depth": 2, "n_heads": 4, "kv_ratio": 2,
+            "lora_rank": 4, "max_len": 32, "model_parallel": 1,
+            "learning_rate": 1e-2, "batch_size": 8, "bf16": False,
+            "quick_train": True, "share_params": False}})
+    job = client.wait_until_train_job_finished(job["id"], timeout=600)
+    trials = [t for t in client.get_trials_of_train_job(job["id"])
+              if t["status"] == "COMPLETED"]
+    assert len(trials) >= 2, trials
+    best = client.get_best_trials_of_train_job(job["id"])
+    draft_id = next(t["id"] for t in trials if t["id"] != best[0]["id"])
+
+    # misconfigurations fail the API call, not a crash-looping worker
+    with pytest.raises(RuntimeError, match="SPECULATE_K"):
+        client.create_inference_job(
+            job["id"], max_workers=1,
+            budget={"DRAFT_TRIAL_ID": draft_id})
+    with pytest.raises(RuntimeError, match="SPECULATE_K"):
+        client.create_inference_job(
+            job["id"], max_workers=1,
+            budget={"SPECULATE_K": 1, "DRAFT_TRIAL_ID": draft_id})
+
+    ijob = client.create_inference_job(
+        job["id"], max_workers=1,
+        budget={"SPECULATE_K": 4, "DRAFT_TRIAL_ID": draft_id,
+                "MAX_NEW_TOKENS": 6})
+    preds = client.predict(ijob["predictor_url"],
+                           ["tok1 tok2 tok3"], timeout=180)
+    assert len(preds) == 1 and isinstance(preds[0], str) and preds[0]
+    # engine counters publish as engine_* keys every STATS_EVERY loop
+    # iterations — keep traffic flowing so the loop iterates (and the
+    # speculative path keeps running) until a snapshot lands
+    eng = {}
+    for i in range(30):
+        client.predict(ijob["predictor_url"],
+                       [f"tok{i % 5 + 1} tok2 tok3"], timeout=60)
+        health = client.get_inference_job_health(ijob["id"])
+        eng = next(iter(health.get("workers", {}).values()), {})
+        if eng.get("engine_spec_draft_model_calls", 0) or \
+                eng.get("engine_spec_calls", 0):
+            break
+    assert eng.get("engine_spec_draft_model_calls", 0) > 0 or \
+        eng.get("engine_spec_calls", 0) > 0, eng
+    client.stop_inference_job(ijob["id"])
