@@ -615,11 +615,20 @@ def chunked_lm_loss_terms(hidden: jnp.ndarray, head_kernel: jnp.ndarray,
     Sequence pads introduced to reach a chunk multiple are masked out of
     both the sum and the count.
     """
-    b, length, d = hidden.shape
     targets = jnp.pad(ids[:, 1:], ((0, 0), (0, 1)))
-    valid = lm_valid_mask(length, lens, example_mask)
-    count = jnp.sum(valid)
+    valid = lm_valid_mask(hidden.shape[1], lens, example_mask)
+    return (_chunked_ce_sum(hidden, targets, valid, head_kernel, chunk),
+            jnp.sum(valid))
 
+
+def _chunked_ce_sum(hidden: jnp.ndarray, targets: jnp.ndarray,
+                    valid: jnp.ndarray, head_kernel: jnp.ndarray,
+                    chunk: int) -> jnp.ndarray:
+    """The chunked projection+CE scan over precomputed targets/valid —
+    shared by the dense-path wrapper above and the sequence-parallel
+    variant below (which shards the SEQUENCE and must therefore shift
+    targets globally before partitioning)."""
+    b, length, d = hidden.shape
     chunk = max(1, min(int(chunk), length))
     pad = (-length) % chunk
     if pad:
@@ -645,7 +654,60 @@ def chunked_lm_loss_terms(hidden: jnp.ndarray, head_kernel: jnp.ndarray,
 
     total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
                             (hs, ts, vs))
-    return total, count
+    return total
+
+
+def chunked_lm_loss_terms_sp(hidden: jnp.ndarray,
+                             head_kernel: jnp.ndarray,
+                             ids: jnp.ndarray, lens: jnp.ndarray,
+                             example_mask: Optional[jnp.ndarray],
+                             chunk: int, mesh, data_axis: str,
+                             sp_axis: str
+                             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """:func:`chunked_lm_loss_terms` with the SEQUENCE dim sharded over
+    ``mesh[sp_axis]`` (the long-context train path) — previously the
+    two knobs were mutually exclusive because chunk slicing through
+    GSPMD would re-gather the sp-sharded activations every chunk.
+
+    The composition that avoids all gathers: the next-token SHIFT runs
+    globally first (targets/valid are (B, L) int/bool — trivial bytes —
+    and the shift is what crosses shard boundaries), then a
+    ``shard_map`` over (data, sp) hands each device its LOCAL
+    (B/dp, L/sp) slice of hidden/targets/valid; every device streams
+    its own chunks through the shared scan and the (sum, count) reduce
+    with one scalar ``psum``. The head kernel stays replicated (this
+    variant is for the dp×sp regime; sp×tp keeps the dense loss —
+    a vocab-sharded head inside the shard would need cross-axis
+    softmax reductions). Same math as the dense path up to f32
+    summation order."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from rafiki_tpu.ops.common import shard_map_kernels
+
+    targets = jnp.pad(ids[:, 1:], ((0, 0), (0, 1)))
+    valid = lm_valid_mask(hidden.shape[1], lens, example_mask)
+    sp = mesh.shape[sp_axis]
+    if hidden.shape[1] % sp:
+        raise ValueError(f"sequence {hidden.shape[1]} must divide the "
+                         f"sp axis ({sp}) for the sharded chunked loss")
+    chunk = max(1, min(int(chunk), hidden.shape[1] // sp))
+
+    h_spec = P(data_axis, sp_axis, None)
+    t_spec = P(data_axis, sp_axis)
+
+    @functools.partial(
+        shard_map_kernels, mesh=mesh,
+        in_specs=(h_spec, P(None, None), t_spec, t_spec),
+        out_specs=(P(), P()))
+    def _local(h_l, kernel, t_l, v_l):
+        total = _chunked_ce_sum(h_l, t_l, v_l, kernel, chunk)
+        count = jnp.sum(v_l)
+        return (jax.lax.psum(total, (data_axis, sp_axis)),
+                jax.lax.psum(count, (data_axis, sp_axis)))
+
+    hidden = jax.device_put(hidden, NamedSharding(mesh, h_spec))
+    return _local(hidden, head_kernel, targets,
+                  valid.astype(jnp.float32))
 
 
 def quantize_llama_params(params: Any) -> Any:
@@ -1085,8 +1147,10 @@ class LlamaLoRA(BaseModel):
             # builds a (data, sp, model) 3-axis mesh with the sp
             # collectives running within each TP head group (needs
             # n_heads and kv heads divisible by model_parallel).
+            # Composes with loss_chunk at model_parallel=1 (each shard
+            # streams its own loss chunks — chunked_lm_loss_terms_sp).
             # max_len must divide by it; mutually exclusive with
-            # pipeline_stages>1, MoE, and loss_chunk.
+            # pipeline_stages>1, MoE, and loss_chunk+model_parallel>1.
             "sequence_parallel": FixedKnob(1),
             # >1 pipelines the decoder blocks over this many devices
             # (GPipe microbatching, parallel/pipeline.py); depth must
@@ -1385,11 +1449,12 @@ class LlamaLoRA(BaseModel):
                                  "MoE blocks (experts would contend "
                                  "with the attention's sp collectives "
                                  "for the model axis)")
-            if int(self.knobs.get("loss_chunk", 0) or 0):
+            if int(self.knobs.get("loss_chunk", 0) or 0) and sp_tp > 1:
                 raise ValueError(
-                    "sequence_parallel>1 is incompatible with "
-                    "loss_chunk (chunk slicing would re-gather the "
-                    "sp-sharded sequence every chunk)")
+                    "loss_chunk with sequence_parallel requires "
+                    "model_parallel=1 (the sharded chunked loss keeps "
+                    "the head replicated; a vocab-sharded head inside "
+                    "the shard would need cross-axis softmax)")
             if len(devices) % (sp * sp_tp):
                 raise ValueError(
                     f"sequence_parallel={sp} x model_parallel={sp_tp} "
@@ -1660,9 +1725,17 @@ class LlamaLoRA(BaseModel):
                     {"params": p}, ib, lens=lb, mutable=["losses"],
                     return_hidden=True)
                 aux = moe_aux_loss(muts)
-                total, count = chunked_lm_loss_terms(
-                    hidden, p["lm_head"]["kernel"], ib, lb, mask,
-                    chunk=loss_chunk)
+                if sp > 1:
+                    # long-context composition: hidden's L is sharded
+                    # over `sp` — stream each shard's own chunks and
+                    # psum (no per-chunk re-gather)
+                    total, count = chunked_lm_loss_terms_sp(
+                        hidden, p["lm_head"]["kernel"], ib, lb, mask,
+                        loss_chunk, mesh, DATA_AXIS, "sp")
+                else:
+                    total, count = chunked_lm_loss_terms(
+                        hidden, p["lm_head"]["kernel"], ib, lb, mask,
+                        chunk=loss_chunk)
             else:
                 # mutable=["losses"]: MoE blocks sow their load-
                 # balance aux there; dense models sow nothing
