@@ -49,13 +49,30 @@ def main() -> None:
         job = client.create_train_job(
             app="llm-demo", task="LANGUAGE_MODELING",
             train_dataset_id=tr, val_dataset_id=va,
-            budget={"TRIAL_COUNT": 1},
+            budget={"TRIAL_COUNT": 2},
             model_ids=[model["id"]],
             train_args={"advisor": "random", "knob_overrides": SMALL})
         job = client.wait_until_train_job_finished(job["id"], timeout=900)
         print("train job:", job["status"])
 
-        ijob = client.create_inference_job(job["id"], max_workers=1)
+        # deploy the best trial WITH speculative decoding: the other
+        # completed trial serves as the draft MODEL (swap in a smaller
+        # parameterization for a real speedup; prompt-lookup drafting
+        # needs only SPECULATE_K). MAX_NEW_TOKENS caps generations.
+        trials = [t for t in client.get_trials_of_train_job(job["id"])
+                  if t["status"] == "COMPLETED"]
+        best_list = client.get_best_trials_of_train_job(job["id"])
+        if not best_list:
+            raise SystemExit(
+                f"no deployable trial (trials: "
+                f"{[t['status'] for t in trials] or 'none completed'})")
+        deploy_budget = {"SPECULATE_K": 4, "MAX_NEW_TOKENS": 8}
+        others = [t["id"] for t in trials
+                  if t["id"] != best_list[0]["id"]]
+        if others:
+            deploy_budget["DRAFT_TRIAL_ID"] = others[0]
+        ijob = client.create_inference_job(job["id"], max_workers=1,
+                                           budget=deploy_budget)
         url = ijob["predictor_url"]
         print("predictor:", url)
 
@@ -72,6 +89,23 @@ def main() -> None:
             t.start()
         for t in threads:
             t.join()
+
+        # live serving health: req/s, latency percentiles, and the
+        # engine's speculation counters (acceptance shows up here).
+        # Counters publish every ~50 worker-loop iterations — keep a
+        # little traffic flowing until a fresh snapshot lands.
+        w = {}
+        for i in range(40):
+            client.predict(url, [f"tok{i % 5 + 1} tok2"], timeout=60)
+            health = client.get_inference_job_health(ijob["id"])
+            w = next(iter(health.get("workers", {}).values()), {})
+            if w.get("engine_spec_calls", 0):
+                break
+        print("speculative calls:",
+              w.get("engine_spec_draft_model_calls")
+              or w.get("engine_spec_calls", 0),
+              "accepted:", w.get("engine_spec_accepted", 0),
+              "drafted:", w.get("engine_spec_drafted", 0))
 
         # seeded sampling: reproducible under any serving load
         samp = {"temperature": 0.8, "top_k": 40, "seed": 1234}
@@ -93,6 +127,7 @@ def main() -> None:
                       f"(partial: {ev.get('partial')})")
             elif ev.get("done"):
                 print("\nfinal:", (ev.get("predictions") or [""])[0])
+
         client.stop_inference_job(ijob["id"])
 
 
