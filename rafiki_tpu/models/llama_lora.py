@@ -1444,11 +1444,12 @@ class LlamaLoRA(BaseModel):
                 raise ValueError(
                     "sequence_parallel>1 is mutually exclusive with "
                     "pipeline_stages>1 (pick sp[×tp]×dp or pp×dp)")
-            if int(self.knobs.get("moe_experts", 0)):
-                raise ValueError("sequence_parallel>1 does not support "
-                                 "MoE blocks (experts would contend "
-                                 "with the attention's sp collectives "
-                                 "for the model axis)")
+            if int(self.knobs.get("moe_experts", 0)) and sp_tp == 1:
+                raise ValueError(
+                    "moe_experts with sequence_parallel requires "
+                    "model_parallel>1: experts shard over the `model` "
+                    "axis, which the dp x sp mesh lacks (the 3-axis "
+                    "dp x sp x model mesh carries both)")
             if int(self.knobs.get("loss_chunk", 0) or 0) and sp_tp > 1:
                 raise ValueError(
                     "loss_chunk with sequence_parallel requires "

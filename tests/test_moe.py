@@ -277,3 +277,40 @@ def test_moe_layer_grads_flow_fast():
     experts = [v for n, v in leaves.items() if "experts" in n]
     assert router and max(router) > 0
     assert experts and max(experts) > 0
+
+
+@pytest.mark.slow
+def test_moe_sp_tp_forward_parity():
+    """MoE under the 3-axis dp x sp x tp mesh (experts over `model`,
+    activation L over `sp`, ulysses attention in the head group):
+    logits equal the plain single-device module — the exactness basis
+    for relaxing the MoE x sequence_parallel exclusion."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from rafiki_tpu.models.llama_lora import TP_RULES, Llama
+    from rafiki_tpu.parallel.sharding import param_shardings
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                ("data", "sp", "model"))
+    kw = dict(vocab_size=256, max_len=32, hidden_dim=32, depth=2,
+              n_heads=4, n_kv_heads=2, mlp_dim=64, lora_rank=4,
+              n_experts=2)
+    m_sp = Llama(**kw, seq_mesh=mesh, seq_axis="sp", head_axis="model")
+    m_plain = Llama(**kw)
+    ids = np.random.RandomState(0).randint(
+        1, 200, size=(4, 32)).astype(np.int32)
+    params = m_plain.init(jax.random.PRNGKey(0),
+                          jnp.asarray(ids))["params"]
+    shardings = param_shardings(params, mesh, tp_rules=TP_RULES,
+                                fsdp=True, min_size=0)
+    params_s = jax.tree_util.tree_map(jax.device_put, params, shardings)
+    ids_s = jax.device_put(jnp.asarray(ids),
+                           NamedSharding(mesh, P("data", "sp")))
+    with mesh:
+        ref, _ = m_plain.apply({"params": params}, jnp.asarray(ids),
+                               mutable=["losses"])
+        got, _ = m_sp.apply({"params": params_s}, ids_s,
+                            mutable=["losses"])
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=3e-4, rtol=3e-4)
