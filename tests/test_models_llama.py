@@ -282,6 +282,34 @@ def test_byte_budget_matches_measured_small_build():
         (budget["opt"], meas_opt)
 
 
+def test_byte_budget_sp_axes_shrink_activations():
+    """The estimator's sequence-parallel branches: sharding L over sp
+    divides the activation term (tokens_dev drops), and the sp×tp
+    3-axis mesh additionally shards the params — the budget math the
+    admission check relies on for long-context jobs."""
+    from rafiki_tpu.models.llama_lora import estimate_train_device_bytes
+
+    module = Llama(vocab_size=2048, max_len=128, hidden_dim=128,
+                   depth=2, n_heads=4, n_kv_heads=2, mlp_dim=256,
+                   lora_rank=4)
+    # sp's value: when batch can't shard further (dp fixed), adding sp
+    # devices divides each device's token count — the long-context
+    # regime. (At a FIXED total device count per-device tokens are
+    # invariant to the dp/sp split; that's not what sp is for.)
+    base = estimate_train_device_bytes(module, batch_size=8,
+                                       data_parallel=2)
+    sp = estimate_train_device_bytes(module, batch_size=8,
+                                     data_parallel=2,
+                                     sequence_parallel=4)
+    assert sp["activations"] < base["activations"], (sp, base)
+    sptp = estimate_train_device_bytes(module, batch_size=8,
+                                       data_parallel=2,
+                                       sequence_parallel=2,
+                                       model_parallel=2)
+    # tp shards the big leaves the dp-only fsdp couldn't split further
+    assert sptp["params"] < sp["params"], (sptp, sp)
+
+
 def test_byte_budget_pipeline_mode_counts_replicated_params():
     """Pipeline mode replicates the param tree per device (train()'s
     rep_pp layout) — the estimator must charge the FULL tree, not the
