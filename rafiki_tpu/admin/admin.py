@@ -51,8 +51,12 @@ class Admin:
                 try:
                     self.services.poll()
                     self._finalize_finished_train_jobs()
-                except Exception:  # keep the monitor alive
-                    pass
+                except Exception:  # keep the monitor alive — but a
+                    # broken poll loop must be visible, not silent
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "service monitor tick failed", exc_info=True)
 
         self._monitor = threading.Thread(target=loop, daemon=True)
         self._monitor.start()

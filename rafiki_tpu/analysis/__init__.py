@@ -1,0 +1,33 @@
+"""``rafiki_tpu.analysis`` — domain-aware static analysis (``rafiki-tpu lint``).
+
+Python's type system and generic linters cannot see the hazard classes
+that actually break a hand-your-model-over platform like this one:
+host-device syncs hiding inside ``jax.jit`` bodies, scalar branches on
+tracers, module state mutated from serving threads without the lock the
+rest of the class holds, and ``except:`` blocks that eat the only
+evidence of a fleet-wide regression. This package is an AST-based rule
+engine targeting exactly those classes, run over ``rafiki_tpu/`` itself
+by a tier-1 test (``tests/test_lint.py``) so the repo stays self-clean
+and every future PR is gated.
+
+Public API:
+
+- :func:`analyze_paths` / :func:`analyze_source` — run all (or selected)
+  rules, returning :class:`Finding` objects.
+- :class:`Rule`, :func:`register` — the rule framework; see
+  ``docs/linting.md`` for how to add a rule.
+- ``# rafiki: noqa[rule-id]`` on a finding's line suppresses it.
+"""
+
+from .engine import (Finding, Rule, all_rules, analyze_paths,
+                     analyze_source, get_rule, register)
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "all_rules",
+    "analyze_paths",
+    "analyze_source",
+    "get_rule",
+    "register",
+]

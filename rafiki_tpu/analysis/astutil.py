@@ -1,0 +1,201 @@
+"""Shared AST helpers for lint rules.
+
+The heavy lifting every JAX rule needs is the *traced-function set*:
+which ``def``s in this module execute under ``jax.jit`` / ``pjit`` /
+``shard_map`` tracing. That is where host-sync and tracer-branch
+hazards live — the same call that is free in eager Python is a
+device round-trip (or a ConcretizationTypeError) once traced.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+#: decorator / wrapper spellings that mean "this function is traced".
+JIT_NAMES = {
+    "jit", "jax.jit", "pjit", "jax.pjit", "jax.experimental.pjit.pjit",
+    "eqx.filter_jit", "nn.jit",
+}
+SHARD_MAP_NAMES = {
+    "shard_map", "jax.shard_map", "jax.experimental.shard_map.shard_map",
+    "shard_map_kernels", "shard_map_checked",
+}
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain; None for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def chain_root(node: ast.AST) -> Optional[ast.AST]:
+    """Innermost value of an attribute chain (``a`` for ``a.b.c``)."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node
+
+
+def attr_depth(node: ast.Attribute) -> int:
+    """Number of attribute hops: ``a.b`` -> 1, ``a.b.c`` -> 2."""
+    depth = 0
+    cur: ast.AST = node
+    while isinstance(cur, ast.Attribute):
+        depth += 1
+        cur = cur.value
+    return depth
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted(node.func)
+
+
+def param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    names.extend(p.arg for p in a.kwonlyargs)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _const_strs(node: ast.AST) -> Set[str]:
+    """String constants in a literal or tuple/list of literals."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            out.add(n.value)
+    return out
+
+
+def _const_ints(node: ast.AST) -> Set[int]:
+    out: Set[int] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, int):
+            out.add(n.value)
+    return out
+
+
+@dataclasses.dataclass
+class TracedInfo:
+    """How a function came to be traced, plus its static/donated args."""
+
+    fn: ast.AST  # FunctionDef | AsyncFunctionDef
+    via: str  # the jit/shard_map spelling that captured it
+    static_names: Set[str]
+    donated: bool  # any donate_argnums/donate_argnames present
+    decorator: Optional[ast.AST] = None  # the decorator node, if any
+
+
+def _jit_call_info(call: ast.Call, fn: ast.AST) -> Tuple[Set[str], bool]:
+    """static_argnames/nums + donation flag from a jit(...) call node."""
+    static: Set[str] = set()
+    donated = False
+    params = param_names(fn)
+    for kw in call.keywords:
+        if kw.arg in ("static_argnames",):
+            static |= _const_strs(kw.value)
+        elif kw.arg in ("static_argnums",):
+            for i in _const_ints(kw.value):
+                if 0 <= i < len(params):
+                    static.add(params[i])
+        elif kw.arg in ("donate_argnums", "donate_argnames"):
+            donated = True
+    return static, donated
+
+
+def _match_traced_decorator(
+        node: ast.AST) -> Optional[Tuple[str, Optional[ast.Call], bool]]:
+    """Is this decorator a tracing transform? Returns
+    ``(spelling, call|None, is_jit)``.
+
+    Matches ``jax.jit``, ``jax.jit(...)`` (decorator-with-args),
+    ``functools.partial(jax.jit, ...)``, and the shard_map spellings in
+    the same three forms — ``@partial(shard_map_kernels, mesh=...)`` is
+    how every in-repo shard_map body is written.
+    """
+    name = dotted(node)
+    if name in JIT_NAMES:
+        return name, None, True
+    if name in SHARD_MAP_NAMES:
+        return name, None, False
+    if isinstance(node, ast.Call):
+        fname = dotted(node.func)
+        if fname in JIT_NAMES:
+            return fname, node, True
+        if fname in SHARD_MAP_NAMES:
+            return fname, node, False
+        if fname in ("functools.partial", "partial") and node.args:
+            inner = dotted(node.args[0])
+            if inner in JIT_NAMES:
+                return inner, node, True
+            if inner in SHARD_MAP_NAMES:
+                return inner, node, False
+    return None
+
+
+def traced_functions(tree: ast.Module) -> Dict[ast.AST, TracedInfo]:
+    """All function defs in the module that run under JAX tracing.
+
+    Three capture forms:
+    - decorated: ``@jax.jit`` / ``@partial(jax.jit, ...)``
+    - wrapped by call: ``step = jax.jit(step_fn)`` or ``jax.jit(f)(x)``
+    - handed to shard_map: ``shard_map(f, mesh=...)`` (first arg)
+    """
+    by_name: Dict[str, ast.AST] = {}
+    out: Dict[ast.AST, TracedInfo] = {}
+    fns = [n for n in ast.walk(tree)
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for fn in fns:
+        by_name[fn.name] = fn  # last def wins; fine for lint purposes
+        for dec in fn.decorator_list:
+            m = _match_traced_decorator(dec)
+            if m is None:
+                continue
+            via, call, is_jit = m
+            static, donated = (_jit_call_info(call, fn)
+                               if is_jit and call is not None
+                               else (set(), False))
+            out[fn] = TracedInfo(fn, via, static, donated, decorator=dec)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = dotted(node.func)
+        is_jit = fname in JIT_NAMES
+        is_smap = fname in SHARD_MAP_NAMES
+        if not (is_jit or is_smap) or not node.args:
+            continue
+        target = node.args[0]
+        fn = by_name.get(target.id) if isinstance(target, ast.Name) \
+            else None  # lambdas and inline expressions aren't analyzed
+        if fn is None or fn in out:
+            continue
+        static, donated = _jit_call_info(node, fn) if is_jit else (set(),
+                                                                   False)
+        out[fn] = TracedInfo(fn, fname or "", static, donated)
+    return out
+
+
+def body_nodes(fn: ast.AST, skip=()):
+    """Walk a function's body WITHOUT descending into the defs in
+    ``skip`` — pass the module's traced-function set so a nested def
+    that is independently captured (its own ``@jax.jit`` etc.) is
+    reported once, under its own entry, not twice. Plain nested defs
+    are included: they trace with the parent."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef,
+                             ast.AsyncFunctionDef)) and node in skip:
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))

@@ -1,0 +1,87 @@
+"""Command-line front end for the lint engine.
+
+Three consumers share this module: ``rafiki-tpu lint`` (the subcommand
+in :mod:`rafiki_tpu.cli`), the ``rafiki-tpu-lint`` console entry
+(pyproject), and ``scripts/lint.py`` (repo checkout, no install). All
+of them parse the same flags and exit with the same contract:
+
+- 0 — no unsuppressed findings (the CI gate passes)
+- 1 — findings (printed to stdout, text or ``--format json``)
+- 2 — usage/IO error (bad rule id, unreadable path)
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from .engine import (all_rules, analyze_paths, get_rule, render_json,
+                     render_text)
+
+
+def add_lint_arguments(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "paths", nargs="*", default=["rafiki_tpu"],
+        help="files or directories to analyze (default: rafiki_tpu)")
+    parser.add_argument(
+        "--format", choices=["text", "json"], default="text",
+        help="finding output format")
+    parser.add_argument(
+        "--select", default=None, metavar="RULE[,RULE...]",
+        help="run only these rule ids (default: all registered rules)")
+    parser.add_argument(
+        "--show-suppressed", action="store_true",
+        help="include findings silenced by `# rafiki: noqa[...]` "
+             "comments (they then count toward the exit code — an "
+             "audit mode, not the CI gate)")
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the registered rules and exit")
+
+
+def run_lint(args: argparse.Namespace) -> int:
+    if args.list_rules:
+        for rule_id, rule in sorted(all_rules().items()):
+            print(f"{rule_id} [{rule.category}/{rule.severity}]\n"
+                  f"    {rule.description}")
+        return 0
+    select = None
+    if args.select:
+        select = [r.strip() for r in args.select.split(",") if r.strip()]
+        try:
+            for rule_id in select:  # validate ids up front: usage error
+                get_rule(rule_id)
+        except KeyError as e:
+            # KeyError's str() wraps its message in quotes; unwrap
+            print(f"rafiki-tpu lint: {e.args[0]}", file=sys.stderr)
+            return 2
+    try:
+        findings = analyze_paths(args.paths, select=select,
+                                 with_suppressed=args.show_suppressed)
+    except OSError as e:
+        # str(OSError) keeps errno text AND the path; a rule bug
+        # (any other exception) propagates with its traceback instead
+        # of masquerading as a usage error
+        print(f"rafiki-tpu lint: {e}", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(render_json(findings))
+    elif findings:
+        print(render_text(findings))
+    else:
+        print("clean: no findings")
+    return 1 if findings else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="rafiki-tpu-lint",
+        description="JAX/concurrency-aware static analysis for the "
+                    "rafiki-tpu codebase (see docs/linting.md)")
+    add_lint_arguments(parser)
+    return run_lint(parser.parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,277 @@
+"""Rule framework + driver for ``rafiki-tpu lint``.
+
+The engine is deliberately tiny: a rule is a class with an ``id``, a
+``severity``, and a ``check(ctx)`` generator over one parsed module.
+Everything stateful (source text, AST, parent links, suppression
+comments) lives in :class:`ModuleContext`, built once per file and
+shared by every rule — rules never re-read the file or re-parse.
+
+Suppression follows the repo-wide comment dialect::
+
+    risky_line()  # rafiki: noqa[silent-except]
+    other_line()  # rafiki: noqa          (blanket — any rule)
+
+A suppression must sit on the finding's own line (or the first line of
+the multi-line statement that produced it); file-wide opt-outs are
+intentionally not offered — they rot.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence
+
+#: suppression comment: ``# rafiki: noqa`` or ``# rafiki: noqa[a, b]``.
+#: The lookahead rejects malformed forms (``noqa[rule`` without ``]``,
+#: ``noqaX``) rather than silently widening them to a blanket
+#: suppression of every rule on the line.
+_NOQA_RE = re.compile(r"#\s*rafiki:\s*noqa(?:\[([^\]]*)\]|(?![\w\[-]))")
+
+SEVERITIES = ("error", "warning")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic, pinned to a file location."""
+
+    rule: str
+    severity: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.severity}] {self.rule}: {self.message}")
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+class ModuleContext:
+    """Everything a rule may inspect about one module, parsed once."""
+
+    def __init__(self, source: str, path: str):
+        self.source = source
+        self.path = path
+        self.tree = ast.parse(source, filename=path)
+        self.lines = source.splitlines()
+        # parent links: rules constantly ask "am I inside X?"
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self._noqa = _collect_noqa(source)
+        self._traced = None  # lazy; see traced()
+
+    def traced(self):
+        """The module's traced-function map
+        (:func:`rafiki_tpu.analysis.astutil.traced_functions`),
+        computed once and shared by every JAX rule."""
+        if self._traced is None:
+            from .astutil import traced_functions
+
+            self._traced = traced_functions(self.tree)
+        return self._traced
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def enclosing_function(
+            self, node: ast.AST) -> Optional[ast.AST]:
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def suppressed(self, rule_id: str, line: int) -> bool:
+        ids = self._noqa.get(line)
+        if ids is None:
+            return False
+        return not ids or rule_id in ids
+
+
+def _collect_noqa(source: str) -> Dict[int, frozenset]:
+    """Map line number -> suppressed rule ids (empty set = blanket).
+
+    Uses the tokenizer, not a per-line regex, so a ``# rafiki: noqa``
+    inside a string literal is NOT a suppression.
+    """
+    out: Dict[int, frozenset] = {}
+    try:
+        tokens = tokenize.generate_tokens(iter(source.splitlines(True)).__next__)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _NOQA_RE.search(tok.string)
+            if not m:
+                continue
+            ids = frozenset(
+                part.strip() for part in (m.group(1) or "").split(",")
+                if part.strip())
+            out[tok.start[0]] = ids
+    except tokenize.TokenError:
+        pass  # unterminated string etc. — the parse error is reported
+    return out
+
+
+class Rule:
+    """Base class for lint rules.
+
+    Subclasses set ``id`` (kebab-case, stable — it is the suppression
+    key), ``category`` (``jax`` | ``concurrency`` | ``robustness``),
+    ``severity``, and a one-line ``description`` (shown by
+    ``lint --list-rules`` and used in docs). ``check`` yields
+    ``(node, message)`` pairs; the engine attaches location, severity,
+    and suppression handling.
+    """
+
+    id: str = ""
+    category: str = ""
+    severity: str = "error"
+    description: str = ""
+
+    def check(self, ctx: ModuleContext):  # pragma: no cover - interface
+        raise NotImplementedError
+        yield  # noqa: unreachable — marks this as a generator
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator adding a rule to the global registry."""
+    if not cls.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if cls.severity not in SEVERITIES:
+        raise ValueError(f"rule {cls.id}: bad severity {cls.severity!r}")
+    if cls.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {cls.id!r}")
+    _REGISTRY[cls.id] = cls()
+    return cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    """The registry, loading the built-in rule modules on first use."""
+    from . import rules  # noqa: F401 — import side effect registers
+
+    return dict(_REGISTRY)
+
+
+def get_rule(rule_id: str) -> Rule:
+    rules = all_rules()
+    if rule_id not in rules:
+        raise KeyError(
+            f"unknown rule {rule_id!r} (known: {', '.join(sorted(rules))})")
+    return rules[rule_id]
+
+
+def _resolve_rules(select: Optional[Sequence[str]]) -> List[Rule]:
+    rules = all_rules()
+    if select is None:
+        return list(rules.values())
+    return [get_rule(r) for r in select]
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   select: Optional[Sequence[str]] = None,
+                   with_suppressed: bool = False) -> List[Finding]:
+    """Run rules over one module's source; returns sorted findings.
+
+    ``with_suppressed`` keeps ``# rafiki: noqa``-silenced findings in
+    the result (used by the suppression tests and ``--show-suppressed``).
+    """
+    try:
+        ctx = ModuleContext(source, path)
+    except SyntaxError as e:
+        return [Finding("parse-error", "error", path, e.lineno or 1,
+                        (e.offset or 1) - 1,
+                        f"could not parse: {e.msg}")]
+    findings: List[Finding] = []
+    for rule in _resolve_rules(select):
+        for node, message in rule.check(ctx):
+            line = getattr(node, "lineno", 1)
+            col = getattr(node, "col_offset", 0)
+            if not with_suppressed and ctx.suppressed(rule.id, line):
+                continue
+            findings.append(Finding(rule.id, rule.severity, path,
+                                    line, col, message))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+#: directories never worth descending into when walking a tree
+_SKIP_DIRS = {"__pycache__", ".git", ".eggs", "build", "dist",
+              "node_modules"}
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+            continue
+        if not os.path.isdir(path):
+            # fail loudly per-path: one typo'd argument must not make
+            # the gate report "clean" on a tree it never visited
+            raise OSError(f"no such file or directory: {path!r}")
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS
+                             and not d.endswith(".egg-info"))
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    yield os.path.join(root, name)
+
+
+def analyze_paths(paths: Iterable[str],
+                  select: Optional[Sequence[str]] = None,
+                  with_suppressed: bool = False) -> List[Finding]:
+    """Run rules over files/trees; nonexistent paths raise ``OSError``."""
+    findings: List[Finding] = []
+    seen = False
+    for path in iter_python_files(paths):
+        seen = True
+        with open(path, "rb") as f:
+            raw = f.read()
+        try:
+            source = raw.decode("utf-8")
+        except UnicodeDecodeError as e:
+            # a finding, not a crash: the gate must report the file and
+            # exit 1, not unwind with a traceback
+            findings.append(Finding("parse-error", "error", path, 1, 0,
+                                    f"not valid UTF-8: {e}"))
+            continue
+        findings.extend(analyze_source(source, path, select=select,
+                                       with_suppressed=with_suppressed))
+    if not seen:
+        raise OSError(f"no python files under {list(paths)!r}")
+    return findings
+
+
+def render_text(findings: Sequence[Finding]) -> str:
+    lines = [f.format() for f in findings]
+    n_err = sum(1 for f in findings if f.severity == "error")
+    n_warn = len(findings) - n_err
+    lines.append(f"{len(findings)} finding(s): {n_err} error(s), "
+                 f"{n_warn} warning(s)")
+    return "\n".join(lines)
+
+
+def render_json(findings: Sequence[Finding]) -> str:
+    return json.dumps({
+        "findings": [f.to_dict() for f in findings],
+        "counts": {
+            "total": len(findings),
+            "error": sum(1 for f in findings if f.severity == "error"),
+            "warning": sum(1 for f in findings
+                           if f.severity == "warning"),
+        },
+    }, indent=2)

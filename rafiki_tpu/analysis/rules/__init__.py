@@ -1,0 +1,12 @@
+"""Built-in lint rules; importing this package registers them all.
+
+One module per hazard category (mirrors ``docs/linting.md``):
+
+- :mod:`jax_tracing` — hazards that only exist under ``jax.jit`` /
+  ``pjit`` / ``shard_map`` tracing.
+- :mod:`concurrency` — shared-state hazards across the serving/worker
+  threads.
+- :mod:`robustness` — error-handling and library-internals hazards.
+"""
+
+from . import concurrency, jax_tracing, robustness  # noqa: F401

@@ -1,0 +1,189 @@
+"""JAX tracing-hazard rules.
+
+All three rules key off the module's *traced-function set*
+(:func:`rafiki_tpu.analysis.astutil.traced_functions`): functions
+decorated with / wrapped by ``jax.jit``/``pjit`` or handed to
+``shard_map``. The same Python that is harmless eager becomes a
+device round-trip, a silent recompile, or a
+``ConcretizationTypeError`` once traced — which is why generic
+linters never flag it.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import JIT_NAMES, body_nodes, dotted, param_names
+from ..engine import Rule, register
+
+#: method calls that force the host to wait on (or copy from) the device
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+#: numpy entry points that pull a tracer/device buffer to host memory
+_HOST_FUNCS = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "onp.asarray", "onp.array", "np.copy", "numpy.copy",
+}
+#: builtins that concretize a tracer to a Python scalar
+_SCALAR_BUILTINS = {"float", "int", "bool", "complex"}
+
+#: annotations that mark a parameter as compile-time config, not data —
+#: branching on those is resolved at trace time, not on a tracer
+_STATIC_ANNOTATIONS = {"bool", "int", "str", "float"}
+
+
+def _param_annotations(fn: ast.AST) -> dict:
+    out = {}
+    a = fn.args
+    for p in a.posonlyargs + a.args + a.kwonlyargs:
+        ann = p.annotation
+        if ann is not None:
+            out[p.arg] = dotted(ann) or ""
+    return out
+
+
+@register
+class JitHostSyncRule(Rule):
+    id = "jax-host-sync"
+    category = "jax"
+    severity = "error"
+    description = (
+        "host-device sync inside a traced function: .item()/.tolist()/"
+        ".block_until_ready()/np.asarray()/float() on a tracer blocks "
+        "the device pipeline every step (or fails to trace at all)")
+
+    def check(self, ctx):
+        for fn, info in ctx.traced().items():
+            params = set(param_names(fn)) - info.static_names
+            for node in body_nodes(fn, skip=ctx.traced()):
+                if not isinstance(node, ast.Call):
+                    continue
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _SYNC_METHODS:
+                    yield node, (
+                        f".{node.func.attr}() inside traced function "
+                        f"'{fn.name}' (via {info.via}) forces a "
+                        "host-device sync; compute on-device and pull "
+                        "results after the traced call returns")
+                    continue
+                name = dotted(node.func)
+                if name in _HOST_FUNCS:
+                    yield node, (
+                        f"{name}() inside traced function '{fn.name}' "
+                        f"(via {info.via}) copies device values to host "
+                        "numpy; use jnp inside traced code")
+                elif (name in _SCALAR_BUILTINS and node.args
+                      and isinstance(node.args[0], ast.Name)
+                      and node.args[0].id in params):
+                    yield node, (
+                        f"{name}({node.args[0].id}) inside traced "
+                        f"function '{fn.name}' concretizes a tracer to "
+                        "a Python scalar; this raises under jit unless "
+                        "the arg is static — mark it static_argnames or "
+                        "keep it a jnp value")
+
+
+@register
+class TracerBranchRule(Rule):
+    id = "jax-tracer-branch"
+    category = "jax"
+    severity = "error"
+    description = (
+        "Python if/while on a traced data argument: the branch runs on "
+        "the TRACE, not per-element — raises ConcretizationTypeError "
+        "or silently bakes one path into the compiled program")
+
+    def check(self, ctx):
+        for fn, info in ctx.traced().items():
+            anns = _param_annotations(fn)
+            data_params = {
+                p for p in param_names(fn)
+                if p not in info.static_names
+                and anns.get(p, "") not in _STATIC_ANNOTATIONS
+                # a parameter never annotated static but named like
+                # config is still data as far as tracing is concerned —
+                # no name-based exemptions here
+            }
+            for node in body_nodes(fn, skip=ctx.traced()):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                offender = self._scalar_param_test(node.test, data_params)
+                if offender:
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    yield node, (
+                        f"`{kind}` on traced argument '{offender}' of "
+                        f"'{fn.name}' (via {info.via}): under tracing "
+                        "this branches on an abstract value — use "
+                        "jnp.where/lax.cond/lax.select, or mark the "
+                        "argument static")
+
+    @staticmethod
+    def _scalar_param_test(test: ast.AST, data_params) -> str:
+        """Name of the offending parameter if the test is built purely
+        from names/constants and touches a data parameter.
+
+        Restricting to pure name/constant/compare tests keeps false
+        positives near zero: ``if x.ndim == 3`` (shape — static under
+        tracing) or ``if mask is None`` (identity on None) never match.
+        """
+        comparators = []
+        if isinstance(test, ast.Name):
+            comparators = [test]
+        elif isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not) \
+                and isinstance(test.operand, ast.Name):
+            comparators = [test.operand]
+        elif isinstance(test, ast.Compare):
+            # `x is None` / `x is not None` is an identity test on the
+            # PYTHON value, legal and common for optional args
+            if any(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+                return ""
+            comparators = [n for n in [test.left] + test.comparators
+                           if isinstance(n, ast.Name)]
+            if not all(isinstance(n, (ast.Name, ast.Constant))
+                       for n in [test.left] + test.comparators):
+                return ""
+        for name in comparators:
+            if name.id in data_params:
+                return name.id
+        return ""
+
+
+@register
+class MissingDonationRule(Rule):
+    id = "jax-missing-donation"
+    category = "jax"
+    severity = "warning"
+    description = (
+        "jit-compiled update function rebinds its first argument but "
+        "declares no donate_argnums: the old buffer stays live across "
+        "the step, doubling peak memory for the largest pytree")
+
+    def check(self, ctx):
+        for fn, info in ctx.traced().items():
+            # donation is a jit/pjit concept; shard_map captures have
+            # no donate_argnums to declare
+            if info.donated or info.via not in JIT_NAMES:
+                continue
+            params = param_names(fn)
+            if not params:
+                continue
+            first = params[0]
+            if first in info.static_names:
+                continue
+            for node in body_nodes(fn, skip=ctx.traced()):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Name) and t.id == first:
+                        yield node, (
+                            f"'{fn.name}' rebinds its first argument "
+                            f"'{first}' under jit without "
+                            "donate_argnums=(0,): the pre-update buffer "
+                            "and its replacement are both live at step "
+                            "peak — donate the input to update in place")
+                        break
+                else:
+                    continue
+                break  # one finding per function is enough

@@ -1,0 +1,164 @@
+"""Robustness-hazard rules.
+
+``silent-except`` is the rule this whole subsystem was built around:
+ADVICE.md's admission-control finding was a broad ``except Exception:``
+whose body was a bare ``return`` — a single line that silently disabled
+fleet-wide OOM protection, with zero signal anywhere. ``library-
+internals`` guards the other documented hazard: code that reaches into
+CPython/stdlib private attributes works until a point release, then
+degrades in whatever way the surrounding code happens to allow.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from ..astutil import attr_depth, chain_root, dotted
+from ..engine import Rule, register
+
+#: broad exception types where swallowing is a hazard; a narrow
+#: ``except KeyError: use_default()`` is normal control flow.
+_BROAD = {"Exception", "BaseException"}
+
+def _handles_visibly(handler: ast.ExceptHandler) -> bool:
+    """Does the handler body do ANYTHING observable with the failure?
+
+    Re-raising, logging, or in fact calling any function at all counts:
+    a body that invokes a fallback path is handling, not swallowing.
+    The hazard this rule exists for is the handler whose body is pure
+    control flow (``pass`` / ``return`` / constant assignment) — the
+    failure leaves no trace anywhere.
+    """
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Raise, ast.Call)):
+            return True
+    return False
+
+
+def _uses_exception_var(handler: ast.ExceptHandler) -> bool:
+    """``except Exception as e`` where the body actually reads ``e``:
+    the error is being inspected/propagated somehow, not swallowed."""
+    if handler.name is None:
+        return False
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Name) and node.id == handler.name and \
+                isinstance(node.ctx, ast.Load):
+            return True
+    return False
+
+
+@register
+class SilentExceptRule(Rule):
+    id = "silent-except"
+    category = "robustness"
+    severity = "error"
+    description = (
+        "broad except whose body neither re-raises, logs, nor reads "
+        "the exception: failures vanish without a trace (the exact "
+        "shape of the ADVICE.md admission-control bug)")
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            type_name = self._broad_name(node.type)
+            if node.type is not None and type_name is None:
+                continue  # narrow except: normal control flow
+            if _handles_visibly(node) or _uses_exception_var(node):
+                continue
+            shown = type_name or "bare except"
+            yield node, (
+                f"except {shown}: swallows every error with no trace "
+                "— log it (logging.warning with exc_info / repr(e)), "
+                "re-raise, call a fallback, or narrow the exception "
+                "type")
+
+    @staticmethod
+    def _broad_name(type_node):
+        """The broad type's name if this handler is broad, else None.
+
+        ``except:`` -> "bare except"; ``except (ValueError, Exception)``
+        is broad because ONE member is; ``except (KeyError, OSError)``
+        is narrow and returns None.
+        """
+        if type_node is None:
+            return "bare except"
+        elts = type_node.elts if isinstance(type_node, ast.Tuple) \
+            else [type_node]
+        for elt in elts:
+            name = dotted(elt)
+            if name in _BROAD:
+                return name
+        return None
+
+
+@register
+class LibraryInternalsRule(Rule):
+    id = "library-internals"
+    category = "robustness"
+    severity = "warning"
+    description = (
+        "reaching into another object's private internals (deep "
+        "`_attr` chains / getattr(obj, '_attr')): works until the "
+        "library refactors — keep a behavioral fallback next to it "
+        "and suppress the finding to document the contract")
+
+    #: roots whose privates are OUR OWN: accessing self._x (or a
+    #: module-local conventionally-private helper) is normal Python.
+    _OWN_ROOTS: Set[str] = {"self", "cls"}
+
+    def check(self, ctx):
+        # names DEFINED in this module (functions/classes): their
+        # private attributes are ours, not a library's
+        own = {n.name for n in ctx.tree.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef))}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute):
+                yield from self._check_attr(node, own)
+            elif isinstance(node, ast.Call):
+                yield from self._check_getattr(node, own)
+
+    def _check_attr(self, node: ast.Attribute, own):
+        attr = node.attr
+        if not attr.startswith("_") or attr.startswith("__"):
+            return
+        # only DEEP chains (a.b._c and beyond): obj._x on a local name
+        # is usually package-internal access; two-plus hops means we
+        # are navigating someone else's object graph
+        if attr_depth(node) < 3:
+            return
+        root = chain_root(node)
+        if isinstance(root, ast.Name) and (root.id in self._OWN_ROOTS
+                                           or root.id in own):
+            return
+        path = dotted(node) or f"...{attr}"
+        yield node, (
+            f"'{path}' navigates a foreign object's private internals; "
+            "an upstream refactor breaks this silently — pair it with "
+            "a fallback and suppress with `# rafiki: noqa"
+            "[library-internals]` to record the contract")
+
+    def _check_getattr(self, node: ast.Call, own):
+        if not (isinstance(node.func, ast.Name)
+                and node.func.id == "getattr" and len(node.args) >= 2):
+            return
+        name_arg = node.args[1]
+        if not (isinstance(name_arg, ast.Constant)
+                and isinstance(name_arg.value, str)):
+            return
+        attr = name_arg.value
+        if not attr.startswith("_") or attr.startswith("__"):
+            return
+        base = node.args[0]
+        root = chain_root(base) if isinstance(
+            base, ast.Attribute) else base
+        if isinstance(root, ast.Name) and (root.id in self._OWN_ROOTS
+                                           or root.id in own):
+            return
+        yield node, (
+            f"getattr(..., {attr!r}) probes a private attribute of a "
+            "foreign object; an upstream refactor breaks this silently "
+            "— pair it with a fallback and suppress with `# rafiki: "
+            "noqa[library-internals]` to record the contract")

@@ -46,12 +46,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="check the environment (backend, devices, native "
              "artifacts, compile cache) and print a health report")
 
+    p_lint = sub.add_parser(
+        "lint",
+        help="run the JAX/concurrency-aware static analyzer over "
+             "source paths (exit 0 = clean; see docs/linting.md)")
+    from .analysis.cli import add_lint_arguments
+
+    add_lint_arguments(p_lint)
+
     _register_service_commands(sub)
 
     args = parser.parse_args(argv)
     if args.cmd is None:
         parser.print_help()
         return 2
+    if args.cmd == "lint":
+        # pure AST analysis — no jax, no backend, no platform env;
+        # keeping it import-light makes the CI gate start instantly
+        from .analysis.cli import run_lint
+
+        return run_lint(args)
     # honor RAFIKI_JAX_PLATFORM before any backend initializes: the TPU-VM
     # image pre-imports jax with the accelerator platform pinned, so env
     # vars alone cannot force dev/tune runs onto CPU

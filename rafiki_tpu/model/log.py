@@ -72,6 +72,6 @@ def _to_plain(v: Any) -> Any:
             return v.item()
         if hasattr(v, "item") and getattr(v, "ndim", None) == 0:
             return v.item()
-    except Exception:
-        pass
+    except Exception:  # rafiki: noqa[silent-except] — best-effort
+        pass           # scalar coercion; the raw value is returned
     return v

@@ -623,11 +623,17 @@ def chunked_lm_loss_terms(hidden: jnp.ndarray, head_kernel: jnp.ndarray,
 
 def _chunked_ce_sum(hidden: jnp.ndarray, targets: jnp.ndarray,
                     valid: jnp.ndarray, head_kernel: jnp.ndarray,
-                    chunk: int) -> jnp.ndarray:
+                    chunk: int, unroll: bool = False) -> jnp.ndarray:
     """The chunked projection+CE scan over precomputed targets/valid —
     shared by the dense-path wrapper above and the sequence-parallel
     variant below (which shards the SEQUENCE and must therefore shift
-    targets globally before partitioning)."""
+    targets globally before partitioning).
+
+    ``unroll`` replaces the ``lax.scan`` with a Python loop over the
+    (static) chunk count: required when this runs INSIDE a ``shard_map``
+    — transposing a scan through shard_map mis-specs the scalar carry
+    on older jax (0.4.x), and the sp variant differentiates through
+    exactly that composition. Same math, unrolled HLO."""
     b, length, d = hidden.shape
     chunk = max(1, min(int(chunk), length))
     pad = (-length) % chunk
@@ -647,6 +653,12 @@ def _chunked_ce_sum(hidden: jnp.ndarray, targets: jnp.ndarray,
         losses = optax.softmax_cross_entropy_with_integer_labels(
             logits.astype(jnp.float32), t)
         return jnp.sum(losses * v)
+
+    if unroll:
+        total = jnp.zeros((), jnp.float32)
+        for i in range(n_chunks):
+            total = total + _chunk_sum(hs[i], ts[i], vs[i])
+        return total
 
     def body(total, xs):
         h, t, v = xs
@@ -682,7 +694,7 @@ def chunked_lm_loss_terms_sp(hidden: jnp.ndarray,
     summation order."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from rafiki_tpu.ops.common import shard_map_kernels
+    from rafiki_tpu.ops.common import shard_map_checked
 
     targets = jnp.pad(ids[:, 1:], ((0, 0), (0, 1)))
     valid = lm_valid_mask(hidden.shape[1], lens, example_mask)
@@ -696,11 +708,12 @@ def chunked_lm_loss_terms_sp(hidden: jnp.ndarray,
     t_spec = P(data_axis, sp_axis)
 
     @functools.partial(
-        shard_map_kernels, mesh=mesh,
+        shard_map_checked, mesh=mesh,
         in_specs=(h_spec, P(None, None), t_spec, t_spec),
         out_specs=(P(), P()))
     def _local(h_l, kernel, t_l, v_l):
-        total = _chunked_ce_sum(h_l, t_l, v_l, kernel, chunk)
+        total = _chunked_ce_sum(h_l, t_l, v_l, kernel, chunk,
+                                unroll=True)
         count = jnp.sum(v_l)
         return (jax.lax.psum(total, (data_axis, sp_axis)),
                 jax.lax.psum(count, (data_axis, sp_axis)))
@@ -911,6 +924,16 @@ def estimate_train_device_bytes(module: "Llama", *,
     from rafiki_tpu.parallel.sharding import (DATA_AXIS, MODEL_AXIS,
                                               param_shardings)
 
+    def abstract_mesh(sizes, names):
+        # jax moved AbstractMesh from shape_tuple=((name, size), ...)
+        # to (axis_sizes, axis_names) positional args; construct
+        # whichever this jax speaks (the old form raises TypeError
+        # inside __init__ when handed the new argument layout)
+        try:
+            return AbstractMesh(tuple(sizes), tuple(names))
+        except TypeError:
+            return AbstractMesh(tuple(zip(names, sizes)))
+
     dp, tp, sp = data_parallel, model_parallel, sequence_parallel
     if pipeline_stages > 1:
         return _estimate_pipeline_device_bytes(
@@ -919,11 +942,11 @@ def estimate_train_device_bytes(module: "Llama", *,
             pipeline_microbatches=pipeline_microbatches,
             adapters_only=adapters_only)
     if sp > 1 and tp > 1:
-        mesh = AbstractMesh((dp, sp, tp), (DATA_AXIS, "sp", MODEL_AXIS))
+        mesh = abstract_mesh((dp, sp, tp), (DATA_AXIS, "sp", MODEL_AXIS))
     elif sp > 1:
-        mesh = AbstractMesh((dp, sp), (DATA_AXIS, "sp"))
+        mesh = abstract_mesh((dp, sp), (DATA_AXIS, "sp"))
     else:
-        mesh = AbstractMesh((dp, tp), (DATA_AXIS, MODEL_AXIS))
+        mesh = abstract_mesh((dp, tp), (DATA_AXIS, MODEL_AXIS))
     tp_rules = None if (sp > 1 and tp == 1) else TP_RULES
 
     abstract = jax.eval_shape(

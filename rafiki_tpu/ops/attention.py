@@ -66,6 +66,34 @@ XLA_SHORT_SEQ = int(os.environ.get("RAFIKI_XLA_SHORT_SEQ", "256"))
 # per-head programs, today's measured-best configuration.
 ATTN_BLOCK_H = max(1, int(os.environ.get("RAFIKI_ATTN_BLOCK_H", "1")))
 
+# (block_h, heads) combos already warned about by the env-default
+# divisibility fallback below — warn once per shape, not per call
+_ENV_BLOCK_H_WARNED = set()
+
+
+def _env_block_h(heads: int) -> int:
+    """Resolve the env-derived block_h default against this call's
+    LOCAL head count. The fleet default is tuned on whole models, but
+    ulysses/ring inner calls see heads/tp/sp — a value that doesn't
+    divide the local count must degrade to per-head programs (with one
+    warning per shape), not hard-fail a template that never asked for
+    head tiling. An EXPLICIT block_h keeps the hard ValueError: that is
+    a deliberate kernel-tuning choice whose silent fallback would
+    invalidate a sweep."""
+    block_h = ATTN_BLOCK_H
+    if block_h > 1 and heads % block_h:
+        key = (block_h, heads)
+        if key not in _ENV_BLOCK_H_WARNED:
+            _ENV_BLOCK_H_WARNED.add(key)
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "RAFIKI_ATTN_BLOCK_H=%d does not divide the local head "
+                "count (%d); falling back to block_h=1 for this shape",
+                block_h, heads)
+        return 1
+    return block_h
+
 
 def _attn_fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *lse_refs,
                      sm_scale: float, causal: bool, block_q: int,
@@ -563,7 +591,7 @@ def flash_attention(q, k, v, sm_scale: Optional[float] = None,
     """
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
     if block_h is None:  # env-tunable fleet default (RAFIKI_ATTN_BLOCK_H)
-        block_h = ATTN_BLOCK_H
+        block_h = _env_block_h(q.shape[1])
     # an explicit block_h>1 is a deliberate kernel-tuning choice FOR the
     # short-seq regime — it must not be silently dropped by the
     # short-seq XLA route (off-TPU fallback still applies)

@@ -378,20 +378,23 @@ class DecodeEngine:
             self._slots = [None] * self.B
             self._queue.clear()
             self._done.clear()
-        self._tok[:] = 0
-        self._pos[:] = 0
-        self._prompt_buf[:] = 0
-        self._prompt_len[:] = 1
-        self._stop_pos[:] = 0  # empty slots must be device-inactive
-        self._temp[:] = 0.0
-        self._topk[:] = 0
-        self._topp[:] = 1.0
-        self._seed[:] = 0
-        self._aid[:] = 0
-        self._prompt_dev = None
-        self._spec_ema = self._spec_floor + 0.5
-        self._spec_idle = 0
-        self._draft_synced = True
+            # host mirrors under the same lock: a submit() racing this
+            # reset must observe either the old world or the cleared
+            # one, never a half-cleared mix
+            self._tok[:] = 0
+            self._pos[:] = 0
+            self._prompt_buf[:] = 0
+            self._prompt_len[:] = 1
+            self._stop_pos[:] = 0  # empty slots must be device-inactive
+            self._temp[:] = 0.0
+            self._topk[:] = 0
+            self._topp[:] = 1.0
+            self._seed[:] = 0
+            self._aid[:] = 0
+            self._prompt_dev = None
+            self._spec_ema = self._spec_floor + 0.5
+            self._spec_idle = 0
+            self._draft_synced = True
         self._cache = self.module.init(
             jax.random.PRNGKey(0), jnp.zeros((self.B, 1), jnp.int32),
             decode=True)["cache"]

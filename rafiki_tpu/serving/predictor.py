@@ -160,8 +160,8 @@ class Predictor:
         try:
             self.hub.arm_reply_ttl(
                 qid, timeout + EXPIRY_SKEW_TOLERANCE_S + 30.0)
-        except Exception:  # noqa: BLE001 — TTL is defense-in-depth
-            pass
+        except Exception:  # rafiki: noqa[silent-except] — the
+            pass           # TTL is defense-in-depth
         for wid in self.worker_ids:
             self.hub.push_query(wid, msg)
 
@@ -192,8 +192,8 @@ class Predictor:
             # must not accumulate in the hub/kv store forever
             try:
                 self.hub.discard_prediction_queue(qid)
-            except Exception:  # noqa: BLE001 — cleanup is best-effort
-                pass
+            except Exception:  # rafiki: noqa[silent-except] —
+                pass           # cleanup is best-effort
         latency = time.monotonic() - t0
         with self._lock:
             self._n_queries += len(queries)
@@ -286,8 +286,8 @@ class Predictor:
             try:
                 self.hub.arm_reply_ttl(
                     qid, timeout + EXPIRY_SKEW_TOLERANCE_S + 30.0)
-            except Exception:  # noqa: BLE001 — TTL is defense-in-depth
-                pass
+            except Exception:  # rafiki: noqa[silent-except] —
+                pass           # the TTL is defense-in-depth
             self.hub.push_query(wid, pack_message(payload))
             while True:
                 remaining = deadline - time.monotonic()
@@ -350,8 +350,8 @@ class Predictor:
         finally:
             try:
                 self.hub.discard_prediction_queue(qid)
-            except Exception:  # noqa: BLE001 — cleanup is best-effort
-                pass
+            except Exception:  # rafiki: noqa[silent-except] —
+                pass           # cleanup is best-effort
         yield final
 
     def stats(self) -> Dict[str, Any]:
@@ -370,8 +370,8 @@ class Predictor:
         for wid in self.worker_ids:
             try:
                 s = self.hub.get_worker_stats(wid)
-            except Exception:  # noqa: BLE001 — health must not 500 on
-                s = None       # a hub hiccup
+            except Exception:  # rafiki: noqa[silent-except] —
+                s = None       # health must not 500 on a hub hiccup
             if s is not None:
                 workers[wid] = s
         return {"queries_served": n_q, "requests_served": n_req,

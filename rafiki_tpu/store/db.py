@@ -59,8 +59,9 @@ class DatabaseAdapter:
         engines with strict transactions, poisons the connection)."""
         try:
             conn.rollback()
-        except Exception:  # noqa: BLE001 — a dead connection can't
-            pass           # rollback; the next execute reports it
+        except Exception:  # rafiki: noqa[silent-except] — a dead
+            pass           # connection can't rollback; the next
+            # execute reports it
 
     def close(self, conn) -> None:
         conn.close()

@@ -234,28 +234,44 @@ def sse_request(method: str, url: str, body: Any = None,
     wait for EACH event once the stream is up — a generation may
     legitimately idle near the server's whole-stream budget, but a down
     host must still fail fast at connect time."""
-    with _open_request(method, url, body, headers, timeout,
-                       accept="text/event-stream") as resp:
+    resp = _open_request(method, url, body, headers, timeout,
+                         accept="text/event-stream")
+    try:
         if read_timeout is not None and read_timeout != timeout:
             # the urlopen timeout rode onto the connected socket; now
             # that the response is live, re-bound it for event reads.
             # CPython: HTTPResponse.fp is a buffered reader over a
             # SocketIO holding the raw socket — reach it defensively
-            # (a refactor of those internals just keeps the old bound)
+            # (the else-branch below keeps the long bound on any
+            # non-CPython/refactored layout)
             sock = getattr(getattr(resp, "fp", None), "raw", None)
-            sock = getattr(sock, "_sock", None)
+            sock = getattr(sock, "_sock", None)  # rafiki: noqa[library-internals] — fallback below
             if hasattr(sock, "settimeout"):
                 sock.settimeout(read_timeout)
-            else:  # loud, not latent: the stream then times out at the
-                # (shorter) connect bound mid-generation
+            else:
+                # introspection failed (non-CPython, internals
+                # refactor): reads would stay bounded by the SHORT
+                # connect timeout and a legitimately idle generation
+                # would die mid-stream. Fall back to the
+                # pre-introspection behavior — re-open the request
+                # with the long bound as the socket timeout for the
+                # whole stream. No event has been consumed yet, and a
+                # duplicated request beats a stream that cannot run
+                # longer than the connect bound.
                 import logging
 
                 logging.getLogger(__name__).warning(
                     "sse_request could not re-bound the socket for "
                     "event reads (HTTPResponse internals changed?); "
-                    "per-event waits stay at the %.0fs connect timeout",
-                    timeout)
+                    "re-opening the stream with the %.0fs bound for "
+                    "the whole request", max(timeout, read_timeout))
+                resp.close()
+                resp = _open_request(method, url, body, headers,
+                                     max(timeout, read_timeout),
+                                     accept="text/event-stream")
         for line in resp:  # socket timeout applies per readline
             line = line.strip()
             if line.startswith(b"data:"):
                 yield json.loads(line[5:].strip().decode("utf-8"))
+    finally:
+        resp.close()

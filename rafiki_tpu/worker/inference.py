@@ -99,7 +99,7 @@ class InferenceWorker:
             # by sampling={"adapter_id": i}. The trials must share
             # every non-adapter leaf (adapters_only training); the
             # stacking validation below fails the boot loudly otherwise
-            trees = [getattr(self.model, "_params")]
+            trees = [self.model._params]
             for tid in extra_adapter_trials:
                 dump = param_store.load(tid)
                 if dump is None:
@@ -107,7 +107,7 @@ class InferenceWorker:
                         f"no parameters for adapter trial {tid!r}")
                 peer = model_class(**knobs)
                 peer.load_parameters(dump)
-                trees.append(getattr(peer, "_params"))
+                trees.append(peer._params)
             try:
                 self.engine = self.model.make_multi_adapter_engine(
                     trees, max_slots=max_slots,
@@ -187,8 +187,17 @@ class InferenceWorker:
                 kwargs["draft"] = draft
             budget = est(**kwargs)
             total = int(budget["total"])
-        except Exception:  # noqa: BLE001 — an estimator bug must
-            return  # never block an admissible deployment
+        except Exception as e:  # an estimator bug must never block an
+            # admissible deployment — but it must be VISIBLE: silently
+            # skipping here disables serving admission control
+            # fleet-wide until workers start OOMing (ADVICE.md r5)
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "serving admission check skipped: "
+                "estimate_serving_device_bytes raised %r", e,
+                exc_info=True)
+            return
         if total > limit:
             raise ValueError(
                 "serving admission control: estimated "
@@ -244,8 +253,8 @@ class InferenceWorker:
                           for k, v in self.engine.stats.items()})
         try:
             self.hub.put_worker_stats(self.worker_id, stats)
-        except Exception:  # noqa: BLE001 — observability must never
-            pass           # kill the serving loop
+        except Exception:  # rafiki: noqa[silent-except] —
+            pass           # observability must never kill the loop
 
     def _count_dropped(self, n: int) -> None:
         if n <= 0:

@@ -195,7 +195,7 @@ class TrainWorker:
                     # kv hiccup here must not void a finished trial
                     self.param_store.delete(ckpt_key)
                     self.param_store.delete(f"{ckpt_key}-meta")
-                except Exception:  # noqa: BLE001
+                except Exception:  # rafiki: noqa[silent-except]
                     pass
                 if not fenced_out:
                     try:
@@ -223,9 +223,10 @@ class TrainWorker:
                 if not fenced_out:
                     try:
                         self.advisor.trial_errored(proposal.trial_no)
-                    except Exception:  # noqa: BLE001 — a dead/restarted
-                        # advisor must not kill the surviving worker; the
-                        # error is durable in the MetaStore either way
+                    except Exception:  # rafiki: noqa[silent-except]
+                        # — a dead/restarted advisor must not kill the
+                        # surviving worker; the error is durable in
+                        # the MetaStore either way
                         pass
                 return None
         finally:
@@ -258,8 +259,16 @@ class TrainWorker:
         try:
             budget = est(len(devs))
             total = int(budget["total"])
-        except Exception:  # noqa: BLE001 — an estimator bug must never
-            return  # block an admissible trial
+        except Exception as e:  # an estimator bug must never block an
+            # admissible trial — but it must be VISIBLE: silently
+            # skipping here disables train admission control
+            # fleet-wide until trials start OOMing (ADVICE.md r5)
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "train admission check skipped: "
+                "estimate_device_budget raised %r", e, exc_info=True)
+            return
         if total > limit:
             raise ValueError(
                 "admission control: estimated "
@@ -336,8 +345,8 @@ class TrainWorker:
             while not stop.wait(self.heartbeat_interval_s):
                 try:
                     self.meta_store.heartbeat_trial(trial_id)
-                except Exception:  # noqa: BLE001 — never kill the trial
-                    pass
+                except Exception:  # rafiki: noqa[silent-except]
+                    pass           # never kill the trial
 
         t = threading.Thread(target=beat, daemon=True,
                              name=f"hb-{trial_id[:8]}")
@@ -420,8 +429,8 @@ class TrainWorker:
                 try:
                     self.param_store.delete(ckpt_key)
                     self.param_store.delete(f"{ckpt_key}-meta")
-                except Exception:  # noqa: BLE001 — cleanup must never
-                    pass           # kill the worker loop
+                except Exception:  # rafiki: noqa[silent-except]
+                    pass  # cleanup must never kill the worker loop
             self._resumes_done += 1
             n += 1
         return n

@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""Standalone lint runner for repo checkouts (no install needed).
+
+Equivalent to ``rafiki-tpu lint`` / ``rafiki-tpu-lint``; defaults to
+analyzing ``rafiki_tpu/`` relative to the repo root so CI can run it
+as ``python scripts/lint.py`` from anywhere.
+"""
+
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+from rafiki_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    os.chdir(_REPO_ROOT)  # "rafiki_tpu" default path resolves here
+    sys.exit(main())

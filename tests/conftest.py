@@ -22,14 +22,24 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent XLA-executable cache: the suite is compile-dominated (every
-# template/mesh combo pays tracing+lowering on CPU), and the programs are
-# identical across runs — cache them on disk so reruns are minutes
-# faster. Safe to delete .jax_cache/ at any time.
-jax.config.update("jax_compilation_cache_dir",
-                  os.path.join(os.path.dirname(os.path.dirname(
-                      os.path.abspath(__file__))), ".jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+# Persistent XLA-executable cache: OFF by default. It used to shave
+# minutes off reruns, but on this jaxlib deserializing a cached CPU
+# executable mid-suite SEGFAULTS the whole pytest process (reproduced
+# deterministically: suite dies at the first test that gets a cache hit
+# after enough prior compile state accumulates; passes start-to-finish
+# with the cache disabled). Opt back in with RAFIKI_TEST_COMPILE_CACHE=1
+# on a jax build where the cache is sound; the dir is keyed by jaxlib
+# version so executables never cross versions.
+if os.environ.get("RAFIKI_TEST_COMPILE_CACHE", "") == "1":
+    import jaxlib
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.dirname(os.path.dirname(
+                          os.path.abspath(__file__))), ".jax_cache",
+                          getattr(jaxlib, "__version__", "unknown")))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+else:
+    jax.config.update("jax_enable_compilation_cache", False)
 
 import pytest  # noqa: E402
 

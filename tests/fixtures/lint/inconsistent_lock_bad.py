@@ -1,0 +1,19 @@
+"""Positive fixture: one bare write against an otherwise-locked attr."""
+import threading
+
+
+class SlotTable:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._live = 0
+
+    def admit(self):
+        with self._lock:
+            self._live += 1
+
+    def finish(self):
+        with self._lock:
+            self._live -= 1
+
+    def evict_all(self):
+        self._live = 0  # bare write: races admit()/finish()

@@ -1,0 +1,44 @@
+"""Negative fixture: consistent locking, setup writes, owner-thread
+mirrors, and the *_locked caller-holds-lock convention."""
+import threading
+
+
+class SafeSlotTable:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._live = 0  # __init__ writes are pre-sharing
+
+    def admit(self):
+        with self._lock:
+            self._live += 1
+
+    def evict_all(self):
+        with self._lock:
+            self._live = 0
+
+    def _rebuild_locked(self):
+        self._live = 0  # caller holds the lock (naming convention)
+
+
+class EngineMirrors:
+    """Lock guards only the queue handoff; the numpy-mirror attrs are
+    owned by the single step thread and written bare BY DESIGN — the
+    lockset vote (bare majority) must keep this clean."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = []
+        self._pos = 0
+
+    def submit(self, item):
+        with self._lock:
+            self._queue.append(item)
+
+    def step(self):
+        self._pos += 1  # owner-thread mirror, bare on purpose
+
+    def prefill(self):
+        self._pos = 0  # owner-thread mirror, bare on purpose
+
+    def rewind(self):
+        self._pos -= 1  # owner-thread mirror, bare on purpose

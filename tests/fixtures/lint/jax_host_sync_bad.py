@@ -1,0 +1,40 @@
+"""Positive fixture: host-device syncs inside traced functions."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def train_step(params, x):
+    loss = jnp.sum(x)
+    print(loss.item())  # sync: concretizes on host every step
+    return params
+
+
+@jax.jit
+def log_step(params, x):
+    host = np.asarray(x)  # sync: device -> host numpy copy
+    return params, host
+
+
+@jax.jit
+def scalarize(params, lr):
+    return params, float(lr)  # sync: tracer -> Python scalar
+
+
+def wrapped(x):
+    x.block_until_ready()  # sync: pipeline stall inside the jit below
+    return x
+
+
+step = jax.jit(wrapped)
+
+
+from functools import partial  # noqa: E402
+
+from rafiki_tpu.ops.common import shard_map_kernels  # noqa: E402
+
+
+@partial(shard_map_kernels, mesh=None, in_specs=(), out_specs=())
+def sharded_body(x):
+    return x.tolist()  # sync inside a shard_map body

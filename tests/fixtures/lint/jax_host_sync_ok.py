@@ -1,0 +1,20 @@
+"""Negative fixture: the same operations where they are legal."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def train_step(params, x):
+    return params, jnp.sum(x)  # stays on device
+
+
+def eager_eval(x):
+    # not traced: pulling to host in eager metric code is fine
+    arr = np.asarray(x)
+    return float(arr.mean()), arr.item() if arr.size == 1 else None
+
+
+def outside(step_fn, params, x):
+    out = step_fn(params, x)
+    return jax.block_until_ready(out)  # sync AFTER the traced call

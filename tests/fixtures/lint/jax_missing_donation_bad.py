@@ -1,0 +1,10 @@
+"""Positive fixture: jit update function that rebinds its first arg
+without donating it — both buffers live at step peak."""
+import jax
+
+
+@jax.jit
+def train_step(params, grads):
+    params = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g,
+                                    params, grads)
+    return params

@@ -1,0 +1,18 @@
+"""Negative fixture: donation declared, or no in-place-style rebind."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def train_step(params, grads):
+    params = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g,
+                                    params, grads)
+    return params
+
+
+@jax.jit
+def evaluate(params, batch):
+    # reads params, never rebinds them: nothing to donate
+    preds = jax.tree_util.tree_map(lambda p: p * 2, params)
+    return preds, batch

@@ -1,0 +1,18 @@
+"""Positive fixture: Python branches on traced data arguments."""
+import jax
+
+
+@jax.jit
+def clip_if(x, limit):
+    if limit:  # branches on a tracer -> ConcretizationTypeError
+        return x
+    return -x
+
+
+@jax.jit
+def loop_while(x, n):
+    total = x
+    while n:  # tracer-valued loop condition
+        total = total + 1
+        n = n - 1
+    return total

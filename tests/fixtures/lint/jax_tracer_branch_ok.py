@@ -1,0 +1,39 @@
+"""Negative fixture: branches that are static (or not branches on
+tracers) inside traced functions."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def static_by_name(x, mode):
+    if mode:  # static argument: resolved at trace time
+        return x
+    return -x
+
+
+@partial(jax.jit, static_argnums=(1,))
+def static_by_num(x, flip):
+    if flip:  # static argument: resolved at trace time
+        return -x
+    return x
+
+
+@jax.jit
+def optional_arg(x, y=None):
+    if y is None:  # identity test on the Python value, not the tracer
+        return x
+    return x + y
+
+
+@jax.jit
+def annotated_config(x, causal: bool):
+    if causal:  # bool-annotated params are compile-time config
+        return jnp.tril(x)
+    return x
+
+
+@jax.jit
+def on_device_branch(x, limit):
+    return jnp.where(limit > 0, x, -x)  # the traced way to branch

@@ -1,0 +1,10 @@
+"""Positive fixture: navigating a foreign object's private internals."""
+
+
+def rebind_socket(resp, read_timeout):
+    sock = resp.fp.raw._sock  # CPython HTTPResponse internals
+    sock.settimeout(read_timeout)
+
+
+def probe(resp):
+    return getattr(resp.fp, "_sock", None)  # same probe, getattr form

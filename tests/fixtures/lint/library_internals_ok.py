@@ -1,0 +1,28 @@
+"""Negative fixture: private access that is ours to make."""
+
+
+def module_helper():
+    return _shared_state()
+
+
+def _shared_state():
+    return {}
+
+
+def remember(fn):
+    # function attribute on a module-local function: our own object
+    if getattr(module_helper, "_done", False):
+        return fn
+    module_helper._done = True
+    return fn
+
+
+class Engine:
+    def __init__(self, model):
+        self._model = model  # own private attr
+
+    def params(self):
+        return self._model.params
+
+    def peek(self):
+        return self._model._params  # single hop: package-internal

@@ -1,0 +1,18 @@
+"""Positive fixture: the ADVICE.md admission-control shape — broad
+except whose body leaves no trace of the failure."""
+
+
+def admission_check(estimate, limit):
+    try:
+        total = estimate()
+    except Exception:
+        return  # the estimator bug silently disables the check
+    if total > limit:
+        raise ValueError("footprint exceeds device limit")
+
+
+def poll(source):
+    try:
+        return source()
+    except:  # noqa: E722
+        pass

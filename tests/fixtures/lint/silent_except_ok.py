@@ -1,0 +1,44 @@
+"""Negative fixture: broad excepts that handle visibly, narrow
+excepts, and exception-variable use."""
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def admission_check(estimate, limit):
+    try:
+        total = estimate()
+    except Exception as e:
+        log.warning("admission check skipped: estimator raised %r", e,
+                    exc_info=True)
+        return
+    if total > limit:
+        raise ValueError("footprint exceeds device limit")
+
+
+def narrow(cfg):
+    try:
+        return cfg["key"]
+    except KeyError:  # narrow type: normal control flow
+        return None
+
+
+def reraise(source):
+    try:
+        return source()
+    except Exception:
+        raise
+
+
+def inspected(source):
+    try:
+        return source()
+    except Exception as e:
+        return {"error": str(e)}  # the exception is read, not dropped
+
+
+def fallback_call(primary, secondary):
+    try:
+        return primary()
+    except Exception:
+        return secondary()  # visible handling: a fallback path runs

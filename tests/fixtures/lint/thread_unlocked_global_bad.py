@@ -1,0 +1,13 @@
+"""Positive fixture: thread target mutating module state lock-free."""
+import threading
+
+STATS = {}
+EVENTS = []
+
+
+def _monitor_loop():
+    STATS["ticks"] = STATS.get("ticks", 0) + 1  # racy dict write
+    EVENTS.append("tick")  # racy list append
+
+
+t = threading.Thread(target=_monitor_loop, daemon=True)

@@ -1,0 +1,25 @@
+"""Negative fixture: locked mutation, non-thread mutation, and local
+shadowing."""
+import threading
+
+STATS = {}
+_STATS_LOCK = threading.Lock()
+
+
+def _monitor_loop():
+    with _STATS_LOCK:
+        STATS["ticks"] = STATS.get("ticks", 0) + 1
+
+
+def eager_helper():
+    # mutates the module dict but never runs on a thread
+    STATS["calls"] = STATS.get("calls", 0) + 1
+
+
+def _shadowing_loop():
+    STATS = {}  # local name shadows the module global
+    STATS["ticks"] = 1
+
+
+t1 = threading.Thread(target=_monitor_loop, daemon=True)
+t2 = threading.Thread(target=_shadowing_loop, daemon=True)

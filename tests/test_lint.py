@@ -1,0 +1,202 @@
+"""Tier-1 gate for the static-analysis subsystem (docs/linting.md).
+
+Two jobs:
+
+1. **Self-clean**: running every rule over ``rafiki_tpu/`` itself must
+   produce zero unsuppressed findings. This is the CI gate — any PR
+   that introduces a traced host-sync, an unlocked write against a
+   locked attr, or a silent broad except fails here with the finding
+   text in the assertion message.
+2. **Rule correctness**: every rule fires on its positive fixture and
+   stays quiet on its negative fixture (``tests/fixtures/lint/``), the
+   suppression dialect works, and the CLI exit codes hold.
+
+No jax import, no device work — this file runs in milliseconds.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from rafiki_tpu.analysis import (all_rules, analyze_paths,
+                                 analyze_source, get_rule)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO_ROOT, "rafiki_tpu")
+FIXTURES = os.path.join(REPO_ROOT, "tests", "fixtures", "lint")
+
+#: rule id -> fixture stem; every registered rule must appear here
+#: (the completeness test below enforces it), so adding a rule without
+#: fixtures fails CI.
+RULE_FIXTURES = {
+    "jax-host-sync": "jax_host_sync",
+    "jax-tracer-branch": "jax_tracer_branch",
+    "jax-missing-donation": "jax_missing_donation",
+    "inconsistent-lock": "inconsistent_lock",
+    "thread-unlocked-global": "thread_unlocked_global",
+    "silent-except": "silent_except",
+    "library-internals": "library_internals",
+}
+
+
+# ---- the gate ----
+
+def test_repo_is_self_clean():
+    findings = analyze_paths([PACKAGE])
+    assert not findings, (
+        "rafiki_tpu/ has unsuppressed lint findings — fix them or, for "
+        "a documented intentional pattern, suppress the line with "
+        "`# rafiki: noqa[rule-id]`:\n"
+        + "\n".join(f.format() for f in findings))
+
+
+def test_issue_catalog_covers_every_category():
+    cats = {r.category for r in all_rules().values()}
+    assert {"jax", "concurrency", "robustness"} <= cats
+    assert len(all_rules()) >= 6
+
+
+# ---- per-rule fixtures ----
+
+@pytest.mark.parametrize("rule_id", sorted(RULE_FIXTURES))
+def test_rule_fires_on_positive_fixture(rule_id):
+    path = os.path.join(FIXTURES, RULE_FIXTURES[rule_id] + "_bad.py")
+    findings = analyze_paths([path], select=[rule_id])
+    assert findings, f"{rule_id} missed its positive fixture"
+    assert all(f.rule == rule_id for f in findings)
+    assert all(f.path == path and f.line > 0 for f in findings)
+
+
+@pytest.mark.parametrize("rule_id", sorted(RULE_FIXTURES))
+def test_rule_quiet_on_negative_fixture(rule_id):
+    path = os.path.join(FIXTURES, RULE_FIXTURES[rule_id] + "_ok.py")
+    findings = analyze_paths([path], select=[rule_id])
+    assert not findings, (
+        f"{rule_id} false-positives on its negative fixture:\n"
+        + "\n".join(f.format() for f in findings))
+
+
+def test_positive_fixtures_trigger_no_foreign_rules():
+    """Each bad fixture demonstrates exactly one hazard class — a
+    finding from another rule means the fixtures drifted."""
+    for rule_id, stem in RULE_FIXTURES.items():
+        path = os.path.join(FIXTURES, stem + "_bad.py")
+        rules_hit = {f.rule for f in analyze_paths([path])}
+        assert rules_hit == {rule_id}, (stem, rules_hit)
+
+
+def test_every_registered_rule_has_fixtures():
+    assert set(RULE_FIXTURES) == set(all_rules()), (
+        "keep RULE_FIXTURES in sync with the registry (one positive + "
+        "one negative fixture per rule)")
+    for rule_id in RULE_FIXTURES:
+        rule = get_rule(rule_id)
+        assert rule.description and rule.category and rule.severity
+
+
+# ---- suppressions ----
+
+def test_noqa_suppression_dialect():
+    path = os.path.join(FIXTURES, "suppressed.py")
+    src = open(path).read()
+    # targeted + blanket suppressions hold; a wrong rule id does not
+    unsuppressed = analyze_source(src, path)
+    assert [(f.line, f.rule) for f in unsuppressed] == \
+        [(21, "silent-except")]
+    # audit mode still surfaces all three
+    everything = analyze_source(src, path, with_suppressed=True)
+    assert len(everything) == 3
+
+
+def test_noqa_inside_string_is_not_a_suppression():
+    src = (
+        "def f(source):\n"
+        "    try:\n"
+        "        return source()\n"
+        "    except Exception:\n"
+        "        s = '# rafiki: noqa[silent-except]'\n"
+        "        return s\n"
+    )
+    assert [f.rule for f in analyze_source(src)] == ["silent-except"]
+
+
+# ---- engine behavior ----
+
+def test_parse_error_is_a_finding_not_a_crash():
+    src = open(os.path.join(FIXTURES, "parse_error.py.txt")).read()
+    findings = analyze_source(src)
+    assert [f.rule for f in findings] == ["parse-error"]
+    assert findings[0].severity == "error"
+
+
+def test_unknown_rule_id_raises():
+    with pytest.raises(KeyError, match="no-such-rule"):
+        analyze_paths([PACKAGE], select=["no-such-rule"])
+
+
+def test_missing_path_raises_even_when_mixed_with_good_paths():
+    # a typo'd CI argument must not yield a "clean" verdict on a tree
+    # that was never visited
+    with pytest.raises(OSError, match="no/such/dir"):
+        analyze_paths([PACKAGE, "no/such/dir"])
+
+
+def test_findings_report_real_locations():
+    path = os.path.join(FIXTURES, "silent_except_bad.py")
+    f = analyze_paths([path], select=["silent-except"])[0]
+    line_text = open(path).read().splitlines()[f.line - 1]
+    assert "except" in line_text
+
+
+# ---- CLI ----
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "rafiki_tpu.cli", "lint", *args],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+
+
+def test_cli_exits_zero_on_clean_tree():
+    proc = _run_cli("rafiki_tpu")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+
+
+def test_cli_exits_nonzero_on_fixtures():
+    proc = _run_cli(os.path.join("tests", "fixtures", "lint"))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "silent-except" in proc.stdout
+
+
+def test_cli_json_output():
+    proc = _run_cli(os.path.join("tests", "fixtures", "lint"),
+                    "--format", "json")
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert payload["counts"]["total"] == len(payload["findings"]) > 0
+    sample = payload["findings"][0]
+    assert {"rule", "severity", "path", "line", "col",
+            "message"} <= set(sample)
+
+
+def test_cli_list_rules():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    for rule_id in RULE_FIXTURES:
+        assert rule_id in proc.stdout
+
+
+def test_cli_bad_path_exits_two():
+    proc = _run_cli("no/such/dir")
+    assert proc.returncode == 2
+    assert "lint" in proc.stderr
+
+
+def test_scripts_lint_runner():
+    proc = subprocess.run(
+        [sys.executable, os.path.join("scripts", "lint.py")],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -194,6 +194,37 @@ def test_attn_block_h_env_default(monkeypatch):
                                atol=2e-5, rtol=2e-5)
 
 
+def test_attn_block_h_env_default_falls_back_on_indivisible(caplog):
+    """A fleet RAFIKI_ATTN_BLOCK_H that doesn't divide this call's
+    LOCAL head count (ulysses/ring inner calls see heads/tp/sp) must
+    degrade to block_h=1 with one warning — not hard-fail a template
+    that never asked for head tiling. An EXPLICIT indivisible block_h
+    keeps raising (covered above)."""
+    import logging
+
+    import rafiki_tpu.ops.attention as attn_mod
+
+    q = _rand(1, 4, 32, 16, key=9)
+    ref = _attention_reference(q, q, q, 1.0 / np.sqrt(16), False)
+    orig = attn_mod.ATTN_BLOCK_H
+    try:
+        attn_mod.ATTN_BLOCK_H = 3  # does not divide h=4
+        with caplog.at_level(logging.WARNING,
+                             logger="rafiki_tpu.ops.attention"):
+            out = attn_mod.flash_attention(q, q, q)
+            out2 = attn_mod.flash_attention(q, q, q)
+    finally:
+        attn_mod.ATTN_BLOCK_H = orig
+        attn_mod._ENV_BLOCK_H_WARNED.clear()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    warned = [r for r in caplog.records
+              if "RAFIKI_ATTN_BLOCK_H" in r.getMessage()]
+    assert len(warned) == 1  # one-time per (block_h, heads) shape
+
+
 def test_flash_attention_bf16():
     q = _rand(1, 2, 128, 64, key=0, dtype=jnp.bfloat16)
     k = _rand(1, 2, 128, 64, key=1, dtype=jnp.bfloat16)

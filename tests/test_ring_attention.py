@@ -39,7 +39,9 @@ def test_ring_matches_dense(n_ring, causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
     # output stays sequence-sharded — no all-gather of the result
-    assert tuple(out.sharding.spec) == (None, None, "sp", None)
+    spec = tuple(out.sharding.spec)  # older jax trims trailing None
+    assert "sp" in spec  # a replicated (all-gathered) result fails
+    assert spec == (None, None, "sp", None)[:len(spec)]
 
 
 @pytest.mark.slow
@@ -105,7 +107,9 @@ def test_ring_2d_mesh_dp_times_sp():
     ref = _attention_reference(q, k, v, 1.0 / np.sqrt(16), True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
-    assert tuple(out.sharding.spec) == ("data", None, "sp", None)
+    spec = tuple(out.sharding.spec)  # older jax trims trailing None
+    assert "sp" in spec and "data" in spec  # gathered result fails
+    assert spec == ("data", None, "sp", None)[:len(spec)]
 
 
 def test_ring_local_block_is_streamed_not_materialized():

@@ -39,7 +39,9 @@ def test_ulysses_matches_dense(n_par, causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
     # output stays sequence-sharded — no all-gather of the result
-    assert tuple(out.sharding.spec) == (None, None, "sp", None)
+    spec = tuple(out.sharding.spec)  # older jax trims trailing None
+    assert "sp" in spec  # a replicated (all-gathered) result fails
+    assert spec == (None, None, "sp", None)[:len(spec)]
 
 
 @pytest.mark.slow
