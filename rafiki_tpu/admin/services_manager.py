@@ -508,6 +508,44 @@ class ServicesManager:
                 cfg["max_new_tokens"] = int(budget["MAX_NEW_TOKENS"])
             if budget.get("SYSTEM_PREFIX"):
                 cfg["system_prefix"] = str(budget["SYSTEM_PREFIX"])
+            if budget.get("KV_PAGE_SIZE"):
+                # paged (block-table) KV serving: cache HBM and
+                # admission scale with the page pool (live tokens),
+                # not max_slots x max_len — see docs/operations.md
+                # "Paged KV cache". KV_PAGES sizes the pool (0/unset =
+                # full coverage, no saving). Misconfigurations fail
+                # HERE at the API call, not as a crash-looping worker.
+                if not decode_loop:
+                    raise ValueError(
+                        "KV_PAGE_SIZE requires a language-modeling "
+                        "deployment (the decode loop owns the KV "
+                        f"cache); task {model['task']} serves through "
+                        "the micro-batcher")
+                page = int(budget["KV_PAGE_SIZE"])
+                trial_max_len = int(
+                    (trial.get("knobs") or {}).get("max_len", 0) or 0)
+                if page <= 0 or (trial_max_len
+                                 and trial_max_len % page):
+                    # the engine's own validity rule, enforced at the
+                    # deployment surface (a bad page size would
+                    # otherwise kill the worker at engine build)
+                    raise ValueError(
+                        f"KV_PAGE_SIZE={page} must be > 0 and divide "
+                        f"the trial's max_len ({trial_max_len})")
+                cfg["kv_page_size"] = page
+                if budget.get("KV_PAGES"):
+                    pages = int(budget["KV_PAGES"])
+                    if pages < 2:
+                        raise ValueError(
+                            f"KV_PAGES={pages} must be >= 2 (page 0 "
+                            "is the scratch page; at least one usable "
+                            "page) — omit it for the full-coverage "
+                            "default")
+                    cfg["kv_pages"] = pages
+            elif budget.get("KV_PAGES"):
+                raise ValueError(
+                    "KV_PAGES requires KV_PAGE_SIZE in the same "
+                    "budget (pages have no size without it)")
             if decode_loop and budget.get("SPECULATE_K"):
                 # speculative decoding at the DEPLOYMENT surface:
                 # SPECULATE_K alone enables prompt-lookup drafting;

@@ -92,12 +92,19 @@ def tune_model(model_class: Type[BaseModel], train_dataset_path: str,
                val_dataset_path: str, total_trials: int = 10,
                advisor_type: str = "auto", seed: int = 0,
                keep_params: bool = True,
-               profile_dir: Optional[str] = None) -> TuneResult:
+               profile_dir: Optional[str] = None,
+               knob_overrides: Optional[Dict[str, Any]] = None
+               ) -> TuneResult:
     """Local single-process tuning loop (reference ``tune_model``): run the
     advisor's propose/feedback cycle in-process and return the best trial.
 
     ``profile_dir`` wraps each trial's train() in a ``jax.profiler`` trace
-    written to ``profile_dir/local-<trial_no>/`` (SURVEY.md §5.1)."""
+    written to ``profile_dir/local-<trial_no>/`` (SURVEY.md §5.1).
+
+    ``knob_overrides`` pins knobs over every proposal — the dev-loop
+    twin of ``TrainWorker.knob_overrides`` (job-level pins), so local
+    runs can hold shape knobs fixed while the advisor searches the
+    rest."""
     from ..advisor import make_advisor, TrialResult
 
     knob_config = model_class.get_knob_config()
@@ -111,6 +118,8 @@ def tune_model(model_class: Type[BaseModel], train_dataset_path: str,
         proposal = advisor.propose()
         if not proposal.is_valid:
             break
+        if knob_overrides:
+            proposal.knobs = {**proposal.knobs, **knob_overrides}
         logger = ModelLogger()
         model = model_class(**proposal.knobs)
         shared = params_by_trial.get(proposal.warm_start_trial_id)

@@ -259,11 +259,26 @@ class _DecoderAttention(nn.Module):
     #: per-element quantization error (<= absmax/254 per component).
     #: Reads dequantize on the fly and fuse into the attention einsum.
     kv_int8: bool = False
+    #: >0 — paged KV cache (serving decode path): per layer K/V live in
+    #: a (kv_pages, kv_page_size, kv_heads, dh) POOL instead of per-slot
+    #: (b, max_len, ...) rows; each batch row maps logical pages to pool
+    #: pages via the ``page_tables`` call operand ((b, max_len/page)
+    #: int32, host-owned). Cache HBM then scales with the pool — live
+    #: tokens — not slots x max_len. Writes scatter at
+    #: (table[pos // page], pos % page); attention gathers the row's
+    #: pages back into logical order, so the masked softmax consumes
+    #: exactly the bytes the contiguous layout would (bit-exact; garbage
+    #: in unallocated pages sits past the position mask). int8-KV scale
+    #: rows page identically. Pool page 0 is the engine's scratch page
+    #: (idle lanes write there; never read unmasked).
+    kv_page_size: int = 0
+    kv_pages: int = 0
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, lens: jnp.ndarray,
                  positions: jnp.ndarray, decode: bool,
-                 adapter_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                 adapter_ids: Optional[jnp.ndarray] = None,
+                 page_tables: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         b, s, d = x.shape
         dh = d // self.n_heads
         dense = functools.partial(LoRADense, rank=self.lora_rank,
@@ -290,19 +305,24 @@ class _DecoderAttention(nn.Module):
             # only allocates zeros and never writes.
             is_live = self.has_variable("cache", "k")
             kv_dtype = jnp.int8 if self.kv_int8 else x.dtype
-            ck = self.variable("cache", "k", jnp.zeros,
-                               (b, self.max_len, self.n_kv_heads, dh),
+            paged = self.kv_page_size > 0
+            if paged:  # pool layout: pages, not per-slot rows
+                kv_shape = (self.kv_pages, self.kv_page_size,
+                            self.n_kv_heads, dh)
+                sc_shape = (self.kv_pages, self.kv_page_size,
+                            self.n_kv_heads)
+            else:
+                kv_shape = (b, self.max_len, self.n_kv_heads, dh)
+                sc_shape = (b, self.max_len, self.n_kv_heads)
+            ck = self.variable("cache", "k", jnp.zeros, kv_shape,
                                kv_dtype)
-            cv = self.variable("cache", "v", jnp.zeros,
-                               (b, self.max_len, self.n_kv_heads, dh),
+            cv = self.variable("cache", "v", jnp.zeros, kv_shape,
                                kv_dtype)
             if self.kv_int8:  # one absmax scale per stored K/V vector
                 sk = self.variable("cache", "k_scale", jnp.zeros,
-                                   (b, self.max_len, self.n_kv_heads),
-                                   jnp.float32)
+                                   sc_shape, jnp.float32)
                 sv = self.variable("cache", "v_scale", jnp.zeros,
-                                   (b, self.max_len, self.n_kv_heads),
-                                   jnp.float32)
+                                   sc_shape, jnp.float32)
             if not is_live:
                 # init trace: local attention for output shape only
                 kk = jnp.repeat(k, rep, axis=2)
@@ -321,7 +341,30 @@ class _DecoderAttention(nn.Module):
                 # (idle slots re-fed their current token) rewrite
                 # identical values — harmless by construction.
                 t = positions  # (b, s) — per-slot, per-token write index
-                rows = jnp.arange(b)[:, None]
+                if paged:
+                    if page_tables is None:
+                        raise ValueError(
+                            "kv_page_size > 0 decode requires the "
+                            "page_tables operand (the serving engine "
+                            "supplies it; plain generate paths must use "
+                            "a contiguous-cache module)")
+                    # write at (table[pos // page], pos % page); the
+                    # gather below restores logical order, so the mask
+                    # math is identical to the contiguous layout
+                    widx = (jnp.take_along_axis(
+                        page_tables, t // self.kv_page_size, axis=1),
+                        t % self.kv_page_size)
+                else:
+                    widx = (jnp.arange(b)[:, None], t)
+
+                def as_rows(c):
+                    # cache → the (b, max_len, ...) logical view the
+                    # attention consumes: a page gather when paged,
+                    # identity otherwise
+                    if paged:
+                        return c[page_tables].reshape(
+                            (b, self.max_len) + c.shape[2:])
+                    return c
                 if self.kv_int8:
                     def q8(u):
                         scale = jnp.maximum(
@@ -334,25 +377,27 @@ class _DecoderAttention(nn.Module):
 
                     qk_, sk_ = q8(k)
                     qv_, sv_ = q8(v)
-                    ck.value = ck.value.at[rows, t].set(qk_)
-                    cv.value = cv.value.at[rows, t].set(qv_)
-                    sk.value = sk.value.at[rows, t].set(sk_)
-                    sv.value = sv.value.at[rows, t].set(sv_)
+                    ck.value = ck.value.at[widx].set(qk_)
+                    cv.value = cv.value.at[widx].set(qv_)
+                    sk.value = sk.value.at[widx].set(sk_)
+                    sv.value = sv.value.at[widx].set(sv_)
                     # multiply in f32 and cast the PRODUCT: casting the
                     # scales to bf16 first would throw away the very
                     # precision their f32 storage pays for (XLA fuses
                     # this into the attention einsum either way)
-                    deq_k = (ck.value.astype(jnp.float32)
-                             * sk.value[..., None]).astype(x.dtype)
-                    deq_v = (cv.value.astype(jnp.float32)
-                             * sv.value[..., None]).astype(x.dtype)
+                    deq_k = (as_rows(ck.value).astype(jnp.float32)
+                             * as_rows(sk.value)[..., None]).astype(
+                                 x.dtype)
+                    deq_v = (as_rows(cv.value).astype(jnp.float32)
+                             * as_rows(sv.value)[..., None]).astype(
+                                 x.dtype)
                     kk = jnp.repeat(deq_k, rep, axis=2)
                     vv = jnp.repeat(deq_v, rep, axis=2)
                 else:
-                    ck.value = ck.value.at[rows, t].set(k)
-                    cv.value = cv.value.at[rows, t].set(v)
-                    kk = jnp.repeat(ck.value, rep, axis=2)
-                    vv = jnp.repeat(cv.value, rep, axis=2)
+                    ck.value = ck.value.at[widx].set(k)
+                    cv.value = cv.value.at[widx].set(v)
+                    kk = jnp.repeat(as_rows(ck.value), rep, axis=2)
+                    vv = jnp.repeat(as_rows(cv.value), rep, axis=2)
                 scores = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / np.sqrt(dh)
                 k_pos = jnp.arange(self.max_len)[None, None, None, :]
                 scores = jnp.where(k_pos <= t[:, None, :, None],
@@ -423,18 +468,22 @@ class _DecoderBlock(nn.Module):
     rope_theta: float = 10000.0
     rope_scaling: Optional[Tuple[float, float, float, float]] = None
     kv_int8: bool = False  # serving-only int8 KV cache
+    kv_page_size: int = 0  # >0 → paged KV pool (see _DecoderAttention)
+    kv_pages: int = 0
 
     @nn.compact
-    def __call__(self, x, lens, positions, decode, adapter_ids=None):
+    def __call__(self, x, lens, positions, decode, adapter_ids=None,
+                 page_tables=None):
         x = x + _DecoderAttention(
             self.n_heads, self.n_kv_heads, self.max_len, self.lora_rank,
             quantized=self.quantized, n_adapters=self.n_adapters,
             seq_mesh=self.seq_mesh, seq_axis=self.seq_axis,
             head_axis=self.head_axis,
             rope_theta=self.rope_theta, rope_scaling=self.rope_scaling,
-            kv_int8=self.kv_int8,
+            kv_int8=self.kv_int8, kv_page_size=self.kv_page_size,
+            kv_pages=self.kv_pages,
             name="attn")(RMSNorm()(x), lens, positions, decode,
-                         adapter_ids)
+                         adapter_ids, page_tables)
         y = RMSNorm()(x)
         if self.n_experts > 0:
             from rafiki_tpu.ops.moe import MoEFeedForward
@@ -512,14 +561,33 @@ class Llama(nn.Module):
     # kv_int8): half the decode cache's HBM at bf16, bounded
     # quantization error. Training/eval never touch the decode branch.
     kv_int8: bool = False
+    # >0 — paged KV cache (serving decode path; see _DecoderAttention.
+    # kv_page_size): per layer K/V live in a (kv_pages, kv_page_size,
+    # …) pool and each batch row maps logical→pool pages via the
+    # ``page_tables`` call operand, so decode-cache HBM scales with the
+    # pool (live tokens), not max_slots × max_len. kv_pages sizes the
+    # pool (page 0 is the engine's scratch page). Training/eval and the
+    # plain generate paths use contiguous-cache modules.
+    kv_page_size: int = 0
+    kv_pages: int = 0
 
     @nn.compact
     def __call__(self, ids: jnp.ndarray, lens: Optional[jnp.ndarray] = None,
                  positions: Optional[jnp.ndarray] = None,
                  decode: bool = False,
                  return_hidden: bool = False,
-                 adapter_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                 adapter_ids: Optional[jnp.ndarray] = None,
+                 page_tables: Optional[jnp.ndarray] = None) -> jnp.ndarray:
         b, s = ids.shape
+        if self.kv_page_size > 0:
+            if self.max_len % self.kv_page_size:
+                raise ValueError(
+                    f"kv_page_size {self.kv_page_size} must divide "
+                    f"max_len {self.max_len}")
+            if self.kv_pages < 2:
+                raise ValueError(
+                    "kv_page_size > 0 needs kv_pages >= 2 (page 0 is "
+                    "the scratch page; at least one usable page)")
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(s), (b, s))
         if lens is None:
@@ -546,8 +614,10 @@ class Llama(nn.Module):
                           rope_theta=self.rope_theta,
                           rope_scaling=self.rope_scaling,
                           kv_int8=self.kv_int8,
+                          kv_page_size=self.kv_page_size,
+                          kv_pages=self.kv_pages,
                           name=f"block_{i}")(x, lens, positions, decode,
-                                             adapter_ids)
+                                             adapter_ids, page_tables)
         x = RMSNorm(name="final_norm")(x)
         if return_hidden:
             # chunked-loss path (chunked_lm_loss_terms): hand back the
@@ -1046,6 +1116,16 @@ def _estimate_pipeline_device_bytes(module: "Llama", *, batch_size: int,
     return out
 
 
+def _default_kv_pages(max_slots: int, max_len: int,
+                      page_size: int) -> int:
+    """Pool size when the operator sets ``kv_page_size`` but not
+    ``kv_pages``: one scratch page plus full coverage (every slot can
+    reach max_len), i.e. paged mechanics with zero admission stalls and
+    no footprint saving. Memory wins come from sizing ``kv_pages`` DOWN
+    to the expected live-token load (docs/operations.md)."""
+    return 1 + max_slots * (max_len // page_size)
+
+
 def stack_lora_adapters(trees: List[Any], validate: bool = True) -> Any:
     """Merge N adapter-only fine-tunes of one base into a single
     multi-adapter param tree for ``Llama(n_adapters=N)``.
@@ -1256,7 +1336,8 @@ class LlamaLoRA(BaseModel):
     def _module(self, quantized: bool = False, n_adapters: int = 0,
                 seq_mesh: Any = None,
                 seq_axis: Optional[str] = None,
-                head_axis: Optional[str] = None) -> Llama:
+                head_axis: Optional[str] = None,
+                kv_page_size: int = 0, kv_pages: int = 0) -> Llama:
         k = self.knobs
         hd = int(k["hidden_dim"])
         heads = int(k["n_heads"])
@@ -1277,7 +1358,9 @@ class LlamaLoRA(BaseModel):
                                       or 10000.0),
                      rope_scaling=_parse_rope_scaling(
                          k.get("rope_scaling", "")),
-                     kv_int8=bool(k.get("kv_cache_int8", False)))
+                     kv_int8=bool(k.get("kv_cache_int8", False)),
+                     kv_page_size=int(kv_page_size),
+                     kv_pages=int(kv_pages))
 
     def estimate_device_budget(self, n_devices: int) -> Dict[str, int]:
         """Per-device train-step HBM budget for THIS parameterization on
@@ -1315,7 +1398,9 @@ class LlamaLoRA(BaseModel):
 
     def estimate_serving_device_bytes(self, max_slots: int = 8,
                                       n_extra_adapters: int = 0,
-                                      draft: Optional["LlamaLoRA"] = None
+                                      draft: Optional["LlamaLoRA"] = None,
+                                      kv_page_size: int = 0,
+                                      kv_pages: int = 0
                                       ) -> Dict[str, int]:
         """Per-device HBM budget for the continuous-batching decode
         engine — the serving twin of :func:`estimate_train_device_bytes`
@@ -1329,7 +1414,11 @@ class LlamaLoRA(BaseModel):
           2 (K and V) x depth, at int8+f32-scales when
           ``kv_cache_int8`` else the compute dtype. Multi-adapter
           serving shares ONE cache (the stacked engine batches
-          tenants into the same slots).
+          tenants into the same slots). With ``kv_page_size > 0``
+          (paged serving) the term is the POOL instead —
+          kv_pages x kv_page_size positions per layer — which is the
+          whole point: admission can budget live tokens, not
+          max_slots x max_len.
         - ``adapters``: stacked LoRA tensors for extra tenants
           (adapter dims scale linearly in tenant count).
         - ``draft``: the draft model's params + its own KV cache when
@@ -1361,11 +1450,31 @@ class LlamaLoRA(BaseModel):
             vocab = module.vocab_size
 
         per_pos = kv_heads * dh
+        if int(kv_page_size) > 0:
+            # paged pool: kv_pages x page_size positions per layer
+            # (exactly what DecodeEngine allocates), independent of
+            # max_slots — the footprint the block-table design buys.
+            # kv_pages=0 mirrors the engine's full-coverage default.
+            # The engine's validity rules apply here too: admission
+            # must never pass a budget for a pool the engine build
+            # will refuse.
+            if L % int(kv_page_size):
+                raise ValueError(f"kv_page_size {kv_page_size} must "
+                                 f"divide max_len {L}")
+            if kv_pages and int(kv_pages) < 2:
+                raise ValueError("paged KV needs kv_pages >= 2 "
+                                 "(scratch page + at least one usable "
+                                 "page)")
+            n_pages = int(kv_pages) or _default_kv_pages(
+                max_slots, L, int(kv_page_size))
+            n_pos = n_pages * int(kv_page_size)
+        else:
+            n_pos = max_slots * L
         if bool(k.get("kv_cache_int8", False)):
             # int8 rows + one f32 absmax scale per (slot, pos, head)
-            kv_dev = max_slots * L * depth * 2 * (per_pos + 4 * kv_heads)
+            kv_dev = n_pos * depth * 2 * (per_pos + 4 * kv_heads)
         else:
-            kv_dev = max_slots * L * depth * 2 * per_pos * act_bytes
+            kv_dev = n_pos * depth * 2 * per_pos * act_bytes
         adapters_dev = 0
         if n_extra_adapters:
             rank = int(k.get("lora_rank", 0) or 0)
@@ -1391,15 +1500,20 @@ class LlamaLoRA(BaseModel):
         out["total"] = sum(out.values())
         return out
 
-    def _serving_module_params(self) -> Tuple[Llama, Any]:
+    def _serving_module_params(self, kv_page_size: int = 0,
+                               kv_pages: int = 0) -> Tuple[Llama, Any]:
         """(module, params) for predict()/make_decode_engine — the int8
         pair when the quantize_int8 knob is set (quantized once per
-        trained tree, then cached)."""
+        trained tree, then cached). Paging fields shape only the decode
+        CACHE, never the params, so any (kv_page_size, kv_pages) pair
+        serves the same trained tree."""
         if not self.knobs.get("quantize_int8"):
-            return self._module(), self._params
+            return self._module(kv_page_size=kv_page_size,
+                                kv_pages=kv_pages), self._params
         if self._qparams is None:
             self._qparams = quantize_llama_params(self._params)
-        return self._module(quantized=True), self._qparams
+        return self._module(quantized=True, kv_page_size=kv_page_size,
+                            kv_pages=kv_pages), self._qparams
 
     def _dtype(self):
         # single source of truth for the bf16 knob → compute dtype
@@ -1957,7 +2071,9 @@ class LlamaLoRA(BaseModel):
                            prefill_chunk: int = 32,
                            speculate_k: int = 0,
                            system_prefix: str = "",
-                           draft_model: Optional["LlamaLoRA"] = None):
+                           draft_model: Optional["LlamaLoRA"] = None,
+                           kv_page_size: int = 0,
+                           kv_pages: int = 0):
         """Continuous-batching serving engine over this model's weights
         (BASELINE.md config #5). The inference worker drives it when
         running in decode-loop mode; see ``serving/decode_engine.py``.
@@ -1966,9 +2082,23 @@ class LlamaLoRA(BaseModel):
         LlamaLoRA sharing this model's vocabulary drafts the
         speculative continuations instead of prompt-lookup n-grams —
         real draft-model speculation, still greedy-lossless (the
-        target's verify step is authoritative either way)."""
+        target's verify step is authoritative either way).
+
+        ``kv_page_size > 0`` serves from a PAGED KV pool of
+        ``kv_pages`` pages (block tables; see DecodeEngine): decode-
+        cache HBM scales with live tokens and admission backpressures
+        on the pool instead of refusing at max_slots × max_len.
+        ``kv_pages=0`` defaults to full coverage (no saving, no
+        stalls); size it down per docs/operations.md. Token-bit-exact
+        with the contiguous engine. The draft model's own cache stays
+        contiguous (drafts are small)."""
         assert self._params is not None, "model is not trained/loaded"
-        module, params = self._serving_module_params()
+        if kv_page_size > 0 and not kv_pages:
+            kv_pages = _default_kv_pages(max_slots,
+                                         int(self.knobs["max_len"]),
+                                         int(kv_page_size))
+        module, params = self._serving_module_params(
+            kv_page_size=kv_page_size, kv_pages=kv_pages)
         text_engine = self._build_text_engine(
             module, params, max_slots, max_new_tokens, steps_per_sync,
             prefill_chunk, speculate_k, draft_model=draft_model)
@@ -2042,7 +2172,9 @@ class LlamaLoRA(BaseModel):
                                   steps_per_sync: int = 4,
                                   prefill_chunk: int = 32,
                                   speculate_k: int = 0,
-                                  validate: bool = True):
+                                  validate: bool = True,
+                                  kv_page_size: int = 0,
+                                  kv_pages: int = 0):
         """ONE continuous-batching engine serving N adapter-only
         fine-tunes of one base (S-LoRA-style multi-adapter serving).
 
@@ -2072,8 +2204,14 @@ class LlamaLoRA(BaseModel):
         quantized = bool(self.knobs.get("quantize_int8"))
         if quantized:
             stacked = quantize_llama_params(stacked)
+        if kv_page_size > 0 and not kv_pages:
+            kv_pages = _default_kv_pages(max_slots,
+                                         int(self.knobs["max_len"]),
+                                         int(kv_page_size))
         module = self._module(quantized=quantized,
-                              n_adapters=len(trees))
+                              n_adapters=len(trees),
+                              kv_page_size=kv_page_size,
+                              kv_pages=kv_pages)
         return self._build_text_engine(
             module, stacked, max_slots, max_new_tokens, steps_per_sync,
             prefill_chunk, speculate_k)

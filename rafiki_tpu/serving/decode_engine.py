@@ -23,6 +23,23 @@ design:
   no separate prefill program, no pipeline bubble between phases.
 - Completed slots detokenize/reply and free immediately; the step loop
   only runs while any slot is live, so an idle engine costs nothing.
+- **Paged KV (block tables).** A module built with ``kv_page_size > 0``
+  stores each layer's K/V in a ``(kv_pages, page_size, heads, dh)``
+  POOL; every slot maps logical pages → pool pages through a small
+  host-owned int32 table fed to each compiled call (static shape, so
+  admission/allocation never recompiles). Pages are allocated lazily
+  as a slot's position crosses page boundaries and freed at
+  completion, so cache HBM and admission scale with LIVE tokens, not
+  ``max_slots × max_len``. Admission reserves each request's
+  worst-case pages (prompt + max_new, NOT max_len) up front — the
+  accounting that makes mid-flight allocation infallible and
+  backpressure deadlock-free: a request that does not fit the pool
+  WAITS in the queue (``admission_stalls``) until completions free
+  reservations, instead of being refused while memory sits idle.
+  Token-bit-exact with the contiguous layout: attention gathers the
+  row's pages back into logical order and the same position mask
+  applies (stale bytes in unallocated/scratch pages sit past it).
+
 
 The engine is token-level and model-agnostic: it needs a flax module
 with the ``decode=True`` cache protocol. Text encode/detok is the
@@ -145,6 +162,36 @@ class DecodeEngine:
         #: device-resident prompt copy, refreshed only on admission — the
         #: (B, L) buffer must not ride host→device on every dispatch
         self._prompt_dev: Optional[jnp.ndarray] = None
+        #: paged KV (module.kv_page_size > 0): host-owned page tables +
+        #: free-list allocator over the module's (kv_pages, page_size,
+        #: …) per-layer pools. Pool page 0 is the SCRATCH page — idle/
+        #: free lanes write their idempotent re-feeds there and no slot
+        #: ever owns it, so a zeroed table row is always safe to step.
+        self.page_size = int(getattr(module, "kv_page_size", 0) or 0)
+        self.paged = self.page_size > 0
+        if self.paged:
+            if self.L % self.page_size:
+                raise ValueError(f"kv_page_size {self.page_size} must "
+                                 f"divide max_len {self.L}")
+            self.n_pages = int(getattr(module, "kv_pages", 0) or 0)
+            if self.n_pages < 2:
+                raise ValueError("paged KV needs kv_pages >= 2 (scratch"
+                                 " page + at least one usable page)")
+            self._n_table = self.L // self.page_size  # table width
+            #: LIFO free list over pages 1..n_pages-1; reservation
+            #: accounting (below) guarantees pops never fail mid-flight
+            self._free_pages = list(range(self.n_pages - 1, 0, -1))
+            self._n_alloc = np.zeros((self.B,), np.int32)
+            #: worst-case pages reserved per slot at admission — the
+            #: invariant sum(_n_res) <= n_pages - 1 is what makes lazy
+            #: allocation infallible and queue waits deadlock-free
+            self._n_res = np.zeros((self.B,), np.int32)
+            self._res_total = 0
+        else:
+            self._n_table = 1  # dummy operand keeps signatures uniform
+        self._ptab = np.zeros((self.B, self._n_table), np.int32)
+        self._ptab_dev = jnp.asarray(self._ptab)
+        self._ptab_dirty = False
         self._cache = module.init(
             jax.random.PRNGKey(0), jnp.zeros((self.B, 1), jnp.int32),
             decode=True)["cache"]
@@ -215,7 +262,15 @@ class DecodeEngine:
             "max_concurrent": 0, "prefill_calls": 0,
             "prefill_tokens": 0, "spec_calls": 0, "spec_drafted": 0,
             "spec_accepted": 0, "prefix_hits": 0, "prefix_tokens": 0,
-            "spec_draft_model_calls": 0, "draft_resyncs": 0}
+            "spec_draft_model_calls": 0, "draft_resyncs": 0,
+            # paged-KV pool observability (all 0 on contiguous
+            # engines): current/peak pages physically allocated, the
+            # usable pool size, and how many step() calls found the
+            # head-of-queue request unable to reserve its worst case
+            # (backpressure waits, not refusals)
+            "kv_pages_used": 0, "kv_pages_high_water": 0,
+            "kv_pages_total": (self.n_pages - 1 if self.paged else 0),
+            "admission_stalls": 0}
 
     # ---- submission / results (thread-safe: worker loop vs callers) ----
     def submit(self, request_id: Any, prompt_ids: np.ndarray,
@@ -249,6 +304,17 @@ class DecodeEngine:
         max_new = max(1, min(int(max_new), self.L - 1))
         prompt = prompt[:max(1, self.L - max_new)]
         aid = self._check_adapter_id(adapter_id)
+        if self.paged:
+            # a request whose worst case exceeds the whole pool could
+            # NEVER admit — it would stall the FIFO queue forever.
+            # Refuse loudly here; everything smaller waits its turn.
+            need = self._pages_for(min(len(prompt) - 1 + max_new,
+                                       self.L))
+            if need > self.n_pages - 1:
+                raise ValueError(
+                    f"request needs {need} KV pages worst-case but the "
+                    f"pool has {self.n_pages - 1} usable pages; raise "
+                    "kv_pages or lower max_new/prompt length")
         with self._lock:
             self._queue.append(_Slot(
                 request_id, prompt, max_new,
@@ -269,6 +335,68 @@ class DecodeEngine:
             raise ValueError(f"adapter_id {aid} out of range for "
                              f"{self.n_adapters}-adapter engine")
         return aid
+
+    # ---- paged-KV allocator (host side, step-thread only: the lock
+    # ---- protects queue/slots vs submitters; tables/free list are
+    # ---- touched exclusively by the thread driving step()) ----
+    def _pages_for(self, stop_pos: int) -> int:
+        """Worst-case pages a request can touch: the scan path writes
+        positions <= stop_pos - 1, and a speculative verify window can
+        overwrite up to ``spec_k - 1`` past it (clamped to the cache).
+        Reserved at admission so lazy allocation can never fail and a
+        waiting queue can never deadlock."""
+        h = min(stop_pos - 1 + (self.spec_k - 1 if self.spec_k else 0),
+                self.L - 1)
+        return h // self.page_size + 1
+
+    def _ensure_pages_to(self, i: int, last_pos: int) -> None:
+        """Allocate slot ``i``'s logical pages covering positions
+        [0, last_pos] — called just before every compiled call with
+        that call's write horizon (this is the LAZY part: a slot holds
+        pages for where it is, not for max_len)."""
+        need = last_pos // self.page_size + 1
+        grew = need > int(self._n_alloc[i])
+        while int(self._n_alloc[i]) < need:
+            # infallible by the reservation invariant (never more than
+            # _n_res[i] <= free-at-admission pages per slot)
+            self._ptab[i, int(self._n_alloc[i])] = self._free_pages.pop()
+            self._n_alloc[i] += 1
+        if grew:
+            self._ptab_dirty = True
+            used = self.n_pages - 1 - len(self._free_pages)
+            self.stats["kv_pages_used"] = used
+            self.stats["kv_pages_high_water"] = max(
+                self.stats["kv_pages_high_water"], used)
+            self.stats["kv_pages_total"] = self.n_pages - 1
+
+    def _release_slot_pages(self, i: int) -> None:
+        """Return slot ``i``'s pages + reservation to the pool (request
+        completed): the table row points back at the scratch page, so
+        the freed lane keeps stepping harmlessly."""
+        n = int(self._n_alloc[i])
+        if n:
+            self._free_pages.extend(
+                int(p) for p in self._ptab[i, :n])
+            self._ptab[i, :n] = 0
+            self._n_alloc[i] = 0
+            self._ptab_dirty = True
+        with self._lock:
+            # reservation counters share the admission loop's lock
+            # discipline (admission reads/writes them under _lock)
+            self._res_total -= int(self._n_res[i])
+            self._n_res[i] = 0
+        self.stats["kv_pages_used"] = \
+            self.n_pages - 1 - len(self._free_pages)
+        self.stats["kv_pages_total"] = self.n_pages - 1
+
+    def _ptab_arg(self) -> jnp.ndarray:
+        """The page-table operand every compiled call consumes (a tiny
+        constant zeros array on contiguous engines), re-uploaded only
+        when allocation changed it."""
+        if self._ptab_dirty:
+            self._ptab_dev = jnp.asarray(self._ptab)
+            self._ptab_dirty = False
+        return self._ptab_dev
 
     def poll(self) -> List[Tuple[Any, List[int]]]:
         """Completed (request_id, generated ids) since the last poll."""
@@ -316,15 +444,22 @@ class DecodeEngine:
         if len(prefix) == 0:
             self._prefixes.pop(aid, None)
             return 0
-        cache1 = self.module.init(
+        # snapshots compute through a CONTIGUOUS-cache twin of the
+        # module even on paged engines: a 1-row (1, plen, …) snapshot
+        # is the natural install source either way (the paged install
+        # scatters it into the hit slots' pages)
+        snap_module = (self.module.clone(kv_page_size=0, kv_pages=0)
+                       if self.paged else self.module)
+        cache1 = snap_module.init(
             jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32),
             decode=True)["cache"]
         # one multi-token cache pass over the prefix (same program shape
         # as chunked prefill, batch 1, chunk = len(prefix))
-        fill = _make_prefill(self.module, 1, len(prefix))
+        fill = _make_prefill(snap_module, 1, len(prefix))
         snap = fill(self.params, cache1, jnp.asarray(prefix[None, :]),
                     jnp.arange(len(prefix), dtype=jnp.int32)[None, :],
-                    jnp.asarray([aid], jnp.int32))
+                    jnp.asarray([aid], jnp.int32),
+                    jnp.zeros((1, 1), jnp.int32))
         plen = len(prefix)
         install = _make_prefix_install(plen)
         # store only the populated rows: the snapshot allocates at
@@ -345,7 +480,8 @@ class DecodeEngine:
             d_snap = d_fill(self.draft_params, d1,
                             jnp.asarray(prefix[None, :]),
                             jnp.arange(plen, dtype=jnp.int32)[None, :],
-                            jnp.asarray([aid], jnp.int32))
+                            jnp.asarray([aid], jnp.int32),
+                            jnp.zeros((1, 1), jnp.int32))
             d_snap = jax.tree_util.tree_map(lambda p: p[:, :plen],
                                             d_snap)
             entry["draft_cache"] = jax.block_until_ready(d_snap)
@@ -355,9 +491,18 @@ class DecodeEngine:
     def _install_prefix(self, rows: List[int],
                         pre: Dict[str, Any]) -> None:
         """Copy prefix ``pre``'s KV rows into the given slots (the
-        same snapshot admission matched/fast-forwarded against)."""
+        same snapshot admission matched/fast-forwarded against). On a
+        paged engine the snapshot scatters into the hit slots' pages
+        (allocated at admission); the draft cache, always contiguous,
+        keeps the row install."""
         rws = jnp.asarray(rows, jnp.int32)
-        self._cache = pre["install"](self._cache, pre["cache"], rws)
+        if self.paged:
+            inst = _make_paged_prefix_install(pre["len"], self.page_size)
+            self._cache = inst(
+                self._cache, pre["cache"],
+                jnp.asarray(self._ptab[np.asarray(rows)], jnp.int32))
+        else:
+            self._cache = pre["install"](self._cache, pre["cache"], rws)
         if self._draft_cache is not None and "draft_cache" in pre:
             self._draft_cache = pre["install"](
                 self._draft_cache, pre["draft_cache"], rws)
@@ -369,6 +514,17 @@ class DecodeEngine:
         with self._lock:
             return bool(self._queue) or any(s is not None
                                             for s in self._slots)
+
+    def reset_stats(self) -> None:
+        """Zero the served-traffic counters without losing capacity
+        gauges (``kv_pages_total`` describes the pool, not traffic) —
+        what the worker's post-warmup scrub needs."""
+        for k in self.stats:
+            self.stats[k] = 0
+        if self.paged:
+            self.stats["kv_pages_total"] = self.n_pages - 1
+            self.stats["kv_pages_used"] = \
+                self.n_pages - 1 - len(self._free_pages)
 
     def reset(self) -> None:
         """Drop all occupants and rebuild device state. For error
@@ -395,6 +551,16 @@ class DecodeEngine:
             self._spec_ema = self._spec_floor + 0.5
             self._spec_idle = 0
             self._draft_synced = True
+            if self.paged:
+                # every occupant is gone: the whole pool returns to the
+                # free list and every table row points at scratch
+                self._free_pages = list(range(self.n_pages - 1, 0, -1))
+                self._ptab[:] = 0
+                self._n_alloc[:] = 0
+                self._n_res[:] = 0
+                self._res_total = 0
+                self._ptab_dirty = True
+                self.stats["kv_pages_used"] = 0
         self._cache = self.module.init(
             jax.random.PRNGKey(0), jnp.zeros((self.B, 1), jnp.int32),
             decode=True)["cache"]
@@ -433,17 +599,25 @@ class DecodeEngine:
                 else:
                     tok_chunk[i, :] = self._tok[i]
                     pos_chunk[i, :] = self._pos[i]
+            if self.paged:
+                # lazy allocation tracks the prompt walk: each chunk
+                # only maps the pages it is about to write
+                for i in range(self.B):
+                    if adv[i] > 0:
+                        self._ensure_pages_to(
+                            i, int(self._pos[i]) + int(adv[i]) - 1)
             tok_dev = jnp.asarray(tok_chunk)
             pos_dev = jnp.asarray(pos_chunk)
             aid_dev = jnp.asarray(self._aid)
             self._cache = self._prefill_fn(
-                self.params, self._cache, tok_dev, pos_dev, aid_dev)
+                self.params, self._cache, tok_dev, pos_dev, aid_dev,
+                self._ptab_arg())
             if self._draft_cache is not None and self._draft_synced:
                 # keep the draft's KV in lockstep with the prompt walk
                 # (while desynced, resync rebuilds prompts anyway)
                 self._draft_cache = self._draft_sync_c(
                     self.draft_params, self._draft_cache, tok_dev,
-                    pos_dev, aid_dev)
+                    pos_dev, aid_dev, self._ptab_arg())
             self.stats["prefill_calls"] += 1
             self.stats["prefill_tokens"] += int(adv.sum())
             for i in range(self.B):
@@ -466,6 +640,24 @@ class DecodeEngine:
             prefix_hits: Dict[int, Tuple[Dict[str, Any], List[int]]] = {}
             for i in range(self.B):
                 if self._slots[i] is None and self._queue:
+                    if self.paged:
+                        # admission is bounded by the PAGE POOL, not
+                        # the slot count: the head request admits only
+                        # if its worst case (prompt + max_new + spec
+                        # margin — its ACTUAL size, never max_len)
+                        # still fits the outstanding reservations.
+                        # FIFO: a too-big head WAITS (backpressure)
+                        # rather than letting smaller latecomers
+                        # starve it; completions free reservations.
+                        head = self._queue[0]
+                        n_res = self._pages_for(
+                            min(len(head.prompt) - 1 + head.max_new,
+                                self.L))
+                        if self._res_total + n_res > self.n_pages - 1:
+                            self.stats["admission_stalls"] += 1
+                            break
+                        self._n_res[i] = n_res
+                        self._res_total += n_res
                     slot = self._queue.pop(0)
                     self._slots[i] = slot
                     self._tok[i] = slot.prompt[0]
@@ -495,6 +687,11 @@ class DecodeEngine:
                     self._topp[i] = slot.top_p
                     self._seed[i] = np.int32(slot.seed & 0x7FFFFFFF)
                     self._aid[i] = slot.adapter_id
+                    if self.paged:
+                        # map the pages the slot starts on: position 0,
+                        # or the whole prefix span for a hit (install
+                        # scatters into them before the next call)
+                        self._ensure_pages_to(i, int(self._pos[i]))
                     admitted = True
             live = [i for i in range(self.B) if self._slots[i] is not None]
             self.stats["max_concurrent"] = max(self.stats["max_concurrent"],
@@ -530,13 +727,20 @@ class DecodeEngine:
             return self._speculative_step(live)
         if self._verify_fn is not None:
             self._spec_idle += 1
+        if self.paged:
+            for i in live:
+                # the fused scan writes positions pos..pos+K-1, frozen
+                # at stop_pos-1: map exactly that window's pages
+                self._ensure_pages_to(i, min(
+                    int(self._pos[i]) + self.K,
+                    int(self._stop_pos[i])) - 1)
         self._cache, emitted = self._step_fns[any_sampling](
             self.params, self._cache, jnp.asarray(self._tok),
             jnp.asarray(self._pos), self._prompt_dev,
             jnp.asarray(self._prompt_len), jnp.asarray(self._stop_pos),
             jnp.asarray(self._temp), jnp.asarray(self._topk),
             jnp.asarray(self._topp), jnp.asarray(self._seed),
-            jnp.asarray(self._aid))
+            jnp.asarray(self._aid), self._ptab_arg())
         emitted = np.asarray(emitted)  # (K, B) — the per-token sync
         self.stats["steps"] += self.K
         if self._draft_cache is not None:
@@ -584,6 +788,8 @@ class DecodeEngine:
                 self._pos[i] = 0  # fresh occupant restarts at position 0
                 self._prompt_len[i] = 1
                 self._stop_pos[i] = 0
+                if self.paged:  # pages (and the reservation) free NOW,
+                    self._release_slot_pages(i)  # not at slot reuse
             else:
                 # reconstruct the next input host-side (mirrors the
                 # on-device selection, so the next fused call continues
@@ -635,7 +841,7 @@ class DecodeEngine:
             self._draft_cache = self._draft_sync_k(
                 self.draft_params, self._draft_cache,
                 jnp.asarray(tok_m), jnp.asarray(pos_m),
-                jnp.asarray(self._aid))
+                jnp.asarray(self._aid), self._ptab_arg())
         self._draft_synced = True
         self.stats["draft_resyncs"] += 1
 
@@ -674,7 +880,8 @@ class DecodeEngine:
                     pos_m[i, j] = pos_m[i, j - 1] if j else p0
         self._draft_cache = self._draft_sync_k(
             self.draft_params, self._draft_cache, jnp.asarray(tok_m),
-            jnp.asarray(pos_m), jnp.asarray(self._aid))
+            jnp.asarray(pos_m), jnp.asarray(self._aid),
+            self._ptab_arg())
 
     def _speculative_step(self, live: List[int]) -> int:
         """One verify call: host-drafted continuations for every live
@@ -698,7 +905,8 @@ class DecodeEngine:
                 self._prompt_dev, jnp.asarray(self._prompt_len),
                 jnp.asarray(self._stop_pos), jnp.asarray(self._temp),
                 jnp.asarray(self._topk), jnp.asarray(self._topp),
-                jnp.asarray(self._seed), jnp.asarray(self._aid))
+                jnp.asarray(self._seed), jnp.asarray(self._aid),
+                self._ptab_arg())
             drafts = np.asarray(d_emit).T.astype(np.int32)  # (B, k-1)
             offs = np.arange(k, dtype=np.int32)[None, :]
             self._draft_cache = self._draft_sync_v(
@@ -706,7 +914,7 @@ class DecodeEngine:
                 jnp.asarray(np.concatenate(
                     [self._tok[:, None], drafts], axis=1)),
                 jnp.asarray(self._pos[:, None] + offs),
-                jnp.asarray(self._aid))
+                jnp.asarray(self._aid), self._ptab_arg())
             self.stats["spec_draft_model_calls"] = \
                 self.stats.get("spec_draft_model_calls", 0) + 1
         else:
@@ -716,10 +924,19 @@ class DecodeEngine:
                 ctx = np.concatenate(
                     [s.prompt, np.asarray(s.generated, np.int32)])
                 drafts[i] = _ngram_draft(ctx, k - 1)
+        if self.paged:
+            for i in live:
+                # the verify window writes positions pos..pos+k-1
+                # (gated above to fit the cache); its pages must exist
+                # even for drafts that end up rejected — the standard
+                # unreachable-then-rewritten rows, inside reservation
+                self._ensure_pages_to(i, min(
+                    int(self._pos[i]) + k - 1, self.L - 1))
         self._cache, g, n_emit = self._verify_fn(
             self.params, self._cache, jnp.asarray(self._tok),
             jnp.asarray(self._pos), jnp.asarray(drafts),
-            jnp.asarray(self._stop_pos), jnp.asarray(self._aid))
+            jnp.asarray(self._stop_pos), jnp.asarray(self._aid),
+            self._ptab_arg())
         g = np.asarray(g)            # (B, k) model argmax per position
         n_emit = np.asarray(n_emit)  # (B,) 1 + accepted draft prefix
         self.stats["steps"] += 1
@@ -754,6 +971,8 @@ class DecodeEngine:
                 self._pos[i] = 0
                 self._prompt_len[i] = 1
                 self._stop_pos[i] = 0
+                if self.paged:
+                    self._release_slot_pages(i)
             else:
                 self._tok[i] = slot.generated[-1]
         if finished:
@@ -835,12 +1054,15 @@ def _make_step(module: Any, n_slots: int, k: int,
     slot idles harmlessly for the remainder of the scan.
 
     Multi-adapter modules additionally consume the per-slot ``aid``
-    operand (which stacked fine-tune each row decodes under)."""
+    operand (which stacked fine-tune each row decodes under); paged-KV
+    modules the per-slot ``ptab`` page tables (a tiny ignored constant
+    otherwise — one signature for both layouts)."""
     multi = int(getattr(module, "n_adapters", 0) or 0) > 0
+    paged = int(getattr(module, "kv_page_size", 0) or 0) > 0
 
     @functools.partial(jax.jit, donate_argnums=(1,))
     def step_fn(params, cache, tok, pos, prompt_buf, prompt_len, stop_pos,
-                temp, top_k, top_p, seed, aid):
+                temp, top_k, top_p, seed, aid, ptab):
         rows = jnp.arange(n_slots)
 
         def body(carry, _):
@@ -848,7 +1070,8 @@ def _make_step(module: Any, n_slots: int, k: int,
             logits, muts = module.apply(
                 {"params": params, "cache": cache}, tok[:, None],
                 positions=pos[:, None], decode=True, mutable=["cache"],
-                **({"adapter_ids": aid} if multi else {}))
+                **({"adapter_ids": aid} if multi else {}),
+                **({"page_tables": ptab} if paged else {}))
             lg = logits[:, -1].astype(jnp.float32)
             if sampling:
                 nxt = _select_next(lg, temp, top_k, top_p, seed, pos)
@@ -885,9 +1108,10 @@ def _make_verify(module: Any, n_slots: int, k: int) -> Callable:
     current token at their current position (an idempotent rewrite)."""
 
     multi = int(getattr(module, "n_adapters", 0) or 0) > 0
+    paged = int(getattr(module, "kv_page_size", 0) or 0) > 0
 
     @functools.partial(jax.jit, donate_argnums=(1,))
-    def verify_fn(params, cache, tok, pos, drafts, stop_pos, aid):
+    def verify_fn(params, cache, tok, pos, drafts, stop_pos, aid, ptab):
         active = (pos < stop_pos)[:, None]
         offs = jnp.arange(k)[None, :]
         seq = jnp.concatenate([tok[:, None], drafts], axis=1)
@@ -896,7 +1120,8 @@ def _make_verify(module: Any, n_slots: int, k: int) -> Callable:
         logits, muts = module.apply(
             {"params": params, "cache": cache}, seq,
             positions=positions, decode=True, mutable=["cache"],
-            **({"adapter_ids": aid} if multi else {}))
+            **({"adapter_ids": aid} if multi else {}),
+            **({"page_tables": ptab} if paged else {}))
         g = jnp.argmax(logits.astype(jnp.float32), -1).astype(jnp.int32)
         ok = jnp.cumprod((drafts == g[:, :-1]).astype(jnp.int32), axis=1)
         n_emit = 1 + jnp.sum(ok, axis=1).astype(jnp.int32)
@@ -921,6 +1146,31 @@ def _make_prefix_install(plen: int) -> Callable:
     return install
 
 
+@functools.lru_cache(maxsize=32)
+def _make_paged_prefix_install(plen: int, page_size: int) -> Callable:
+    """Paged-engine twin of :func:`_make_prefix_install`: scatter a
+    (1, plen, …) contiguous snapshot into the hit slots' PAGES —
+    ``tabs`` is the (n_rows, n_tables) page-table slice of exactly the
+    rows being installed, whose prefix pages the engine allocated at
+    admission. Cached by (length, page size) like its contiguous twin."""
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def install(cache, pre, tabs):
+        pos = jnp.arange(plen)
+        pg = tabs[:, pos // page_size]   # (n_rows, plen) pool pages
+        off = pos % page_size            # (plen,) in-page offsets
+
+        def put(c, p):
+            vals = jnp.broadcast_to(
+                p[:, :plen].astype(c.dtype),
+                (tabs.shape[0], plen) + p.shape[2:])
+            return c.at[pg, off].set(vals)
+
+        return jax.tree_util.tree_map(put, cache, pre)
+
+    return install
+
+
 @functools.lru_cache(maxsize=8)
 def _make_prefill(module: Any, n_slots: int, chunk: int) -> Callable:
     """One C-token prefill call: feed (B, C) tokens at their per-slot
@@ -929,13 +1179,15 @@ def _make_prefill(module: Any, n_slots: int, chunk: int) -> Callable:
     (B, C, vocab) projection — the call is pure KV-cache population at
     matmul (not matvec) arithmetic intensity."""
     multi = int(getattr(module, "n_adapters", 0) or 0) > 0
+    paged = int(getattr(module, "kv_page_size", 0) or 0) > 0
 
     @functools.partial(jax.jit, donate_argnums=(1,))
-    def prefill_fn(params, cache, tok_chunk, pos_chunk, aid):
+    def prefill_fn(params, cache, tok_chunk, pos_chunk, aid, ptab):
         _, muts = module.apply(
             {"params": params, "cache": cache}, tok_chunk,
             positions=pos_chunk, decode=True, mutable=["cache"],
-            **({"adapter_ids": aid} if multi else {}))
+            **({"adapter_ids": aid} if multi else {}),
+            **({"page_tables": ptab} if paged else {}))
         return muts["cache"]
 
     return prefill_fn
@@ -1010,6 +1262,9 @@ class TextDecodeEngine:
     def reset(self) -> None:
         self._stream_sent.clear()
         self.engine.reset()
+
+    def reset_stats(self) -> None:
+        self.engine.reset_stats()
 
     @property
     def busy(self) -> bool:

@@ -46,7 +46,8 @@ class InferenceWorker:
                  speculate_k: int = 0, system_prefix: str = "",
                  extra_adapter_trials: Optional[List[str]] = None,
                  draft_trial_id: str = "",
-                 draft_knobs: Optional[dict] = None) -> None:
+                 draft_knobs: Optional[dict] = None,
+                 kv_page_size: int = 0, kv_pages: int = 0) -> None:
         self.worker_id = worker_id
         self.hub = hub
         self.max_batch_msgs = max_batch_msgs
@@ -70,7 +71,9 @@ class InferenceWorker:
         self._admission_check(
             max_slots if decode_loop else 0,
             len(extra_adapter_trials or ()) if decode_loop else 0,
-            draft_for_admission)
+            draft_for_admission,
+            kv_page_size=kv_page_size if decode_loop else 0,
+            kv_pages=kv_pages if decode_loop else 0)
         self.engine = None
         if draft_trial_id and (not decode_loop or speculate_k < 2):
             # fail loudly, like the multi-adapter misconfigurations: an
@@ -108,12 +111,17 @@ class InferenceWorker:
                 peer = model_class(**knobs)
                 peer.load_parameters(dump)
                 trees.append(peer._params)
+            extra = {}
+            if kv_page_size:  # only ride when set: user templates that
+                # predate paged KV keep working at the defaults
+                extra = {"kv_page_size": kv_page_size,
+                         "kv_pages": kv_pages}
             try:
                 self.engine = self.model.make_multi_adapter_engine(
                     trees, max_slots=max_slots,
                     max_new_tokens=max_new_tokens,
                     steps_per_sync=steps_per_sync,
-                    speculate_k=speculate_k)
+                    speculate_k=speculate_k, **extra)
             except ValueError as e:
                 raise RuntimeError(
                     "multi-adapter deployment requires trials that "
@@ -136,6 +144,11 @@ class InferenceWorker:
                     extra["speculate_k"] = speculate_k
                 if system_prefix:
                     extra["system_prefix"] = system_prefix
+                if kv_page_size:
+                    # paged-KV serving: cache HBM scales with the page
+                    # pool (live tokens), not max_slots x max_len
+                    extra["kv_page_size"] = kv_page_size
+                    extra["kv_pages"] = kv_pages
                 if draft_trial_id and speculate_k:
                     # draft-MODEL speculation: a second (smaller) trial
                     # drafts; its own knobs shape it (same tokenizer
@@ -163,7 +176,8 @@ class InferenceWorker:
         self._warmup()
 
     def _admission_check(self, max_slots: int, n_extra_adapters: int,
-                         draft=None) -> None:
+                         draft=None, kv_page_size: int = 0,
+                         kv_pages: int = 0) -> None:
         """Refuse a deployment whose serving footprint (params + KV
         cache + stacked adapters + draft params/cache + working set)
         exceeds the device's HBM, BEFORE any engine build/compile —
@@ -171,7 +185,10 @@ class InferenceWorker:
         by exposing ``estimate_serving_device_bytes``; the limit
         resolution is shared (``worker.admission``). Micro-batch
         deployments (no decode loop) pass ``max_slots=0``: no engine
-        means no KV cache to charge."""
+        means no KV cache to charge. A paged-KV deployment
+        (``kv_page_size > 0``) is budgeted at its PAGE POOL, not
+        max_slots × max_len — the admission headroom the block-table
+        cache exists to create."""
         est = getattr(self.model, "estimate_serving_device_bytes", None)
         if est is None:
             return
@@ -185,6 +202,10 @@ class InferenceWorker:
                       "n_extra_adapters": n_extra_adapters}
             if draft is not None:
                 kwargs["draft"] = draft
+            if kv_page_size:  # only when set: estimators that predate
+                # paged KV keep admitting their deployments
+                kwargs["kv_page_size"] = kv_page_size
+                kwargs["kv_pages"] = kv_pages
             budget = est(**kwargs)
             total = int(budget["total"])
         except Exception as e:  # an estimator bug must never block an
@@ -219,8 +240,15 @@ class InferenceWorker:
                 while self.engine.busy:
                     self.engine.step()
                 self.engine.poll()  # drop the dummy completion
-                for k in self.engine.stats:  # don't count the dummy in
-                    self.engine.stats[k] = 0  # served-traffic metrics
+                # don't count the dummy in served-traffic metrics;
+                # engines with capacity gauges (paged-KV pool size)
+                # scrub counters only — duck-typed user engines without
+                # reset_stats get the plain zeroing
+                if hasattr(self.engine, "reset_stats"):
+                    self.engine.reset_stats()
+                else:
+                    for k in self.engine.stats:
+                        self.engine.stats[k] = 0
             else:
                 self.model.warmup()
         except Exception:  # noqa: BLE001 — slower first request, not a
@@ -557,7 +585,9 @@ def main(argv: Optional[list] = None) -> int:
         extra_adapter_trials=list(cfg.get("extra_adapter_trials") or []),
         draft_trial_id=str(cfg.get("draft_trial_id", "")),
         draft_knobs=_require_dict_or_none(cfg.get("draft_knobs"),
-                                          "draft_knobs"))
+                                          "draft_knobs"),
+        kv_page_size=int(cfg.get("kv_page_size", 0)),
+        kv_pages=int(cfg.get("kv_pages", 0)))
     print(f"inference worker {worker.worker_id} serving", flush=True)
     worker.run()
     return 0

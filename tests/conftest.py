@@ -50,11 +50,11 @@ def tmp_workdir(tmp_path):
 
 
 @pytest.fixture(scope="session")
-def trained(tmp_path_factory):
+def trained_lm(tmp_path_factory):
     """ONE tiny trained LM shared by every serving-side test file
-    (decode engine, draft speculation, kv-int8, multi-adapter,
-    streaming) — previously each file's module-scoped copy re-ran the
-    same training, ~5s a pop on the default leg. Tests treat it as
+    (decode engine, draft speculation, kv-int8, multi-adapter, paged
+    KV, streaming) — previously each file's module-scoped copy re-ran
+    the same training, ~5s a pop on the default leg. Tests treat it as
     read-only: engines and dumps never mutate ``_params``."""
     from test_decode_engine import KNOBS
 
@@ -67,3 +67,11 @@ def trained(tmp_path_factory):
     m = LlamaLoRA(**KNOBS)
     m.train(tr)
     return m
+
+
+@pytest.fixture(scope="session")
+def trained(trained_lm):
+    """Short name most serving tests use; ``trained_lm`` exists for
+    files whose own module-level ``trained`` fixture shadows this one
+    (e.g. test_worker_serving's sub-train-job fixture)."""
+    return trained_lm

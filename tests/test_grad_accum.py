@@ -48,7 +48,13 @@ def test_grad_accum_matches_big_batch_exactly(tmp_path):
                                    rtol=1e-3, atol=1e-3)
 
 
+@pytest.mark.slow
 def test_grad_accum_composes_with_chunked_loss(tmp_path):
+    # slow leg: the composition smoke compiles a grad-accum scan AROUND
+    # the checkpointed chunked-loss scan (~20s of XLA for a loss-goes-
+    # down assertion); the component oracles already ride the slow twins
+    # (test_grad_accum_matches_big_batch_exactly, test_llama_trains_
+    # with_chunked_loss), so the default leg keeps neither duplicated
     m, losses = _train(tmp_path, grad_accum=2, loss_chunk=8)
     assert losses and np.isfinite(losses[-1])
     assert losses[-1] < losses[0]

@@ -74,9 +74,15 @@ def test_profiler_trace_per_trial(tmp_path, datasets):
 
     train_p, val_p, _ = datasets
     prof = tmp_path / "profiles"
+    # pin the shape knobs tiny: the oracle is "a trace lands per
+    # trial", not the sampled model's size — an unlucky random draw
+    # (3x256 hidden) made this the slowest default test
     tune_model(JaxFeedForward, train_p, val_p,
                total_trials=1, advisor_type="random",
-               profile_dir=str(prof))
+               profile_dir=str(prof),
+               knob_overrides={"max_epochs": 1, "hidden_layer_count": 1,
+                               "hidden_layer_units": 16,
+                               "batch_size": 64})
     trial_dirs = list(prof.iterdir())
     assert len(trial_dirs) == 1 and trial_dirs[0].name == "local-0"
     # jax.profiler writes plugins/profile/<ts>/*.trace.json.gz (and more)

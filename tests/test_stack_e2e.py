@@ -133,10 +133,35 @@ def test_full_stack_lm_generation(stack):
 
     ijob = client.create_inference_job(job["id"], max_workers=1)
     assert ijob["predictor_url"]
-    preds = client.predict(ijob["predictor_url"],
-                           ["tok1 tok2 tok3", "tok4 tok5"], timeout=180)
+    prompts = ["tok1 tok2 tok3", "tok4 tok5"]
+    preds = client.predict(ijob["predictor_url"], prompts, timeout=180)
     assert len(preds) == 2
     assert all(isinstance(p, str) and p for p in preds), preds
+    client.stop_inference_job(ijob["id"])
+
+    # paged-KV deployment surface: misconfigurations fail the API call
+    # (not a crash-looping worker), a sized-down pool serves the SAME
+    # text as the contiguous engine above, and the pool gauges ride
+    # /health (KV_PAGE_SIZE/KV_PAGES — docs/operations.md)
+    with pytest.raises(RuntimeError, match="KV_PAGES requires"):
+        client.create_inference_job(job["id"], max_workers=1,
+                                    budget={"KV_PAGES": 9})
+    with pytest.raises(RuntimeError, match="KV_PAGE_SIZE"):
+        client.create_inference_job(
+            job["id"], max_workers=1,
+            budget={"KV_PAGE_SIZE": 5})  # doesn't divide max_len=32
+    with pytest.raises(RuntimeError, match="KV_PAGES"):
+        client.create_inference_job(
+            job["id"], max_workers=1,
+            budget={"KV_PAGE_SIZE": 8, "KV_PAGES": 1})
+    ijob = client.create_inference_job(
+        job["id"], max_workers=1,
+        budget={"KV_PAGE_SIZE": 8, "KV_PAGES": 9})
+    paged = client.predict(ijob["predictor_url"], prompts, timeout=180)
+    assert paged == preds, (paged, preds)
+    health = client.get_inference_job_health(ijob["id"])
+    assert any(s.get("engine_kv_pages_total") == 8
+               for s in (health.get("workers") or {}).values()), health
     client.stop_inference_job(ijob["id"])
 
 

@@ -715,24 +715,19 @@ def test_serving_admission_refuses_oversized_engine(monkeypatch):
     w.stop()
 
 
-def test_per_request_max_new_clamped():
+def test_per_request_max_new_clamped(trained_lm):
     """Clients control generation length via sampling.max_new, clamped
-    by the worker's configured cap (slot-occupancy protection)."""
+    by the worker's configured cap (slot-occupancy protection).
+    ``trained_lm``: the session LM fixture (this file's own ``trained``
+    fixture is the MLP sub-train-job and shadows the short name)."""
     import threading
 
     from rafiki_tpu.models.llama_lora import LlamaLoRA
     from rafiki_tpu.serving.queues import InProcQueueHub
     from test_decode_engine import KNOBS as LM_KNOBS
 
-    from rafiki_tpu.data import generate_text_classification_dataset
-    import tempfile, os
-    d = tempfile.mkdtemp()
-    tr = os.path.join(d, "t.jsonl")
-    generate_text_classification_dataset(tr, 48, seed=0)
-    m = LlamaLoRA(**LM_KNOBS)
-    m.train(tr)
     store = ParamStore.from_uri("mem://")
-    store.save("lm0", m.dump_parameters())
+    store.save("lm0", trained_lm.dump_parameters())
     hub = InProcQueueHub()
     worker = InferenceWorker(LlamaLoRA, "lm0", LM_KNOBS, store, hub,
                              "w0", decode_loop=True, max_slots=4,
