@@ -12,6 +12,8 @@ from __future__ import annotations
 import base64
 from typing import Any, Dict, Optional, Tuple
 
+from ..obs import (PROM_CONTENT_TYPE, MetricsRegistry, TraceBuffer,
+                   mint_trace_id)
 from ..utils.http import JsonHttpService, RawResponse
 from .admin import Admin, AuthError
 
@@ -20,8 +22,32 @@ class AdminApp:
     def __init__(self, admin: Admin, host: str = "127.0.0.1",
                  port: int = 0) -> None:
         self.admin = admin
-        self.http = JsonHttpService(host, port)
+        # control-plane metrics: live gauges evaluated at scrape time
+        # against the ServicesManager (no second bookkeeping), plus the
+        # HTTP request counter/latency the service kit wires itself
+        self.metrics = MetricsRegistry()
+        self.traces = TraceBuffer(256)
+        svcs = admin.services
+        self.metrics.gauge("admin_services",
+                           "live managed service processes",
+                           fn=lambda: len(svcs.services))
+        self.metrics.gauge("admin_free_slots",
+                           "unallocated device sub-mesh slots",
+                           fn=lambda: svcs.allocator.free_count())
+        self.metrics.gauge(
+            "admin_respawns_done", "self-healing worker respawns",
+            fn=lambda: svcs.respawn_stats()["respawns_done"])
+        self.metrics.gauge(
+            "admin_pending_respawns", "slot-starved respawns queued",
+            fn=lambda: svcs.respawn_stats()["pending_respawns"])
+        self.http = JsonHttpService(host, port, registry=self.metrics)
         r = self.http.route
+        # /metrics is numeric-only and stays open like /health; the
+        # trace ring carries job ids/app names — USER-owned metadata —
+        # so unlike the (by-design unauthenticated) worker/predictor
+        # surfaces, the admin's /debug/requests sits behind auth
+        r("GET", "/metrics", self._metrics)
+        r("GET", "/debug/requests", self._auth(self._debug_requests))
         r("POST", "/tokens", self._login)
         r("GET", "/health", self._health)
         r("GET", "/", self._dashboard)
@@ -73,6 +99,21 @@ class AdminApp:
         return wrapped
 
     # ---- routes ----
+    def _metrics(self, _m, _b, _h) -> Tuple[int, Any]:
+        return 200, RawResponse(
+            self.metrics.render_prometheus().encode("utf-8"),
+            PROM_CONTENT_TYPE)
+
+    def _debug_requests(self, m, _b, _user) -> Tuple[int, Any]:
+        from ..obs import DEBUG_REQUESTS_DEFAULT_N
+
+        n = int(m.get("n", DEBUG_REQUESTS_DEFAULT_N))  # a bad n is a
+        # ValueError -> the _auth wrapper's 400, same as other routes
+        if n < 0:
+            return 400, {"error": "n must be >= 0"}
+        recs = self.traces.recent(n)
+        return 200, {"requests": recs, "count": len(recs)}
+
     def _dashboard(self, _m, _b, _h) -> Tuple[int, Any]:
         """Operator dashboard (SURVEY.md §1 layer 1): a self-contained
         HTML+JS page over this very REST API — jobs → trials → loss
@@ -128,12 +169,16 @@ class AdminApp:
                                             task=body.get("task"))
 
     def _create_train_job(self, _m, body, user) -> Tuple[int, Any]:
-        return 200, self.admin.create_train_job(
+        job = self.admin.create_train_job(
             user["id"], body["app"], body["task"],
             body["train_dataset_id"], body["val_dataset_id"],
             body.get("budget", {"TRIAL_COUNT": 5}),
             model_ids=body.get("model_ids"),
             train_args=body.get("train_args"))
+        # job lifecycle lands in the admin's own /debug/requests ring
+        self.traces.start(mint_trace_id(), request_id=str(job["id"]),
+                          span="create_train_job", app=body["app"])
+        return 200, job
 
     def _get_train_job(self, m, _b, user) -> Tuple[int, Any]:
         return 200, self.admin.get_train_job(m["id"])
@@ -159,12 +204,15 @@ class AdminApp:
     def _create_inference_job(self, _m, body, user) -> Tuple[int, Any]:
         try:
             budget = body.get("budget")
-            return 200, self.admin.create_inference_job(
+            job = self.admin.create_inference_job(
                 user["id"], body["train_job_id"],
                 max_workers=int(body.get("max_workers", 2)),
                 budget=budget if isinstance(budget, dict) else None)
         except RuntimeError as e:
             return 409, {"error": str(e)}
+        self.traces.start(mint_trace_id(), request_id=str(job["id"]),
+                          span="create_inference_job")
+        return 200, job
 
     def _get_inference_job(self, m, _b, user) -> Tuple[int, Any]:
         return 200, self.admin.get_inference_job(m["id"])

@@ -325,7 +325,12 @@ class ServicesManager:
                      "knob_overrides": overrides,
                      "checkpoint_interval_s": job["train_args"].get(
                          "checkpoint_interval_s", 30.0),
-                     "worker_id": f"tw-{sub['id'][:8]}-{w}"},
+                     "worker_id": f"tw-{sub['id'][:8]}-{w}",
+                     # /metrics + /debug/requests sidecar: ephemeral
+                     # port, discoverable from this file
+                     "obs_port_file": str(
+                         self.workdir / f"tw-{sub['id'][:8]}-{w}"
+                                        ".obs_port")},
                     ServiceType.TRAIN_WORKER, slot=slot,
                     train_job_id=train_job_id, sub_train_job_id=sub["id"])
                 spawned.append(worker)
@@ -500,6 +505,11 @@ class ServicesManager:
                    "param_store_uri": self.param_store_uri,
                    "kv_host": self.kv_host, "kv_port": self.kv_port,
                    "worker_id": wid, "decode_loop": decode_loop,
+                   # /metrics + /debug/requests sidecar: ephemeral
+                   # port, discoverable from this file (and from the
+                   # obs_port gauge the worker publishes to /health)
+                   "obs_port_file": str(self.workdir
+                                        / f"{wid}.obs_port"),
                    # decode-loop dispatch amortization (ops guide): K
                    # fused steps per device program, tunable per job
                    "steps_per_sync": int(budget.get("STEPS_PER_SYNC",

@@ -7,6 +7,9 @@ One module per hazard category (mirrors ``docs/linting.md``):
 - :mod:`concurrency` — shared-state hazards across the serving/worker
   threads.
 - :mod:`robustness` — error-handling and library-internals hazards.
+- :mod:`observability` — counters written behind the metrics plane's
+  back.
 """
 
-from . import concurrency, jax_tracing, robustness  # noqa: F401
+from . import (concurrency, jax_tracing, observability,  # noqa: F401
+               robustness)

@@ -1,0 +1,74 @@
+"""Observability-hygiene rules.
+
+PR 6 replaced the repo's ad-hoc ``self.stats`` dicts with the
+``rafiki_tpu.obs`` registry (locked StatsMaps, race-free snapshots,
+Prometheus exposition). ``obs-unregistered-metric`` keeps the repo from
+regressing: a bare ``something.stats[...] = ...`` write (or a fresh
+``.stats = {...}`` dict literal) creates a counter the metrics plane
+cannot see, whose reads race the writer, and whose name never reaches
+``/metrics`` — exactly the drift this subsystem was built to end.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import dotted
+from ..engine import Rule, register
+
+
+@register
+class ObsUnregisteredMetricRule(Rule):
+    id = "obs-unregistered-metric"
+    category = "observability"
+    severity = "error"
+    description = (
+        "ad-hoc `*.stats[...] = ...` counter write (or `.stats = {...}` "
+        "dict literal) outside the obs registry: invisible to /metrics "
+        "and racy to snapshot — use obs.StatsMap inc/set/max_set")
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.AugAssign):
+                yield from self._check_subscript_target(node,
+                                                        node.target)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    yield from self._check_subscript_target(node, t)
+                    yield from self._check_dict_literal(node, t)
+
+    @staticmethod
+    def _is_stats_attr(expr) -> bool:
+        """``<anything>.stats`` — the attribute spelling the repo's
+        hand-rolled counter dicts all used. Bare local names
+        (``stats[...]``) stay allowed: a function-local scratch dict is
+        not a metrics surface."""
+        return isinstance(expr, ast.Attribute) and expr.attr == "stats"
+
+    def _check_subscript_target(self, stmt, target):
+        if not isinstance(target, ast.Subscript):
+            return  # plain rebinding (e.g. `self.stats = StatsMap(…)`)
+        # peel chained subscripts: stats["a"]["b"] = ... still writes
+        # through the stats mapping
+        base = target
+        while isinstance(base, ast.Subscript):
+            base = base.value
+        if not self._is_stats_attr(base):
+            return
+        path = dotted(base) or "….stats"
+        yield stmt, (
+            f"'{path}[...] = ...' writes a counter behind the metrics "
+            "plane's back (unregistered, racy to snapshot); make "
+            f"'{path}' an obs.StatsMap and use "
+            ".inc()/.set()/.max_set()")
+
+    def _check_dict_literal(self, stmt, target):
+        if not self._is_stats_attr(target):
+            return
+        if isinstance(stmt.value, (ast.Dict, ast.DictComp)):
+            path = dotted(target) or "….stats"
+            yield stmt, (
+                f"'{path}' is created as a plain dict: its counters "
+                "never reach /metrics and reads race writers — build "
+                "an obs.StatsMap (and register it on the process's "
+                "MetricsRegistry) instead")

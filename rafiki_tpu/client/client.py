@@ -154,11 +154,14 @@ class Client:
     # ---- online prediction ----
     def predict(self, predictor_url: str, queries: Sequence[Any],
                 timeout: Optional[float] = None,
-                sampling: Optional[Dict[str, Any]] = None) -> List[Any]:
+                sampling: Optional[Dict[str, Any]] = None,
+                trace_id: Optional[str] = None) -> List[Any]:
         """``sampling`` (generation jobs): {temperature, top_k, top_p,
         seed, eos_id, max_new, adapter_id} forwarded to the decode
         loop; omit for greedy defaults. ``max_new`` is clamped by the
-        worker's configured cap."""
+        worker's configured cap. ``trace_id`` rides as
+        ``X-Rafiki-Trace-Id`` so this request's timeline can be pulled
+        from the predictor's and workers' ``/debug/requests``."""
         body: Dict[str, Any] = {"queries": _jsonable(queries)}
         if timeout is not None:
             body["timeout"] = timeout
@@ -169,12 +172,14 @@ class Client:
         sock_timeout = self.timeout if timeout is None else \
             max(self.timeout, timeout + 30.0)
         out = json_request("POST", f"{predictor_url.rstrip('/')}/predict",
-                           body, timeout=sock_timeout)
+                           body, headers=_trace_headers(trace_id),
+                           timeout=sock_timeout)
         return out["predictions"]
 
     def predict_stream(self, predictor_url: str, queries: Sequence[Any],
                        timeout: Optional[float] = None,
-                       sampling: Optional[Dict[str, Any]] = None):
+                       sampling: Optional[Dict[str, Any]] = None,
+                       trace_id: Optional[str] = None):
         """Streaming generation: yields the predictor's SSE events —
         ``{"delta": {qi: text}}`` per new-token batch (append to query
         qi's output), rarely ``{"replace": {qi: text}}`` (authoritative
@@ -199,8 +204,13 @@ class Client:
         server_budget = STREAM_BUDGET_S if timeout is None else timeout
         yield from sse_request(
             "POST", f"{predictor_url.rstrip('/')}/predict_stream",
-            body, timeout=self.timeout,
+            body, headers=_trace_headers(trace_id),
+            timeout=self.timeout,
             read_timeout=max(self.timeout, server_budget + 30.0))
+
+
+def _trace_headers(trace_id: Optional[str]) -> Optional[Dict[str, str]]:
+    return {"X-Rafiki-Trace-Id": trace_id} if trace_id else None
 
 
 def _jsonable(queries: Sequence[Any]) -> List[Any]:

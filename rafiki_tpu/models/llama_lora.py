@@ -1965,7 +1965,11 @@ class LlamaLoRA(BaseModel):
                      for b in batch_iterator({"ids": ids, "lens": lens},
                                              batch_size, seed=epoch)),
                     sharding=b_shard)
-                ctx.logger.log(epoch=epoch, loss=mean_loss)
+                # tokens: the epoch's (padded) token volume — the train
+                # worker's obs hook turns it into tokens/s + est_mfu so
+                # trials compare on throughput, not just loss
+                ctx.logger.log(epoch=epoch, loss=mean_loss,
+                               tokens=int(ids.shape[0] * ids.shape[1]))
                 if ctx.checkpoint is not None:
                     # preemption safety: worker throttles + persists.
                     # The live (sharded device) tree rides along so a

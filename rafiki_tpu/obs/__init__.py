@@ -1,0 +1,22 @@
+"""rafiki-tpu observability plane (dependency-free).
+
+One metrics core (counters / gauges / fixed-bucket histograms /
+StatsMaps + Prometheus text exposition), one request-tracing core
+(trace IDs + bounded span rings), and the HTTP surfacing that mounts
+``GET /metrics`` and ``GET /debug/requests`` on every service. See
+``docs/observability.md`` for the metric catalog and how the pieces
+join across processes.
+"""
+
+from .http import DEBUG_REQUESTS_DEFAULT_N, ObsServer, mount_obs_routes
+from .metrics import (DEFAULT_LATENCY_BUCKETS_S, PROM_CONTENT_TYPE,
+                      Counter, Gauge, Histogram, MetricsRegistry,
+                      StatsMap)
+from .trace import TraceBuffer, mint_trace_id, sanitize_trace_id
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "StatsMap",
+    "DEFAULT_LATENCY_BUCKETS_S", "PROM_CONTENT_TYPE",
+    "TraceBuffer", "mint_trace_id", "sanitize_trace_id",
+    "ObsServer", "mount_obs_routes", "DEBUG_REQUESTS_DEFAULT_N",
+]

@@ -1,0 +1,83 @@
+"""HTTP surfacing for the obs plane: ``/metrics`` + ``/debug/requests``.
+
+Two entry points:
+
+- :func:`mount_obs_routes` adds the two routes to an EXISTING
+  :class:`~rafiki_tpu.utils.http.JsonHttpService` (admin app, predictor
+  service — processes that already listen).
+- :class:`ObsServer` is a standalone single-purpose server for
+  processes that had no HTTP surface at all (the inference and train
+  workers): the worker loop stays a queue consumer; scrapes and
+  timeline pulls ride a daemon-threaded sidecar on an ephemeral port.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+from ..utils.http import JsonHttpService, RawResponse
+from .metrics import PROM_CONTENT_TYPE, MetricsRegistry
+from .trace import TraceBuffer
+
+#: default /debug/requests page size (override with ?n=K)
+DEBUG_REQUESTS_DEFAULT_N = 32
+
+
+def mount_obs_routes(http: JsonHttpService, registry: MetricsRegistry,
+                     traces: Optional[TraceBuffer] = None) -> None:
+    """Mount ``GET /metrics`` (Prometheus text) and
+    ``GET /debug/requests?n=K`` (JSON trace records, newest first)."""
+
+    def _metrics(_m, _b, _h) -> Tuple[int, Any]:
+        return 200, RawResponse(
+            registry.render_prometheus().encode("utf-8"),
+            PROM_CONTENT_TYPE)
+
+    def _debug_requests(m, _b, _h) -> Tuple[int, Any]:
+        try:
+            n = int(m.get("n", DEBUG_REQUESTS_DEFAULT_N))
+        except (TypeError, ValueError):
+            return 400, {"error": "n must be an integer"}
+        if n < 0:
+            return 400, {"error": "n must be >= 0"}
+        recs = traces.recent(n) if traces is not None else []
+        return 200, {"requests": recs, "count": len(recs)}
+
+    http.route("GET", "/metrics", _metrics)
+    http.route("GET", "/debug/requests", _debug_requests)
+
+
+class ObsServer:
+    """Sidecar observability endpoint for HTTP-less processes.
+
+    Serves exactly ``/metrics``, ``/debug/requests``, and a trivial
+    ``/health`` on a daemon-threaded stdlib server; the owning loop
+    never blocks on it and ``stop()`` is idempotent.
+    """
+
+    def __init__(self, registry: MetricsRegistry,
+                 traces: Optional[TraceBuffer] = None,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        self.registry = registry
+        self.traces = traces
+        # the sidecar instruments its own scrapes too (http_requests_
+        # total on a worker IS the scrape count — a cheap liveness probe)
+        self.http = JsonHttpService(host, port, registry=registry)
+        mount_obs_routes(self.http, registry, traces)
+        self.http.route("GET", "/health",
+                        lambda _m, _b, _h: (200, {"ok": True}))
+        self._started = False
+
+    def start(self) -> Tuple[str, int]:
+        host, port = self.http.start()
+        self._started = True
+        return host, port
+
+    @property
+    def port(self) -> int:
+        return self.http.port
+
+    def stop(self) -> None:
+        if self._started:
+            self.http.stop()
+            self._started = False

@@ -57,6 +57,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.metrics import StatsMap
+
 # Speculation break-even (tokens per verify call) and how many scan
 # calls to wait before re-probing a gated-off speculator. ~1.5 means a
 # draft window must beat single-token decoding by 50% to keep the
@@ -67,6 +69,10 @@ SPEC_MIN_TOKENS_PER_CALL = 1.5
 # break-even floor sits higher than free host-side n-gram drafting
 SPEC_MIN_TOKENS_PER_CALL_DRAFT = 2.2
 SPEC_REPROBE_CALLS = 32
+#: generated-token interval between decode_mark trace spans per slot —
+#: coarse enough to stay off the hot path, fine enough that a stalled
+#: generation shows WHERE it stalled in /debug/requests
+SPAN_DECODE_MARK_EVERY = 32
 # EMA decay for tokens-per-verify-call: 0.7 gates hopeless content off
 # after ~2 zero-acceptance calls (start is just above the floor) while
 # a healthy acceptance stream keeps the path on indefinitely
@@ -87,6 +93,7 @@ class _Slot:
     n_consumed: int = 0         # tokens fed to the model so far
     generated: List[int] = field(default_factory=list)
     n_streamed: int = 0         # generated tokens already poll_partial'd
+    first_tokened: bool = False  # first_token span already emitted
 
 
 class DecodeEngine:
@@ -257,7 +264,12 @@ class DecodeEngine:
         #: prompts — a prefix's KV is a function of the adapter that
         #: computed it); single-adapter engines use key 0
         self._prefixes: Dict[int, Dict[str, Any]] = {}
-        self.stats: Dict[str, int] = {
+        #: served-traffic counters + pool gauges, as a race-free
+        #: ``obs.StatsMap`` (dict reads everywhere keep working; writes
+        #: go through inc/set/max_set — see the obs-unregistered-metric
+        #: lint rule). Gauge names are load-bearing: the worker, the
+        #: /health aggregation, and the dashboard all key on them.
+        self.stats = StatsMap({
             "steps": 0, "tokens_generated": 0, "requests_done": 0,
             "max_concurrent": 0, "prefill_calls": 0,
             "prefill_tokens": 0, "spec_calls": 0, "spec_drafted": 0,
@@ -270,7 +282,15 @@ class DecodeEngine:
             # (backpressure waits, not refusals)
             "kv_pages_used": 0, "kv_pages_high_water": 0,
             "kv_pages_total": (self.n_pages - 1 if self.paged else 0),
-            "admission_stalls": 0}
+            "admission_stalls": 0})
+        #: optional request-lifecycle hook ``(event, request_id, attrs)``
+        #: — the inference worker wires it into its trace buffer and
+        #: latency histograms (TTFT, time-in-queue). Events: admitted,
+        #: prefill, first_token, decode_mark (every
+        #: ``SPAN_DECODE_MARK_EVERY`` generated tokens), done. None
+        #: (the default) costs one attribute read per emission site.
+        self.span_sink: Optional[Callable[[str, Any, Dict[str, Any]],
+                                          None]] = None
 
     # ---- submission / results (thread-safe: worker loop vs callers) ----
     def submit(self, request_id: Any, prompt_ids: np.ndarray,
@@ -364,10 +384,9 @@ class DecodeEngine:
         if grew:
             self._ptab_dirty = True
             used = self.n_pages - 1 - len(self._free_pages)
-            self.stats["kv_pages_used"] = used
-            self.stats["kv_pages_high_water"] = max(
-                self.stats["kv_pages_high_water"], used)
-            self.stats["kv_pages_total"] = self.n_pages - 1
+            self.stats.set("kv_pages_used", used)
+            self.stats.max_set("kv_pages_high_water", used)
+            self.stats.set("kv_pages_total", self.n_pages - 1)
 
     def _release_slot_pages(self, i: int) -> None:
         """Return slot ``i``'s pages + reservation to the pool (request
@@ -385,9 +404,9 @@ class DecodeEngine:
             # discipline (admission reads/writes them under _lock)
             self._res_total -= int(self._n_res[i])
             self._n_res[i] = 0
-        self.stats["kv_pages_used"] = \
-            self.n_pages - 1 - len(self._free_pages)
-        self.stats["kv_pages_total"] = self.n_pages - 1
+        self.stats.set("kv_pages_used",
+                       self.n_pages - 1 - len(self._free_pages))
+        self.stats.set("kv_pages_total", self.n_pages - 1)
 
     def _ptab_arg(self) -> jnp.ndarray:
         """The page-table operand every compiled call consumes (a tiny
@@ -506,8 +525,8 @@ class DecodeEngine:
         if self._draft_cache is not None and "draft_cache" in pre:
             self._draft_cache = pre["install"](
                 self._draft_cache, pre["draft_cache"], rws)
-        self.stats["prefix_hits"] += len(rows)
-        self.stats["prefix_tokens"] += pre["len"] * len(rows)
+        self.stats.inc("prefix_hits", len(rows))
+        self.stats.inc("prefix_tokens", pre["len"] * len(rows))
 
     @property
     def busy(self) -> bool:
@@ -519,12 +538,34 @@ class DecodeEngine:
         """Zero the served-traffic counters without losing capacity
         gauges (``kv_pages_total`` describes the pool, not traffic) —
         what the worker's post-warmup scrub needs."""
-        for k in self.stats:
-            self.stats[k] = 0
+        keep = {}
         if self.paged:
-            self.stats["kv_pages_total"] = self.n_pages - 1
-            self.stats["kv_pages_used"] = \
-                self.n_pages - 1 - len(self._free_pages)
+            keep = {"kv_pages_total": self.n_pages - 1,
+                    "kv_pages_used":
+                        self.n_pages - 1 - len(self._free_pages)}
+        self.stats.reset(keep=keep)
+
+    def stats_snapshot(self) -> Dict[str, int]:
+        """A point-in-time copy of the counters, taken under the stats
+        lock — the ONLY race-free way to read them while the step
+        thread runs (iterating ``stats`` key-by-key from another thread
+        used to race concurrent mutation)."""
+        return self.stats.snapshot()
+
+    def _span(self, event: str, request_id: Any, **attrs: Any) -> None:
+        """Emit a request-lifecycle event to the wired sink (no-op —
+        one attribute read — when nothing is wired)."""
+        sink = self.span_sink
+        if sink is None:
+            return
+        try:
+            sink(event, request_id, attrs)
+        except Exception:  # noqa: BLE001 — observability must never
+            import logging  # kill the step loop; log once per type
+
+            logging.getLogger(__name__).warning(
+                "span sink failed on %s", event, exc_info=True)
+            self.span_sink = None  # a broken sink stays broken: detach
 
     def reset(self) -> None:
         """Drop all occupants and rebuild device state. For error
@@ -560,7 +601,7 @@ class DecodeEngine:
                 self._n_res[:] = 0
                 self._res_total = 0
                 self._ptab_dirty = True
-                self.stats["kv_pages_used"] = 0
+                self.stats.set("kv_pages_used", 0)
         self._cache = self.module.init(
             jax.random.PRNGKey(0), jnp.zeros((self.B, 1), jnp.int32),
             decode=True)["cache"]
@@ -618,8 +659,8 @@ class DecodeEngine:
                 self._draft_cache = self._draft_sync_c(
                     self.draft_params, self._draft_cache, tok_dev,
                     pos_dev, aid_dev, self._ptab_arg())
-            self.stats["prefill_calls"] += 1
-            self.stats["prefill_tokens"] += int(adv.sum())
+            self.stats.inc("prefill_calls")
+            self.stats.inc("prefill_tokens", int(adv.sum()))
             for i in range(self.B):
                 if adv[i] > 0:
                     self._pos[i] += int(adv[i])
@@ -631,6 +672,7 @@ class DecodeEngine:
         """Admit queued requests into free slots, run K fused compiled
         steps for every live slot, harvest completions. Returns live
         count (at admission time)."""
+        admitted_info: List[Tuple[Any, int, int]] = []
         with self._lock:
             admitted = False
             # rows grouped by adapter id with the SNAPSHOT each matched
@@ -654,7 +696,7 @@ class DecodeEngine:
                             min(len(head.prompt) - 1 + head.max_new,
                                 self.L))
                         if self._res_total + n_res > self.n_pages - 1:
-                            self.stats["admission_stalls"] += 1
+                            self.stats.inc("admission_stalls")
                             break
                         self._n_res[i] = n_res
                         self._res_total += n_res
@@ -693,9 +735,14 @@ class DecodeEngine:
                         # scatters into them before the next call)
                         self._ensure_pages_to(i, int(self._pos[i]))
                     admitted = True
+                    admitted_info.append((slot.request_id, i,
+                                          len(slot.prompt)))
             live = [i for i in range(self.B) if self._slots[i] is not None]
-            self.stats["max_concurrent"] = max(self.stats["max_concurrent"],
-                                               len(live))
+            self.stats.max_set("max_concurrent", len(live))
+        # span emission OUTSIDE the engine lock: the sink may take its
+        # own locks (trace buffer, histograms) and must not nest ours
+        for rid, row, plen in admitted_info:
+            self._span("admitted", rid, slot=row, prompt_tokens=plen)
         if not live:
             return 0
         for pre, rows in prefix_hits.values():
@@ -706,6 +753,8 @@ class DecodeEngine:
             self._install_prefix(rows, pre)
         if admitted and self._prefill_fn is not None:
             self._chunked_prefill()
+            for rid, row, plen in admitted_info:
+                self._span("prefill", rid, prompt_tokens=plen)
         if admitted or self._prompt_dev is None:
             # refresh the device-resident prompts only when they changed
             self._prompt_dev = jnp.asarray(self._prompt_buf)
@@ -742,7 +791,7 @@ class DecodeEngine:
             jnp.asarray(self._topp), jnp.asarray(self._seed),
             jnp.asarray(self._aid), self._ptab_arg())
         emitted = np.asarray(emitted)  # (K, B) — the per-token sync
-        self.stats["steps"] += self.K
+        self.stats.inc("steps", self.K)
         if self._draft_cache is not None:
             if not any_sampling and (
                     self._spec_ema >= self._spec_floor
@@ -768,6 +817,7 @@ class DecodeEngine:
             n_real = max(0, min(self.K, int(self._stop_pos[i]) - pos0,
                                 self.L - pos0))
             eos_hit = False
+            n0 = len(slot.generated)
             for j in range(n_real):
                 if pos0 + j >= plen - 1:  # emission at a generated pos
                     t = int(emitted[j, i])
@@ -777,7 +827,10 @@ class DecodeEngine:
                         eos_hit = True
                         break
                     slot.generated.append(t)
-                    self.stats["tokens_generated"] += 1
+            n1 = len(slot.generated)
+            if n1 > n0:
+                self.stats.inc("tokens_generated", n1 - n0)
+                self._mark_progress(slot, n0, n1)
             slot.n_consumed += n_real
             self._pos[i] = pos0 + n_real
             if (eos_hit or len(slot.generated) >= slot.max_new
@@ -800,8 +853,21 @@ class DecodeEngine:
         if finished:
             with self._lock:
                 self._done.extend(finished)
-                self.stats["requests_done"] += len(finished)
+                self.stats.inc("requests_done", len(finished))
+            for rid, toks in finished:
+                self._span("done", rid, tokens=len(toks))
         return len(live)
+
+    def _mark_progress(self, slot: "_Slot", n0: int, n1: int) -> None:
+        """first_token / periodic decode_mark spans for a slot that
+        grew from ``n0`` to ``n1`` generated tokens this call. Pure
+        integer math when no sink is wired."""
+        if self.span_sink is None:
+            return
+        if n0 == 0:
+            self._span("first_token", slot.request_id)
+        if n0 // SPAN_DECODE_MARK_EVERY != n1 // SPAN_DECODE_MARK_EVERY:
+            self._span("decode_mark", slot.request_id, tokens=n1)
 
     def _resync_draft(self) -> None:
         """Rebuild the draft cache from every live slot's ACCEPTED
@@ -843,7 +909,7 @@ class DecodeEngine:
                 jnp.asarray(tok_m), jnp.asarray(pos_m),
                 jnp.asarray(self._aid), self._ptab_arg())
         self._draft_synced = True
-        self.stats["draft_resyncs"] += 1
+        self.stats.inc("draft_resyncs")
 
     def _mirror_scan_onto_draft(self, emitted: np.ndarray) -> None:
         """Write the fused scan's ACTUALLY-CONSUMED inputs into the
@@ -915,8 +981,7 @@ class DecodeEngine:
                     [self._tok[:, None], drafts], axis=1)),
                 jnp.asarray(self._pos[:, None] + offs),
                 jnp.asarray(self._aid), self._ptab_arg())
-            self.stats["spec_draft_model_calls"] = \
-                self.stats.get("spec_draft_model_calls", 0) + 1
+            self.stats.inc("spec_draft_model_calls")
         else:
             drafts = np.zeros((self.B, k - 1), np.int32)
             for i in live:
@@ -939,8 +1004,8 @@ class DecodeEngine:
             self._ptab_arg())
         g = np.asarray(g)            # (B, k) model argmax per position
         n_emit = np.asarray(n_emit)  # (B,) 1 + accepted draft prefix
-        self.stats["steps"] += 1
-        self.stats["spec_calls"] += 1
+        self.stats.inc("steps")
+        self.stats.inc("spec_calls")
         self._spec_idle = 0
         self._spec_ema = (SPEC_EMA_DECAY * self._spec_ema
                           + (1 - SPEC_EMA_DECAY)
@@ -957,12 +1022,15 @@ class DecodeEngine:
             eos_hit = slot.eos_id is not None and slot.eos_id in toks
             if eos_hit:  # drop the EOS and anything verified past it
                 toks = toks[:toks.index(slot.eos_id)]
+            n0 = len(slot.generated)
             slot.generated.extend(toks)
             slot.n_consumed += take
             self._pos[i] = pos0 + take
-            self.stats["tokens_generated"] += len(toks)
-            self.stats["spec_drafted"] += k - 1
-            self.stats["spec_accepted"] += take - 1
+            if toks:
+                self.stats.inc("tokens_generated", len(toks))
+                self._mark_progress(slot, n0, len(slot.generated))
+            self.stats.inc("spec_drafted", k - 1)
+            self.stats.inc("spec_accepted", take - 1)
             if (eos_hit or len(slot.generated) >= slot.max_new
                     or int(self._pos[i]) >= self.L):
                 finished.append((slot.request_id, slot.generated))
@@ -978,7 +1046,9 @@ class DecodeEngine:
         if finished:
             with self._lock:
                 self._done.extend(finished)
-                self.stats["requests_done"] += len(finished)
+                self.stats.inc("requests_done", len(finished))
+            for rid, toks in finished:
+                self._span("done", rid, tokens=len(toks))
         return len(live)
 
 
@@ -1273,3 +1343,16 @@ class TextDecodeEngine:
     @property
     def stats(self) -> Dict[str, int]:
         return self.engine.stats
+
+    def stats_snapshot(self) -> Dict[str, int]:
+        return self.engine.stats_snapshot()
+
+    @property
+    def span_sink(self):
+        return self.engine.span_sink
+
+    @span_sink.setter
+    def span_sink(self, sink) -> None:
+        # request ids pass through submit untouched, so the token
+        # engine's lifecycle events carry the caller's ids directly
+        self.engine.span_sink = sink

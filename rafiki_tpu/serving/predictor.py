@@ -20,6 +20,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..obs import (MetricsRegistry, TraceBuffer, mint_trace_id,
+                   mount_obs_routes, sanitize_trace_id)
 from ..utils.http import STREAM_BUDGET_S, JsonHttpService, StreamResponse
 from .queues import (EXPIRY_SKEW_TOLERANCE_S, QueueHub, pack_message,
                      unpack_message)
@@ -105,11 +107,33 @@ class Predictor:
         #: the controller's signal
         self._reply_lat: "collections.deque[float]" = collections.deque(
             maxlen=self.LATENCY_WINDOW)
-        self._n_queries = 0
-        self._n_requests = 0
-        self._latency_sum = 0.0
+        #: the obs plane: request counters + fixed-bucket latency
+        #: histograms (scraped via /metrics) and the per-request trace
+        #: ring (/debug/requests). The bounded reservoir below stays —
+        #: it feeds the adaptive-gather CONTROLLER, which wants exact
+        #: recent samples, not bucket counts.
+        self.metrics = MetricsRegistry()
+        self.traces = TraceBuffer(512)
+        self._c_requests = self.metrics.counter(
+            "requests_served", "predict/predict_stream calls answered")
+        self._c_queries = self.metrics.counter(
+            "queries_served", "individual queries answered")
+        self._h_e2e = self.metrics.histogram(
+            "request_seconds", "end-to-end request latency (seconds)")
+        self._h_reply = self.metrics.histogram(
+            "gather_reply_seconds",
+            "scatter-to-reply latency per worker answer (seconds)")
+        self.metrics.gauge(
+            "gather_deadline_s",
+            "adaptive-gather controller's live budget (seconds)",
+            fn=self._gather_deadline_s)
         self._latencies: "collections.deque[float]" = collections.deque(
             maxlen=self.LATENCY_WINDOW)
+        #: per-worker publish watermarks for staleness detection:
+        #: worker_id -> (last seen uptime_s, local monotonic at change).
+        #: Monotonic on BOTH sides — wall-clock steps can't grey out a
+        #: healthy fleet (the published_at failure mode)
+        self._worker_seen: Dict[str, Tuple[float, float]] = {}
         self._rr = 0  # round-robin cursor for single-worker streams
         #: consecutive zero-answer adaptive gathers — drives the
         #: escalating recovery below (a single penalty sample per miss
@@ -134,23 +158,36 @@ class Predictor:
 
     def predict(self, queries: Sequence[Any],
                 timeout: Optional[float] = None,
-                sampling: Optional[Dict] = None) -> Tuple[List[Any], Dict]:
+                sampling: Optional[Dict] = None,
+                trace_id: Optional[str] = None
+                ) -> Tuple[List[Any], Dict]:
         """Returns (ensembled predictions, info dict). ``sampling``
         (generation jobs only) rides with the message to the decode
         loop: {temperature, top_k, top_p, seed, eos_id, max_new,
         adapter_id} — seeded draws are reproducible per
         (seed, position) regardless of serving load; max_new is
-        clamped by the worker's configured cap."""
+        clamped by the worker's configured cap.
+
+        ``trace_id``: honored when well-formed (the HTTP front passes
+        an inbound ``X-Rafiki-Trace-Id``), else minted here; it rides
+        in the scatter payload so worker-side span records join this
+        predictor's across ``/debug/requests``, and comes back in
+        ``info["trace_id"]``."""
         t0 = time.monotonic()
         adaptive = timeout is None and self.adaptive_gather
         timeout = self._gather_deadline_s() if timeout is None else timeout
         qid = uuid.uuid4().hex
+        tid = sanitize_trace_id(trace_id) or mint_trace_id()
+        self.traces.start(tid, request_id=qid, span="received",
+                          n_queries=len(queries),
+                          timeout_s=round(float(timeout), 4))
         deadline = t0 + timeout
         # the wall-clock deadline rides with the query: a worker that
         # pops it too late drops it instead of computing an answer
         # nobody will read (and recreating a discarded reply queue)
         payload = {"id": qid, "queries": _stack(queries),
-                   "deadline_ts": time.time() + timeout}
+                   "deadline_ts": time.time() + timeout,
+                   "trace_id": tid}
         if sampling:
             payload["sampling"] = dict(sampling)
         msg = pack_message(payload)
@@ -164,6 +201,8 @@ class Predictor:
             pass           # TTL is defense-in-depth
         for wid in self.worker_ids:
             self.hub.push_query(wid, msg)
+        self.traces.add_span(tid, "scattered",
+                             workers=len(self.worker_ids))
 
         per_worker: List[List[Any]] = []
         errors: List[str] = []
@@ -184,8 +223,13 @@ class Predictor:
                     # 504 on a 'fully answering' fleet)
                     errors.append(str(reply["error"]))
                     continue
+                reply_lat = time.monotonic() - t0
                 with self._lock:  # controller signal: scatter→ANSWER
-                    self._reply_lat.append(time.monotonic() - t0)
+                    self._reply_lat.append(reply_lat)
+                self._h_reply.observe(reply_lat)
+                self.traces.add_span(
+                    tid, "reply",
+                    worker=str(reply.get("worker_id") or ""))
                 per_worker.append(list(reply["predictions"]))
         finally:
             # drop the reply queue even on a gather error: late answers
@@ -195,10 +239,10 @@ class Predictor:
             except Exception:  # rafiki: noqa[silent-except] —
                 pass           # cleanup is best-effort
         latency = time.monotonic() - t0
+        self._c_queries.inc(len(queries))
+        self._c_requests.inc()
+        self._h_e2e.observe(latency)
         with self._lock:
-            self._n_queries += len(queries)
-            self._n_requests += 1
-            self._latency_sum += latency
             self._latencies.append(latency)
             if adaptive and not per_worker:
                 # anti-death-spiral: a zero-ANSWER gather under the
@@ -237,14 +281,18 @@ class Predictor:
                 # learned budget works again — explicit-timeout traffic
                 # answering must not starve the 3-miss flush
                 self._gather_misses = 0
+        self.traces.add_span(tid, "done", answered=len(per_worker),
+                             latency_s=round(latency, 4))
         info = {"workers_answered": len(per_worker),
                 "workers_asked": len(self.worker_ids),
-                "latency_s": latency, "errors": errors}
+                "latency_s": latency, "errors": errors,
+                "trace_id": tid}
         return ensemble_predictions(per_worker), info
 
     def predict_stream(self, queries: Sequence[Any],
                        timeout: Optional[float] = None,
-                       sampling: Optional[Dict] = None):
+                       sampling: Optional[Dict] = None,
+                       trace_id: Optional[str] = None):
         """Streaming generation: yield per-query text deltas as the
         decode loop produces them, then a final event.
 
@@ -269,12 +317,17 @@ class Predictor:
         t0 = time.monotonic()
         timeout = self.STREAM_TIMEOUT if timeout is None else timeout
         qid = uuid.uuid4().hex
+        tid = sanitize_trace_id(trace_id) or mint_trace_id()
         deadline = t0 + timeout
         with self._lock:
             wid = self.worker_ids[self._rr % len(self.worker_ids)]
             self._rr += 1
+        self.traces.start(tid, request_id=qid, span="received",
+                          n_queries=len(queries), stream=True,
+                          worker=wid)
         payload = {"id": qid, "queries": _stack(queries), "stream": True,
-                   "deadline_ts": time.time() + timeout}
+                   "deadline_ts": time.time() + timeout,
+                   "trace_id": tid}
         if sampling:
             payload["sampling"] = dict(sampling)
         # accumulated text per query index — the final predictions
@@ -310,6 +363,8 @@ class Predictor:
                 if "delta" in reply:
                     d = {int(k): str(v)
                          for k, v in dict(reply["delta"]).items()}
+                    if not acc:  # first streamed token(s) of the request
+                        self.traces.add_span(tid, "first_delta")
                     for k, v in d.items():
                         acc[k] = acc.get(k, "") + v
                     yield {"delta": {str(k): v for k, v in d.items()}}
@@ -335,11 +390,14 @@ class Predictor:
                 latency = time.monotonic() - t0
                 final = {"done": True, "predictions": preds,
                          "info": {"worker_id": reply.get("worker_id"),
-                                  "latency_s": latency}}
+                                  "latency_s": latency,
+                                  "trace_id": tid}}
+                self._c_queries.inc(len(queries))
+                self._c_requests.inc()
+                self._h_e2e.observe(latency)
+                self.traces.add_span(tid, "done",
+                                     latency_s=round(latency, 4))
                 with self._lock:
-                    self._n_queries += len(queries)
-                    self._n_requests += 1
-                    self._latency_sum += latency
                     self._latencies.append(latency)
                 break
         except Exception as e:  # noqa: BLE001 — the SSE response is
@@ -359,9 +417,9 @@ class Predictor:
         (the BASELINE p50 metric; surfaced in ``GET /health``)."""
         with self._lock:
             lat = sorted(self._latencies)
-            n_req = self._n_requests
-            n_q = self._n_queries
-            lat_sum = self._latency_sum
+        n_req = int(self._c_requests.value)
+        n_q = int(self._c_queries.value)
+        lat_sum = self._h_e2e.sum
 
         def pct(p: float) -> float:
             return nearest_rank(lat, p)
@@ -373,11 +431,18 @@ class Predictor:
             except Exception:  # rafiki: noqa[silent-except] —
                 s = None       # health must not 500 on a hub hiccup
             if s is not None:
-                workers[wid] = s
+                workers[wid] = self._annotate_staleness(wid, s)
         return {"queries_served": n_q, "requests_served": n_req,
                 "latency_sum_s": lat_sum, "latency_window_n": len(lat),
                 "latency_p50_s": pct(0.50), "latency_p95_s": pct(0.95),
                 "latency_p99_s": pct(0.99),
+                # the same distribution from the FIXED-BUCKET histogram
+                # (what /metrics exposes): coarser than the window
+                # percentiles but covers the whole process lifetime —
+                # the dashboard's e2e p50/p95 source
+                "e2e_hist_p50_s": self._h_e2e.quantile(0.50),
+                "e2e_hist_p95_s": self._h_e2e.quantile(0.95),
+                "e2e_hist_count": self._h_e2e.count,
                 # the latency/accuracy controller's live budget (equals
                 # gather_timeout when adaptive gathering is off/warming)
                 "gather_deadline_s": self._gather_deadline_s(),
@@ -386,6 +451,41 @@ class Predictor:
                 # engine stats): a worker silently dropping expired
                 # queries shows up HERE, not as mystery timeouts
                 "workers": workers}
+
+    def _annotate_staleness(self, wid: str, s: Dict[str, Any]
+                            ) -> Dict[str, Any]:
+        """Stamp ``stale`` onto a worker's published stats.
+
+        Clock-step safe: the worker publishes a MONOTONIC ``uptime_s``
+        and its own ``stale_after_s`` budget; this side tracks when the
+        uptime last ADVANCED on its own monotonic clock. A worker whose
+        uptime hasn't moved for longer than its budget is stale (dead,
+        hung, or partitioned) — wall-clock ``published_at`` is kept in
+        the payload for humans but no longer gates anything. Workers
+        predating ``uptime_s`` fall back to the wall-clock test."""
+        s = dict(s)
+        now = time.monotonic()
+        up = s.get("uptime_s")
+        budget = float(s.get("stale_after_s") or 60.0)
+        if isinstance(up, (int, float)) and not isinstance(up, bool):
+            with self._lock:
+                last = self._worker_seen.get(wid)
+                # any CHANGE refreshes the watermark: an advance is a
+                # live publisher, and a DECREASE is a respawned worker
+                # whose uptime restarted near 0 — without the `!=` a
+                # healthy replacement would read stale until it outlived
+                # its dead predecessor's uptime
+                if last is None or up != last[0]:
+                    self._worker_seen[wid] = (float(up), now)
+                    s["stale"] = False
+                else:
+                    s["stale"] = (now - last[1]) > budget
+        else:
+            pub = s.get("published_at")
+            s["stale"] = bool(
+                isinstance(pub, (int, float))
+                and time.time() - float(pub) > budget)
+        return s
 
 
 def _stack(queries: Sequence[Any]) -> Any:
@@ -416,10 +516,22 @@ class PredictorService:
     def __init__(self, predictor: Predictor, host: str = "127.0.0.1",
                  port: int = 0) -> None:
         self.predictor = predictor
-        self.http = JsonHttpService(host, port)
+        self.http = JsonHttpService(host, port,
+                                    registry=predictor.metrics)
         self.http.route("POST", "/predict", self._predict)
         self.http.route("POST", "/predict_stream", self._predict_stream)
         self.http.route("GET", "/health", self._health)
+        # GET /metrics (Prometheus text) + GET /debug/requests?n=K
+        mount_obs_routes(self.http, predictor.metrics, predictor.traces)
+
+    @staticmethod
+    def _trace_header(headers) -> Optional[str]:
+        """The inbound ``X-Rafiki-Trace-Id``, case-insensitively (the
+        stdlib handler hands headers through as sent)."""
+        for k, v in (headers or {}).items():
+            if k.lower() == "x-rafiki-trace-id":
+                return v
+        return None
 
     def start(self) -> Tuple[str, int]:
         return self.http.start()
@@ -455,7 +567,7 @@ class PredictorService:
                 f"timeout must be <= {MAX_REQUEST_TIMEOUT_S:.0f}s")
         return True, t
 
-    def _predict(self, _m, body, _h) -> Tuple[int, Any]:
+    def _predict(self, _m, body, headers) -> Tuple[int, Any]:
         queries = (body or {}).get("queries")
         if not isinstance(queries, list) or not queries:
             return 400, {"error": "body must be {queries: [...]}"}
@@ -465,13 +577,14 @@ class PredictorService:
         sampling = (body or {}).get("sampling")
         preds, info = self.predictor.predict(
             queries, timeout=timeout,
-            sampling=sampling if isinstance(sampling, dict) else None)
+            sampling=sampling if isinstance(sampling, dict) else None,
+            trace_id=self._trace_header(headers))
         if info["workers_answered"] == 0:
             return 504, {"error": "no worker answered in time",
                          "info": info}
         return 200, {"predictions": preds, "info": info}
 
-    def _predict_stream(self, _m, body, _h) -> Tuple[int, Any]:
+    def _predict_stream(self, _m, body, headers) -> Tuple[int, Any]:
         """SSE: one ``data: <json>\\n\\n`` event per generator yield
         (token deltas, then the final done/error event)."""
         queries = (body or {}).get("queries")
@@ -483,7 +596,8 @@ class PredictorService:
         sampling = (body or {}).get("sampling")
         events = self.predictor.predict_stream(
             queries, timeout=timeout,
-            sampling=sampling if isinstance(sampling, dict) else None)
+            sampling=sampling if isinstance(sampling, dict) else None,
+            trace_id=self._trace_header(headers))
 
         def sse():
             import json as _json

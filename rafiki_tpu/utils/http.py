@@ -48,14 +48,33 @@ def _compile(pattern: str) -> re.Pattern:
 
 
 class JsonHttpService:
-    """A threading HTTP server over a JSON route table."""
+    """A threading HTTP server over a JSON route table.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+    Handlers receive the path-pattern groups MERGED with URL query
+    parameters (path segments win on a name clash), the parsed JSON
+    body, and the request headers.
+
+    ``registry`` (a duck-typed ``rafiki_tpu.obs.MetricsRegistry``)
+    auto-instruments every surface that passes one: a
+    ``http_requests_total`` counter and an ``http_request_seconds``
+    handler-latency histogram — the time INSIDE the handler, so a
+    long-lived SSE stream does not read as one enormous request.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 registry: Any = None) -> None:
         self._routes: List[Tuple[str, re.Pattern, Handler]] = []
         self._host = host
         self._port = port
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._req_counter = None
+        self._req_hist = None
+        if registry is not None:
+            self._req_counter = registry.counter(
+                "http_requests_total", "HTTP requests served")
+            self._req_hist = registry.histogram(
+                "http_request_seconds", "handler latency (seconds)")
 
     def route(self, method: str, pattern: str, handler: Handler) -> None:
         self._routes.append((method.upper(), _compile(pattern), handler))
@@ -63,6 +82,7 @@ class JsonHttpService:
     # ---- lifecycle ----
     def start(self) -> Tuple[str, int]:
         routes = self._routes
+        req_counter, req_hist = self._req_counter, self._req_hist
 
         class _Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -78,15 +98,26 @@ class JsonHttpService:
                 except Exception:
                     self._reply(400, {"error": "malformed JSON body"})
                     return
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 for m, pat, handler in routes:
                     if m != method:
                         continue
                     match = pat.match(path)
                     if match:
+                        params = match.groupdict()
+                        if query:
+                            from urllib.parse import parse_qsl
+
+                            for k, v in parse_qsl(query):
+                                # path segments win: a ?id=... must not
+                                # shadow a /things/<id> capture
+                                params.setdefault(k, v)
+                        import time as _time
+
+                        t0 = _time.monotonic()
                         try:
                             status, payload = handler(
-                                match.groupdict(), body,
+                                params, body,
                                 dict(self.headers.items()))
                         except _HttpError as e:
                             status, payload = e.status, {"error": e.message}
@@ -95,6 +126,9 @@ class JsonHttpService:
                             payload = {"error": "internal error",
                                        "detail": traceback.format_exc(
                                            limit=5)}
+                        if req_counter is not None:
+                            req_counter.inc()
+                            req_hist.observe(_time.monotonic() - t0)
                         self._reply(status, payload)
                         return
                 self._reply(404, {"error": f"no route {method} {path}"})

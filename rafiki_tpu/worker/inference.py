@@ -26,12 +26,15 @@ TPU-first deltas:
 from __future__ import annotations
 
 import threading
+import time
 import traceback
-from typing import Any, List, Optional, Type
+from typing import Any, Dict, List, Optional, Tuple, Type
 
 import numpy as np
 
 from ..model.base import BaseModel
+from ..obs import (MetricsRegistry, ObsServer, StatsMap, TraceBuffer,
+                   mint_trace_id)
 from ..serving.queues import (EXPIRY_SKEW_TOLERANCE_S, QueueHub,
                               pack_message, unpack_message)
 from ..store.param_store import ParamStore
@@ -55,7 +58,36 @@ class InferenceWorker:
         #: gather timeouts from the predictor side, so the worker keeps
         #: its own count (and logs) — the first diagnostic to check when
         #: "the predictor only sees timeouts" (clock skew, ADVICE r3)
-        self.stats = {"dropped_expired": 0}
+        self.stats = StatsMap({"dropped_expired": 0})
+        #: the obs plane: registry scraped at GET /metrics (serve_obs
+        #: sidecar), trace ring at GET /debug/requests, and the request-
+        #: lifecycle histograms the engine's span hook feeds
+        self.metrics = MetricsRegistry()
+        self.metrics.register_stats(self.stats)
+        self.traces = TraceBuffer(512)
+        self._boot_mono = time.monotonic()
+        self._h_ttft = self.metrics.histogram(
+            "ttft_seconds", "queued -> first generated token (seconds)")
+        self._h_queue = self.metrics.histogram(
+            "time_in_queue_seconds",
+            "queued -> decode-slot admission (seconds)")
+        self._h_e2e = self.metrics.histogram(
+            "request_seconds",
+            "queued -> request fully answered (seconds)")
+        self._h_occupancy = self.metrics.histogram(
+            "batch_occupancy", "live decode slots per engine step",
+            buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128))
+        self._h_tps = self.metrics.histogram(
+            "decode_tokens_per_s",
+            "per-request generated-token throughput",
+            buckets=(1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000,
+                     5000))
+        #: engine request id -> (trace_id, queued monotonic). Touched
+        #: only by the serve-loop thread (submits, step, span hook all
+        #: run there), so no lock
+        self._req_obs: Dict[Any, Tuple[str, float]] = {}
+        self._obs_server: Optional[ObsServer] = None
+        self._obs_port = 0
         self._stop = threading.Event()
         self.model = model_class(**knobs)
         params = param_store.load(trial_id)
@@ -173,6 +205,18 @@ class InferenceWorker:
                     "%s has no make_decode_engine; serving through the "
                     "predict() micro-batcher instead of the continuous-"
                     "batching decode loop", model_class.__name__)
+        if self.engine is not None:
+            # engine counters surface on /metrics under their BARE
+            # names (kv_pages_used, admission_stalls, …) — the hub
+            # publish below keeps the engine_ prefix for back-compat
+            st = self.engine.stats
+            if hasattr(st, "snapshot"):
+                self.metrics.register_stats(st)
+            else:  # duck-typed user engine with a plain dict
+                self.metrics.register_stats(lambda: dict(st))
+            if hasattr(self.engine, "span_sink"):
+                # request-lifecycle events -> trace spans + histograms
+                self.engine.span_sink = self._engine_span
         self._warmup()
 
     def _admission_check(self, max_slots: int, n_extra_adapters: int,
@@ -247,8 +291,8 @@ class InferenceWorker:
                 if hasattr(self.engine, "reset_stats"):
                     self.engine.reset_stats()
                 else:
-                    for k in self.engine.stats:
-                        self.engine.stats[k] = 0
+                    st = self.engine.stats
+                    st.update({k: 0 for k in list(st)})
             else:
                 self.model.warmup()
         except Exception:  # noqa: BLE001 — slower first request, not a
@@ -264,33 +308,99 @@ class InferenceWorker:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._obs_server is not None:
+            self._obs_server.stop()
+            self._obs_server = None
+
+    def serve_obs(self, host: str = "127.0.0.1",
+                  port: int = 0) -> Tuple[str, int]:
+        """Start the observability sidecar (``GET /metrics`` Prometheus
+        text, ``GET /debug/requests?n=K`` trace records) on a daemon
+        thread; returns its (host, port). The serve loop never touches
+        it — scrapes read the same locked registry the loop writes."""
+        self._obs_server = ObsServer(self.metrics, self.traces,
+                                     host=host, port=port)
+        host, port = self._obs_server.start()
+        self._obs_port = port
+        return host, port
 
     #: loop iterations between stats publications to the hub
     STATS_EVERY = 50
+    #: how long published counters stay trustworthy: the loop publishes
+    #: at least every STATS_EVERY x poll_timeout seconds (~25s at the
+    #: defaults), so an uptime_s that has not advanced for this long
+    #: means a dead/hung/partitioned worker, not a slow one
+    STALE_AFTER_S = 60.0
 
     def _publish_stats(self) -> None:
         """Push this worker's counters to the hub so the predictor's
         /health can surface them (silent expiry drops are otherwise
-        indistinguishable from gather timeouts on the predictor side)."""
-        import time
+        indistinguishable from gather timeouts on the predictor side).
 
-        stats = dict(self.stats)
-        stats["published_at"] = time.time()  # staleness signal for ops
+        Snapshots are taken through the obs StatsMaps' own locks — the
+        only race-free read while the engine thread mutates (iterating
+        the live dict here used to be able to blow up with "dictionary
+        changed size during iteration" under load)."""
+        stats = self.stats.snapshot()
+        stats["published_at"] = time.time()  # for humans; staleness
+        # rides the MONOTONIC pair below — a wall-clock step (NTP, VM
+        # migration) must neither grey out a healthy worker nor let a
+        # dead one's counters pose as current
+        stats["uptime_s"] = time.monotonic() - self._boot_mono
+        stats["stale_after_s"] = self.STALE_AFTER_S
+        if self._obs_port:
+            stats["obs_port"] = self._obs_port  # where /metrics lives
         if self.engine is not None:
-            stats.update({f"engine_{k}": v
-                          for k, v in self.engine.stats.items()})
+            snap = (self.engine.stats_snapshot()
+                    if hasattr(self.engine, "stats_snapshot")
+                    else dict(self.engine.stats))
+            stats.update({f"engine_{k}": v for k, v in snap.items()})
+            # bucket-derived latency summaries (dashboard TTFT/e2e)
+            stats["ttft_p50_s"] = self._h_ttft.quantile(0.50)
+            stats["ttft_p95_s"] = self._h_ttft.quantile(0.95)
+            stats["e2e_p50_s"] = self._h_e2e.quantile(0.50)
+            stats["e2e_p95_s"] = self._h_e2e.quantile(0.95)
         try:
             self.hub.put_worker_stats(self.worker_id, stats)
         except Exception:  # rafiki: noqa[silent-except] —
             pass           # observability must never kill the loop
+
+    def _engine_span(self, event: str, rid: Any, attrs: dict) -> None:
+        """Decode-engine lifecycle hook: admitted / prefill /
+        first_token / decode_mark / done events become trace spans, and
+        the queued→X durations feed the latency histograms. Runs on the
+        serve-loop thread (the engine's step caller), so the rid→trace
+        map needs no lock; unknown rids (the warmup dummy) are
+        ignored."""
+        entry = self._req_obs.get(rid)
+        if entry is None:
+            return
+        tid, t_queued = entry
+        now = time.monotonic()
+        if event == "admitted":
+            self._h_queue.observe(now - t_queued)
+            self.traces.add_span(tid, "admitted", worker=self.worker_id,
+                                 **attrs)
+        elif event == "first_token":
+            self._h_ttft.observe(now - t_queued)
+            self.traces.add_span(tid, "first_token")
+        elif event == "done":
+            dt = now - t_queued
+            self._h_e2e.observe(dt)
+            tokens = attrs.get("tokens") or 0
+            if tokens and dt > 0:
+                self._h_tps.observe(tokens / dt)
+            self.traces.add_span(tid, "done", **attrs)
+            self._req_obs.pop(rid, None)
+        else:
+            self.traces.add_span(tid, event, **attrs)
 
     def _count_dropped(self, n: int) -> None:
         if n <= 0:
             return
         import logging
 
-        total = self.stats["dropped_expired"] = \
-            self.stats["dropped_expired"] + n
+        total = self.stats.inc("dropped_expired", n)
         # log the first drop and then every 100th: one line is enough to
         # diagnose skew, a line per query would flood under overload
         if total == n or total % 100 < n:
@@ -352,6 +462,12 @@ class InferenceWorker:
                 m = unpack_message(raw)
                 if _expired(m):
                     self._count_dropped(1)
+                    tid = str(m.get("trace_id") or "")
+                    if tid:  # the drop is visible in the trace, not
+                        # just a counter — joins the predictor's record
+                        self.traces.start(
+                            tid, request_id=str(m.get("id") or ""),
+                            span="expired", worker=self.worker_id)
                     raw = self.hub.pop_query(self.worker_id, 0.0)
                     continue
                 qs = m["queries"]
@@ -362,6 +478,12 @@ class InferenceWorker:
                         {"id": m["id"], "worker_id": self.worker_id,
                          "predictions": []}))
                 else:
+                    tid = str(m.get("trace_id") or "") or mint_trace_id()
+                    t_queued = time.monotonic()
+                    self.traces.start(tid, request_id=str(m["id"]),
+                                      span="queued",
+                                      worker=self.worker_id,
+                                      n_queries=len(qs))
                     samp = _safe_sampling(m.get("sampling"))
                     if "max_new" in samp:
                         # per-request generation length, clamped by the
@@ -376,6 +498,7 @@ class InferenceWorker:
                                     samp["max_new"]))
                     try:
                         for qi, text in enumerate(qs):
+                            self._req_obs[(m["id"], qi)] = (tid, t_queued)
                             self.engine.submit((m["id"], qi), str(text),
                                                **samp)
                     except ValueError as e:
@@ -383,6 +506,10 @@ class InferenceWorker:
                         # adapter engine: reject the whole message —
                         # serving a different fine-tune than requested
                         # would be a correct-looking wrong answer
+                        for qi in range(len(qs)):
+                            self._req_obs.pop((m["id"], qi), None)
+                        self.traces.add_span(tid, "rejected",
+                                             error=str(e))
                         self.hub.push_prediction(m["id"], pack_message(
                             {"id": m["id"],
                              "worker_id": self.worker_id,
@@ -395,7 +522,8 @@ class InferenceWorker:
             if not self.engine.busy:
                 continue
             try:
-                self.engine.step()
+                n_live = self.engine.step()
+                self._h_occupancy.observe(n_live)
             except Exception:
                 err = traceback.format_exc()
                 for mid in list(inflight):
@@ -404,6 +532,12 @@ class InferenceWorker:
                          "predictions": [], "error": err}))
                     del inflight[mid]
                 streaming.clear()
+                # every in-flight request's timeline ends HERE, not in
+                # silence: the reset below preempts all occupants
+                for _rid, (tid, _t) in list(self._req_obs.items()):
+                    self.traces.add_span(tid, "preempted",
+                                         error="engine step failed")
+                self._req_obs.clear()
                 # a failed step may have consumed the donated cache:
                 # drop every occupant and rebuild device state, or the
                 # loop hot-spins on a permanently broken engine
@@ -437,6 +571,7 @@ class InferenceWorker:
 
     def _serve_batch(self, messages: List[dict]) -> None:
         # flatten all messages' queries into one forward pass
+        t0 = time.monotonic()
         counts = []
         flat: List[Any] = []
         for m in messages:
@@ -444,6 +579,12 @@ class InferenceWorker:
             qs = list(qs) if not isinstance(qs, (list, tuple)) else qs
             counts.append(len(qs))
             flat.extend(qs)
+            tid = str(m.get("trace_id") or "")
+            if tid:  # join the predictor's trace (micro-batch path has
+                # no slot lifecycle — one queued + one served span)
+                self.traces.start(tid, request_id=str(m.get("id") or ""),
+                                  span="queued", worker=self.worker_id,
+                                  n_queries=len(qs))
         try:
             preds = self.model.predict(flat)
             err = ""
@@ -452,6 +593,7 @@ class InferenceWorker:
             err = traceback.format_exc()
         # split results back per message and reply on per-query-id queues
         ofs = 0
+        dt = time.monotonic() - t0
         for m, c in zip(messages, counts):
             chunk = preds[ofs:ofs + c] if not err else []
             ofs += c
@@ -460,6 +602,12 @@ class InferenceWorker:
             if err:
                 reply["error"] = err
             self.hub.push_prediction(m["id"], pack_message(reply))
+            self._h_e2e.observe(dt)
+            tid = str(m.get("trace_id") or "")
+            if tid:
+                self.traces.add_span(
+                    tid, "error" if err else "served",
+                    latency_s=round(dt, 4))
 
 
 def _require_dict_or_none(value: Any, name: str) -> Optional[dict]:
@@ -588,7 +736,15 @@ def main(argv: Optional[list] = None) -> int:
                                           "draft_knobs"),
         kv_page_size=int(cfg.get("kv_page_size", 0)),
         kv_pages=int(cfg.get("kv_pages", 0)))
-    print(f"inference worker {worker.worker_id} serving", flush=True)
+    # observability sidecar: /metrics + /debug/requests on an ephemeral
+    # (or configured) port, written to obs_port_file for the operator
+    obs_host, obs_port = worker.serve_obs(
+        cfg.get("obs_host", "127.0.0.1"), int(cfg.get("obs_port", 0)))
+    if cfg.get("obs_port_file"):
+        with open(cfg["obs_port_file"], "w") as f:
+            f.write(str(obs_port))
+    print(f"inference worker {worker.worker_id} serving "
+          f"(obs on {obs_host}:{obs_port})", flush=True)
     worker.run()
     return 0
 

@@ -17,11 +17,15 @@ TPU-first deltas:
 
 from __future__ import annotations
 
+import math
+import time
 import traceback
-from typing import Any, List, Optional, Type
+from typing import Any, Dict, List, Optional, Tuple, Type
 
 from ..model.base import BaseModel, TrainContext
 from ..model.log import ModelLogger
+from ..obs import (MetricsRegistry, ObsServer, TraceBuffer,
+                   mint_trace_id)
 from ..store.param_store import ParamStore
 
 #: substrings marking infra-class failures in exception text. The gRPC/XLA
@@ -95,6 +99,44 @@ class TrainWorker:
         #: still lets a restarted worker reclaim its pre-restart orphan)
         self._own_trial_ids: set = set()
         self.trials_run = 0
+        #: obs plane: per-trial wall/epoch timing + throughput so the
+        #: advisor's trials become comparable on MORE than loss — the
+        #: same registry/trace surfaces (/metrics, /debug/requests via
+        #: serve_obs) every other service exposes
+        self.metrics = MetricsRegistry()
+        self.traces = TraceBuffer(256)
+        self._h_trial = self.metrics.histogram(
+            "trial_seconds", "trial wall time, train+eval (seconds)",
+            buckets=(1, 2, 5, 10, 30, 60, 120, 300, 600, 1800, 3600,
+                     7200, 14400))
+        self._h_epoch = self.metrics.histogram(
+            "epoch_seconds", "gap between epoch metric records",
+            buckets=(0.1, 0.25, 0.5, 1, 2, 5, 10, 30, 60, 120, 300,
+                     600, 1800))
+        self._c_completed = self.metrics.counter(
+            "trials_completed", "trials that finished with a score")
+        self._c_errored = self.metrics.counter(
+            "trials_errored", "trials that raised")
+        self._g_tps = self.metrics.gauge(
+            "last_trial_tokens_per_s",
+            "token throughput of the last completed trial (LM only)")
+        self._g_mfu = self.metrics.gauge(
+            "last_trial_est_mfu",
+            "estimated model-FLOPs utilization of the last trial")
+        self._obs_server: Optional[ObsServer] = None
+
+    def serve_obs(self, host: str = "127.0.0.1",
+                  port: int = 0) -> Tuple[str, int]:
+        """Start the observability sidecar (``GET /metrics``,
+        ``GET /debug/requests`` — trial timelines) on a daemon thread."""
+        self._obs_server = ObsServer(self.metrics, self.traces,
+                                     host=host, port=port)
+        return self._obs_server.start()
+
+    def stop_obs(self) -> None:
+        if self._obs_server is not None:
+            self._obs_server.stop()
+            self._obs_server = None
 
     # ---- one trial ----
     def run_trial(self, proposal) -> Optional[float]:
@@ -129,9 +171,22 @@ class TrainWorker:
         self._own_trial_ids.add(trial_id)
 
         logger = ModelLogger()
-        if self.meta_store is not None:
-            logger.sink = lambda rec: self.meta_store.add_trial_log(
-                trial_id, rec.kind, rec.data, rec.time)
+        obs_acc: Dict[str, Any] = {"tokens": 0, "epochs": 0,
+                                   "last_t": None}
+
+        def _sink(rec) -> None:
+            # obs first (epoch timing / token accounting), then the
+            # MetaStore forward the dashboard reads
+            self._observe_log_record(rec, obs_acc)
+            if self.meta_store is not None:
+                self.meta_store.add_trial_log(trial_id, rec.kind,
+                                              rec.data, rec.time)
+
+        logger.sink = _sink
+        t_start = time.monotonic()
+        trace_id = self.traces.start(
+            mint_trace_id(), request_id=trial_id, span="trial_start",
+            trial_no=proposal.trial_no, worker=self.worker_id)
 
         # heartbeat covers the trial row's ENTIRE time in RUNNING state —
         # including the final (possibly multi-GB) parameter save — so a
@@ -180,7 +235,10 @@ class TrainWorker:
                     model.train(self.train_dataset_path, ctx)
                 score = float(model.evaluate(self.val_dataset_path))
 
-                self.param_store.save(trial_id, model.dump_parameters())
+                blob = model.dump_parameters()
+                self._record_trial_obs(logger, trace_id, t_start,
+                                       obs_acc, blob, score)
+                self.param_store.save(trial_id, blob)
                 model.destroy()
                 fenced_out = False
                 if self.meta_store is not None:
@@ -215,6 +273,10 @@ class TrainWorker:
                 self.trials_run += 1
                 return score
             except Exception as e:  # trial fault isolation (SURVEY §5.3)
+                self._c_errored.inc()
+                self.traces.add_span(trace_id, "trial_errored",
+                                     error=f"{type(e).__name__}: {e}"[:200],
+                                     error_class=classify_trial_error(e))
                 fenced_out = False
                 if self.meta_store is not None:
                     fenced_out = not self.meta_store.mark_trial_errored(
@@ -231,6 +293,69 @@ class TrainWorker:
                 return None
         finally:
             hb_stop()
+
+    def _observe_log_record(self, rec, obs_acc: Dict[str, Any]) -> None:
+        """Watch the trial's metric stream: every ``values`` record
+        carrying a loss marks an epoch boundary — the inter-record gap
+        is the live step-time signal — and templates that report a
+        per-epoch ``tokens`` count (the LM loop does) accumulate it for
+        throughput/MFU at trial end."""
+        if rec.kind != "values" or "loss" not in rec.data:
+            return
+        now = time.monotonic()
+        if obs_acc["last_t"] is not None:
+            self._h_epoch.observe(now - obs_acc["last_t"])
+        obs_acc["last_t"] = now
+        obs_acc["epochs"] += 1
+        tokens = rec.data.get("tokens")
+        if isinstance(tokens, (int, float)) and tokens > 0:
+            obs_acc["tokens"] += int(tokens)
+
+    def _record_trial_obs(self, logger: ModelLogger, trace_id: str,
+                          t_start: float, obs_acc: Dict[str, Any],
+                          blob: Any, score: float) -> None:
+        """Per-trial throughput record: wall seconds always; tokens/s
+        and estimated MFU when the template reported per-epoch token
+        counts (MFU ≈ 6·N·tokens/s over the device peak — the standard
+        dense-LM approximation; an ESTIMATE, labeled as such). Logged
+        through the trial's own logger so it lands in the MetaStore
+        next to the loss curve — the advisor's trials become comparable
+        on throughput, not just loss."""
+        dt = time.monotonic() - t_start
+        self._h_trial.observe(dt)
+        self._c_completed.inc()
+        vals: Dict[str, Any] = {"trial_seconds": round(dt, 3),
+                                "epochs_logged": obs_acc["epochs"]}
+        if obs_acc["tokens"] and dt > 0:
+            tps = obs_acc["tokens"] / dt
+            vals["tokens_per_s"] = round(tps, 1)
+            self._g_tps.set(tps)
+            n_params = _count_blob_params(blob)
+            # tokens/s is FLEET-wide (the trial shards over this
+            # worker's whole sub-mesh), so the denominator is the
+            # sub-mesh's aggregate peak, not one chip's
+            devs = self.devices
+            if devs is None:
+                try:
+                    import jax
+
+                    devs = jax.local_devices()
+                except (ImportError, RuntimeError):
+                    devs = None
+            peak = _device_peak_flops(devs) * max(1, len(devs or ()))
+            if n_params and peak:
+                mfu = 6.0 * n_params * tps / peak
+                vals["est_mfu"] = round(mfu, 5)
+                self._g_mfu.set(mfu)
+        try:
+            logger.log(**vals)
+        except Exception:  # noqa: BLE001 — a meta-store hiccup on the
+            import logging  # throughput record must not void the trial
+
+            logging.getLogger(__name__).warning(
+                "trial throughput record failed", exc_info=True)
+        self.traces.add_span(trace_id, "trial_done",
+                             score=round(score, 6), **vals)
 
     def _admission_check(self, model) -> None:
         """Refuse a trial whose ESTIMATED per-device train footprint
@@ -485,6 +610,57 @@ class TrainWorker:
         return n
 
 
+def _count_blob_params(blob: Any) -> int:
+    """Leaf-element count of a dumped parameter tree (numpy arrays in
+    nested dicts/lists) — no jax import needed."""
+    if hasattr(blob, "shape"):
+        try:
+            return int(math.prod(blob.shape))
+        except (TypeError, ValueError):
+            return 0
+    if isinstance(blob, dict):
+        return sum(_count_blob_params(v) for v in blob.values())
+    if isinstance(blob, (list, tuple)):
+        return sum(_count_blob_params(v) for v in blob)
+    return 0
+
+
+#: bf16 peak FLOP/s per chip by device_kind substring (first match
+#: wins, so the more specific names come first). Used only for the
+#: est_mfu label — an estimate feeding trial comparisons, not billing.
+_PEAK_FLOPS_BF16 = (
+    ("v6", 918e12), ("v5p", 459e12), ("v5", 197e12),
+    ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+)
+
+
+def _device_peak_flops(devices: Optional[List[Any]] = None) -> float:
+    """Per-device peak FLOP/s: the ``RAFIKI_DEVICE_PEAK_FLOPS`` env
+    override wins (how CPU runs get a nonzero MFU denominator in
+    tests), else a device_kind lookup; unknown hardware → 0, which
+    suppresses the MFU estimate rather than fabricating one."""
+    import os
+
+    env = os.environ.get("RAFIKI_DEVICE_PEAK_FLOPS", "")
+    if env:
+        try:
+            return max(0.0, float(env))
+        except ValueError:
+            return 0.0
+    try:
+        if devices is None:
+            import jax
+
+            devices = jax.local_devices()
+        kind = str(getattr(devices[0], "device_kind", "") or "").lower()
+    except (ImportError, IndexError, RuntimeError):
+        return 0.0
+    for key, flops in _PEAK_FLOPS_BF16:
+        if key in kind:
+            return flops
+    return 0.0
+
+
 def main(argv: Optional[list] = None) -> int:
     """Service entrypoint: ``python -m rafiki_tpu.worker.train``.
 
@@ -531,7 +707,19 @@ def main(argv: Optional[list] = None) -> int:
         knob_overrides=cfg.get("knob_overrides"),
         checkpoint_interval_s=float(
             cfg.get("checkpoint_interval_s", 30.0)))
-    n = worker.run()
+    # observability sidecar: /metrics (trial/epoch timing, MFU gauges)
+    # + /debug/requests (per-trial timelines)
+    obs_host, obs_port = worker.serve_obs(
+        cfg.get("obs_host", "127.0.0.1"), int(cfg.get("obs_port", 0)))
+    if cfg.get("obs_port_file"):
+        with open(cfg["obs_port_file"], "w") as f:
+            f.write(str(obs_port))
+    print(f"train worker {worker.worker_id} obs on "
+          f"{obs_host}:{obs_port}", flush=True)
+    try:
+        n = worker.run()
+    finally:
+        worker.stop_obs()
     print(f"train worker {worker.worker_id} done: {n} trials", flush=True)
     return 0
 

@@ -1,0 +1,17 @@
+"""Positive fixture: ad-hoc stats-dict counter writes the obs registry
+never sees."""
+
+
+class Engine:
+    def __init__(self):
+        self.stats = {"steps": 0, "tokens": 0}  # dict literal: invisible
+
+    def step(self):
+        self.stats["steps"] += 1  # augmented subscript write
+
+    def finish(self, n):
+        self.stats["tokens"] = self.stats["tokens"] + n  # plain write
+
+
+def publish(worker):
+    worker.engine.stats["published"] = 1  # deep chains count too

@@ -1,0 +1,26 @@
+"""Negative fixture: registry-backed counters and plain reads are
+fine; so are local scratch dicts that are not a ``.stats`` surface."""
+
+from rafiki_tpu.obs import StatsMap
+
+
+class Engine:
+    def __init__(self):
+        self.stats = StatsMap({"steps": 0, "tokens": 0})
+
+    def step(self):
+        self.stats.inc("steps")
+
+    def finish(self, n):
+        self.stats.inc("tokens", n)
+        self.stats.max_set("max_tokens", n)
+
+
+def read_side(engine):
+    # reads keep dict ergonomics — only writes are policed
+    snapshot = dict(engine.stats)
+    total = engine.stats["tokens"]
+    # a local scratch dict is not a metrics surface
+    stats = {}
+    stats["anything"] = total
+    return snapshot, stats

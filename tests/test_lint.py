@@ -39,6 +39,7 @@ RULE_FIXTURES = {
     "thread-unlocked-global": "thread_unlocked_global",
     "silent-except": "silent_except",
     "library-internals": "library_internals",
+    "obs-unregistered-metric": "obs_unregistered_metric",
 }
 
 

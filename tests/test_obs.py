@@ -1,0 +1,440 @@
+"""The observability plane (rafiki_tpu/obs): histogram bucket math,
+Prometheus text exposition, trace-ID propagation predictor→worker,
+ring-buffer bounds under churn, /metrics on every service surface, and
+stale-worker detection.
+
+The pure-core tests run in milliseconds; the end-to-end legs ride the
+session ``trained``/``trained_lm`` LM fixture like the rest of the
+serving suite.
+"""
+
+import re
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from rafiki_tpu.obs import (Counter, Histogram, MetricsRegistry,
+                            StatsMap, TraceBuffer, mint_trace_id,
+                            sanitize_trace_id)
+
+# ---------------------------------------------------------------- core
+
+
+def test_histogram_bucket_math():
+    h = Histogram("lat_seconds", buckets=(0.1, 1.0, 10.0))
+    # boundary semantics are le (<=): an observation AT a bound lands
+    # in that bound's bucket, just past it in the next
+    h.observe(0.1)
+    h.observe(0.100001)
+    h.observe(5.0)
+    h.observe(99.0)   # +Inf bucket
+    assert h.count == 4
+    assert h.sum == pytest.approx(0.1 + 0.100001 + 5.0 + 99.0)
+    lines = h.expose()
+    by_le = {}
+    for ln in lines:
+        m = re.match(r'lat_seconds_bucket\{le="([^"]+)"\} (\d+)', ln)
+        if m:
+            by_le[m.group(1)] = int(m.group(2))
+    assert by_le["0.1"] == 1          # the exact-boundary observation
+    assert by_le["1.0"] == 2          # cumulative: +0.100001
+    assert by_le["10.0"] == 3         # +5.0
+    assert by_le["+Inf"] == 4         # everything, == _count
+    # cumulative counts are monotone
+    vals = [by_le[k] for k in ("0.1", "1.0", "10.0", "+Inf")]
+    assert vals == sorted(vals)
+    # sum/count invariant rides the exposition too
+    assert any(ln.startswith("lat_seconds_count 4") for ln in lines)
+    assert any(ln.startswith("lat_seconds_sum ") for ln in lines)
+
+
+def test_histogram_quantile_estimates():
+    h = Histogram("q", buckets=(1, 2, 4, 8))
+    for v in (0.5, 1.5, 1.5, 3.0, 7.0):
+        h.observe(v)
+    # p50: target rank 3 of 5 -> inside the (1, 2] bucket
+    assert 1.0 <= h.quantile(0.5) <= 2.0
+    # p99 -> the (4, 8] bucket
+    assert 4.0 <= h.quantile(0.99) <= 8.0
+    # monotone in p
+    qs = [h.quantile(p) for p in (0.1, 0.5, 0.9, 0.99)]
+    assert qs == sorted(qs)
+    # +Inf-bucket mass clamps to the last finite bound
+    h2 = Histogram("q2", buckets=(1,))
+    h2.observe(50.0)
+    assert h2.quantile(0.99) == 1.0
+    assert Histogram("q3", buckets=(1,)).quantile(0.5) == 0.0  # empty
+
+
+def test_prometheus_exposition_is_valid():
+    reg = MetricsRegistry()
+    reg.counter("req_total", "requests").inc(3)
+    reg.gauge("live_gauge", "live", fn=lambda: 7)
+    reg.histogram("h_seconds", buckets=(0.5, 5.0)).observe(0.1)
+    sm = StatsMap({"kv_pages_used": 2, "admission_stalls": 0})
+    reg.register_stats(sm)
+    text = reg.render_prometheus()
+    sample = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*"          # metric name
+        r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'   # optional label set
+        r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})? '
+        r"[-+0-9.eEInfa]+$")                   # value (incl. +Inf)
+    for ln in text.strip().splitlines():
+        assert ln.startswith("#") or sample.match(ln), ln
+    # the hand-rolled-dict replacement surfaces under its bare names
+    assert "kv_pages_used 2" in text
+    assert "# TYPE h_seconds histogram" in text
+    assert 'h_seconds_bucket{le="+Inf"} 1' in text
+    assert "live_gauge 7" in text
+    # flat snapshot view for hub publishing
+    snap = reg.snapshot()
+    assert snap["req_total"] == 3 and snap["kv_pages_used"] == 2
+    assert snap["h_seconds_count"] == 1
+
+
+def test_registry_type_conflicts_and_names():
+    reg = MetricsRegistry()
+    reg.counter("a_total")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("a_total")
+    with pytest.raises(ValueError, match="invalid metric name"):
+        reg.counter("bad name")
+
+
+def test_stats_map_snapshot_race_free():
+    """Concurrent inc + snapshot/iteration: the crash mode this class
+    exists to end is `dictionary changed size during iteration`."""
+    sm = StatsMap()
+    stop = threading.Event()
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            sm.inc(f"k{i % 50}")
+            i += 1
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        for _ in range(300):
+            dict(sm)          # iterates via locked snapshot
+            sm.snapshot()
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+def test_trace_ring_bounds_under_churn():
+    tb = TraceBuffer(maxlen=8)
+    for i in range(100):
+        tb.start(f"t{i}", request_id=str(i))
+    assert len(tb) == 8
+    recent = tb.recent(100)
+    assert [r["trace_id"] for r in recent] == \
+        [f"t{i}" for i in range(99, 91, -1)]
+    # live records still take spans; evicted ones recreate a fragment
+    tb.add_span("t99", "done", tokens=3)
+    assert [s["name"] for s in tb.get("t99")["spans"]] == \
+        ["queued", "done"]
+    tb.add_span("t0", "late")  # evicted long ago — fragment, not a loss
+    assert tb.get("t0")["spans"][0]["name"] == "late"
+    assert len(tb) == 8  # still bounded
+
+
+def test_trace_id_sanitization():
+    assert sanitize_trace_id("abc-123.X:y") == "abc-123.X:y"
+    assert sanitize_trace_id("  padded  ") == "padded"
+    assert sanitize_trace_id("bad id") == ""      # whitespace inside
+    assert sanitize_trace_id("x" * 200) == ""     # oversized
+    assert sanitize_trace_id(None) == ""
+    assert len(mint_trace_id()) == 32
+
+
+# ------------------------------------------------------- service surfaces
+
+
+def _get(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.headers.get("Content-Type", ""), resp.read().decode()
+
+
+def test_trace_propagation_predictor_to_worker(trained_lm):
+    """Acceptance leg: one request's trace ID, supplied via
+    X-Rafiki-Trace-Id, is followable across the predictor's AND the
+    worker's /debug/requests, with the request-lifecycle spans
+    (queued → admitted → first_token → done) on the worker side and
+    TTFT/e2e histograms fed on both /metrics surfaces."""
+    from test_decode_engine import KNOBS as LM_KNOBS
+
+    from rafiki_tpu.models.llama_lora import LlamaLoRA
+    from rafiki_tpu.serving.predictor import (Predictor,
+                                              PredictorService)
+    from rafiki_tpu.serving.queues import InProcQueueHub
+    from rafiki_tpu.store.param_store import ParamStore
+    from rafiki_tpu.utils.http import json_request
+    from rafiki_tpu.worker.inference import InferenceWorker
+
+    store = ParamStore.from_uri("mem://")
+    store.save("lm-obs", trained_lm.dump_parameters())
+    hub = InProcQueueHub()
+    worker = InferenceWorker(LlamaLoRA, "lm-obs", LM_KNOBS, store, hub,
+                             "w-obs", decode_loop=True, max_slots=4,
+                             max_new_tokens=4)
+    w_host, w_port = worker.serve_obs()
+    wt = threading.Thread(target=worker.run, daemon=True)
+    wt.start()
+    svc = PredictorService(Predictor(hub, ["w-obs"],
+                                     gather_timeout=120.0))
+    host, port = svc.start()
+    tid = "e2e-trace-0042"
+    try:
+        req = urllib.request.Request(
+            f"http://{host}:{port}/predict",
+            data=b'{"queries": ["tok1 tok2 tok3"]}',
+            headers={"Content-Type": "application/json",
+                     "X-Rafiki-Trace-Id": tid}, method="POST")
+        import json as _json
+
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            out = _json.loads(resp.read())
+        assert out["predictions"] and out["predictions"][0]
+        # the honored trace id comes back in info
+        assert out["info"]["trace_id"] == tid
+
+        # predictor side: received → scattered → reply → done
+        pred_dbg = json_request(
+            "GET", f"http://{host}:{port}/debug/requests?n=16")
+        rec_p = next(r for r in pred_dbg["requests"]
+                     if r["trace_id"] == tid)
+        names_p = [s["name"] for s in rec_p["spans"]]
+        assert names_p[0] == "received" and "done" in names_p
+        assert "reply" in names_p
+
+        # worker side, SAME trace id: the decode-loop lifecycle
+        wrk_dbg = json_request(
+            "GET", f"http://{w_host}:{w_port}/debug/requests?n=16")
+        rec_w = next(r for r in wrk_dbg["requests"]
+                     if r["trace_id"] == tid)
+        names_w = [s["name"] for s in rec_w["spans"]]
+        for expected in ("queued", "admitted", "first_token", "done"):
+            assert expected in names_w, (expected, names_w)
+        # span order: queued before admitted before first_token ≤ done
+        assert names_w.index("queued") < names_w.index("admitted") \
+            < names_w.index("first_token")
+
+        # both /metrics surfaces render valid text with the latency
+        # histograms the acceptance criteria name
+        ctype, pred_metrics = _get(f"http://{host}:{port}/metrics")
+        assert ctype.startswith("text/plain")
+        assert "request_seconds_bucket" in pred_metrics
+        assert "requests_served 1" in pred_metrics
+        _, wrk_metrics = _get(f"http://{w_host}:{w_port}/metrics")
+        assert "ttft_seconds_bucket" in wrk_metrics
+        assert "request_seconds_bucket" in wrk_metrics
+        # engine gauges keep their bare names on the worker surface
+        assert "tokens_generated" in wrk_metrics
+        assert re.search(r"^kv_pages_used \d", wrk_metrics, re.M)
+    finally:
+        svc.stop()
+        worker.stop()
+        wt.join(timeout=10)
+
+
+def test_worker_health_carries_ttft_and_uptime(trained_lm):
+    """The hub-published stats now carry the monotonic staleness pair
+    (uptime_s / stale_after_s) plus bucket-derived TTFT/e2e summaries —
+    what the dashboard's worker line renders."""
+    from test_decode_engine import KNOBS as LM_KNOBS
+
+    from rafiki_tpu.models.llama_lora import LlamaLoRA
+    from rafiki_tpu.serving.predictor import Predictor
+    from rafiki_tpu.serving.queues import InProcQueueHub
+    from rafiki_tpu.store.param_store import ParamStore
+    from rafiki_tpu.worker.inference import InferenceWorker
+
+    store = ParamStore.from_uri("mem://")
+    store.save("lm-h", trained_lm.dump_parameters())
+    hub = InProcQueueHub()
+    worker = InferenceWorker(LlamaLoRA, "lm-h", LM_KNOBS, store, hub,
+                             "w-h", decode_loop=True, max_slots=2,
+                             max_new_tokens=3)
+    wt = threading.Thread(target=worker.run, daemon=True)
+    wt.start()
+    try:
+        pred = Predictor(hub, ["w-h"], gather_timeout=120.0)
+        preds, info = pred.predict(["tok1 tok2"])
+        assert preds and preds[0]
+        worker._publish_stats()
+        s = pred.stats()["workers"]["w-h"]
+        assert s["uptime_s"] > 0 and s["stale_after_s"] > 0
+        assert s["stale"] is False
+        assert s["ttft_p50_s"] > 0 and s["e2e_p95_s"] > 0
+        assert s["engine_requests_done"] >= 1
+    finally:
+        worker.stop()
+        wt.join(timeout=10)
+
+
+def test_predictor_marks_stale_workers():
+    """Monotonic staleness: a worker whose published uptime_s stops
+    advancing past its stale_after_s budget greys out; a republish with
+    advanced uptime clears it. Wall-clock (published_at) never enters
+    the decision."""
+    from rafiki_tpu.serving.predictor import Predictor
+    from rafiki_tpu.serving.queues import InProcQueueHub
+
+    hub = InProcQueueHub()
+    pred = Predictor(hub, ["w0"], gather_timeout=1.0)
+    hub.put_worker_stats("w0", {"uptime_s": 5.0, "stale_after_s": 0.15,
+                                "published_at": 0.0})  # ancient wall ts
+    assert pred.stats()["workers"]["w0"]["stale"] is False  # fresh sight
+    time.sleep(0.25)  # uptime unchanged past the budget
+    assert pred.stats()["workers"]["w0"]["stale"] is True
+    hub.put_worker_stats("w0", {"uptime_s": 6.0, "stale_after_s": 0.15})
+    assert pred.stats()["workers"]["w0"]["stale"] is False  # advanced
+    time.sleep(0.25)
+    # a RESPAWNED worker restarts uptime near 0 — any uptime CHANGE
+    # refreshes the watermark, so the healthy replacement is never
+    # greyed out waiting to outlive its dead predecessor's uptime
+    hub.put_worker_stats("w0", {"uptime_s": 0.4, "stale_after_s": 0.15})
+    assert pred.stats()["workers"]["w0"]["stale"] is False
+    # legacy publisher (no uptime_s): wall-clock fallback
+    hub.put_worker_stats("w1", {"published_at": time.time() - 9999.0})
+    pred2 = Predictor(hub, ["w1"], gather_timeout=1.0)
+    assert pred2.stats()["workers"]["w1"]["stale"] is True
+
+
+def test_admin_metrics_surface(tmp_path):
+    """GET /metrics on the admin app: control-plane gauges evaluated
+    live + the HTTP self-instrumentation."""
+    from rafiki_tpu.admin.admin import Admin
+    from rafiki_tpu.admin.app import AdminApp
+    from rafiki_tpu.admin.services_manager import ServicesManager
+    from rafiki_tpu.parallel.mesh import DeviceSpec
+    from rafiki_tpu.store.meta_store import MetaStore
+
+    meta = MetaStore(str(tmp_path / "meta.db"))
+    manager = ServicesManager(meta, str(tmp_path), slot_size=1,
+                              platform="cpu",
+                              devices=[DeviceSpec(id=0)])
+    app = AdminApp(Admin(meta, manager))
+    host, port = app.start()
+    try:
+        ctype, text = _get(f"http://{host}:{port}/metrics")
+        assert ctype.startswith("text/plain")
+        assert "admin_services 0" in text
+        assert "admin_free_slots 1" in text
+        assert "admin_respawns_done 0" in text
+        # the scrape itself was counted (second scrape sees >= 1)
+        _, text2 = _get(f"http://{host}:{port}/metrics")
+        assert re.search(r"^http_requests_total [1-9]", text2, re.M)
+        # the admin's trace ring carries user-owned job metadata:
+        # unauthenticated pulls 401 (unlike the worker/predictor
+        # surfaces, which have no auth model by design)
+        from rafiki_tpu.utils.http import json_request
+
+        with pytest.raises(RuntimeError, match="401"):
+            json_request("GET",
+                         f"http://{host}:{port}/debug/requests")
+        token = json_request(
+            "POST", f"http://{host}:{port}/tokens",
+            {"email": "superadmin@rafiki",
+             "password": "rafiki"})["token"]
+        out = json_request(
+            "GET", f"http://{host}:{port}/debug/requests",
+            headers={"Authorization": f"Bearer {token}"})
+        assert out["requests"] == []
+    finally:
+        app.stop()
+
+
+def test_train_worker_metrics_and_trial_timeline(tmp_path, monkeypatch):
+    """The train worker's obs surface: trial_seconds histogram +
+    trials_completed counter on /metrics, a per-trial timeline in
+    /debug/requests, and throughput records (tokens_per_s + est_mfu
+    under a pinned peak-FLOPs denominator) in the trial logs."""
+    from rafiki_tpu.advisor import make_advisor
+    from rafiki_tpu.data import generate_text_classification_dataset
+    from rafiki_tpu.models.llama_lora import LlamaLoRA
+    from rafiki_tpu.utils.http import json_request
+    from rafiki_tpu.worker.train import TrainWorker
+    from test_decode_engine import KNOBS as LM_KNOBS
+
+    monkeypatch.setenv("RAFIKI_DEVICE_PEAK_FLOPS", "1e12")
+    tr = str(tmp_path / "tr.jsonl")
+    va = str(tmp_path / "va.jsonl")
+    generate_text_classification_dataset(tr, 48, seed=0)
+    generate_text_classification_dataset(va, 16, seed=1)
+    advisor = make_advisor(LlamaLoRA.get_knob_config(), "random",
+                           total_trials=1, seed=0)
+    # pin the searchable knobs to the tiny test scale; fixed knobs
+    # (max_epochs/vocab_size) keep their config values — overriding a
+    # FixedKnob is a validation error by design (quick_train caps the
+    # epochs anyway)
+    overrides = {k: v for k, v in LM_KNOBS.items()
+                 if k not in ("max_epochs", "vocab_size", "hidden_dim")}
+    overrides["hidden_dim"] = 64
+    worker = TrainWorker(LlamaLoRA, advisor, tr, va,
+                         knob_overrides=overrides,
+                         checkpoint_interval_s=0)
+    host, port = worker.serve_obs()
+    try:
+        assert worker.run(max_trials=1) == 1
+        ctype, text = _get(f"http://{host}:{port}/metrics")
+        assert ctype.startswith("text/plain")
+        assert "trials_completed 1" in text
+        assert "trial_seconds_bucket" in text
+        assert re.search(r"^last_trial_tokens_per_s [0-9.]*[1-9]",
+                         text, re.M)
+        assert re.search(r"^last_trial_est_mfu [0-9.e-]*[1-9]",
+                         text, re.M)
+        dbg = json_request("GET",
+                           f"http://{host}:{port}/debug/requests")
+        spans = [s["name"] for s in dbg["requests"][0]["spans"]]
+        assert spans[0] == "trial_start" and "trial_done" in spans
+        done = next(s for s in dbg["requests"][0]["spans"]
+                    if s["name"] == "trial_done")
+        assert done["tokens_per_s"] > 0 and done["est_mfu"] > 0
+    finally:
+        worker.stop_obs()
+
+
+def test_engine_span_events_direct(trained):
+    """The DecodeEngine's span hook fires the documented lifecycle on a
+    raw (token-level) engine, and a broken sink detaches instead of
+    killing the step loop."""
+    import numpy as np
+
+    from rafiki_tpu.serving.decode_engine import DecodeEngine
+
+    module, params = trained._module(), trained._params
+    eng = DecodeEngine(module, params, max_slots=2, max_len=32)
+    events = []
+    eng.span_sink = lambda ev, rid, attrs: events.append((ev, rid))
+    eng.submit("r1", np.asarray([1, 5, 9], np.int32), 3)
+    for _ in range(32):
+        if not eng.busy:
+            break
+        eng.step()
+    assert dict(eng.poll())["r1"]
+    names = [ev for ev, rid in events if rid == "r1"]
+    assert names[0] == "admitted"
+    assert "first_token" in names and names[-1] == "done"
+    assert names.index("admitted") < names.index("first_token")
+
+    def boom(ev, rid, attrs):
+        raise RuntimeError("sink broke")
+
+    eng.span_sink = boom
+    eng.submit("r2", np.asarray([1, 2], np.int32), 2)
+    for _ in range(32):
+        if not eng.busy:
+            break
+        eng.step()  # must not raise
+    assert dict(eng.poll())["r2"]
+    assert eng.span_sink is None  # detached after the first failure
+    # stats_snapshot is the locked read path
+    snap = eng.stats_snapshot()
+    assert snap["requests_done"] == 2

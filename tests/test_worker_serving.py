@@ -354,9 +354,11 @@ def test_expiry_skew_tolerance():
 
 
 def test_dropped_expired_counter():
+    from rafiki_tpu.obs import StatsMap
+
     w = InferenceWorker.__new__(InferenceWorker)  # no model boot needed
     w.worker_id = "w0"
-    w.stats = {"dropped_expired": 0}
+    w.stats = StatsMap({"dropped_expired": 0})
     w._count_dropped(3)
     w._count_dropped(0)
     assert w.stats["dropped_expired"] == 3
