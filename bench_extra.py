@@ -701,6 +701,128 @@ def _bench_advisor(out_path: str, n_trials: int) -> None:
     })
 
 
+def _bench_failover(out_path: str) -> None:
+    """Kill one worker mid-stream under load and measure what the
+    client experiences: the stream-gap (longest silence between
+    delivered events, covering detection + re-scatter + prefix
+    re-ingest) and zero-token-loss (streamed deltas + final text
+    exactly equal a no-fault reference run)."""
+    import threading
+
+    import jax
+
+    from rafiki_tpu.chaos import ChaosConfig, ChaosInjector
+    from rafiki_tpu.models.llama_lora import LlamaLoRA
+    from rafiki_tpu.serving.predictor import Predictor
+    from rafiki_tpu.serving.queues import InProcQueueHub
+    from rafiki_tpu.store.param_store import ParamStore
+    from rafiki_tpu.worker.inference import InferenceWorker
+
+    backend = jax.default_backend()
+    on_accel = backend not in ("cpu",)
+    knobs = {
+        "max_epochs": 1, "vocab_size": 1 << 14,
+        "hidden_dim": 256 if on_accel else 64,
+        "depth": 4 if on_accel else 2,
+        "n_heads": 8 if on_accel else 4, "kv_ratio": 2,
+        "lora_rank": 8, "max_len": 64 if on_accel else 32,
+        "model_parallel": 1, "learning_rate": 1e-3, "batch_size": 8,
+        "bf16": on_accel, "quick_train": True, "share_params": False,
+    }
+    # a REAL quick-trained trial, not an init-dump: prefix re-ingestion
+    # round-trips through the tokenizer's learned id↔token table, which
+    # an untrained dump does not populate (its <id> renderings are
+    # one-way — no production trial serves untrained)
+    import tempfile
+
+    from rafiki_tpu.data import generate_text_classification_dataset
+
+    model = LlamaLoRA(**knobs)
+    with tempfile.TemporaryDirectory() as d:
+        tr = f"{d}/train.jsonl"
+        generate_text_classification_dataset(tr, 64, seed=0)
+        model.train(tr)
+    store = ParamStore.from_uri("mem://")
+    store.save("trial-lm", model.dump_parameters())
+    max_new = 24 if on_accel else 12
+    kill_after = max_new // 2
+    prompt = "tok1 tok2 tok3"
+
+    def boot(hub, wid, **kw):
+        w = InferenceWorker(LlamaLoRA, "trial-lm", knobs, store, hub,
+                            worker_id=wid, decode_loop=True,
+                            max_slots=8, max_new_tokens=max_new, **kw)
+        th = threading.Thread(target=w.run, daemon=True)
+        th.start()
+        return w, th
+
+    def run_stream(pred):
+        events, times = [], []
+        for ev in pred.predict_stream([prompt], timeout=120.0):
+            events.append(ev)
+            times.append(time.monotonic())
+        acc = "".join(v for e in events[:-1]
+                      for v in e.get("delta", {}).values())
+        return events[-1], acc, times
+
+    # no-fault reference
+    hub = InProcQueueHub()
+    ref, ref_t = boot(hub, "ref")
+    final, ref_acc, _ = run_stream(
+        Predictor(hub, ["ref"], gather_timeout=120.0))
+    expected = final["predictions"][0]
+    ref.stop()
+    ref_t.join(timeout=30)
+
+    # faulty fleet under background unary load
+    hub = InProcQueueHub()
+    chaos = ChaosInjector(ChaosConfig(kill_after_tokens=kill_after))
+    w0, t0_ = boot(hub, "w0", steps_per_sync=1, chaos=chaos)
+    w1, t1_ = boot(hub, "w1")
+    pred = Predictor(hub, ["w0", "w1"], gather_timeout=120.0,
+                     stream_silence_timeout_s=1.0,
+                     breaker_fail_threshold=1)
+    stop_load = threading.Event()
+
+    def load_client():
+        while not stop_load.is_set():
+            try:
+                pred.predict([prompt], timeout=5.0)
+            except Exception:  # noqa: BLE001 — load gen best-effort
+                pass
+
+    loaders = [threading.Thread(target=load_client, daemon=True)
+               for _ in range(2)]
+    for th in loaders:
+        th.start()
+    try:
+        t_start = time.monotonic()
+        final, acc, times = run_stream(pred)
+        dt = time.monotonic() - t_start
+    finally:
+        stop_load.set()
+        for th in loaders:
+            th.join(timeout=10)
+        w1.stop()
+        t1_.join(timeout=30)
+        t0_.join(timeout=30)
+
+    gaps = [b - a for a, b in zip(times, times[1:])]
+    _record(out_path, {
+        "stage": "failover", "backend": backend,
+        "zero_token_loss": bool(
+            final.get("predictions") == [expected]
+            and acc == ref_acc == expected),
+        "stream_gap_s": max(gaps) if gaps else dt,
+        "stream_total_s": dt,
+        "failovers": int(final.get("info", {}).get("failovers", -1)),
+        "silence_timeout_s": 1.0, "kill_after_tokens": kill_after,
+        "max_new": max_new,
+        "breaker_trips": int(
+            pred.breakers.counters["breaker_trips"]),
+    })
+
+
 def _child(out_path: str, budget: float, use_kv: bool) -> None:
     t_start = time.monotonic()
 
@@ -753,6 +875,13 @@ def _child(out_path: str, budget: float, use_kv: bool) -> None:
             _bench_advisor(out_path, n_trials=6)
         except Exception as e:  # noqa: BLE001
             _record(out_path, {"stage": "advisor_error",
+                               "error": repr(e)[:300]})
+
+    if budget - (time.monotonic() - t_start) > 60:
+        try:
+            _bench_failover(out_path)
+        except Exception as e:  # noqa: BLE001
+            _record(out_path, {"stage": "failover_error",
                                "error": repr(e)[:300]})
 
     if budget - (time.monotonic() - t_start) > 120:
@@ -898,6 +1027,20 @@ def main() -> None:
             "kv_pages_high_water": kvf["kv_pages_high_water"],
             "kv_pages_total": kvf["kv_pages_total"],
             "admission_stalls": kvf["admission_stalls"]}))
+    fo = next((r for r in records if r.get("stage") == "failover"),
+              None)
+    if fo:
+        print(json.dumps({
+            "metric": "failover_stream_gap_s",
+            "value": round(fo["stream_gap_s"], 3), "unit": "s",
+            "backend": fo["backend"],
+            "zero_token_loss": fo["zero_token_loss"],
+            "failovers": fo["failovers"],
+            "silence_timeout_s": fo["silence_timeout_s"],
+            "kill_after_tokens": fo["kill_after_tokens"],
+            "max_new": fo["max_new"],
+            "breaker_trips": fo["breaker_trips"],
+            "stream_total_s": round(fo["stream_total_s"], 3)}))
     mo = next((r for r in records
                if r.get("stage") == "metrics_overhead"), None)
     if mo:

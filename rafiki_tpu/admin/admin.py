@@ -326,6 +326,22 @@ class Admin:
             # 500 from the admin itself
             return {"ok": False, "error": str(e)}
 
+    def rolling_restart_inference_job(self, job_id: str,
+                                      drain_timeout: float = 120.0
+                                      ) -> Dict[str, Any]:
+        """Cycle the job's workers with zero dropped streams: each is
+        drained (finishes in-flight work while the predictor routes
+        around it), stopped, and respawned before the next one goes."""
+        job = self.meta.get_inference_job(job_id)
+        if job is None:
+            raise KeyError(f"no inference job {job_id!r}")
+        if job["status"] != "RUNNING":
+            raise ValueError(
+                f"inference job {job_id} is {job['status']}, not "
+                "RUNNING — nothing to restart")
+        return self.services.rolling_restart(job_id,
+                                             drain_timeout=drain_timeout)
+
     def stop_inference_job(self, job_id: str) -> None:
         # STOPPED first — same respawn-race reasoning as stop_train_job
         self.meta.update_inference_job(job_id, status="STOPPED",

@@ -72,6 +72,8 @@ class AdminApp:
           self._auth(self._get_inference_job_health))
         r("POST", "/inference_jobs/<id>/stop",
           self._auth(self._stop_inference_job))
+        r("POST", "/inference_jobs/<id>/rolling_restart",
+          self._auth(self._rolling_restart))
 
     def start(self) -> Tuple[str, int]:
         return self.http.start()
@@ -132,12 +134,21 @@ class AdminApp:
 
     def _health(self, _m, _b, _h) -> Tuple[int, Any]:
         svc = self.admin.services
-        # respawn_stats is lock-protected: the monitor thread mutates the
-        # underlying dicts while this HTTP thread reads
+        # respawn_stats/degraded_jobs are lock-protected: the monitor
+        # thread mutates the underlying dicts while this thread reads
+        # jobs whose self-healing is exhausted/lost (job id → reason):
+        # a job quietly running under-replicated must be visible here,
+        # not just in a warning log. Fetched FIRST — degraded_jobs()
+        # prunes STOPPED jobs, and the count must describe the same
+        # pruned view the map shows (a monitor alerting on the counter
+        # must find its job in the list)
+        degraded = svc.degraded_jobs()
         return 200, {"ok": True,
                      "n_services": len(svc.services),
                      "free_slots": svc.allocator.free_count(),
-                     **svc.respawn_stats()}
+                     **svc.respawn_stats(),
+                     "degraded_jobs": len(degraded),
+                     "degraded": degraded}
 
     def _login(self, _m, body, _h) -> Tuple[int, Any]:
         try:
@@ -226,6 +237,20 @@ class AdminApp:
     def _stop_inference_job(self, m, _b, user) -> Tuple[int, Any]:
         self.admin.stop_inference_job(m["id"])
         return 200, {"ok": True}
+
+    def _rolling_restart(self, m, body, user) -> Tuple[int, Any]:
+        """Zero-downtime worker cycling: drain→stop→respawn each of the
+        job's workers one at a time (deploys/config reloads that must
+        not drop a stream)."""
+        try:
+            return 200, self.admin.rolling_restart_inference_job(
+                m["id"], drain_timeout=float(
+                    (body or {}).get("drain_timeout", 120.0)))
+        except RuntimeError as e:
+            # already-in-progress / no free slot: a conflict with the
+            # current state, not a server bug — 409 like the other
+            # resource-conflict paths
+            return 409, {"error": str(e)}
 
 
 def main(argv: Optional[list] = None) -> int:

@@ -117,6 +117,17 @@ class ServicesManager:
         #: without this, a single-worker job whose only slot got snatched
         #: between release and re-acquire would lose healing forever
         self._pending_respawns: List[Dict[str, Any]] = []
+        #: jobs whose self-healing is exhausted or lost (respawn budget
+        #: spent, queued respawn dropped): job id → reason. Surfaced on
+        #: the admin /health so a job quietly running under-replicated
+        #: (or not at all) is visible, not just a log line.
+        self._degraded: Dict[str, str] = {}
+        #: completed drain→stop→respawn cycles (rolling_restart)
+        self._rolling_restarts = 0
+        #: one rolling restart at a time: a concurrent second call (an
+        #: operator retrying a timed-out request) would drain the fresh
+        #: replacements and spawn duplicates sharing one worker id
+        self._rolling_lock = threading.Lock()
 
     def reap_stale_services(self) -> int:
         """Admin restart adoption: service rows left non-STOPPED by a
@@ -646,6 +657,12 @@ class ServicesManager:
                     logging.getLogger(__name__).warning(
                         "queued respawn for %s failed and was dropped: "
                         "%s", item["dead_id"], e)
+                    mk = item["spec"]["meta_kwargs"]
+                    self._mark_degraded(
+                        item["spec"]["service_type"],
+                        mk.get("train_job_id")
+                        or mk.get("inference_job_id"),
+                        f"queued respawn failed: {e}")
             self._pending_respawns = still_pending
         for svc in list(self.services.values()):
             if svc.alive():
@@ -699,6 +716,11 @@ class ServicesManager:
                 "respawn budget exhausted for %s job %s (last casualty "
                 "%s) — a worker config appears to crash "
                 "deterministically", stype, job_id, dead_service_id)
+            # the drop is not just a log line: the job surfaces as
+            # degraded on /health (and ERRORED in the store when it has
+            # no workers left at all)
+            self._mark_degraded(stype, job_id,
+                                "respawn budget exhausted")
             return True
         slot = None
         if spec["needs_slot"]:
@@ -714,14 +736,210 @@ class ServicesManager:
             raise
         self._respawn_counts[lineage] = \
             self._respawn_counts.get(lineage, 0) + 1
+        # healing worked: the job is no longer degraded (a stale flag
+        # that survives recovery teaches operators to ignore it)
+        self._degraded.pop(job_id, None)
         return True
+
+    def _live_workers_of(self, stype: str, job_id: str
+                         ) -> List[ManagedService]:
+        """Still-alive workers of ``stype`` belonging to ``job_id``
+        (caller holds op_lock or tolerates a snapshot)."""
+        key = ("train_job_id" if stype == ServiceType.TRAIN_WORKER
+               else "inference_job_id")
+        out = []
+        for sid, svc in self.services.items():
+            if svc.service_type != stype or not svc.alive():
+                continue
+            spec = self._respawn_specs.get(sid)
+            if spec and spec["meta_kwargs"].get(key) == job_id:
+                out.append(svc)
+        return out
+
+    def _mark_degraded(self, stype: str, job_id: Optional[str],
+                       reason: str) -> None:
+        """Record a job whose self-healing is gone. With zero workers
+        left the job is not degraded but DEAD — its store row flips to
+        ERRORED so the dashboard's status column shows it."""
+        if not job_id:
+            return
+        self._degraded[job_id] = reason
+        if self._live_workers_of(stype, job_id):
+            return  # under-replicated but still serving
+        import logging
+
+        try:
+            if stype == ServiceType.TRAIN_WORKER:
+                self.meta.update_train_job(job_id,
+                                           status=TrainJobStatus.ERRORED)
+            else:
+                self.meta.update_inference_job(job_id, status="ERRORED")
+        except Exception as e:  # noqa: BLE001 — a store hiccup must not
+            # kill the monitor loop; the /health degraded list already
+            # carries the signal
+            logging.getLogger(__name__).warning(
+                "could not mark job %s ERRORED: %s", job_id, e)
+
+    def degraded_jobs(self) -> Dict[str, str]:
+        """Jobs that lost self-healing (job id → reason), for /health.
+        Jobs an operator has since STOPPED drop off the list (ERRORED
+        ones stay — that verdict is the point of the flag)."""
+        with self.op_lock:
+            out = dict(self._degraded)
+        for jid in list(out):
+            job = self.meta.get_train_job(jid) or \
+                self.meta.get_inference_job(jid)
+            if job is not None and job.get("status") == "STOPPED":
+                with self.op_lock:
+                    self._degraded.pop(jid, None)
+                del out[jid]
+        return out
 
     def respawn_stats(self) -> Dict[str, int]:
         """Self-healing counters for /health (locked: the monitor thread
         mutates these dicts while HTTP threads read)."""
         with self.op_lock:
             return {"respawns_done": sum(self._respawn_counts.values()),
-                    "pending_respawns": len(self._pending_respawns)}
+                    "pending_respawns": len(self._pending_respawns),
+                    "degraded_jobs": len(self._degraded),
+                    "rolling_restarts_done": self._rolling_restarts}
+
+    # ---- graceful drain / rolling restart ----
+    def _request_drain(self, config: Dict[str, Any]) -> bool:
+        """Ask a worker to drain: POST /drain on its obs sidecar
+        (discovered via the obs_port_file the worker wrote at boot),
+        falling back to a ``{"control": "drain"}`` message on its query
+        queue. Returns False when neither channel is available."""
+        import logging
+
+        from ..utils.http import json_request
+
+        log = logging.getLogger(__name__)
+        port_file = config.get("obs_port_file")
+        if port_file:
+            try:
+                port = int(Path(port_file).read_text().strip())
+                json_request("POST", f"http://127.0.0.1:{port}/drain",
+                             {}, timeout=5.0)
+                return True
+            except Exception as e:  # noqa: BLE001 — the sidecar may be
+                # gone with a hung worker; the queue channel still works
+                log.warning("drain via obs sidecar failed (%s); "
+                            "falling back to queue control message", e)
+        wid = config.get("worker_id")
+        if wid and self.kv_port:
+            from ..serving.queues import KVQueueHub, pack_message
+
+            KVQueueHub(self.kv_host, self.kv_port).push_query(
+                wid, pack_message({"control": "drain"}))
+            return True
+        log.warning("no drain channel for worker config %r",
+                    config.get("worker_id"))
+        return False
+
+    def rolling_restart(self, inference_job_id: str,
+                        drain_timeout: float = 120.0
+                        ) -> Dict[str, Any]:
+        """Drain → stop → respawn each of a live inference job's
+        workers ONE AT A TIME, so a deploy/restart never drops a
+        stream: the draining worker finishes its in-flight requests
+        (streams included) while the predictor's breaker board routes
+        new traffic to its siblings; only then is it replaced. A worker
+        that fails to drain within ``drain_timeout`` is terminated —
+        the restart must converge even over a hung process. Returns the
+        old→new service id pairs."""
+        if not self._rolling_lock.acquire(blocking=False):
+            raise RuntimeError(
+                "a rolling restart is already in progress — wait for "
+                "it to finish (retrying a timed-out request would "
+                "drain the fresh replacements)")
+        try:
+            return self._rolling_restart(inference_job_id,
+                                         drain_timeout)
+        finally:
+            self._rolling_lock.release()
+
+    def _rolling_restart(self, inference_job_id: str,
+                         drain_timeout: float) -> Dict[str, Any]:
+        with self.op_lock:
+            targets = []
+            for sid, svc in list(self.services.items()):
+                if svc.service_type != ServiceType.INFERENCE_WORKER:
+                    continue
+                spec = self._respawn_specs.get(sid)
+                if spec and spec["meta_kwargs"].get(
+                        "inference_job_id") == inference_job_id:
+                    targets.append((sid, svc, spec))
+        if not targets:
+            raise KeyError("no live inference workers for job "
+                           f"{inference_job_id!r}")
+        import logging
+
+        log = logging.getLogger(__name__)
+        restarted = []
+        for sid, svc, spec in targets:
+            with self.op_lock:
+                # de-register crash healing for THIS worker only, at
+                # its own turn: dying non-zero while draining (or the
+                # terminate below) must not make the monitor respawn
+                # it in parallel with the replacement spawned here —
+                # while workers not yet reached keep their healing if
+                # the restart aborts mid-way
+                self._respawn_specs.pop(sid, None)
+            drain_sent = self._request_drain(spec["config"])
+            # wait OUTSIDE op_lock: the monitor thread must stay able
+            # to poll (and the draining worker may take a while to
+            # finish its streams). A worker that was never asked to
+            # drain (no channel) gets a short grace, not the full
+            # budget — waiting can't help it finish what it doesn't
+            # know to finish.
+            try:
+                svc.proc.wait(timeout=drain_timeout if drain_sent
+                              else min(5.0, drain_timeout))
+            except subprocess.TimeoutExpired:
+                log.warning(
+                    "worker %s did not drain within %.0fs%s; "
+                    "terminating", sid, drain_timeout,
+                    "" if drain_sent else " (no drain channel)")
+                svc.proc.terminate()
+                try:
+                    svc.proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    svc.proc.kill()
+                    svc.proc.wait()
+            with self.op_lock:
+                if sid in self.services:  # the monitor may have reaped
+                    # the rc=0 exit already (drain = clean completion)
+                    self.meta.update_service(sid,
+                                             status=ServiceStatus.STOPPED)
+                    if svc.slot is not None:
+                        self.allocator.release(svc.slot)
+                        svc.slot = None
+                    self._respawn_specs.pop(sid, None)
+                    del self.services[sid]
+                slot = None
+                if spec["needs_slot"]:
+                    slot = self.allocator.acquire(
+                        timeout=self.slot_timeout)
+                    if slot is None:
+                        raise RuntimeError(
+                            "no free device slot to respawn drained "
+                            f"worker {sid} — rolling restart aborted "
+                            "mid-way")
+                try:
+                    new = self._spawn(spec["module"], spec["config"],
+                                      spec["service_type"], slot=slot,
+                                      **spec["meta_kwargs"])
+                except Exception:
+                    if slot is not None:
+                        self.allocator.release(slot)
+                    raise
+                self._rolling_restarts += 1
+                # a fresh healthy worker supersedes any degraded flag
+                self._degraded.pop(inference_job_id, None)
+            restarted.append({"old": sid, "new": new.service_id,
+                              "drained": bool(drain_sent)})
+        return {"job_id": inference_job_id, "restarted": restarted}
 
     def pending_respawn_job_ids(self) -> set:
         """Jobs that currently have a queued (slot-starved) worker

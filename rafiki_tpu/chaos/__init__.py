@@ -1,0 +1,205 @@
+"""Deterministic fault injection for the serving data plane.
+
+Fault tolerance that is only exercised by real outages is untested
+code. This package injects the failure modes the request path claims to
+survive — worker death mid-stream, dropped replies, delayed queues,
+corrupted payloads — deterministically (seeded RNG, token-count
+triggers), so tier-1 tests and the ``bench_extra failover`` stage can
+drive every branch of the breaker/failover/drain machinery on demand.
+
+Three pieces:
+
+- :class:`ChaosConfig` — the injector knob set, parseable from the
+  ``RAFIKI_CHAOS`` env var (``key=value`` pairs, comma/semicolon
+  separated) so a real spawned worker process can be made faulty
+  without code changes::
+
+      RAFIKI_CHAOS="kill_after_tokens=32,seed=7"      # die mid-stream
+      RAFIKI_CHAOS="drop_reply_p=0.2,delay_queue_s=0.05"
+
+- :class:`ChaosInjector` — the seeded decision core + injection
+  counters (a :class:`~rafiki_tpu.obs.metrics.StatsMap`, so injected
+  faults are visible on the worker's ``/metrics`` as ``chaos_*``
+  gauges: a chaos run is observable, not a mystery).
+
+- :class:`ChaosHub` — a :class:`~rafiki_tpu.serving.queues.QueueHub`
+  wrapper applying reply-drop / delay / corruption at the hub boundary;
+  the kill-after-N-tokens trigger is threaded through the inference
+  worker's decode loop instead (death is a worker behavior, not a
+  queue one).
+
+Injectors default to all-off; an all-off config costs nothing because
+the worker only wraps its hub when at least one fault is armed.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from dataclasses import dataclass, fields
+from typing import Any, Dict, Optional
+
+from ..obs.metrics import StatsMap
+from ..serving.queues import QueueHub
+
+#: the env var workers read at boot (see ChaosConfig.from_env)
+CHAOS_ENV = "RAFIKI_CHAOS"
+
+
+@dataclass
+class ChaosConfig:
+    """Injector knobs. All-off by default; every field is independent.
+
+    - ``kill_after_tokens``: the worker dies (decode loop exits without
+      replying or publishing, process exits non-zero) once its engine
+      has generated this many tokens in total. The deterministic
+      "worker killed mid-stream" trigger.
+    - ``drop_reply_p``: each reply push (delta or final) is dropped
+      with this probability — a lossy data plane / dying worker.
+    - ``delay_queue_s``: every queue push sleeps this long first —
+      transit latency / an overloaded hub.
+    - ``corrupt_payload_p``: each reply push is bit-flipped with this
+      probability — a torn write; consumers must fail structured, not
+      crash.
+    - ``seed``: drives every probabilistic draw; same seed + same
+      traffic order = same faults.
+    """
+
+    kill_after_tokens: int = 0
+    drop_reply_p: float = 0.0
+    delay_queue_s: float = 0.0
+    corrupt_payload_p: float = 0.0
+    seed: int = 0
+
+    @property
+    def armed(self) -> bool:
+        return bool(self.kill_after_tokens > 0 or self.drop_reply_p > 0
+                    or self.delay_queue_s > 0
+                    or self.corrupt_payload_p > 0)
+
+    @classmethod
+    def parse(cls, spec: str) -> "ChaosConfig":
+        """``"kill_after_tokens=8,drop_reply_p=0.5,seed=3"`` → config.
+        Unknown keys and malformed values raise: a chaos run with a
+        typo'd knob silently testing nothing is worse than no run."""
+        kw: Dict[str, Any] = {}
+        casts = {f.name: f.type for f in fields(cls)}
+        for part in spec.replace(";", ",").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, sep, val = part.partition("=")
+            key = key.strip()
+            if not sep or key not in casts:
+                raise ValueError(
+                    f"unknown chaos knob {key!r} (have: "
+                    f"{sorted(casts)})")
+            cast = int if casts[key] in (int, "int") else float
+            kw[key] = cast(val.strip())
+        return cls(**kw)
+
+    @classmethod
+    def from_env(cls, env: Optional[Dict[str, str]] = None
+                 ) -> Optional["ChaosConfig"]:
+        """The ``RAFIKI_CHAOS`` config, or None when unset/empty."""
+        spec = (env if env is not None else os.environ).get(
+            CHAOS_ENV, "").strip()
+        if not spec:
+            return None
+        cfg = cls.parse(spec)
+        return cfg if cfg.armed else None
+
+
+class ChaosInjector:
+    """Seeded decision core. One injector per faulty process; all
+    decisions funnel through it so a (seed, traffic order) pair replays
+    identically. Counters are exposed as ``chaos_*`` metrics by the
+    owning worker."""
+
+    def __init__(self, cfg: ChaosConfig) -> None:
+        self.cfg = cfg
+        self._rng = random.Random(cfg.seed)
+        self.counters = StatsMap({"replies_dropped": 0,
+                                  "payloads_corrupted": 0,
+                                  "queue_delays": 0,
+                                  "kills": 0})
+
+    def should_kill(self, tokens_generated: int) -> bool:
+        """True once the cumulative generated-token count crosses the
+        configured kill point (then latched: a killed worker stays
+        killed)."""
+        k = self.cfg.kill_after_tokens
+        if k <= 0 or tokens_generated < k:
+            return False
+        if not self.counters["kills"]:
+            self.counters.inc("kills")
+        return True
+
+    def mangle_reply(self, data: bytes) -> Optional[bytes]:
+        """Apply drop/corrupt faults to a reply payload: None = dropped,
+        otherwise the (possibly corrupted) bytes to push."""
+        if self.cfg.drop_reply_p > 0 and \
+                self._rng.random() < self.cfg.drop_reply_p:
+            self.counters.inc("replies_dropped")
+            return None
+        if self.cfg.corrupt_payload_p > 0 and \
+                self._rng.random() < self.cfg.corrupt_payload_p:
+            self.counters.inc("payloads_corrupted")
+            if data:
+                i = self._rng.randrange(len(data))
+                data = data[:i] + bytes([data[i] ^ 0xFF]) + data[i + 1:]
+        return data
+
+    def maybe_delay(self) -> None:
+        d = self.cfg.delay_queue_s
+        if d > 0:
+            self.counters.inc("queue_delays")
+            time.sleep(d)
+
+
+class ChaosHub(QueueHub):
+    """A :class:`QueueHub` decorator applying the injector's queue
+    faults. Pops and stats pass through untouched — the faults modeled
+    here live on the PUSH side (a worker failing to get its answer
+    out), which is where the breaker/failover machinery must catch
+    them."""
+
+    def __init__(self, inner: QueueHub, injector: ChaosInjector) -> None:
+        self.inner = inner
+        self.injector = injector
+
+    def push_query(self, worker_id: str, data: bytes) -> None:
+        self.injector.maybe_delay()
+        self.inner.push_query(worker_id, data)
+
+    def pop_query(self, worker_id: str, timeout: float):
+        return self.inner.pop_query(worker_id, timeout)
+
+    def push_prediction(self, query_id: str, data: bytes) -> None:
+        self.injector.maybe_delay()
+        mangled = self.injector.mangle_reply(data)
+        if mangled is None:
+            return  # dropped on the floor — the fault being injected
+        self.inner.push_prediction(query_id, mangled)
+
+    def pop_prediction(self, query_id: str, timeout: float):
+        return self.inner.pop_prediction(query_id, timeout)
+
+    def query_depth(self, worker_id: str) -> int:
+        return self.inner.query_depth(worker_id)
+
+    def discard_prediction_queue(self, query_id: str) -> None:
+        self.inner.discard_prediction_queue(query_id)
+
+    def arm_reply_ttl(self, query_id: str, ttl_s: float) -> None:
+        self.inner.arm_reply_ttl(query_id, ttl_s)
+
+    def put_worker_stats(self, worker_id: str, stats) -> None:
+        self.inner.put_worker_stats(worker_id, stats)
+
+    def get_worker_stats(self, worker_id: str):
+        return self.inner.get_worker_stats(worker_id)
+
+
+__all__ = ["CHAOS_ENV", "ChaosConfig", "ChaosHub", "ChaosInjector"]

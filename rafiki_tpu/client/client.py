@@ -12,9 +12,44 @@ from __future__ import annotations
 
 import base64
 import time
+from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Type
 
-from ..utils.http import json_request
+from ..utils.http import HttpStatusError, json_request
+
+#: cap on how long predict() sleeps honoring a 503's retry_after_s —
+#: a server bug must not park the caller for an hour
+MAX_RETRY_AFTER_S = 30.0
+
+
+@dataclass
+class StreamInterrupted:
+    """Typed terminal event for a stream that ended with a *resumable*
+    error: the predictor lost every healthy worker mid-stream and hands
+    back the query id plus the text delivered so far. Pass ``partial``
+    back as ``resume=`` (or let ``predict_stream(auto_resume=...)`` do
+    it) to continue the stream without re-paying the delivered tokens.
+
+    Duck-dict compatible (``ev.get("done")``, ``ev["error"]``) so event
+    loops written against plain dict events keep working."""
+
+    error: str
+    partial: List[Optional[str]]
+    qid: str = ""
+    trace_id: str = ""
+    retry_after_s: float = 0.0
+    raw: Dict[str, Any] = field(default_factory=dict)
+    done: bool = True
+    resumable: bool = True
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self.raw.get(key, default)
+
+    def __getitem__(self, key: str) -> Any:
+        return self.raw[key]
+
+    def __contains__(self, key: str) -> bool:
+        return key in self.raw
 
 
 class Client:
@@ -151,17 +186,47 @@ class Client:
     def stop_inference_job(self, job_id: str) -> None:
         self._call("POST", f"/inference_jobs/{job_id}/stop")
 
+    def rolling_restart_inference_job(self, job_id: str,
+                                      drain_timeout: float = 120.0,
+                                      expected_workers: int = 2
+                                      ) -> Dict[str, Any]:
+        """Cycle the job's workers one at a time with graceful drain —
+        a deploy/restart that never drops a stream. Returns the
+        old→new service id pairs. The endpoint is synchronous and can
+        legitimately block ~``expected_workers × drain_timeout`` while
+        long streams finish, so the socket timeout is sized to that
+        (plus respawn slack) instead of the unary default — a
+        premature client timeout would tempt a retry the server
+        rejects with 409 (one rolling restart at a time)."""
+        headers = {}
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
+        sock = max(self.timeout,
+                   max(1, int(expected_workers)) * drain_timeout + 60.0)
+        return json_request(
+            "POST",
+            f"{self.admin_url}/inference_jobs/{job_id}/rolling_restart",
+            {"drain_timeout": drain_timeout}, headers=headers,
+            timeout=sock)
+
     # ---- online prediction ----
     def predict(self, predictor_url: str, queries: Sequence[Any],
                 timeout: Optional[float] = None,
                 sampling: Optional[Dict[str, Any]] = None,
-                trace_id: Optional[str] = None) -> List[Any]:
+                trace_id: Optional[str] = None,
+                retry_on_503: bool = True) -> List[Any]:
         """``sampling`` (generation jobs): {temperature, top_k, top_p,
         seed, eos_id, max_new, adapter_id} forwarded to the decode
         loop; omit for greedy defaults. ``max_new`` is clamped by the
         worker's configured cap. ``trace_id`` rides as
         ``X-Rafiki-Trace-Id`` so this request's timeline can be pulled
-        from the predictor's and workers' ``/debug/requests``."""
+        from the predictor's and workers' ``/debug/requests``.
+
+        A structured 503 (every worker breaker open, or the fleet
+        mid-rolling-restart) is retried ONCE after honoring the
+        server's ``retry_after_s`` (capped at ``MAX_RETRY_AFTER_S``) —
+        the server told us exactly when trying again can help. Disable
+        with ``retry_on_503=False``."""
         body: Dict[str, Any] = {"queries": _jsonable(queries)}
         if timeout is not None:
             body["timeout"] = timeout
@@ -171,29 +236,49 @@ class Client:
         # slow-but-working predictor (first-request compile) looks dead
         sock_timeout = self.timeout if timeout is None else \
             max(self.timeout, timeout + 30.0)
-        out = json_request("POST", f"{predictor_url.rstrip('/')}/predict",
-                           body, headers=_trace_headers(trace_id),
-                           timeout=sock_timeout)
+        url = f"{predictor_url.rstrip('/')}/predict"
+        headers = _trace_headers(trace_id)
+        try:
+            out = json_request("POST", url, body, headers=headers,
+                               timeout=sock_timeout)
+        except HttpStatusError as e:
+            retry_after = e.payload.get("retry_after_s")
+            if not (retry_on_503 and e.status == 503
+                    and isinstance(retry_after, (int, float))):
+                raise
+            time.sleep(min(max(0.0, float(retry_after)),
+                           MAX_RETRY_AFTER_S))
+            out = json_request("POST", url, body, headers=headers,
+                               timeout=sock_timeout)
         return out["predictions"]
 
     def predict_stream(self, predictor_url: str, queries: Sequence[Any],
                        timeout: Optional[float] = None,
                        sampling: Optional[Dict[str, Any]] = None,
-                       trace_id: Optional[str] = None):
+                       trace_id: Optional[str] = None,
+                       resume: Optional[Sequence[Optional[str]]] = None,
+                       auto_resume: int = 1):
         """Streaming generation: yields the predictor's SSE events —
         ``{"delta": {qi: text}}`` per new-token batch (append to query
         qi's output), rarely ``{"replace": {qi: text}}`` (authoritative
         text diverged from the streamed prefix — overwrite, don't
         append), then one ``{"done": True, "predictions": [...]}`` (or
         done+error). Every stream ends with a done event. Only
-        meaningful against generation (decode-loop) inference jobs."""
+        meaningful against generation (decode-loop) inference jobs.
+
+        **Resumable errors**: when the predictor loses every healthy
+        worker mid-stream it ends the stream with a *resumable* event
+        carrying the delivered text. Up to ``auto_resume`` times, this
+        generator transparently re-requests with that partial as
+        ``resume`` (after honoring ``retry_after_s``) and the stream
+        continues where it stopped — no text re-delivered or lost.
+        When resumes are exhausted (or ``auto_resume=0``) the terminal
+        event is a typed :class:`StreamInterrupted` instead of a bare
+        error string, so callers can resume on their own schedule.
+        ``resume`` seeds the first request (continuing an earlier
+        interrupted stream)."""
         from ..utils.http import STREAM_BUDGET_S, sse_request
 
-        body: Dict[str, Any] = {"queries": _jsonable(queries)}
-        if timeout is not None:
-            body["timeout"] = timeout
-        if sampling:
-            body["sampling"] = sampling
         # a request queued behind busy decode slots can legitimately
         # produce no deltas until near the server's WHOLE-stream budget
         # — so with no explicit timeout, size the per-EVENT wait to the
@@ -202,11 +287,49 @@ class Client:
         # establishment keeps the short self.timeout: a down host must
         # fail fast, not after the stream budget.
         server_budget = STREAM_BUDGET_S if timeout is None else timeout
-        yield from sse_request(
-            "POST", f"{predictor_url.rstrip('/')}/predict_stream",
-            body, headers=_trace_headers(trace_id),
-            timeout=self.timeout,
-            read_timeout=max(self.timeout, server_budget + 30.0))
+        partial = list(resume) if resume else None
+        resumes_left = max(0, int(auto_resume))
+        while True:
+            body: Dict[str, Any] = {"queries": _jsonable(queries)}
+            if timeout is not None:
+                body["timeout"] = timeout
+            if sampling:
+                body["sampling"] = sampling
+            if partial and any(p for p in partial):
+                body["resume"] = [p if isinstance(p, str) else None
+                                  for p in partial]
+            resumed_here = False
+            for ev in sse_request(
+                    "POST",
+                    f"{predictor_url.rstrip('/')}/predict_stream",
+                    body, headers=_trace_headers(trace_id),
+                    timeout=self.timeout,
+                    read_timeout=max(self.timeout,
+                                     server_budget + 30.0)):
+                if not (isinstance(ev, dict) and ev.get("done")
+                        and ev.get("resumable")):
+                    yield ev
+                    continue
+                partial = list(ev.get("partial") or [])
+                if resumes_left > 0:
+                    # resume even with NO delivered text: an empty
+                    # resume is just a fresh request after
+                    # retry_after_s — the stream twin of predict()'s
+                    # structured-503 retry
+                    resumes_left -= 1
+                    resumed_here = True
+                    time.sleep(min(
+                        max(0.0, float(ev.get("retry_after_s") or 0)),
+                        MAX_RETRY_AFTER_S))
+                    break  # re-request with the partial as resume
+                yield StreamInterrupted(
+                    error=str(ev.get("error") or ""),
+                    partial=partial, qid=str(ev.get("qid") or ""),
+                    trace_id=str(ev.get("trace_id") or ""),
+                    retry_after_s=float(ev.get("retry_after_s") or 0),
+                    raw=ev)
+            if not resumed_here:
+                return
 
 
 def _trace_headers(trace_id: Optional[str]) -> Optional[Dict[str, str]]:

@@ -1270,30 +1270,92 @@ class TextDecodeEngine:
     from the owning model template (see ``LlamaLoRA.make_decode_engine``).
     """
 
+    #: the inference worker checks this before forwarding a failover
+    #: request's ``forced_prefix`` (duck-typed user engines without the
+    #: kwarg must get a structured rejection, not a TypeError that
+    #: kills the serve thread)
+    supports_resume = True
+
     def __init__(self, engine: DecodeEngine,
                  encode: Callable[[str], np.ndarray],
                  decode: Callable[[List[int]], str],
-                 max_new: int = 8) -> None:
+                 max_new: int = 8, resume_sep: str = " ") -> None:
         self.engine = engine
         self._encode = encode
         self._decode = decode
         self.max_new = int(max_new)
+        #: text joint between a prompt and a forced resume prefix (and
+        #: between the prefix and the continuation decode): " " matches
+        #: both tokenizer families — the hash tokenizer splits/joins on
+        #: whitespace exactly, and the byte-BPE detok lstrips the
+        #: leading space its first generated token usually carries
+        self._sep = resume_sep
         self._stream_sent: Dict[Any, str] = {}  # rid -> text delivered
+        #: rid -> forced resume prefix (failover re-submissions): the
+        #: already-delivered text the engine re-ingests as prompt but
+        #: which deltas/finals must present as generated output
+        self._forced: Dict[Any, str] = {}
+        #: resume requests whose prefix already covered the whole token
+        #: budget: completed without touching the engine, surfaced on
+        #: the next poll()
+        self._forced_done: List[Tuple[Any, str]] = []
 
     def submit(self, request_id: Any, text: str,
                max_new: Optional[int] = None, temperature: float = 0.0,
                top_k: int = 0, top_p: float = 1.0, seed: int = 0,
-               eos_id: Optional[int] = None, adapter_id: int = 0) -> None:
-        self.engine.submit(request_id, self._encode(text),
-                           self.max_new if max_new is None else max_new,
+               eos_id: Optional[int] = None, adapter_id: int = 0,
+               forced_prefix: str = "") -> None:
+        """``forced_prefix`` (streaming failover / client resume): text
+        a previous worker already emitted for this request. It is
+        re-ingested as part of the prompt (the engine's chunked-prefill
+        path — prefix compute at matmul intensity, no decode steps),
+        the token budget shrinks by the tokens it covers, and deltas /
+        the final text present it as OUTPUT — the resumed stream
+        continues exactly where the dead one stopped, without
+        re-emitting or dropping text. Greedy continuations are
+        token-exact whenever re-tokenizing prompt+prefix reproduces the
+        original token boundaries (true for the whitespace tokenizer;
+        byte-BPE may shift a boundary at the splice, in which case the
+        predictor's replace/divergence machinery still keeps the client
+        consistent)."""
+        budget = self.max_new if max_new is None else int(max_new)
+        if forced_prefix:
+            full = text + self._sep + forced_prefix
+            covered = max(0, len(self._encode(full))
+                          - len(self._encode(text)))
+            remaining = budget - covered
+            if remaining <= 0:
+                # the dead worker had already generated the whole
+                # budget; only its final message was lost — complete
+                # instantly with the prefix as the authoritative text
+                self._forced_done.append((request_id,
+                                          str(forced_prefix)))
+                return
+            self._forced[request_id] = str(forced_prefix)
+            self._stream_sent[request_id] = str(forced_prefix)
+            text, budget = full, remaining
+        self.engine.submit(request_id, self._encode(text), budget,
                            temperature=temperature, top_k=top_k,
                            top_p=top_p, seed=seed, eos_id=eos_id,
                            adapter_id=adapter_id)
 
+    def _full_text(self, rid: Any, ids: List[int]) -> str:
+        """The request's cumulative OUTPUT text: decoded generated ids,
+        preceded by the forced resume prefix when one is active."""
+        text = self._decode(ids)
+        base = self._forced.get(rid)
+        if base is not None:
+            text = base + (self._sep + text if text else "")
+        return text
+
     def poll(self) -> List[Tuple[Any, str]]:
-        done = [(rid, self._decode(ids)) for rid, ids in self.engine.poll()]
+        done = [(rid, self._full_text(rid, ids))
+                for rid, ids in self.engine.poll()]
+        done.extend(self._forced_done)
+        self._forced_done = []
         for rid, _ in done:  # a finished request stops streaming state
             self._stream_sent.pop(rid, None)
+            self._forced.pop(rid, None)
         return done
 
     def poll_partial(self) -> List[Tuple[Any, str]]:
@@ -1312,7 +1374,7 @@ class TextDecodeEngine:
         the final text instead. Suffix-empty events are dropped."""
         out: List[Tuple[Any, str]] = []
         for rid, ids in self.engine.poll_partial():
-            text = self._decode(ids).rstrip("�")
+            text = self._full_text(rid, ids).rstrip("�")
             sent = self._stream_sent.get(rid, "")
             if len(text) > len(sent) and text.startswith(sent):
                 out.append((rid, text[len(sent):]))
@@ -1331,6 +1393,8 @@ class TextDecodeEngine:
 
     def reset(self) -> None:
         self._stream_sent.clear()
+        self._forced.clear()
+        self._forced_done.clear()
         self.engine.reset()
 
     def reset_stats(self) -> None:

@@ -23,6 +23,7 @@ import numpy as np
 from ..obs import (MetricsRegistry, TraceBuffer, mint_trace_id,
                    mount_obs_routes, sanitize_trace_id)
 from ..utils.http import STREAM_BUDGET_S, JsonHttpService, StreamResponse
+from .breaker import CLOSED, OPEN, BreakerBoard
 from .queues import (EXPIRY_SKEW_TOLERANCE_S, QueueHub, pack_message,
                      unpack_message)
 
@@ -78,12 +79,25 @@ class Predictor:
     #: timeouts to this budget).
     STREAM_TIMEOUT = STREAM_BUDGET_S
 
+    #: a gather miss only counts toward a worker's circuit breaker when
+    #: the budget it missed was at least this long: misses under an
+    #: aggressively learned adaptive budget (or a tiny explicit client
+    #: timeout) mean "slower than the controller wants", not "dead" —
+    #: shedding those is the adaptive controller's job, and letting
+    #: them trip breakers would turn a fleet-wide slowdown into a
+    #: fast-fail outage
+    BREAKER_MIN_TIMEOUT_S = 1.0
+
     def __init__(self, hub: QueueHub, worker_ids: Sequence[str],
                  gather_timeout: float = 10.0,
                  adaptive_gather: bool = False,
                  target_answer_frac: float = 0.95,
                  gather_margin: float = 1.5,
-                 min_gather_timeout: float = 0.05) -> None:
+                 min_gather_timeout: float = 0.05,
+                 breaker_fail_threshold: int = 3,
+                 breaker_cooldown_s: float = 2.0,
+                 stream_silence_timeout_s: float = 30.0,
+                 max_stream_failovers: int = 2) -> None:
         """``adaptive_gather`` enables the serving latency/accuracy
         controller (the reference paper's batching/wait tradeoff,
         SURVEY.md §3.3 note): instead of always waiting
@@ -99,6 +113,20 @@ class Predictor:
         self.hub = hub
         self.worker_ids = list(worker_ids)
         self.gather_timeout = gather_timeout
+        #: per-worker circuit breakers: fed by gather answer/miss
+        #: outcomes, the monotonic staleness signal, and drain
+        #: announcements; consulted at every scatter (open workers are
+        #: skipped, shrinking the gather quorum; all-open fast-fails)
+        self.breakers = BreakerBoard(
+            self.worker_ids, fail_threshold=breaker_fail_threshold,
+            cooldown_s=breaker_cooldown_s)
+        #: mid-stream reply-silence watchdog: no delta/final from the
+        #: stream's worker for this long triggers failover to a healthy
+        #: replica (NOT the whole-stream timeout — a dead worker must
+        #: not cost the client the full stream budget). Generous by
+        #: default: a long prefill queued behind busy slots is silence
+        self.stream_silence_timeout_s = float(stream_silence_timeout_s)
+        self.max_stream_failovers = max(0, int(max_stream_failovers))
         self.adaptive_gather = bool(adaptive_gather)
         self.target_answer_frac = min(1.0, max(0.0, target_answer_frac))
         self.gather_margin = max(1.0, gather_margin)
@@ -127,6 +155,22 @@ class Predictor:
             "gather_deadline_s",
             "adaptive-gather controller's live budget (seconds)",
             fn=self._gather_deadline_s)
+        # fault-tolerance plane: breaker trips/recoveries (board
+        # counters), open-worker gauge, fast-fail + failover counters
+        self.metrics.register_stats(self.breakers.counters)
+        self.metrics.gauge(
+            "breaker_open_workers",
+            "workers currently excluded from scatter "
+            "(open/half-open/draining)", fn=self.breakers.n_open)
+        self._c_fast_fail = self.metrics.counter(
+            "requests_fast_failed",
+            "requests 503'd with every breaker open")
+        self._c_failover = self.metrics.counter(
+            "stream_failovers",
+            "mid-stream failovers to another worker")
+        self._c_resumable = self.metrics.counter(
+            "stream_resumable_errors",
+            "streams ended with a resumable error event")
         self._latencies: "collections.deque[float]" = collections.deque(
             maxlen=self.LATENCY_WINDOW)
         #: per-worker publish watermarks for staleness detection:
@@ -141,7 +185,39 @@ class Predictor:
         #: stale fast samples; a fleet-wide slowdown must relearn in a
         #: few requests, not ~100)
         self._gather_misses = 0
+        self._last_drain_refresh = 0.0
         self._lock = threading.Lock()
+
+    #: floor between drain-exclusion refreshes on the scatter path —
+    #: per-request hub reads would tax the healthy hot path for a
+    #: condition that only exists around rolling restarts
+    DRAIN_REFRESH_EVERY_S = 1.0
+
+    def _refresh_excluded_workers(self, force: bool = False) -> None:
+        """Re-read hub stats for workers the board currently excludes
+        as draining. The draining flag is normally cleared when a
+        /health render annotates the respawned worker's fresh stats —
+        but a predictor used purely through predict()/predict_stream
+        never renders /health, and without this re-check a rolling
+        restart would leave drained-then-respawned workers excluded
+        forever (a shrunken quorum while siblings stay healthy, a
+        permanent fast-fail with none). Rate-limited unless ``force``
+        (the about-to-fast-fail path, where one extra hub read beats a
+        wrong 503)."""
+        now = time.monotonic()
+        if not force and now - self._last_drain_refresh < \
+                self.DRAIN_REFRESH_EVERY_S:
+            return
+        self._last_drain_refresh = now
+        for wid, st in self.breakers.snapshot().items():
+            if not st.get("draining"):
+                continue
+            try:
+                s = self.hub.get_worker_stats(wid)
+            except Exception:  # rafiki: noqa[silent-except] — a hub
+                continue       # hiccup just delays the re-admission
+            if s is not None:
+                self._annotate_staleness(wid, s)
 
     def _gather_deadline_s(self) -> float:
         """The adaptive controller's current gather budget."""
@@ -181,12 +257,41 @@ class Predictor:
         self.traces.start(tid, request_id=qid, span="received",
                           n_queries=len(queries),
                           timeout_s=round(float(timeout), 4))
+        # breaker gating: open workers are skipped at scatter time —
+        # their share of the gather quorum shrinks accordingly. All
+        # open: fast-fail with a structured 503 + retry_after_s instead
+        # of burning the whole gather budget on a dead fleet.
+        if self.breakers.any_draining():
+            # drained workers re-admit themselves through their fresh
+            # published stats (rate-limited; a partial fleet must not
+            # serve a shrunken quorum forever after a rolling restart)
+            self._refresh_excluded_workers()
+        targets = self.breakers.targets()
+        if not targets:
+            self._refresh_excluded_workers(force=True)
+            targets = self.breakers.targets()
+        if not targets:
+            self._c_fast_fail.inc()
+            self._c_requests.inc()
+            retry = round(self.breakers.retry_after_s(), 3)
+            self.traces.add_span(tid, "fast_fail",
+                                 retry_after_s=retry)
+            return [], {"workers_answered": 0, "workers_asked": 0,
+                        "workers_skipped": len(self.worker_ids),
+                        "latency_s": time.monotonic() - t0,
+                        "errors": ["no worker available "
+                                   "(all circuit breakers open)"],
+                        "fast_fail": True, "retry_after_s": retry,
+                        "trace_id": tid}
         deadline = t0 + timeout
         # the wall-clock deadline rides with the query: a worker that
         # pops it too late drops it instead of computing an answer
-        # nobody will read (and recreating a discarded reply queue)
+        # nobody will read (and recreating a discarded reply queue).
+        # ttl_s/sent_ts are the relative twin — workers prefer them,
+        # judged against their own skew estimate (see worker._expired)
         payload = {"id": qid, "queries": _stack(queries),
                    "deadline_ts": time.time() + timeout,
+                   "ttl_s": float(timeout), "sent_ts": time.time(),
                    "trace_id": tid}
         if sampling:
             payload["sampling"] = dict(sampling)
@@ -199,22 +304,38 @@ class Predictor:
                 qid, timeout + EXPIRY_SKEW_TOLERANCE_S + 30.0)
         except Exception:  # rafiki: noqa[silent-except] — the
             pass           # TTL is defense-in-depth
-        for wid in self.worker_ids:
+        for wid in targets:
             self.hub.push_query(wid, msg)
-        self.traces.add_span(tid, "scattered",
-                             workers=len(self.worker_ids))
+        self.traces.add_span(tid, "scattered", workers=len(targets))
 
         per_worker: List[List[Any]] = []
         errors: List[str] = []
+        answered: set = set()
+        n_draining = 0
         try:
-            for _ in self.worker_ids:
+            for _ in targets:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     break
                 reply_bytes = self.hub.pop_prediction(qid, remaining)
                 if reply_bytes is None:
                     break
-                reply = unpack_message(reply_bytes)
+                try:
+                    reply = unpack_message(reply_bytes)
+                    if not isinstance(reply, dict):
+                        raise ValueError("reply is not a mapping")
+                except Exception:  # rafiki: noqa[silent-except] — a
+                    # corrupted reply (torn write, chaos injection) is
+                    # one replica's bad answer, not a request failure:
+                    # skip it and keep gathering the others
+                    errors.append("undecodable reply payload")
+                    continue
+                wid_r = str(reply.get("worker_id") or "")
+                if wid_r:
+                    # any decodable reply — answer OR structured error
+                    # — proves the worker is alive and responsive
+                    answered.add(wid_r)
+                    self.breakers.record_success(wid_r)
                 if reply.get("error"):
                     # error replies are NOT controller answers: a
                     # fast-failing replica must not drag the learned
@@ -222,14 +343,17 @@ class Predictor:
                     # but-slower replicas would get shed while requests
                     # 504 on a 'fully answering' fleet)
                     errors.append(str(reply["error"]))
+                    if reply.get("draining") and wid_r:
+                        # voluntary drain (rolling restart): stop
+                        # scattering to it until its stats say otherwise
+                        n_draining += 1
+                        self.breakers.set_draining(wid_r, True)
                     continue
                 reply_lat = time.monotonic() - t0
                 with self._lock:  # controller signal: scatter→ANSWER
                     self._reply_lat.append(reply_lat)
                 self._h_reply.observe(reply_lat)
-                self.traces.add_span(
-                    tid, "reply",
-                    worker=str(reply.get("worker_id") or ""))
+                self.traces.add_span(tid, "reply", worker=wid_r)
                 per_worker.append(list(reply["predictions"]))
         finally:
             # drop the reply queue even on a gather error: late answers
@@ -281,18 +405,78 @@ class Predictor:
                 # learned budget works again — explicit-timeout traffic
                 # answering must not starve the 3-miss flush
                 self._gather_misses = 0
+        # breaker feed: a scattered-to worker that never replied inside
+        # the budget is a miss — but only when the budget was a real
+        # liveness test (see BREAKER_MIN_TIMEOUT_S): misses under a
+        # collapsed adaptive budget are the controller shedding
+        # stragglers, not the fleet dying
+        if timeout >= self.BREAKER_MIN_TIMEOUT_S:
+            for wid in targets:
+                if wid not in answered:
+                    self.breakers.record_failure(wid)
         self.traces.add_span(tid, "done", answered=len(per_worker),
                              latency_s=round(latency, 4))
         info = {"workers_answered": len(per_worker),
-                "workers_asked": len(self.worker_ids),
+                "workers_asked": len(targets),
+                "workers_skipped": len(self.worker_ids) - len(targets),
                 "latency_s": latency, "errors": errors,
                 "trace_id": tid}
+        if not per_worker and errors and n_draining == len(errors):
+            # every reply was a drain rejection (rolling restart caught
+            # mid-window): tell the client WHEN retrying helps instead
+            # of a bare 504 — the HTTP front maps this to 503
+            info["fast_fail"] = True
+            info["retry_after_s"] = round(
+                max(1.0, self.breakers.retry_after_s()), 3)
         return ensemble_predictions(per_worker), info
+
+    def _pick_stream_worker(self, exclude=()) -> Optional[str]:
+        """Round-robin over CLOSED (healthy, non-draining) workers,
+        minus workers this stream already failed on. With no closed
+        candidate, at most ONE due open breaker is probed — unlike the
+        unary path's ``targets()``, a stream sends traffic to a single
+        worker, so flipping every due breaker to half-open would record
+        probes nobody scatters to. None when no candidate exists (the
+        resumable-error path)."""
+        if self.breakers.any_draining():
+            self._refresh_excluded_workers()  # rate-limited
+        snap = self.breakers.snapshot()
+        closed = [w for w in self.worker_ids
+                  if w not in exclude
+                  and snap.get(w, {}).get("state") == CLOSED
+                  and not snap.get(w, {}).get("draining")]
+        if closed:
+            with self._lock:
+                rr = self._rr
+                self._rr += 1
+            return closed[rr % len(closed)]
+        for attempt in (0, 1):
+            for w in self.worker_ids:
+                if w not in exclude and self.breakers.allow(w):
+                    return w  # this stream IS the half-open probe
+            if attempt == 0:
+                # drained workers re-admit themselves via fresh stats
+                self._refresh_excluded_workers(force=True)
+        return None
+
+    def _resumable_final(self, acc: Dict[int, str], n_queries: int,
+                         error: str, qid: str, tid: str) -> Dict:
+        """The structured terminal event for a stream that could not be
+        failed over: the client SDK holds (qid + accumulated text) and
+        can auto-resume by re-requesting with ``resume`` once
+        ``retry_after_s`` elapses."""
+        self._c_resumable.inc()
+        return {"done": True, "error": error, "resumable": True,
+                "qid": qid, "trace_id": tid,
+                "retry_after_s": round(
+                    max(0.05, self.breakers.retry_after_s()), 3),
+                "partial": [acc.get(i) for i in range(n_queries)]}
 
     def predict_stream(self, queries: Sequence[Any],
                        timeout: Optional[float] = None,
                        sampling: Optional[Dict] = None,
-                       trace_id: Optional[str] = None):
+                       trace_id: Optional[str] = None,
+                       resume_partial: Optional[Sequence[Any]] = None):
         """Streaming generation: yield per-query text deltas as the
         decode loop produces them, then a final event.
 
@@ -313,103 +497,212 @@ class Predictor:
         ``timeout`` bounds the WHOLE stream; default
         ``STREAM_TIMEOUT`` (not ``gather_timeout``, which is sized for
         unary request/response — a generation legitimately runs for
-        minutes)."""
+        minutes).
+
+        **Failover**: a dead/stale worker mid-stream (circuit-breaker
+        trip or ``stream_silence_timeout_s`` of reply silence — never
+        the whole-stream timeout) re-submits the request to a healthy
+        worker with the already-emitted text as a forced prefix; the
+        engine re-ingests it through chunked prefill and the stream
+        resumes without duplicating or losing text. When no healthy
+        worker exists the terminal event is a structured *resumable*
+        error (``resumable`` + ``qid`` + ``partial`` +
+        ``retry_after_s``) the client SDK can auto-resume via
+        ``resume_partial`` — which is also the server side of a
+        client-driven resume."""
         t0 = time.monotonic()
         timeout = self.STREAM_TIMEOUT if timeout is None else timeout
-        qid = uuid.uuid4().hex
         tid = sanitize_trace_id(trace_id) or mint_trace_id()
         deadline = t0 + timeout
-        with self._lock:
-            wid = self.worker_ids[self._rr % len(self.worker_ids)]
-            self._rr += 1
-        self.traces.start(tid, request_id=qid, span="received",
-                          n_queries=len(queries), stream=True,
-                          worker=wid)
-        payload = {"id": qid, "queries": _stack(queries), "stream": True,
-                   "deadline_ts": time.time() + timeout,
-                   "trace_id": tid}
-        if sampling:
-            payload["sampling"] = dict(sampling)
         # accumulated text per query index — the final predictions
         # message may carry tokens never sent as deltas (the request
-        # finished mid-fused-step); the tail is emitted before "done"
+        # finished mid-fused-step); the tail is emitted before "done".
+        # A client resume seeds it with the partial text the previous
+        # stream delivered (the failover machinery re-used end to end).
         acc: Dict[int, str] = {}
+        if resume_partial:
+            for i, p in enumerate(list(resume_partial)[:len(queries)]):
+                if isinstance(p, str) and p:
+                    acc[i] = p
+        self.traces.start(tid, request_id="", span="received",
+                          n_queries=len(queries), stream=True,
+                          resumed=bool(acc))
         final: Optional[Dict[str, Any]] = None
+        qid = ""
+        tried: set = set()
+        attempts = 0
         try:
-            try:
-                self.hub.arm_reply_ttl(
-                    qid, timeout + EXPIRY_SKEW_TOLERANCE_S + 30.0)
-            except Exception:  # rafiki: noqa[silent-except] —
-                pass           # the TTL is defense-in-depth
-            self.hub.push_query(wid, pack_message(payload))
-            while True:
+            while final is None:  # one iteration per scatter attempt
+                if attempts > self.max_stream_failovers:
+                    final = self._resumable_final(
+                        acc, len(queries),
+                        "stream failover limit reached", qid, tid)
+                    break
+                wid = self._pick_stream_worker(tried)
+                if wid is None:
+                    final = self._resumable_final(
+                        acc, len(queries),
+                        "no healthy worker available", qid, tid)
+                    break
+                if qid:  # leaving a previous attempt's reply queue
+                    try:
+                        self.hub.discard_prediction_queue(qid)
+                    except Exception:  # rafiki: noqa[silent-except] —
+                        pass           # cleanup is best-effort
+                attempts += 1
+                qid = uuid.uuid4().hex
                 remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    final = {"done": True, "error": "stream timed out",
-                             "partial": [acc.get(i)
-                                         for i in range(len(queries))]}
-                    break
-                reply_bytes = self.hub.pop_prediction(qid, remaining)
-                if reply_bytes is None:
-                    continue  # pop_prediction timed out early; re-check
-                reply = unpack_message(reply_bytes)
-                if reply.get("error"):
-                    # same terminal contract as the timeout branch: the
-                    # client learns what streamed text is authoritative
-                    final = {"done": True, "error": str(reply["error"]),
-                             "partial": [acc.get(i)
-                                         for i in range(len(queries))]}
-                    break
-                if "delta" in reply:
-                    d = {int(k): str(v)
-                         for k, v in dict(reply["delta"]).items()}
-                    if not acc:  # first streamed token(s) of the request
-                        self.traces.add_span(tid, "first_delta")
-                    for k, v in d.items():
-                        acc[k] = acc.get(k, "") + v
-                    yield {"delta": {str(k): v for k, v in d.items()}}
-                    continue
-                preds = list(reply.get("predictions") or [])
-                tail: Dict[str, str] = {}
-                replace: Dict[str, str] = {}
-                for qi, full in enumerate(preds):
-                    sent = acc.get(qi, "")
-                    if not isinstance(full, str) or full == sent:
+                payload = {"id": qid, "queries": _stack(queries),
+                           "stream": True,
+                           "deadline_ts": time.time() + remaining,
+                           "ttl_s": float(remaining),
+                           "sent_ts": time.time(), "trace_id": tid}
+                if sampling:
+                    payload["sampling"] = dict(sampling)
+                fp = {str(i): t for i, t in acc.items() if t}
+                if fp:
+                    # the failover worker re-ingests the delivered text
+                    # as a forced prompt prefix and continues the
+                    # stream past it (TextDecodeEngine.submit)
+                    payload["forced_prefix"] = fp
+                try:
+                    self.hub.arm_reply_ttl(
+                        qid, remaining + EXPIRY_SKEW_TOLERANCE_S + 30.0)
+                except Exception:  # rafiki: noqa[silent-except] —
+                    pass           # the TTL is defense-in-depth
+                self.hub.push_query(wid, pack_message(payload))
+                self.traces.add_span(
+                    tid, "scattered" if attempts == 1 else "failover",
+                    worker=wid, request_id=qid)
+                last_event = time.monotonic()
+                failover_reason = ""
+                saw_event = False  # any reply bytes from this worker
+                while True:  # one attempt's event loop
+                    now = time.monotonic()
+                    remaining = deadline - now
+                    if remaining <= 0:
+                        final = {"done": True,
+                                 "error": "stream timed out",
+                                 "partial": [acc.get(i) for i in
+                                             range(len(queries))]}
+                        break
+                    silence_left = (last_event
+                                    + self.stream_silence_timeout_s
+                                    - now)
+                    if silence_left <= 0:
+                        failover_reason = "reply silence"
+                        break
+                    if self.breakers.state(wid) == OPEN:
+                        # concurrent traffic (or the staleness feed)
+                        # already declared this worker dead — don't
+                        # wait out our own silence window
+                        failover_reason = "breaker open"
+                        break
+                    # bounded pop: wake at least once per second so a
+                    # breaker trip is noticed promptly even while the
+                    # silence budget is long
+                    reply_bytes = self.hub.pop_prediction(
+                        qid, min(remaining, silence_left, 1.0))
+                    if reply_bytes is None:
+                        continue  # re-check timeout/silence/breaker
+                    saw_event = True
+                    try:
+                        reply = unpack_message(reply_bytes)
+                        if not isinstance(reply, dict):
+                            raise ValueError("reply is not a mapping")
+                    except Exception:  # rafiki: noqa[silent-except]
+                        # — a corrupted payload from this worker is a
+                        # failover trigger, not a dead stream
+                        failover_reason = "undecodable reply"
+                        break
+                    if reply.get("error"):
+                        if reply.get("draining"):
+                            # voluntary drain rejection: route the
+                            # stream elsewhere, no breaker penalty
+                            self.breakers.set_draining(wid, True)
+                            failover_reason = "worker draining"
+                            break
+                        # same terminal contract as the timeout branch:
+                        # the client learns what text is authoritative
+                        final = {"done": True,
+                                 "error": str(reply["error"]),
+                                 "partial": [acc.get(i) for i in
+                                             range(len(queries))]}
+                        break
+                    last_event = time.monotonic()
+                    self.breakers.record_success(wid)
+                    if "delta" in reply:
+                        d = {int(k): str(v)
+                             for k, v in dict(reply["delta"]).items()}
+                        if not acc:  # first streamed token(s)
+                            self.traces.add_span(tid, "first_delta")
+                        for k, v in d.items():
+                            acc[k] = acc.get(k, "") + v
+                        yield {"delta": {str(k): v
+                                         for k, v in d.items()}}
                         continue
-                    if full.startswith(sent):
-                        tail[str(qi)] = full[len(sent):]
-                    else:  # streamed prefix diverged (shouldn't happen
-                        # with append-only poll_partial; authoritative
-                        # text wins, flagged as replace — NOT a delta a
-                        # concatenating client would double-count)
-                        replace[str(qi)] = full
-                if tail:
-                    yield {"delta": tail}
-                if replace:
-                    yield {"replace": replace}
-                latency = time.monotonic() - t0
-                final = {"done": True, "predictions": preds,
-                         "info": {"worker_id": reply.get("worker_id"),
-                                  "latency_s": latency,
-                                  "trace_id": tid}}
-                self._c_queries.inc(len(queries))
-                self._c_requests.inc()
-                self._h_e2e.observe(latency)
-                self.traces.add_span(tid, "done",
-                                     latency_s=round(latency, 4))
-                with self._lock:
-                    self._latencies.append(latency)
-                break
+                    preds = list(reply.get("predictions") or [])
+                    tail: Dict[str, str] = {}
+                    replace: Dict[str, str] = {}
+                    for qi, full in enumerate(preds):
+                        sent = acc.get(qi, "")
+                        if not isinstance(full, str) or full == sent:
+                            continue
+                        if full.startswith(sent):
+                            tail[str(qi)] = full[len(sent):]
+                        else:  # streamed prefix diverged (shouldn't
+                            # happen with append-only poll_partial;
+                            # authoritative text wins, flagged as
+                            # replace — NOT a delta a concatenating
+                            # client would double-count)
+                            replace[str(qi)] = full
+                    if tail:
+                        yield {"delta": tail}
+                    if replace:
+                        yield {"replace": replace}
+                    latency = time.monotonic() - t0
+                    final = {"done": True, "predictions": preds,
+                             "info": {"worker_id":
+                                      reply.get("worker_id"),
+                                      "latency_s": latency,
+                                      "failovers": attempts - 1,
+                                      "trace_id": tid}}
+                    self._c_queries.inc(len(queries))
+                    self._c_requests.inc()
+                    self._h_e2e.observe(latency)
+                    self.traces.add_span(tid, "done",
+                                         latency_s=round(latency, 4))
+                    with self._lock:
+                        self._latencies.append(latency)
+                    break
+                if final is None:
+                    # this attempt's worker is gone: penalize it and
+                    # re-submit with the delivered text as the prefix.
+                    # Silence from a worker that never sent ANYTHING is
+                    # ambiguous — a long prefill queued behind busy
+                    # slots looks identical to death — so only a
+                    # proven-then-silent worker feeds the breaker
+                    # (saturation must not cascade into fast-fail 503s
+                    # for unary traffic)
+                    self._c_failover.inc()
+                    if failover_reason != "worker draining" and \
+                            saw_event:
+                        self.breakers.record_failure(wid)
+                    tried.add(wid)
+                    self.traces.add_span(tid, "worker_lost",
+                                         worker=wid,
+                                         reason=failover_reason)
         except Exception as e:  # noqa: BLE001 — the SSE response is
             # already committed (200 + headers) when this generator
             # runs, so errors can't become an HTTP status: every
             # failure mode must surface as a terminal done event
             final = {"done": True, "error": f"{type(e).__name__}: {e}"}
         finally:
-            try:
-                self.hub.discard_prediction_queue(qid)
-            except Exception:  # rafiki: noqa[silent-except] —
-                pass           # cleanup is best-effort
+            if qid:
+                try:
+                    self.hub.discard_prediction_queue(qid)
+                except Exception:  # rafiki: noqa[silent-except] —
+                    pass           # cleanup is best-effort
         yield final
 
     def stats(self) -> Dict[str, Any]:
@@ -447,6 +740,11 @@ class Predictor:
                 # gather_timeout when adaptive gathering is off/warming)
                 "gather_deadline_s": self._gather_deadline_s(),
                 "adaptive_gather": self.adaptive_gather,
+                # per-worker circuit-breaker state + fault counters
+                # (trips/recoveries ride /metrics too)
+                "breakers": self.breakers.snapshot(),
+                "stream_failovers": int(self._c_failover.value),
+                "requests_fast_failed": int(self._c_fast_fail.value),
                 # per-worker published counters (drop accounting, decode-
                 # engine stats): a worker silently dropping expired
                 # queries shows up HERE, not as mystery timeouts
@@ -462,7 +760,14 @@ class Predictor:
         uptime hasn't moved for longer than its budget is stale (dead,
         hung, or partitioned) — wall-clock ``published_at`` is kept in
         the payload for humans but no longer gates anything. Workers
-        predating ``uptime_s`` fall back to the wall-clock test."""
+        predating ``uptime_s`` fall back to the wall-clock test.
+
+        The verdict also feeds the circuit-breaker board: a stale
+        worker force-opens its breaker (the staleness signal is the
+        liveness ground truth the gather-miss heuristic approximates),
+        and the published ``draining`` flag sets/clears the board's
+        drain exclusion — a respawned worker's fresh stats are what
+        re-admit its id after a rolling restart."""
         s = dict(s)
         now = time.monotonic()
         up = s.get("uptime_s")
@@ -485,6 +790,10 @@ class Predictor:
             s["stale"] = bool(
                 isinstance(pub, (int, float))
                 and time.time() - float(pub) > budget)
+        if s["stale"]:
+            self.breakers.record_stale(wid)
+        if "draining" in s:
+            self.breakers.set_draining(wid, bool(s["draining"]))
         return s
 
 
@@ -580,6 +889,16 @@ class PredictorService:
             sampling=sampling if isinstance(sampling, dict) else None,
             trace_id=self._trace_header(headers))
         if info["workers_answered"] == 0:
+            if info.get("fast_fail"):
+                # structured 503: every breaker open (or the whole
+                # fleet draining) — the client is told when retrying
+                # can possibly help instead of burning its own timeout
+                return 503, {"error": info["errors"][0]
+                             if info.get("errors")
+                             else "no worker available",
+                             "retry_after_s": info.get("retry_after_s",
+                                                       1.0),
+                             "info": info}
             return 504, {"error": "no worker answered in time",
                          "info": info}
         return 200, {"predictions": preds, "info": info}
@@ -594,10 +913,16 @@ class PredictorService:
         if not ok:
             return 400, {"error": timeout}
         sampling = (body or {}).get("sampling")
+        resume = (body or {}).get("resume")
+        if resume is not None and not isinstance(resume, list):
+            return 400, {"error": "resume must be a list of partial "
+                                  "texts (one per query, null for "
+                                  "none)"}
         events = self.predictor.predict_stream(
             queries, timeout=timeout,
             sampling=sampling if isinstance(sampling, dict) else None,
-            trace_id=self._trace_header(headers))
+            trace_id=self._trace_header(headers),
+            resume_partial=resume)
 
         def sse():
             import json as _json
@@ -633,7 +958,16 @@ def main(argv: Optional[list] = None) -> int:
                           gather_timeout=float(cfg.get("gather_timeout",
                                                        30.0)),
                           adaptive_gather=bool(
-                              cfg.get("adaptive_gather")))
+                              cfg.get("adaptive_gather")),
+                          breaker_fail_threshold=int(
+                              cfg.get("breaker_fail_threshold", 3)),
+                          breaker_cooldown_s=float(
+                              cfg.get("breaker_cooldown_s", 2.0)),
+                          stream_silence_timeout_s=float(
+                              cfg.get("stream_silence_timeout_s",
+                                      30.0)),
+                          max_stream_failovers=int(
+                              cfg.get("max_stream_failovers", 2)))
     svc = PredictorService(predictor, cfg.get("host", "127.0.0.1"),
                            int(cfg.get("port", 0)))
     host, port = svc.start()

@@ -210,12 +210,30 @@ def http_error(status: int, message: str) -> _HttpError:
 
 # ---- client side -----------------------------------------------------------
 
+class HttpStatusError(RuntimeError):
+    """A non-2xx HTTP response, with the status code and decoded JSON
+    payload attached. Subclasses RuntimeError so every existing caller
+    that catches the old convention keeps working; new callers (the
+    client SDK's structured-503 retry) can inspect ``status`` and
+    ``payload`` (e.g. ``payload.get("retry_after_s")``) instead of
+    parsing the message string."""
+
+    def __init__(self, method: str, url: str, status: int,
+                 payload: Any) -> None:
+        detail = payload.get("error", payload) \
+            if isinstance(payload, dict) else payload
+        super().__init__(f"{method} {url} -> {status}: {detail}")
+        self.status = int(status)
+        self.payload = payload if isinstance(payload, dict) else {}
+
+
 def _open_request(method: str, url: str, body: Any,
                   headers: Optional[Dict[str, str]], timeout: float,
                   accept: Optional[str] = None):
-    """Open a JSON-bodied request, translating HTTPError into the
-    RuntimeError convention shared by every client in this repo.
-    Returns the live response object (caller closes)."""
+    """Open a JSON-bodied request, translating HTTPError into
+    :class:`HttpStatusError` (a RuntimeError, the convention shared by
+    every client in this repo). Returns the live response object
+    (caller closes)."""
     import urllib.error
     import urllib.request
 
@@ -234,9 +252,7 @@ def _open_request(method: str, url: str, body: Any,
             payload = json.loads(raw) if raw else {}
         except json.JSONDecodeError:
             payload = {"error": raw.decode("utf-8", "replace")}
-        raise RuntimeError(
-            f"{method} {url} -> {e.code}: {payload.get('error', payload)}"
-        ) from None
+        raise HttpStatusError(method, url, e.code, payload) from None
 
 
 def json_request(method: str, url: str, body: Any = None,

@@ -39,6 +39,44 @@ from ..serving.queues import (EXPIRY_SKEW_TOLERANCE_S, QueueHub,
                               pack_message, unpack_message)
 from ..store.param_store import ParamStore
 
+#: expiry pad for the RELATIVE (ttl_s) deadline path: the residual
+#: error there is the skew-estimator's convergence slack, not raw
+#: cross-host clock skew, so it is a fraction of the wall-clock
+#: EXPIRY_SKEW_TOLERANCE_S it replaces
+TTL_EXPIRY_PAD_S = 0.5
+
+
+class ClockSkewEstimator:
+    """Skew-compensated elapsed time since a remote wall-clock stamp.
+
+    Every scatter payload carries ``sent_ts`` (the predictor's wall
+    clock at scatter). ``now - sent_ts`` observed here is *true elapsed
+    + clock skew*; since elapsed is never negative and promptly-popped
+    queries have near-zero elapsed, the MINIMUM of those observations
+    converges on the skew itself (one-way-delay estimation, the NTP
+    trick). Subtracting it yields an elapsed estimate that is immune to
+    static cross-host skew — the failure mode where a worker clock
+    running ahead silently dropped every fresh query while the
+    predictor only saw timeouts (ADVICE r3). The estimate relaxes
+    upward very slowly so a mid-run clock step eventually re-converges
+    instead of poisoning the minimum forever."""
+
+    #: upward relaxation per observation (dimensionless fraction of the
+    #: gap): ~460 observations to close 99% of a step — minutes of
+    #: traffic, versus never
+    RELAX = 0.01
+
+    def __init__(self) -> None:
+        self._est: Optional[float] = None
+
+    def elapsed_since(self, sent_ts: float) -> float:
+        obs = time.time() - float(sent_ts)  # true elapsed + skew
+        if self._est is None or obs < self._est:
+            self._est = obs
+        else:
+            self._est += self.RELAX * (obs - self._est)
+        return obs - self._est
+
 
 class InferenceWorker:
     def __init__(self, model_class: Type[BaseModel], trial_id: str,
@@ -50,20 +88,51 @@ class InferenceWorker:
                  extra_adapter_trials: Optional[List[str]] = None,
                  draft_trial_id: str = "",
                  draft_knobs: Optional[dict] = None,
-                 kv_page_size: int = 0, kv_pages: int = 0) -> None:
+                 kv_page_size: int = 0, kv_pages: int = 0,
+                 chaos: Optional[Any] = None) -> None:
         self.worker_id = worker_id
         self.hub = hub
         self.max_batch_msgs = max_batch_msgs
         #: visible drop accounting: silent expiry drops look identical to
         #: gather timeouts from the predictor side, so the worker keeps
         #: its own count (and logs) — the first diagnostic to check when
-        #: "the predictor only sees timeouts" (clock skew, ADVICE r3)
-        self.stats = StatsMap({"dropped_expired": 0})
+        #: "the predictor only sees timeouts" (clock skew, ADVICE r3).
+        #: drain_rejected counts messages error-replied while draining.
+        self.stats = StatsMap({"dropped_expired": 0,
+                               "drain_rejected": 0})
+        #: deterministic fault injection (tests / chaos drills): either
+        #: passed programmatically or armed via the RAFIKI_CHAOS env
+        #: var; when armed, queue-level faults ride a ChaosHub wrapper
+        #: and the kill-after-N-tokens trigger is checked in the decode
+        #: loop. None (the default) costs nothing.
+        if chaos is None:
+            from ..chaos import ChaosConfig, ChaosInjector
+
+            cfg = ChaosConfig.from_env()
+            chaos = ChaosInjector(cfg) if cfg is not None else None
+        self.chaos = chaos
+        self.chaos_killed = False
+        if self.chaos is not None:
+            from ..chaos import ChaosHub
+
+            self.hub = ChaosHub(hub, self.chaos)
+        #: graceful drain: set via POST /drain on the obs sidecar or a
+        #: {"control": "drain"} queue message — stop admitting, finish
+        #: in-flight streams, publish `draining`, then exit the loop
+        self._draining = threading.Event()
+        #: skew-compensated expiry clock for the relative ttl_s
+        #: deadlines (wall deadline_ts stays as the fallback)
+        self._skew = ClockSkewEstimator()
         #: the obs plane: registry scraped at GET /metrics (serve_obs
         #: sidecar), trace ring at GET /debug/requests, and the request-
         #: lifecycle histograms the engine's span hook feeds
         self.metrics = MetricsRegistry()
         self.metrics.register_stats(self.stats)
+        if self.chaos is not None:
+            # injected faults are observable, not a mystery: chaos_*
+            # gauges ride the worker's /metrics like any counter
+            self.metrics.register_stats(self.chaos.counters,
+                                        prefix="chaos_")
         self.traces = TraceBuffer(512)
         self._boot_mono = time.monotonic()
         self._h_ttft = self.metrics.histogram(
@@ -312,14 +381,48 @@ class InferenceWorker:
             self._obs_server.stop()
             self._obs_server = None
 
+    def drain(self) -> None:
+        """Begin a graceful drain: stop admitting new requests (they
+        get an immediate structured ``draining`` rejection the
+        predictor fails over on), finish every in-flight request —
+        including streams — then exit the serve loop cleanly (the
+        process exits 0: a drained worker is a completed one, not a
+        crash to respawn). Idempotent; safe from any thread (the obs
+        sidecar's /drain handler and the queue control path both land
+        here)."""
+        if self._draining.is_set():
+            return
+        import logging
+
+        logging.getLogger(__name__).info(
+            "%s draining: finishing in-flight work, rejecting new",
+            self.worker_id)
+        self._draining.set()
+        # publish immediately so the predictor's breaker board learns
+        # of the drain from stats, not only from rejection replies
+        self._publish_stats()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
     def serve_obs(self, host: str = "127.0.0.1",
                   port: int = 0) -> Tuple[str, int]:
         """Start the observability sidecar (``GET /metrics`` Prometheus
-        text, ``GET /debug/requests?n=K`` trace records) on a daemon
-        thread; returns its (host, port). The serve loop never touches
-        it — scrapes read the same locked registry the loop writes."""
+        text, ``GET /debug/requests?n=K`` trace records, ``POST
+        /drain``) on a daemon thread; returns its (host, port). The
+        serve loop never touches it — scrapes read the same locked
+        registry the loop writes, and drain flips an Event the loop
+        polls."""
         self._obs_server = ObsServer(self.metrics, self.traces,
                                      host=host, port=port)
+        # the drain control endpoint (rolling restarts): mounted on the
+        # sidecar because the worker itself is a queue consumer with no
+        # HTTP surface of its own
+        self._obs_server.http.route(
+            "POST", "/drain",
+            lambda _m, _b, _h: (self.drain() or
+                                (200, {"ok": True, "draining": True})))
         host, port = self._obs_server.start()
         self._obs_port = port
         return host, port
@@ -342,6 +445,9 @@ class InferenceWorker:
         the live dict here used to be able to blow up with "dictionary
         changed size during iteration" under load)."""
         stats = self.stats.snapshot()
+        stats["draining"] = self._draining.is_set()  # breaker-board
+        # scatter exclusion during rolling restarts; the respawned
+        # worker's fresh False is what re-admits the id
         stats["published_at"] = time.time()  # for humans; staleness
         # rides the MONOTONIC pair below — a wall-clock step (NTP, VM
         # migration) must neither grey out a healthy worker nor let a
@@ -410,6 +516,47 @@ class InferenceWorker:
                 "between predictor and worker hosts",
                 self.worker_id, n, "y" if n == 1 else "ies", total)
 
+    def _handle_control(self, m: dict) -> None:
+        """Control messages ride the ordinary query queue (``{"control":
+        "drain"}``): the queue is the one channel every deployment
+        shape shares, HTTP sidecar or not."""
+        cmd = str(m.get("control") or "")
+        if cmd == "drain":
+            self.drain()
+        else:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "%s ignoring unknown control message %r",
+                self.worker_id, cmd)
+
+    def _reject_draining(self, m: dict) -> None:
+        """Answer a message popped while draining with an immediate
+        structured rejection: the predictor fails the request over to a
+        healthy replica instead of timing out on a queue nobody will
+        serve."""
+        if "id" not in m:
+            return
+        self.stats.inc("drain_rejected")
+        tid = str(m.get("trace_id") or "")
+        if tid:
+            self.traces.start(tid, request_id=str(m.get("id") or ""),
+                              span="drain_rejected",
+                              worker=self.worker_id)
+        self.hub.push_prediction(m["id"], pack_message(
+            {"id": m["id"], "worker_id": self.worker_id,
+             "predictions": [], "error": "worker draining",
+             "draining": True}))
+
+    def _drain_reject_queued(self) -> None:
+        """Flush the query queue with drain rejections (non-blocking)."""
+        raw = self.hub.pop_query(self.worker_id, 0.0)
+        while raw is not None:
+            m = unpack_message(raw)
+            if not m.get("control"):
+                self._reject_draining(m)
+            raw = self.hub.pop_query(self.worker_id, 0.0)
+
     # ---- the loop ----
     def run(self, poll_timeout: float = 0.5,
             max_iterations: Optional[int] = None) -> None:
@@ -422,6 +569,11 @@ class InferenceWorker:
             n += 1
             if n % self.STATS_EVERY == 1:  # incl. first iteration:
                 self._publish_stats()      # fresh boots appear at once
+            if self._draining.is_set():
+                # micro-batch serving has no in-flight state between
+                # iterations: reject what is queued and leave
+                self._drain_reject_queued()
+                break
             first = self.hub.pop_query(self.worker_id, poll_timeout)
             if first is None:
                 continue
@@ -431,9 +583,18 @@ class InferenceWorker:
                 if more is None:
                     break
                 messages.append(unpack_message(more))
-            live = [m for m in messages if not _expired(m)]
-            self._count_dropped(len(messages) - len(live))
+            serve = []
+            for m in messages:
+                if m.get("control"):
+                    self._handle_control(m)
+                else:
+                    serve.append(m)
+            live = [m for m in serve
+                    if not _expired(m, skew_est=self._skew)]
+            self._count_dropped(len(serve) - len(live))
             if live:
+                # messages popped alongside a drain control preceded
+                # the drain: they are in-flight and get served
                 self._serve_batch(live)
         self._publish_stats()  # final counters visible after stop
 
@@ -460,7 +621,18 @@ class InferenceWorker:
                                      0.0 if busy else poll_timeout)
             while raw is not None:
                 m = unpack_message(raw)
-                if _expired(m):
+                if m.get("control"):
+                    self._handle_control(m)
+                    raw = self.hub.pop_query(self.worker_id, 0.0)
+                    continue
+                if self._draining.is_set():
+                    # draining: in-flight requests keep decoding below,
+                    # new arrivals get an immediate structured
+                    # rejection the predictor fails over on
+                    self._reject_draining(m)
+                    raw = self.hub.pop_query(self.worker_id, 0.0)
+                    continue
+                if _expired(m, skew_est=self._skew):
                     self._count_dropped(1)
                     tid = str(m.get("trace_id") or "")
                     if tid:  # the drop is visible in the trace, not
@@ -496,11 +668,33 @@ class InferenceWorker:
                             samp["max_new"],
                             getattr(self.engine, "max_new",
                                     samp["max_new"]))
+                    fp = m.get("forced_prefix")
+                    fp = fp if isinstance(fp, dict) else {}
+                    if fp:
+                        self.traces.add_span(
+                            tid, "resumed",
+                            prefix_chars=sum(len(str(v))
+                                             for v in fp.values()))
                     try:
+                        if fp and not getattr(self.engine,
+                                              "supports_resume",
+                                              False):
+                            # checked BEFORE any submit (a per-query
+                            # check would leak the message's earlier
+                            # queries into the engine when a later one
+                            # rejects) — and structured, never a
+                            # TypeError that kills the thread
+                            raise ValueError(
+                                "engine does not support stream "
+                                "resume (forced_prefix)")
                         for qi, text in enumerate(qs):
+                            kwargs = dict(samp)
+                            prefix = str(fp.get(str(qi), "") or "")
+                            if prefix:
+                                kwargs["forced_prefix"] = prefix
                             self._req_obs[(m["id"], qi)] = (tid, t_queued)
                             self.engine.submit((m["id"], qi), str(text),
-                                               **samp)
+                                               **kwargs)
                     except ValueError as e:
                         # e.g. adapter_id out of range on a multi-
                         # adapter engine: reject the whole message —
@@ -519,42 +713,62 @@ class InferenceWorker:
                         if m.get("stream"):
                             streaming.add(m["id"])
                 raw = self.hub.pop_query(self.worker_id, 0.0)
-            if not self.engine.busy:
-                continue
-            try:
-                n_live = self.engine.step()
-                self._h_occupancy.observe(n_live)
-            except Exception:
-                err = traceback.format_exc()
-                for mid in list(inflight):
-                    self.hub.push_prediction(mid, pack_message(
-                        {"id": mid, "worker_id": self.worker_id,
-                         "predictions": [], "error": err}))
-                    del inflight[mid]
-                streaming.clear()
-                # every in-flight request's timeline ends HERE, not in
-                # silence: the reset below preempts all occupants
-                for _rid, (tid, _t) in list(self._req_obs.items()):
-                    self.traces.add_span(tid, "preempted",
-                                         error="engine step failed")
-                self._req_obs.clear()
-                # a failed step may have consumed the donated cache:
-                # drop every occupant and rebuild device state, or the
-                # loop hot-spins on a permanently broken engine
-                self.engine.reset()
-                continue
-            if streaming and hasattr(self.engine, "poll_partial"):
-                # per-message delta events between steps: the reply
-                # queue carries them ahead of the final predictions
-                # message (pushes are FIFO per query id)
-                deltas: dict = {}
-                for (mid, qi), delta in self.engine.poll_partial():
-                    if mid in streaming:
-                        deltas.setdefault(mid, {})[str(qi)] = delta
-                for mid, d in deltas.items():
-                    self.hub.push_prediction(mid, pack_message(
-                        {"id": mid, "worker_id": self.worker_id,
-                         "delta": d}))
+            stepped = self.engine.busy
+            if stepped:
+                try:
+                    n_live = self.engine.step()
+                    self._h_occupancy.observe(n_live)
+                except Exception:
+                    err = traceback.format_exc()
+                    for mid in list(inflight):
+                        self.hub.push_prediction(mid, pack_message(
+                            {"id": mid, "worker_id": self.worker_id,
+                             "predictions": [], "error": err}))
+                        del inflight[mid]
+                    streaming.clear()
+                    # every in-flight request's timeline ends HERE, not
+                    # in silence: the reset below preempts all occupants
+                    for _rid, (tid, _t) in list(self._req_obs.items()):
+                        self.traces.add_span(tid, "preempted",
+                                             error="engine step failed")
+                    self._req_obs.clear()
+                    # a failed step may have consumed the donated cache:
+                    # drop every occupant and rebuild device state, or
+                    # the loop hot-spins on a permanently broken engine
+                    self.engine.reset()
+                    continue
+                if self.chaos is not None and self.chaos.should_kill(
+                        int(self.engine.stats.get("tokens_generated",
+                                                  0) or 0)):
+                    # injected sudden death: exit WITHOUT replying,
+                    # streaming, or publishing — exactly what a killed
+                    # process looks like to the rest of the stack (the
+                    # fused step that crossed the threshold never gets
+                    # its tokens out)
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "%s chaos-killed after %s generated tokens",
+                        self.worker_id,
+                        self.chaos.cfg.kill_after_tokens)
+                    self.chaos_killed = True
+                    return
+                if streaming and hasattr(self.engine, "poll_partial"):
+                    # per-message delta events between steps: the reply
+                    # queue carries them ahead of the final predictions
+                    # message (pushes are FIFO per query id)
+                    deltas: dict = {}
+                    for (mid, qi), delta in self.engine.poll_partial():
+                        if mid in streaming:
+                            deltas.setdefault(mid, {})[str(qi)] = delta
+                    for mid, d in deltas.items():
+                        self.hub.push_prediction(mid, pack_message(
+                            {"id": mid, "worker_id": self.worker_id,
+                             "delta": d}))
+            # harvest runs even when the engine is idle: a resume whose
+            # forced prefix covered the whole token budget completes
+            # without ever occupying a slot (TextDecodeEngine's
+            # instant-done path)
             for (mid, qi), text in self.engine.poll():
                 entry = inflight.get(mid)
                 if entry is None:
@@ -565,8 +779,14 @@ class InferenceWorker:
                     self.hub.push_prediction(mid, pack_message(
                         {"id": mid, "worker_id": self.worker_id,
                          "predictions": preds}))
+                    for i in range(entry[0]):  # instant-done requests
+                        # emit no engine `done` span to clear these
+                        self._req_obs.pop((mid, i), None)
                     del inflight[mid]
                     streaming.discard(mid)
+            if self._draining.is_set() and not inflight \
+                    and not self.engine.busy:
+                break  # drain complete: every in-flight stream answered
         self._publish_stats()  # final counters visible after stop
 
     def _serve_batch(self, messages: List[dict]) -> None:
@@ -665,18 +885,37 @@ def _safe_sampling(samp: Any) -> dict:
     return out
 
 
-def _expired(msg: dict, skew_s: float = EXPIRY_SKEW_TOLERANCE_S) -> bool:
+def _expired(msg: dict, skew_s: float = EXPIRY_SKEW_TOLERANCE_S,
+             skew_est: Optional[ClockSkewEstimator] = None) -> bool:
     """The predictor stamps each query with its gather deadline; a
     worker that pops it too late must drop it — the answer would land
     in a discarded reply queue and leak there forever (and the forward
-    pass would be wasted compute). ``skew_s`` pads the test because
-    deadline_ts is the PREDICTOR's wall clock (ADVICE r3): without the
-    margin, cross-machine clock skew beyond the gather timeout makes a
-    worker silently drop every query while the predictor only sees
-    timeouts. The cost is at most one wasted forward per truly-late
-    query; reply-queue TTLs are padded against the same constant."""
+    pass would be wasted compute).
+
+    **Preferred path** (payloads carrying the relative ``ttl_s`` +
+    ``sent_ts`` pair and a ``skew_est``): elapsed-since-scatter comes
+    from the :class:`ClockSkewEstimator` — cross-host wall-clock skew
+    cancels, so the pad shrinks from ``EXPIRY_SKEW_TOLERANCE_S`` to
+    ``TTL_EXPIRY_PAD_S`` and a worker clock running minutes ahead no
+    longer silently drops every fresh query.
+
+    **Fallback** (old payloads / no estimator): the wall-clock
+    ``deadline_ts`` judged on this host's clock, padded by ``skew_s``
+    because deadline_ts is the PREDICTOR's wall clock (ADVICE r3):
+    without the margin, cross-machine clock skew beyond the gather
+    timeout makes a worker silently drop every query while the
+    predictor only sees timeouts. The cost is at most one wasted
+    forward per truly-late query; reply-queue TTLs are padded against
+    the same constant."""
     import time
 
+    ttl = msg.get("ttl_s")
+    sent = msg.get("sent_ts")
+    if (skew_est is not None and ttl is not None and sent is not None
+            and isinstance(ttl, (int, float))
+            and isinstance(sent, (int, float))):
+        return skew_est.elapsed_since(float(sent)) \
+            > float(ttl) + TTL_EXPIRY_PAD_S
     ts = msg.get("deadline_ts")
     return ts is not None and time.time() > float(ts) + skew_s
 
@@ -746,6 +985,15 @@ def main(argv: Optional[list] = None) -> int:
     print(f"inference worker {worker.worker_id} serving "
           f"(obs on {obs_host}:{obs_port})", flush=True)
     worker.run()
+    if worker.chaos_killed:
+        # a chaos-killed worker must look ERRORED to the control plane
+        # (non-zero rc → ServicesManager respawns it), not drained
+        print(f"inference worker {worker.worker_id} chaos-killed",
+              flush=True)
+        return 31
+    if worker.draining:
+        print(f"inference worker {worker.worker_id} drained cleanly",
+              flush=True)
     return 0
 
 

@@ -105,6 +105,37 @@ def test_normal_exit_is_not_respawned(mgr_and_job):
     assert (ServiceType.TRAIN_WORKER, job["id"]) not in mgr._respawn_counts
 
 
+def test_respawn_budget_exhaustion_surfaces_degraded(mgr_and_job):
+    """The pending-respawn drop / budget-exhaustion path must not be
+    just a log line: the job shows up in respawn_stats/degraded_jobs
+    (what the admin /health exposes) and — with no workers left — its
+    store row flips to ERRORED (what the dashboard's status column
+    renders)."""
+    import subprocess
+
+    from rafiki_tpu.admin.services_manager import ManagedService
+
+    mgr, meta, job = mgr_and_job
+    mgr.max_respawns = 0  # healing budget already spent
+    proc = subprocess.Popen(["/bin/false"])
+    proc.wait()
+    row = meta.create_service(ServiceType.TRAIN_WORKER, host="", port=0,
+                              pid=proc.pid, train_job_id=job["id"])
+    mgr.services[row["id"]] = ManagedService(
+        row["id"], ServiceType.TRAIN_WORKER, proc)
+    mgr._respawn_specs[row["id"]] = {
+        "module": "rafiki_tpu.worker.train", "config": {},
+        "service_type": ServiceType.TRAIN_WORKER, "needs_slot": False,
+        "meta_kwargs": {"train_job_id": job["id"]}}
+    mgr.poll()
+    stats = mgr.respawn_stats()
+    assert stats["degraded_jobs"] == 1
+    assert "respawn budget exhausted" in \
+        mgr.degraded_jobs()[job["id"]]
+    # last worker gone + healing gone = the job is dead, not degraded
+    assert meta.get_train_job(job["id"])["status"] == "ERRORED"
+
+
 def test_slotless_respawn_queued_and_retried(mgr_and_job):
     import subprocess
 
