@@ -701,6 +701,66 @@ def _bench_advisor(out_path: str, n_trials: int) -> None:
     })
 
 
+#: trials/hour of the sequential advisor stage as committed by the
+#: round that measured it (`advisor_trials_per_hour`, cpu fallback) —
+#: the denominator ISSUE 8's ≥10× gang target is defined against
+_SEQ_ADVISOR_BASELINE_TPH = 892.0
+
+
+def _bench_advisor_gang(out_path: str) -> None:
+    """Gang-compiled trials/hour on the MLP template vs the sequential
+    892/h baseline. Apples-to-apples: the random advisor (every trial a
+    full-budget train, same dataset sizes as the sequential stage) with
+    the shape knobs pinned so all lanes share one static bucket; a
+    fresh 4-trial sequential sample is timed alongside as an on-rig
+    denominator next to the committed baseline."""
+    import tempfile
+
+    import jax
+
+    from rafiki_tpu.advisor import make_advisor
+    from rafiki_tpu.data import generate_image_classification_dataset
+    from rafiki_tpu.model import tune_model
+    from rafiki_tpu.models.mlp import JaxFeedForward
+    from rafiki_tpu.tuning import GangEngine
+
+    backend = jax.default_backend()
+    gang_size = 16
+    n_trials = 64
+    pins = {"hidden_layer_count": 2, "hidden_layer_units": 64,
+            "batch_size": 64}
+    with tempfile.TemporaryDirectory() as d:
+        tr, va = f"{d}/tr.npz", f"{d}/va.npz"
+        generate_image_classification_dataset(tr, 512, seed=0)
+        generate_image_classification_dataset(va, 128, seed=1)
+        seq_n = 4
+        t0 = time.monotonic()
+        tune_model(JaxFeedForward, tr, va, total_trials=seq_n,
+                   advisor_type="random", seed=1, knob_overrides=pins)
+        seq_tph = seq_n / (time.monotonic() - t0) * 3600.0
+        adv = make_advisor(JaxFeedForward.get_knob_config(), "random",
+                           total_trials=n_trials, seed=0)
+        eng = GangEngine(JaxFeedForward, adv, tr, va,
+                         gang_size=gang_size, mode="gang",
+                         knob_overrides=pins)
+        t0 = time.monotonic()
+        results = eng.run()
+        dt = time.monotonic() - t0
+    tph = len(results) / dt * 3600.0
+    best = adv.best_effort
+    _record(out_path, {
+        "stage": "advisor_gang", "backend": backend,
+        "gang_size": gang_size, "n_trials": len(results),
+        "search_s": dt, "trials_per_hour": tph,
+        "baseline_trials_per_hour": _SEQ_ADVISOR_BASELINE_TPH,
+        "speedup_vs_baseline": tph / _SEQ_ADVISOR_BASELINE_TPH,
+        "seq_sample_trials_per_hour": seq_tph,
+        "speedup_vs_seq_sample": tph / max(seq_tph, 1e-9),
+        "static_buckets": eng.n_buckets,
+        "compiles": sum(eng.compile_counts().values()),
+        "best_score": float(best.score) if best else -1.0})
+
+
 def _bench_failover(out_path: str) -> None:
     """Kill one worker mid-stream under load and measure what the
     client experiences: the stream-gap (longest silence between
@@ -875,6 +935,13 @@ def _child(out_path: str, budget: float, use_kv: bool) -> None:
             _bench_advisor(out_path, n_trials=6)
         except Exception as e:  # noqa: BLE001
             _record(out_path, {"stage": "advisor_error",
+                               "error": repr(e)[:300]})
+
+    if budget - (time.monotonic() - t_start) > 60:
+        try:
+            _bench_advisor_gang(out_path)
+        except Exception as e:  # noqa: BLE001
+            _record(out_path, {"stage": "advisor_gang_error",
                                "error": repr(e)[:300]})
 
     if budget - (time.monotonic() - t_start) > 60:
@@ -1093,6 +1160,20 @@ def main() -> None:
             "unit": "trials/hour", "backend": adv["backend"],
             "n_trials": adv["n_trials"],
             "best_score": adv["best_score"]}))
+    ag = next((r for r in records if r.get("stage") == "advisor_gang"),
+              None)
+    if ag:
+        print(json.dumps({
+            "metric": "gang_trials_per_hour",
+            "value": round(ag["trials_per_hour"], 1),
+            "unit": "trials/hour", "backend": ag["backend"],
+            "gang_size": ag["gang_size"], "n_trials": ag["n_trials"],
+            "speedup_vs_baseline": round(ag["speedup_vs_baseline"], 2),
+            "seq_sample_trials_per_hour": round(
+                ag["seq_sample_trials_per_hour"], 1),
+            "static_buckets": ag["static_buckets"],
+            "compiles": ag["compiles"],
+            "best_score": ag["best_score"]}))
     if not pred and not gen and not adv:
         print(json.dumps({"metric": "bench_extra_error", "value": 0.0,
                           "unit": "", "errors": collect_errors(records)}))

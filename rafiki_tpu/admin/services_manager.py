@@ -256,9 +256,11 @@ class ServicesManager:
         # a knob_overrides key that matches NO model's knob config is a
         # typo: fail before spawning anything rather than silently running
         # the full search on the dimension the user believes is pinned
-        requested = set((job["train_args"].get("knob_overrides") or {}))
+        # (same validator as tune_model's dev loop — model/knob.py)
+        requested = job["train_args"].get("knob_overrides") or {}
         if requested:
             from ..model.base import load_model_class
+            from ..model.knob import validate_override_keys
 
             known: set = set()
             for sub in subs:
@@ -266,11 +268,9 @@ class ServicesManager:
                 known |= set(load_model_class(
                     model["model_bytes"],
                     model["model_class"]).get_knob_config())
-            unknown = requested - known
-            if unknown:
-                raise ValueError(
-                    f"knob_overrides {sorted(unknown)} match no knob of "
-                    f"any model in this job (known: {sorted(known)})")
+            validate_override_keys(
+                known, requested,
+                context="knob_overrides for this job's models:")
 
         spawned: List[ManagedService] = []
         for sub in subs:
@@ -334,6 +334,10 @@ class ServicesManager:
                      "sub_train_job_id": sub["id"],
                      "profile_dir": profile_dir,
                      "knob_overrides": overrides,
+                     # gang trial mode: K trials per compiled step on
+                     # this worker's sub-mesh (small-zoo templates)
+                     "gang_size": int(job["train_args"].get(
+                         "gang_size") or 0),
                      "checkpoint_interval_s": job["train_args"].get(
                          "checkpoint_interval_s", 30.0),
                      "worker_id": f"tw-{sub['id'][:8]}-{w}",

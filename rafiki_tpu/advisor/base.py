@@ -16,7 +16,7 @@ import random
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..model.knob import (KnobConfig, Knobs, knob_config_from_json,
                           knob_config_to_json)
@@ -127,16 +127,52 @@ class BaseAdvisor:
             self._outstanding[proposal.trial_no] = proposal
             return proposal
 
+    def propose_batch(self, k: int) -> List[Proposal]:
+        """Up to ``k`` proposals under ONE lock acquisition — the gang
+        engine's lane-fill primitive.
+
+        Atomicity is the determinism guarantee: no concurrent worker can
+        interleave a propose/feedback between batch members, so for a
+        given advisor seed and feedback history the batch equals ``k``
+        sequential :meth:`propose` calls exactly — same knob sets
+        regardless of lane count (tier-1 asserts this for the random and
+        BOHB advisors). Returns fewer than ``k`` (possibly zero)
+        proposals when the budget runs out mid-batch."""
+        out: List[Proposal] = []
+        with self._lock:
+            for _ in range(max(0, k)):
+                if self._budget_exhausted():
+                    break
+                proposal = self._propose(self._next_trial_no)
+                if not proposal.is_valid:
+                    break
+                proposal.trial_no = self._next_trial_no
+                self._next_trial_no += 1
+                self._outstanding[proposal.trial_no] = proposal
+                out.append(proposal)
+        return out
+
     def feedback(self, result: TrialResult) -> None:
         with self._lock:
-            self._outstanding.pop(result.trial_no, None)
-            self.results.append(result)
-            # Only full-budget trials compete for "best" (a BOHB low-rung
-            # score is not comparable to a full train).
-            if result.budget_scale >= 1.0 and (
-                    self.best is None or result.score > self.best.score):
-                self.best = result
-            self._feedback(result)
+            self._feedback_locked(result)
+
+    def feedback_batch(self, results: Sequence[TrialResult]) -> None:
+        """Report a batch of completed lanes atomically (order preserved:
+        rung/posterior state sees them in the given sequence, same as
+        sequential feedback calls)."""
+        with self._lock:
+            for result in results:
+                self._feedback_locked(result)
+
+    def _feedback_locked(self, result: TrialResult) -> None:
+        self._outstanding.pop(result.trial_no, None)
+        self.results.append(result)
+        # Only full-budget trials compete for "best" (a BOHB low-rung
+        # score is not comparable to a full train).
+        if result.budget_scale >= 1.0 and (
+                self.best is None or result.score > self.best.score):
+            self.best = result
+        self._feedback(result)
 
     def trial_errored(self, trial_no: int) -> None:
         """Reference semantics: an errored trial is dropped and the budget

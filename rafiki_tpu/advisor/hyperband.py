@@ -16,6 +16,17 @@ warm-starts from its own lower-rung checkpoint via
 ``warm_start_trial_id`` — which maps BOHB rungs directly onto the
 ParamStore's share/resume machinery (SURVEY.md §5.3/§5.4: rungs pair
 naturally with checkpointed, preemptible trials).
+
+Gang/batched use: the base class's atomic ``propose_batch`` /
+``feedback_batch`` drive the same ``_propose``/``_feedback`` hooks, so
+per-lane rung state (``_by_trial_no``) is registered for every batch
+member before any lane trains and promotion decisions are identical to
+the sequential call sequence. ASHA's async promotion rule is what makes
+in-place lane culling sound: a lane's trial finishes its rung, the
+batch feedback lands, and the very next ``propose_batch`` may hand back
+a promotion of that trial (same knobs, higher budget, warm start) —
+which the gang engine maps onto "keep the lane's params, reset its
+optimizer" with no recompile.
 """
 
 from __future__ import annotations
@@ -228,14 +239,21 @@ class BOHBAdvisor(BaseAdvisor):
         from scipy.stats import gaussian_kde
 
         n_top = max(2, int(math.ceil(len(y) * self._tpe_top_quantile)))
+        if n_top <= x.shape[1]:
+            # a KDE over fewer points than dimensions has a singular
+            # covariance — scipy raises outright (surfaced by the gang
+            # engine's batched pulls on the 4-dim MLP space); keep
+            # exploring randomly until the top quantile outgrows the
+            # dimensionality
+            return self._np_rng.random(len(self._dims)).tolist()
         order = np.argsort(y)[::-1]
         good, bad = x[order[:n_top]], x[order[n_top:]]
         jitter = 1e-3 * self._np_rng.standard_normal(good.T.shape)
         try:
             kde_good = gaussian_kde(good.T + jitter, bw_method="scott")
             kde_bad = (gaussian_kde(bad.T, bw_method="scott")
-                       if len(bad) >= 2 else None)
-        except np.linalg.LinAlgError:
+                       if len(bad) > x.shape[1] else None)
+        except (np.linalg.LinAlgError, ValueError):
             return self._np_rng.random(len(self._dims)).tolist()
         cand = np.clip(
             kde_good.resample(self._n_candidates,

@@ -24,7 +24,9 @@ class AdvisorService:
         self.advisor = advisor
         self.http = JsonHttpService(host, port)
         self.http.route("POST", "/proposal", self._propose)
+        self.http.route("POST", "/proposal_batch", self._propose_batch)
         self.http.route("POST", "/feedback", self._feedback)
+        self.http.route("POST", "/feedback_batch", self._feedback_batch)
         self.http.route("POST", "/trial_errored", self._trial_errored)
         self.http.route("GET", "/status", self._status)
 
@@ -39,9 +41,22 @@ class AdvisorService:
                  _h: Dict[str, str]) -> Tuple[int, Any]:
         return 200, self.advisor.propose().to_json()
 
+    def _propose_batch(self, _m: Dict[str, str], body: Any,
+                       _h: Dict[str, str]) -> Tuple[int, Any]:
+        # one advisor-side lock acquisition: the batch is atomic even
+        # with multiple gang workers hitting the same service
+        batch = self.advisor.propose_batch(int(body.get("k", 1)))
+        return 200, {"proposals": [p.to_json() for p in batch]}
+
     def _feedback(self, _m: Dict[str, str], body: Any,
                   _h: Dict[str, str]) -> Tuple[int, Any]:
         self.advisor.feedback(TrialResult.from_json(body))
+        return 200, {"ok": True}
+
+    def _feedback_batch(self, _m: Dict[str, str], body: Any,
+                        _h: Dict[str, str]) -> Tuple[int, Any]:
+        self.advisor.feedback_batch(
+            [TrialResult.from_json(r) for r in body.get("results", [])])
         return 200, {"ok": True}
 
     def _trial_errored(self, _m: Dict[str, str], body: Any,
@@ -70,8 +85,18 @@ class AdvisorClient:
         return Proposal.from_json(json_request(
             "POST", f"{self.base_url}/proposal", {}, timeout=self.timeout))
 
+    def propose_batch(self, k: int) -> list:
+        body = json_request("POST", f"{self.base_url}/proposal_batch",
+                            {"k": k}, timeout=self.timeout)
+        return [Proposal.from_json(p) for p in body.get("proposals", [])]
+
     def feedback(self, result: TrialResult) -> None:
         json_request("POST", f"{self.base_url}/feedback", result.to_json(),
+                     timeout=self.timeout)
+
+    def feedback_batch(self, results: list) -> None:
+        json_request("POST", f"{self.base_url}/feedback_batch",
+                     {"results": [r.to_json() for r in results]},
                      timeout=self.timeout)
 
     def trial_errored(self, trial_no: int) -> None:

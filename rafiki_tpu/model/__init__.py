@@ -9,9 +9,10 @@ from .dev import test_model_class, tune_model, TuneResult
 from .knob import (BaseKnob, CategoricalKnob, FixedKnob, FloatKnob,
                    IntegerKnob, KnobConfig, Knobs, PolicyKnob,
                    knob_config_from_json, knob_config_to_json, sample_knobs,
-                   shape_signature, tunable_knobs, validate_knobs)
+                   shape_signature, static_signature, traceable_knobs,
+                   tunable_knobs, validate_knobs, validate_override_keys)
 from .log import LogRecord, ModelLogger
-from .loop import train_epoch
+from .loop import GangSpec, train_epoch
 from .template_utils import bucketed_forward, conform_images, \
     same_tree_shapes
 
@@ -21,6 +22,8 @@ __all__ = [
     "serialize_model_class", "test_model_class", "tune_model", "TuneResult",
     "BaseKnob", "CategoricalKnob", "FixedKnob", "FloatKnob", "IntegerKnob",
     "KnobConfig", "Knobs", "PolicyKnob", "knob_config_from_json",
-    "knob_config_to_json", "sample_knobs", "shape_signature", "tunable_knobs",
-    "validate_knobs", "LogRecord", "ModelLogger",
+    "knob_config_to_json", "sample_knobs", "shape_signature",
+    "static_signature", "traceable_knobs", "tunable_knobs",
+    "validate_knobs", "validate_override_keys", "LogRecord", "ModelLogger",
+    "GangSpec",
 ]

@@ -17,7 +17,8 @@ import numpy as np
 
 from .base import BaseModel, Params, TrainContext, serialize_model_class, \
     load_model_class
-from .knob import Knobs, sample_knobs, validate_knobs
+from .knob import Knobs, sample_knobs, validate_knobs, \
+    validate_override_keys
 from .log import ModelLogger
 
 
@@ -93,8 +94,8 @@ def tune_model(model_class: Type[BaseModel], train_dataset_path: str,
                advisor_type: str = "auto", seed: int = 0,
                keep_params: bool = True,
                profile_dir: Optional[str] = None,
-               knob_overrides: Optional[Dict[str, Any]] = None
-               ) -> TuneResult:
+               knob_overrides: Optional[Dict[str, Any]] = None,
+               gang_size: int = 0) -> TuneResult:
     """Local single-process tuning loop (reference ``tune_model``): run the
     advisor's propose/feedback cycle in-process and return the best trial.
 
@@ -104,12 +105,31 @@ def tune_model(model_class: Type[BaseModel], train_dataset_path: str,
     ``knob_overrides`` pins knobs over every proposal — the dev-loop
     twin of ``TrainWorker.knob_overrides`` (job-level pins), so local
     runs can hold shape knobs fixed while the advisor searches the
-    rest."""
+    rest. Unknown keys fail fast, same as the admin API's job-level
+    validation.
+
+    ``gang_size >= 1`` routes through the gang-compiled tuning engine
+    (``rafiki_tpu/tuning``): K trials train as K lanes of one vmapped
+    jit step — small-zoo templates only (those with ``make_gang_spec``;
+    others fall back to this sequential loop with a warning)."""
     from ..advisor import make_advisor, TrialResult
 
     knob_config = model_class.get_knob_config()
+    validate_override_keys(knob_config, knob_overrides,
+                           context="knob_overrides")
     advisor = make_advisor(knob_config, advisor_type,
                            total_trials=total_trials, seed=seed)
+
+    if gang_size >= 1:
+        from ..tuning import supports_gang
+
+        if supports_gang(model_class):
+            return _tune_model_gang(model_class, advisor,
+                                    train_dataset_path, val_dataset_path,
+                                    gang_size, knob_overrides, keep_params)
+        warnings.warn(
+            f"{model_class.__name__} has no gang spec; "
+            "tune_model(gang_size=...) falling back to sequential trials")
 
     trials: List[TrialSummary] = []
     params_by_trial: Dict[str, Params] = {}
@@ -168,6 +188,38 @@ def tune_model(model_class: Type[BaseModel], train_dataset_path: str,
         raise RuntimeError("no successful trial")
     return TuneResult(best_knobs=best.knobs, best_score=best.score,
                       best_params=params_by_trial.get(best.trial_id, {}),
+                      trials=trials)
+
+
+def _tune_model_gang(model_class: Type[BaseModel], advisor: Any,
+                     train_dataset_path: str, val_dataset_path: str,
+                     gang_size: int,
+                     knob_overrides: Optional[Dict[str, Any]],
+                     keep_params: bool) -> TuneResult:
+    """Gang-engine twin of the sequential loop: same advisor cycle, K
+    lanes per compiled step, one TrialSummary per lane-trial."""
+    from ..tuning import GangEngine
+
+    blobs: Dict[str, Params] = {}
+
+    def on_result(result, blob) -> None:
+        if keep_params:
+            blobs[result.trial_id] = blob
+
+    engine = GangEngine(model_class, advisor, train_dataset_path,
+                        val_dataset_path, gang_size=gang_size, mode="gang",
+                        knob_overrides=knob_overrides,
+                        keep_blobs=True, on_result=on_result)
+    results = engine.run()
+    trials = [TrialSummary(knobs=r.knobs, score=r.score,
+                           logger=ModelLogger(),
+                           params=blobs.get(r.trial_id))
+              for r in results]
+    best = advisor.best_effort
+    if best is None:
+        raise RuntimeError("no successful trial")
+    return TuneResult(best_knobs=best.knobs, best_score=best.score,
+                      best_params=blobs.get(best.trial_id, {}),
                       trials=trials)
 
 

@@ -16,13 +16,23 @@ Design notes (TPU-first):
   hidden width). The trial scheduler uses it to bucket proposals by XLA
   compile signature and amortize compilation across trials (SURVEY.md §7
   "Compile-time amortization in search").
+- ``traceable`` marks knobs whose value can be threaded into a compiled
+  train step as a traced array operand (learning rate, dropout, weight
+  decay, momentum, ...). The gang-compiled tuning engine
+  (``rafiki_tpu/tuning``) runs K configurations that differ only in
+  traceable knobs as K lanes of ONE ``jax.vmap``-ed jit step — no
+  per-trial recompile. Non-traceable knobs define the *static bucket*
+  (:func:`static_signature`): one compile per bucket, not per trial.
+  ``traceable`` and ``shape_relevant`` are mutually exclusive — a knob
+  that changes array shapes can never be a traced operand.
 """
 
 from __future__ import annotations
 
 import math
 import random as _random
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import (Any, Dict, Iterable, List, Mapping, Optional, Sequence,
+                    Union)
 
 KnobValue = Union[int, float, str, bool]
 
@@ -33,8 +43,14 @@ class BaseKnob:
     #: subclasses set this; used for JSON round-trip dispatch
     kind: str = "base"
 
-    def __init__(self, shape_relevant: bool = False) -> None:
+    def __init__(self, shape_relevant: bool = False,
+                 traceable: bool = False) -> None:
+        if shape_relevant and traceable:
+            raise ValueError(
+                "a knob cannot be both shape_relevant and traceable: "
+                "shape changes force a recompile, traced operands must not")
         self.shape_relevant = shape_relevant
+        self.traceable = traceable
 
     # ---- sampling / optimization interface ----
     def sample(self, rng: _random.Random) -> KnobValue:
@@ -86,8 +102,9 @@ class FixedKnob(BaseKnob):
 
     kind = "fixed"
 
-    def __init__(self, value: KnobValue, shape_relevant: bool = False) -> None:
-        super().__init__(shape_relevant)
+    def __init__(self, value: KnobValue, shape_relevant: bool = False,
+                 traceable: bool = False) -> None:
+        super().__init__(shape_relevant, traceable)
         self.value = value
 
     def sample(self, rng: _random.Random) -> KnobValue:
@@ -108,11 +125,13 @@ class FixedKnob(BaseKnob):
 
     def to_json(self) -> Dict[str, Any]:
         return {"kind": self.kind, "value": self.value,
-                "shape_relevant": self.shape_relevant}
+                "shape_relevant": self.shape_relevant,
+                "traceable": self.traceable}
 
     @classmethod
     def _from_json(cls, d: Dict[str, Any]) -> "FixedKnob":
-        return cls(d["value"], d.get("shape_relevant", False))
+        return cls(d["value"], d.get("shape_relevant", False),
+                   d.get("traceable", False))
 
 
 class CategoricalKnob(BaseKnob):
@@ -121,8 +140,9 @@ class CategoricalKnob(BaseKnob):
     kind = "categorical"
 
     def __init__(self, values: Sequence[KnobValue],
-                 shape_relevant: bool = False) -> None:
-        super().__init__(shape_relevant)
+                 shape_relevant: bool = False,
+                 traceable: bool = False) -> None:
+        super().__init__(shape_relevant, traceable)
         if not values:
             raise ValueError("CategoricalKnob requires at least one value")
         self.values = list(values)
@@ -150,11 +170,13 @@ class CategoricalKnob(BaseKnob):
 
     def to_json(self) -> Dict[str, Any]:
         return {"kind": self.kind, "values": self.values,
-                "shape_relevant": self.shape_relevant}
+                "shape_relevant": self.shape_relevant,
+                "traceable": self.traceable}
 
     @classmethod
     def _from_json(cls, d: Dict[str, Any]) -> "CategoricalKnob":
-        return cls(d["values"], d.get("shape_relevant", False))
+        return cls(d["values"], d.get("shape_relevant", False),
+                   d.get("traceable", False))
 
 
 class IntegerKnob(BaseKnob):
@@ -163,8 +185,9 @@ class IntegerKnob(BaseKnob):
     kind = "integer"
 
     def __init__(self, value_min: int, value_max: int, is_exp: bool = False,
-                 shape_relevant: bool = False) -> None:
-        super().__init__(shape_relevant)
+                 shape_relevant: bool = False,
+                 traceable: bool = False) -> None:
+        super().__init__(shape_relevant, traceable)
         if value_min > value_max:
             raise ValueError("value_min must be <= value_max")
         if is_exp and value_min <= 0:
@@ -205,12 +228,14 @@ class IntegerKnob(BaseKnob):
     def to_json(self) -> Dict[str, Any]:
         return {"kind": self.kind, "value_min": self.value_min,
                 "value_max": self.value_max, "is_exp": self.is_exp,
-                "shape_relevant": self.shape_relevant}
+                "shape_relevant": self.shape_relevant,
+                "traceable": self.traceable}
 
     @classmethod
     def _from_json(cls, d: Dict[str, Any]) -> "IntegerKnob":
         return cls(d["value_min"], d["value_max"], d.get("is_exp", False),
-                   d.get("shape_relevant", False))
+                   d.get("shape_relevant", False),
+                   d.get("traceable", False))
 
 
 class FloatKnob(BaseKnob):
@@ -219,8 +244,9 @@ class FloatKnob(BaseKnob):
     kind = "float"
 
     def __init__(self, value_min: float, value_max: float,
-                 is_exp: bool = False, shape_relevant: bool = False) -> None:
-        super().__init__(shape_relevant)
+                 is_exp: bool = False, shape_relevant: bool = False,
+                 traceable: bool = False) -> None:
+        super().__init__(shape_relevant, traceable)
         if value_min > value_max:
             raise ValueError("value_min must be <= value_max")
         if is_exp and value_min <= 0:
@@ -259,12 +285,14 @@ class FloatKnob(BaseKnob):
     def to_json(self) -> Dict[str, Any]:
         return {"kind": self.kind, "value_min": self.value_min,
                 "value_max": self.value_max, "is_exp": self.is_exp,
-                "shape_relevant": self.shape_relevant}
+                "shape_relevant": self.shape_relevant,
+                "traceable": self.traceable}
 
     @classmethod
     def _from_json(cls, d: Dict[str, Any]) -> "FloatKnob":
         return cls(d["value_min"], d["value_max"], d.get("is_exp", False),
-                   d.get("shape_relevant", False))
+                   d.get("shape_relevant", False),
+                   d.get("traceable", False))
 
 
 class PolicyKnob(BaseKnob):
@@ -287,8 +315,9 @@ class PolicyKnob(BaseKnob):
         "ADAPTERS_ONLY",       # strict-LoRA training (multi-adapter serving)
     )
 
-    def __init__(self, policy: str, shape_relevant: bool = False) -> None:
-        super().__init__(shape_relevant)
+    def __init__(self, policy: str, shape_relevant: bool = False,
+                 traceable: bool = False) -> None:
+        super().__init__(shape_relevant, traceable)
         self.policy = policy
 
     def sample(self, rng: _random.Random) -> bool:
@@ -305,11 +334,13 @@ class PolicyKnob(BaseKnob):
 
     def to_json(self) -> Dict[str, Any]:
         return {"kind": self.kind, "policy": self.policy,
-                "shape_relevant": self.shape_relevant}
+                "shape_relevant": self.shape_relevant,
+                "traceable": self.traceable}
 
     @classmethod
     def _from_json(cls, d: Dict[str, Any]) -> "PolicyKnob":
-        return cls(d["policy"], d.get("shape_relevant", False))
+        return cls(d["policy"], d.get("shape_relevant", False),
+                   d.get("traceable", False))
 
 
 _KNOB_KINDS = {c.kind: c for c in
@@ -387,3 +418,48 @@ def shape_signature(knob_config: KnobConfig, knobs: Knobs) -> str:
     items = sorted((n, knobs[n]) for n, k in knob_config.items()
                    if k.shape_relevant)
     return repr(items)
+
+
+def traceable_knobs(knob_config: KnobConfig) -> List[str]:
+    """Names of knobs declared ``traceable``, in sorted order.
+
+    These are the per-lane traced operands of a gang-compiled train step;
+    sorted so every process packs lane hyperparameter arrays in the same
+    axis order without coordination."""
+    return sorted(n for n, k in knob_config.items() if k.traceable)
+
+
+def static_signature(knob_config: KnobConfig, knobs: Knobs) -> str:
+    """Stable key over NON-traceable knob values — the compile bucket.
+
+    Two proposals with equal static signatures differ only in traced
+    operands, so they can run as lanes of the same vmapped executable:
+    one compile per bucket, not per trial. A superset of
+    :func:`shape_signature` — non-shape static knobs like an optimizer
+    choice also fork the compiled program — EXCEPT policy knobs: those
+    are system toggles handled outside the traced step by contract
+    (budget scaling, warm-start gating), and BOHB flips them per rung,
+    so keying on them would force a recompile at every rung boundary."""
+    items = sorted((n, knobs.get(n)) for n, k in knob_config.items()
+                   if not k.traceable and not isinstance(k, PolicyKnob))
+    return repr(items)
+
+
+def validate_override_keys(known: Iterable[str],
+                           overrides: Optional[Mapping[str, Any]],
+                           context: str = "knob_overrides") -> None:
+    """Reject override keys that name no known knob.
+
+    One validator for every override surface — the admin API
+    (``ServicesManager`` job-level pins) and the dev loop
+    (``tune_model(knob_overrides=)``) — so a typo'd key fails fast
+    everywhere instead of silently letting the advisor search the
+    dimension the user believes is pinned."""
+    if not overrides:
+        return
+    known = set(known)
+    unknown = set(overrides) - known
+    if unknown:
+        raise ValueError(
+            f"{context} {sorted(unknown)} match no knob "
+            f"(known: {sorted(known)})")

@@ -11,7 +11,9 @@ per-template copy.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterator, Optional, Tuple
+from dataclasses import dataclass
+from typing import (Any, Callable, Dict, Iterator, Optional, Sequence,
+                    Tuple)
 
 import numpy as np
 
@@ -47,3 +49,65 @@ def train_epoch(step: Callable[[Any, dict], Tuple[Any, Any]],
     if not losses:
         return state, float("nan")
     return state, float(np.mean([float(l) for l in losses]))
+
+
+@dataclass
+class GangSpec:
+    """A template's *functional* training recipe — the contract the
+    gang-compiled tuning engine (``rafiki_tpu/tuning``) drives.
+
+    The ordinary :meth:`BaseModel.train` is imperative: it owns its epoch
+    loop and bakes every knob into Python. A gang spec factors the same
+    computation into pure functions over an explicit per-lane ``state``
+    pytree, with the template's *traceable* knobs arriving as a dict of
+    traced scalars (``hp``). The engine vmaps these functions over K
+    lanes (lane = trial) so K configurations train inside ONE compiled
+    step; all non-traceable knobs were already burned in when the
+    template built the spec (one spec per static bucket —
+    :func:`rafiki_tpu.model.knob.static_signature`).
+
+    Templates opt in via ``make_gang_spec(knobs, train_path, val_path)``
+    (a classmethod returning one of these) plus ``gang_epochs(knobs,
+    budget_scale)``; the engine falls back to per-trial sequential
+    execution for templates that don't.
+
+    Semantics contract (checked by tier-1 equivalence tests): driving a
+    1-lane gang through ``init_lane``/``train_step``/``eval_lane`` must
+    reproduce the template's sequential ``train()``/``evaluate()``
+    bit-for-bit on the same dataset and knob assignment.
+    """
+
+    #: traceable knob names, in the axis order the engine packs per-lane
+    #: hp arrays (use ``traceable_knobs(get_knob_config())``)
+    hp_names: Sequence[str]
+    #: ``(rng, hp) -> state`` — build ONE lane's state (params + opt);
+    #: must not depend on hp for pytree STRUCTURE (values only)
+    init_lane: Callable[[Any, Dict[str, Any]], Any]
+    #: ``(state, hp, batch) -> (state, loss)`` — pure; vmapped over
+    #: state, hp AND batch (in_axes=(0, 0, 0)) and jitted with the
+    #: state donated. The batch axis is per-lane because each lane
+    #: follows its OWN epoch schedule (a refilled lane restarts at
+    #: epoch 0), so lane i's batch at any step is exactly what its
+    #: sequential twin would see — do not assume lanes share data
+    train_step: Callable[[Any, Dict[str, Any], Dict[str, Any]],
+                         Tuple[Any, Any]]
+    #: ``(epoch) -> iterator of host batch dicts`` (static shapes; the
+    #: same batches the template's sequential loop sees at that epoch —
+    #: the engine stacks one batch per lane from per-lane iterators)
+    epoch_batches: Callable[[int], Iterator[Dict[str, np.ndarray]]]
+    #: ``(state, hp, xb) -> predicted class ids [B]`` — vmapped for
+    #: scoring; engine computes masked accuracy over ``eval_batches``
+    eval_lane: Callable[[Any, Dict[str, Any], Any], Any]
+    #: ``() -> iterator of {"x", "y", "mask"} host eval batches``
+    eval_batches: Callable[[], Iterator[Dict[str, np.ndarray]]]
+    #: ``(lane_state) -> blob`` — a ``dump_parameters()``-shaped blob for
+    #: the ParamStore / TuneResult (host numpy)
+    export_blob: Callable[[Any], Dict[str, Any]]
+    #: ``(fresh_state, parent_blob) -> state`` — warm-start a lane from a
+    #: completed trial's blob (params from the blob, optimizer fresh —
+    #: exactly what the sequential warm-start path does)
+    warm_lane: Callable[[Any, Dict[str, Any]], Any]
+    #: name of the template's SHARE_PARAMS policy knob, if any: the
+    #: engine only applies a proposal's warm start when this knob is
+    #: truthy in its assignment (mirrors the sequential gate)
+    share_params_knob: Optional[str] = None

@@ -47,6 +47,17 @@ class BatchNormCNNTemplate(BaseModel):
     def _module(self):
         raise NotImplementedError
 
+    @classmethod
+    def gang_epochs(cls, knobs: Dict[str, Any],
+                    budget_scale: float) -> int:
+        """Epoch count ``train()`` would spend (the gang/trial
+        scheduler's per-proposal budget; mirrors the loop below)."""
+        epochs = max(1, round(int(knobs["max_epochs"])
+                              * float(budget_scale)))
+        if knobs.get("quick_train"):
+            epochs = min(epochs, 2)
+        return epochs
+
     # ---- shared internals ----
     def _prep(self, images: np.ndarray) -> np.ndarray:
         x = images.astype(np.float32) / 255.0
@@ -113,10 +124,7 @@ class BatchNormCNNTemplate(BaseModel):
                                               variables["batch_stats"])),
                 }
 
-        epochs = max(1, round(int(self.knobs["max_epochs"])
-                              * float(ctx.budget_scale)))
-        if self.knobs.get("quick_train"):
-            epochs = min(epochs, 2)
+        epochs = self.gang_epochs(self.knobs, ctx.budget_scale)
         steps_per_epoch = max(1, (n_samples + batch_size - 1) // batch_size)
         schedule = optax.cosine_decay_schedule(
             float(self.knobs["learning_rate"]), epochs * steps_per_epoch)

@@ -151,8 +151,15 @@ class ResNetClassifier(BatchNormCNNTemplate):
                                        shape_relevant=True),
             "width_mult": CategoricalKnob([0.25, 0.5, 1.0],
                                           shape_relevant=True),
-            "learning_rate": FloatKnob(1e-3, 1.0, is_exp=True),
-            "weight_decay": FloatKnob(1e-5, 1e-2, is_exp=True),
+            # traceable: continuous optimizer knobs are gang-lane-ready
+            # (they never fork the compiled program); the BatchNorm CNN
+            # recipe still trains per-trial until a gang spec lands, but
+            # the trial scheduler already buckets on the structural
+            # knobs only
+            "learning_rate": FloatKnob(1e-3, 1.0, is_exp=True,
+                                       traceable=True),
+            "weight_decay": FloatKnob(1e-5, 1e-2, is_exp=True,
+                                      traceable=True),
             "batch_size": CategoricalKnob([32, 64, 128, 256],
                                           shape_relevant=True),
             "bf16": CategoricalKnob([True, False]),

@@ -5,11 +5,18 @@ zoo"); this template is its accelerator-native counterpart: a jit-compiled
 flax MLP over standardized features, so tabular jobs ride the same TPU
 sub-mesh scheduling as every other template. Feature standardization
 (mean/std learned at train time) ships inside the parameter blob.
+
+Knob application is *functional*: ``learning_rate`` AND ``dropout`` are
+traceable — the train step takes them as traced scalar operands, with
+dropout applied as explicit inverted-dropout masks (``bernoulli(keep)``
+with a traced keep probability) instead of ``nn.Dropout`` (whose rate is
+compile-time Python). The same functions back the sequential ``train()``
+loop and the gang engine's vmapped lanes (``make_gang_spec``), so lanes
+differing in lr/dropout share ONE compiled step.
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -19,9 +26,9 @@ import numpy as np
 from rafiki_tpu.constants import TaskType
 from rafiki_tpu.data import batch_iterator, load_tabular_dataset
 from rafiki_tpu.model import (BaseModel, CategoricalKnob, FixedKnob,
-                              FloatKnob, IntegerKnob, KnobConfig,
-                              PolicyKnob, TrainContext, bucketed_forward,
-                              same_tree_shapes)
+                              FloatKnob, GangSpec, IntegerKnob, Knobs,
+                              KnobConfig, PolicyKnob, TrainContext,
+                              bucketed_forward, same_tree_shapes)
 
 
 class JaxTabularMLP(BaseModel):
@@ -36,8 +43,9 @@ class JaxTabularMLP(BaseModel):
             "hidden_layer_count": IntegerKnob(1, 4, shape_relevant=True),
             "hidden_layer_units": IntegerKnob(16, 256, is_exp=True,
                                               shape_relevant=True),
-            "dropout": FloatKnob(0.0, 0.5),
-            "learning_rate": FloatKnob(1e-4, 1e-1, is_exp=True),
+            "dropout": FloatKnob(0.0, 0.5, traceable=True),
+            "learning_rate": FloatKnob(1e-4, 1e-1, is_exp=True,
+                                       traceable=True),
             "batch_size": CategoricalKnob([64, 128, 256],
                                           shape_relevant=True),
             "quick_train": PolicyKnob("QUICK_TRAIN"),
@@ -53,23 +61,85 @@ class JaxTabularMLP(BaseModel):
         self._fwd: Optional[Any] = None
 
     # ---- internals ----
-    def _module(self):
+    @staticmethod
+    def _build_module(layers: int, units: int, n_classes: int):
         from flax import linen as nn
-
-        layers = int(self.knobs["hidden_layer_count"])
-        units = int(self.knobs["hidden_layer_units"])
-        rate = float(self.knobs.get("dropout", 0.0))
-        n_classes = self._n_classes
 
         class _Net(nn.Module):
             @nn.compact
-            def __call__(self, x, train: bool = False):
-                for _ in range(layers):
+            def __call__(self, x, drop_masks=None):
+                for li in range(layers):
                     x = nn.relu(nn.Dense(units)(x))
-                    x = nn.Dropout(rate, deterministic=not train)(x)
+                    if drop_masks is not None:  # None ⇒ deterministic
+                        x = x * drop_masks[li]
                 return nn.Dense(n_classes)(x)
 
         return _Net()
+
+    def _module(self):
+        return self._build_module(int(self.knobs["hidden_layer_count"]),
+                                  int(self.knobs["hidden_layer_units"]),
+                                  self._n_classes)
+
+    @staticmethod
+    def _lane_functions(module, layers: int, units: int, n_features: int,
+                        batch_size: int):
+        """``(init_lane, train_step)`` shared by the sequential loop and
+        the gang engine's vmapped lanes. ``hp`` = traced
+        ``{"dropout", "learning_rate"}``: dropout rides as explicit
+        inverted-dropout masks (traced keep probability), lr as a
+        post-``scale_by_adam`` multiplier — bit-identical to
+        ``optax.adam(lr)``."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        tx = optax.scale_by_adam()
+
+        def init_lane(rng: Any, hp: Dict[str, Any]) -> Dict[str, Any]:
+            params = module.init(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, n_features)))["params"]
+            # dropout rng stream lives IN the lane state so the compiled
+            # step owns its own randomness (seed matches the historical
+            # per-template PRNGKey(1) stream)
+            return {"params": params, "opt": tx.init(params),
+                    "rng": jax.random.PRNGKey(1)}
+
+        def train_step(state: Dict[str, Any], hp: Dict[str, Any],
+                       batch: Dict[str, Any]):
+            rng, step_rng = jax.random.split(state["rng"])
+            keep = 1.0 - hp["dropout"]  # knob domain [0, 0.5] ⇒ keep>0
+            layer_rngs = jax.random.split(step_rng, max(layers, 1))
+            drop_masks = [
+                jax.random.bernoulli(layer_rngs[li], keep,
+                                     (batch_size, units)) / keep
+                for li in range(layers)]
+
+            def loss_fn(p):
+                logits = module.apply({"params": p}, batch["x"],
+                                      drop_masks=drop_masks)
+                losses = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, batch["y"])
+                mask = batch["mask"].astype(jnp.float32)
+                return jnp.sum(losses * mask) / jnp.maximum(
+                    jnp.sum(mask), 1.0)
+
+            loss, grads = jax.value_and_grad(loss_fn)(state["params"])
+            updates, opt = tx.update(grads, state["opt"], state["params"])
+            updates = jax.tree_util.tree_map(
+                lambda u: -hp["learning_rate"] * u, updates)
+            return {"params": optax.apply_updates(state["params"], updates),
+                    "opt": opt, "rng": rng}, loss
+
+        return init_lane, train_step
+
+    @classmethod
+    def gang_epochs(cls, knobs: Knobs, budget_scale: float) -> int:
+        epochs = max(1, round(int(knobs["max_epochs"])
+                              * float(budget_scale)))
+        if knobs.get("quick_train"):
+            epochs = min(epochs, 2)
+        return epochs
 
     def _standardize(self, x: np.ndarray) -> np.ndarray:
         assert self._mean is not None and self._std is not None
@@ -80,7 +150,6 @@ class JaxTabularMLP(BaseModel):
               ctx: Optional[TrainContext] = None) -> None:
         import jax
         import jax.numpy as jnp
-        import optax
 
         ctx = ctx or TrainContext()
         ds = load_tabular_dataset(dataset_path)
@@ -94,40 +163,27 @@ class JaxTabularMLP(BaseModel):
         y = ds.labels
 
         module = self._module()
-        if self._params is None:
-            params = module.init(jax.random.PRNGKey(0),
-                                 jnp.zeros((1, x.shape[1])))["params"]
-        else:
-            params = self._params
+        batch_size = int(self.knobs["batch_size"])
+        init_lane, train_step = self._lane_functions(
+            module, int(self.knobs["hidden_layer_count"]),
+            int(self.knobs["hidden_layer_units"]), x.shape[1], batch_size)
+        hp = {"dropout": jnp.float32(float(self.knobs.get("dropout", 0.0))),
+              "learning_rate":
+              jnp.float32(float(self.knobs["learning_rate"]))}
+        state = init_lane(jax.random.PRNGKey(0), hp)
+        if self._params is not None:  # warm-started via load_parameters
+            state = {**state, "params": self._params}
         if ctx.shared_params is not None and self.knobs.get("share_params"):
             shared = ctx.shared_params.get("params")
-            if shared is not None and same_tree_shapes(params, shared):
-                params = jax.tree_util.tree_map(jnp.asarray, shared)
+            if shared is not None and same_tree_shapes(state["params"],
+                                                       shared):
+                state = {**state,
+                         "params": jax.tree_util.tree_map(jnp.asarray,
+                                                          shared)}
 
-        tx = optax.adam(float(self.knobs["learning_rate"]))
-        opt_state = tx.init(params)
-
-        # donate the param/opt trees: in-place update, no per-step copies
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def train_step(params, opt_state, rng, xb, yb, mask):
-            def loss_fn(p):
-                logits = module.apply({"params": p}, xb, train=True,
-                                      rngs={"dropout": rng})
-                losses = optax.softmax_cross_entropy_with_integer_labels(
-                    logits, yb)
-                return jnp.sum(losses * mask) / jnp.maximum(
-                    jnp.sum(mask), 1.0)
-
-            loss, grads = jax.value_and_grad(loss_fn)(params)
-            updates, opt_state = tx.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state, loss
-
-        epochs = max(1, round(int(self.knobs["max_epochs"])
-                              * float(ctx.budget_scale)))
-        if self.knobs.get("quick_train"):
-            epochs = min(epochs, 2)
-        batch_size = int(self.knobs["batch_size"])
-        rng = jax.random.PRNGKey(1)
+        # donate the state tree: in-place update, no per-step copies
+        step = jax.jit(train_step, donate_argnums=(0,))
+        epochs = self.gang_epochs(self.knobs, ctx.budget_scale)
         ctx.logger.define_plot("Loss over epochs", ["loss"], x_axis="epoch")
         # donation invalidates buffers that may alias self._params (warm
         # start / re-train): drop the stale reference first
@@ -136,23 +192,80 @@ class JaxTabularMLP(BaseModel):
             losses = []
             for b in batch_iterator({"x": x, "y": y}, batch_size,
                                     seed=epoch):
-                rng, step_rng = jax.random.split(rng)
-                params, opt_state, loss = train_step(
-                    params, opt_state, step_rng, b["x"], b["y"],
-                    b["mask"].astype(np.float32))
+                state, loss = step(state, hp, b)
                 losses.append(float(loss))
             mean_loss = float(np.mean(losses))
             ctx.logger.log(epoch=epoch, loss=mean_loss)
             if ctx.checkpoint is not None:
                 # preemption safety: worker throttles + persists
-                self._params = params
+                self._params = state["params"]
                 ctx.checkpoint(self.dump_parameters,
                                frac_done=(epoch + 1) / epochs)
             if ctx.should_continue is not None and \
                     not ctx.should_continue(epoch, -mean_loss):
                 break
-        self._params = params
+        self._params = state["params"]
         self._fwd = None
+
+    @classmethod
+    def make_gang_spec(cls, knobs: Knobs, train_dataset_path: str,
+                       val_dataset_path: str) -> GangSpec:
+        """Functional training recipe for the gang engine: lanes share
+        this static bucket's architecture/batch shape and differ only in
+        the traced ``dropout``/``learning_rate`` operands."""
+        import jax.numpy as jnp
+
+        ds = load_tabular_dataset(train_dataset_path)
+        if ds.n_classes == 0:
+            raise ValueError("JaxTabularMLP is a classifier; dataset is "
+                             "regression (n_classes=0)")
+        mean = ds.features.mean(axis=0)
+        std = ds.features.std(axis=0) + 1e-6
+        x = ((ds.features - mean) / std).astype(np.float32)
+        y = ds.labels
+        layers = int(knobs["hidden_layer_count"])
+        units = int(knobs["hidden_layer_units"])
+        batch_size = int(knobs["batch_size"])
+        module = cls._build_module(layers, units, int(ds.n_classes))
+        init_lane, train_step = cls._lane_functions(
+            module, layers, units, x.shape[1], batch_size)
+        vds = load_tabular_dataset(val_dataset_path)
+        vx = ((vds.features - mean) / std).astype(np.float32)
+        vy = vds.labels
+        meta = {"n_classes": int(ds.n_classes)}
+
+        def epoch_batches(epoch: int):
+            return batch_iterator({"x": x, "y": y}, batch_size, seed=epoch)
+
+        def eval_lane(state, hp, xb):
+            return jnp.argmax(module.apply({"params": state["params"]},
+                                           xb), -1)
+
+        def eval_batches():
+            return batch_iterator({"x": vx, "y": vy}, 256, shuffle=False)
+
+        def export_blob(lane_state):
+            return {"params": jax.tree_util.tree_map(
+                        np.asarray, lane_state["params"]),
+                    "mean": np.asarray(mean), "std": np.asarray(std),
+                    "meta": dict(meta)}
+
+        def warm_lane(fresh, blob):
+            shared = (blob or {}).get("params")
+            if shared is None or not same_tree_shapes(fresh["params"],
+                                                      shared):
+                return fresh  # incompatible architecture → cold start
+            return {**fresh, "params": jax.tree_util.tree_map(jnp.asarray,
+                                                              shared)}
+
+        import jax
+
+        return GangSpec(hp_names=("dropout", "learning_rate"),
+                        init_lane=init_lane, train_step=train_step,
+                        epoch_batches=epoch_batches, eval_lane=eval_lane,
+                        eval_batches=eval_batches, export_blob=export_blob,
+                        warm_lane=warm_lane,
+                        share_params_knob="share_params")
 
     def _probs(self, x: np.ndarray) -> np.ndarray:
         import jax

@@ -1,0 +1,6 @@
+"""Gang-compiled tuning: vmap K hyperparameter configs into one
+compiled train step (Podracer/Anakin pattern — see ``gang.py``)."""
+
+from .gang import GangEngine, supports_gang
+
+__all__ = ["GangEngine", "supports_gang"]

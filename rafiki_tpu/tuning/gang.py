@@ -1,0 +1,505 @@
+"""Gang-compiled tuning engine: K trials as K lanes of ONE compiled step.
+
+The advisor stack schedules one process per trial, which is right for
+Llama-sized templates but wasteful for the small zoo (MLP / tabular /
+CNN-lite), where XLA compile + per-step dispatch dominate the trial wall
+clock. This engine adopts the Anakin pattern from "Podracer
+architectures for scalable RL" (PAPERS.md, arXiv:2104.06272): ``vmap``
+K hyperparameter configurations of the same template into one
+jit-compiled train step on one mesh, so the interpreter cost is paid
+once per *gang*, not once per trial.
+
+Mechanics:
+
+- A **lane** is a trial. Per-lane *traceable* knobs (learning rate,
+  dropout, ...) ride as traced ``[K]`` operands; all other knobs are
+  burned into the compiled program, so proposals are grouped into
+  **static buckets** by :func:`rafiki_tpu.model.knob.static_signature`
+  — one compile per bucket, never per trial.
+- The advisor issues batched suggestions (``propose_batch``); ASHA/BOHB
+  rung exits are evaluated at epoch boundaries and **cull lanes in
+  place**: a finished lane is refilled from the advisor's next batch
+  with no recompile (a promotion refill warm-starts from the parent
+  trial's in-engine param snapshot, optimizer fresh — exactly the
+  sequential warm-start semantics).
+- Each lane consumes the SAME batch schedule the template's sequential
+  ``train()`` would (per-lane epoch counters seed the batch iterator),
+  so a lane's training is bit-for-bit the sequential trial's training —
+  tier-1 asserts score equivalence and that culling decisions match
+  process mode.
+
+``mode="sequential"`` runs the identical schedule through the
+template's ordinary per-trial ``train()``/``evaluate()`` path (what a
+process-per-trial deployment does) — the equivalence baseline and the
+fallback for templates without a ``make_gang_spec``.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple, Type
+
+import numpy as np
+
+from ..advisor.base import Proposal, TrialResult
+from ..model.base import BaseModel, TrainContext
+from ..model.knob import (Knobs, static_signature, traceable_knobs,
+                          validate_override_keys)
+from ..model.log import ModelLogger
+
+
+def supports_gang(model_class: Type[BaseModel]) -> bool:
+    """True when the template implements the gang contract
+    (``make_gang_spec`` + ``gang_epochs``)."""
+    return (callable(getattr(model_class, "make_gang_spec", None))
+            and callable(getattr(model_class, "gang_epochs", None)))
+
+
+class _VmapExec:
+    """One static bucket's compiled executor: stacked lane state, per-lane
+    hp arrays, and the jitted vmapped train/eval functions (built once,
+    reused across gang sessions of the same bucket)."""
+
+    def __init__(self, spec, gang_size: int) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self.spec = spec
+        self.k = gang_size
+        self._jnp = jnp
+        # lanes vmap over state and hp; the batch is per-lane too (axis
+        # 0) so each lane sees the batch schedule its sequential twin
+        # would (lane epochs differ after an in-place refill)
+        self.step = jax.jit(
+            jax.vmap(spec.train_step, in_axes=(0, 0, 0)),
+            donate_argnums=(0,))
+        self.eval_step = jax.jit(
+            jax.vmap(spec.eval_lane, in_axes=(0, 0, None)))
+        self.state: Any = None
+        self.hp: Dict[str, Any] = {
+            n: jnp.zeros((gang_size,), jnp.float32) for n in spec.hp_names}
+
+    def _lane_hp(self, knobs: Knobs) -> Dict[str, Any]:
+        return {n: self._jnp.float32(float(knobs[n]))
+                for n in self.spec.hp_names}
+
+    def fill_lane(self, i: int, knobs: Knobs,
+                  warm_blob: Optional[dict]) -> None:
+        """(Re)initialize lane ``i`` — fresh params/optimizer, optionally
+        warm-started from a completed trial's blob — and write its
+        traceable knob values into the hp arrays. Eager ops only: a
+        refill never recompiles."""
+        import jax
+
+        lane_hp = self._lane_hp(knobs)
+        lane = self.spec.init_lane(jax.random.PRNGKey(0), lane_hp)
+        if warm_blob is not None:
+            lane = self.spec.warm_lane(lane, warm_blob)
+        if self.state is None:
+            # first fill: broadcast lane 0's structure to K lanes
+            self.state = jax.tree_util.tree_map(
+                lambda a: self._jnp.broadcast_to(
+                    a[None], (self.k,) + a.shape).copy(), lane)
+        self.state = jax.tree_util.tree_map(
+            lambda s, v: s.at[i].set(v), self.state, lane)
+        for n, v in lane_hp.items():
+            self.hp[n] = self.hp[n].at[i].set(v)
+
+    def run_epoch(self, lane_epochs: List[int]) -> Tuple[int, int]:
+        """Step every lane through one epoch of its OWN batch schedule
+        (lane i's batches come from ``epoch_batches(lane_epochs[i])``).
+        Returns (steps, samples-per-lane) for throughput accounting."""
+        iters = [self.spec.epoch_batches(e) for e in lane_epochs]
+        steps = samples = 0
+        for per_lane in zip(*iters):
+            batch = {key: np.stack([b[key] for b in per_lane])
+                     for key in per_lane[0]}
+            self.state, _loss = self.step(self.state, self.hp, batch)
+            steps += 1
+            samples += int(per_lane[0]["mask"].sum())
+        return steps, samples
+
+    def scores(self) -> np.ndarray:
+        """Masked accuracy per lane over the validation stream — the
+        vmapped twin of the template's ``evaluate``."""
+        correct = np.zeros(self.k)
+        total = 0.0
+        for eb in self.spec.eval_batches():
+            preds = np.asarray(self.eval_step(self.state, self.hp,
+                                              eb["x"]))
+            mask = eb["mask"].astype(np.float64)
+            correct += ((preds == np.asarray(eb["y"])[None, :])
+                        * mask[None, :]).sum(axis=1)
+            total += float(mask.sum())
+        return correct / max(total, 1.0)
+
+    def export(self, i: int) -> dict:
+        import jax
+
+        lane = jax.tree_util.tree_map(lambda a: np.asarray(a[i]),
+                                      self.state)
+        return self.spec.export_blob(lane)
+
+    def compile_count(self) -> int:
+        """Distinct train-step executables this bucket compiled (1 when
+        every trial shape-matched the bucket, which is the invariant
+        tier-1 asserts)."""
+        try:
+            return int(self.step._cache_size())
+        except Exception:  # rafiki: noqa[silent-except]
+            return -1  # cache introspection is jax-version-dependent
+
+
+class GangEngine:
+    """Drives an advisor's propose/feedback cycle over gang-compiled
+    lanes (``mode="gang"``) or the template's ordinary per-trial path on
+    the same schedule (``mode="sequential"`` — the process-mode
+    equivalence baseline).
+    """
+
+    #: completed-trial param snapshots kept for warm starts (LRU)
+    MAX_BLOBS = 64
+
+    def __init__(self, model_class: Type[BaseModel], advisor: Any,
+                 train_dataset_path: str, val_dataset_path: str,
+                 gang_size: int = 8, mode: str = "gang",
+                 knob_overrides: Optional[Dict[str, Any]] = None,
+                 metrics: Optional[Any] = None,
+                 keep_blobs: bool = True,
+                 on_result: Optional[Any] = None) -> None:
+        if mode not in ("gang", "sequential"):
+            raise ValueError(f"unknown gang mode {mode!r}")
+        if gang_size < 1:
+            raise ValueError("gang_size must be >= 1")
+        if mode == "gang" and not supports_gang(model_class):
+            raise ValueError(
+                f"{model_class.__name__} has no make_gang_spec/gang_epochs;"
+                " use mode='sequential' or tune_model's fallback")
+        self.model_class = model_class
+        self.advisor = advisor
+        self.train_dataset_path = train_dataset_path
+        self.val_dataset_path = val_dataset_path
+        self.gang_size = int(gang_size)
+        self.mode = mode
+        self.knob_config = model_class.get_knob_config()
+        self.knob_overrides = dict(knob_overrides or {})
+        validate_override_keys(self.knob_config, self.knob_overrides,
+                               context="knob_overrides")
+        self.hp_names = traceable_knobs(self.knob_config)
+        self.keep_blobs = keep_blobs
+        self.on_result = on_result  # callable(TrialResult, blob) or None
+        self.results: List[TrialResult] = []
+        self._pending: List[Proposal] = []
+        self._seen_buckets: set = set()
+        self._execs: "OrderedDict[str, _VmapExec]" = OrderedDict()
+        self._blobs: "OrderedDict[str, dict]" = OrderedDict()
+        self._t0: Optional[float] = None
+        from ..obs import StatsMap
+
+        self.stats = StatsMap({
+            "trials_completed": 0, "trials_started": 0, "lanes_culled": 0,
+            "promotions": 0, "warm_start_misses": 0, "epoch_rounds": 0,
+            "buckets": 0, "samples": 0})
+        self._max_trials: Optional[int] = None
+        self._wire_metrics(metrics)
+
+    # ---- obs plumbing ----
+    def _wire_metrics(self, metrics: Optional[Any]) -> None:
+        if metrics is None:
+            self._g_active = self._c_culled = self._g_tph = \
+                self._g_sps = None
+            return
+        self._g_active = metrics.gauge(
+            "gang_lanes_active",
+            "gang lanes currently training a live trial")
+        self._c_culled = metrics.counter(
+            "gang_lanes_culled_total",
+            "lanes whose trial exited a sub-full ASHA rung (culled in "
+            "place; promotions return in a later refill)")
+        self._g_tph = metrics.gauge(
+            "trials_per_hour",
+            "completed-trial throughput of the gang engine")
+        self._g_sps = metrics.gauge(
+            "gang_samples_per_s",
+            "aggregate training samples/s across all lanes")
+
+    def _publish(self, active: int) -> None:
+        if self._g_active is not None:
+            self._g_active.set(active)
+        if self._g_tph is not None and self._t0 is not None:
+            dt = max(time.monotonic() - self._t0, 1e-9)
+            self._g_tph.set(self.stats["trials_completed"] / dt * 3600.0)
+
+    # ---- proposal plumbing ----
+    def _bucket_of(self, p: Proposal) -> str:
+        return static_signature(self.knob_config, p.knobs)
+
+    def _remaining_starts(self) -> Optional[int]:
+        if self._max_trials is None:
+            return None
+        return max(0, self._max_trials
+                   - int(self.stats["trials_started"]))
+
+    def _take_pending(self, bucket: str, n: int) -> List[Proposal]:
+        """Pop up to ``n`` pending proposals matching ``bucket``,
+        preserving arrival order; tops up from the advisor when pending
+        runs dry (non-matching new proposals are queued, not dropped).
+        Capped by the caller's ``max_trials`` budget — every proposal
+        returned here is about to start a lane."""
+        remaining = self._remaining_starts()
+        if remaining is not None:
+            n = min(n, remaining)
+        out: List[Proposal] = []
+        rest: List[Proposal] = []
+        for p in self._pending:
+            if len(out) < n and self._bucket_of(p) == bucket:
+                out.append(p)
+            else:
+                rest.append(p)
+        self._pending = rest
+        if len(out) < n:
+            # ONE top-up pull: a refill comes from the advisor's next
+            # batch or not at all — hunting further would drain the
+            # whole trial budget into the pending queue whenever the
+            # advisor fragments across buckets
+            for p in self.advisor.propose_batch(n - len(out)):
+                self._apply_overrides(p)
+                if len(out) < n and self._bucket_of(p) == bucket:
+                    out.append(p)
+                else:
+                    self._pending.append(p)
+        return out
+
+    def _apply_overrides(self, p: Proposal) -> None:
+        if self.knob_overrides:
+            p.knobs = {**p.knobs, **self.knob_overrides}
+        self.model_class.validate_knobs(p.knobs)
+
+    def _epochs_for(self, p: Proposal) -> int:
+        return int(self.model_class.gang_epochs(p.knobs, p.budget_scale)) \
+            if supports_gang(self.model_class) else 1
+
+    def _warm_blob(self, p: Proposal,
+                   share_knob: Optional[str]) -> Optional[dict]:
+        """The parent blob a refill warm-starts from, mirroring the
+        sequential gate: a warm_start ref only applies when the
+        template's SHARE_PARAMS knob is on for this proposal. A miss
+        (parent evicted from the bounded LRU, or minted by another gang
+        worker sharing this advisor) cold-starts the lane — VISIBLY, so
+        an unexpectedly slow high rung is diagnosable."""
+        if not p.warm_start_trial_id:
+            return None
+        if share_knob is not None and not p.knobs.get(share_knob):
+            return None
+        blob = self._blobs.get(p.warm_start_trial_id)
+        if blob is None:
+            self.stats.inc("warm_start_misses")
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "gang warm start %r for trial %d not in the blob cache "
+                "(evicted or foreign worker); lane cold-starts",
+                p.warm_start_trial_id, p.trial_no)
+        return blob
+
+    def _record(self, p: Proposal, score: float, blob: dict) -> None:
+        trial_id = f"gang-{p.trial_no}"
+        if self.keep_blobs:
+            self._blobs[trial_id] = blob
+            while len(self._blobs) > self.MAX_BLOBS:
+                self._blobs.popitem(last=False)
+        result = TrialResult(
+            trial_no=p.trial_no, knobs=p.knobs, score=float(score),
+            trial_id=trial_id, budget_scale=p.budget_scale, meta=p.meta)
+        self.results.append(result)
+        self.stats.inc("trials_completed")
+        if p.meta.get("parent_trial_no") is not None:
+            self.stats.inc("promotions")
+        if p.budget_scale < 1.0 - 1e-9:
+            self.stats.inc("lanes_culled")
+            if self._c_culled is not None:
+                self._c_culled.inc()
+        if self.on_result is not None:
+            self.on_result(result, blob)
+
+    # ---- the run loop ----
+    def run(self, max_trials: Optional[int] = None) -> List[TrialResult]:
+        """Pull batched proposals until the advisor's budget — or
+        ``max_trials`` — is spent; returns one TrialResult per
+        lane-trial (also fed back to the advisor, in completion order).
+        The cap bounds trials STARTED, enforced on every lane fill (not
+        just between bucket sessions)."""
+        self._t0 = time.monotonic()
+        self._max_trials = max_trials
+        while True:
+            remaining = self._remaining_starts()
+            if remaining is not None and remaining <= 0:
+                break
+            if not self._pending:
+                k = self.gang_size if remaining is None \
+                    else min(self.gang_size, remaining)
+                batch = self.advisor.propose_batch(k)
+                if not batch:
+                    break
+                for p in batch:
+                    self._apply_overrides(p)
+                self._pending.extend(batch)
+            bucket = self._bucket_of(self._pending[0])
+            self._run_session(bucket)
+        if self._pending:
+            # proposals pulled but never laned (cap hit / bucket
+            # stranded at budget end): release the advisor's
+            # outstanding slots so its `finished` can turn true
+            for p in self._pending:
+                try:
+                    self.advisor.trial_errored(p.trial_no)
+                except Exception:  # rafiki: noqa[silent-except]
+                    pass  # advisor may already be gone at teardown
+            self._pending.clear()
+        self._publish(active=0)
+        return self.results
+
+    def _run_session(self, bucket: str) -> None:
+        """Run one gang over one static bucket until every lane drains
+        (culled lanes refill in place from the advisor's next batch;
+        lanes idle out when the next proposals belong to other
+        buckets)."""
+        lanes: List[Optional[Proposal]] = [None] * self.gang_size
+        epochs_left = [0] * self.gang_size
+        lane_epoch = [0] * self.gang_size
+        initial = self._take_pending(bucket, self.gang_size)
+        if not initial:
+            return
+        exec_ = self._get_exec(bucket, initial[0].knobs)
+        self._seen_buckets.add(bucket)
+        self.stats.set("buckets", len(self._seen_buckets))
+        for i, p in enumerate(initial):
+            self._fill(exec_, i, p, lanes, epochs_left, lane_epoch)
+        try:
+            while any(p is not None for p in lanes):
+                self._session_round(bucket, exec_, lanes, epochs_left,
+                                    lane_epoch)
+        except Exception:
+            # a template bug fails the whole gang; release the advisor's
+            # outstanding slots so the budget is not stranded
+            for p in lanes:
+                if p is not None:
+                    try:
+                        self.advisor.trial_errored(p.trial_no)
+                    except Exception:  # rafiki: noqa[silent-except]
+                        pass  # advisor may be gone; original error wins
+            raise
+
+    def _session_round(self, bucket: str, exec_: Optional[_VmapExec],
+                       lanes: List[Optional[Proposal]],
+                       epochs_left: List[int],
+                       lane_epoch: List[int]) -> None:
+        """One epoch round: step active lanes, then eval / feedback /
+        refill the lanes whose trial budget just drained."""
+        t_round = time.monotonic()
+        if exec_ is not None:
+            # inactive lanes step a dummy schedule (epoch 0); their
+            # state is ignored and overwritten on refill
+            _steps, samples = exec_.run_epoch(
+                [lane_epoch[i] if lanes[i] is not None else 0
+                 for i in range(self.gang_size)])
+            n_active = sum(p is not None for p in lanes)
+            self.stats.inc("samples", samples * n_active)
+            if self._g_sps is not None:
+                self._g_sps.set(samples * n_active
+                                / max(time.monotonic() - t_round, 1e-9))
+        self.stats.inc("epoch_rounds")
+        finished: List[int] = []
+        for i, p in enumerate(lanes):
+            if p is None:
+                continue
+            lane_epoch[i] += 1
+            epochs_left[i] -= 1
+            if epochs_left[i] <= 0:
+                finished.append(i)
+        if not finished:
+            self._publish(sum(p is not None for p in lanes))
+            return
+        scores = exec_.scores() if exec_ is not None else None
+        batch_results: List[TrialResult] = []
+        for i in finished:
+            p = lanes[i]
+            if exec_ is not None:
+                score, blob = float(scores[i]), exec_.export(i)
+            else:
+                score, blob = self._run_sequential_trial(p)
+            self._record(p, score, blob)
+            batch_results.append(self.results[-1])
+            lanes[i] = None
+        self.advisor.feedback_batch(batch_results)
+        refills = self._take_pending(bucket, len(finished))
+        for i, p in zip(finished, refills):
+            self._fill(exec_, i, p, lanes, epochs_left, lane_epoch)
+        self._publish(sum(p is not None for p in lanes))
+
+    def _fill(self, exec_: Optional[_VmapExec], i: int, p: Proposal,
+              lanes: List[Optional[Proposal]], epochs_left: List[int],
+              lane_epoch: List[int]) -> None:
+        lanes[i] = p
+        self.stats.inc("trials_started")
+        epochs_left[i] = max(1, self._epochs_for(p))
+        lane_epoch[i] = 0
+        if exec_ is not None:
+            share = exec_.spec.share_params_knob
+            exec_.fill_lane(i, p.knobs, self._warm_blob(p, share))
+
+    def _get_exec(self, bucket: str,
+                  rep_knobs: Knobs) -> Optional[_VmapExec]:
+        if self.mode == "sequential":
+            return None
+        exec_ = self._execs.get(bucket)
+        if exec_ is None:
+            spec = self.model_class.make_gang_spec(
+                dict(rep_knobs), self.train_dataset_path,
+                self.val_dataset_path)
+            if list(spec.hp_names) != list(self.hp_names):
+                raise ValueError(
+                    f"gang spec hp_names {list(spec.hp_names)} != "
+                    f"traceable knobs {self.hp_names}")
+            exec_ = _VmapExec(spec, self.gang_size)
+            self._execs[bucket] = exec_
+        return exec_
+
+    # ---- sequential (process-mode) executor ----
+    def _run_sequential_trial(self, p: Proposal) -> Tuple[float, dict]:
+        """The template's ordinary per-trial path on the gang schedule:
+        what one process-per-trial worker would compute for this
+        proposal (warm start included)."""
+        model = self.model_class(**p.knobs)
+        shared = self._blobs.get(p.warm_start_trial_id) \
+            if p.warm_start_trial_id else None
+        ctx = TrainContext(logger=ModelLogger(),
+                           budget_scale=p.budget_scale,
+                           shared_params=shared,
+                           trial_id=f"gang-{p.trial_no}")
+        model.train(self.train_dataset_path, ctx)
+        score = float(model.evaluate(self.val_dataset_path))
+        import jax
+
+        blob = jax.tree_util.tree_map(
+            lambda x: np.asarray(x) if hasattr(x, "shape") else x,
+            model.dump_parameters())
+        model.destroy()
+        return score, blob
+
+    # ---- introspection (tier-1 compile-count assertions) ----
+    def compile_counts(self) -> Dict[str, int]:
+        """Per-bucket count of distinct train-step executables."""
+        return {b: e.compile_count() for b, e in self._execs.items()}
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self._execs)
+
+    @property
+    def trials_per_hour(self) -> float:
+        if self._t0 is None:
+            return 0.0
+        dt = max(time.monotonic() - self._t0, 1e-9)
+        return self.stats["trials_completed"] / dt * 3600.0

@@ -560,6 +560,57 @@ class TrainWorker:
             n += 1
         return n
 
+    # ---- gang trial mode (rafiki_tpu/tuning) ----
+    def run_gang(self, gang_size: int,
+                 max_trials: Optional[int] = None) -> int:
+        """Gang-compiled trial mode for small-zoo templates: K proposals
+        train as K lanes of one vmapped jit step (one compile per static
+        knob bucket), the advisor is driven through its batched verbs,
+        and ASHA rungs cull lanes in place. Reports one TrialResult per
+        lane (MetaStore row + ParamStore blob each, so deployment and
+        the dashboard see gang trials exactly like process trials) and
+        publishes ``gang_lanes_active`` / ``gang_lanes_culled_total`` /
+        ``trials_per_hour`` / ``gang_samples_per_s`` through this
+        worker's ObsServer. Falls back to the process loop for templates
+        without a gang spec."""
+        from ..model.knob import shape_signature
+        from ..tuning import GangEngine, supports_gang
+
+        if not supports_gang(self.model_class):
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "%s has no gang spec; gang_size=%d ignored, running "
+                "sequential trials", self.model_class.__name__, gang_size)
+            return self.run(max_trials)
+
+        knob_config = self.model_class.get_knob_config()
+
+        def on_result(result, blob) -> None:
+            trial_id = result.trial_id
+            if self.meta_store is not None:
+                row = self.meta_store.create_trial(
+                    self.sub_train_job_id, result.trial_no,
+                    model_id=self.model_id, knobs=result.knobs,
+                    worker_id=self.worker_id,
+                    budget_scale=result.budget_scale,
+                    shape_sig=shape_signature(knob_config, result.knobs))
+                trial_id = row["id"]
+            self.param_store.save(trial_id, blob)
+            if self.meta_store is not None:
+                self.meta_store.mark_trial_completed(
+                    trial_id, result.score, params_saved=True)
+            self._c_completed.inc()
+            self.trials_run += 1
+
+        engine = GangEngine(
+            self.model_class, self.advisor, self.train_dataset_path,
+            self.val_dataset_path, gang_size=gang_size, mode="gang",
+            knob_overrides=self.knob_overrides, metrics=self.metrics,
+            on_result=on_result)
+        results = engine.run(max_trials)
+        return len(results)
+
     # ---- the loop ----
     def run(self, max_trials: Optional[int] = None) -> int:
         """Pull proposals until the advisor says stop; returns #trials.
@@ -717,7 +768,8 @@ def main(argv: Optional[list] = None) -> int:
     print(f"train worker {worker.worker_id} obs on "
           f"{obs_host}:{obs_port}", flush=True)
     try:
-        n = worker.run()
+        gang_size = int(cfg.get("gang_size") or 0)
+        n = worker.run_gang(gang_size) if gang_size >= 1 else worker.run()
     finally:
         worker.stop_obs()
     print(f"train worker {worker.worker_id} done: {n} trials", flush=True)

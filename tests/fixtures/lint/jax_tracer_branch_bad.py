@@ -16,3 +16,12 @@ def loop_while(x, n):
         total = total + 1
         n = n - 1
     return total
+
+
+@jax.jit
+def gang_train_step(state, dropout, batch):
+    # the gang-engine failure mode: a traceable knob arrives as a traced
+    # per-lane scalar — a Python `if` on it branches on the TRACE
+    if dropout > 0:  # traced hyperparameter in a Python branch
+        return state * (1.0 - dropout)
+    return state

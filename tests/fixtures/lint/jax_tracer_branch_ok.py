@@ -37,3 +37,10 @@ def annotated_config(x, causal: bool):
 @jax.jit
 def on_device_branch(x, limit):
     return jnp.where(limit > 0, x, -x)  # the traced way to branch
+
+
+@jax.jit
+def gang_train_step(state, dropout, batch):
+    # traceable knobs stay in jnp-land: masks/where instead of `if`
+    keep = 1.0 - dropout
+    return state * jnp.where(keep > 0.5, keep, 1.0)

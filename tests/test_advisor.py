@@ -238,6 +238,88 @@ def test_bohb_concurrent_workers_race_final_trial():
     assert adv.best_effort.budget_scale >= 1.0
 
 
+def test_bohb_tpe_survives_high_dim_small_sample():
+    """Regression: with more search dimensions than top-quantile points
+    (any 4-knob template after ~8 completions) the TPE KDE covariance
+    is singular and scipy raises ValueError — the sampler must fall
+    back to random exploration, not crash the advisor."""
+    cfg = {f"k{i}": FloatKnob(0.0, 1.0) for i in range(4)}
+    adv = make_advisor(cfg, "bohb", total_trials=24, seed=0)
+    run_search(adv, lambda knobs: sum(knobs[f"k{i}"] for i in range(4)),
+               budget_scale_aware=True)
+    assert len(adv.results) == 24
+    assert adv.best_effort is not None
+
+
+@pytest.mark.parametrize("advisor_type", ["random", "bohb"])
+def test_propose_batch_equals_sequential_proposes(advisor_type):
+    """Batched-advisor determinism: propose_batch(k) must hand out the
+    exact knob sets k sequential propose() calls would (same seed →
+    same proposals, regardless of lane count), and stay deterministic
+    across identically-fed advisors."""
+    cfg = bohb_config() if advisor_type == "bohb" else search_config()
+    a = make_advisor(cfg, advisor_type, total_trials=24, seed=11)
+    b = make_advisor(cfg, advisor_type, total_trials=24, seed=11)
+    batch = a.propose_batch(6)
+    seq = [b.propose() for _ in range(6)]
+    assert [p.knobs for p in batch] == [p.knobs for p in seq]
+    assert [p.budget_scale for p in batch] == [p.budget_scale for p in seq]
+    # identical feedback → identical NEXT batches (rung/posterior state
+    # advances the same way through the batched verbs)
+    results = [TrialResult(trial_no=p.trial_no, knobs=p.knobs,
+                           score=quadratic_score(p.knobs),
+                           trial_id=f"t{p.trial_no}",
+                           budget_scale=p.budget_scale, meta=p.meta)
+               for p in batch]
+    a.feedback_batch(results)
+    for r in results:
+        b.feedback(r)
+    batch2 = a.propose_batch(4)
+    seq2 = [b.propose() for _ in range(4)]
+    assert [p.knobs for p in batch2] == [p.knobs for p in seq2]
+    assert [p.warm_start_trial_id for p in batch2] == \
+        [p.warm_start_trial_id for p in seq2]
+
+
+def test_propose_batch_respects_budget_and_lane_count():
+    adv = make_advisor(search_config(), "random", total_trials=5, seed=0)
+    batch = adv.propose_batch(8)  # more lanes than budget
+    assert len(batch) == 5
+    assert [p.trial_no for p in batch] == [0, 1, 2, 3, 4]
+    assert adv.propose_batch(3) == []
+    # lane count does not change the knob stream: a same-seed advisor
+    # pulled in different batch sizes sees the same sequence
+    a = make_advisor(search_config(), "random", total_trials=6, seed=3)
+    b = make_advisor(search_config(), "random", total_trials=6, seed=3)
+    knobs_a = [p.knobs for p in a.propose_batch(2)] + \
+        [p.knobs for p in a.propose_batch(4)]
+    knobs_b = [p.knobs for p in b.propose_batch(6)]
+    assert knobs_a == knobs_b
+
+
+def test_advisor_service_batch_verbs():
+    from rafiki_tpu.advisor.service import AdvisorClient, AdvisorService
+
+    adv = make_advisor(bohb_config(), "bohb", total_trials=6, seed=4)
+    ref = make_advisor(bohb_config(), "bohb", total_trials=6, seed=4)
+    svc = AdvisorService(adv)
+    host, port = svc.start()
+    try:
+        client = AdvisorClient(f"http://{host}:{port}")
+        batch = client.propose_batch(6)
+        assert [p.knobs for p in batch] == \
+            [p.knobs for p in ref.propose_batch(6)]
+        client.feedback_batch([
+            TrialResult(trial_no=p.trial_no, knobs=p.knobs,
+                        score=quadratic_score(p.knobs),
+                        trial_id=f"t{p.trial_no}",
+                        budget_scale=p.budget_scale, meta=p.meta)
+            for p in batch])
+        assert client.status()["n_results"] == 6
+    finally:
+        svc.stop()
+
+
 def test_arch_evolution_advisor():
     """ENAS-lite: seeds a random population, then mutates tournament
     winners; a non-shape mutation inherits the parent's params

@@ -7,8 +7,9 @@ from rafiki_tpu.model.knob import (BaseKnob, CategoricalKnob, FixedKnob,
                                    knob_config_from_json, knob_config_to_json,
                                    knobs_from_unit_vector,
                                    knobs_to_unit_vector, sample_knobs,
-                                   shape_signature, tunable_knobs,
-                                   validate_knobs)
+                                   shape_signature, static_signature,
+                                   traceable_knobs, tunable_knobs,
+                                   validate_knobs, validate_override_keys)
 
 
 def make_config():
@@ -98,3 +99,59 @@ def test_invalid_domains():
         FloatKnob(0.0, 1.0, is_exp=True)
     with pytest.raises(ValueError):
         CategoricalKnob([])
+
+
+def traced_config():
+    return {
+        "lr": FloatKnob(1e-5, 1e-1, is_exp=True, traceable=True),
+        "dropout": FloatKnob(0.0, 0.5, traceable=True),
+        "hidden": IntegerKnob(32, 512, is_exp=True, shape_relevant=True),
+        "opt": CategoricalKnob(["adam", "sgd"]),
+        "epochs": FixedKnob(3),
+        "quick": PolicyKnob("QUICK_TRAIN"),
+    }
+
+
+def test_traceable_trait_and_json_round_trip():
+    cfg = traced_config()
+    assert traceable_knobs(cfg) == ["dropout", "lr"]
+    cfg2 = knob_config_from_json(knob_config_to_json(cfg))
+    assert cfg == cfg2
+    assert cfg2["lr"].traceable and not cfg2["hidden"].traceable
+    # pre-trait wire forms (no "traceable" key) stay loadable
+    legacy = {k: {kk: vv for kk, vv in d.items() if kk != "traceable"}
+              for k, d in knob_config_to_json(cfg).items()}
+    loaded = knob_config_from_json(legacy)
+    assert all(not k.traceable for k in loaded.values())
+
+
+def test_traceable_excludes_shape_relevant():
+    with pytest.raises(ValueError, match="shape_relevant and traceable"):
+        FloatKnob(0.0, 1.0, shape_relevant=True, traceable=True)
+
+
+def test_static_signature_buckets():
+    cfg = traced_config()
+    a = sample_knobs(cfg, random.Random(0))
+    # traceable knobs never fork the bucket
+    b = dict(a, lr=a["lr"] * 0.1, dropout=0.4)
+    # policy knobs are scheduling, not program — BOHB flips them per rung
+    c = dict(a, quick=not a["quick"])
+    # static knobs (shape or not) do fork it
+    d = dict(a, opt="sgd" if a["opt"] == "adam" else "adam")
+    e = dict(a, hidden=a["hidden"] + 1)
+    assert static_signature(cfg, a) == static_signature(cfg, b)
+    assert static_signature(cfg, a) == static_signature(cfg, c)
+    assert static_signature(cfg, a) != static_signature(cfg, d)
+    assert static_signature(cfg, a) != static_signature(cfg, e)
+
+
+def test_validate_override_keys_shared_validator():
+    cfg = traced_config()
+    validate_override_keys(cfg, None)
+    validate_override_keys(cfg, {})
+    validate_override_keys(cfg, {"lr": 1e-3, "hidden": 64})
+    with pytest.raises(ValueError, match="knob_overrides.*learnin_rate"):
+        validate_override_keys(cfg, {"learnin_rate": 1e-3})
+    with pytest.raises(ValueError, match="job pins.*bogus"):
+        validate_override_keys(["lr"], {"bogus": 1}, context="job pins")

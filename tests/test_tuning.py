@@ -1,0 +1,245 @@
+"""Gang-compiled tuning engine: equivalence vs the sequential/process
+paths, static-bucket compile accounting, and the worker/dev plumbing.
+
+The load-bearing claims (ISSUE 8 acceptance):
+- a 1-lane gang run scores IDENTICALLY to the sequential ``tune_model``
+  path on the MLP template (vmapped lane == sequential trial);
+- ASHA/BOHB culls the same trial set in gang mode as in process mode
+  for a fixed seed (same proposals, same scores, same promotions);
+- compile count equals the number of static knob buckets, not the
+  number of trials (asserted via the jitted step's compilation cache).
+"""
+
+import numpy as np
+import pytest
+
+from rafiki_tpu.advisor import make_advisor
+from rafiki_tpu.model import tune_model
+from rafiki_tpu.models.mlp import JaxFeedForward
+from rafiki_tpu.models.tabular import JaxTabularMLP
+from rafiki_tpu.tuning import GangEngine, supports_gang
+
+#: shape pins so every proposal lands in ONE static bucket (the knobs
+#: the advisor still searches — learning_rate (+ dropout for tabular) —
+#: are traceable, i.e. per-lane traced operands)
+MLP_PINS = {"hidden_layer_count": 1, "hidden_layer_units": 24,
+            "batch_size": 32}
+TAB_PINS = {"hidden_layer_count": 2, "hidden_layer_units": 32,
+            "batch_size": 128}
+
+
+@pytest.fixture(scope="module")
+def image_data(tmp_path_factory):
+    from rafiki_tpu.data import generate_image_classification_dataset
+
+    d = tmp_path_factory.mktemp("gang_img")
+    tr, va = str(d / "tr.npz"), str(d / "va.npz")
+    generate_image_classification_dataset(tr, 256, seed=0)
+    generate_image_classification_dataset(va, 96, seed=1)
+    return tr, va
+
+
+@pytest.fixture(scope="module")
+def table_data(tmp_path_factory):
+    from rafiki_tpu.data import generate_tabular_dataset
+
+    d = tmp_path_factory.mktemp("gang_tab")
+    tr, va = str(d / "tr.npz"), str(d / "va.npz")
+    generate_tabular_dataset(tr, 384, seed=0)
+    generate_tabular_dataset(va, 128, seed=1)
+    return tr, va
+
+
+def result_tuples(results):
+    return [(r.trial_no, r.score, r.budget_scale, r.meta.get("rung"),
+             r.meta.get("parent_trial_no")) for r in results]
+
+
+def test_supports_gang_detection():
+    from rafiki_tpu.models.resnet import ResNetClassifier
+
+    assert supports_gang(JaxFeedForward)
+    assert supports_gang(JaxTabularMLP)
+    # gang_epochs without make_gang_spec is not enough
+    assert not supports_gang(ResNetClassifier)
+    with pytest.raises(ValueError, match="make_gang_spec"):
+        GangEngine(ResNetClassifier, object(), "tr", "va", mode="gang")
+
+
+def test_one_lane_gang_scores_equal_sequential_tune_model(image_data):
+    """ISSUE criterion (a): a 1-lane gang IS a sequential trial — same
+    proposals, bit-equal scores (vmap over one lane changes nothing)."""
+    tr, va = image_data
+    seq = tune_model(JaxFeedForward, tr, va, total_trials=3,
+                     advisor_type="random", seed=7)
+    adv = make_advisor(JaxFeedForward.get_knob_config(), "random",
+                       total_trials=3, seed=7)
+    eng = GangEngine(JaxFeedForward, adv, tr, va, gang_size=1,
+                     mode="gang")
+    results = eng.run()
+    assert [r.knobs for r in results] == [t.knobs for t in seq.trials]
+    assert [r.score for r in results] == [t.score for t in seq.trials]
+    assert adv.best_effort.score == seq.best_score
+
+
+def test_gang_asha_culls_match_process_mode(table_data):
+    """ISSUE criterion (b): same seed → gang mode and process mode feed
+    the advisor identical scores in identical order, so BOHB promotes
+    (and therefore culls) the same trial set. Covers two traceable
+    knobs (lr + dropout) and in-lane warm-started promotions."""
+    tr, va = table_data
+    kc = JaxTabularMLP.get_knob_config()
+    a_gang = make_advisor(kc, "bohb", total_trials=8, seed=5)
+    e_gang = GangEngine(JaxTabularMLP, a_gang, tr, va, gang_size=4,
+                        mode="gang", knob_overrides=TAB_PINS)
+    r_gang = e_gang.run()
+    a_proc = make_advisor(kc, "bohb", total_trials=8, seed=5)
+    e_proc = GangEngine(JaxTabularMLP, a_proc, tr, va, gang_size=4,
+                        mode="sequential", knob_overrides=TAB_PINS)
+    r_proc = e_proc.run()
+    assert result_tuples(r_gang) == result_tuples(r_proc)
+    promoted_gang = {r.meta.get("parent_trial_no") for r in r_gang
+                     if r.meta.get("parent_trial_no") is not None}
+    promoted_proc = {r.meta.get("parent_trial_no") for r in r_proc
+                     if r.meta.get("parent_trial_no") is not None}
+    assert promoted_gang == promoted_proc
+    culled_gang = {r.trial_no for r in r_gang} - promoted_gang
+    culled_proc = {r.trial_no for r in r_proc} - promoted_proc
+    assert culled_gang == culled_proc
+    assert promoted_gang, "fixture must exercise at least one promotion"
+    # every proposal shared the pinned bucket: exactly one compile total
+    assert e_gang.n_buckets == 1
+    assert list(e_gang.compile_counts().values()) == [1]
+    assert a_gang.best_effort.score == a_proc.best_effort.score
+
+
+def test_compile_count_equals_static_buckets_not_trials(image_data):
+    """ISSUE criterion (c): with batch_size free (a shape knob) trials
+    spread over up to 3 buckets; the jitted step count — via JAX's own
+    compilation cache — must equal the bucket count, NOT the trial
+    count."""
+    tr, va = image_data
+    pins = {"hidden_layer_count": 1, "hidden_layer_units": 24}
+    adv = make_advisor(JaxFeedForward.get_knob_config(), "random",
+                       total_trials=6, seed=2)
+    eng = GangEngine(JaxFeedForward, adv, tr, va, gang_size=2,
+                     mode="gang", knob_overrides=pins)
+    results = eng.run()
+    assert len(results) == 6
+    batch_sizes = {r.knobs["batch_size"] for r in results}
+    assert len(batch_sizes) >= 2, "seed must spread over buckets"
+    assert eng.n_buckets == len(batch_sizes)
+    assert len(results) > eng.n_buckets
+    counts = eng.compile_counts()
+    # one executable per bucket: no silent per-trial recompiles
+    assert list(counts.values()) == [1] * len(batch_sizes)
+
+
+def test_gang_max_trials_cap_enforced_mid_session(image_data):
+    """Regression: the cap bounds trials STARTED on every lane refill,
+    not just between bucket sessions — and proposals pulled but never
+    laned are released back to the advisor (no stranded outstanding
+    slots)."""
+    tr, va = image_data
+    adv = make_advisor(JaxFeedForward.get_knob_config(), "random",
+                       total_trials=64, seed=0)
+    eng = GangEngine(JaxFeedForward, adv, tr, va, gang_size=2,
+                     mode="gang", knob_overrides=MLP_PINS)
+    results = eng.run(max_trials=4)
+    assert len(results) == 4
+    assert eng.stats["trials_started"] == 4
+    assert not adv._outstanding
+
+
+def test_tune_model_gang_path_and_override_validation(image_data):
+    tr, va = image_data
+    res = tune_model(JaxFeedForward, tr, va, total_trials=4,
+                     advisor_type="random", seed=3, gang_size=2,
+                     knob_overrides=MLP_PINS)
+    assert len(res.trials) == 4
+    assert res.best_score == max(t.score for t in res.trials)
+    assert res.best_params and "params" in res.best_params
+    # the dev loop now fails fast on typo'd override keys, exactly like
+    # the admin API's job-level validation (shared validator)
+    with pytest.raises(ValueError, match="knob_overrides.*learnin_rate"):
+        tune_model(JaxFeedForward, tr, va, total_trials=1,
+                   knob_overrides={"learnin_rate": 1e-3})
+    with pytest.raises(ValueError, match="knob_overrides.*learnin_rate"):
+        tune_model(JaxFeedForward, tr, va, total_trials=1, gang_size=2,
+                   knob_overrides={"learnin_rate": 1e-3})
+
+
+def test_tune_model_gang_falls_back_without_spec(tmp_path):
+    """A template without a gang spec warns and runs the sequential
+    loop — gang_size is a hint, not a hard requirement."""
+    from rafiki_tpu.model import BaseModel, FixedKnob
+
+    calls = []
+
+    class _Toy(BaseModel):
+        @staticmethod
+        def get_knob_config():
+            return {"c": FixedKnob(1)}
+
+        def train(self, dataset_path, ctx=None):
+            calls.append("train")
+
+        def evaluate(self, dataset_path):
+            return 0.5
+
+        def predict(self, queries):
+            return [0.0 for _ in queries]
+
+        def dump_parameters(self):
+            return {"w": np.zeros(1)}
+
+        def load_parameters(self, params):
+            pass
+
+    with pytest.warns(UserWarning, match="no gang spec"):
+        res = tune_model(_Toy, "tr", "va", total_trials=2,
+                         advisor_type="random", gang_size=4)
+    assert calls == ["train", "train"]
+    assert res.best_score == 0.5
+
+
+def test_gang_obs_gauges_ride_metrics_registry(image_data):
+    from rafiki_tpu.obs import MetricsRegistry
+
+    tr, va = image_data
+    reg = MetricsRegistry()
+    adv = make_advisor(JaxFeedForward.get_knob_config(), "bohb",
+                       total_trials=6, seed=1)
+    eng = GangEngine(JaxFeedForward, adv, tr, va, gang_size=3,
+                     mode="gang", knob_overrides=MLP_PINS, metrics=reg)
+    results = eng.run()
+    snap = reg.snapshot()
+    assert snap["gang_lanes_active"] == 0  # drained at exit
+    assert snap["trials_per_hour"] > 0
+    assert snap["gang_lanes_culled_total"] == sum(
+        1 for r in results if r.budget_scale < 1.0 - 1e-9)
+    assert eng.stats["trials_completed"] == len(results)
+
+
+def test_train_worker_gang_mode(image_data):
+    """Worker plumbing: run_gang reports one completed trial per lane
+    through the worker's stores/counters (dashboard parity with process
+    trials)."""
+    from rafiki_tpu.worker.train import TrainWorker
+
+    tr, va = image_data
+    adv = make_advisor(JaxFeedForward.get_knob_config(), "random",
+                       total_trials=4, seed=9)
+    worker = TrainWorker(JaxFeedForward, adv, tr, va,
+                         knob_overrides=MLP_PINS,
+                         checkpoint_interval_s=0)
+    n = worker.run_gang(gang_size=2)
+    assert n == 4
+    assert worker.trials_run == 4
+    snap = worker.metrics.snapshot()
+    assert snap["trials_completed"] == 4
+    assert snap["gang_lanes_active"] == 0
+    assert snap["trials_per_hour"] > 0
+    # params of every lane-trial landed in the worker's ParamStore
+    for r in adv.results:
+        assert worker.param_store.load(r.trial_id) is not None
