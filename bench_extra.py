@@ -883,6 +883,130 @@ def _bench_failover(out_path: str) -> None:
     })
 
 
+def _bench_admin_recovery(out_path: str) -> None:
+    """kill -9 a REAL control-plane process under streaming load,
+    restart it against the same workdir, and measure what matters:
+    time-to-reconverge (second boot → full re-adoption, including the
+    lease-TTL wait) and the load the DATA PLANE dropped during the
+    control plane's death (target: zero — the kvd and every worker
+    survive and are adopted, so streams never notice)."""
+    import os
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+
+    from rafiki_tpu.native.client import KVClient
+
+    workdir = tempfile.mkdtemp(prefix="bench_admin_recovery_")
+    lease_ttl = 3.0
+    n_services = 4
+
+    def start_driver(mode: str, ready: str) -> subprocess.Popen:
+        cfg = {"workdir": workdir, "db_path": f"{workdir}/meta.db",
+               "n_services": n_services, "mode": mode,
+               "ready_file": f"{workdir}/{ready}",
+               "lease_ttl_s": lease_ttl}
+        path = f"{workdir}/{ready}.cfg.json"
+        with open(path, "w") as f:
+            json.dump(cfg, f)
+        return subprocess.Popen(
+            [sys.executable, "-m", "rafiki_tpu.chaos.control_driver",
+             "--config", path],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+
+    def wait_ready(name: str, proc: subprocess.Popen,
+                   timeout: float = 120.0) -> dict:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if os.path.exists(f"{workdir}/{name}"):
+                with open(f"{workdir}/{name}") as f:
+                    return json.load(f)
+            if proc.poll() is not None:
+                raise RuntimeError(f"driver died rc={proc.returncode}")
+            time.sleep(0.05)
+        raise TimeoutError(name)
+
+    p1 = start_driver("boot", "r1.json")
+    r1 = wait_ready("r1.json", p1)
+
+    # streaming load over the kvd queues: sequence-numbered round
+    # trips; any missing seq = a dropped message, gaps in the round-
+    # trip timeline = data-plane unavailability
+    stop_load = threading.Event()
+    sent, got, times = [], [], []
+
+    def load() -> None:
+        cli = KVClient("127.0.0.1", int(r1["kv_port"]))
+        seq = 0
+        while not stop_load.is_set():
+            try:
+                cli.rpush("bench:stream", str(seq).encode())
+                sent.append(seq)
+                out = cli.brpop("bench:stream", timeout=2.0)
+                if out is not None:
+                    got.append(int(out[1]))
+                    times.append(time.monotonic())
+                seq += 1
+                time.sleep(0.005)
+            except OSError:
+                time.sleep(0.05)  # transport gap — shows up as a
+                # round-trip gap in `times`, which is the measurement
+
+    loader = threading.Thread(target=load, daemon=True)
+    loader.start()
+    time.sleep(1.0)  # steady-state load before the kill
+
+    t_kill = time.monotonic()
+    os.kill(p1.pid, signal.SIGKILL)
+    p1.wait()
+    p2 = start_driver("reconcile", "r2.json")
+    try:
+        r2 = wait_ready("r2.json", p2)
+        reconverge_s = time.monotonic() - t_kill
+        time.sleep(1.0)  # load continues after recovery
+        stop_load.set()
+        loader.join(timeout=10)
+        gaps = [b - a for a, b in zip(times, times[1:])]
+        _record(out_path, {
+            "stage": "admin_recovery", "backend": "cpu",
+            "reconverge_s": round(reconverge_s, 3),
+            "lease_ttl_s": lease_ttl,
+            "driver_boot_s": r2.get("boot_s"),
+            "services_expected": n_services,
+            "services_adopted": r2.get("services_adopted"),
+            "kv_adopted": r2.get("kv_adopted"),
+            "adopted_pids_match": sorted(r2.get("adopted_pids") or [])
+            == sorted(r1.get("spawned_pids") or []),
+            "lease_generation": r2.get("lease_generation"),
+            "stream_msgs": len(sent),
+            "dropped_stream_msgs": len(set(sent[:-1]) - set(got)),
+            "stream_max_gap_s": round(max(gaps), 3) if gaps else None,
+        })
+    finally:
+        p2.terminate()
+        try:
+            p2.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            p2.kill()
+        # p1 was SIGKILLed by design, orphaning its kvd + dummies; p2
+        # normally adopts-then-stops them, but if the reconcile leg
+        # failed they would outlive the bench — sweep them from the
+        # MetaStore rows (identity-gated) like `stack stop` does, then
+        # drop the scratch workdir
+        try:
+            import shutil
+            from pathlib import Path
+
+            from rafiki_tpu.admin.stack import _reap_orphans
+
+            _reap_orphans(Path(workdir))
+            shutil.rmtree(workdir, ignore_errors=True)
+        except Exception as e:  # noqa: BLE001 — cleanup best-effort
+            print(f"admin_recovery cleanup failed: {e!r}",
+                  file=sys.stderr)
+
+
 def _child(out_path: str, budget: float, use_kv: bool) -> None:
     t_start = time.monotonic()
 
@@ -949,6 +1073,13 @@ def _child(out_path: str, budget: float, use_kv: bool) -> None:
             _bench_failover(out_path)
         except Exception as e:  # noqa: BLE001
             _record(out_path, {"stage": "failover_error",
+                               "error": repr(e)[:300]})
+
+    if budget - (time.monotonic() - t_start) > 30:
+        try:
+            _bench_admin_recovery(out_path)
+        except Exception as e:  # noqa: BLE001
+            _record(out_path, {"stage": "admin_recovery_error",
                                "error": repr(e)[:300]})
 
     if budget - (time.monotonic() - t_start) > 120:
@@ -1108,6 +1239,22 @@ def main() -> None:
             "max_new": fo["max_new"],
             "breaker_trips": fo["breaker_trips"],
             "stream_total_s": round(fo["stream_total_s"], 3)}))
+    ar = next((r for r in records
+               if r.get("stage") == "admin_recovery"), None)
+    if ar:
+        print(json.dumps({
+            "metric": "admin_recovery_reconverge_s",
+            "value": ar["reconverge_s"], "unit": "s",
+            "backend": ar["backend"],
+            "lease_ttl_s": ar["lease_ttl_s"],
+            "services_adopted": ar["services_adopted"],
+            "services_expected": ar["services_expected"],
+            "kv_adopted": ar["kv_adopted"],
+            "adopted_pids_match": ar["adopted_pids_match"],
+            "lease_generation": ar["lease_generation"],
+            "dropped_stream_msgs": ar["dropped_stream_msgs"],
+            "stream_max_gap_s": ar["stream_max_gap_s"],
+            "stream_msgs": ar["stream_msgs"]}))
     mo = next((r for r in records
                if r.get("stage") == "metrics_overhead"), None)
     if mo:

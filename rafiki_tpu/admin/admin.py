@@ -46,9 +46,20 @@ class Admin:
 
     # ---- lifecycle ----
     def start_monitor(self, interval_s: float = 0.5) -> None:
+        # lease renewal lives on the ServicesManager's OWN heartbeat
+        # thread (started at acquire_lease time, before reconcile —
+        # and idempotent here): it never takes op_lock, so a spawn's
+        # 180s port-wait cannot starve the heartbeat past the TTL and
+        # hand the stack to a concurrent boot
+        self.services.start_lease_heartbeat()
+
         def loop() -> None:
             while not self._monitor_stop.wait(interval_s):
                 try:
+                    # a fenced admin must stop respawning/finalizing:
+                    # the children now belong to the new admin
+                    if self.services.fenced:
+                        continue
                     self.services.poll()
                     self._finalize_finished_train_jobs()
                 except Exception:  # keep the monitor alive — but a
@@ -65,7 +76,7 @@ class Admin:
         self._monitor_stop.set()
         if self._monitor is not None:
             self._monitor.join(timeout=5)
-        self.services.stop_all()
+        self.services.stop_all()  # also stops the lease heartbeat
 
     def _finalize_finished_train_jobs(self) -> None:
         running = [s for s in self.services.services.values()
@@ -119,6 +130,12 @@ class Admin:
                     user_type: str) -> Dict[str, Any]:
         u = self.meta.create_user(email, password, user_type)
         return {k: u[k] for k in ("id", "email", "user_type")}
+
+    # ---- control-plane backup ----
+    def backup(self, path: str) -> Dict[str, Any]:
+        """Online MetaStore snapshot (consistent under concurrent
+        writers) — the pre-risky-ops step of the recovery runbook."""
+        return self.meta.backup(path)
 
     # ---- models ----
     def create_model(self, user_id: str, name: str, task: str,

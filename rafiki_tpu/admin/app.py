@@ -40,6 +40,28 @@ class AdminApp:
         self.metrics.gauge(
             "admin_pending_respawns", "slot-starved respawns queued",
             fn=lambda: svcs.respawn_stats()["pending_respawns"])
+        # crash-recovery plane: what the boot reconciler did and where
+        # the single-writer lease stands (docs/observability.md)
+        self.metrics.gauge(
+            "admin_services_adopted",
+            "live services re-adopted by the boot reconciler",
+            fn=lambda: svcs.recovery["services_adopted"])
+        self.metrics.gauge(
+            "admin_orphans_reaped",
+            "stopped-job survivors killed by the boot reconciler",
+            fn=lambda: svcs.recovery["orphans_reaped"])
+        self.metrics.gauge(
+            "admin_services_crashed",
+            "service rows found dead at boot (CRASHED)",
+            fn=lambda: svcs.recovery["services_crashed"])
+        self.metrics.gauge(
+            "admin_lease_takeovers",
+            "expired-lease takeovers performed by this admin",
+            fn=lambda: svcs.recovery["lease_takeovers"])
+        self.metrics.gauge(
+            "admin_lease_generation",
+            "fencing generation of the held admin lease",
+            fn=lambda: svcs.lease_generation)
         self.http = JsonHttpService(host, port, registry=self.metrics)
         r = self.http.route
         # /metrics is numeric-only and stays open like /health; the
@@ -74,6 +96,7 @@ class AdminApp:
           self._auth(self._stop_inference_job))
         r("POST", "/inference_jobs/<id>/rolling_restart",
           self._auth(self._rolling_restart))
+        r("POST", "/system/backup", self._auth(self._backup))
 
     def start(self) -> Tuple[str, int]:
         return self.http.start()
@@ -148,7 +171,10 @@ class AdminApp:
                      "free_slots": svc.allocator.free_count(),
                      **svc.respawn_stats(),
                      "degraded_jobs": len(degraded),
-                     "degraded": degraded}
+                     "degraded": degraded,
+                     # boot-reconciler outcome + lease state: feeds the
+                     # dashboard's recovery banner
+                     "recovery": svc.recovery_stats()}
 
     def _login(self, _m, body, _h) -> Tuple[int, Any]:
         try:
@@ -238,6 +264,25 @@ class AdminApp:
         self.admin.stop_inference_job(m["id"])
         return 200, {"ok": True}
 
+    def _backup(self, _m, body, user) -> Tuple[int, Any]:
+        """Online MetaStore snapshot to a server-side path — the
+        "before risky ops" half of the recovery runbook. Superadmin
+        only: the path lands on the admin host's filesystem."""
+        from ..constants import UserType
+
+        if user.get("user_type") not in (UserType.SUPERADMIN,
+                                         UserType.ADMIN):
+            return 403, {"error": "backup requires an admin user"}
+        path = str(body.get("path") or "")
+        if not path:
+            return 400, {"error": "body must name a backup 'path'"}
+        try:
+            return 200, {"ok": True, **self.admin.backup(path)}
+        except NotImplementedError as e:
+            return 501, {"error": str(e)}
+        except OSError as e:
+            return 500, {"error": f"backup failed: {e}"}
+
     def _rolling_restart(self, m, body, user) -> Tuple[int, Any]:
         """Zero-downtime worker cycling: drain→stop→respawn each of the
         job's workers one at a time (deploys/config reloads that must
@@ -263,12 +308,12 @@ def main(argv: Optional[list] = None) -> int:
     apply_platform_env()
 
     from ..store.meta_store import MetaStore
-    from .services_manager import ServicesManager
+    from .services_manager import LeaseHeldError, ServicesManager
 
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", required=True,
                         help="JSON: {workdir, db_path, host, port, "
-                             "slot_size, port_file}")
+                             "slot_size, port_file, lease_ttl_s}")
     args = parser.parse_args(argv)
     with open(args.config) as f:
         cfg = json.load(f)
@@ -277,11 +322,65 @@ def main(argv: Optional[list] = None) -> int:
     manager = ServicesManager(meta, cfg["workdir"],
                               slot_size=int(cfg.get("slot_size", 1)),
                               default_workers=int(cfg.get("workers", 1)))
-    # restart adoption: rows left RUNNING by a dead admin are stale
-    reaped = manager.reap_stale_services()
-    if reaped:
-        print(f"reaped {reaped} stale service rows", flush=True)
+    # single-writer fencing: refuse to run against a MetaStore a LIVE
+    # admin owns (a duplicate boot would spawn a second stack on chips
+    # the first still holds); an EXPIRED lease is taken over with a
+    # bumped fencing generation. A crash-restart lands here within the
+    # dead holder's TTL, so retry for lease_wait_s (default TTL + 5 s)
+    # before giving up — a LIVE holder keeps renewing and wins every
+    # retry, so duplicates are still refused (lease_wait_s=0 restores
+    # strict fail-fast).
+    import time as _time
+
+    ttl_s = float(cfg.get("lease_ttl_s", 15.0))
+    wait_s = float(cfg.get("lease_wait_s", ttl_s + 5.0))
+    lease_deadline = _time.monotonic() + wait_s
+    while True:
+        try:
+            lease = manager.acquire_lease(ttl_s=ttl_s)
+            break
+        except LeaseHeldError as e:
+            if _time.monotonic() < lease_deadline:
+                _time.sleep(0.25)
+                continue
+            # structured error on stdout (→ admin.log) so `stack start`
+            # and operators see WHY the boot was refused
+            print(json.dumps({"error": "admin_lease_held",
+                              "detail": str(e), "lease": e.lease}),
+                  flush=True)
+            return 3
+    if lease.get("took_over"):
+        print(f"took over expired admin lease (generation "
+              f"{lease['generation']})", flush=True)
+    # heartbeat BEFORE reconcile: reconciling can exceed the TTL
+    # (per-orphan kill grace, health probes) and an unrenewed lease
+    # would let a concurrent boot take over mid-reconcile
+    manager.start_lease_heartbeat()
+    if cfg.get("cold_start"):
+        # operator opt-out of adoption (`stack start --cold`): kill
+        # every recorded survivor and boot from a clean slate — for
+        # when the previous stack's state is not to be trusted
+        reaped = manager.reap_stale_services()
+        print(f"cold start: reaped {reaped} stale service row(s)",
+              flush=True)
+    else:
+        # crash-only boot: re-adopt surviving services, crash+respawn
+        # the dead, reap orphans — the rows are the source of truth
+        recovery = manager.reconcile()
+        print("reconciled: "
+              f"{recovery['services_adopted']} adopted, "
+              f"{recovery['services_crashed']} crashed, "
+              f"{recovery['orphans_reaped']} orphans reaped",
+              flush=True)
     manager.start_data_plane()
+
+    # deterministic chaos: arm the admin-suicide timer when configured
+    # (RAFIKI_CHAOS kill_admin_after_s — the "SIGKILL mid-load" drill)
+    from ..chaos import ChaosConfig, arm_admin_kill
+
+    chaos_cfg = ChaosConfig.from_env()
+    if chaos_cfg is not None:
+        arm_admin_kill(chaos_cfg)
     admin = Admin(meta, manager)
     admin.start_monitor()
     app = AdminApp(admin, cfg.get("host", "127.0.0.1"),

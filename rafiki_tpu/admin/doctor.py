@@ -1,0 +1,203 @@
+"""Workdir drift audit: MetaStore rows vs live pids vs slots vs ports.
+
+``rafiki-tpu doctor --workdir W`` compares the four places control-plane
+state lives — the MetaStore's ``services`` rows, the actual process
+table (``/proc``, identity-checked via recorded kernel start times),
+the recorded sub-mesh device assignments, and the ``*.obs_port``
+sidecar files — and prints every disagreement as a drift finding. Zero
+drift = the recorded world matches the real one; anything else is what
+an operator (or the recovery tests) needs to see before trusting a
+restarted control plane.
+
+Pure read-only: the audit never signals, spawns, or writes — it is safe
+to run against a LIVE stack.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+from .proc import identity_matches, pid_alive
+
+#: row states that claim a live process
+_LIVE_STATES = ("STARTED", "RUNNING")
+
+
+def audit_workdir(workdir: str,
+                  db_path: Optional[str] = None) -> Dict[str, Any]:
+    """Audit ``workdir`` and return the drift report (JSON-ready).
+
+    ``report["drift"]`` is the flat list of human-readable findings;
+    ``report["ok"]`` is True iff it is empty. Per-service detail rows
+    live under ``report["services"]``.
+    """
+    wd = Path(workdir)
+    db = Path(db_path) if db_path else wd / "meta.db"
+    report: Dict[str, Any] = {
+        "workdir": str(wd), "db_path": str(db), "checked_at": time.time(),
+        "services": [], "drift": [], "ok": True}
+    drift: List[str] = report["drift"]
+    if not db.exists():
+        drift.append(f"no MetaStore at {db} — nothing to audit against")
+        report["ok"] = False
+        return report
+
+    from ..store.meta_store import MetaStore
+
+    # mode=ro connection: the audit must be INCAPABLE of writing (or
+    # schema-migrating) a live stack's database, not merely polite
+    meta = MetaStore(str(db), read_only=True)
+    rows = meta.get_services()
+    claimed_ports: set = set()
+    device_owners: Dict[int, str] = {}
+    for row in rows:
+        pid = int(row.get("pid") or 0)
+        start_time = float(row.get("start_time") or 0)
+        spec = row.get("spawn_spec") or {}
+        status = row["status"]
+        alive = pid_alive(pid) if pid > 0 else False
+        ident = identity_matches(pid, start_time) if alive else False
+        entry = {
+            "id": row["id"], "service_type": row["service_type"],
+            "status": status, "pid": pid, "pid_alive": alive,
+            "identity_ok": ident, "start_time": start_time,
+            "port": int(row.get("port") or 0),
+            "devices": _devices(row), "has_spawn_spec": bool(spec)}
+        label = f"{row['service_type']} {row['id'][:8]}"
+        if status in _LIVE_STATES:
+            if not ident:
+                drift.append(
+                    f"{label}: row is {status} but pid {pid} is "
+                    + ("a DIFFERENT process (identity mismatch — "
+                       "recycled pid?)" if alive else "dead"))
+            else:
+                # live and ours: check its recorded probe channel and
+                # claim its devices for the double-booking check
+                port = _probe_port(row, spec, wd)
+                entry["probe_port"] = port
+                if port:
+                    claimed_ports.add(port)
+                    entry["probe_ok"] = _http_alive(
+                        row.get("host") or "127.0.0.1", port)
+                    if not entry["probe_ok"]:
+                        drift.append(
+                            f"{label}: pid {pid} is alive but port "
+                            f"{port} does not answer")
+                for dev in entry["devices"]:
+                    if dev in device_owners:
+                        drift.append(
+                            f"{label}: device {dev} is also recorded "
+                            f"for {device_owners[dev]} (double-booked "
+                            "sub-mesh)")
+                    device_owners[dev] = label
+        else:  # terminal row
+            if ident:
+                drift.append(
+                    f"{label}: row is {status} but pid {pid} is still "
+                    "alive (orphaned process)")
+            if status in ("ERRORED", "CRASHED") and not spec and \
+                    row["service_type"] in ("TRAIN_WORKER",
+                                            "INFERENCE_WORKER"):
+                drift.append(
+                    f"{label}: crashed worker row has no spawn_spec — "
+                    "unrecoverable by the reconciler (pre-recovery row?)")
+        report["services"].append(entry)
+
+    # obs_port sidecar files with no live owner are stale turds that can
+    # mislead the next drain/adoption
+    stale_ports = []
+    for pf in sorted(wd.glob("*.obs_port")):
+        try:
+            port = int(pf.read_text().strip())
+        except (OSError, ValueError):
+            drift.append(f"{pf.name}: unreadable obs_port file")
+            continue
+        if port not in claimed_ports and not _http_alive("127.0.0.1",
+                                                         port):
+            stale_ports.append(pf.name)
+    if stale_ports:
+        drift.append(
+            f"stale obs_port files (no live service on the recorded "
+            f"port): {', '.join(stale_ports)}")
+
+    lease = meta.get_admin_lease()
+    if lease:
+        age = time.time() - float(lease.get("heartbeat_at") or 0)
+        report["lease"] = {**lease, "heartbeat_age_s": round(age, 1)}
+        live_rows = any(s["status"] in _LIVE_STATES
+                        for s in report["services"])
+        if live_rows and age > 60.0:
+            drift.append(
+                f"admin lease heartbeat is {age:.0f}s old while "
+                "service rows claim to be live — the admin is gone; "
+                "restart it (it will re-adopt survivors)")
+    report["n_services"] = len(rows)
+    report["ok"] = not drift
+    return report
+
+
+def render_text(report: Dict[str, Any]) -> str:
+    """Human-readable rendering of :func:`audit_workdir`'s report."""
+    lines = [f"workdir audit: {report['workdir']}"]
+    for s in report.get("services", []):
+        mark = "ok " if (s["status"] not in _LIVE_STATES
+                         or s["identity_ok"]) else "DRIFT"
+        lines.append(
+            f"[{mark}] {s['service_type']:<17} {s['id'][:8]} "
+            f"{s['status']:<8} pid={s['pid']} "
+            f"alive={str(s['pid_alive']).lower()} "
+            f"identity={str(s['identity_ok']).lower()}"
+            + (f" devices={s['devices']}" if s["devices"] else ""))
+    lease = report.get("lease")
+    if lease:
+        lines.append(
+            f"lease: holder={str(lease.get('holder', ''))[:12]} "
+            f"generation={lease.get('generation')} "
+            f"heartbeat_age={lease.get('heartbeat_age_s')}s")
+    if report["drift"]:
+        lines.append(f"DRIFT ({len(report['drift'])} finding(s)):")
+        lines.extend(f"  - {d}" for d in report["drift"])
+    else:
+        lines.append("no drift: recorded state matches the live world")
+    return "\n".join(lines)
+
+
+def _devices(row: Dict[str, Any]) -> List[int]:
+    try:
+        return [int(d) for d in json.loads(row.get("devices") or "[]")]
+    except (ValueError, TypeError):
+        return []
+
+
+def _probe_port(row: Dict[str, Any], spec: Dict[str, Any],
+                wd: Path) -> int:
+    port = int(row.get("port") or 0)
+    if port > 0:
+        return port
+    port_file = ((spec.get("config") or {}).get("obs_port_file")
+                 if spec else None)
+    if port_file and Path(port_file).exists():
+        try:
+            return int(Path(port_file).read_text().strip())
+        except (OSError, ValueError):
+            return 0
+    return 0
+
+
+def _http_alive(host: str, port: int) -> bool:
+    """TCP-level liveness: any process accepting on the port counts
+    (not every service has /health; the audit checks reachability,
+    not route tables)."""
+    import socket
+
+    try:
+        with socket.create_connection((host, port), timeout=2.0):
+            return True
+    except OSError:
+        return False
+
+
+__all__ = ["audit_workdir", "render_text"]

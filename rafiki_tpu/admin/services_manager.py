@@ -15,6 +15,17 @@ sub-mesh" via env vars (``TPU_VISIBLE_CHIPS`` et al., SURVEY.md §7):
   Docker services; ``poll()`` is the failure detector (SURVEY.md §5.3).
 - The data plane (param blobs + query queues) is one ``rafiki-kvd``
   process per stack (the Redis container equivalent, SURVEY.md §5.8(b)).
+
+Crash-only control plane (the orchestrator-recovery duty of
+arXiv:1804.06087, which Docker Swarm carried for the reference): every
+spawn persists its FULL recipe (``spawn_spec``) and the child's kernel
+start time into the service row, so the row — not this object's dicts —
+is the source of truth. A restarted admin calls :meth:`reconcile` to
+re-ADOPT surviving children (identity-checked pid + health probe, slots
+re-reserved), crash-and-respawn the dead ones under the durable respawn
+budget, and reap orphans whose job was stopped meanwhile. A
+single-writer lease row (generation-fenced) keeps a stale or duplicate
+admin from spawning a second stack on chips the first still holds.
 """
 
 from __future__ import annotations
@@ -34,6 +45,37 @@ from ..constants import (ServiceStatus, ServiceType, SubTrainJobStatus,
 from ..parallel.mesh import DeviceSpec, SubMesh, SubMeshAllocator, \
     submesh_env_vars
 from ..store.meta_store import MetaStore
+from .proc import (AdoptedProcess, identity_matches, proc_start_time,
+                   terminate_pid)
+
+#: service rows in these states are settled history — never adopted,
+#: respawned, or reaped again
+_TERMINAL = (ServiceStatus.STOPPED, ServiceStatus.ERRORED,
+             ServiceStatus.CRASHED)
+
+#: worker service types eligible for self-healing respawn
+_WORKER_TYPES = (ServiceType.TRAIN_WORKER, ServiceType.INFERENCE_WORKER)
+
+
+class LeaseHeldError(RuntimeError):
+    """Another live admin holds the single-writer lease for this
+    MetaStore — booting a second control plane would double-spawn the
+    stack. Carries the holder/generation for a structured error."""
+
+    def __init__(self, lease: Dict[str, Any]) -> None:
+        self.lease = dict(lease)
+        age = time.time() - float(lease.get("heartbeat_at") or 0)
+        super().__init__(
+            f"admin lease held by {lease.get('holder', '?')[:12]} "
+            f"(generation {lease.get('generation')}, heartbeat "
+            f"{age:.1f}s ago) — a live admin owns this MetaStore; "
+            "stop it first or wait for its lease to expire")
+
+
+class AdminFencedError(RuntimeError):
+    """This manager LOST the lease (a newer admin took over): every
+    mutating operation is refused so the two control planes cannot
+    fight over the same processes and chips."""
 
 
 class ManagedService:
@@ -41,13 +83,17 @@ class ManagedService:
 
     def __init__(self, service_id: str, service_type: str,
                  proc: subprocess.Popen, slot: Optional[SubMesh] = None,
-                 host: str = "", port: int = 0) -> None:
+                 host: str = "", port: int = 0,
+                 adopted: bool = False) -> None:
         self.service_id = service_id
         self.service_type = service_type
         self.proc = proc
         self.slot = slot
         self.host = host
         self.port = port
+        #: True when this handle was rebuilt around a surviving pid by
+        #: the boot reconciler rather than spawned by this manager
+        self.adopted = adopted
 
     @property
     def url(self) -> str:
@@ -57,15 +103,6 @@ class ManagedService:
         return self.proc.poll() is None
 
 
-def _cmdline_is_ours(pid: int) -> bool:
-    """Guard against recycled pids before killing a recorded service pid:
-    only processes whose cmdline looks like a rafiki service count."""
-    try:
-        with open(f"/proc/{pid}/cmdline", "rb") as f:
-            cmd = f.read().replace(b"\0", b" ").decode(errors="replace")
-    except OSError:
-        return False
-    return "rafiki" in cmd
 
 
 def probe_devices(timeout: float = 120.0) -> Dict[str, Any]:
@@ -110,7 +147,11 @@ class ServicesManager:
         #: still RUNNING. Lineage = (type, job id): the restart budget is
         #: shared by a job's workers so a crash-looping config converges.
         self._respawn_specs: Dict[str, Dict[str, Any]] = {}
-        self._respawn_counts: Dict[Any, int] = {}
+        #: in-memory mirror of the DURABLE respawn_budgets table — the
+        #: store is authoritative (increments write through), so the
+        #: budget survives an admin crash/restart
+        self._respawn_counts: Dict[Any, int] = \
+            self._load_respawn_counts()
         #: max replacement spawns per (service type, job) lineage
         self.max_respawns = 3
         #: respawns that found no free slot, retried on every poll —
@@ -128,38 +169,398 @@ class ServicesManager:
         #: operator retrying a timed-out request) would drain the fresh
         #: replacements and spawn duplicates sharing one worker id
         self._rolling_lock = threading.Lock()
+        #: single-writer admin lease (generation-fenced). Opt-in:
+        #: acquire_lease() arms it; a manager that never acquires (unit
+        #: tests, embedded use) is never fenced.
+        self.lease_holder = uuid.uuid4().hex
+        self.lease_generation = 0
+        self.lease_ttl_s = 15.0
+        self._lease_held = False
+        self.fenced = False
+        #: boot-reconciler outcome counters, surfaced on the admin
+        #: /metrics (services_adopted / orphans_reaped / ...) and in
+        #: the /health recovery block + dashboard banner
+        from ..obs.metrics import StatsMap
+
+        self.recovery = StatsMap({
+            "services_adopted": 0, "services_crashed": 0,
+            "orphans_reaped": 0, "respawns_queued": 0,
+            "kv_adopted": 0, "lease_takeovers": 0,
+            "last_recovery_at": 0.0})
+
+    def _load_respawn_counts(self) -> Dict[Any, int]:
+        """Durable lineage budgets → the (type, job_id)-keyed mirror."""
+        out: Dict[Any, int] = {}
+        try:
+            for lineage, count in self.meta.get_respawn_counts().items():
+                stype, _, job_id = lineage.partition(":")
+                out[(stype, job_id)] = int(count)
+        except Exception:  # noqa: BLE001 — a pre-migration store must
+            # not break boot; budgets then start fresh (old behavior)
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "could not load durable respawn budgets", exc_info=True)
+        return out
+
+    # ---- admin lease (single-writer fencing) ----
+    def acquire_lease(self, ttl_s: Optional[float] = None
+                      ) -> Dict[str, Any]:
+        """Claim the MetaStore's single-writer admin lease, or raise
+        :class:`LeaseHeldError` when a live admin already owns it. A
+        takeover of an EXPIRED lease bumps the generation (counted as
+        ``lease_takeovers``) — the old holder's next renew fails and
+        fences it out."""
+        if ttl_s is not None:
+            self.lease_ttl_s = float(ttl_s)
+        got = self.meta.acquire_admin_lease(self.lease_holder,
+                                            ttl_s=self.lease_ttl_s)
+        if got is None:
+            raise LeaseHeldError(self.meta.get_admin_lease() or {})
+        self._lease_held = True
+        self.fenced = False
+        self.lease_generation = int(got["generation"])
+        if got.get("took_over"):
+            self.recovery.inc("lease_takeovers")
+        return got
+
+    def start_lease_heartbeat(self,
+                              interval_s: Optional[float] = None) -> None:
+        """Start the background lease-renewal thread (idempotent).
+
+        Call IMMEDIATELY after :meth:`acquire_lease` — before
+        :meth:`reconcile`: reconciling can legitimately exceed the TTL
+        (per-orphan SIGTERM/SIGKILL grace, health probes), and with no
+        heartbeat a concurrent boot would "take over" from a live admin
+        mid-reconcile. The thread is deliberately independent of the
+        admin's monitor loop: it never touches op_lock, so a blocking
+        spawn cannot starve it. It exits on release/fence."""
+        if getattr(self, "_hb_thread", None) is not None and \
+                self._hb_thread.is_alive():
+            return
+        if not self._lease_held:
+            return
+        tick = interval_s if interval_s is not None else \
+            max(0.2, min(self.lease_ttl_s / 3.0, 5.0))
+        self._hb_stop = threading.Event()
+
+        def loop() -> None:
+            while not self._hb_stop.wait(tick):
+                try:
+                    if not self.renew_lease():
+                        return  # fenced: nothing left to renew
+                except Exception:  # a store hiccup must not kill the
+                    # heartbeat — the next tick retries
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "lease heartbeat failed", exc_info=True)
+
+        self._hb_thread = threading.Thread(target=loop, daemon=True)
+        self._hb_thread.start()
+
+    def _stop_lease_heartbeat(self) -> None:
+        stop = getattr(self, "_hb_stop", None)
+        if stop is not None:
+            stop.set()
+        th = getattr(self, "_hb_thread", None)
+        if th is not None and th.is_alive():
+            th.join(timeout=5)
+        self._hb_thread = None
+
+    def renew_lease(self) -> bool:
+        """Heartbeat the held lease. False (and ``self.fenced``) when a
+        newer admin took over — from then on every spawn/stop raises
+        and stop_all releases handles WITHOUT killing, because the
+        children now belong to the new admin."""
+        if not self._lease_held or self.fenced:
+            return not self.fenced
+        if self.meta.renew_admin_lease(self.lease_holder):
+            return True
+        import logging
+
+        logging.getLogger(__name__).error(
+            "admin lease lost (a newer admin took over) — fencing this "
+            "manager: no further spawns/stops")
+        self.fenced = True
+        return False
+
+    def release_lease(self) -> None:
+        """Clean shutdown: expire the lease instantly so the next admin
+        boots without waiting out the TTL. Stops the heartbeat FIRST so
+        a late renew cannot resurrect the released lease."""
+        self._stop_lease_heartbeat()
+        if self._lease_held and not self.fenced:
+            try:
+                self.meta.release_admin_lease(self.lease_holder)
+            except Exception:  # noqa: BLE001 — shutdown must not die
+                # on a store hiccup; the TTL covers the release anyway
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "admin lease release failed (the TTL will expire "
+                    "it)", exc_info=True)
+        self._lease_held = False
+
+    def _check_fence(self) -> None:
+        if self.fenced:
+            raise AdminFencedError(
+                "admin lease lost — this manager is fenced; a newer "
+                "admin owns the stack now")
 
     def reap_stale_services(self) -> int:
-        """Admin restart adoption: service rows left non-STOPPED by a
-        previous admin in this workdir belong to processes that died with
-        it (children share its session) or leaked — kill any that still
-        answer their recorded pid and mark every stale row STOPPED.
-        Returns the number of rows reaped. Call before spawning anything
-        so a restarted control plane starts from consistent MetaStore
-        state."""
-        import os
-        import signal as _signal
-
+        """Scorched-earth restart cleanup: kill every process a
+        previous admin's non-terminal rows still point at and mark the
+        rows STOPPED. :meth:`reconcile` (which ADOPTS survivors instead
+        of killing them) is the normal boot path; this remains for
+        operators who explicitly want a cold start. Kills are gated on
+        the hardened pid identity — recorded start time included — so a
+        recycled pid is never killed."""
         reaped = 0
         for row in self.meta.get_services():
-            if row["status"] in (ServiceStatus.STOPPED,
-                                 ServiceStatus.ERRORED):
+            if row["status"] in _TERMINAL:
                 continue
             if row["id"] in self.services:  # owned by THIS manager
                 continue
             pid = int(row.get("pid") or 0)
-            if pid > 0 and _cmdline_is_ours(pid):
-                try:
-                    os.kill(pid, _signal.SIGTERM)
-                except (ProcessLookupError, PermissionError):
-                    pass
+            if pid > 0:
+                terminate_pid(pid, float(row.get("start_time") or 0))
             self.meta.update_service(row["id"],
                                      status=ServiceStatus.STOPPED)
             reaped += 1
         return reaped
 
+    # ---- boot reconciler (crash-only control plane) ----
+    def reconcile(self) -> Dict[str, Any]:
+        """Rebuild the process table from the MetaStore after an admin
+        death. For every non-terminal service row left by the previous
+        admin:
+
+        - **adopt** survivors: pid alive + hardened identity (cmdline
+          AND recorded kernel start time) + health probe on the
+          recorded HTTP/obs port → a :class:`ManagedService` handle is
+          rebuilt around the pid, its sub-mesh slot re-reserved, and
+          its respawn spec re-registered — streams and trials keep
+          running, nothing is restarted;
+        - **crash** the dead: rows whose process is gone (or failed the
+          identity/probe check) go CRASHED; crashed WORKERS of a
+          still-RUNNING job flow into the existing respawn path under
+          the durable respawn budget;
+        - **reap** orphans: survivors whose job was stopped while the
+          admin was down are killed (identity-gated) and marked
+          STOPPED.
+
+        The kvd data plane is adopted the same way (PING on the
+        recorded port), so param blobs and in-flight queues survive the
+        admin dying. Returns the recovery counter snapshot.
+        """
+        with self.op_lock:
+            return self._reconcile()
+
+    def _reconcile(self) -> Dict[str, Any]:
+        import logging
+
+        log = logging.getLogger(__name__)
+        self._respawn_counts = self._load_respawn_counts()
+        crashed_workers: List[Dict[str, Any]] = []
+        for row in self.meta.get_services():
+            if row["status"] in _TERMINAL or row["id"] in self.services:
+                continue
+            stype = row["service_type"]
+            if stype == ServiceType.DATA_PLANE:
+                self._reconcile_data_plane(row)
+                continue
+            pid = int(row.get("pid") or 0)
+            start_time = float(row.get("start_time") or 0)
+            spec = row.get("spawn_spec") or None
+            job_id = row.get("train_job_id") or \
+                row.get("inference_job_id")
+            job = None
+            if job_id:
+                job = self.meta.get_train_job(job_id) or \
+                    self.meta.get_inference_job(job_id)
+            job_running = bool(job and job.get("status") == "RUNNING")
+            alive = identity_matches(pid, start_time)
+
+            if alive and job_id and not job_running:
+                # orphan: its job was stopped/finished while no admin
+                # was alive to stop the process
+                log.info("reaping orphan %s %s (job %s is %s)",
+                         stype, row["id"], job_id,
+                         job.get("status") if job else "gone")
+                terminate_pid(pid, start_time)
+                self.meta.update_service(row["id"],
+                                         status=ServiceStatus.STOPPED)
+                self.recovery.inc("orphans_reaped")
+                continue
+
+            probe = self._probe_service(row, spec) if alive else False
+            if alive and probe is not False:
+                if self._adopt_service(row, spec, pid, start_time):
+                    continue
+                # un-adoptable (slot conflict): fall through to crash
+                alive = False
+
+            # dead / identity mismatch / failed probe → CRASHED
+            if alive or identity_matches(pid, start_time):
+                # process exists but is not serving: kill it before
+                # respawning a replacement or two claim one slot
+                terminate_pid(pid, start_time)
+            self.meta.update_service(row["id"],
+                                     status=ServiceStatus.CRASHED)
+            self.recovery.inc("services_crashed")
+            if job_running and spec and stype in _WORKER_TYPES:
+                crashed_workers.append({"dead_id": row["id"],
+                                        "spec": spec})
+
+        # crashed workers flow into the EXISTING respawn path, under
+        # the budget that survived the restart
+        for item in crashed_workers:
+            try:
+                if not self._respawn(item["dead_id"], item["spec"]):
+                    self._pending_respawns.append(item)
+                    self.recovery.inc("respawns_queued")
+            except Exception as e:  # noqa: BLE001 — reconcile must
+                # finish; a failed respawn is a degraded job, not a
+                # dead control plane
+                log.warning("boot respawn of %s failed: %s",
+                            item["dead_id"], e)
+                mk = item["spec"].get("meta_kwargs") or {}
+                self._mark_degraded(
+                    item["spec"]["service_type"],
+                    mk.get("train_job_id") or mk.get("inference_job_id"),
+                    f"boot respawn failed: {e}")
+        self.recovery.set("last_recovery_at", time.time())
+        return self.recovery_stats()
+
+    def _adopt_service(self, row: Dict[str, Any],
+                       spec: Optional[Dict[str, Any]], pid: int,
+                       start_time: float) -> bool:
+        """Rebuild a ManagedService handle around a surviving pid.
+        False when its recorded sub-mesh cannot be re-reserved (the
+        caller then treats it as crashed)."""
+        import logging
+
+        stype = row["service_type"]
+        slot = None
+        if spec and spec.get("needs_slot"):
+            try:
+                devices = json.loads(row.get("devices") or "[]")
+            except ValueError:
+                devices = []
+            slot = self.allocator.reserve(devices)
+            if slot is None:
+                logging.getLogger(__name__).warning(
+                    "cannot adopt %s %s: its recorded sub-mesh %r is "
+                    "no longer free", stype, row["id"], devices)
+                return False
+        svc = ManagedService(
+            row["id"], stype, AdoptedProcess(pid, start_time), slot,
+            host=row.get("host") or "127.0.0.1",
+            port=int(row.get("port") or 0), adopted=True)
+        self.services[row["id"]] = svc
+        if spec and stype in _WORKER_TYPES:
+            self._respawn_specs[row["id"]] = {
+                "module": spec["module"], "config": spec["config"],
+                "service_type": stype,
+                "needs_slot": bool(spec.get("needs_slot")),
+                "meta_kwargs": dict(spec.get("meta_kwargs") or {})}
+        self.meta.update_service(row["id"],
+                                 status=ServiceStatus.RUNNING)
+        self.recovery.inc("services_adopted")
+        return True
+
+    def _probe_service(self, row: Dict[str, Any],
+                       spec: Optional[Dict[str, Any]]
+                       ) -> Optional[bool]:
+        """Health-probe a candidate's recorded HTTP surface: the row's
+        own port (advisor/predictor) or the worker's obs sidecar (port
+        discovered from its ``obs_port_file``). ANY HTTP answer —
+        including an error status — counts as alive (the process is
+        serving; not every service has /health). None = no probe
+        channel recorded: identity alone must decide."""
+        import urllib.error
+
+        from ..utils.http import json_request
+
+        host = row.get("host") or "127.0.0.1"
+        port = int(row.get("port") or 0)
+        if port <= 0:
+            cfg = (spec or {}).get("config") or {}
+            port_file = cfg.get("obs_port_file")
+            if port_file and Path(port_file).exists():
+                try:
+                    port = int(Path(port_file).read_text().strip())
+                except (OSError, ValueError):
+                    port = 0
+        if port <= 0:
+            return None
+        try:
+            json_request("GET", f"http://{host}:{port}/health",
+                         timeout=3.0)
+            return True
+        except urllib.error.HTTPError:
+            return True  # it answered — alive, just no /health route
+        except (OSError, ValueError):
+            return False  # refused/timeout/garbage: not serving
+
+    def _reconcile_data_plane(self, row: Dict[str, Any]) -> None:
+        """Adopt a surviving rafiki-kvd (param blobs + queues live in
+        its memory — killing it would drop every in-flight stream and
+        deployed trial's params), or mark the row CRASHED so
+        ``start_data_plane`` boots a fresh one."""
+        import logging
+
+        from .proc import pid_alive
+
+        pid = int(row.get("pid") or 0)
+        start_time = float(row.get("start_time") or 0)
+        host, port = row.get("host") or "127.0.0.1", \
+            int(row.get("port") or 0)
+        ok = False
+        # identity first (recycled pid must not be PINGed as ours);
+        # kvd's cmdline is "rafiki-kvd ..." so cmdline_is_ours holds
+        if port > 0 and pid_alive(pid) and identity_matches(
+                pid, start_time):
+            try:
+                from ..native.client import KVClient
+
+                c = KVClient(host, port, connect_timeout=3.0)
+                ok = c.ping()
+                c.close()
+            except (OSError, RuntimeError):
+                ok = False  # refused / protocol error: not a live kvd
+        if ok:
+            self.kv_host, self.kv_port = host, port
+            server = _AdoptedKVServer(host, port,
+                                      AdoptedProcess(pid, start_time))
+            self._kv_server = server
+            self._kv_proc = server._proc
+            self._kv_service_id = row["id"]
+            self.recovery.inc("kv_adopted")
+            logging.getLogger(__name__).info(
+                "adopted data plane kvd pid %d on %s:%d", pid, host,
+                port)
+        else:
+            if identity_matches(pid, start_time):
+                terminate_pid(pid, start_time)
+            self.meta.update_service(row["id"],
+                                     status=ServiceStatus.CRASHED)
+            self.recovery.inc("services_crashed")
+
+    def recovery_stats(self) -> Dict[str, Any]:
+        """Reconciler + lease counters for /metrics, /health, and the
+        dashboard recovery banner."""
+        out = self.recovery.snapshot()
+        out["lease_generation"] = self.lease_generation
+        out["fenced"] = bool(self.fenced)
+        return out
+
     # ---- data plane ----
     def start_data_plane(self) -> None:
+        if self.kv_port:
+            return  # already running or adopted by reconcile()
+        self._check_fence()
         from ..native.client import KVServer
 
         server = KVServer()
@@ -168,8 +569,14 @@ class ServicesManager:
         self.kv_host, self.kv_port = server.host, server.port
         row = self.meta.create_service(
             ServiceType.DATA_PLANE, host=server.host, port=server.port,
-            pid=server._proc.pid)
+            pid=server._proc.pid,
+            spawn_spec={"module": "rafiki-kvd", "config": {},
+                        "service_type": ServiceType.DATA_PLANE,
+                        "needs_slot": False, "meta_kwargs": {}},
+            start_time=proc_start_time(server._proc.pid))
         self._kv_service_id = row["id"]
+        self.meta.update_service(row["id"],
+                                 status=ServiceStatus.RUNNING)
 
     @property
     def param_store_uri(self) -> str:
@@ -182,6 +589,7 @@ class ServicesManager:
                service_type: str, slot: Optional[SubMesh] = None,
                wait_port_file: bool = False, timeout: float = 180.0,
                **meta_kwargs: Any) -> ManagedService:
+        self._check_fence()
         tag = f"{service_type.lower()}-{uuid.uuid4().hex[:8]}"
         cfg_path = self.workdir / f"{tag}.json"
         port_file = self.workdir / f"{tag}.port"
@@ -219,14 +627,22 @@ class ServicesManager:
                 proc.kill()
                 raise TimeoutError(f"{service_type} did not report a port")
 
+        # the ROW carries everything needed to re-adopt or respawn this
+        # service after an admin crash: the full spawn recipe plus the
+        # pid's kernel start time (the recycle-proof identity half)
+        spawn_spec = {"module": module, "config": dict(config),
+                      "service_type": service_type,
+                      "needs_slot": slot is not None,
+                      "meta_kwargs": dict(meta_kwargs), "tag": tag}
         row = self.meta.create_service(
             service_type, host=host, port=port, pid=proc.pid,
             devices=[d.id for d in (slot.devices if slot else [])],
+            spawn_spec=spawn_spec,
+            start_time=proc_start_time(proc.pid),
             **meta_kwargs)
         svc = ManagedService(row["id"], service_type, proc, slot, host, port)
         self.services[row["id"]] = svc
-        if service_type in (ServiceType.TRAIN_WORKER,
-                            ServiceType.INFERENCE_WORKER):
+        if service_type in _WORKER_TYPES:
             self._respawn_specs[row["id"]] = {
                 "module": module, "config": dict(config),
                 "service_type": service_type, "needs_slot": slot is not None,
@@ -738,8 +1154,16 @@ class ServicesManager:
             if slot is not None:
                 self.allocator.release(slot)
             raise
-        self._respawn_counts[lineage] = \
-            self._respawn_counts.get(lineage, 0) + 1
+        # write-through: the budget lives in the MetaStore so an admin
+        # crash cannot reset it (a crash-looping worker config would
+        # otherwise get a fresh budget per admin restart)
+        try:
+            self._respawn_counts[lineage] = \
+                self.meta.incr_respawn_count(stype, job_id)
+        except Exception:  # noqa: BLE001 — never lose healing to a
+            # store hiccup; fall back to the in-memory count
+            self._respawn_counts[lineage] = \
+                self._respawn_counts.get(lineage, 0) + 1
         # healing worked: the job is no longer degraded (a stale flag
         # that survives recovery teaches operators to ignore it)
         self._degraded.pop(job_id, None)
@@ -852,6 +1276,7 @@ class ServicesManager:
         that fails to drain within ``drain_timeout`` is terminated —
         the restart must converge even over a hung process. Returns the
         old→new service id pairs."""
+        self._check_fence()
         if not self._rolling_lock.acquire(blocking=False):
             raise RuntimeError(
                 "a rolling restart is already in progress — wait for "
@@ -959,6 +1384,7 @@ class ServicesManager:
             return out
 
     def stop_service(self, service_id: str, timeout: float = 10.0) -> None:
+        self._check_fence()
         with self.op_lock:
             self._stop_service(service_id, timeout)
 
@@ -980,9 +1406,30 @@ class ServicesManager:
         self._respawn_specs.pop(service_id, None)
         del self.services[service_id]
 
+    def _drop_handles(self) -> None:
+        """Fenced shutdown: the children (and their MetaStore rows) now
+        belong to the admin that took the lease over — killing them
+        would tear down the NEW admin's adopted stack. Release only our
+        local bookkeeping."""
+        for sid, svc in list(self.services.items()):
+            if svc.slot is not None:
+                try:
+                    self.allocator.release(svc.slot)
+                except ValueError:
+                    pass
+                svc.slot = None
+            self._respawn_specs.pop(sid, None)
+            del self.services[sid]
+        self._kv_proc = None
+        self.kv_host, self.kv_port = "", 0
+
     def stop_all(self) -> None:
+        if self.fenced:
+            self._drop_handles()
+            return
         for sid in list(self.services):
-            self.stop_service(sid)
+            with self.op_lock:
+                self._stop_service(sid, timeout=10.0)
         if self._kv_proc is not None:
             self._kv_server.stop()
             self._kv_proc = None
@@ -990,3 +1437,27 @@ class ServicesManager:
             if getattr(self, "_kv_service_id", None):
                 self.meta.update_service(self._kv_service_id,
                                          status=ServiceStatus.STOPPED)
+        self.release_lease()
+
+
+class _AdoptedKVServer:
+    """KVServer-shaped handle over a rafiki-kvd the reconciler adopted
+    (same ``host``/``port``/``_proc``/``stop()`` surface as
+    :class:`rafiki_tpu.native.client.KVServer`)."""
+
+    def __init__(self, host: str, port: int,
+                 proc: AdoptedProcess) -> None:
+        self.host, self.port = host, port
+        self._proc = proc
+
+    def stop(self) -> None:
+        from ..native.client import KVClient
+
+        try:
+            KVClient(self.host, self.port).shutdown()
+        except OSError:
+            pass
+        try:
+            self._proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            self._proc.kill()

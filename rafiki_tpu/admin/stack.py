@@ -35,9 +35,15 @@ def stack_command(args: argparse.Namespace) -> int:
         cfg = {"workdir": str(workdir), "db_path": str(workdir / "meta.db"),
                "host": "127.0.0.1", "port": args.port,
                "slot_size": args.slot_size, "workers": args.workers,
+               "cold_start": bool(getattr(args, "cold", False)),
                "port_file": str(workdir / "admin.port")}
         cfg_path = workdir / "admin.json"
         cfg_path.write_text(json.dumps(cfg))
+        # a stale port file from a previous (killed) admin would make
+        # the wait loop below declare the stack up before the new admin
+        # has even bound — e.g. while it is still waiting out a dead
+        # predecessor's lease TTL
+        (workdir / "admin.port").unlink(missing_ok=True)
         log = open(workdir / "admin.log", "ab")
         proc = subprocess.Popen(
             [sys.executable, "-m", "rafiki_tpu.admin.app",
@@ -51,6 +57,20 @@ def stack_command(args: argparse.Namespace) -> int:
             if port_file.exists() and port_file.read_text().strip():
                 break
             if proc.poll() is not None:
+                # a lease-fenced boot exits rc=3 with a structured JSON
+                # error on its last log line — surface it verbatim
+                if proc.returncode == 3:
+                    try:
+                        last = (workdir / "admin.log").read_bytes() \
+                            .decode(errors="replace").strip() \
+                            .splitlines()[-1]
+                        err = json.loads(last)
+                        print(f"admin refused to start: "
+                              f"{err.get('detail', last)}",
+                              file=sys.stderr)
+                        return 3
+                    except (OSError, ValueError, IndexError):
+                        pass
                 print(f"admin died on startup; see {workdir / 'admin.log'}",
                       file=sys.stderr)
                 return 1
@@ -106,51 +126,41 @@ def stack_command(args: argparse.Namespace) -> int:
 
 
 def _pid_alive(pid: int) -> bool:
+    """Zombie-aware: a SIGKILLed admin whose parent has not reaped it
+    yet still answers signal 0, but it is dead for every purpose here —
+    `stack start` must not refuse to restart over a corpse."""
     try:
         os.kill(pid, 0)
-        return True
     except (ProcessLookupError, PermissionError):
         return False
+    from .proc import proc_state
+
+    return proc_state(pid) != "Z"
 
 
 def _reap_orphans(workdir: Path) -> int:
     """Kill service processes that outlived the admin (e.g. the admin was
     SIGKILLed so its graceful shutdown never ran) and mark their MetaStore
-    rows STOPPED. The admin records every child's pid in the services
-    table, so the stack CLI can finish the cleanup from the db alone."""
+    rows STOPPED. The admin records every child's pid — and its kernel
+    start time — in the services table, so the stack CLI can finish the
+    cleanup from the db alone. Kills are identity-gated on
+    (cmdline, start_time): a recycled pid can never be killed, even by
+    another rafiki process that happens to reuse the number."""
     db = workdir / "meta.db"
     if not db.exists():
         return 0
     from ..store.meta_store import MetaStore
+    from .proc import identity_matches, terminate_pid
 
     meta = MetaStore(str(db))
     killed = 0
     for row in meta.get_services():
-        if row["status"] in ("STOPPED", "ERRORED"):
+        if row["status"] in ("STOPPED", "ERRORED", "CRASHED"):
             continue
         pid = int(row.get("pid") or 0)
-        if pid > 0 and _pid_alive(pid) and _looks_like_service(pid):
-            try:
-                os.kill(pid, signal.SIGTERM)
-                for _ in range(50):
-                    if not _pid_alive(pid):
-                        break
-                    time.sleep(0.1)
-                else:
-                    os.kill(pid, signal.SIGKILL)
+        start_time = float(row.get("start_time") or 0)
+        if pid > 0 and identity_matches(pid, start_time):
+            if terminate_pid(pid, start_time):
                 killed += 1
-            except (ProcessLookupError, PermissionError):
-                pass  # exited between the check and the kill
         meta.update_service(row["id"], status="STOPPED")
     return killed
-
-
-def _looks_like_service(pid: int) -> bool:
-    """Guard against recycled pids: only kill processes whose cmdline
-    looks like one of ours (rafiki service module or the kv daemon)."""
-    try:
-        with open(f"/proc/{pid}/cmdline", "rb") as f:
-            cmd = f.read().replace(b"\0", b" ").decode(errors="replace")
-    except OSError:
-        return False
-    return "rafiki" in cmd

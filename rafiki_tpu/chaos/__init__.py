@@ -62,6 +62,13 @@ class ChaosConfig:
     - ``corrupt_payload_p``: each reply push is bit-flipped with this
       probability — a torn write; consumers must fail structured, not
       crash.
+    - ``kill_admin_after_s``: the ADMIN process SIGKILLs itself this
+      many seconds after arming (:func:`arm_admin_kill` in the admin
+      entrypoint) — the deterministic "control plane dies mid-load"
+      drill behind the crash-recovery tests and the
+      ``bench_extra admin_recovery`` stage. SIGKILL on purpose: no
+      graceful-shutdown path may run, exactly like an OOM-kill or a
+      host reboot.
     - ``seed``: drives every probabilistic draw; same seed + same
       traffic order = same faults.
     """
@@ -70,13 +77,15 @@ class ChaosConfig:
     drop_reply_p: float = 0.0
     delay_queue_s: float = 0.0
     corrupt_payload_p: float = 0.0
+    kill_admin_after_s: float = 0.0
     seed: int = 0
 
     @property
     def armed(self) -> bool:
         return bool(self.kill_after_tokens > 0 or self.drop_reply_p > 0
                     or self.delay_queue_s > 0
-                    or self.corrupt_payload_p > 0)
+                    or self.corrupt_payload_p > 0
+                    or self.kill_admin_after_s > 0)
 
     @classmethod
     def parse(cls, spec: str) -> "ChaosConfig":
@@ -109,6 +118,28 @@ class ChaosConfig:
             return None
         cfg = cls.parse(spec)
         return cfg if cfg.armed else None
+
+
+def arm_admin_kill(cfg: ChaosConfig) -> Optional["object"]:
+    """Arm the control-plane suicide timer: SIGKILL this process
+    ``cfg.kill_admin_after_s`` seconds from now. Called by the admin
+    entrypoint when chaos is armed; returns the started timer (or None
+    when the knob is off) so a test can cancel it. SIGKILL — not
+    SIGTERM — because the drill exists to prove recovery WITHOUT the
+    graceful-shutdown path ever running."""
+    if cfg.kill_admin_after_s <= 0:
+        return None
+    import os
+    import signal
+    import threading
+
+    def _die() -> None:
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    timer = threading.Timer(cfg.kill_admin_after_s, _die)
+    timer.daemon = True
+    timer.start()
+    return timer
 
 
 class ChaosInjector:
@@ -202,4 +233,5 @@ class ChaosHub(QueueHub):
         return self.inner.get_worker_stats(worker_id)
 
 
-__all__ = ["CHAOS_ENV", "ChaosConfig", "ChaosHub", "ChaosInjector"]
+__all__ = ["CHAOS_ENV", "ChaosConfig", "ChaosHub", "ChaosInjector",
+           "arm_admin_kill"]

@@ -41,10 +41,25 @@ def main(argv: Optional[List[str]] = None) -> int:
                        help="target vocab size (specials + 256 bytes + "
                             "merges)")
 
-    sub.add_parser(
+    p_doc = sub.add_parser(
         "doctor",
         help="check the environment (backend, devices, native "
-             "artifacts, compile cache) and print a health report")
+             "artifacts, compile cache) and print a health report; "
+             "with --workdir, audit a stack workdir instead (MetaStore "
+             "rows vs live pids vs slots vs obs ports — drift report)")
+    p_doc.add_argument("--workdir", default=None,
+                       help="stack workdir to audit (read-only; safe "
+                            "against a live stack)")
+    p_doc.add_argument("--json", action="store_true", dest="as_json",
+                       help="emit the audit as JSON (with --workdir)")
+
+    p_backup = sub.add_parser(
+        "backup",
+        help="snapshot a stack's MetaStore (SQLite online backup; "
+             "consistent under a live admin) — run before risky ops")
+    p_backup.add_argument("out", help="destination file for the snapshot")
+    p_backup.add_argument("--workdir", default="./rafiki_stack",
+                          help="stack workdir holding meta.db")
 
     p_lint = sub.add_parser(
         "lint",
@@ -66,6 +81,27 @@ def main(argv: Optional[List[str]] = None) -> int:
         from .analysis.cli import run_lint
 
         return run_lint(args)
+    if args.cmd == "doctor" and args.workdir:
+        # workdir drift audit: pure /proc + sqlite reads, no jax, no
+        # backend — must work on a box whose accelerator is wedged
+        # (that is exactly when operators reach for it)
+        return _doctor_workdir(args.workdir, args.as_json)
+    if args.cmd == "backup":
+        import json as _json
+
+        from .store.meta_store import MetaStore
+
+        db = f"{args.workdir}/meta.db"
+        import os.path
+
+        if not os.path.exists(db):
+            print(f"no MetaStore at {db}", file=sys.stderr)
+            return 1
+        # read-only open: the backup tool must never migrate or touch
+        # the live store it is snapshotting
+        out = MetaStore(db, read_only=True).backup(args.out)
+        print(_json.dumps({"ok": True, **out}))
+        return 0
     # honor RAFIKI_JAX_PLATFORM before any backend initializes: the TPU-VM
     # image pre-imports jax with the accelerator platform pinned, so env
     # vars alone cannot force dev/tune runs onto CPU
@@ -123,6 +159,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.cmd == "doctor":
         return _doctor()
     return _run_service_command(args)
+
+
+def _doctor_workdir(workdir: str, as_json: bool) -> int:
+    """Drift audit over a stack workdir; exit 0 iff zero drift."""
+    import json as _json
+
+    from .admin.doctor import audit_workdir, render_text
+
+    report = audit_workdir(workdir)
+    if as_json:
+        print(_json.dumps(report, indent=2))
+    else:
+        print(render_text(report))
+    return 0 if report["ok"] else 1
 
 
 def _doctor() -> int:
@@ -258,6 +308,10 @@ def _register_service_commands(sub: argparse._SubParsersAction) -> None:
     p.add_argument("--slot-size", dest="slot_size", type=int, default=1,
                    help="devices per trial slot (ICI-contiguous sub-mesh "
                         "size; e.g. 2 on 8 devices -> 4 slots)")
+    p.add_argument("--cold", action="store_true",
+                   help="start: kill every recorded survivor instead of "
+                        "re-adopting it (clean-slate boot for when the "
+                        "previous stack's state is not to be trusted)")
 
 
 def _run_service_command(args: argparse.Namespace) -> int:

@@ -209,6 +209,13 @@ class Client:
             {"drain_timeout": drain_timeout}, headers=headers,
             timeout=sock)
 
+    def backup(self, path: str) -> Dict[str, Any]:
+        """Snapshot the admin's MetaStore to ``path`` ON THE ADMIN
+        HOST (SQLite online backup — consistent under live traffic).
+        Run before risky operations; see docs/operations.md "Admin
+        death & recovery"."""
+        return self._call("POST", "/system/backup", {"path": path})
+
     # ---- online prediction ----
     def predict(self, predictor_url: str, queries: Sequence[Any],
                 timeout: Optional[float] = None,

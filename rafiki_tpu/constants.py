@@ -68,6 +68,11 @@ class ServiceStatus(StrEnum):
     RUNNING = "RUNNING"
     STOPPED = "STOPPED"
     ERRORED = "ERRORED"
+    # verdict of the boot reconciler: the row's recorded process did not
+    # survive the admin's death (pid gone, identity mismatch, or failed
+    # health probe). Terminal like ERRORED; crashed WORKERS of a still-
+    # RUNNING job flow into the respawn path.
+    CRASHED = "CRASHED"
 
 
 class UserType(StrEnum):

@@ -217,6 +217,24 @@ class SubMeshAllocator:
             self._free.sort()
             self._cv.notify()
 
+    def reserve(self, device_ids: Sequence[int]) -> Optional[SubMesh]:
+        """Acquire the SPECIFIC slot covering exactly ``device_ids``
+        (order-insensitive), or None when no free slot matches. The
+        admin's boot reconciler uses this to re-reserve the sub-mesh a
+        re-adopted service still physically holds — an arbitrary
+        ``acquire()`` could hand the adopted worker's chips to a new
+        spawn while the old process is still driving them."""
+        want = sorted(int(i) for i in device_ids)
+        with self._cv:
+            for idx in list(self._free):
+                slot = self._slots[idx]
+                have = sorted(getattr(d, "id", i)
+                              for i, d in enumerate(slot.devices))
+                if have == want:
+                    self._free.remove(idx)
+                    return slot
+            return None
+
     def free_count(self) -> int:
         with self._lock:
             return len(self._free)

@@ -75,17 +75,33 @@ class DatabaseAdapter:
         already exists (the no-op re-run), raise on anything else."""
         raise NotImplementedError
 
+    def backup(self, conn, path: str) -> None:
+        """Consistent online snapshot of the whole database to a file
+        at ``path``. Engines without a one-file snapshot concept may
+        raise NotImplementedError."""
+        raise NotImplementedError(
+            "online backup is not supported by this database engine")
+
 
 # ---------------------------------------------------------------- sqlite
 
 class SqliteAdapter(DatabaseAdapter):
-    def __init__(self, path: str) -> None:
+    def __init__(self, path: str, read_only: bool = False) -> None:
         self.path = path
+        #: open via the ro URI: auditors (doctor) must not be able to
+        #: write — or migrate — a live stack's database, and sqlite
+        #: refuses to CREATE a missing file in this mode
+        self.read_only = read_only and path != ":memory:"
 
     def connect(self):
         import sqlite3
 
-        conn = sqlite3.connect(self.path, check_same_thread=False)
+        if self.read_only:
+            conn = sqlite3.connect(f"file:{self.path}?mode=ro",
+                                   uri=True, check_same_thread=False)
+            conn.execute("PRAGMA busy_timeout=10000")
+        else:
+            conn = sqlite3.connect(self.path, check_same_thread=False)
         conn.row_factory = sqlite3.Row
         return conn
 
@@ -108,6 +124,34 @@ class SqliteAdapter(DatabaseAdapter):
         conn.execute("PRAGMA busy_timeout=10000")
         conn.execute("PRAGMA foreign_keys=ON")
         conn.executescript(schema_sql)
+
+    def backup(self, conn, path: str) -> None:
+        """SQLite online backup API: page-wise copy that is consistent
+        under concurrent writers (WAL readers keep going). Falls back
+        to ``VACUUM INTO`` (sqlite >= 3.27) when the driver lacks
+        ``Connection.backup``. The destination is replaced atomically
+        via a temp file so a crash mid-backup never leaves a torn
+        snapshot at ``path``."""
+        import os
+        import sqlite3
+
+        tmp = f"{path}.tmp-{os.getpid()}"
+        try:
+            if hasattr(conn, "backup"):
+                dest = sqlite3.connect(tmp)
+                try:
+                    conn.backup(dest)
+                finally:
+                    dest.close()
+            else:  # pragma: no cover - ancient driver fallback
+                conn.execute("VACUUM INTO ?", (tmp,))
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
 
     def try_migration(self, conn, ddl: str) -> bool:
         import sqlite3
@@ -215,12 +259,20 @@ class PostgresAdapter(DatabaseAdapter):
             return False
 
 
-def adapter_for(url_or_path: str) -> DatabaseAdapter:
+def adapter_for(url_or_path: str,
+                read_only: bool = False) -> DatabaseAdapter:
     """``:memory:`` / a filesystem path / ``sqlite:///path`` → SQLite;
-    ``postgresql://...`` (or ``postgres://``) → PostgreSQL."""
+    ``postgresql://...`` (or ``postgres://``) → PostgreSQL.
+    ``read_only`` is sqlite-only (the doctor/backup CLIs audit a local
+    stack's file) — asking for it on another engine is a caller bug."""
     u = str(url_or_path)
     if u.startswith(("postgresql://", "postgres://")):
+        if read_only:
+            raise ValueError(
+                "read_only MetaStore access is only supported on the "
+                "sqlite backend")
         return PostgresAdapter(u)
     if u.startswith("sqlite:///"):
-        return SqliteAdapter(u[len("sqlite:///"):] or ":memory:")
-    return SqliteAdapter(u)
+        return SqliteAdapter(u[len("sqlite:///"):] or ":memory:",
+                             read_only=read_only)
+    return SqliteAdapter(u, read_only=read_only)

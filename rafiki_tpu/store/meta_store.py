@@ -70,7 +70,15 @@ CREATE TABLE IF NOT EXISTS services (
     id TEXT PRIMARY KEY, service_type TEXT NOT NULL,
     status TEXT NOT NULL, train_job_id TEXT, sub_train_job_id TEXT,
     inference_job_id TEXT, host TEXT, port INTEGER, pid INTEGER,
-    devices TEXT, error TEXT, created_at REAL NOT NULL, stopped_at REAL);
+    devices TEXT, error TEXT, created_at REAL NOT NULL, stopped_at REAL,
+    spawn_spec TEXT, start_time REAL DEFAULT 0);
+CREATE TABLE IF NOT EXISTS respawn_budgets (
+    lineage TEXT PRIMARY KEY, count INTEGER NOT NULL DEFAULT 0,
+    updated_at REAL NOT NULL);
+CREATE TABLE IF NOT EXISTS admin_lease (
+    id INTEGER PRIMARY KEY CHECK (id = 1), holder TEXT NOT NULL,
+    generation INTEGER NOT NULL, heartbeat_at REAL NOT NULL,
+    acquired_at REAL NOT NULL, ttl_s REAL NOT NULL DEFAULT 15);
 """
 
 
@@ -95,13 +103,20 @@ class MetaStore:
     per instance with a process-wide write lock.
     """
 
-    def __init__(self, db_path: str = ":memory:") -> None:
+    def __init__(self, db_path: str = ":memory:",
+                 read_only: bool = False) -> None:
         from .db import adapter_for
 
         self._db_path = db_path
-        self._adapter = adapter_for(db_path)
+        self._read_only = read_only
+        self._adapter = adapter_for(db_path, read_only=read_only)
         self._conn = self._adapter.connect()
         self._lock = threading.RLock()
+        if read_only:
+            # auditors (doctor --workdir, backup CLI) must not write —
+            # or schema-migrate — a live stack's database: skip DDL
+            # entirely; the connection itself refuses writes
+            return
         with self._lock:
             self._adapter.init_schema(self._conn, _SCHEMA)
             # migrate pre-heartbeat databases (column added for
@@ -119,6 +134,15 @@ class MetaStore:
                 self._exec(
                     "UPDATE trials SET error_class='preemption' "
                     "WHERE status='ERRORED' AND error_class IS NULL")
+            # crash-only control plane (PR 9): the service row is the
+            # durable source of truth for spawn state — migrate
+            # pre-recovery databases
+            self._adapter.try_migration(
+                self._conn, "ALTER TABLE services ADD COLUMN "
+                            "spawn_spec TEXT")
+            self._adapter.try_migration(
+                self._conn, "ALTER TABLE services ADD COLUMN "
+                            "start_time REAL DEFAULT 0")
             self._adapter.commit(self._conn)
 
     def close(self) -> None:
@@ -159,7 +183,7 @@ class MetaStore:
             self._adapter.commit(self._conn)
 
     #: columns stored as JSON text, decoded on every read
-    _JSON_COLS = ("knobs", "budget", "train_args", "config")
+    _JSON_COLS = ("knobs", "budget", "train_args", "config", "spawn_spec")
 
     def _decode(self, row: Dict[str, Any]) -> Dict[str, Any]:
         for col in self._JSON_COLS:
@@ -505,14 +529,23 @@ class MetaStore:
                        sub_train_job_id: Optional[str] = None,
                        inference_job_id: Optional[str] = None,
                        host: str = "", port: int = 0, pid: int = 0,
-                       devices: Optional[List[int]] = None
-                       ) -> Dict[str, Any]:
+                       devices: Optional[List[int]] = None,
+                       spawn_spec: Optional[Dict[str, Any]] = None,
+                       start_time: float = 0.0) -> Dict[str, Any]:
+        """``spawn_spec`` (full module/config/slot recipe) and
+        ``start_time`` (kernel start time of the pid, the recycle-proof
+        half of its identity) make the ROW, not the spawning admin's
+        memory, the durable source of truth: a restarted admin rebuilds
+        its entire process table from these columns."""
         row = {"id": _uid(), "service_type": service_type,
                "status": "STARTED", "train_job_id": train_job_id,
                "sub_train_job_id": sub_train_job_id,
                "inference_job_id": inference_job_id, "host": host,
                "port": port, "pid": pid,
-               "devices": json.dumps(devices or []), "created_at": _now()}
+               "devices": json.dumps(devices or []),
+               "spawn_spec": json.dumps(spawn_spec)
+               if spawn_spec is not None else None,
+               "start_time": start_time, "created_at": _now()}
         self._insert("services", row)
         return self.get_service(row["id"])  # type: ignore[return-value]
 
@@ -527,7 +560,165 @@ class MetaStore:
         return self._all("SELECT * FROM services")
 
     def update_service(self, service_id: str, **fields: Any) -> None:
+        if "spawn_spec" in fields and \
+                not isinstance(fields["spawn_spec"], (str, type(None))):
+            fields["spawn_spec"] = json.dumps(fields["spawn_spec"])
         self._update("services", service_id, fields)
+
+    # ---- respawn budgets (durable self-healing accounting) ----
+    @staticmethod
+    def _lineage(service_type: str, job_id: str) -> str:
+        return f"{service_type}:{job_id}"
+
+    def incr_respawn_count(self, service_type: str, job_id: str) -> int:
+        """Atomically bump and return the (service type, job) lineage's
+        respawn count. Durable: a crash-looping config cannot reset its
+        budget by crashing the ADMIN too — the restarted admin resumes
+        the same counter."""
+        lineage = self._lineage(service_type, job_id)
+        with self._lock:
+            cur = self._exec(
+                "UPDATE respawn_budgets SET count=count+1, updated_at=? "
+                "WHERE lineage=?", (_now(), lineage))
+            if cur.rowcount == 0:
+                self._exec(
+                    "INSERT INTO respawn_budgets (lineage, count, "
+                    "updated_at) VALUES (?,?,?)", (lineage, 1, _now()))
+            self._adapter.commit(self._conn)
+            row = self._exec(
+                "SELECT count FROM respawn_budgets WHERE lineage=?",
+                (lineage,), max_rows=1).fetchone()
+        return int(row["count"]) if row else 1
+
+    def get_respawn_counts(self) -> Dict[str, int]:
+        """All lineages → count (lineage = ``"<type>:<job_id>"``)."""
+        return {r["lineage"]: int(r["count"]) for r in self._all(
+            "SELECT lineage, count FROM respawn_budgets")}
+
+    # ---- admin lease (single-writer fencing) ----
+    def acquire_admin_lease(self, holder: str,
+                            ttl_s: float = 15.0
+                            ) -> Optional[Dict[str, Any]]:
+        """Claim the single-writer admin lease. Exactly one row (id=1)
+        exists; ``generation`` is a fencing token that only ever grows.
+        Outcomes:
+
+        - no lease yet → insert at generation 1;
+        - we already hold it → heartbeat renewed, same generation;
+        - held but the heartbeat is older than the TTL the lease was
+          GRANTED with (recorded in the row — expiry is the holder's
+          contract, not the challenger's opinion) → TAKEOVER: holder
+          replaced, generation += 1 (``took_over`` True);
+        - held by a live other → ``None`` (the caller must fail fast,
+          not spawn a duplicate stack).
+
+        ``ttl_s`` becomes the TTL of the lease THIS caller ends up
+        holding. Cross-PROCESS atomicity comes from the database, not
+        the in-process lock: the fresh-lease INSERT races on the id=1
+        primary key (exactly one boot wins; losers get ``None``), and
+        takeovers are conditional on the observed holder+generation.
+        """
+        now = _now()
+        with self._lock:
+            row = self._exec("SELECT * FROM admin_lease WHERE id=1",
+                             max_rows=1).fetchone()
+            if row is None:
+                try:
+                    self._exec(
+                        "INSERT INTO admin_lease (id, holder, "
+                        "generation, heartbeat_at, acquired_at, ttl_s) "
+                        "VALUES (1,?,?,?,?,?)",
+                        (holder, 1, now, now, ttl_s))
+                    self._adapter.commit(self._conn)
+                except Exception:
+                    # two fresh boots raced the id=1 primary key from
+                    # separate processes (self._lock cannot cover that)
+                    # — if a row exists now, the other boot won and we
+                    # are simply fenced; anything else is a real error
+                    self._adapter.rollback(self._conn)
+                    if self._exec("SELECT 1 FROM admin_lease WHERE "
+                                  "id=1", max_rows=1).fetchone() is None:
+                        raise
+                    return None
+                return {"holder": holder, "generation": 1,
+                        "took_over": False}
+            if row["holder"] == holder:
+                self._exec(
+                    "UPDATE admin_lease SET heartbeat_at=?, ttl_s=? "
+                    "WHERE id=1 AND holder=?", (now, ttl_s, holder))
+                self._adapter.commit(self._conn)
+                return {"holder": holder,
+                        "generation": int(row["generation"]),
+                        "took_over": False}
+            held_ttl = float(row["ttl_s"] or 0) or ttl_s
+            if now - float(row["heartbeat_at"] or 0) <= held_ttl:
+                return None  # live other admin: fenced out
+            gen = int(row["generation"]) + 1
+            cur = self._exec(
+                "UPDATE admin_lease SET holder=?, generation=?, "
+                "heartbeat_at=?, acquired_at=?, ttl_s=? WHERE id=1 "
+                "AND holder=? AND generation=?",
+                (holder, gen, now, now, ttl_s, row["holder"],
+                 row["generation"]))
+            self._adapter.commit(self._conn)
+            if cur.rowcount == 0:
+                return None  # raced another takeover: it won
+            return {"holder": holder, "generation": gen,
+                    "took_over": True}
+
+    def renew_admin_lease(self, holder: str) -> bool:
+        """Heartbeat the lease. False = we no longer hold it (a newer
+        admin took over) — the caller is FENCED and must stop mutating
+        shared state immediately."""
+        with self._lock:
+            cur = self._exec(
+                "UPDATE admin_lease SET heartbeat_at=? WHERE id=1 AND "
+                "holder=?", (_now(), holder))
+            self._adapter.commit(self._conn)
+            return cur.rowcount == 1
+
+    def release_admin_lease(self, holder: str) -> bool:
+        """Clean shutdown: zero the heartbeat (instantly expired) but
+        KEEP holder + generation — the fencing token must stay
+        monotonic across releases, so the next boot takes over at
+        generation + 1 rather than restarting at 1."""
+        with self._lock:
+            cur = self._exec(
+                "UPDATE admin_lease SET heartbeat_at=0 WHERE id=1 AND "
+                "holder=?", (holder,))
+            self._adapter.commit(self._conn)
+            return cur.rowcount == 1
+
+    def get_admin_lease(self) -> Optional[Dict[str, Any]]:
+        return self._one("SELECT * FROM admin_lease WHERE id=1")
+
+    # ---- online backup ----
+    def backup(self, path: str) -> Dict[str, Any]:
+        """Snapshot the live store to ``path`` (SQLite online backup
+        API; consistent even with concurrent writers). Returns
+        {path, bytes}. Operators run this before risky ops — see
+        docs/operations.md "Admin death & recovery"."""
+        db_file = getattr(self._adapter, "path", None)
+        if db_file and db_file != ":memory:":
+            # dedicated connection, NO store lock: SQLite's backup API
+            # is online by design — holding the store-wide lock for the
+            # whole page copy would stall every other caller (including
+            # the admin's lease heartbeat) for the backup's duration
+            conn = self._adapter.connect()
+            try:
+                self._adapter.backup(conn, path)
+            finally:
+                self._adapter.close(conn)
+        else:
+            # :memory: (or non-file engines): the live connection IS
+            # the database — serialize briefly under the lock
+            with self._lock:
+                self._adapter.backup(self._conn, path)
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = 0
+        return {"path": path, "bytes": size}
 
 
 def _hash_password(password: str, salt: str) -> str:

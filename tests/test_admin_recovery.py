@@ -1,0 +1,694 @@
+"""Control-plane crash recovery: durable spawn state, service
+re-adoption, admin fencing.
+
+The admin is the last single point of failure after PR 7 made the data
+plane survive worker death: these tests kill the control plane (by
+abandoning its ServicesManager mid-flight, and — slow tier — by
+``kill -9`` on a real driver process) and prove a restarted admin
+
+- re-ADOPTS the previous admin's surviving children (identical pids,
+  hardened ``(cmdline, start_time)`` identity, slots re-reserved, kvd
+  data plane included, so in-flight streams never notice),
+- flows dead rows (CRASHED) into the existing respawn path under the
+  respawn budget PERSISTED in the MetaStore,
+- reaps orphans whose job was stopped while no admin was alive,
+- and is fenced by the single-writer lease: a duplicate admin on the
+  same store refuses to boot, a stale one loses every mutating op.
+
+Satellites covered here: the pid-recycle start-time guard, the
+``claim_trial_for_resume`` two-claimant race, MetaStore online backup,
+and the ``rafiki-tpu doctor --workdir`` drift audit.
+"""
+
+import json
+import subprocess
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from rafiki_tpu.admin.proc import (AdoptedProcess, identity_matches,
+                                   proc_start_time)
+from rafiki_tpu.admin.services_manager import (AdminFencedError,
+                                               LeaseHeldError,
+                                               ServicesManager)
+from rafiki_tpu.constants import ServiceStatus, ServiceType
+from rafiki_tpu.parallel.mesh import DeviceSpec
+from rafiki_tpu.store.meta_store import MetaStore
+
+
+def _mgr(meta, path, n_devices=2):
+    return ServicesManager(
+        meta, str(path), slot_size=1, platform="cpu",
+        devices=[DeviceSpec(id=i) for i in range(n_devices)])
+
+
+def _running_inference_job(meta):
+    user = meta.create_user(f"op{time.time_ns()}@x", "pw", "ADMIN")
+    tj = meta.create_train_job(user["id"], f"app{time.time_ns()}", 1,
+                               "LANGUAGE_MODELING", {"TRIAL_COUNT": 1},
+                               "d1", "d2")
+    ij = meta.create_inference_job(user["id"], tj["id"])
+    meta.update_inference_job(ij["id"], status="RUNNING")
+    return ij
+
+
+def _spawn_dummy(mgr, wd, ij_id, wid, slot=True):
+    return mgr._spawn(
+        "rafiki_tpu.chaos.dummy_service",
+        {"worker_id": wid, "drain_linger_s": 0.2,
+         "obs_port_file": str(Path(wd) / f"{wid}.obs_port")},
+        ServiceType.INFERENCE_WORKER,
+        slot=mgr.allocator.acquire(timeout=5.0) if slot else None,
+        inference_job_id=ij_id)
+
+
+def _wait_ports(wd, wids, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if all((Path(wd) / f"{w}.obs_port").exists() for w in wids):
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"obs ports never appeared for {wids}")
+
+
+# ------------------------------------------------- durable spawn state
+
+def test_spawn_records_durable_state(tmp_path):
+    """The service row carries the FULL spawn recipe plus the pid's
+    kernel start time — everything a restarted admin needs to re-adopt
+    or respawn without any in-memory state."""
+    meta = MetaStore(str(tmp_path / "meta.db"))
+    ij = _running_inference_job(meta)
+    mgr = _mgr(meta, tmp_path / "wd")
+    try:
+        svc = _spawn_dummy(mgr, tmp_path / "wd", ij["id"], "dw-0")
+        row = meta.get_service(svc.service_id)
+        spec = row["spawn_spec"]
+        assert spec["module"] == "rafiki_tpu.chaos.dummy_service"
+        assert spec["service_type"] == ServiceType.INFERENCE_WORKER
+        assert spec["needs_slot"] is True
+        assert spec["config"]["worker_id"] == "dw-0"
+        assert spec["meta_kwargs"]["inference_job_id"] == ij["id"]
+        assert row["start_time"] == proc_start_time(svc.proc.pid) > 0
+        assert identity_matches(svc.proc.pid, row["start_time"])
+        # the data plane row is durable the same way
+        mgr.start_data_plane()
+        kv_row = meta.get_service(mgr._kv_service_id)
+        assert kv_row["start_time"] == proc_start_time(
+            mgr._kv_proc.pid) > 0
+        assert kv_row["spawn_spec"]["service_type"] == \
+            ServiceType.DATA_PLANE
+    finally:
+        mgr.stop_all()
+
+
+def test_reconcile_adopts_live_services_and_kv(tmp_path):
+    """Admin dies (manager abandoned, children keep running) → a fresh
+    manager on the same store re-adopts every survivor: identical pids,
+    slots re-reserved, kvd adopted, respawn specs re-armed."""
+    meta = MetaStore(str(tmp_path / "meta.db"))
+    ij = _running_inference_job(meta)
+    mgr1 = _mgr(meta, tmp_path / "wd")
+    mgr1.start_data_plane()
+    kv_pid = mgr1._kv_proc.pid
+    old = [_spawn_dummy(mgr1, tmp_path / "wd", ij["id"], f"dw-{i}")
+           for i in range(2)]
+    _wait_ports(tmp_path / "wd", ["dw-0", "dw-1"])
+    old_pids = sorted(s.proc.pid for s in old)
+
+    # "SIGKILL the admin": mgr1 is abandoned without stop_all — its
+    # children and MetaStore rows survive it
+    mgr2 = _mgr(MetaStore(str(tmp_path / "meta.db")), tmp_path / "wd")
+    try:
+        rec = mgr2.reconcile()
+        assert rec["services_adopted"] == 2
+        assert rec["kv_adopted"] == 1
+        assert rec["services_crashed"] == 0
+        adopted = sorted(s.proc.pid for s in mgr2.services.values())
+        assert adopted == old_pids  # identical pids — nothing restarted
+        assert all(s.adopted and s.alive()
+                   for s in mgr2.services.values())
+        assert mgr2.allocator.free_count() == 0  # slots re-reserved
+        assert mgr2.kv_port and mgr2._kv_proc.pid == kv_pid
+        # healing is re-armed from the durable spawn specs
+        assert set(mgr2._respawn_specs) == \
+            {s.service_id for s in old}
+        # rolling restart still works over ADOPTED handles (drain →
+        # exit 0 → replace): proof the rebuilt processes are managed,
+        # not just listed
+        out = mgr2.rolling_restart(ij["id"], drain_timeout=30.0)
+        assert len(out["restarted"]) == 2
+        assert all(s.alive() for s in mgr2.services.values())
+    finally:
+        mgr2.stop_all()
+
+
+def test_reconcile_respawns_crashed_under_persisted_budget(tmp_path):
+    """Rows whose process died with the admin go CRASHED and re-enter
+    the respawn path — but under the budget PERSISTED in the store: an
+    admin restart cannot hand a crash-looping config a fresh budget."""
+    meta = MetaStore(str(tmp_path / "meta.db"))
+    ij = _running_inference_job(meta)
+    wd = tmp_path / "wd"
+    wd.mkdir()
+
+    def dead_worker_row(job_id, wid):
+        proc = subprocess.Popen(["/bin/true"])
+        proc.wait()
+        spec = {"module": "rafiki_tpu.chaos.dummy_service",
+                "config": {"worker_id": wid, "drain_linger_s": 0.1,
+                           "obs_port_file": str(wd / f"{wid}.obs_port")},
+                "service_type": ServiceType.INFERENCE_WORKER,
+                "needs_slot": False,
+                "meta_kwargs": {"inference_job_id": job_id}}
+        row = meta.create_service(
+            ServiceType.INFERENCE_WORKER, inference_job_id=job_id,
+            pid=proc.pid, spawn_spec=spec, start_time=123.0)
+        meta.update_service(row["id"], status=ServiceStatus.RUNNING)
+        return row
+
+    # budget has one respawn left (1 spent of max 2)
+    meta.incr_respawn_count(ServiceType.INFERENCE_WORKER, ij["id"])
+    row = dead_worker_row(ij["id"], "dw-r")
+    mgr = _mgr(meta, wd)
+    mgr.max_respawns = 2
+    try:
+        rec = mgr.reconcile()
+        assert rec["services_crashed"] == 1
+        assert meta.get_service(row["id"])["status"] == \
+            ServiceStatus.CRASHED
+        live = [s for s in mgr.services.values() if s.alive()]
+        assert len(live) == 1  # replacement spawned
+        # the increment WROTE THROUGH: a third admin would see 2 spent
+        lineage = f"{ServiceType.INFERENCE_WORKER}:{ij['id']}"
+        assert meta.get_respawn_counts()[lineage] == 2
+
+        # next admin restart: budget now exhausted → no new respawn,
+        # the job surfaces as degraded instead of crash-looping
+        for s in live:
+            s.proc.terminate()
+            s.proc.wait(timeout=10)
+        meta2 = MetaStore(str(tmp_path / "meta.db"))
+        row2 = dead_worker_row(ij["id"], "dw-r2")
+        mgr2 = _mgr(meta2, wd)
+        mgr2.max_respawns = 2
+        try:
+            rec2 = mgr2.reconcile()
+            assert rec2["services_crashed"] >= 1
+            assert not [s for s in mgr2.services.values()
+                        if s.service_type ==
+                        ServiceType.INFERENCE_WORKER and s.alive()]
+            assert ij["id"] in mgr2.degraded_jobs()
+            assert meta2.get_service(row2["id"])["status"] == \
+                ServiceStatus.CRASHED
+        finally:
+            mgr2.stop_all()
+    finally:
+        mgr.stop_all()
+
+
+def test_reconcile_reaps_orphans_of_stopped_jobs(tmp_path):
+    """A survivor whose job was stopped while no admin was alive is an
+    orphan burning a slot: killed (identity-gated) and marked STOPPED."""
+    meta = MetaStore(str(tmp_path / "meta.db"))
+    ij = _running_inference_job(meta)
+    mgr1 = _mgr(meta, tmp_path / "wd")
+    svc = _spawn_dummy(mgr1, tmp_path / "wd", ij["id"], "dw-orph")
+    _wait_ports(tmp_path / "wd", ["dw-orph"])
+    # the job is stopped AFTER the admin "died"
+    meta.update_inference_job(ij["id"], status="STOPPED")
+
+    mgr2 = _mgr(MetaStore(str(tmp_path / "meta.db")), tmp_path / "wd")
+    try:
+        rec = mgr2.reconcile()
+        assert rec["orphans_reaped"] == 1
+        assert rec["services_adopted"] == 0
+        assert meta.get_service(svc.service_id)["status"] == \
+            ServiceStatus.STOPPED
+        svc.proc.wait(timeout=10)  # reap our child: actually dead
+        assert not mgr2.services
+        assert mgr2.allocator.free_count() == 2  # slot NOT reserved
+    finally:
+        mgr2.stop_all()
+
+
+def test_pid_recycle_guard_start_time(tmp_path):
+    """A row whose pid is alive but whose recorded start time does not
+    match points at a RECYCLED pid: the reconciler must neither adopt
+    nor kill that process — the row is simply dead (CRASHED)."""
+    meta = MetaStore(str(tmp_path / "meta.db"))
+    ij = _running_inference_job(meta)
+    mgr1 = _mgr(meta, tmp_path / "wd")
+    svc = _spawn_dummy(mgr1, tmp_path / "wd", ij["id"], "dw-rec",
+                       slot=False)
+    _wait_ports(tmp_path / "wd", ["dw-rec"])
+    # forge a wrong start time (as if the real worker died and the
+    # kernel handed its pid to this unrelated-but-rafiki process);
+    # drop the spawn_spec so the crash path cannot respawn a twin that
+    # would muddy the aliveness assertion below
+    meta.update_service(svc.service_id, start_time=1.0, spawn_spec=None)
+    try:
+        mgr2 = _mgr(MetaStore(str(tmp_path / "meta.db")),
+                    tmp_path / "wd")
+        rec = mgr2.reconcile()
+        assert rec["services_adopted"] == 0
+        assert rec["services_crashed"] >= 1
+        assert meta.get_service(svc.service_id)["status"] == \
+            ServiceStatus.CRASHED
+        assert svc.alive()  # the recycled pid was NOT killed
+        # AdoptedProcess judges the same identity: wrong start time =
+        # dead, and signalling through it is a no-op
+        ap = AdoptedProcess(svc.proc.pid, start_time=1.0)
+        assert ap.poll() == AdoptedProcess.ADOPTED_EXIT
+        ap.kill()
+        assert svc.alive()
+        mgr2.stop_all()
+    finally:
+        mgr1.stop_all()
+
+
+def test_cold_start_reaps_instead_of_adopting(tmp_path, capsys):
+    """`stack start --cold` path: reap_stale_services kills every
+    recorded survivor (identity-gated) instead of adopting — the
+    operator opt-out for untrusted state."""
+    meta = MetaStore(str(tmp_path / "meta.db"))
+    ij = _running_inference_job(meta)
+    mgr1 = _mgr(meta, tmp_path / "wd")
+    svc = _spawn_dummy(mgr1, tmp_path / "wd", ij["id"], "dw-cold")
+    _wait_ports(tmp_path / "wd", ["dw-cold"])
+
+    mgr2 = _mgr(MetaStore(str(tmp_path / "meta.db")), tmp_path / "wd")
+    try:
+        assert mgr2.reap_stale_services() >= 1
+        svc.proc.wait(timeout=10)
+        assert meta.get_service(svc.service_id)["status"] == \
+            ServiceStatus.STOPPED
+        assert not mgr2.services  # nothing adopted
+        # the CLI exposes the flag (stack forwards it as cold_start)
+        from rafiki_tpu.cli import main as cli_main
+
+        with pytest.raises(SystemExit) as ei:
+            cli_main(["stack", "--help"])
+        assert ei.value.code == 0
+        assert "--cold" in capsys.readouterr().out
+    finally:
+        mgr2.stop_all()
+        mgr1.stop_all()
+
+
+# ------------------------------------------------------- admin fencing
+
+def test_admin_lease_acquire_takeover_and_fencing(tmp_path):
+    meta = MetaStore(str(tmp_path / "meta.db"))
+    mgr1 = _mgr(meta, tmp_path / "wd")
+    mgr2 = _mgr(MetaStore(str(tmp_path / "meta.db")), tmp_path / "w2")
+    got = mgr1.acquire_lease(ttl_s=30.0)
+    assert got["generation"] == 1 and not got["took_over"]
+    # a second live admin is fenced OUT at boot
+    with pytest.raises(LeaseHeldError) as ei:
+        mgr2.acquire_lease(ttl_s=30.0)
+    assert ei.value.lease["generation"] == 1
+    # re-acquire by the holder is a renew, not a takeover
+    assert mgr1.acquire_lease()["generation"] == 1
+
+    # the holder dies (heartbeat goes stale) → takeover bumps the
+    # fencing generation
+    meta.release_admin_lease(mgr1.lease_holder)
+    got2 = mgr2.acquire_lease(ttl_s=30.0)
+    assert got2["took_over"] and got2["generation"] == 2
+    assert mgr2.recovery["lease_takeovers"] == 1
+
+    # the stale admin's next renew FAILS and fences it: every mutating
+    # op now raises, and stop_all releases handles without killing
+    assert mgr1.renew_lease() is False
+    assert mgr1.fenced
+    with pytest.raises(AdminFencedError):
+        mgr1._spawn("rafiki_tpu.chaos.dummy_service", {},
+                    ServiceType.INFERENCE_WORKER)
+    with pytest.raises(AdminFencedError):
+        mgr1.stop_service("any")
+    with pytest.raises(AdminFencedError):
+        mgr1.rolling_restart("any")
+    with pytest.raises(AdminFencedError):
+        mgr1.start_data_plane()
+    mgr1.stop_all()  # must be a no-op cleanup, not a raise
+    # an unleased manager (unit-test/embedded use) is never fenced
+    mgr3 = _mgr(MetaStore(str(tmp_path / "meta.db")), tmp_path / "w3")
+    assert mgr3.renew_lease() is True and not mgr3.fenced
+    mgr2.stop_all()
+
+
+def test_fenced_stop_all_spares_adopted_children(tmp_path):
+    """The acceptance detail that makes fencing worth having: a STALE
+    admin shutting down must not kill the children the NEW admin just
+    adopted."""
+    meta = MetaStore(str(tmp_path / "meta.db"))
+    ij = _running_inference_job(meta)
+    mgr1 = _mgr(meta, tmp_path / "wd")
+    mgr1.acquire_lease(ttl_s=30.0)
+    svc = _spawn_dummy(mgr1, tmp_path / "wd", ij["id"], "dw-f")
+    _wait_ports(tmp_path / "wd", ["dw-f"])
+
+    mgr2 = _mgr(MetaStore(str(tmp_path / "meta.db")), tmp_path / "wd")
+    meta.release_admin_lease(mgr1.lease_holder)  # mgr1 "died"
+    mgr2.acquire_lease(ttl_s=30.0)
+    try:
+        assert mgr2.reconcile()["services_adopted"] == 1
+        assert mgr1.renew_lease() is False  # fenced
+        mgr1.stop_all()
+        time.sleep(0.2)
+        assert svc.alive(), "fenced admin killed an adopted child"
+        assert mgr2.services and all(
+            s.alive() for s in mgr2.services.values())
+    finally:
+        mgr2.stop_all()
+    svc.proc.wait(timeout=10)
+    assert not identity_matches(svc.proc.pid, 0)
+
+
+# ------------------------------- the acceptance chaos test (tier-1)
+
+def test_admin_kill_mid_stream_zero_drop(trained, tmp_path):
+    """THE acceptance drill: the control plane dies with an inference
+    stream in flight and is restarted against the same workdir +
+    MetaStore. The stream rides the kvd data plane, which the new
+    admin ADOPTS (same pid) instead of restarting — so the stream
+    completes token-exact vs a no-fault run: zero dropped, zero
+    duplicated. A concurrently booted second admin is fenced out by
+    the lease the whole time."""
+    from test_decode_engine import KNOBS
+
+    from rafiki_tpu.chaos import ChaosConfig, ChaosHub, ChaosInjector
+    from rafiki_tpu.models.llama_lora import LlamaLoRA
+    from rafiki_tpu.serving.predictor import Predictor
+    from rafiki_tpu.serving.queues import KVQueueHub
+    from rafiki_tpu.store.param_store import ParamStore
+    from rafiki_tpu.worker.inference import InferenceWorker
+
+    store = ParamStore.from_uri("mem://")
+    store.save("t0", trained.dump_parameters())
+    prompt = "tok1 tok2 tok3"
+    max_new = 16
+
+    def boot_worker(hub, delay_s=0.0):
+        if delay_s:
+            # pace the reply pushes so the 16-token stream SPANS the
+            # admin's death + lease takeover + reconcile (~1.5s) —
+            # delays change timing only, never content
+            hub = ChaosHub(hub, ChaosInjector(
+                ChaosConfig(delay_queue_s=delay_s)))
+        w = InferenceWorker(LlamaLoRA, "t0", KNOBS, store, hub, "w0",
+                            decode_loop=True, max_slots=4,
+                            max_new_tokens=max_new, steps_per_sync=1)
+        th = threading.Thread(target=w.run, daemon=True)
+        th.start()
+        return w, th
+
+    def collect(pred, out):
+        for ev in pred.predict_stream([prompt], timeout=120.0):
+            out.append((time.monotonic(), ev))
+
+    meta = MetaStore(str(tmp_path / "meta.db"))
+    mgr1 = _mgr(meta, tmp_path / "wd")
+    mgr1.acquire_lease(ttl_s=1.0)
+    mgr1.start_data_plane()
+    kv_pid = mgr1._kv_proc.pid
+
+    # no-fault reference over the SAME kvd (deterministic greedy)
+    hub = KVQueueHub(mgr1.kv_host, mgr1.kv_port)
+    w, th = boot_worker(hub)
+    ref: list = []
+    collect(Predictor(hub, ["w0"], gather_timeout=120.0), ref)
+    expected = ref[-1][1]["predictions"]
+    assert expected and expected[0]
+    w.stop()
+    th.join(timeout=30)
+
+    # live run: stream in flight while the admin dies + restarts
+    hub = KVQueueHub(mgr1.kv_host, mgr1.kv_port)
+    w, th = boot_worker(hub, delay_s=0.25)
+    events: list = []
+    t = threading.Thread(
+        target=collect,
+        args=(Predictor(hub, ["w0"], gather_timeout=120.0), events),
+        daemon=True)
+    t.start()
+    # wait until deltas are flowing — the stream IS in flight
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and len(events) < 2:
+        time.sleep(0.01)
+    assert len(events) >= 2, "stream never started"
+
+    # admin dies (no graceful shutdown ran); lease ttl 1s expires
+    t_kill = time.monotonic()
+    mgr2 = _mgr(MetaStore(str(tmp_path / "meta.db")), tmp_path / "wd")
+    while True:  # supervisor-style retry until the stale lease expires
+        try:
+            lease = mgr2.acquire_lease(ttl_s=30.0)
+            break
+        except LeaseHeldError:
+            assert time.monotonic() - t_kill < 30
+            time.sleep(0.05)
+    assert lease["took_over"] and lease["generation"] == 2
+    rec = mgr2.reconcile()
+    assert rec["kv_adopted"] == 1
+    assert mgr2._kv_proc.pid == kv_pid  # SAME kvd: queues intact
+    n_at_recovery = len(events)
+
+    # a duplicate third admin is fenced out while mgr2 is live
+    mgr3 = _mgr(MetaStore(str(tmp_path / "meta.db")), tmp_path / "w3")
+    with pytest.raises(LeaseHeldError):
+        mgr3.acquire_lease(ttl_s=30.0)
+
+    t.join(timeout=120)
+    assert not t.is_alive(), "stream never finished"
+    final = events[-1][1]
+    assert final.get("done") and "error" not in final, final
+    # token-exact vs the no-fault reference: zero dropped, zero
+    # duplicated tokens across the admin's death and rebirth
+    acc = "".join(v for _, e in events[:-1]
+                  for v in e.get("delta", {}).values())
+    assert final["predictions"] == expected
+    assert acc == expected[0]
+    # the stream was genuinely mid-flight when the control plane died
+    assert 0 < n_at_recovery < len(events)
+
+    w.stop()
+    th.join(timeout=30)
+    mgr2.stop_all()
+
+
+# ------------------------------------------ claim-race satellite
+
+def test_claim_trial_for_resume_two_concurrent_claimants(tmp_path):
+    """Exactly one of two concurrent claimants wins the conditional
+    UPDATE; the loser's (i.e. the presumed-dead owner's) late
+    mark_trial_completed is rejected by the fenced terminal update."""
+    meta = MetaStore(str(tmp_path / "meta.db"))
+    user = meta.create_user("op@x", "pw", "ADMIN")
+    tj = meta.create_train_job(user["id"], "app", 1,
+                               "IMAGE_CLASSIFICATION",
+                               {"TRIAL_COUNT": 1}, "d1", "d2")
+    sub = meta.create_sub_train_job(tj["id"], "m1")
+    trial = meta.create_trial(sub["id"], 0, "m1", {"lr": 0.1},
+                              worker_id="dead-worker")
+    # stale heartbeat: the owner is presumed dead
+    meta.update_trial(trial["id"], heartbeat_at=time.time() - 3600,
+                      started_at=time.time() - 3600)
+
+    barrier = threading.Barrier(2)
+    results = {}
+
+    def claim(wid):
+        # each claimant gets its own connection — two real worker
+        # processes would
+        m = MetaStore(str(tmp_path / "meta.db"))
+        barrier.wait()
+        results[wid] = m.claim_trial_for_resume(trial["id"], wid,
+                                                stale_after_s=60.0)
+
+    ts = [threading.Thread(target=claim, args=(f"w{i}",))
+          for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert sorted(results.values()) == [False, True], results
+    assert meta.get_trial(trial["id"])["status"] == "TERMINATED"
+    # the presumed-dead owner un-stalls and reports success: fenced out
+    assert meta.mark_trial_completed(trial["id"], 0.9, True) is False
+    assert meta.get_trial(trial["id"])["status"] == "TERMINATED"
+    # and an errored report is fenced identically
+    assert meta.mark_trial_errored(trial["id"], "boom") is False
+
+
+# ------------------------------------------------ backup satellite
+
+def test_metastore_backup_online_and_admin_route(tmp_path):
+    """Online snapshot while the store is live; the copy opens as a
+    full MetaStore. The admin exposes it as POST /system/backup and
+    the client SDK wraps that."""
+    from rafiki_tpu.admin.admin import Admin
+    from rafiki_tpu.admin.app import AdminApp
+    from rafiki_tpu.client import Client
+
+    meta = MetaStore(str(tmp_path / "meta.db"))
+    user = meta.create_user("u@x", "pw", "ADMIN")
+    tj = meta.create_train_job(user["id"], "app", 1,
+                               "IMAGE_CLASSIFICATION",
+                               {"TRIAL_COUNT": 1}, "d1", "d2")
+    out = meta.backup(str(tmp_path / "snap.db"))
+    assert out["bytes"] > 0
+    copy = MetaStore(str(tmp_path / "snap.db"))
+    assert copy.get_train_job(tj["id"])["app"] == "app"
+    assert copy.get_user_by_email("u@x") is not None
+
+    manager = _mgr(meta, tmp_path / "wd", n_devices=1)
+    admin = Admin(meta, manager)
+    app = AdminApp(admin)
+    host, port = app.start()
+    try:
+        c = Client(f"http://{host}:{port}")
+        c.login("superadmin@rafiki", "rafiki")
+        got = c.backup(str(tmp_path / "snap2.db"))
+        assert got["ok"] and got["bytes"] > 0
+        assert MetaStore(str(tmp_path / "snap2.db")).get_train_job(
+            tj["id"]) is not None
+        # non-admin users may not write server-side files
+        c.create_user("dev@x", "pw", "APP_DEVELOPER")
+        c2 = Client(f"http://{host}:{port}")
+        c2.login("dev@x", "pw")
+        from rafiki_tpu.client.client import HttpStatusError
+
+        with pytest.raises(HttpStatusError) as ei:
+            c2.backup(str(tmp_path / "nope.db"))
+        assert ei.value.status == 403
+    finally:
+        app.stop()
+
+
+def test_backup_cli(tmp_path, capsys):
+    from rafiki_tpu.cli import main as cli_main
+
+    wd = tmp_path / "stack"
+    wd.mkdir()
+    meta = MetaStore(str(wd / "meta.db"))
+    meta.create_user("u@x", "pw", "ADMIN")
+    rc = cli_main(["backup", str(tmp_path / "out.db"),
+                   "--workdir", str(wd)])
+    assert rc == 0
+    rep = json.loads(capsys.readouterr().out.strip())
+    assert rep["ok"] and rep["bytes"] > 0
+    assert MetaStore(str(tmp_path / "out.db")).get_user_by_email(
+        "u@x") is not None
+    # missing store: structured failure, not a traceback
+    assert cli_main(["backup", str(tmp_path / "o2.db"),
+                     "--workdir", str(tmp_path / "nowhere")]) == 1
+
+
+# ------------------------------------------------ doctor satellite
+
+def test_doctor_workdir_audit_reports_drift(tmp_path, capsys):
+    from rafiki_tpu.admin.doctor import audit_workdir, render_text
+    from rafiki_tpu.cli import main as cli_main
+
+    meta = MetaStore(str(tmp_path / "meta.db"))
+    ij = _running_inference_job(meta)
+    mgr = _mgr(meta, tmp_path)
+    try:
+        svc = _spawn_dummy(mgr, tmp_path, ij["id"], "dw-a")
+        _wait_ports(tmp_path, ["dw-a"])
+        rep = audit_workdir(str(tmp_path))
+        assert rep["ok"] and rep["drift"] == []
+        entry = next(s for s in rep["services"]
+                     if s["id"] == svc.service_id)
+        assert entry["pid_alive"] and entry["identity_ok"]
+        assert "no drift" in render_text(rep)
+
+        # drift 1: RUNNING row whose pid is dead
+        dead = subprocess.Popen(["/bin/true"])
+        dead.wait()
+        r1 = meta.create_service(ServiceType.INFERENCE_WORKER,
+                                 inference_job_id=ij["id"],
+                                 pid=dead.pid, start_time=5.0)
+        meta.update_service(r1["id"], status=ServiceStatus.RUNNING)
+        # drift 2: STOPPED row whose process is still alive (orphan)
+        row = meta.get_service(svc.service_id)
+        meta.update_service(svc.service_id,
+                            status=ServiceStatus.STOPPED)
+        # drift 3: stale obs_port file nothing listens on
+        (tmp_path / "ghost.obs_port").write_text("1")
+
+        rep2 = audit_workdir(str(tmp_path))
+        assert not rep2["ok"]
+        text = "\n".join(rep2["drift"])
+        assert "dead" in text
+        assert "still alive (orphaned process)" in text
+        assert "ghost.obs_port" in text
+
+        # the CLI renders both forms and exits 1 on drift
+        assert cli_main(["doctor", "--workdir", str(tmp_path),
+                         "--json"]) == 1
+        parsed = json.loads(capsys.readouterr().out)
+        assert parsed["drift"] == rep2["drift"]
+        assert cli_main(["doctor", "--workdir", str(tmp_path)]) == 1
+        assert "DRIFT" in capsys.readouterr().out
+        # restore the row so stop_all finalizes cleanly
+        meta.update_service(svc.service_id, status=row["status"])
+    finally:
+        mgr.stop_all()
+
+
+# ------------------------------------------- slow-tier real kill -9
+
+@pytest.mark.slow
+def test_control_driver_kill9_e2e(tmp_path):
+    """Out-of-process acceptance: a REAL control-plane process is
+    kill -9'd and a second one reconverges against the same workdir —
+    adopted pids identical, lease generation bumped, zero drift in the
+    doctor audit afterwards."""
+    import os
+    import signal
+    import sys
+
+    from rafiki_tpu.admin.doctor import audit_workdir
+
+    def start(mode, ready):
+        cfg = {"workdir": str(tmp_path),
+               "db_path": str(tmp_path / "meta.db"), "n_services": 2,
+               "ready_file": str(tmp_path / ready), "mode": mode,
+               "lease_ttl_s": 3.0}
+        cfg_path = tmp_path / f"{ready}.cfg.json"
+        cfg_path.write_text(json.dumps(cfg))
+        return subprocess.Popen(
+            [sys.executable, "-m", "rafiki_tpu.chaos.control_driver",
+             "--config", str(cfg_path)],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+
+    def wait_ready(name, proc, timeout=90.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if (tmp_path / name).exists():
+                return json.loads((tmp_path / name).read_text())
+            assert proc.poll() is None, "driver died"
+            time.sleep(0.1)
+        raise TimeoutError(name)
+
+    p1 = start("boot", "r1.json")
+    r1 = wait_ready("r1.json", p1)
+    os.kill(p1.pid, signal.SIGKILL)
+    p1.wait()
+    p2 = start("reconcile", "r2.json")
+    try:
+        r2 = wait_ready("r2.json", p2)
+        assert r2["adopted_pids"] == r1["spawned_pids"]
+        assert r2["kv_port"] == r1["kv_port"]
+        assert r2["took_over"] and r2["lease_generation"] == 2
+        assert r2["services_adopted"] == 2 and r2["kv_adopted"] == 1
+        rep = audit_workdir(str(tmp_path))
+        assert rep["ok"], rep["drift"]
+    finally:
+        p2.terminate()
+        assert p2.wait(timeout=60) == 0
