@@ -983,10 +983,30 @@ class ServicesManager:
                             "page) — omit it for the full-coverage "
                             "default")
                     cfg["kv_pages"] = pages
+                if "PAGED_KERNEL" in budget:
+                    # paged decode dispatch override: the Pallas
+                    # block-table kernel vs the page gather. Unset /
+                    # blank / "auto" keep the ops-level rule (kernel
+                    # on TPU, gather off-TPU); an explicit value
+                    # forces one path fleet-wide for this job (A/B,
+                    # incident rollback). Parsed by the WORKER'S own
+                    # tri-state coercion so the admin surface can
+                    # never mean something different from the same
+                    # value in a worker config.
+                    from ..worker.inference import _tristate
+
+                    pk = _tristate(budget["PAGED_KERNEL"])
+                    if pk is not None:
+                        cfg["paged_kernel"] = pk
             elif budget.get("KV_PAGES"):
                 raise ValueError(
                     "KV_PAGES requires KV_PAGE_SIZE in the same "
                     "budget (pages have no size without it)")
+            elif "PAGED_KERNEL" in budget:
+                raise ValueError(
+                    "PAGED_KERNEL requires KV_PAGE_SIZE in the same "
+                    "budget (it selects the PAGED decode path's "
+                    "implementation)")
             if decode_loop and budget.get("SPECULATE_K"):
                 # speculative decoding at the DEPLOYMENT surface:
                 # SPECULATE_K alone enables prompt-lookup drafting;

@@ -45,6 +45,8 @@ from rafiki_tpu.model import (BaseModel, CategoricalKnob, FixedKnob,
                               TrainContext, same_tree_shapes, train_epoch)
 from rafiki_tpu.models.bert import _TOKEN_RE, PAD_ID, HashTokenizer
 from rafiki_tpu.ops.attention import flash_attention
+from rafiki_tpu.ops.paged_attention import (paged_decode_attention,
+                                            resolve_paged_kernel)
 from rafiki_tpu.parallel.sharding import (DATA_AXIS, MODEL_AXIS,
                                           batch_sharding, make_mesh,
                                           param_shardings)
@@ -232,6 +234,20 @@ class LoRADense(nn.Module):
         return y
 
 
+def _masked_decode_attention(q, kk, vv, t, dh: int, dtype) -> jnp.ndarray:
+    """The decode branch's gather-path attention: (b, s, H, dh) queries
+    over (b, length, H, dh) logical-order keys/values, each query token
+    masked to keys at-or-before its own position. ``length`` follows
+    the gathered view — on paged engines that is the live-width slice
+    of the table (pages actually allocated), not ``max_len``, so the
+    fallback stops touching dead pages."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / np.sqrt(dh)
+    k_pos = jnp.arange(kk.shape[1])[None, None, None, :]
+    scores = jnp.where(k_pos <= t[:, None, :, None], scores, -1e30)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), -1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(dtype), vv)
+
+
 class _DecoderAttention(nn.Module):
     n_heads: int
     n_kv_heads: int
@@ -273,6 +289,16 @@ class _DecoderAttention(nn.Module):
     #: (idle lanes write there; never read unmasked).
     kv_page_size: int = 0
     kv_pages: int = 0
+    #: paged decode dispatch (kv_page_size > 0 only): ``None`` (auto)
+    #: runs the Pallas paged-attention kernel — which walks the block
+    #: table directly instead of gathering pages back to logical order
+    #: — on TPU and the page gather off-TPU; ``True``/``False`` force
+    #: one path (tests force ``True``, riding the interpreter on CPU).
+    #: Only the single-token decode step (s == 1, the generation hot
+    #: loop) takes the kernel; chunked prefill and speculative verify
+    #: windows keep the gather (multi-query windows are matmul-bound,
+    #: not page-walk-bound). See ``ops/paged_attention.py``.
+    paged_kernel: Optional[bool] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, lens: jnp.ndarray,
@@ -358,13 +384,20 @@ class _DecoderAttention(nn.Module):
                     widx = (jnp.arange(b)[:, None], t)
 
                 def as_rows(c):
-                    # cache → the (b, max_len, ...) logical view the
-                    # attention consumes: a page gather when paged,
-                    # identity otherwise
+                    # cache → the logical view the attention consumes:
+                    # a page gather when paged (covering only the
+                    # tables the engine passed — its live-width slice,
+                    # not max_len), identity otherwise
                     if paged:
                         return c[page_tables].reshape(
-                            (b, self.max_len) + c.shape[2:])
+                            (b, page_tables.shape[1]
+                             * self.kv_page_size) + c.shape[2:])
                     return c
+                # the paged-native kernel takes the single-token decode
+                # step (the generation hot loop); multi-token windows
+                # (chunked prefill, speculative verify) keep the gather
+                use_kernel = (paged and s == 1
+                              and resolve_paged_kernel(self.paged_kernel))
                 if self.kv_int8:
                     def q8(u):
                         scale = jnp.maximum(
@@ -381,6 +414,20 @@ class _DecoderAttention(nn.Module):
                     cv.value = cv.value.at[widx].set(qv_)
                     sk.value = sk.value.at[widx].set(sk_)
                     sv.value = sv.value.at[widx].set(sv_)
+                else:
+                    ck.value = ck.value.at[widx].set(k)
+                    cv.value = cv.value.at[widx].set(v)
+                if use_kernel:
+                    # walk the block table directly: partial softmax
+                    # per pool page, LSE-merged, int8 dequant fused
+                    # into the page load, dead pages skipped — per-step
+                    # HBM traffic scales with live tokens
+                    o = paged_decode_attention(
+                        q[:, 0], ck.value, cv.value, page_tables,
+                        t[:, 0], sm_scale=1.0 / float(np.sqrt(dh)),
+                        **({"k_scale": sk.value, "v_scale": sv.value}
+                           if self.kv_int8 else {}))[:, None]
+                elif self.kv_int8:
                     # multiply in f32 and cast the PRODUCT: casting the
                     # scales to bf16 first would throw away the very
                     # precision their f32 storage pays for (XLA fuses
@@ -391,20 +438,14 @@ class _DecoderAttention(nn.Module):
                     deq_v = (as_rows(cv.value).astype(jnp.float32)
                              * as_rows(sv.value)[..., None]).astype(
                                  x.dtype)
-                    kk = jnp.repeat(deq_k, rep, axis=2)
-                    vv = jnp.repeat(deq_v, rep, axis=2)
+                    o = _masked_decode_attention(
+                        q, jnp.repeat(deq_k, rep, axis=2),
+                        jnp.repeat(deq_v, rep, axis=2), t, dh, x.dtype)
                 else:
-                    ck.value = ck.value.at[widx].set(k)
-                    cv.value = cv.value.at[widx].set(v)
-                    kk = jnp.repeat(as_rows(ck.value), rep, axis=2)
-                    vv = jnp.repeat(as_rows(cv.value), rep, axis=2)
-                scores = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / np.sqrt(dh)
-                k_pos = jnp.arange(self.max_len)[None, None, None, :]
-                scores = jnp.where(k_pos <= t[:, None, :, None],
-                                   scores, -1e30)
-                probs = jax.nn.softmax(scores.astype(jnp.float32), -1)
-                o = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(x.dtype),
-                               vv)
+                    o = _masked_decode_attention(
+                        q, jnp.repeat(as_rows(ck.value), rep, axis=2),
+                        jnp.repeat(as_rows(cv.value), rep, axis=2),
+                        t, dh, x.dtype)
         else:
             if self.seq_axis is not None:
                 qt = q.transpose(0, 2, 1, 3)
@@ -470,6 +511,7 @@ class _DecoderBlock(nn.Module):
     kv_int8: bool = False  # serving-only int8 KV cache
     kv_page_size: int = 0  # >0 → paged KV pool (see _DecoderAttention)
     kv_pages: int = 0
+    paged_kernel: Optional[bool] = None  # paged decode dispatch (ditto)
 
     @nn.compact
     def __call__(self, x, lens, positions, decode, adapter_ids=None,
@@ -481,7 +523,7 @@ class _DecoderBlock(nn.Module):
             head_axis=self.head_axis,
             rope_theta=self.rope_theta, rope_scaling=self.rope_scaling,
             kv_int8=self.kv_int8, kv_page_size=self.kv_page_size,
-            kv_pages=self.kv_pages,
+            kv_pages=self.kv_pages, paged_kernel=self.paged_kernel,
             name="attn")(RMSNorm()(x), lens, positions, decode,
                          adapter_ids, page_tables)
         y = RMSNorm()(x)
@@ -570,6 +612,10 @@ class Llama(nn.Module):
     # plain generate paths use contiguous-cache modules.
     kv_page_size: int = 0
     kv_pages: int = 0
+    # paged decode dispatch (see _DecoderAttention.paged_kernel): None
+    # (auto) = Pallas block-table kernel on TPU, page gather off-TPU;
+    # True/False force one path. Serving-surface flag like kv_pages.
+    paged_kernel: Optional[bool] = None
 
     @nn.compact
     def __call__(self, ids: jnp.ndarray, lens: Optional[jnp.ndarray] = None,
@@ -616,6 +662,7 @@ class Llama(nn.Module):
                           kv_int8=self.kv_int8,
                           kv_page_size=self.kv_page_size,
                           kv_pages=self.kv_pages,
+                          paged_kernel=self.paged_kernel,
                           name=f"block_{i}")(x, lens, positions, decode,
                                              adapter_ids, page_tables)
         x = RMSNorm(name="final_norm")(x)
@@ -1337,7 +1384,8 @@ class LlamaLoRA(BaseModel):
                 seq_mesh: Any = None,
                 seq_axis: Optional[str] = None,
                 head_axis: Optional[str] = None,
-                kv_page_size: int = 0, kv_pages: int = 0) -> Llama:
+                kv_page_size: int = 0, kv_pages: int = 0,
+                paged_kernel: Optional[bool] = None) -> Llama:
         k = self.knobs
         hd = int(k["hidden_dim"])
         heads = int(k["n_heads"])
@@ -1360,7 +1408,8 @@ class LlamaLoRA(BaseModel):
                          k.get("rope_scaling", "")),
                      kv_int8=bool(k.get("kv_cache_int8", False)),
                      kv_page_size=int(kv_page_size),
-                     kv_pages=int(kv_pages))
+                     kv_pages=int(kv_pages),
+                     paged_kernel=paged_kernel)
 
     def estimate_device_budget(self, n_devices: int) -> Dict[str, int]:
         """Per-device train-step HBM budget for THIS parameterization on
@@ -1501,19 +1550,23 @@ class LlamaLoRA(BaseModel):
         return out
 
     def _serving_module_params(self, kv_page_size: int = 0,
-                               kv_pages: int = 0) -> Tuple[Llama, Any]:
+                               kv_pages: int = 0,
+                               paged_kernel: Optional[bool] = None
+                               ) -> Tuple[Llama, Any]:
         """(module, params) for predict()/make_decode_engine — the int8
         pair when the quantize_int8 knob is set (quantized once per
         trained tree, then cached). Paging fields shape only the decode
-        CACHE, never the params, so any (kv_page_size, kv_pages) pair
-        serves the same trained tree."""
+        CACHE, never the params, so any (kv_page_size, kv_pages,
+        paged_kernel) triple serves the same trained tree."""
         if not self.knobs.get("quantize_int8"):
             return self._module(kv_page_size=kv_page_size,
-                                kv_pages=kv_pages), self._params
+                                kv_pages=kv_pages,
+                                paged_kernel=paged_kernel), self._params
         if self._qparams is None:
             self._qparams = quantize_llama_params(self._params)
         return self._module(quantized=True, kv_page_size=kv_page_size,
-                            kv_pages=kv_pages), self._qparams
+                            kv_pages=kv_pages,
+                            paged_kernel=paged_kernel), self._qparams
 
     def _dtype(self):
         # single source of truth for the bf16 knob → compute dtype
@@ -2077,7 +2130,8 @@ class LlamaLoRA(BaseModel):
                            system_prefix: str = "",
                            draft_model: Optional["LlamaLoRA"] = None,
                            kv_page_size: int = 0,
-                           kv_pages: int = 0):
+                           kv_pages: int = 0,
+                           paged_kernel: Optional[bool] = None):
         """Continuous-batching serving engine over this model's weights
         (BASELINE.md config #5). The inference worker drives it when
         running in decode-loop mode; see ``serving/decode_engine.py``.
@@ -2095,14 +2149,20 @@ class LlamaLoRA(BaseModel):
         ``kv_pages=0`` defaults to full coverage (no saving, no
         stalls); size it down per docs/operations.md. Token-bit-exact
         with the contiguous engine. The draft model's own cache stays
-        contiguous (drafts are small)."""
+        contiguous (drafts are small).
+
+        ``paged_kernel`` (paged engines only): ``None`` (auto, the
+        default) decodes through the Pallas block-table kernel on TPU
+        and the page gather off-TPU; ``True``/``False`` force one
+        path (see ``ops/paged_attention.py``)."""
         assert self._params is not None, "model is not trained/loaded"
         if kv_page_size > 0 and not kv_pages:
             kv_pages = _default_kv_pages(max_slots,
                                          int(self.knobs["max_len"]),
                                          int(kv_page_size))
         module, params = self._serving_module_params(
-            kv_page_size=kv_page_size, kv_pages=kv_pages)
+            kv_page_size=kv_page_size, kv_pages=kv_pages,
+            paged_kernel=paged_kernel if kv_page_size > 0 else None)
         text_engine = self._build_text_engine(
             module, params, max_slots, max_new_tokens, steps_per_sync,
             prefill_chunk, speculate_k, draft_model=draft_model)
@@ -2178,7 +2238,8 @@ class LlamaLoRA(BaseModel):
                                   speculate_k: int = 0,
                                   validate: bool = True,
                                   kv_page_size: int = 0,
-                                  kv_pages: int = 0):
+                                  kv_pages: int = 0,
+                                  paged_kernel: Optional[bool] = None):
         """ONE continuous-batching engine serving N adapter-only
         fine-tunes of one base (S-LoRA-style multi-adapter serving).
 
@@ -2215,7 +2276,10 @@ class LlamaLoRA(BaseModel):
         module = self._module(quantized=quantized,
                               n_adapters=len(trees),
                               kv_page_size=kv_page_size,
-                              kv_pages=kv_pages)
+                              kv_pages=kv_pages,
+                              paged_kernel=(paged_kernel
+                                            if kv_page_size > 0
+                                            else None))
         return self._build_text_engine(
             module, stacked, max_slots, max_new_tokens, steps_per_sync,
             prefill_chunk, speculate_k)

@@ -1,0 +1,257 @@
+"""Paged-native flash decode attention as a Pallas TPU kernel.
+
+The paged KV pool (serving PR 5) cut cache HBM 2.56x but the decode hot
+loop paid the win back: every step ``_DecoderAttention`` gathered all of
+a slot's pages back into logical ``(b, max_len, heads, dh)`` order
+before the masked softmax, re-materializing the whole logical KV per
+generated token. This kernel consumes the pool **directly**:
+
+- **Grid over (batch, kv-head tile, pages).** Each program reads ONE
+  ``(page_size, block_h, dh)`` K/V block straight out of the pool — the
+  block table rides in as a scalar-prefetch operand and the BlockSpec
+  index map does the table walk (``tabs[b, page]``), so the page gather
+  never materializes in HBM.
+- **LSE-merged partial softmax.** Per page the program computes a
+  partial (max, sum, weighted-V accumulator) and folds it into running
+  f32 state in VMEM scratch — the same online-softmax recurrence
+  ``_attn_fwd_kernel`` streams key blocks with, here streamed across
+  grid steps (TPU grids execute sequentially per core; the page axis is
+  minor, so a (batch, head-tile) row sees its pages back to back and
+  the final page step writes the normalized output).
+- **Live pages only.** A slot at position ``t`` owns ``t // page_size
+  + 1`` live pages; later grid steps map their block index to pool
+  page 0 (the engine's scratch page — dead table entries already point
+  there) and skip compute via ``pl.when``. Consecutive same-index
+  fetches are elided by the pipeline, so per-step HBM traffic scales
+  with LIVE tokens, not ``max_len``.
+- **Fused int8-KV dequant.** Quantized pools pass their f32 absmax
+  scale rows (same pool geometry, same table walk); the kernel
+  dequantizes each page block in registers — the scale multiply fuses
+  into the f32 attention math and no dequantized cache ever exists.
+- **GQA without the repeat.** Queries arrive grouped per kv head
+  (``rep = n_heads / n_kv_heads`` query rows share one K/V page
+  block), so the ``jnp.repeat`` the gather path pays per step never
+  happens. ``block_h`` tiles kv heads per program exactly like
+  ``flash_attention``'s head tiling (env default via
+  ``_env_block_h``, same divisibility fallback).
+
+Dispatch policy (mirrors ``ops/attention.py``): the decode path runs
+the kernel on TPU by default and falls back to the page gather off-TPU
+(``resolve_paged_kernel``); ``interpret=True`` forces the kernel
+through the Pallas interpreter, which is how the CPU tier-1 equivalence
+tests run it. Numerics: f32 accumulation regardless of pool dtype; the
+online softmax is the associativity-reordered twin of the gather path's
+masked softmax, so outputs agree to f32 roundoff (token-exact in
+practice — proven per decode mode in ``tests/test_paged_kv.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from rafiki_tpu.ops.attention import NEG_INF, _env_block_h, \
+    _resolve_interpret
+from rafiki_tpu.ops.common import gqa_repeat_factor
+
+
+def resolve_paged_kernel(flag: Optional[bool]) -> bool:
+    """The serving dispatch rule for the ``paged_kernel`` flag:
+    ``None`` (auto, the fleet default) runs the kernel only on a real
+    TPU backend — off-TPU the page gather through XLA is orders of
+    magnitude faster than the Pallas interpreter. An explicit
+    ``True``/``False`` wins either way (tests force ``True`` and ride
+    the interpreter via ``_resolve_interpret``)."""
+    if flag is None:
+        return jax.default_backend() == "tpu"
+    return bool(flag)
+
+
+def _paged_decode_kernel(t_ref, tab_ref, q_ref, k_ref, v_ref, *rest,
+                         sm_scale: float, page_size: int, block_h: int,
+                         n_tables: int, quantized: bool):
+    from jax.experimental import pallas as pl
+
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_scr, l_scr, acc_scr = rest
+    bi = pl.program_id(0)
+    pg = pl.program_id(2)
+    t = t_ref[bi]  # this slot's query position (keys k_pos <= t live)
+    n_live = t // page_size + 1
+
+    @pl.when(pg == 0)
+    def _init():  # fresh (batch, head-tile) row: reset the running state
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(pg < n_live)
+    def _partial():  # dead pages: no compute (their fetch was elided by
+        # the index map collapsing them onto the scratch page)
+        k_pos = pg * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        mask = k_pos <= t  # (1, page_size): masks the last live page's
+        # dead tail AND any speculative-overwrite rows above t
+        for hh in range(block_h):  # static unroll over the head tile
+            q = q_ref[0, hh].astype(jnp.float32) * sm_scale  # (rep, dh)
+            k = k_ref[0, :, hh, :].astype(jnp.float32)  # (page_size, dh)
+            v = v_ref[0, :, hh, :].astype(jnp.float32)
+            if quantized:  # dequant in registers, fused into the math
+                k = k * ks_ref[0, :, hh][:, None]
+                v = v * vs_ref[0, :, hh][:, None]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # (rep, page_size)
+            s = jnp.where(mask, s, NEG_INF)
+
+            m_prev = m_scr[hh]  # (rep, 1) running max
+            m_new = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)
+            l_scr[hh] = l_scr[hh] * alpha + jnp.sum(p, -1, keepdims=True)
+            acc_scr[hh] = acc_scr[hh] * alpha + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)  # (rep, dh)
+            m_scr[hh] = m_new
+
+    @pl.when(pg == n_tables - 1)
+    def _finish():  # position 0 is always live, so l > 0 on every row
+        o_ref[0] = (acc_scr[...] /
+                    jnp.maximum(l_scr[...], 1e-30)).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, k_pool, v_pool, page_tables, positions,
+                           sm_scale: float,
+                           k_scale=None, v_scale=None,
+                           block_h: Optional[int] = None,
+                           interpret: Optional[bool] = None
+                           ) -> jnp.ndarray:
+    """Single-token decode attention straight off a paged KV pool.
+
+    - ``q``: (b, n_heads, dh) — this step's query vector per slot.
+    - ``k_pool``/``v_pool``: (n_pages, page_size, n_kv_heads, dh), the
+      per-layer pool (f32/bf16, or int8 with ``k_scale``/``v_scale``
+      absmax rows of shape (n_pages, page_size, n_kv_heads)).
+    - ``page_tables``: (b, n_tables) int32 logical→pool page map. Dead
+      entries (at or past a slot's live count) must point at a valid
+      pool page — the serving engine keeps them at 0, the scratch page.
+      The table may be narrower than ``max_len/page_size``: it only has
+      to cover every slot's live pages (the engine passes its
+      live-width slice).
+    - ``positions``: (b,) int32 query positions; keys ``k_pos <=
+      positions[i]`` are visible to slot i (the decode-branch mask).
+
+    Returns (b, n_heads, dh) in ``q``'s dtype. GQA queries are grouped
+    per kv head internally (``jnp.repeat`` convention: q head h ↔ kv
+    head ``h // rep``). ``block_h`` tiles kv heads per program
+    (default: the ``RAFIKI_ATTN_BLOCK_H`` fleet default through the
+    same divisibility fallback as ``flash_attention``).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, n_heads, dh = q.shape
+    n_pages, page_size, n_kv, dh_k = k_pool.shape
+    if dh_k != dh:
+        raise ValueError(f"head_dim mismatch: q has {dh}, pool {dh_k}")
+    rep = gqa_repeat_factor(n_heads, n_kv)
+    n_tables = page_tables.shape[1]
+    if block_h is None:
+        block_h = _env_block_h(n_kv)
+    if block_h < 1 or n_kv % block_h:
+        raise ValueError(f"block_h={block_h} must be >= 1 and divide "
+                         f"the kv head count ({n_kv})")
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None):
+        raise ValueError("k_scale and v_scale must be passed together")
+    interpret = _resolve_interpret(interpret)
+
+    qh = q.reshape(b, n_kv, rep, dh)
+    t = jnp.asarray(positions, jnp.int32)
+    tabs = jnp.asarray(page_tables, jnp.int32)
+
+    def q_map(bi, kh, pg, t_ref, tab_ref):
+        return (bi, kh, 0, 0)
+
+    def kv_map(bi, kh, pg, t_ref, tab_ref):
+        # the block-table walk: live pages come from the table, dead
+        # ones collapse onto pool page 0 so consecutive dead steps
+        # re-use one (skipped-compute) fetch instead of streaming
+        # garbage — per-step traffic scales with live tokens
+        live = pg <= t_ref[bi] // page_size
+        return (jnp.where(live, tab_ref[bi, pg], 0), 0, kh, 0)
+
+    def sc_map(bi, kh, pg, t_ref, tab_ref):
+        live = pg <= t_ref[bi] // page_size
+        return (jnp.where(live, tab_ref[bi, pg], 0), 0, kh)
+
+    in_specs = [
+        pl.BlockSpec((1, block_h, rep, dh), q_map),
+        pl.BlockSpec((1, page_size, block_h, dh), kv_map),
+        pl.BlockSpec((1, page_size, block_h, dh), kv_map),
+    ]
+    operands = [qh, k_pool, v_pool]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, page_size, block_h), sc_map),
+                     pl.BlockSpec((1, page_size, block_h), sc_map)]
+        operands += [k_scale, v_scale]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, n_kv // block_h, n_tables),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, block_h, rep, dh), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((block_h, rep, 1), jnp.float32),   # running max
+            pltpu.VMEM((block_h, rep, 1), jnp.float32),   # running sum
+            pltpu.VMEM((block_h, rep, dh), jnp.float32),  # weighted V
+        ],
+    )
+    kernel = functools.partial(
+        _paged_decode_kernel, sm_scale=float(sm_scale),
+        page_size=page_size, block_h=block_h, n_tables=n_tables,
+        quantized=quantized)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, n_kv, rep, dh), q.dtype),
+        interpret=interpret,
+    )(t, tabs, *operands)
+    return out.reshape(b, n_heads, dh)
+
+
+def _paged_attention_reference(q, k_pool, v_pool, page_tables, positions,
+                               sm_scale: float, k_scale=None,
+                               v_scale=None) -> jnp.ndarray:
+    """Pure-XLA oracle: gather the pages back into logical order (the
+    pre-kernel serving path) and run the masked softmax in f32. The
+    kernel-equivalence property tests compare against this."""
+    b, n_heads, dh = q.shape
+    _, page_size, n_kv, _ = k_pool.shape
+    rep = gqa_repeat_factor(n_heads, n_kv)
+    n_tables = page_tables.shape[1]
+    length = n_tables * page_size
+
+    def rows(pool):  # (b, length, n_kv, ...) logical view
+        return pool[page_tables].reshape((b, length) + pool.shape[2:])
+
+    k = rows(k_pool).astype(jnp.float32)
+    v = rows(v_pool).astype(jnp.float32)
+    if k_scale is not None:
+        k = k * rows(k_scale)[..., None]
+        v = v * rows(v_scale)[..., None]
+    k = jnp.repeat(k, rep, axis=2)
+    v = jnp.repeat(v, rep, axis=2)
+    s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32),
+                   k) * sm_scale
+    k_pos = jnp.arange(length)[None, None, :]
+    s = jnp.where(k_pos <= jnp.asarray(positions)[:, None, None],
+                  s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhk,bkhd->bhd", p, v).astype(q.dtype)

@@ -58,6 +58,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..obs.metrics import StatsMap
+from ..ops.paged_attention import resolve_paged_kernel
 
 # Speculation break-even (tokens per verify call) and how many scan
 # calls to wait before re-probing a gated-off speculator. ~1.5 means a
@@ -196,8 +197,17 @@ class DecodeEngine:
             self._res_total = 0
         else:
             self._n_table = 1  # dummy operand keeps signatures uniform
+        #: is the paged-native Pallas decode kernel live on this engine
+        #: (module flag resolved against the backend — the ops-level
+        #: dispatch rule)? Surfaced as the ``paged_kernel_active``
+        #: gauge so kernel-vs-gather fleets are tellable apart on
+        #: /metrics.
+        self.paged_kernel_active = bool(
+            self.paged and resolve_paged_kernel(
+                getattr(module, "paged_kernel", None)))
         self._ptab = np.zeros((self.B, self._n_table), np.int32)
         self._ptab_dev = jnp.asarray(self._ptab)
+        self._ptab_dev_width = self._n_table
         self._ptab_dirty = False
         self._cache = module.init(
             jax.random.PRNGKey(0), jnp.zeros((self.B, 1), jnp.int32),
@@ -282,7 +292,10 @@ class DecodeEngine:
             # (backpressure waits, not refusals)
             "kv_pages_used": 0, "kv_pages_high_water": 0,
             "kv_pages_total": (self.n_pages - 1 if self.paged else 0),
-            "admission_stalls": 0})
+            "admission_stalls": 0,
+            # 1 while the Pallas block-table decode kernel serves this
+            # engine's single-token steps (0 = page gather / contiguous)
+            "paged_kernel_active": int(self.paged_kernel_active)})
         #: optional request-lifecycle hook ``(event, request_id, attrs)``
         #: — the inference worker wires it into its trace buffer and
         #: latency histograms (TTFT, time-in-queue). Events: admitted,
@@ -408,12 +421,31 @@ class DecodeEngine:
                        self.n_pages - 1 - len(self._free_pages))
         self.stats.set("kv_pages_total", self.n_pages - 1)
 
+    def _live_table_width(self) -> int:
+        """Table columns the NEXT compiled call actually needs: enough
+        to cover every slot's allocated pages (``_ensure_pages_to`` runs
+        before every call, so ``_n_alloc`` already reflects that call's
+        write horizon), rounded up to a power of two so the jit cache
+        sees at most log2(max_len/page_size) distinct operand widths.
+        Slicing the operand shrinks BOTH decode paths' per-step cost to
+        live tokens: the gather fallback stops materializing (and
+        soft-maxing over) dead pages, and the kernel's page grid stops
+        iterating them."""
+        hi = max(1, int(self._n_alloc.max()))
+        w = 1
+        while w < hi:
+            w *= 2
+        return min(w, self._n_table)
+
     def _ptab_arg(self) -> jnp.ndarray:
         """The page-table operand every compiled call consumes (a tiny
         constant zeros array on contiguous engines), re-uploaded only
-        when allocation changed it."""
-        if self._ptab_dirty:
-            self._ptab_dev = jnp.asarray(self._ptab)
+        when allocation changed it — and sliced to the live width (see
+        :meth:`_live_table_width`) on paged engines."""
+        width = self._live_table_width() if self.paged else self._n_table
+        if self._ptab_dirty or width != self._ptab_dev_width:
+            self._ptab_dev = jnp.asarray(self._ptab[:, :width])
+            self._ptab_dev_width = width
             self._ptab_dirty = False
         return self._ptab_dev
 
@@ -538,11 +570,11 @@ class DecodeEngine:
         """Zero the served-traffic counters without losing capacity
         gauges (``kv_pages_total`` describes the pool, not traffic) —
         what the worker's post-warmup scrub needs."""
-        keep = {}
+        keep = {"paged_kernel_active": int(self.paged_kernel_active)}
         if self.paged:
-            keep = {"kv_pages_total": self.n_pages - 1,
-                    "kv_pages_used":
-                        self.n_pages - 1 - len(self._free_pages)}
+            keep.update(kv_pages_total=self.n_pages - 1,
+                        kv_pages_used=(self.n_pages - 1
+                                       - len(self._free_pages)))
         self.stats.reset(keep=keep)
 
     def stats_snapshot(self) -> Dict[str, int]:

@@ -89,6 +89,7 @@ class InferenceWorker:
                  draft_trial_id: str = "",
                  draft_knobs: Optional[dict] = None,
                  kv_page_size: int = 0, kv_pages: int = 0,
+                 paged_kernel: Optional[bool] = None,
                  chaos: Optional[Any] = None) -> None:
         self.worker_id = worker_id
         self.hub = hub
@@ -151,6 +152,11 @@ class InferenceWorker:
             "per-request generated-token throughput",
             buckets=(1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000,
                      5000))
+        self._h_step = self.metrics.histogram(
+            "decode_step_seconds",
+            "one fused engine step() — admission + K decode tokens "
+            "(seconds); read next to paged_kernel_active to see the "
+            "kernel-vs-gather difference on a live worker")
         #: engine request id -> (trace_id, queued monotonic). Touched
         #: only by the serve-loop thread (submits, step, span hook all
         #: run there), so no lock
@@ -217,6 +223,8 @@ class InferenceWorker:
                 # predate paged KV keep working at the defaults
                 extra = {"kv_page_size": kv_page_size,
                          "kv_pages": kv_pages}
+                if paged_kernel is not None:
+                    extra["paged_kernel"] = bool(paged_kernel)
             try:
                 self.engine = self.model.make_multi_adapter_engine(
                     trees, max_slots=max_slots,
@@ -250,6 +258,10 @@ class InferenceWorker:
                     # pool (live tokens), not max_slots x max_len
                     extra["kv_page_size"] = kv_page_size
                     extra["kv_pages"] = kv_pages
+                    if paged_kernel is not None:
+                        # explicit kernel-vs-gather override; absent =
+                        # the ops-level auto rule (kernel on TPU only)
+                        extra["paged_kernel"] = bool(paged_kernel)
                 if draft_trial_id and speculate_k:
                     # draft-MODEL speculation: a second (smaller) trial
                     # drafts; its own knobs shape it (same tokenizer
@@ -716,7 +728,9 @@ class InferenceWorker:
             stepped = self.engine.busy
             if stepped:
                 try:
+                    t_step = time.monotonic()
                     n_live = self.engine.step()
+                    self._h_step.observe(time.monotonic() - t_step)
                     self._h_occupancy.observe(n_live)
                 except Exception:
                     err = traceback.format_exc()
@@ -920,6 +934,23 @@ def _expired(msg: dict, skew_s: float = EXPIRY_SKEW_TOLERANCE_S,
     return ts is not None and time.time() > float(ts) + skew_s
 
 
+def _tristate(v: Any) -> Optional[bool]:
+    """Config value → the ``paged_kernel`` tri-state: absent /
+    blank / ``"auto"`` mean None (the ops-level backend rule
+    decides); anything else coerces to a hard bool override. One
+    parse for the worker config AND the admin ``PAGED_KERNEL``
+    budget key — two diverging coercions of the same value would be
+    a config-dependent dispatch bug."""
+    if v is None:
+        return None
+    if isinstance(v, str):
+        s = v.strip().lower()
+        if s in ("", "auto"):
+            return None
+        return s in ("1", "true", "on", "yes")
+    return bool(v)
+
+
 def _to_plain(preds: List[Any]) -> List[Any]:
     """Predictions as a list of plain lists/scalars (msgpack-safe)."""
     out = []
@@ -974,7 +1005,8 @@ def main(argv: Optional[list] = None) -> int:
         draft_knobs=_require_dict_or_none(cfg.get("draft_knobs"),
                                           "draft_knobs"),
         kv_page_size=int(cfg.get("kv_page_size", 0)),
-        kv_pages=int(cfg.get("kv_pages", 0)))
+        kv_pages=int(cfg.get("kv_pages", 0)),
+        paged_kernel=_tristate(cfg.get("paged_kernel")))
     # observability sidecar: /metrics + /debug/requests on an ephemeral
     # (or configured) port, written to obs_port_file for the operator
     obs_host, obs_port = worker.serve_obs(

@@ -1,0 +1,189 @@
+"""Paged-native flash decode kernel — LSE partial-softmax equivalence.
+
+Property tests for ``ops/paged_attention.py``: the Pallas kernel (run
+through the interpreter so CPU tier-1 exercises the REAL kernel math,
+not a fallback) must match the pure-XLA page-gather oracle across page
+counts, partial last pages, scratch-page garbage, GQA ratios, head
+tiles, int8 scale rows, and bf16 pools. The oracle is the same math
+``_DecoderAttention``'s gather path computes, which is what makes the
+engine-level kernel-vs-gather bit-exactness in ``test_paged_kv.py``
+plausible rather than lucky.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from rafiki_tpu.ops.paged_attention import (_paged_attention_reference,
+                                            paged_decode_attention,
+                                            resolve_paged_kernel)
+
+
+def _setup(positions, n_kv=2, rep=2, dh=8, ps=8, n_tables=4,
+           n_pages=12, seed=0, int8=False, scale=1.0, dtype=np.float32):
+    """Random pools + a permuted block table per slot: live pages drawn
+    from a shuffled free list (page 0 never live — the engine's scratch
+    invariant), dead entries left at 0. Scratch page filled with large
+    garbage so any leak past the position mask is loud."""
+    rng = np.random.default_rng(seed)
+    b = len(positions)
+    heads = n_kv * rep
+    q = (rng.normal(size=(b, heads, dh)) * scale).astype(dtype)
+    if int8:
+        kp = rng.integers(-127, 128,
+                          size=(n_pages, ps, n_kv, dh)).astype(np.int8)
+        vp = rng.integers(-127, 128,
+                          size=(n_pages, ps, n_kv, dh)).astype(np.int8)
+        ks = rng.uniform(1e-3, 0.1,
+                         size=(n_pages, ps, n_kv)).astype(np.float32)
+        vs = rng.uniform(1e-3, 0.1,
+                         size=(n_pages, ps, n_kv)).astype(np.float32)
+        scales = (ks, vs)
+    else:
+        kp = (rng.normal(size=(n_pages, ps, n_kv, dh))
+              * scale).astype(dtype)
+        vp = (rng.normal(size=(n_pages, ps, n_kv, dh))
+              * scale).astype(dtype)
+        kp[0], vp[0] = 1e3, -1e3  # scratch garbage: leaks are loud
+        scales = None
+    t = np.asarray(positions, np.int32)
+    tabs = np.zeros((b, n_tables), np.int32)
+    free = list(rng.permutation(np.arange(1, n_pages)))
+    for i in range(b):
+        for pg in range(int(t[i]) // ps + 1):
+            tabs[i, pg] = free.pop()
+    return q, kp, vp, tabs, t, scales
+
+
+def _both(q, kp, vp, tabs, t, scales=None, **kw):
+    sm = 1.0 / np.sqrt(q.shape[-1])
+    sk, sv = scales if scales else (None, None)
+    out = paged_decode_attention(q, kp, vp, tabs, t, sm_scale=sm,
+                                 k_scale=sk, v_scale=sv,
+                                 interpret=True, **kw)
+    ref = _paged_attention_reference(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tabs), t, sm,
+        None if sk is None else jnp.asarray(sk),
+        None if sv is None else jnp.asarray(sv))
+    return np.asarray(out, np.float32), np.asarray(ref, np.float32)
+
+
+@pytest.mark.parametrize("positions", [
+    [0, 0, 0, 0],          # single live key, page count 1
+    [3, 5, 1, 6],          # partial first page everywhere
+    [7, 8, 15, 16],        # exact page boundaries and first-past-it
+    [0, 7, 12, 31],        # mixed: 1..4 live pages, full last table
+])
+def test_kernel_matches_reference_across_page_counts(positions):
+    q, kp, vp, tabs, t, _ = _setup(positions)
+    out, ref = _both(q, kp, vp, tabs, t)
+    np.testing.assert_allclose(out, ref, atol=2e-6, rtol=1e-5)
+
+
+def test_scratch_page_garbage_never_leaks():
+    """Dead table entries point at pool page 0 (the engine's scratch
+    page). Its 1e3-magnitude garbage must not move the output: the
+    kernel skips dead pages entirely and masks the live tail, so the
+    answer equals an oracle run over a pool whose scratch page is
+    ZEROED (not merely the garbage oracle agreeing with itself)."""
+    q, kp, vp, tabs, t, _ = _setup([2, 9, 17, 30])
+    out, _ = _both(q, kp, vp, tabs, t)
+    kz, vz = kp.copy(), vp.copy()
+    kz[0], vz[0] = 0.0, 0.0
+    ref0 = np.asarray(_paged_attention_reference(
+        jnp.asarray(q), jnp.asarray(kz), jnp.asarray(vz),
+        jnp.asarray(tabs), t, 1.0 / np.sqrt(q.shape[-1])), np.float32)
+    np.testing.assert_allclose(out, ref0, atol=2e-6, rtol=1e-5)
+
+
+def test_live_width_table_slice_matches_full_width():
+    """The engine passes its live-width table slice; the kernel's
+    answer must not depend on how many dead columns ride along."""
+    q, kp, vp, tabs, t, _ = _setup([5, 9, 2, 0], n_tables=8)
+    full, _ = _both(q, kp, vp, tabs, t)
+    narrow, _ = _both(q, kp, vp, tabs[:, :2], t)
+    np.testing.assert_allclose(full, narrow, atol=2e-6, rtol=1e-5)
+
+
+def test_lse_merge_across_magnitude_spread():
+    """Pages with wildly different score magnitudes: the cross-page
+    LSE merge must stay stable where a naive sum-of-exps would
+    overflow/underflow."""
+    q, kp, vp, tabs, t, _ = _setup([31, 31, 31, 31], n_pages=20,
+                                   scale=1.0)
+    # scale each LIVE page's keys by 10^page so the running max moves
+    # on every merge step
+    for i in range(tabs.shape[0]):
+        for pg in range(4):
+            kp[tabs[i, pg]] *= 10.0 ** pg
+    out, ref = _both(q, kp, vp, tabs, t)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-4)
+
+
+def test_gqa_ratios_and_block_h():
+    """rep in {1, 2, 4} (MHA through 4:1 GQA) and the block_h kv-head
+    tile both reproduce the oracle; an indivisible block_h fails
+    loudly like flash_attention's."""
+    for n_kv, rep in ((4, 1), (2, 2), (1, 4)):
+        q, kp, vp, tabs, t, _ = _setup([4, 11, 19, 26], n_kv=n_kv,
+                                       rep=rep, seed=n_kv)
+        out, ref = _both(q, kp, vp, tabs, t)
+        np.testing.assert_allclose(out, ref, atol=2e-6, rtol=1e-5)
+    q, kp, vp, tabs, t, _ = _setup([4, 11, 19, 26], n_kv=4, rep=2)
+    out, ref = _both(q, kp, vp, tabs, t, block_h=2)
+    np.testing.assert_allclose(out, ref, atol=2e-6, rtol=1e-5)
+    with pytest.raises(ValueError, match="block_h"):
+        paged_decode_attention(q, kp, vp, tabs, t, sm_scale=0.3,
+                               block_h=3, interpret=True)
+
+
+def test_int8_scale_rows_dequant_in_kernel():
+    """int8 pools + per-(page, pos, head) f32 absmax scale rows: the
+    fused in-kernel dequant matches the dequantize-then-attend oracle
+    (both accumulate in f32)."""
+    q, kp, vp, tabs, t, scales = _setup([3, 8, 16, 30], int8=True)
+    out, ref = _both(q, kp, vp, tabs, t, scales=scales)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-4)
+
+
+def test_bf16_pools_and_output_dtype():
+    q, kp, vp, tabs, t, _ = _setup([6, 13, 22, 31], dtype=np.float32)
+    qb = jnp.asarray(q, jnp.bfloat16)
+    kb, vb = jnp.asarray(kp, jnp.bfloat16), jnp.asarray(vp, jnp.bfloat16)
+    sm = 1.0 / np.sqrt(q.shape[-1])
+    out = paged_decode_attention(qb, kb, vb, tabs, t, sm_scale=sm,
+                                 interpret=True)
+    assert out.dtype == jnp.bfloat16
+    ref = _paged_attention_reference(qb, kb, vb, jnp.asarray(tabs), t, sm)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=2e-2, rtol=2e-2)
+
+
+def test_kernel_composes_with_jit():
+    """The serving engine calls the kernel from inside jitted step
+    programs — the pallas_call must trace cleanly under jit with the
+    positions/table as traced operands."""
+    q, kp, vp, tabs, t, _ = _setup([2, 9, 17, 30])
+    sm = 1.0 / np.sqrt(q.shape[-1])
+
+    @jax.jit
+    def step(q, kp, vp, tabs, t):
+        return paged_decode_attention(q, kp, vp, tabs, t, sm_scale=sm,
+                                      interpret=True)
+
+    out = np.asarray(step(q, kp, vp, tabs, t), np.float32)
+    _, ref = _both(q, kp, vp, tabs, t)
+    np.testing.assert_allclose(out, ref, atol=2e-6, rtol=1e-5)
+
+
+def test_resolve_paged_kernel_dispatch_rule():
+    """None = auto (kernel only on TPU — CPU tier-1 must resolve to
+    the gather fallback); explicit booleans always win."""
+    auto = resolve_paged_kernel(None)
+    assert auto == (jax.default_backend() == "tpu")
+    assert resolve_paged_kernel(True) is True
+    assert resolve_paged_kernel(False) is False

@@ -154,14 +154,24 @@ def test_full_stack_lm_generation(stack):
         client.create_inference_job(
             job["id"], max_workers=1,
             budget={"KV_PAGE_SIZE": 8, "KV_PAGES": 1})
+    with pytest.raises(RuntimeError, match="PAGED_KERNEL requires"):
+        client.create_inference_job(job["id"], max_workers=1,
+                                    budget={"PAGED_KERNEL": True})
+    # PAGED_KERNEL rides the same surface (forced gather here — the
+    # auto rule resolves to gather on CPU anyway; the gauge proves the
+    # dispatch is visible end-to-end)
     ijob = client.create_inference_job(
         job["id"], max_workers=1,
-        budget={"KV_PAGE_SIZE": 8, "KV_PAGES": 9})
+        budget={"KV_PAGE_SIZE": 8, "KV_PAGES": 9,
+                "PAGED_KERNEL": "false"})
     paged = client.predict(ijob["predictor_url"], prompts, timeout=180)
     assert paged == preds, (paged, preds)
     health = client.get_inference_job_health(ijob["id"])
-    assert any(s.get("engine_kv_pages_total") == 8
-               for s in (health.get("workers") or {}).values()), health
+    workers = (health.get("workers") or {}).values()
+    assert any(s.get("engine_kv_pages_total") == 8 for s in workers), \
+        health
+    assert any(s.get("engine_paged_kernel_active") == 0
+               for s in workers), health
     client.stop_inference_job(ijob["id"])
 
 
