@@ -971,6 +971,96 @@ def _bench_failover(out_path: str) -> None:
     })
 
 
+def _bench_scaleout(out_path: str) -> None:
+    """1 vs N=3 workers under shared-prefix + mixed stream traffic,
+    then a full membership cycle (autoscale-up, drain-based
+    scale-down, rolling restart) under load — on the deterministic
+    capacity-model harness (``rafiki_tpu.chaos.scaleout``): per-step
+    cost = base + per_req × live, so capacity genuinely scales with
+    engines the way separate accelerators do. The numbers measure the
+    ROUTING/SCALING plane (placement, affinity, zero-loss membership
+    changes), never kernels — provenance says so explicitly."""
+    import jax
+
+    from rafiki_tpu.chaos.scaleout import (ScaleoutHarness,
+                                           shared_prefix_prompts)
+
+    MAX_NEW = 20
+    KW = dict(max_slots=8, max_new=MAX_NEW, base_step_s=0.001,
+              per_req_step_s=0.002, stream_silence_timeout_s=10.0)
+
+    # leg 1: one worker, saturating shared-prefix load
+    h1 = ScaleoutHarness(1, **KW)
+    try:
+        single = h1.run_load(shared_prefix_prompts(6, 3), n_clients=18,
+                             streams_per_client=2, timeout=120.0)
+    finally:
+        h1.stop()
+
+    # leg 2: three workers, shared-prefix families balanced by the
+    # real HRW map (2 per worker) + per-family user-turn mix
+    h3 = ScaleoutHarness(3, **KW)
+    try:
+        fams: dict = {w: [] for w in h3.workers}
+        g = 0
+        while any(len(v) < 2 for v in fams.values()) and g < 500:
+            fam = f"fam{g:03d}-" * 12
+            owner = h3.pred.router.owner(fam[:64])
+            if len(fams[owner]) < 2:
+                fams[owner].append(fam)
+            g += 1
+        prompts3 = [f"{p} user question {j}"
+                    for v in fams.values() for p in v for j in range(3)]
+        scaled = h3.run_load(prompts3, n_clients=18,
+                             streams_per_client=2, timeout=120.0)
+        snap = h3.pred.router.snapshot()
+    finally:
+        h3.stop()
+
+    # leg 3: membership cycle under load — zero dropped/dup tokens
+    hc = ScaleoutHarness(2, **KW)
+    try:
+        events = []
+
+        def cycle():
+            wid = hc.add_worker()
+            events.append("up")
+            time.sleep(0.3)
+            victim = [w for w in hc.workers if w != wid][0]
+            hc.drain_worker(victim)
+            events.append("down")
+            time.sleep(0.2)
+            hc.rolling_restart()
+            events.append("rolling_restart")
+
+        cyc = hc.run_load(shared_prefix_prompts(4, 3), n_clients=8,
+                          streams_per_client=6, timeout=120.0,
+                          on_half_done=cycle)
+    finally:
+        hc.stop()
+
+    _record(out_path, {
+        "stage": "scaleout", "backend": jax.default_backend(),
+        "provenance": "cpu-fallback; simulated decode capacity (stub "
+                      "engine, base+per_req step-time model) — "
+                      "measures the routing/scaling plane, not "
+                      "kernels",
+        "workers": 3, "max_slots": 8, "max_new": MAX_NEW,
+        "single_tokens_per_s": single["tokens_per_s"],
+        "scaled_tokens_per_s": scaled["tokens_per_s"],
+        "throughput_ratio": (scaled["tokens_per_s"]
+                             / max(single["tokens_per_s"], 1e-9)),
+        "single_ttft_p95_s": single["ttft_p95_s"],
+        "scaled_ttft_p95_s": scaled["ttft_p95_s"],
+        "affinity_hit_rate": snap["affinity_hit_rate"],
+        "single_zero_token_loss": single["ok"],
+        "scaled_zero_token_loss": scaled["ok"],
+        "cycle_zero_token_loss": cyc["ok"],
+        "cycle_streams": cyc["streams"],
+        "cycle_failovers": cyc["failovers"],
+        "cycle_events": events})
+
+
 def _bench_admin_recovery(out_path: str) -> None:
     """kill -9 a REAL control-plane process under streaming load,
     restart it against the same workdir, and measure what matters:
@@ -1170,6 +1260,13 @@ def _child(out_path: str, budget: float, use_kv: bool) -> None:
             _record(out_path, {"stage": "failover_error",
                                "error": repr(e)[:300]})
 
+    if budget - (time.monotonic() - t_start) > 45:
+        try:
+            _bench_scaleout(out_path)
+        except Exception as e:  # noqa: BLE001
+            _record(out_path, {"stage": "scaleout_error",
+                               "error": repr(e)[:300]})
+
     if budget - (time.monotonic() - t_start) > 30:
         try:
             _bench_admin_recovery(out_path)
@@ -1348,6 +1445,24 @@ def main() -> None:
             "max_new": fo["max_new"],
             "breaker_trips": fo["breaker_trips"],
             "stream_total_s": round(fo["stream_total_s"], 3)}))
+    so = next((r for r in records if r.get("stage") == "scaleout"),
+              None)
+    if so:
+        print(json.dumps({
+            "metric": "scaleout_throughput_ratio_3x_workers",
+            "value": round(so["throughput_ratio"], 2), "unit": "x",
+            "backend": so["backend"], "provenance": so["provenance"],
+            "workers": so["workers"],
+            "single_tokens_per_s": round(so["single_tokens_per_s"], 1),
+            "scaled_tokens_per_s": round(so["scaled_tokens_per_s"], 1),
+            "single_ttft_p95_s": round(so["single_ttft_p95_s"], 4),
+            "scaled_ttft_p95_s": round(so["scaled_ttft_p95_s"], 4),
+            "affinity_hit_rate": round(so["affinity_hit_rate"], 4),
+            "cycle_zero_token_loss": so["cycle_zero_token_loss"],
+            "cycle_streams": so["cycle_streams"],
+            "cycle_failovers": so["cycle_failovers"],
+            "cycle_events": so["cycle_events"],
+            "max_slots": so["max_slots"], "max_new": so["max_new"]}))
     ar = next((r for r in records
                if r.get("stage") == "admin_recovery"), None)
     if ar:

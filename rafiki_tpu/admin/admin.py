@@ -61,6 +61,10 @@ class Admin:
                     if self.services.fenced:
                         continue
                     self.services.poll()
+                    # inference-pool autoscaler: grow on sustained
+                    # admission stalls, shrink through the drain path
+                    # (self-rate-limited; no-op without armed jobs)
+                    self.services.autoscale_tick()
                     self._finalize_finished_train_jobs()
                 except Exception:  # keep the monitor alive — but a
                     # broken poll loop must be visible, not silent
@@ -302,9 +306,13 @@ class Admin:
         ``ADAPTIVE_GATHER`` (latency/accuracy gather controller),
         ``MAX_NEW_TOKENS`` / ``SYSTEM_PREFIX`` (decode-loop generation
         cap / shared-prefix KV cache), ``SPECULATE_K`` (speculative
-        decoding: prompt-lookup drafting at depth K) and
+        decoding: prompt-lookup drafting at depth K),
         ``DRAFT_TRIAL_ID`` (a completed same-template trial to use as
-        the draft MODEL instead of prompt lookup)."""
+        the draft MODEL instead of prompt lookup), and the autoscaler
+        keys ``AUTOSCALE`` / ``MIN_WORKERS`` / ``MAX_WORKERS`` /
+        ``AUTOSCALE_COOLDOWN_S`` (grow the pool on sustained admission
+        stalls, shrink through the drain path — see
+        docs/operations.md "Scale-out & autoscaling")."""
         job = self.meta.create_inference_job(user_id, train_job_id,
                                              budget=budget)
         self.services.create_inference_services(job["id"],
@@ -358,6 +366,32 @@ class Admin:
                 "RUNNING — nothing to restart")
         return self.services.rolling_restart(job_id,
                                              drain_timeout=drain_timeout)
+
+    def scale_inference_job(self, job_id: str, workers: int,
+                            drain_timeout: float = 120.0
+                            ) -> Dict[str, Any]:
+        """Manually scale a RUNNING inference job's worker pool to an
+        exact replica count: ups spawn from the job's template and join
+        the routing pool once warmed; downs drain newest-first (the
+        predictor fails their streams over with forced prefixes — a
+        shrink never drops a stream)."""
+        job = self.meta.get_inference_job(job_id)
+        if job is None:
+            raise KeyError(f"no inference job {job_id!r}")
+        if job["status"] != "RUNNING":
+            raise ValueError(
+                f"inference job {job_id} is {job['status']}, not "
+                "RUNNING — nothing to scale")
+        return self.services.scale_inference_job(
+            job_id, workers, drain_timeout=drain_timeout)
+
+    def get_inference_job_autoscaler(self, job_id: str
+                                     ) -> Dict[str, Any]:
+        """The job's routing pool + autoscaler state (bounds, tick
+        counters, in-flight warmups/drains)."""
+        if self.meta.get_inference_job(job_id) is None:
+            raise KeyError(f"no inference job {job_id!r}")
+        return self.services.scaleout_status(job_id)
 
     def stop_inference_job(self, job_id: str) -> None:
         # STOPPED first — same respawn-race reasoning as stop_train_job

@@ -62,6 +62,19 @@ class AdminApp:
             "admin_lease_generation",
             "fencing generation of the held admin lease",
             fn=lambda: svcs.lease_generation)
+        # scale-out plane: autoscaler actions (docs/observability.md)
+        self.metrics.gauge(
+            "admin_autoscale_ups",
+            "inference-pool replicas added by autoscale/manual scale",
+            fn=lambda: svcs.scaling["autoscale_ups"])
+        self.metrics.gauge(
+            "admin_autoscale_downs",
+            "inference-pool replicas drained out by autoscale/manual "
+            "scale", fn=lambda: svcs.scaling["autoscale_downs"])
+        self.metrics.gauge(
+            "admin_autoscale_blocked",
+            "autoscale-up decisions skipped for want of a device slot",
+            fn=lambda: svcs.scaling["autoscale_blocked"])
         self.http = JsonHttpService(host, port, registry=self.metrics)
         r = self.http.route
         # /metrics is numeric-only and stays open like /health; the
@@ -96,6 +109,10 @@ class AdminApp:
           self._auth(self._stop_inference_job))
         r("POST", "/inference_jobs/<id>/rolling_restart",
           self._auth(self._rolling_restart))
+        r("POST", "/inference_jobs/<id>/scale",
+          self._auth(self._scale_inference_job))
+        r("GET", "/inference_jobs/<id>/autoscaler",
+          self._auth(self._get_autoscaler))
         r("POST", "/system/backup", self._auth(self._backup))
 
     def start(self) -> Tuple[str, int]:
@@ -172,6 +189,9 @@ class AdminApp:
                      **svc.respawn_stats(),
                      "degraded_jobs": len(degraded),
                      "degraded": degraded,
+                     # autoscaler action counters (per-job detail lives
+                     # at GET /inference_jobs/<id>/autoscaler)
+                     "scaling": svc.scaling.snapshot(),
                      # boot-reconciler outcome + lease state: feeds the
                      # dashboard's recovery banner
                      "recovery": svc.recovery_stats()}
@@ -282,6 +302,25 @@ class AdminApp:
             return 501, {"error": str(e)}
         except OSError as e:
             return 500, {"error": f"backup failed: {e}"}
+
+    def _scale_inference_job(self, m, body, user) -> Tuple[int, Any]:
+        """Manual pool scaling: ``{"workers": N}`` grows from the
+        job's template / drains newest-first down to N with zero
+        dropped streams."""
+        if "workers" not in (body or {}):
+            return 400, {"error": "body must name 'workers' (the "
+                                  "target replica count)"}
+        try:
+            return 200, self.admin.scale_inference_job(
+                m["id"], int(body["workers"]),
+                drain_timeout=float(body.get("drain_timeout", 120.0)))
+        except RuntimeError as e:
+            # no free slot / conflicting operation: a conflict with
+            # current capacity, not a server bug
+            return 409, {"error": str(e)}
+
+    def _get_autoscaler(self, m, _b, user) -> Tuple[int, Any]:
+        return 200, self.admin.get_inference_job_autoscaler(m["id"])
 
     def _rolling_restart(self, m, body, user) -> Tuple[int, Any]:
         """Zero-downtime worker cycling: drain→stop→respawn each of the

@@ -45,6 +45,7 @@ from ..constants import (ServiceStatus, ServiceType, SubTrainJobStatus,
 from ..parallel.mesh import DeviceSpec, SubMesh, SubMeshAllocator, \
     submesh_env_vars
 from ..store.meta_store import MetaStore
+from .autoscaler import AutoscaleConfig, AutoscalePolicy
 from .proc import (AdoptedProcess, identity_matches, proc_start_time,
                    terminate_pid)
 
@@ -187,6 +188,19 @@ class ServicesManager:
             "orphans_reaped": 0, "respawns_queued": 0,
             "kv_adopted": 0, "lease_takeovers": 0,
             "last_recovery_at": 0.0})
+        #: horizontal scale-out state per inference job: routing pool,
+        #: spawn template for extra replicas, autoscale policy (when
+        #: the budget armed one), warming/draining workers in flight.
+        #: Rebuilt lazily from live services + the job budget after an
+        #: admin restart (_ensure_scaleout), so adoption keeps scaling.
+        self._scaleout: Dict[str, Dict[str, Any]] = {}
+        self._last_autoscale_tick = 0.0
+        self._pool_hub_cache: Any = None
+        self._pool_hub_key: Any = None
+        #: autoscaler action counters, surfaced on admin /metrics
+        self.scaling = StatsMap({
+            "autoscale_ups": 0, "autoscale_downs": 0,
+            "autoscale_blocked": 0, "pool_publishes": 0})
 
     def _load_respawn_counts(self) -> Dict[Any, int]:
         """Durable lineage budgets → the (type, job_id)-keyed mirror."""
@@ -866,6 +880,22 @@ class ServicesManager:
                         "plain replicas", primary_model["id"], sig0)
         n_services = 1 if multi_adapter else len(best)
 
+        # autoscale bounds validate at the API surface — a bad bound
+        # (MIN > initial, MAX < MIN, bounds without AUTOSCALE) fails
+        # the create call, not a monitor tick hours later
+        if AutoscaleConfig.from_budget(budget, n_services) is not None \
+                and n_services > 1:
+            # replicas deploy DISTINCT best trials (an ensemble);
+            # autoscaled clones of trial 0 would double-weight it in
+            # the unary gather, and a scale-down could evict another
+            # trial's only replica
+            raise ValueError(
+                "AUTOSCALE requires a single-replica deployment "
+                f"(this create would spawn {n_services} workers, one "
+                "per DISTINCT best trial): create with max_workers=1 "
+                "(or MULTI_ADAPTER) and let the autoscaler grow the "
+                "pool with clones of the best trial")
+
         # A replica MUST own a device slot: quietly pinning it to host CPU
         # would serve at CPU speed — a perf cliff, never a default. Acquire
         # every slot BEFORE taking op_lock: release paths (poll /
@@ -1065,6 +1095,10 @@ class ServicesManager:
             "rafiki_tpu.serving.predictor",
             {"worker_ids": worker_ids, "kv_host": self.kv_host,
              "kv_port": self.kv_port, "host": "127.0.0.1", "port": 0,
+             # live routing-pool membership key: the predictor's
+             # router/breaker tables follow autoscale events published
+             # under the job id without a predictor rebuild
+             "pool_id": inference_job_id,
              # the serving latency/accuracy controller (paper's
              # batching/wait tradeoff): gather deadline tracks the
              # fleet's observed reply latencies instead of always
@@ -1076,6 +1110,11 @@ class ServicesManager:
         self.meta.update_inference_job(
             inference_job_id, status="RUNNING",
             predictor_host=f"{predictor.host}:{predictor.port}")
+        # arm the scale-out state (routing pool + replica template +
+        # autoscale policy when the budget asked for one) and publish
+        # the initial membership for the predictor's router
+        self._ensure_scaleout(inference_job_id)
+        self._publish_pool(inference_job_id)
         return spawned
 
     # ---- lifecycle / failure detection ----
@@ -1389,6 +1428,508 @@ class ServicesManager:
             restarted.append({"old": sid, "new": new.service_id,
                               "drained": bool(drain_sent)})
         return {"job_id": inference_job_id, "restarted": restarted}
+
+    # ---- horizontal scale-out / autoscaler ----
+    #: floor between autoscale evaluations (the monitor ticks faster)
+    AUTOSCALE_TICK_EVERY_S = 1.0
+    #: a scaled-up worker joins the routing pool when its obs sidecar
+    #: reports a port (boot + warmup complete) — or after this long
+    #: regardless (the predictor's breakers gate a worker that still
+    #: is not serving; membership must not hang on a lost port file)
+    WARM_PUBLISH_TIMEOUT_S = 600.0
+
+    def _pool_hub(self):
+        """A cached KVQueueHub against the live data plane (worker
+        stats reads + pool-membership publishes)."""
+        from ..serving.queues import KVQueueHub
+
+        key = (self.kv_host, self.kv_port)
+        if self._pool_hub_cache is None or self._pool_hub_key != key:
+            self._pool_hub_cache = KVQueueHub(self.kv_host, self.kv_port)
+            self._pool_hub_key = key
+        return self._pool_hub_cache
+
+    @staticmethod
+    def _wid_index(wid: str) -> int:
+        """The numeric suffix of ``iw-<job8>-<n>`` worker ids (pool
+        ordering + next-index recovery); -1 when unparseable."""
+        try:
+            return int(wid.rsplit("-", 1)[1])
+        except (IndexError, ValueError):
+            return -1
+
+    def _ensure_scaleout(self, job_id: str) -> Optional[Dict[str, Any]]:
+        """The job's scale-out state, rebuilt from live services + the
+        job budget when missing (an adopted stack keeps scaling after
+        an admin restart). None when the job has no live inference
+        workers to derive a pool/template from."""
+        with self.op_lock:
+            st = self._scaleout.get(job_id)
+            if st is not None:
+                return st
+            workers: List[Any] = []
+            for sid, spec in self._respawn_specs.items():
+                if spec["service_type"] != ServiceType.INFERENCE_WORKER:
+                    continue
+                if spec["meta_kwargs"].get("inference_job_id") != job_id:
+                    continue
+                wid = str(spec["config"].get("worker_id") or "")
+                if wid:
+                    workers.append((self._wid_index(wid), wid, spec))
+            if not workers:
+                return None
+            workers.sort(key=lambda t: (t[0], t[1]))
+            job = self.meta.get_inference_job(job_id)
+            budget = (job or {}).get("budget") or {}
+            policy = None
+            trial_ids = {s["config"].get("trial_id")
+                         for _, _, s in workers}
+            try:
+                cfg_as = AutoscaleConfig.from_budget(budget,
+                                                     len(workers))
+                if cfg_as is not None and len(trial_ids) > 1:
+                    # an ensemble pool (distinct trials) must never be
+                    # auto-scaled: clones would skew the gather and a
+                    # shrink could evict a trial's only replica
+                    raise ValueError(
+                        "pool serves distinct trials (ensemble)")
+                if cfg_as is not None:
+                    policy = AutoscalePolicy(cfg_as)
+            except ValueError as e:
+                # validated at create; a rebuilt pool can disagree with
+                # the budget bounds after manual scaling — run without
+                # the policy rather than refuse to track the pool
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "autoscaler for job %s disabled on rebuild: %s",
+                    job_id, e)
+            st = {"pool": [w for _, w, _ in workers],
+                  "template": dict(workers[0][2]["config"]),
+                  "module": workers[0][2]["module"],
+                  "next_index": max(i for i, _, _ in workers) + 1,
+                  "pool_version": 0.0, "policy": policy,
+                  "warming": [], "victim": None,
+                  "drain_timeout": 120.0}
+            self._scaleout[job_id] = st
+            return st
+
+    def _publish_pool(self, job_id: str) -> None:
+        """Write the job's routing-pool membership to the hub (the
+        predictor's router applies the diff live). Version is a
+        strictly increasing stamp so a late re-delivery can't roll the
+        pool back."""
+        with self.op_lock:
+            st = self._scaleout.get(job_id)
+            if st is None or not self.kv_port:
+                return
+            st["pool_version"] = max(time.time(),
+                                     st["pool_version"] + 1e-4)
+            members = {"workers": list(st["pool"]),
+                       "version": st["pool_version"],
+                       "published_at": time.time()}
+        try:
+            self._pool_hub().put_pool_members(job_id, members)
+            self.scaling.inc("pool_publishes")
+        except Exception:  # noqa: BLE001 — the hub may be mid-restart;
+            # the next scale event (or tick) republishes
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "pool membership publish failed for job %s", job_id,
+                exc_info=True)
+
+    def _worker_sid(self, job_id: str, wid: str) -> Optional[str]:
+        """service id of the job's worker ``wid`` (caller holds
+        op_lock or tolerates a snapshot)."""
+        for sid, spec in self._respawn_specs.items():
+            if spec["service_type"] != ServiceType.INFERENCE_WORKER:
+                continue
+            if spec["meta_kwargs"].get("inference_job_id") != job_id:
+                continue
+            if spec["config"].get("worker_id") == wid:
+                return sid
+        return None
+
+    def _scale_up_one(self, job_id: str,
+                      slot_timeout: float) -> Optional[str]:
+        """Spawn one extra replica from the job's template. The new
+        worker starts WARMING: it joins the routing pool (and the
+        published membership) only once its obs sidecar reports a port
+        — a worker mid-compile must not attract streams. Returns the
+        new worker id, or None when no device slot was free."""
+        with self.op_lock:
+            self._check_fence()
+            if self._scaleout.get(job_id) is None:
+                raise KeyError(f"no scale-out state for job {job_id!r}")
+        # acquire the slot OUTSIDE op_lock: every release path (monitor
+        # poll, stop_service, a draining victim's reap) needs that
+        # lock, so blocking on the allocator while holding it could
+        # never be satisfied by a concurrent release — the same
+        # invariant create_inference_services documents
+        slot = self.allocator.acquire(timeout=slot_timeout)
+        if slot is None:
+            return None
+        with self.op_lock:
+            st = self._scaleout.get(job_id)
+            if st is None:  # job stopped between the locks
+                self.allocator.release(slot)
+                return None
+            idx = st["next_index"]
+            st["next_index"] += 1
+            wid = f"iw-{job_id[:8]}-{idx}"
+            cfg = dict(st["template"])
+            cfg["worker_id"] = wid
+            port_file = self.workdir / f"{wid}.obs_port"
+            cfg["obs_port_file"] = str(port_file)
+            try:
+                port_file.unlink()  # a stale file from a previous life
+            except OSError:         # must not instantly promote
+                pass
+            try:
+                self._spawn(st["module"], cfg,
+                            ServiceType.INFERENCE_WORKER, slot=slot,
+                            inference_job_id=job_id)
+            except Exception:
+                self.allocator.release(slot)
+                raise
+            st["warming"].append({"wid": wid,
+                                  "port_file": str(port_file),
+                                  "since": time.monotonic()})
+            self.scaling.inc("autoscale_ups")
+            return wid
+
+    def _promote_warmed(self, job_id: str,
+                        st: Dict[str, Any]) -> None:
+        """Move warmed-up replicas (obs port reported) into the routing
+        pool and publish the new membership."""
+        changed = False
+        with self.op_lock:
+            for item in list(st["warming"]):
+                ready = Path(item["port_file"]).exists()
+                timed_out = (time.monotonic() - item["since"]
+                             > self.WARM_PUBLISH_TIMEOUT_S)
+                if not ready and not timed_out:
+                    continue
+                st["warming"].remove(item)
+                if item["wid"] not in st["pool"]:
+                    st["pool"].append(item["wid"])
+                changed = True
+        if changed:
+            self._publish_pool(job_id)
+
+    def _begin_scale_down(self, job_id: str, wid: str) -> bool:
+        """Start a drain-based scale-down of ``wid``: membership FIRST
+        (the predictor stops routing there and fails over its streams
+        with forced prefixes), then the graceful-drain request; the
+        victim finishes in-flight work and exits 0 (reaped by the
+        monitor). Crash-healing for the victim is de-registered so a
+        non-zero exit while draining is not respawned."""
+        with self.op_lock:
+            st = self._scaleout.get(job_id)
+            if st is None or st.get("victim"):
+                return False
+            if wid in st["pool"]:
+                st["pool"].remove(wid)
+            sid = self._worker_sid(job_id, wid)
+            spec = self._respawn_specs.pop(sid, None) if sid else None
+            cfg = dict((spec or {}).get("config") or {})
+            if sid is not None and sid in self.services:
+                st["victim"] = {"sid": sid, "wid": wid, "cfg": cfg,
+                                "deadline": time.monotonic()
+                                + st["drain_timeout"]}
+        self._publish_pool(job_id)
+        with self.op_lock:
+            st = self._scaleout.get(job_id)
+            victim = (st or {}).get("victim")
+        if not victim:
+            return False  # worker already gone: the pool just shrank
+        self._request_drain(victim["cfg"])
+        self.scaling.inc("autoscale_downs")
+        return True
+
+    def _victim_tick(self, job_id: str, st: Dict[str, Any]) -> None:
+        """Advance an in-flight scale-down: a cleanly drained victim is
+        reaped by the monitor poll (rc=0 → STOPPED, slot released); one
+        that blows its drain deadline is terminated — a stuck scale-
+        down must converge, not wedge the autoscaler forever."""
+        with self.op_lock:
+            v = st.get("victim")
+            if not v:
+                return
+            if v["sid"] not in self.services:
+                st["victim"] = None  # drained + reaped: done
+                return
+            overdue = time.monotonic() > v["deadline"]
+        if overdue:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "scale-down victim %s did not drain in time; "
+                "terminating", v["wid"])
+            self.stop_service(v["sid"])
+            with self.op_lock:
+                st["victim"] = None
+
+    @staticmethod
+    def _choose_victim(st: Dict[str, Any],
+                       stats: Dict[str, Any]) -> Optional[str]:
+        """Scale-down victim: the member with the fewest live KV pages
+        (least in-flight state to fail over), ties to the most recently
+        added — the pool shrinks newest-first by default."""
+        pool = list(st["pool"])
+        if len(pool) <= 1:
+            return None
+
+        def pages(wid: str) -> float:
+            s = stats.get(wid)
+            if not isinstance(s, dict):
+                return float("inf")
+            v = s.get("engine_kv_pages_used", s.get("kv_pages_used"))
+            return float(v) if isinstance(v, (int, float)) else \
+                float("inf")
+
+        return min(pool, key=lambda w: (pages(w), -pool.index(w)))
+
+    def autoscale_tick(self, force: bool = False) -> List[Dict[str, Any]]:
+        """One autoscaler evaluation (called from the admin monitor
+        loop; self-rate-limited). Grows a job's pool on sustained
+        admission stalls, shrinks it through the drain path when idle;
+        promotes warmed replicas into the routing pool and converges
+        stuck drains. Returns the actions taken (for tests/logs)."""
+        actions: List[Dict[str, Any]] = []
+        if self.fenced or not self.kv_port:
+            return actions
+        now = time.monotonic()
+        if not force and now - self._last_autoscale_tick < \
+                self.AUTOSCALE_TICK_EVERY_S:
+            return actions
+        self._last_autoscale_tick = now
+        with self.op_lock:
+            job_ids = set(self._scaleout)
+            for spec in self._respawn_specs.values():
+                if spec["service_type"] == ServiceType.INFERENCE_WORKER:
+                    jid = spec["meta_kwargs"].get("inference_job_id")
+                    if jid:
+                        job_ids.add(jid)
+        for job_id in sorted(job_ids):
+            job = self.meta.get_inference_job(job_id)
+            if job is None or job.get("status") != "RUNNING":
+                with self.op_lock:
+                    self._scaleout.pop(job_id, None)
+                continue
+            st = self._ensure_scaleout(job_id)
+            if st is None:
+                continue
+            self._promote_warmed(job_id, st)
+            self._victim_tick(job_id, st)
+            with self.op_lock:
+                policy = st.get("policy")
+                busy = bool(st.get("victim") or st.get("warming")
+                            or st.get("manual"))
+                pool = list(st["pool"])
+            if policy is None or busy:
+                # no policy, or a previous action / an operator's
+                # manual scale still converging — decisions wait until
+                # the pool is quiescent (the policy must never fight
+                # an in-flight operation)
+                continue
+            stats: Dict[str, Any] = {}
+            for wid in pool:
+                try:
+                    stats[wid] = self._pool_hub().get_worker_stats(wid)
+                except Exception:  # rafiki: noqa[silent-except] — a
+                    stats[wid] = None  # hub hiccup reads as missing
+            decision = policy.observe(stats)
+            if decision == "up":
+                try:
+                    wid = self._scale_up_one(job_id, slot_timeout=0.0)
+                except Exception as e:  # noqa: BLE001 — a failed spawn
+                    # must not kill the monitor loop
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "autoscale-up spawn for job %s failed: %s",
+                        job_id, e)
+                    wid = None
+                if wid is None:
+                    self.scaling.inc("autoscale_blocked")
+                    actions.append({"job_id": job_id,
+                                    "action": "blocked"})
+                else:
+                    actions.append({"job_id": job_id, "action": "up",
+                                    "worker": wid})
+            elif decision == "down":
+                victim = self._choose_victim(st, stats)
+                if victim and self._begin_scale_down(job_id, victim):
+                    actions.append({"job_id": job_id, "action": "down",
+                                    "worker": victim})
+        return actions
+
+    def scale_inference_job(self, job_id: str, workers: int,
+                            drain_timeout: float = 120.0,
+                            warm_timeout: float = 180.0
+                            ) -> Dict[str, Any]:
+        """Manual scale to an exact replica count (the operator's
+        override; also stamps the autoscaler cooldown so the policy
+        doesn't immediately fight the operator). Ups spawn from the
+        job's template and block until the new workers report their
+        obs port (joined the routing pool); downs drain newest-first,
+        one at a time, and block until each victim exits."""
+        self._check_fence()
+        workers = int(workers)
+        if workers < 1:
+            raise ValueError(f"workers={workers} must be >= 1")
+        st = self._ensure_scaleout(job_id)
+        if st is None:
+            raise KeyError(
+                f"no live inference workers for job {job_id!r}")
+        with self.op_lock:
+            if st.get("manual"):
+                raise RuntimeError(
+                    f"a manual scale of job {job_id} is already in "
+                    "progress — wait for it to finish")
+            if len(self._pool_trial_ids(job_id, st)) > 1:
+                raise RuntimeError(
+                    f"job {job_id}'s replicas serve DISTINCT trials "
+                    "(an ensemble) — scaling would clone one trial "
+                    "and skew/evict the others; redeploy with "
+                    "max_workers=1 (or MULTI_ADAPTER) to scale")
+            # the busy flag + an up-front cooldown stamp keep the
+            # autoscaler's tick out while this (possibly minutes-long,
+            # drain-blocking) operation runs — the policy must not
+            # undo the operator's target mid-flight
+            st["manual"] = True
+            policy = st.get("policy")
+        if policy is not None:
+            policy.note_action()
+        try:
+            return self._scale_to(job_id, st, workers, drain_timeout,
+                                  warm_timeout)
+        finally:
+            with self.op_lock:
+                st["manual"] = False
+            if policy is not None:
+                policy.note_action()  # cooldown runs from COMPLETION
+
+    def _pool_trial_ids(self, job_id: str,
+                        st: Dict[str, Any]) -> set:
+        """Distinct ``trial_id`` values across the pool's worker
+        configs (caller holds op_lock). More than one means the job is
+        a cross-trial ensemble — cloning its template would double-
+        weight one trial in the unary gather and a scale-down could
+        evict another trial's only replica."""
+        out = set()
+        for wid in st["pool"]:
+            sid = self._worker_sid(job_id, wid)
+            spec = self._respawn_specs.get(sid) if sid else None
+            out.add((spec or {}).get("config", {}).get("trial_id"))
+        return out
+
+    def _scale_to(self, job_id: str, st: Dict[str, Any], workers: int,
+                  drain_timeout: float,
+                  warm_timeout: float) -> Dict[str, Any]:
+        result: Dict[str, Any] = {"job_id": job_id, "scaled_up": [],
+                                  "scaled_down": []}
+        with self.op_lock:
+            current = len(st["pool"]) + len(st["warming"])
+        while current < workers:
+            wid = self._scale_up_one(job_id,
+                                     slot_timeout=self.slot_timeout)
+            if wid is None:
+                raise RuntimeError(
+                    f"no free device slot to scale job {job_id} to "
+                    f"{workers} workers ({self.allocator.n_slots} "
+                    f"slots, {self.allocator.free_count()} free)")
+            result["scaled_up"].append(wid)
+            current += 1
+        deadline = time.monotonic() + warm_timeout
+        while time.monotonic() < deadline:
+            self._promote_warmed(job_id, st)
+            with self.op_lock:
+                if not st["warming"]:
+                    break
+            time.sleep(0.05)
+        with self.op_lock:
+            # blown warm deadline: publish anyway — the breakers gate a
+            # worker that still is not serving
+            for item in list(st["warming"]):
+                st["warming"].remove(item)
+                if item["wid"] not in st["pool"]:
+                    st["pool"].append(item["wid"])
+        self._publish_pool(job_id)
+        while True:
+            with self.op_lock:
+                if len(st["pool"]) <= workers:
+                    break
+                victim = st["pool"][-1]
+            self._scale_down_blocking(job_id, victim, drain_timeout)
+            result["scaled_down"].append(victim)
+        with self.op_lock:
+            result["pool"] = list(st["pool"])
+        return result
+
+    def _scale_down_blocking(self, job_id: str, wid: str,
+                             drain_timeout: float) -> None:
+        """Manual-path scale-down: membership first, then drain, then
+        wait for the exit (terminate on a blown deadline) — mirrors
+        rolling_restart's reap-or-terminate contract."""
+        with self.op_lock:
+            st = self._scaleout.get(job_id)
+            if st is None:
+                return
+            if wid in st["pool"]:
+                st["pool"].remove(wid)
+            sid = self._worker_sid(job_id, wid)
+            spec = self._respawn_specs.pop(sid, None) if sid else None
+            svc = self.services.get(sid) if sid else None
+        self._publish_pool(job_id)
+        if svc is None:
+            return
+        drain_sent = self._request_drain(
+            dict((spec or {}).get("config") or {}))
+        try:
+            svc.proc.wait(timeout=drain_timeout if drain_sent
+                          else min(5.0, drain_timeout))
+        except subprocess.TimeoutExpired:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "scale-down victim %s did not drain within %.0fs; "
+                "terminating", wid, drain_timeout)
+            svc.proc.terminate()
+            try:
+                svc.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                svc.proc.kill()
+                svc.proc.wait()
+        with self.op_lock:
+            if sid in self.services:  # the monitor may have reaped the
+                # clean rc=0 exit already
+                self.meta.update_service(sid,
+                                         status=ServiceStatus.STOPPED)
+                if svc.slot is not None:
+                    self.allocator.release(svc.slot)
+                    svc.slot = None
+                del self.services[sid]
+        self.scaling.inc("autoscale_downs")
+
+    def scaleout_status(self, job_id: str) -> Dict[str, Any]:
+        """Pool + autoscaler state for the admin API/dashboard."""
+        with self.op_lock:
+            st = self._scaleout.get(job_id)
+            if st is None:
+                return {"enabled": False, "pool": [], "warming": [],
+                        "victim": None}
+            policy = st.get("policy")
+            out = {"enabled": policy is not None,
+                   "pool": list(st["pool"]),
+                   "warming": [w["wid"] for w in st["warming"]],
+                   "victim": (st.get("victim") or {}).get("wid"),
+                   "drain_timeout_s": st["drain_timeout"]}
+        if policy is not None:
+            out.update(policy.status())
+        return out
 
     def pending_respawn_job_ids(self) -> set:
         """Jobs that currently have a queued (slot-starved) worker

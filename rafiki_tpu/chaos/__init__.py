@@ -232,6 +232,12 @@ class ChaosHub(QueueHub):
     def get_worker_stats(self, worker_id: str):
         return self.inner.get_worker_stats(worker_id)
 
+    def put_pool_members(self, pool_id: str, members) -> None:
+        self.inner.put_pool_members(pool_id, members)
+
+    def get_pool_members(self, pool_id: str):
+        return self.inner.get_pool_members(pool_id)
+
 
 __all__ = ["CHAOS_ENV", "ChaosConfig", "ChaosHub", "ChaosInjector",
            "arm_admin_kill"]

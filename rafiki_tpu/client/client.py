@@ -209,6 +209,32 @@ class Client:
             {"drain_timeout": drain_timeout}, headers=headers,
             timeout=sock)
 
+    def scale_inference_job(self, job_id: str, workers: int,
+                            drain_timeout: float = 120.0
+                            ) -> Dict[str, Any]:
+        """Manually scale the job's worker pool to exactly ``workers``
+        replicas: ups spawn from the job's template and join the
+        routing pool once warmed, downs drain newest-first (streams
+        fail over with forced prefixes — never dropped). Synchronous:
+        the socket timeout is sized to the drain/warm budget like
+        :meth:`rolling_restart_inference_job`."""
+        headers = {}
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
+        sock = max(self.timeout, drain_timeout * 2 + 240.0)
+        return json_request(
+            "POST",
+            f"{self.admin_url}/inference_jobs/{job_id}/scale",
+            {"workers": int(workers), "drain_timeout": drain_timeout},
+            headers=headers, timeout=sock)
+
+    def get_inference_job_autoscaler(self, job_id: str
+                                     ) -> Dict[str, Any]:
+        """The job's routing pool + autoscaler state (bounds, pending
+        warmups/drains, cooldown)."""
+        return self._call("GET",
+                          f"/inference_jobs/{job_id}/autoscaler")
+
     def backup(self, path: str) -> Dict[str, Any]:
         """Snapshot the admin's MetaStore to ``path`` ON THE ADMIN
         HOST (SQLite online backup — consistent under live traffic).

@@ -1,7 +1,8 @@
-"""Online serving: queues, predictor endpoint, ensembling."""
+"""Online serving: queues, predictor endpoint, ensembling, routing."""
 
 from .queues import (InProcQueueHub, KVQueueHub, QueueHub, pack_message,
                      unpack_message)
+from .router import Router
 
-__all__ = ["QueueHub", "InProcQueueHub", "KVQueueHub", "pack_message",
-           "unpack_message"]
+__all__ = ["QueueHub", "InProcQueueHub", "KVQueueHub", "Router",
+           "pack_message", "unpack_message"]

@@ -61,7 +61,12 @@ class _Breaker:
 
 
 class BreakerBoard:
-    """Circuit breakers for a fixed fleet of worker ids.
+    """Circuit breakers for a (dynamic) fleet of worker ids.
+
+    Membership follows the pool: :meth:`add_worker` admits a scale-up
+    replica (CLOSED), :meth:`remove_worker` drops a departed one —
+    outcome feeds for non-members are no-ops so an in-flight gather
+    finishing after a scale-down cannot resurrect the id.
 
     ``fail_threshold`` consecutive misses trip a breaker open;
     ``cooldown_s`` later one probe is admitted (half-open), and each
@@ -88,11 +93,27 @@ class BreakerBoard:
                                   "breaker_probes": 0,
                                   "breaker_stale_trips": 0})
 
-    def _get(self, wid: str) -> _Breaker:
-        b = self._b.get(wid)
-        if b is None:  # unknown ids (late-added workers) start closed
-            b = self._b[wid] = _Breaker()
-        return b
+    def _get(self, wid: str) -> Optional[_Breaker]:
+        """The worker's breaker, or None for a non-member. Unknown ids
+        are NOT lazily created: after :meth:`remove_worker` a straggling
+        outcome feed (an in-flight gather finishing) must not resurrect
+        state for a worker the pool no longer contains — ``targets()``
+        iterates this dict, so a resurrected entry would be scattered
+        to forever."""
+        return self._b.get(wid)
+
+    # ---- dynamic membership (pool scale-out) ----
+    def add_worker(self, wid: str) -> None:
+        """Admit a new pool member; it starts CLOSED."""
+        with self._lock:
+            if wid not in self._b:
+                self._b[wid] = _Breaker()
+
+    def remove_worker(self, wid: str) -> None:
+        """Drop a departed member's breaker state entirely (scale-down,
+        not an outage: no trip is recorded)."""
+        with self._lock:
+            self._b.pop(wid, None)
 
     # ---- scatter-time gating ----
     def _due(self, b: _Breaker, now: float) -> bool:
@@ -126,11 +147,12 @@ class BreakerBoard:
         return out
 
     def allow(self, wid: str) -> bool:
-        """Single-worker variant of :meth:`targets` (stream routing)."""
+        """Single-worker variant of :meth:`targets` (stream routing).
+        Non-members are never admittable."""
         now = self._now()
         with self._lock:
             b = self._get(wid)
-            if b.draining:
+            if b is None or b.draining:
                 return False
             if b.state == CLOSED:
                 return True
@@ -150,6 +172,8 @@ class BreakerBoard:
         explicitly via :meth:`set_draining`."""
         with self._lock:
             b = self._get(wid)
+            if b is None:
+                return  # removed mid-gather: nothing to close
             if b.state != CLOSED:
                 self.counters.inc("breaker_recoveries")
             b.state = CLOSED
@@ -163,6 +187,8 @@ class BreakerBoard:
         now = self._now()
         with self._lock:
             b = self._get(wid)
+            if b is None:
+                return  # removed mid-gather: a miss on a non-member
             if b.state == HALF_OPEN:
                 b.cooldown_s = min(self.max_cooldown_s,
                                    max(self.cooldown_s,
@@ -186,7 +212,7 @@ class BreakerBoard:
         now = self._now()
         with self._lock:
             b = self._get(wid)
-            if b.state == CLOSED:
+            if b is not None and b.state == CLOSED:
                 b.state = OPEN
                 b.opened_at = now
                 b.cooldown_s = b.cooldown_s or self.cooldown_s
@@ -195,7 +221,9 @@ class BreakerBoard:
 
     def set_draining(self, wid: str, draining: bool) -> None:
         with self._lock:
-            self._get(wid).draining = bool(draining)
+            b = self._get(wid)
+            if b is not None:
+                b.draining = bool(draining)
 
     def any_draining(self) -> bool:
         """O(n) under the lock — the scatter path's cheap guard for

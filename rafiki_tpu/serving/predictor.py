@@ -23,9 +23,10 @@ import numpy as np
 from ..obs import (MetricsRegistry, TraceBuffer, mint_trace_id,
                    mount_obs_routes, sanitize_trace_id)
 from ..utils.http import STREAM_BUDGET_S, JsonHttpService, StreamResponse
-from .breaker import CLOSED, OPEN, BreakerBoard
+from .breaker import OPEN, BreakerBoard
 from .queues import (EXPIRY_SKEW_TOLERANCE_S, QueueHub, pack_message,
                      unpack_message)
+from .router import Router
 
 
 def nearest_rank(sorted_vals: Sequence[float], p: float) -> float:
@@ -97,7 +98,10 @@ class Predictor:
                  breaker_fail_threshold: int = 3,
                  breaker_cooldown_s: float = 2.0,
                  stream_silence_timeout_s: float = 30.0,
-                 max_stream_failovers: int = 2) -> None:
+                 max_stream_failovers: int = 2,
+                 pool_id: str = "",
+                 affinity_prefix_chars: int = Router.DEFAULT_PREFIX_CHARS
+                 ) -> None:
         """``adaptive_gather`` enables the serving latency/accuracy
         controller (the reference paper's batching/wait tradeoff,
         SURVEY.md §3.3 note): instead of always waiting
@@ -120,6 +124,18 @@ class Predictor:
         self.breakers = BreakerBoard(
             self.worker_ids, fail_threshold=breaker_fail_threshold,
             cooldown_s=breaker_cooldown_s)
+        #: single-worker stream placement: prefix-affinity (HRW) with
+        #: load-aware fallback over the live pool, breaker-gated —
+        #: replaces the old round-robin cursor even for one worker
+        self.router = Router(self.worker_ids, self.breakers,
+                             prefix_chars=affinity_prefix_chars)
+        #: hub key this job's pool membership is published under (the
+        #: inference job id); empty = static membership (direct
+        #: add_worker/remove_worker calls only)
+        self.pool_id = str(pool_id or "")
+        self._pool_version = 0.0
+        self._last_pool_refresh = 0.0
+        self._last_load_refresh = 0.0
         #: mid-stream reply-silence watchdog: no delta/final from the
         #: stream's worker for this long triggers failover to a healthy
         #: replica (NOT the whole-stream timeout — a dead worker must
@@ -171,6 +187,17 @@ class Predictor:
         self._c_resumable = self.metrics.counter(
             "stream_resumable_errors",
             "streams ended with a resumable error event")
+        # scale-out plane: router decision counters + live pool gauges
+        self.metrics.register_stats(self.router.counters)
+        self.metrics.gauge(
+            "router_pool_size",
+            "workers in this job's routing pool (live membership)",
+            fn=lambda: len(self.router))
+        self.metrics.gauge(
+            "router_affinity_hit_rate",
+            "fraction of keyed placements that landed on their HRW "
+            "owner (prefix-cache hit proxy)",
+            fn=self.router.affinity_hit_rate)
         self._latencies: "collections.deque[float]" = collections.deque(
             maxlen=self.LATENCY_WINDOW)
         #: per-worker publish watermarks for staleness detection:
@@ -178,7 +205,6 @@ class Predictor:
         #: Monotonic on BOTH sides — wall-clock steps can't grey out a
         #: healthy fleet (the published_at failure mode)
         self._worker_seen: Dict[str, Tuple[float, float]] = {}
-        self._rr = 0  # round-robin cursor for single-worker streams
         #: consecutive zero-answer adaptive gathers — drives the
         #: escalating recovery below (a single penalty sample per miss
         #: needs ~0.05·WINDOW misses to move the p95 past a window of
@@ -219,6 +245,102 @@ class Predictor:
             if s is not None:
                 self._annotate_staleness(wid, s)
 
+    #: floor between hub membership reads on the request path — a
+    #: scale event lands within this; per-request reads would tax every
+    #: request for a change that happens a few times an hour
+    POOL_REFRESH_EVERY_S = 2.0
+    #: floor between load-signal refreshes feeding the router (worker
+    #: stats + queue depths); workers republish at a similar cadence
+    LOAD_REFRESH_EVERY_S = 1.0
+
+    # ---- dynamic pool membership (scale-out) ----
+    def add_worker(self, wid: str) -> None:
+        """Admit a new pool member live: breaker (CLOSED) first so the
+        id is scatter-eligible the instant the router can pick it, then
+        the router table (HRW claims only the keys it now owns)."""
+        with self._lock:
+            if wid in self.worker_ids:
+                return
+            self.worker_ids.append(wid)
+        self.breakers.add_worker(wid)
+        self.router.add_worker(wid)
+
+    def remove_worker(self, wid: str) -> None:
+        """Remove a departed member: breaker state goes first (unary
+        scatter stops immediately, and a straggling gather outcome
+        can't resurrect the id), then the router table (streams stop
+        placing there; HRW remaps only this worker's keys), then the
+        staleness watermark. An in-flight stream on the removed worker
+        notices on its next loop tick and fails over with its
+        delivered text as the forced prefix — removal is never a
+        dropped stream."""
+        self.breakers.remove_worker(wid)
+        self.router.remove_worker(wid)
+        with self._lock:
+            if wid in self.worker_ids:
+                self.worker_ids.remove(wid)
+            self._worker_seen.pop(wid, None)
+
+    def _refresh_membership(self, force: bool = False) -> None:
+        """Apply the control plane's published pool membership (see
+        ``QueueHub.put_pool_members``). Rate-limited; ``force`` on the
+        about-to-fail paths. Only newer versions apply, and an empty
+        worker list is ignored — a publisher bug must not unroute the
+        whole fleet."""
+        if not self.pool_id:
+            return
+        now = time.monotonic()
+        if not force and now - self._last_pool_refresh < \
+                self.POOL_REFRESH_EVERY_S:
+            return
+        self._last_pool_refresh = now
+        try:
+            pool = self.hub.get_pool_members(self.pool_id)
+        except Exception:  # rafiki: noqa[silent-except] — a hub hiccup
+            return         # just delays the membership diff
+        if not isinstance(pool, dict):
+            return
+        workers = [str(w) for w in (pool.get("workers") or []) if w]
+        if not workers:
+            return
+        try:
+            version = float(pool.get("version") or 0.0)
+        except (TypeError, ValueError):
+            version = 0.0
+        if version and version <= self._pool_version:
+            return  # already applied (or an out-of-order straggler)
+        self._pool_version = max(self._pool_version, version)
+        with self._lock:
+            have = list(self.worker_ids)
+        for wid in workers:
+            if wid not in have:
+                self.add_worker(wid)
+        want = set(workers)
+        for wid in have:
+            if wid not in want:
+                self.remove_worker(wid)
+
+    def _refresh_load_signals(self) -> None:
+        """Feed the router's load view (and the staleness/drain
+        breaker signals — one read serves both) from the hub's
+        published worker stats + queue depths. Rate-limited."""
+        now = time.monotonic()
+        if now - self._last_load_refresh < self.LOAD_REFRESH_EVERY_S:
+            return
+        self._last_load_refresh = now
+        with self._lock:
+            members = list(self.worker_ids)
+        for wid in members:
+            try:
+                s = self.hub.get_worker_stats(wid)
+                depth = self.hub.query_depth(wid)
+            except Exception:  # rafiki: noqa[silent-except] — load
+                continue       # signals are advisory; stale beats dead
+            if s is not None:
+                self._annotate_staleness(wid, s)
+                self.router.observe(wid, s)
+            self.router.observe_queue_depth(wid, depth)
+
     def _gather_deadline_s(self) -> float:
         """The adaptive controller's current gather budget."""
         if not self.adaptive_gather:
@@ -257,6 +379,9 @@ class Predictor:
         self.traces.start(tid, request_id=qid, span="received",
                           n_queries=len(queries),
                           timeout_s=round(float(timeout), 4))
+        # live membership first: a scaled pool must be scattered to
+        # (and a removed worker not) without a predictor rebuild
+        self._refresh_membership()
         # breaker gating: open workers are skipped at scatter time —
         # their share of the gather quorum shrinks accordingly. All
         # open: fast-fail with a structured 503 + retry_after_s instead
@@ -268,6 +393,7 @@ class Predictor:
             self._refresh_excluded_workers()
         targets = self.breakers.targets()
         if not targets:
+            self._refresh_membership(force=True)
             self._refresh_excluded_workers(force=True)
             targets = self.breakers.targets()
         if not targets:
@@ -430,34 +556,28 @@ class Predictor:
                 max(1.0, self.breakers.retry_after_s()), 3)
         return ensemble_predictions(per_worker), info
 
-    def _pick_stream_worker(self, exclude=()) -> Optional[str]:
-        """Round-robin over CLOSED (healthy, non-draining) workers,
-        minus workers this stream already failed on. With no closed
-        candidate, at most ONE due open breaker is probed — unlike the
-        unary path's ``targets()``, a stream sends traffic to a single
-        worker, so flipping every due breaker to half-open would record
-        probes nobody scatters to. None when no candidate exists (the
+    def _pick_stream_worker(self, queries: Optional[Sequence[Any]] = None,
+                            exclude=()) -> Optional[str]:
+        """Route one stream through the affinity/load router:
+        prefix-affinity (HRW over the live pool) with load-aware
+        fallback, minus workers this stream already failed on. The
+        open/draining gating — including the at-most-ONE half-open
+        probe when no closed candidate exists — lives in
+        :meth:`Router.select` now. None when no candidate exists (the
         resumable-error path)."""
+        self._refresh_membership()
         if self.breakers.any_draining():
             self._refresh_excluded_workers()  # rate-limited
-        snap = self.breakers.snapshot()
-        closed = [w for w in self.worker_ids
-                  if w not in exclude
-                  and snap.get(w, {}).get("state") == CLOSED
-                  and not snap.get(w, {}).get("draining")]
-        if closed:
-            with self._lock:
-                rr = self._rr
-                self._rr += 1
-            return closed[rr % len(closed)]
-        for attempt in (0, 1):
-            for w in self.worker_ids:
-                if w not in exclude and self.breakers.allow(w):
-                    return w  # this stream IS the half-open probe
-            if attempt == 0:
-                # drained workers re-admit themselves via fresh stats
-                self._refresh_excluded_workers(force=True)
-        return None
+        self._refresh_load_signals()
+        key = self.router.affinity_key(queries)
+        wid = self.router.select(key, exclude=exclude)
+        if wid is None:
+            # drained workers re-admit themselves via fresh stats, and
+            # a scale event may have landed since the last poll
+            self._refresh_membership(force=True)
+            self._refresh_excluded_workers(force=True)
+            wid = self.router.select(key, exclude=exclude)
+        return wid
 
     def _resumable_final(self, acc: Dict[int, str], n_queries: int,
                          error: str, qid: str, tid: str) -> Dict:
@@ -487,9 +607,12 @@ class Predictor:
         ``{"done": True, "predictions": [...], "info"}`` or ``{"done":
         True, "error": ...}``. Every stream ends with a done event,
         including on hub failures mid-stream. Unlike :meth:`predict`,
-        the request goes to ONE worker (round-robin): an ensemble over
-        replicas has no meaningful token stream — mid-generation the
-        replicas disagree, and averaging text deltas is nonsense. The
+        the request goes to ONE worker, placed by the affinity/load
+        :class:`Router` (shared prefixes colocate on the worker holding
+        their KV snapshot, ties break to the least-loaded replica): an
+        ensemble over replicas has no meaningful token stream —
+        mid-generation the replicas disagree, and averaging text deltas
+        is nonsense. The
         reference has no streaming path at all (SURVEY.md §3.3 is
         strictly request/response); this is the continuous-batching
         engine's ``poll_partial`` surfaced end to end.
@@ -538,7 +661,7 @@ class Predictor:
                         acc, len(queries),
                         "stream failover limit reached", qid, tid)
                     break
-                wid = self._pick_stream_worker(tried)
+                wid = self._pick_stream_worker(queries, tried)
                 if wid is None:
                     final = self._resumable_final(
                         acc, len(queries),
@@ -597,6 +720,13 @@ class Predictor:
                         # already declared this worker dead — don't
                         # wait out our own silence window
                         failover_reason = "breaker open"
+                        break
+                    if wid not in self.router:
+                        # the pool scaled this worker out mid-stream
+                        # (remove_worker / membership diff): fail over
+                        # now with the delivered text as the forced
+                        # prefix instead of riding a departing worker
+                        failover_reason = "worker removed"
                         break
                     # bounded pop: wake at least once per second so a
                     # breaker trip is noticed promptly even while the
@@ -708,6 +838,7 @@ class Predictor:
     def stats(self) -> Dict[str, Any]:
         """Counters + latency percentiles over the recent-request window
         (the BASELINE p50 metric; surfaced in ``GET /health``)."""
+        self._refresh_membership()
         with self._lock:
             lat = sorted(self._latencies)
         n_req = int(self._c_requests.value)
@@ -718,13 +849,16 @@ class Predictor:
             return nearest_rank(lat, p)
 
         workers: Dict[str, Any] = {}
-        for wid in self.worker_ids:
+        for wid in list(self.worker_ids):  # snapshot: membership may
+            # change under a concurrent scale event
             try:
                 s = self.hub.get_worker_stats(wid)
             except Exception:  # rafiki: noqa[silent-except] —
                 s = None       # health must not 500 on a hub hiccup
             if s is not None:
                 workers[wid] = self._annotate_staleness(wid, s)
+                self.router.observe(wid, s)  # /health readers keep the
+                #                              load view fresh too
         return {"queries_served": n_q, "requests_served": n_req,
                 "latency_sum_s": lat_sum, "latency_window_n": len(lat),
                 "latency_p50_s": pct(0.50), "latency_p95_s": pct(0.95),
@@ -743,6 +877,10 @@ class Predictor:
                 # per-worker circuit-breaker state + fault counters
                 # (trips/recoveries ride /metrics too)
                 "breakers": self.breakers.snapshot(),
+                # routing pool: membership, decision counters, affinity
+                # hit rate, per-worker load view (docs/operations.md
+                # "Scale-out & autoscaling")
+                "router": self.router.snapshot(),
                 "stream_failovers": int(self._c_failover.value),
                 "requests_fast_failed": int(self._c_fast_fail.value),
                 # per-worker published counters (drop accounting, decode-
@@ -967,7 +1105,14 @@ def main(argv: Optional[list] = None) -> int:
                               cfg.get("stream_silence_timeout_s",
                                       30.0)),
                           max_stream_failovers=int(
-                              cfg.get("max_stream_failovers", 2)))
+                              cfg.get("max_stream_failovers", 2)),
+                          # live pool membership key (the inference job
+                          # id): the router follows autoscale events
+                          # published by the control plane
+                          pool_id=str(cfg.get("pool_id", "")),
+                          affinity_prefix_chars=int(
+                              cfg.get("affinity_prefix_chars",
+                                      Router.DEFAULT_PREFIX_CHARS)))
     svc = PredictorService(predictor, cfg.get("host", "127.0.0.1"),
                            int(cfg.get("port", 0)))
     host, port = svc.start()

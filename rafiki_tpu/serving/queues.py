@@ -84,6 +84,20 @@ class QueueHub:
     def get_worker_stats(self, worker_id: str) -> Optional[Dict[str, Any]]:
         raise NotImplementedError
 
+    def put_pool_members(self, pool_id: str,
+                         members: Dict[str, Any]) -> None:
+        """The control plane publishes a job's live worker-id set here
+        (``{"workers": [...], "version": ...}``) whenever the pool
+        changes — autoscale up/down, manual scale. The predictor polls
+        it (rate-limited) and applies the diff to its breaker board +
+        router table, so membership follows the worker set without a
+        predictor rebuild. Deliberately durable (no TTL): membership is
+        configuration, not liveness — health stays the breakers' job."""
+        raise NotImplementedError
+
+    def get_pool_members(self, pool_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
 
 class _KeyQueue:
     """One deque + its OWN condvar. A shared hub-wide condition would
@@ -114,6 +128,7 @@ class InProcQueueHub(QueueHub):
         self._meta = threading.Lock()  # guards the key → queue dict
         self._ops = 0
         self._stats: Dict[str, Dict[str, Any]] = {}  # worker counters
+        self._pools: Dict[str, Dict[str, Any]] = {}  # pool memberships
         #: armed reply-queue TTLs (key → monotonic deadline): unlike the
         #: idle sweep, an armed TTL fires even while late pushes keep
         #: refreshing last_used (an abandoned STREAM's worker keeps
@@ -213,6 +228,14 @@ class InProcQueueHub(QueueHub):
         with self._meta:
             return self._stats.get(worker_id)
 
+    def put_pool_members(self, pool_id: str, members) -> None:
+        with self._meta:
+            self._pools[pool_id] = dict(members)
+
+    def get_pool_members(self, pool_id: str):
+        with self._meta:
+            return self._pools.get(pool_id)
+
 
 class KVQueueHub(QueueHub):
     """Queues on the native kv server. Blocking pops hold a socket, so each
@@ -283,3 +306,13 @@ class KVQueueHub(QueueHub):
         # kv_server.cc) — one EXPIRE at scatter covers the whole
         # query lifetime including post-discard stragglers
         self._client().expire(f"q:preds:{query_id}", ttl_s)
+
+    def put_pool_members(self, pool_id: str, members) -> None:
+        # no TTL: membership is durable configuration written by the
+        # (lease-fenced, single-writer) admin — a stale-looking list is
+        # still the last truth; worker HEALTH stays the breakers' job
+        self._client().set(f"pool:{pool_id}", pack_message(dict(members)))
+
+    def get_pool_members(self, pool_id: str):
+        raw = self._client().get(f"pool:{pool_id}")
+        return None if raw is None else unpack_message(raw)

@@ -478,6 +478,10 @@ class InferenceWorker:
             stats["ttft_p95_s"] = self._h_ttft.quantile(0.95)
             stats["e2e_p50_s"] = self._h_e2e.quantile(0.50)
             stats["e2e_p95_s"] = self._h_e2e.quantile(0.95)
+            # queue-wait p95: the router's cleanest "this worker is
+            # behind" signal (TTFT includes prefill length, queue wait
+            # is pure backlog)
+            stats["queue_p95_s"] = self._h_queue.quantile(0.95)
         try:
             self.hub.put_worker_stats(self.worker_id, stats)
         except Exception:  # rafiki: noqa[silent-except] —
