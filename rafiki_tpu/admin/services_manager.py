@@ -949,6 +949,53 @@ class ServicesManager:
         spawned: List[ManagedService] = []
         worker_ids: List[str] = []
         services = [best[0]] if multi_adapter else best
+        # SLO / overload budget keys, validated HERE at the create API
+        # (a typo'd class or negative cap fails the call, not a
+        # crash-looping worker). SLO_DEFAULT classes unlabeled
+        # requests on the predictor AND every worker; SLO_P95_TARGET_S
+        # (> 0, seconds of interactive TTFT p95) arms the predictor's
+        # brownout ladder; SLO_SHED_BATCH_DEPTH /
+        # SLO_SHED_BACKGROUND_DEPTH (>= 0) cap best-effort backlog;
+        # SLO_BACKGROUND_MAX_NEW (>= 1) is the ladder's stage-2 clamp
+        # and therefore requires the ladder to be armed.
+        from ..serving.slo import normalize_slo
+        slo_default = ""
+        if "SLO_DEFAULT" in budget:
+            try:
+                slo_default = normalize_slo(budget["SLO_DEFAULT"])
+            except ValueError as e:
+                raise ValueError(f"SLO_DEFAULT: {e}") from e
+        slo_shed_depths: Dict[str, int] = {}
+        for key, cls in (("SLO_SHED_BATCH_DEPTH", "batch"),
+                         ("SLO_SHED_BACKGROUND_DEPTH", "background")):
+            if key in budget:
+                d = int(budget[key])
+                if d < 0:
+                    raise ValueError(f"{key}={d} must be >= 0 "
+                                     "(fleet queue-backlog cap)")
+                slo_shed_depths[cls] = d
+        brownout_target = 0.0
+        if budget.get("SLO_P95_TARGET_S"):
+            brownout_target = float(budget["SLO_P95_TARGET_S"])
+            if brownout_target <= 0:
+                raise ValueError(
+                    f"SLO_P95_TARGET_S={budget['SLO_P95_TARGET_S']} "
+                    "must be > 0 (target interactive TTFT p95, "
+                    "seconds)")
+        bg_clamp = 0
+        if "SLO_BACKGROUND_MAX_NEW" in budget:
+            # membership, not truthiness: 0 must FAIL the create call
+            # (the documented >= 1 contract), not silently fall back
+            # to the predictor's default clamp
+            bg_clamp = int(budget["SLO_BACKGROUND_MAX_NEW"])
+            if bg_clamp < 1:
+                raise ValueError(
+                    f"SLO_BACKGROUND_MAX_NEW={bg_clamp} must be >= 1")
+            if not brownout_target:
+                raise ValueError(
+                    "SLO_BACKGROUND_MAX_NEW requires SLO_P95_TARGET_S "
+                    "in the same budget (the brownout ladder applies "
+                    "the clamp at stage 2)")
         for i, trial in enumerate(services):
             sub = self.meta.get_sub_train_job(trial["sub_train_job_id"])
             model = self.meta.get_model(sub["model_id"])
@@ -977,6 +1024,8 @@ class ServicesManager:
                                                     4))}
             if budget.get("MAX_NEW_TOKENS"):
                 cfg["max_new_tokens"] = int(budget["MAX_NEW_TOKENS"])
+            if slo_default:
+                cfg["default_slo"] = slo_default
             if budget.get("SYSTEM_PREFIX"):
                 cfg["system_prefix"] = str(budget["SYSTEM_PREFIX"])
             if budget.get("KV_PAGE_SIZE"):
@@ -1091,19 +1140,28 @@ class ServicesManager:
             spawned.append(svc)
             worker_ids.append(wid)
 
+        pred_cfg: Dict[str, Any] = {
+            "worker_ids": worker_ids, "kv_host": self.kv_host,
+            "kv_port": self.kv_port, "host": "127.0.0.1", "port": 0,
+            # live routing-pool membership key: the predictor's
+            # router/breaker tables follow autoscale events published
+            # under the job id without a predictor rebuild
+            "pool_id": inference_job_id,
+            # the serving latency/accuracy controller (paper's
+            # batching/wait tradeoff): gather deadline tracks the
+            # fleet's observed reply latencies instead of always
+            # waiting full timeout for stragglers
+            "adaptive_gather": bool(budget.get("ADAPTIVE_GATHER"))}
+        if slo_default:
+            pred_cfg["default_slo"] = slo_default
+        if slo_shed_depths:
+            pred_cfg["slo_shed_depths"] = slo_shed_depths
+        if brownout_target:
+            pred_cfg["brownout_target_p95_s"] = brownout_target
+        if bg_clamp:
+            pred_cfg["brownout_clamp_max_new"] = bg_clamp
         predictor = self._spawn(
-            "rafiki_tpu.serving.predictor",
-            {"worker_ids": worker_ids, "kv_host": self.kv_host,
-             "kv_port": self.kv_port, "host": "127.0.0.1", "port": 0,
-             # live routing-pool membership key: the predictor's
-             # router/breaker tables follow autoscale events published
-             # under the job id without a predictor rebuild
-             "pool_id": inference_job_id,
-             # the serving latency/accuracy controller (paper's
-             # batching/wait tradeoff): gather deadline tracks the
-             # fleet's observed reply latencies instead of always
-             # waiting full timeout for stragglers
-             "adaptive_gather": bool(budget.get("ADAPTIVE_GATHER"))},
+            "rafiki_tpu.serving.predictor", pred_cfg,
             ServiceType.PREDICTOR, wait_port_file=True,
             inference_job_id=inference_job_id)
         spawned.append(predictor)

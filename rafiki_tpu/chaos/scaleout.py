@@ -138,9 +138,15 @@ class StubDecodeEngine:
                        len(self._live) * self.PAGES_PER_SLOT)
         self.stats.max_set("max_concurrent", len(self._live))
 
-    def step(self) -> int:
+    def _admit_pending(self) -> None:
+        """Move queued requests into free slots (subclass hook: the
+        SLO stub engine replaces the plain FIFO with the shared
+        class-queue + preemption policy)."""
         while self._pending and len(self._live) < self.max_slots:
             self._admit(self._pending.popleft())
+
+    def step(self) -> int:
+        self._admit_pending()
         n = len(self._live)
         if n == 0:
             self._gauge_pages()
@@ -218,7 +224,20 @@ class StubLM:
 
 class ScaleoutHarness:
     """N real worker serve-loops over stub engines + one predictor with
-    the affinity router, driven through membership events."""
+    the affinity router, driven through membership events.
+
+    Subclass hooks (the SLO overload harness rides them): ``MODEL_CLASS``
+    picks the stub model every booted worker serves;
+    ``_predictor_kwargs``/``_worker_kwargs`` extend the predictor /
+    worker constructions."""
+
+    MODEL_CLASS = StubLM
+
+    def _predictor_kwargs(self) -> Dict[str, Any]:
+        return {}
+
+    def _worker_kwargs(self) -> Dict[str, Any]:
+        return {}
 
     def __init__(self, n_workers: int, max_slots: int = 8,
                  max_new: int = 16, base_step_s: float = 0.002,
@@ -245,7 +264,8 @@ class ScaleoutHarness:
         self.pred = Predictor(
             self.hub, list(self.workers), gather_timeout=30.0,
             stream_silence_timeout_s=stream_silence_timeout_s,
-            breaker_fail_threshold=3, pool_id=pool_id)
+            breaker_fail_threshold=3, pool_id=pool_id,
+            **self._predictor_kwargs())
         # drill-speed refresh cadences (instance overrides of the
         # rate-limit floors; production keeps the class defaults)
         self.pred.POOL_REFRESH_EVERY_S = pool_refresh_every_s
@@ -254,10 +274,12 @@ class ScaleoutHarness:
 
     # ---- membership events ----
     def _boot(self, wid: str) -> None:
-        w = InferenceWorker(StubLM, "stub", self.knobs, self.store,
-                            self.hub, wid, decode_loop=True,
+        w = InferenceWorker(self.MODEL_CLASS, "stub", self.knobs,
+                            self.store, self.hub, wid,
+                            decode_loop=True,
                             max_slots=self.max_slots,
-                            max_new_tokens=self.max_new)
+                            max_new_tokens=self.max_new,
+                            **self._worker_kwargs())
         th = threading.Thread(target=w.run, kwargs={"poll_timeout": 0.02},
                               daemon=True)
         th.start()

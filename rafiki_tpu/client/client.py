@@ -247,6 +247,7 @@ class Client:
                 timeout: Optional[float] = None,
                 sampling: Optional[Dict[str, Any]] = None,
                 trace_id: Optional[str] = None,
+                slo: Optional[str] = None,
                 retry_on_503: bool = True) -> List[Any]:
         """``sampling`` (generation jobs): {temperature, top_k, top_p,
         seed, eos_id, max_new, adapter_id} forwarded to the decode
@@ -255,16 +256,29 @@ class Client:
         ``X-Rafiki-Trace-Id`` so this request's timeline can be pulled
         from the predictor's and workers' ``/debug/requests``.
 
-        A structured 503 (every worker breaker open, or the fleet
-        mid-rolling-restart) is retried ONCE after honoring the
-        server's ``retry_after_s`` (capped at ``MAX_RETRY_AFTER_S``) —
-        the server told us exactly when trying again can help. Disable
-        with ``retry_on_503=False``."""
+        ``slo`` (``interactive``/``batch``/``background``): the
+        request's admission class; omit for the job's default.
+        Best-effort classes admit after interactive, may be preempted
+        (resuming token-exact), and may be SHED under overload.
+
+        Two distinct structured 503s, both retried ONCE after
+        honoring the server's ``retry_after_s`` (capped at
+        ``MAX_RETRY_AFTER_S``): a *shed* 503
+        (``HttpStatusError.shed`` — overload backpressure on a
+        best-effort class; retrying after the hint is expected to
+        work) and a breaker *fast-fail* 503 (fleet down/draining;
+        retrying probes the outage). When the retry also fails the
+        typed :class:`~rafiki_tpu.utils.http.HttpStatusError`
+        surfaces with ``.shed``/``.retry_after_s`` so callers can
+        schedule their own backoff. Disable with
+        ``retry_on_503=False``."""
         body: Dict[str, Any] = {"queries": _jsonable(queries)}
         if timeout is not None:
             body["timeout"] = timeout
         if sampling:
             body["sampling"] = sampling
+        if slo is not None:
+            body["slo"] = slo
         # the socket must outlive the server-side gather deadline, or a
         # slow-but-working predictor (first-request compile) looks dead
         sock_timeout = self.timeout if timeout is None else \
@@ -275,12 +289,14 @@ class Client:
             out = json_request("POST", url, body, headers=headers,
                                timeout=sock_timeout)
         except HttpStatusError as e:
-            retry_after = e.payload.get("retry_after_s")
+            # shed 503s and breaker fast-fail 503s both carry the
+            # structured retry hint; e.shed tells them apart when the
+            # retry below also fails and the error reaches the caller
+            retry_after = e.retry_after_s
             if not (retry_on_503 and e.status == 503
-                    and isinstance(retry_after, (int, float))):
+                    and retry_after is not None):
                 raise
-            time.sleep(min(max(0.0, float(retry_after)),
-                           MAX_RETRY_AFTER_S))
+            time.sleep(min(max(0.0, retry_after), MAX_RETRY_AFTER_S))
             out = json_request("POST", url, body, headers=headers,
                                timeout=sock_timeout)
         return out["predictions"]
@@ -290,7 +306,8 @@ class Client:
                        sampling: Optional[Dict[str, Any]] = None,
                        trace_id: Optional[str] = None,
                        resume: Optional[Sequence[Optional[str]]] = None,
-                       auto_resume: int = 1):
+                       auto_resume: int = 1,
+                       slo: Optional[str] = None):
         """Streaming generation: yields the predictor's SSE events —
         ``{"delta": {qi: text}}`` per new-token batch (append to query
         qi's output), rarely ``{"replace": {qi: text}}`` (authoritative
@@ -309,7 +326,14 @@ class Client:
         event is a typed :class:`StreamInterrupted` instead of a bare
         error string, so callers can resume on their own schedule.
         ``resume`` seeds the first request (continuing an earlier
-        interrupted stream)."""
+        interrupted stream).
+
+        ``slo``: admission class (omit for the job default). A shed /
+        fast-fail 503 at stream open is retried ONCE after honoring
+        ``retry_after_s``; a second refusal raises the typed
+        :class:`~rafiki_tpu.utils.http.HttpStatusError` whose
+        ``.shed`` distinguishes overload backpressure from a dead
+        fleet."""
         from ..utils.http import STREAM_BUDGET_S, sse_request
 
         # a request queued behind busy decode slots can legitimately
@@ -322,45 +346,63 @@ class Client:
         server_budget = STREAM_BUDGET_S if timeout is None else timeout
         partial = list(resume) if resume else None
         resumes_left = max(0, int(auto_resume))
+        retry_503_left = 1
         while True:
             body: Dict[str, Any] = {"queries": _jsonable(queries)}
             if timeout is not None:
                 body["timeout"] = timeout
             if sampling:
                 body["sampling"] = sampling
+            if slo is not None:
+                body["slo"] = slo
             if partial and any(p for p in partial):
                 body["resume"] = [p if isinstance(p, str) else None
                                   for p in partial]
             resumed_here = False
-            for ev in sse_request(
-                    "POST",
-                    f"{predictor_url.rstrip('/')}/predict_stream",
-                    body, headers=_trace_headers(trace_id),
-                    timeout=self.timeout,
-                    read_timeout=max(self.timeout,
-                                     server_budget + 30.0)):
-                if not (isinstance(ev, dict) and ev.get("done")
-                        and ev.get("resumable")):
-                    yield ev
-                    continue
-                partial = list(ev.get("partial") or [])
-                if resumes_left > 0:
-                    # resume even with NO delivered text: an empty
-                    # resume is just a fresh request after
-                    # retry_after_s — the stream twin of predict()'s
-                    # structured-503 retry
-                    resumes_left -= 1
-                    resumed_here = True
-                    time.sleep(min(
-                        max(0.0, float(ev.get("retry_after_s") or 0)),
-                        MAX_RETRY_AFTER_S))
-                    break  # re-request with the partial as resume
-                yield StreamInterrupted(
-                    error=str(ev.get("error") or ""),
-                    partial=partial, qid=str(ev.get("qid") or ""),
-                    trace_id=str(ev.get("trace_id") or ""),
-                    retry_after_s=float(ev.get("retry_after_s") or 0),
-                    raw=ev)
+            try:
+                for ev in sse_request(
+                        "POST",
+                        f"{predictor_url.rstrip('/')}/predict_stream",
+                        body, headers=_trace_headers(trace_id),
+                        timeout=self.timeout,
+                        read_timeout=max(self.timeout,
+                                         server_budget + 30.0)):
+                    if not (isinstance(ev, dict) and ev.get("done")
+                            and ev.get("resumable")):
+                        yield ev
+                        continue
+                    partial = list(ev.get("partial") or [])
+                    if resumes_left > 0:
+                        # resume even with NO delivered text: an empty
+                        # resume is just a fresh request after
+                        # retry_after_s — the stream twin of predict()'s
+                        # structured-503 retry
+                        resumes_left -= 1
+                        resumed_here = True
+                        time.sleep(min(
+                            max(0.0,
+                                float(ev.get("retry_after_s") or 0)),
+                            MAX_RETRY_AFTER_S))
+                        break  # re-request with the partial as resume
+                    yield StreamInterrupted(
+                        error=str(ev.get("error") or ""),
+                        partial=partial, qid=str(ev.get("qid") or ""),
+                        trace_id=str(ev.get("trace_id") or ""),
+                        retry_after_s=float(ev.get("retry_after_s")
+                                            or 0),
+                        raw=ev)
+            except HttpStatusError as e:
+                # the stream never opened: a shed 503 (overload
+                # backpressure — e.shed) or a breaker fast-fail 503.
+                # One honored retry, like predict(); the second
+                # refusal raises the typed error for the caller.
+                if not (e.status == 503 and retry_503_left > 0
+                        and e.retry_after_s is not None):
+                    raise
+                retry_503_left -= 1
+                time.sleep(min(max(0.0, e.retry_after_s),
+                               MAX_RETRY_AFTER_S))
+                continue
             if not resumed_here:
                 return
 

@@ -59,6 +59,8 @@ import numpy as np
 
 from ..obs.metrics import StatsMap
 from ..ops.paged_attention import resolve_paged_kernel
+from .slo import (DEFAULT_SLO, ClassQueue, evictable_occupants,
+                  normalize_slo, preemption_victim)
 
 # Speculation break-even (tokens per verify call) and how many scan
 # calls to wait before re-probing a gated-off speculator. ~1.5 means a
@@ -91,10 +93,23 @@ class _Slot:
     seed: int = 0               # with (position) → the sample's PRNG key
     eos_id: Optional[int] = None  # emitting this token ends the request
     adapter_id: int = 0         # multi-adapter engines: which fine-tune
+    slo: str = DEFAULT_SLO      # admission class (interactive first)
+    seq: int = 0                # arrival order; preemption evicts the
+    #                             YOUNGEST lowest-class victim
     n_consumed: int = 0         # tokens fed to the model so far
     generated: List[int] = field(default_factory=list)
+    #: tokens generated BEFORE a preemption (re-ingested as prompt on
+    #: resume, but still part of this request's OUTPUT): poll/
+    #: poll_partial present prior + generated, so a preempted request
+    #: resumes token-exact with nothing duplicated or lost
+    prior: List[int] = field(default_factory=list)
     n_streamed: int = 0         # generated tokens already poll_partial'd
     first_tokened: bool = False  # first_token span already emitted
+    #: admitted via the aging promotion (served ahead of waiting
+    #: higher-priority work): immune to preemption — evicting it on
+    #: the next interactive arrival would starve exactly the way
+    #: aging exists to prevent
+    shielded: bool = False
 
 
 class DecodeEngine:
@@ -148,7 +163,11 @@ class DecodeEngine:
         #: tile, and pays 1/C as many dispatches for prompt ingestion.
         self.C = max(1, min(int(prefill_chunk), self.L))
         self._slots: List[Optional[_Slot]] = [None] * self.B
-        self._queue: List[_Slot] = []
+        #: class-aware admission queue (interactive > batch >
+        #: background, FIFO within class, aging so background never
+        #: starves). Caller-locked: every touch happens under _lock.
+        self._cq = ClassQueue()
+        self._seq = 0  # arrival stamp: preemption evicts youngest
         self._done: List[Tuple[Any, List[int]]] = []
         self._lock = threading.Lock()
         # host mirrors of the per-slot device inputs; prompts ride to the
@@ -293,6 +312,14 @@ class DecodeEngine:
             "kv_pages_used": 0, "kv_pages_high_water": 0,
             "kv_pages_total": (self.n_pages - 1 if self.paged else 0),
             "admission_stalls": 0,
+            # SLO plane: mid-flight evictions of lower-class work so
+            # an interactive request could admit (the victim resumes
+            # token-exact from its re-queued prefix), aging promotions
+            # (background served ahead of waiting interactive so it
+            # never starves), and live per-class queue depths
+            "preemptions": 0, "slo_aged_promotions": 0,
+            "queued_interactive": 0, "queued_batch": 0,
+            "queued_background": 0,
             # 1 while the Pallas block-table decode kernel serves this
             # engine's single-token steps (0 = page gather / contiguous)
             "paged_kernel_active": int(self.paged_kernel_active)})
@@ -310,7 +337,7 @@ class DecodeEngine:
                max_new: int, temperature: float = 0.0, top_k: int = 0,
                top_p: float = 1.0, seed: int = 0,
                eos_id: Optional[int] = None,
-               adapter_id: int = 0) -> None:
+               adapter_id: int = 0, slo: str = "") -> None:
         """Queue a request. ``prompt_ids``: 1-D valid tokens (≥1); the
         prompt + generation must fit the cache (truncated to fit).
 
@@ -332,11 +359,19 @@ class DecodeEngine:
         fine-tune this request decodes under. Out-of-range ids raise
         ``ValueError`` — silently serving a DIFFERENT fine-tune would
         be a correct-looking wrong answer (each adapter is a different
-        trial/tenant). Ignored on single-adapter engines."""
+        trial/tenant). Ignored on single-adapter engines.
+
+        ``slo`` (``interactive`` / ``batch`` / ``background``, default
+        interactive): admission class. Interactive admits first (FIFO
+        within a class, aging so nothing starves) and may PREEMPT
+        lower-class occupants when the pool/slots are full — the
+        victim's pages free and it resumes token-exact later from its
+        re-queued prefix. Unknown classes raise ``ValueError``."""
         prompt = np.asarray(prompt_ids, np.int32).ravel()
         max_new = max(1, min(int(max_new), self.L - 1))
         prompt = prompt[:max(1, self.L - max_new)]
         aid = self._check_adapter_id(adapter_id)
+        cls = normalize_slo(slo)
         if self.paged:
             # a request whose worst case exceeds the whole pool could
             # NEVER admit — it would stall the FIFO queue forever.
@@ -349,12 +384,13 @@ class DecodeEngine:
                     f"pool has {self.n_pages - 1} usable pages; raise "
                     "kv_pages or lower max_new/prompt length")
         with self._lock:
-            self._queue.append(_Slot(
+            self._seq += 1
+            self._cq.push(cls, _Slot(
                 request_id, prompt, max_new,
                 temperature=float(temperature), top_k=int(top_k),
                 top_p=float(top_p), seed=int(seed),
                 eos_id=None if eos_id is None else int(eos_id),
-                adapter_id=aid))
+                adapter_id=aid, slo=cls, seq=self._seq))
 
     def _check_adapter_id(self, adapter_id: int) -> int:
         """Validate a request's adapter selection. Out-of-range ids
@@ -401,10 +437,14 @@ class DecodeEngine:
             self.stats.max_set("kv_pages_high_water", used)
             self.stats.set("kv_pages_total", self.n_pages - 1)
 
-    def _release_slot_pages(self, i: int) -> None:
+    def _release_slot_pages(self, i: int, have_lock: bool = False
+                            ) -> None:
         """Return slot ``i``'s pages + reservation to the pool (request
-        completed): the table row points back at the scratch page, so
-        the freed lane keeps stepping harmlessly."""
+        completed or preempted): the table row points back at the
+        scratch page, so the freed lane keeps stepping harmlessly.
+        ``have_lock``: the SLO-preemption path calls this from inside
+        the admission loop, which already holds ``_lock`` (the lock is
+        not reentrant)."""
         n = int(self._n_alloc[i])
         if n:
             self._free_pages.extend(
@@ -412,11 +452,15 @@ class DecodeEngine:
             self._ptab[i, :n] = 0
             self._n_alloc[i] = 0
             self._ptab_dirty = True
-        with self._lock:
-            # reservation counters share the admission loop's lock
-            # discipline (admission reads/writes them under _lock)
+        if have_lock:
             self._res_total -= int(self._n_res[i])
             self._n_res[i] = 0
+        else:
+            with self._lock:
+                # reservation counters share the admission loop's lock
+                # discipline (admission reads/writes them under _lock)
+                self._res_total -= int(self._n_res[i])
+                self._n_res[i] = 0
         self.stats.set("kv_pages_used",
                        self.n_pages - 1 - len(self._free_pages))
         self.stats.set("kv_pages_total", self.n_pages - 1)
@@ -466,9 +510,16 @@ class DecodeEngine:
         ``step`` itself); finished requests surface via ``poll``."""
         out: List[Tuple[Any, List[int]]] = []
         for slot in self._slots:
-            if slot is not None and len(slot.generated) > slot.n_streamed:
-                out.append((slot.request_id, list(slot.generated)))
-                slot.n_streamed = len(slot.generated)
+            if slot is None:
+                continue
+            total = len(slot.prior) + len(slot.generated)
+            if total > slot.n_streamed:
+                # prior + generated: a preempt-resumed request streams
+                # its full output, never re-emitting the re-ingested
+                # prefix (n_streamed carried across the preemption)
+                out.append((slot.request_id,
+                            slot.prior + list(slot.generated)))
+                slot.n_streamed = total
         return out
 
     def register_prefix(self, prefix_ids: np.ndarray,
@@ -563,8 +614,8 @@ class DecodeEngine:
     @property
     def busy(self) -> bool:
         with self._lock:
-            return bool(self._queue) or any(s is not None
-                                            for s in self._slots)
+            return bool(self._cq) or any(s is not None
+                                         for s in self._slots)
 
     def reset_stats(self) -> None:
         """Zero the served-traffic counters without losing capacity
@@ -605,7 +656,7 @@ class DecodeEngine:
         buffer, so the old cache must not be touched again."""
         with self._lock:
             self._slots = [None] * self.B
-            self._queue.clear()
+            self._cq.clear()
             self._done.clear()
             # host mirrors under the same lock: a submit() racing this
             # reset must observe either the old world or the cleared
@@ -699,12 +750,118 @@ class DecodeEngine:
                     self._slots[i].n_consumed += int(adv[i])
                     self._tok[i] = self._prompt_buf[i, int(self._pos[i])]
 
+    # ---- SLO preemption (lock held: admission-loop context) ----
+    def _occupants(self) -> List[Tuple[int, str, int, bool]]:
+        """Live slots as the ``(handle, slo, seq, shielded)`` tuples
+        the shared eviction policy (`serving/slo.py`) consumes."""
+        return [(j, s.slo, s.seq, s.shielded)
+                for j, s in enumerate(self._slots) if s is not None]
+
+    def _victim_for(self, cls: str) -> Optional[int]:
+        """The slot to evict so a ``cls`` head can admit — the shared
+        :func:`preemption_victim` policy (youngest lowest-class,
+        shielded immune) over the live slots."""
+        return preemption_victim(cls, self._occupants())
+
+    def _evictable_for(self, cls: str) -> List[int]:
+        """Every slot :meth:`_victim_for` could ever return for a
+        ``cls`` head — the feasibility pre-check sums their
+        reservations BEFORE committing any eviction (a preemption
+        that cannot end in the head admitting would destroy the
+        victims' progress for nothing; pre-SLO behavior just stalled
+        in place with the lower-class work still running). Same
+        predicate as victim selection BY CONSTRUCTION (both call
+        :func:`evictable_occupants`), which is what guarantees the
+        paged reclaim loop in :meth:`step` terminates in admission."""
+        return [j for j, _s, _q in
+                evictable_occupants(cls, self._occupants())]
+
+    def _preempt_slot(self, j: int, by: str
+                      ) -> Tuple[Any, int, int, str, str]:
+        """Evict slot ``j`` mid-generation so a higher-class admission
+        fits. Cheap under paged KV: the victim's pages + reservation
+        return to the pool NOW; the victim becomes a front-of-class
+        re-queued request whose prompt is its original prompt PLUS
+        everything generated so far (the PR 7 forced-prefix shape), so
+        on re-admission it re-ingests that prefix through chunked
+        prefill and continues at the SAME absolute positions —
+        token-exact in every decode mode (greedy argmax depends only
+        on history; sampled draws are pure functions of (seed,
+        position); speculation is greedy-lossless; int8-KV and
+        multi-adapter ride the same cache math). The vacated KV rows
+        are the standard unreachable-then-rewritten slot-reuse case.
+        Returns the ``preempted`` span record."""
+        slot = self._slots[j]
+        gen = list(slot.generated)
+        prompt = (np.concatenate([slot.prompt,
+                                  np.asarray(gen, np.int32)])
+                  if gen else slot.prompt)
+        resumed = _Slot(slot.request_id, prompt,
+                        slot.max_new - len(gen),
+                        temperature=slot.temperature, top_k=slot.top_k,
+                        top_p=slot.top_p, seed=slot.seed,
+                        eos_id=slot.eos_id,
+                        adapter_id=slot.adapter_id, slo=slot.slo,
+                        seq=slot.seq, prior=slot.prior + gen)
+        resumed.n_streamed = slot.n_streamed
+        resumed.first_tokened = slot.first_tokened
+        resumed.shielded = slot.shielded
+        self._slots[j] = None
+        self._tok[j] = 0
+        self._pos[j] = 0  # fresh occupant restarts at position 0
+        self._prompt_len[j] = 1
+        self._stop_pos[j] = 0
+        if self.paged:
+            self._release_slot_pages(j, have_lock=True)
+        self._cq.push(resumed.slo, resumed, front=True)
+        self.stats.inc("preemptions")
+        return (slot.request_id, j, len(gen), slot.slo, by)
+
+    def _seat_slot(self, i: int, slot: _Slot,
+                   prefix_hits: Dict[int, Tuple[Dict[str, Any],
+                                                List[int]]]) -> None:
+        """Install a popped request into free slot ``i``: host mirrors,
+        shared-prefix fast-forward, first lazy pages. Lock held."""
+        self._slots[i] = slot
+        self._tok[i] = slot.prompt[0]
+        self._pos[i] = 0
+        self._prompt_buf[i, :] = 0
+        self._prompt_buf[i, :len(slot.prompt)] = slot.prompt
+        self._prompt_len[i] = len(slot.prompt)
+        pre = self._prefixes.get(slot.adapter_id)
+        if (pre is not None and len(slot.prompt) > pre["len"]
+                and np.array_equal(slot.prompt[:pre["len"]],
+                                   pre["ids"])):
+            # shared-prefix hit: skip its prefill — the KV copy makes
+            # positions 0..plen-1 as if prefilled, and the prompt walk
+            # resumes at plen
+            prefix_hits.setdefault(
+                slot.adapter_id, (pre, []))[1].append(i)
+            self._pos[i] = pre["len"]
+            slot.n_consumed = pre["len"]
+            self._tok[i] = slot.prompt[pre["len"]]
+        # finish once pos reaches plen - 1 + max_new (the step at
+        # input position p emits a GENERATED token iff p >= plen - 1)
+        self._stop_pos[i] = min(
+            len(slot.prompt) - 1 + slot.max_new, self.L)
+        self._temp[i] = slot.temperature
+        self._topk[i] = slot.top_k
+        self._topp[i] = slot.top_p
+        self._seed[i] = np.int32(slot.seed & 0x7FFFFFFF)
+        self._aid[i] = slot.adapter_id
+        if self.paged:
+            # map the pages the slot starts on: position 0, or the
+            # whole prefix span for a hit (install scatters into them
+            # before the next call)
+            self._ensure_pages_to(i, int(self._pos[i]))
+
     # ---- the loop body ----
     def step(self) -> int:
         """Admit queued requests into free slots, run K fused compiled
         steps for every live slot, harvest completions. Returns live
         count (at admission time)."""
-        admitted_info: List[Tuple[Any, int, int]] = []
+        admitted_info: List[Tuple[Any, int, int, str]] = []
+        preempted_info: List[Tuple[Any, int, int, str, str]] = []
         with self._lock:
             admitted = False
             # rows grouped by adapter id with the SNAPSHOT each matched
@@ -712,69 +869,84 @@ class DecodeEngine:
             # documented as not concurrent with step, so within one
             # admission an adapter maps to exactly one snapshot)
             prefix_hits: Dict[int, Tuple[Dict[str, Any], List[int]]] = {}
-            for i in range(self.B):
-                if self._slots[i] is None and self._queue:
-                    if self.paged:
-                        # admission is bounded by the PAGE POOL, not
-                        # the slot count: the head request admits only
-                        # if its worst case (prompt + max_new + spec
-                        # margin — its ACTUAL size, never max_len)
-                        # still fits the outstanding reservations.
-                        # FIFO: a too-big head WAITS (backpressure)
-                        # rather than letting smaller latecomers
-                        # starve it; completions free reservations.
-                        head = self._queue[0]
-                        n_res = self._pages_for(
-                            min(len(head.prompt) - 1 + head.max_new,
-                                self.L))
-                        if self._res_total + n_res > self.n_pages - 1:
-                            self.stats.inc("admission_stalls")
-                            break
-                        self._n_res[i] = n_res
-                        self._res_total += n_res
-                    slot = self._queue.pop(0)
-                    self._slots[i] = slot
-                    self._tok[i] = slot.prompt[0]
-                    self._pos[i] = 0
-                    self._prompt_buf[i, :] = 0
-                    self._prompt_buf[i, :len(slot.prompt)] = slot.prompt
-                    self._prompt_len[i] = len(slot.prompt)
-                    pre = self._prefixes.get(slot.adapter_id)
-                    if (pre is not None and len(slot.prompt) > pre["len"]
-                            and np.array_equal(slot.prompt[:pre["len"]],
-                                               pre["ids"])):
-                        # shared-prefix hit: skip its prefill — the KV
-                        # copy below makes positions 0..plen-1 as if
-                        # prefilled, and the prompt walk resumes at plen
-                        prefix_hits.setdefault(
-                            slot.adapter_id, (pre, []))[1].append(i)
-                        self._pos[i] = pre["len"]
-                        slot.n_consumed = pre["len"]
-                        self._tok[i] = slot.prompt[pre["len"]]
-                    # finish once pos reaches plen - 1 + max_new (the
-                    # step at input position p emits a GENERATED token
-                    # iff p >= plen - 1)
-                    self._stop_pos[i] = min(
-                        len(slot.prompt) - 1 + slot.max_new, self.L)
-                    self._temp[i] = slot.temperature
-                    self._topk[i] = slot.top_k
-                    self._topp[i] = slot.top_p
-                    self._seed[i] = np.int32(slot.seed & 0x7FFFFFFF)
-                    self._aid[i] = slot.adapter_id
-                    if self.paged:
-                        # map the pages the slot starts on: position 0,
-                        # or the whole prefix span for a hit (install
-                        # scatters into them before the next call)
-                        self._ensure_pages_to(i, int(self._pos[i]))
-                    admitted = True
-                    admitted_info.append((slot.request_id, i,
-                                          len(slot.prompt)))
+            while True:
+                nxt = self._cq.peek()
+                if nxt is None:
+                    break
+                cls, head = nxt
+                i = next((j for j in range(self.B)
+                          if self._slots[j] is None), None)
+                # feasibility BEFORE any eviction: admission is
+                # bounded by slots AND (paged) the page pool — the
+                # head admits only if its worst case (prompt +
+                # max_new + spec margin — its ACTUAL size, never
+                # max_len) fits what is free plus what eviction could
+                # reclaim from strictly-lower-class, non-shielded
+                # occupants. If even that is insufficient, STALL
+                # WITHOUT evicting: destroying a victim's progress
+                # while the head still cannot admit would be pure
+                # loss (backpressure keeps FIFO fairness — smaller
+                # latecomers never starve the head; completions free
+                # reservations).
+                victims = self._evictable_for(cls)
+                if i is None and not victims:
+                    break
+                n_res = 0
+                if self.paged:
+                    n_res = self._pages_for(
+                        min(len(head.prompt) - 1 + head.max_new,
+                            self.L))
+                    avail = self.n_pages - 1 - self._res_total
+                    reclaim = sum(int(self._n_res[j]) for j in victims)
+                    if avail + reclaim < n_res:
+                        self.stats.inc("admission_stalls")
+                        break
+                if i is None:
+                    # every slot occupied: evict the youngest
+                    # lowest-class occupant (pages return NOW — cheap
+                    # under paged KV; the victim resumes token-exact
+                    # later from its re-queued prefix)
+                    i = self._victim_for(cls)
+                    preempted_info.append(self._preempt_slot(i, cls))
+                if self.paged:
+                    while self._res_total + n_res > self.n_pages - 1:
+                        # guaranteed to terminate in admission by the
+                        # feasibility check above
+                        j = self._victim_for(cls)
+                        preempted_info.append(
+                            self._preempt_slot(j, cls))
+                    self._n_res[i] = n_res
+                    self._res_total += n_res
+                # pop() == the peeked head: nothing ran between (a
+                # preemption only pushes into strictly LOWER classes,
+                # whose skip counters are unchanged)
+                _, slot = self._cq.pop()
+                if self._cq.last_pop_promoted:
+                    slot.shielded = True  # aging fired: this slot may
+                    #                       not be preempted in turn
+                self._seat_slot(i, slot, prefix_hits)
+                admitted = True
+                admitted_info.append((slot.request_id, i,
+                                      len(slot.prompt), slot.slo,
+                                      bool(slot.prior)))
+            depths = self._cq.depths()
+            self.stats.set("slo_aged_promotions", self._cq.promotions)
             live = [i for i in range(self.B) if self._slots[i] is not None]
             self.stats.max_set("max_concurrent", len(live))
+        for c, d in depths.items():
+            self.stats.set(f"queued_{c}", d)
         # span emission OUTSIDE the engine lock: the sink may take its
         # own locks (trace buffer, histograms) and must not nest ours
-        for rid, row, plen in admitted_info:
-            self._span("admitted", rid, slot=row, prompt_tokens=plen)
+        for rid, row, n_gen, vslo, by in preempted_info:
+            self._span("preempted", rid, slot=row, tokens=n_gen,
+                       slo=vslo, by=by)
+        for rid, row, plen, cls, resumed in admitted_info:
+            # `resumed` marks a preempt-resume RE-admission: observers
+            # must not treat it as a fresh queue-wait sample (the gap
+            # since submit includes the victim's pre-preemption
+            # service time, not backlog)
+            self._span("admitted", rid, slot=row, prompt_tokens=plen,
+                       slo=cls, resumed=resumed)
         if not live:
             return 0
         for pre, rows in prefix_hits.values():
@@ -785,7 +957,7 @@ class DecodeEngine:
             self._install_prefix(rows, pre)
         if admitted and self._prefill_fn is not None:
             self._chunked_prefill()
-            for rid, row, plen in admitted_info:
+            for rid, row, plen, cls, resumed in admitted_info:
                 self._span("prefill", rid, prompt_tokens=plen)
         if admitted or self._prompt_dev is None:
             # refresh the device-resident prompts only when they changed
@@ -867,7 +1039,10 @@ class DecodeEngine:
             self._pos[i] = pos0 + n_real
             if (eos_hit or len(slot.generated) >= slot.max_new
                     or int(self._pos[i]) >= self.L):
-                finished.append((slot.request_id, slot.generated))
+                # prior + generated: a preempt-resumed request replies
+                # with its FULL output (the re-ingested prefix counts)
+                finished.append((slot.request_id,
+                                 slot.prior + slot.generated))
                 self._slots[i] = None
                 self._tok[i] = 0
                 self._pos[i] = 0  # fresh occupant restarts at position 0
@@ -896,7 +1071,10 @@ class DecodeEngine:
         integer math when no sink is wired."""
         if self.span_sink is None:
             return
-        if n0 == 0:
+        if not slot.first_tokened:
+            # flag, not n0 == 0: a preempt-resumed slot restarts its
+            # generated list at 0 but its stream already first-tokened
+            slot.first_tokened = True
             self._span("first_token", slot.request_id)
         if n0 // SPAN_DECODE_MARK_EVERY != n1 // SPAN_DECODE_MARK_EVERY:
             self._span("decode_mark", slot.request_id, tokens=n1)
@@ -1065,7 +1243,8 @@ class DecodeEngine:
             self.stats.inc("spec_accepted", take - 1)
             if (eos_hit or len(slot.generated) >= slot.max_new
                     or int(self._pos[i]) >= self.L):
-                finished.append((slot.request_id, slot.generated))
+                finished.append((slot.request_id,
+                                 slot.prior + slot.generated))
                 self._slots[i] = None
                 self._tok[i] = 0
                 self._pos[i] = 0
@@ -1307,6 +1486,10 @@ class TextDecodeEngine:
     #: kwarg must get a structured rejection, not a TypeError that
     #: kills the serve thread)
     supports_resume = True
+    #: ditto for the ``slo`` admission-class kwarg: the worker only
+    #: forwards it to engines that declare the capability (a duck-typed
+    #: user engine must degrade to classless FIFO, not TypeError)
+    supports_slo = True
 
     def __init__(self, engine: DecodeEngine,
                  encode: Callable[[str], np.ndarray],
@@ -1336,7 +1519,7 @@ class TextDecodeEngine:
                max_new: Optional[int] = None, temperature: float = 0.0,
                top_k: int = 0, top_p: float = 1.0, seed: int = 0,
                eos_id: Optional[int] = None, adapter_id: int = 0,
-               forced_prefix: str = "") -> None:
+               forced_prefix: str = "", slo: str = "") -> None:
         """``forced_prefix`` (streaming failover / client resume): text
         a previous worker already emitted for this request. It is
         re-ingested as part of the prompt (the engine's chunked-prefill
@@ -1369,7 +1552,7 @@ class TextDecodeEngine:
         self.engine.submit(request_id, self._encode(text), budget,
                            temperature=temperature, top_k=top_k,
                            top_p=top_p, seed=seed, eos_id=eos_id,
-                           adapter_id=adapter_id)
+                           adapter_id=adapter_id, slo=slo)
 
     def _full_text(self, rid: Any, ids: List[int]) -> str:
         """The request's cumulative OUTPUT text: decoded generated ids,

@@ -20,13 +20,14 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..obs import (MetricsRegistry, TraceBuffer, mint_trace_id,
-                   mount_obs_routes, sanitize_trace_id)
+from ..obs import (MetricsRegistry, StatsMap, TraceBuffer,
+                   mint_trace_id, mount_obs_routes, sanitize_trace_id)
 from ..utils.http import STREAM_BUDGET_S, JsonHttpService, StreamResponse
 from .breaker import OPEN, BreakerBoard
 from .queues import (EXPIRY_SKEW_TOLERANCE_S, QueueHub, pack_message,
                      unpack_message)
 from .router import Router
+from .slo import BrownoutController, normalize_slo
 
 
 def nearest_rank(sorted_vals: Sequence[float], p: float) -> float:
@@ -80,6 +81,13 @@ class Predictor:
     #: timeouts to this budget).
     STREAM_TIMEOUT = STREAM_BUDGET_S
 
+    #: default fleet queue-backlog caps per best-effort class: beyond
+    #: these the shed gate 503s the class with a structured
+    #: ``retry_after_s`` instead of letting it deepen the overload.
+    #: Interactive is never depth-shed (its protection is admission
+    #: priority + preemption, not refusal).
+    DEFAULT_SHED_DEPTHS = {"batch": 64, "background": 16}
+
     #: a gather miss only counts toward a worker's circuit breaker when
     #: the budget it missed was at least this long: misses under an
     #: aggressively learned adaptive budget (or a tiny explicit client
@@ -100,8 +108,11 @@ class Predictor:
                  stream_silence_timeout_s: float = 30.0,
                  max_stream_failovers: int = 2,
                  pool_id: str = "",
-                 affinity_prefix_chars: int = Router.DEFAULT_PREFIX_CHARS
-                 ) -> None:
+                 affinity_prefix_chars: int = Router.DEFAULT_PREFIX_CHARS,
+                 default_slo: str = "",
+                 slo_shed_depths: Optional[Dict[str, int]] = None,
+                 brownout_target_p95_s: float = 0.0,
+                 brownout_clamp_max_new: int = 16) -> None:
         """``adaptive_gather`` enables the serving latency/accuracy
         controller (the reference paper's batching/wait tradeoff,
         SURVEY.md §3.3 note): instead of always waiting
@@ -113,7 +124,20 @@ class Predictor:
         (its answers are dropped from the ensemble: slightly less
         accuracy, much less latency), while a healthy fleet keeps full
         ensembles because the quantile tracks its real speed. Explicit
-        per-request ``timeout`` always wins."""
+        per-request ``timeout`` always wins.
+
+        **SLO / overload controls**: ``default_slo`` classes requests
+        that carry no ``slo`` of their own; ``slo_shed_depths`` caps
+        the fleet queue backlog per best-effort class (batch /
+        background — interactive is never depth-shed), beyond which
+        requests get a structured shed 503 with ``retry_after_s``
+        BEFORE they deepen the overload; ``brownout_target_p95_s``
+        (> 0 enables the ladder) is the interactive-TTFT-p95 target
+        the hysteresis brownout ladder defends — stage 1 halves the
+        best-effort caps, stage 2 additionally clamps background
+        ``max_new`` to ``brownout_clamp_max_new``, stage 3 pauses
+        background entirely. See docs/operations.md "Overload &
+        brownout"."""
         self.hub = hub
         self.worker_ids = list(worker_ids)
         self.gather_timeout = gather_timeout
@@ -143,6 +167,18 @@ class Predictor:
         #: default: a long prefill queued behind busy slots is silence
         self.stream_silence_timeout_s = float(stream_silence_timeout_s)
         self.max_stream_failovers = max(0, int(max_stream_failovers))
+        #: SLO plane: per-job default class, best-effort shed caps,
+        #: and the brownout ladder fed by the live interactive p95
+        #: (workers publish slo_interactive_ttft_p95_s; the ladder
+        #: steps on the fleet max so one hot replica counts)
+        self.default_slo = normalize_slo(default_slo)
+        self.shed_depths = dict(self.DEFAULT_SHED_DEPTHS)
+        for k, v in (slo_shed_depths or {}).items():
+            self.shed_depths[normalize_slo(k)] = max(0, int(v))
+        self.brownout = BrownoutController(
+            target_p95_s=brownout_target_p95_s)
+        self.brownout_clamp_max_new = max(1,
+                                          int(brownout_clamp_max_new))
         self.adaptive_gather = bool(adaptive_gather)
         self.target_answer_frac = min(1.0, max(0.0, target_answer_frac))
         self.gather_margin = max(1.0, gather_margin)
@@ -187,6 +223,19 @@ class Predictor:
         self._c_resumable = self.metrics.counter(
             "stream_resumable_errors",
             "streams ended with a resumable error event")
+        # SLO plane: shed decisions per class + the live brownout stage
+        self._shed_counts = StatsMap({"requests_shed_batch": 0,
+                                      "requests_shed_background": 0})
+        self.metrics.register_stats(self._shed_counts)
+        self._c_shed = self.metrics.counter(
+            "requests_shed",
+            "best-effort requests 503'd by the SLO shed gate "
+            "(structured retry_after_s — backpressure, not failure)")
+        self.metrics.gauge(
+            "brownout_stage",
+            "live brownout ladder stage (0 normal, 1 capped, "
+            "2 clamped, 3 background paused)",
+            fn=lambda: self.brownout.stage)
         # scale-out plane: router decision counters + live pool gauges
         self.metrics.register_stats(self.router.counters)
         self.metrics.gauge(
@@ -325,11 +374,18 @@ class Predictor:
         breaker signals — one read serves both) from the hub's
         published worker stats + queue depths. Rate-limited."""
         now = time.monotonic()
-        if now - self._last_load_refresh < self.LOAD_REFRESH_EVERY_S:
-            return
-        self._last_load_refresh = now
         with self._lock:
+            # atomic check-then-set: this refresh now TICKS the
+            # brownout ladder's dwell counters, and two request
+            # threads racing the unguarded watermark would double-tick
+            # a transition ("dwell consecutive observations" is the
+            # hysteresis contract)
+            if now - self._last_load_refresh < \
+                    self.LOAD_REFRESH_EVERY_S:
+                return
+            self._last_load_refresh = now
             members = list(self.worker_ids)
+        p95s: List[float] = []
         for wid in members:
             try:
                 s = self.hub.get_worker_stats(wid)
@@ -337,9 +393,23 @@ class Predictor:
             except Exception:  # rafiki: noqa[silent-except] — load
                 continue       # signals are advisory; stale beats dead
             if s is not None:
-                self._annotate_staleness(wid, s)
+                annotated = self._annotate_staleness(wid, s)
                 self.router.observe(wid, s)
+                v = s.get("slo_interactive_ttft_p95_s")
+                if not annotated.get("stale") and \
+                        isinstance(v, (int, float)) and \
+                        not isinstance(v, bool):
+                    # a dead/stuck worker's LAST published p95 must
+                    # not pin the ladder: stale stats are liveness
+                    # fiction, not a latency signal
+                    p95s.append(float(v))
             self.router.observe_queue_depth(wid, depth)
+        # brownout ladder tick: the fleet MAX interactive p95 (one hot
+        # replica is an SLO breach; averaging would hide it). Rides
+        # this rate-limited refresh so the ladder's dwell counts are
+        # roughly seconds, and an idle/recovered fleet (no samples)
+        # walks back down.
+        self.brownout.observe(max(p95s) if p95s else None)
 
     def _gather_deadline_s(self) -> float:
         """The adaptive controller's current gather budget."""
@@ -354,10 +424,70 @@ class Predictor:
                        nearest_rank(lat, self.target_answer_frac)
                        * self.gather_margin))
 
+    # ---- SLO shed gate (predictor-side overload backpressure) ----
+    def shed_verdict(self, slo: Optional[str] = None
+                     ) -> Optional[Dict[str, Any]]:
+        """Should a ``slo``-class request be shed RIGHT NOW? None =
+        admit; otherwise the structured shed payload (a 503 at the
+        HTTP front). Best-effort classes are refused with a
+        ``retry_after_s`` once the fleet queue backlog exceeds their
+        (brownout-adjusted) cap — BEFORE they deepen the overload —
+        and background is refused outright at brownout stage 3.
+        Interactive is never shed here: its protection is engine-side
+        priority + preemption, not refusal. Shedding is backpressure,
+        not failure — the reply names the class, the live stage, and
+        when retrying can help."""
+        cls = normalize_slo(slo, default=self.default_slo)
+        # refresh BEFORE the interactive early-return: this
+        # (rate-limited) call is what ticks the brownout ladder, and
+        # a fleet serving only interactive traffic must still walk
+        # the ladder back down after an overload ends — de-escalation
+        # cannot wait for the next best-effort arrival
+        self._refresh_load_signals()
+        if cls == "interactive":
+            return None
+        stage = self.brownout.stage
+        cap = self.brownout.shed_cap(cls, self.shed_depths.get(cls, 0))
+        # fleet backlog FOR THIS CLASS: unpopped hub messages plus the
+        # engines' published class-queue depths (workers pop the hub
+        # eagerly, so overload backlog sits in the engine queues)
+        depth = (self.router.total_queue_depth()
+                 + self.router.class_backlog(cls))
+        if cls == "background" and stage >= 3:
+            reason = "background paused (brownout stage 3)"
+        elif cap >= 0 and depth > cap:
+            reason = (f"{cls} backlog {depth} over cap {cap}"
+                      + (f" (brownout stage {stage})" if stage else ""))
+        else:
+            return None
+        retry = round(min(30.0, 1.0 + 0.1 * max(0, depth - max(cap, 0))),
+                      3)
+        self._c_shed.inc()
+        self._shed_counts.inc(f"requests_shed_{cls}")
+        return {"shed": True, "slo": cls, "error": f"shed: {reason}",
+                "retry_after_s": retry, "brownout_stage": stage}
+
+    def _brownout_sampling(self, cls: str,
+                           sampling: Optional[Dict]) -> Optional[Dict]:
+        """Stage >= 2: clamp background ``max_new`` so long best-effort
+        generations release their slots/pages sooner (the 'clamped'
+        rung of the ladder). Other classes/stages pass through
+        untouched."""
+        if cls == "interactive":
+            return sampling
+        mn = (sampling or {}).get("max_new")
+        c = self.brownout.clamp_max_new(cls, mn,
+                                        self.brownout_clamp_max_new)
+        if c is not None and c != mn:
+            sampling = dict(sampling or {})
+            sampling["max_new"] = c
+        return sampling
+
     def predict(self, queries: Sequence[Any],
                 timeout: Optional[float] = None,
                 sampling: Optional[Dict] = None,
-                trace_id: Optional[str] = None
+                trace_id: Optional[str] = None,
+                slo: Optional[str] = None
                 ) -> Tuple[List[Any], Dict]:
         """Returns (ensembled predictions, info dict). ``sampling``
         (generation jobs only) rides with the message to the decode
@@ -370,8 +500,30 @@ class Predictor:
         an inbound ``X-Rafiki-Trace-Id``), else minted here; it rides
         in the scatter payload so worker-side span records join this
         predictor's across ``/debug/requests``, and comes back in
-        ``info["trace_id"]``."""
+        ``info["trace_id"]``.
+
+        ``slo`` (``interactive``/``batch``/``background``; default =
+        the job's ``default_slo``): the request's admission class. It
+        rides the scatter payload to the engine's class-aware queue,
+        and best-effort classes may be SHED here (structured 503 with
+        ``retry_after_s`` via ``info["shed"]``) when the backlog cap
+        or brownout ladder says admitting would hurt interactive
+        traffic."""
         t0 = time.monotonic()
+        cls = normalize_slo(slo, default=self.default_slo)
+        shed = self.shed_verdict(cls)
+        if shed is not None:
+            self._c_requests.inc()
+            tid = sanitize_trace_id(trace_id) or mint_trace_id()
+            self.traces.start(tid, request_id="", span="shed",
+                              slo=cls,
+                              retry_after_s=shed["retry_after_s"])
+            return [], {"workers_answered": 0, "workers_asked": 0,
+                        "workers_skipped": len(self.worker_ids),
+                        "latency_s": time.monotonic() - t0,
+                        "errors": [shed["error"]], "fast_fail": True,
+                        "trace_id": tid, **shed}
+        sampling = self._brownout_sampling(cls, sampling)
         adaptive = timeout is None and self.adaptive_gather
         timeout = self._gather_deadline_s() if timeout is None else timeout
         qid = uuid.uuid4().hex
@@ -416,9 +568,9 @@ class Predictor:
         # ttl_s/sent_ts are the relative twin — workers prefer them,
         # judged against their own skew estimate (see worker._expired)
         payload = {"id": qid, "queries": _stack(queries),
-                   "deadline_ts": time.time() + timeout,
+                   "deadline_ts": time.time() + timeout,  # rafiki: noqa[wall-clock-deadline] — legacy-worker fallback; ttl_s+sent_ts below is the sanctioned path
                    "ttl_s": float(timeout), "sent_ts": time.time(),
-                   "trace_id": tid}
+                   "trace_id": tid, "slo": cls}
         if sampling:
             payload["sampling"] = dict(sampling)
         msg = pack_message(payload)
@@ -596,7 +748,8 @@ class Predictor:
                        timeout: Optional[float] = None,
                        sampling: Optional[Dict] = None,
                        trace_id: Optional[str] = None,
-                       resume_partial: Optional[Sequence[Any]] = None):
+                       resume_partial: Optional[Sequence[Any]] = None,
+                       slo: Optional[str] = None):
         """Streaming generation: yield per-query text deltas as the
         decode loop produces them, then a final event.
 
@@ -632,8 +785,20 @@ class Predictor:
         error (``resumable`` + ``qid`` + ``partial`` +
         ``retry_after_s``) the client SDK can auto-resume via
         ``resume_partial`` — which is also the server side of a
-        client-driven resume."""
+        client-driven resume.
+
+        ``slo``: admission class (see :meth:`predict`); a shed
+        best-effort stream ends with a single
+        ``{"done": True, "shed": True, "retry_after_s": ...}`` event
+        (the HTTP front pre-flights the same verdict into a 503
+        before the SSE response commits)."""
         t0 = time.monotonic()
+        cls = normalize_slo(slo, default=self.default_slo)
+        shed = self.shed_verdict(cls)
+        if shed is not None:
+            yield {"done": True, **shed}
+            return
+        sampling = self._brownout_sampling(cls, sampling)
         timeout = self.STREAM_TIMEOUT if timeout is None else timeout
         tid = sanitize_trace_id(trace_id) or mint_trace_id()
         deadline = t0 + timeout
@@ -677,9 +842,10 @@ class Predictor:
                 remaining = deadline - time.monotonic()
                 payload = {"id": qid, "queries": _stack(queries),
                            "stream": True,
-                           "deadline_ts": time.time() + remaining,
+                           "deadline_ts": time.time() + remaining,  # rafiki: noqa[wall-clock-deadline] — legacy-worker fallback; ttl_s+sent_ts is the sanctioned path
                            "ttl_s": float(remaining),
-                           "sent_ts": time.time(), "trace_id": tid}
+                           "sent_ts": time.time(), "trace_id": tid,
+                           "slo": cls}
                 if sampling:
                     payload["sampling"] = dict(sampling)
                 fp = {str(i): t for i, t in acc.items() if t}
@@ -752,6 +918,15 @@ class Predictor:
                             self.breakers.set_draining(wid, True)
                             failover_reason = "worker draining"
                             break
+                        if reply.get("expired"):
+                            # the worker popped the query past its
+                            # deadline and said so (structured, not a
+                            # silent drop): fail over NOW — the
+                            # remaining stream budget goes to a
+                            # replica that can still answer, instead
+                            # of waiting out the silence window
+                            failover_reason = "expired at worker"
+                            break
                         # same terminal contract as the timeout branch:
                         # the client learns what text is authoritative
                         final = {"done": True,
@@ -815,8 +990,12 @@ class Predictor:
                     # (saturation must not cascade into fast-fail 503s
                     # for unary traffic)
                     self._c_failover.inc()
-                    if failover_reason != "worker draining" and \
-                            saw_event:
+                    if failover_reason not in (
+                            "worker draining", "expired at worker") \
+                            and saw_event:
+                        # a drain rejection is voluntary and an
+                        # expired rejection PROVES the worker alive
+                        # and responsive — neither is breaker evidence
                         self.breakers.record_failure(wid)
                     tried.add(wid)
                     self.traces.add_span(tid, "worker_lost",
@@ -874,6 +1053,17 @@ class Predictor:
                 # gather_timeout when adaptive gathering is off/warming)
                 "gather_deadline_s": self._gather_deadline_s(),
                 "adaptive_gather": self.adaptive_gather,
+                # SLO / overload plane: class default, live backlog vs
+                # the shed caps, shed decisions per class, and the
+                # brownout ladder (docs/operations.md "Overload &
+                # brownout")
+                "slo": {"default": self.default_slo,
+                        "shed_depths": dict(self.shed_depths),
+                        "queue_depth": self.router.total_queue_depth(),
+                        "requests_shed": int(self._c_shed.value),
+                        **{k: int(v) for k, v in
+                           self._shed_counts.snapshot().items()},
+                        "brownout": self.brownout.snapshot()},
                 # per-worker circuit-breaker state + fault counters
                 # (trips/recoveries ride /metrics too)
                 "breakers": self.breakers.snapshot(),
@@ -927,7 +1117,7 @@ class Predictor:
             pub = s.get("published_at")
             s["stale"] = bool(
                 isinstance(pub, (int, float))
-                and time.time() - float(pub) > budget)
+                and time.time() - float(pub) > budget)  # rafiki: noqa[wall-clock-deadline] — fallback for workers predating the monotonic uptime_s pair
         if s["stale"]:
             self.breakers.record_stale(wid)
         if "draining" in s:
@@ -1014,6 +1204,20 @@ class PredictorService:
                 f"timeout must be <= {MAX_REQUEST_TIMEOUT_S:.0f}s")
         return True, t
 
+    @staticmethod
+    def _parse_slo(body) -> Tuple[bool, Any]:
+        """(True, normalized-class-or-None) or (False, error). Absent/
+        null means "job default"; an unknown class is a client error —
+        silently serving a typo'd class as interactive would defeat
+        the admission policy."""
+        slo = (body or {}).get("slo")
+        if slo is None:
+            return True, None
+        try:
+            return True, normalize_slo(slo)
+        except ValueError as e:
+            return False, str(e)
+
     def _predict(self, _m, body, headers) -> Tuple[int, Any]:
         queries = (body or {}).get("queries")
         if not isinstance(queries, list) or not queries:
@@ -1021,12 +1225,28 @@ class PredictorService:
         ok, timeout = self._parse_timeout(body)
         if not ok:
             return 400, {"error": timeout}
+        ok, slo = self._parse_slo(body)
+        if not ok:
+            return 400, {"error": slo}
         sampling = (body or {}).get("sampling")
         preds, info = self.predictor.predict(
             queries, timeout=timeout,
             sampling=sampling if isinstance(sampling, dict) else None,
-            trace_id=self._trace_header(headers))
+            trace_id=self._trace_header(headers), slo=slo)
         if info["workers_answered"] == 0:
+            if info.get("shed"):
+                # structured SHED 503: overload backpressure on a
+                # best-effort class — distinct from the breaker
+                # fast-fail below (`shed: true` + brownout stage), so
+                # clients can tell "come back later" from "fleet down"
+                return 503, {"error": info["errors"][0]
+                             if info.get("errors") else "shed",
+                             "shed": True, "slo": info.get("slo"),
+                             "brownout_stage":
+                                 info.get("brownout_stage", 0),
+                             "retry_after_s": info.get("retry_after_s",
+                                                       1.0),
+                             "info": info}
             if info.get("fast_fail"):
                 # structured 503: every breaker open (or the whole
                 # fleet draining) — the client is told when retrying
@@ -1050,17 +1270,26 @@ class PredictorService:
         ok, timeout = self._parse_timeout(body)
         if not ok:
             return 400, {"error": timeout}
+        ok, slo = self._parse_slo(body)
+        if not ok:
+            return 400, {"error": slo}
         sampling = (body or {}).get("sampling")
         resume = (body or {}).get("resume")
         if resume is not None and not isinstance(resume, list):
             return 400, {"error": "resume must be a list of partial "
                                   "texts (one per query, null for "
                                   "none)"}
+        shed = self.predictor.shed_verdict(slo)
+        if shed is not None:
+            # pre-flight the shed verdict into a REAL 503 — once the
+            # SSE response commits (200 + headers) a shed could only
+            # be a terminal event, invisible to plain HTTP clients
+            return 503, {**shed, "info": {"shed": True}}
         events = self.predictor.predict_stream(
             queries, timeout=timeout,
             sampling=sampling if isinstance(sampling, dict) else None,
             trace_id=self._trace_header(headers),
-            resume_partial=resume)
+            resume_partial=resume, slo=slo)
 
         def sse():
             import json as _json
@@ -1112,7 +1341,16 @@ def main(argv: Optional[list] = None) -> int:
                           pool_id=str(cfg.get("pool_id", "")),
                           affinity_prefix_chars=int(
                               cfg.get("affinity_prefix_chars",
-                                      Router.DEFAULT_PREFIX_CHARS)))
+                                      Router.DEFAULT_PREFIX_CHARS)),
+                          # SLO / overload controls (admin budget keys
+                          # SLO_DEFAULT / SLO_SHED_*_DEPTH /
+                          # SLO_P95_TARGET_S / SLO_BACKGROUND_MAX_NEW)
+                          default_slo=str(cfg.get("default_slo", "")),
+                          slo_shed_depths=cfg.get("slo_shed_depths"),
+                          brownout_target_p95_s=float(
+                              cfg.get("brownout_target_p95_s", 0.0)),
+                          brownout_clamp_max_new=int(
+                              cfg.get("brownout_clamp_max_new", 16)))
     svc = PredictorService(predictor, cfg.get("host", "127.0.0.1"),
                            int(cfg.get("port", 0)))
     host, port = svc.start()

@@ -72,7 +72,7 @@ class _Load:
     """Latest observed load signals for one worker."""
 
     __slots__ = ("pages_ratio", "stalls_total", "stalled_until",
-                 "queue_depth", "wait_p95_s", "at")
+                 "queue_depth", "wait_p95_s", "class_backlog", "at")
 
     def __init__(self) -> None:
         self.pages_ratio = 0.0    # kv_pages_used / kv_pages_total
@@ -84,6 +84,11 @@ class _Load:
         self.stalled_until = 0.0  # recent stall growth holds 'saturated'
         self.queue_depth = 0      # unpopped messages on the query queue
         self.wait_p95_s = 0.0     # queue-wait p95 (fallback: TTFT p95)
+        #: engine-side per-class admission backlog (the `queued_*`
+        #: gauges): workers pop the hub eagerly, so the REAL backlog
+        #: under overload sits in the engine's class queue, not the
+        #: hub — the SLO shed gate reads it from here
+        self.class_backlog: Dict[str, int] = {}
         self.at = 0.0
 
 
@@ -207,6 +212,10 @@ class Router:
                 ld.stalls_total = stalls
             if isinstance(p95, (int, float)) and not isinstance(p95, bool):
                 ld.wait_p95_s = float(p95)
+            for cls in ("interactive", "batch", "background"):
+                q = _signal(stats, f"queued_{cls}")
+                if q is not None:
+                    ld.class_backlog[cls] = int(q)
             ld.at = now
 
     def observe_queue_depth(self, wid: str, depth: int) -> None:
@@ -215,6 +224,40 @@ class Router:
             if ld is None:
                 ld = self._load[wid] = _Load()
             ld.queue_depth = max(0, int(depth))
+
+    def _backlog_members(self) -> List[str]:
+        """Members whose backlog gauges are TRUSTWORTHY: breaker
+        CLOSED only. A dead/stale worker's breaker force-opens, and
+        its last-published ``queued_*`` gauges describe a corpse —
+        summing them would pin the shed gate shut on an idle fleet
+        (the same corpse-pins-the-controller hazard as the brownout
+        p95 feed)."""
+        snap = self._board.snapshot()
+        with self._lock:
+            return [w for w in self._members
+                    if (snap.get(w) or {}).get("state") == CLOSED]
+
+    def total_queue_depth(self) -> int:
+        """Unpopped query-queue messages summed over live (breaker-
+        CLOSED) members — the predictor's SLO shed gate compares this
+        against the per-class depth caps (a fleet-level backlog
+        level, refreshed on the same rate-limited tick as the load
+        view)."""
+        members = self._backlog_members()
+        with self._lock:
+            return sum(self._load[w].queue_depth for w in members
+                       if w in self._load)
+
+    def class_backlog(self, slo: str) -> int:
+        """Fleet-wide ENGINE admission backlog for one SLO class (the
+        live members' published ``queued_<class>`` gauges summed).
+        Workers pop the hub eagerly, so under overload the backlog
+        lives in the engines' class queues — hub depth alone
+        under-measures it."""
+        members = self._backlog_members()
+        with self._lock:
+            return sum(self._load[w].class_backlog.get(slo, 0)
+                       for w in members if w in self._load)
 
     def saturated(self, wid: str) -> bool:
         """True when placing a request on ``wid`` would likely stall at

@@ -1,0 +1,318 @@
+"""SLO classes, class-aware queueing, and the brownout ladder.
+
+Rafiki's signature move is trading quality for latency under load
+(SURVEY.md §3.3; the adaptive-gather controller is the unary half).
+This module is the *mixed-traffic* half: overload becomes a first-class,
+gracefully-degraded regime instead of an emergent FIFO stall.
+
+Three pieces, deliberately host-side and dependency-free so both the
+real :class:`~rafiki_tpu.serving.decode_engine.DecodeEngine` and the
+chaos harness's stub engine run the SAME policy code:
+
+- **SLO classes** (``interactive`` > ``batch`` > ``background``): a
+  per-job default with a per-request override, plumbed predictor →
+  scatter payload → worker → engine. :func:`normalize_slo` is the one
+  validator every surface shares — the admin budget key, the HTTP
+  body, the client SDK kwarg, and the engine must all mean the same
+  three strings.
+
+- :class:`ClassQueue` — per-class FIFO with **aging**: admission
+  serves interactive first, FIFO within a class, and a class whose
+  head has been skipped ``aging_skips`` times is force-promoted so
+  background work never starves outright (bounded unfairness instead
+  of unbounded wait). Caller-locked by design: both engines mutate it
+  under their own admission lock, so the queue itself takes none.
+
+- :class:`BrownoutController` — a hysteresis ladder over degradation
+  stages driven by the live interactive latency p95: 0 *normal* → 1
+  *capped* (best-effort admission caps halve) → 2 *clamped*
+  (background ``max_new`` clamped) → 3 *paused* (background shed
+  outright). Entering a stage needs ``dwell`` consecutive
+  over-threshold observations and leaving needs ``dwell`` consecutive
+  under-threshold ones, with distinct enter/exit ratios — load
+  flapping around the target must not flap the ladder.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Deque, Dict, Optional, Tuple
+
+#: priority order, highest first: admission serves interactive before
+#: batch before background; preemption evicts in the reverse order.
+SLO_CLASSES: Tuple[str, ...] = ("interactive", "batch", "background")
+
+#: class -> rank (lower = more urgent); the comparison preemption and
+#: admission both key on
+SLO_PRIORITY: Dict[str, int] = {c: i for i, c in enumerate(SLO_CLASSES)}
+
+DEFAULT_SLO = "interactive"
+
+#: stage index -> operator-facing name (metrics expose the index; the
+#: dashboard and /health show the name)
+BROWNOUT_STAGES: Tuple[str, ...] = ("normal", "capped", "clamped",
+                                    "paused")
+
+
+def normalize_slo(value: Any, default: str = DEFAULT_SLO) -> str:
+    """The one SLO-class validator every surface shares. ``None`` /
+    empty → ``default``; anything else must (case-insensitively) name
+    one of :data:`SLO_CLASSES` or ``ValueError`` — a typo'd class
+    silently serving as interactive would defeat the whole admission
+    policy."""
+    if value is None:
+        return default
+    s = str(value).strip().lower()
+    if not s:
+        return default
+    if s not in SLO_PRIORITY:
+        raise ValueError(
+            f"unknown SLO class {value!r} (one of: "
+            f"{', '.join(SLO_CLASSES)})")
+    return s
+
+
+def slo_priority(slo: str) -> int:
+    """Rank of a class (0 = most urgent). Unknown classes rank LAST —
+    a duck-typed item with a bad label must never outrank real
+    traffic."""
+    return SLO_PRIORITY.get(slo, len(SLO_CLASSES))
+
+
+def evictable_occupants(cls: str, occupants):
+    """The occupants a ``cls`` head may preempt: strictly LOWER class,
+    not shielded (aged promotions are immune). ``occupants`` is an
+    iterable of ``(handle, slo, seq, shielded)``; returns the matching
+    ``(handle, slo, seq)`` triples. This is THE eviction predicate —
+    both the real decode engine's feasibility pre-check and every
+    victim selection (real and stub) go through it, so the two can
+    never drift apart (the paged reclaim loop's termination proof
+    depends on feasibility and selection filtering identically)."""
+    p = slo_priority(cls)
+    return [(h, s, q) for h, s, q, shielded in occupants
+            if not shielded and slo_priority(s) > p]
+
+
+def preemption_victim(cls: str, occupants) -> Optional[Any]:
+    """The ONE occupant to evict for a ``cls`` head: the YOUNGEST
+    (highest seq) member of the LOWEST evictable class — least-urgent,
+    least-invested work goes first. None when nothing ranks below
+    ``cls`` (equal-or-higher-class work is never preempted)."""
+    cands = evictable_occupants(cls, occupants)
+    if not cands:
+        return None
+    return max(cands, key=lambda t: (slo_priority(t[1]), t[2]))[0]
+
+
+class ClassQueue:
+    """Per-class FIFO admission queue with starvation-bounding aging.
+
+    NOT thread-safe on purpose: the decode engine mutates its queue
+    under its own admission lock and the stub engine is single-threaded
+    by contract; an internal lock here would nest under theirs for no
+    benefit.
+
+    Aging: every :meth:`pop` that serves class X increments a skip
+    counter on every LOWER-priority class that had a waiter; a class
+    whose counter reaches ``aging_skips`` is served next regardless of
+    priority (and its counter resets). Interactive bursts therefore
+    delay background by at most ``aging_skips`` admissions, never
+    forever."""
+
+    #: admissions a lower class may be skipped before force-promotion
+    DEFAULT_AGING_SKIPS = 16
+
+    def __init__(self, aging_skips: int = DEFAULT_AGING_SKIPS) -> None:
+        self.aging_skips = max(1, int(aging_skips))
+        self._qs: Dict[str, Deque[Any]] = {
+            c: collections.deque() for c in SLO_CLASSES}
+        self._skips: Dict[str, int] = {c: 0 for c in SLO_CLASSES}
+        #: force-promotions performed (the aging mechanism firing) —
+        #: engines surface it as the ``slo_aged_promotions`` gauge
+        self.promotions = 0
+        #: did the LAST pop fire the aging mechanism? Engines shield
+        #: such admissions from preemption — an aged-promoted
+        #: background request immediately evicted by the next
+        #: interactive arrival would starve exactly the way aging
+        #: exists to prevent
+        self.last_pop_promoted = False
+
+    def push(self, slo: str, item: Any, front: bool = False) -> None:
+        """Enqueue ``item`` under ``slo`` (validated). ``front``
+        re-queues a preempted item ahead of its class peers so it
+        resumes before newer same-class work."""
+        q = self._qs[normalize_slo(slo)]
+        if front:
+            q.appendleft(item)
+        else:
+            q.append(item)
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self._qs.values())
+
+    def __bool__(self) -> bool:
+        return any(self._qs.values())
+
+    def depth(self, slo: str) -> int:
+        return len(self._qs[normalize_slo(slo)])
+
+    def depths(self) -> Dict[str, int]:
+        return {c: len(q) for c, q in self._qs.items()}
+
+    def next_class(self) -> Optional[str]:
+        """The class the next :meth:`pop` will serve: an aged class
+        first (most-skipped wins ties), else the highest-priority
+        non-empty one. None when empty."""
+        aged = [c for c in SLO_CLASSES
+                if self._qs[c] and self._skips[c] >= self.aging_skips]
+        if aged:
+            return max(aged, key=lambda c: self._skips[c])
+        for c in SLO_CLASSES:
+            if self._qs[c]:
+                return c
+        return None
+
+    def peek(self) -> Optional[Tuple[str, Any]]:
+        """(class, head item) the next pop would return, without
+        popping — engines check page reservations against the head
+        before committing."""
+        c = self.next_class()
+        if c is None:
+            return None
+        return c, self._qs[c][0]
+
+    def pop(self) -> Optional[Tuple[str, Any]]:
+        """Serve the next item (see :meth:`next_class`), updating the
+        aging counters."""
+        c = self.next_class()
+        if c is None:
+            return None
+        self.last_pop_promoted = bool(
+            self._skips[c] >= self.aging_skips and any(
+                self._qs[h] for h in SLO_CLASSES
+                if SLO_PRIORITY[h] < SLO_PRIORITY[c]))
+        if self.last_pop_promoted:
+            # served ahead of waiting higher-priority work: the aging
+            # mechanism fired, not ordinary priority order
+            self.promotions += 1
+        item = self._qs[c].popleft()
+        self._skips[c] = 0
+        for lower in SLO_CLASSES:
+            if SLO_PRIORITY[lower] > SLO_PRIORITY[c] and self._qs[lower]:
+                self._skips[lower] += 1
+        return c, item
+
+    def clear(self) -> None:
+        for c in SLO_CLASSES:
+            self._qs[c].clear()
+            self._skips[c] = 0
+
+
+class BrownoutController:
+    """Hysteresis ladder over degradation stages, fed by the live
+    interactive latency p95.
+
+    Stages (index is the ``brownout_stage`` gauge):
+
+    0. **normal** — no degradation.
+    1. **capped** — best-effort (batch + background) shed caps halve.
+    2. **clamped** — background ``max_new`` additionally clamped to
+       ``clamp_max_new`` (long best-effort generations release their
+       slots/pages sooner).
+    3. **paused** — background is shed outright (structured 503 with
+       ``retry_after_s``); batch keeps the halved cap.
+
+    A stage is entered only after ``dwell`` CONSECUTIVE observations
+    above ``target_p95_s × enter_ratio`` and left only after ``dwell``
+    consecutive observations below ``target_p95_s × exit_ratio``
+    (enter > exit: the band between them is sticky, so p95 noise
+    around the target cannot flap the ladder). ``target_p95_s <= 0``
+    disables the ladder (stage pinned at 0) — shedding then runs on
+    the static depth caps alone."""
+
+    def __init__(self, target_p95_s: float = 0.0,
+                 enter_ratio: float = 1.5, exit_ratio: float = 1.1,
+                 dwell: int = 3) -> None:
+        self.target_p95_s = float(target_p95_s)
+        self.enter_ratio = max(1.0, float(enter_ratio))
+        self.exit_ratio = max(0.0, min(float(exit_ratio),
+                                       self.enter_ratio))
+        self.dwell = max(1, int(dwell))
+        self.stage = 0
+        self.escalations = 0
+        self.deescalations = 0
+        self._hot = 0
+        self._cool = 0
+        self._last_p95 = 0.0
+
+    @property
+    def enabled(self) -> bool:
+        return self.target_p95_s > 0
+
+    def observe(self, p95_s: Optional[float]) -> int:
+        """Feed one interactive-p95 observation; returns the (possibly
+        changed) stage. ``None``/non-positive observations (no
+        interactive traffic yet) count toward COOLING — an idle fleet
+        must walk back down the ladder, not stick at a stale stage."""
+        if not self.enabled:
+            return self.stage
+        v = float(p95_s) if isinstance(p95_s, (int, float)) and \
+            not isinstance(p95_s, bool) else 0.0
+        self._last_p95 = v
+        if v > self.target_p95_s * self.enter_ratio:
+            self._hot += 1
+            self._cool = 0
+            if self._hot >= self.dwell and \
+                    self.stage < len(BROWNOUT_STAGES) - 1:
+                self.stage += 1
+                self.escalations += 1
+                self._hot = 0
+        elif v < self.target_p95_s * self.exit_ratio:
+            self._cool += 1
+            self._hot = 0
+            if self._cool >= self.dwell and self.stage > 0:
+                self.stage -= 1
+                self.deescalations += 1
+                self._cool = 0
+        else:
+            # the sticky band between exit and enter: neither streak
+            # survives it — transitions need consecutive evidence
+            self._hot = 0
+            self._cool = 0
+        return self.stage
+
+    # ---- what each stage means for admission (shared semantics:
+    # ---- predictor shed gate and docs both read these) ----
+    def shed_cap(self, slo: str, base_cap: int) -> int:
+        """The effective queue-depth cap for ``slo`` at the current
+        stage: interactive is never capped, best-effort caps halve at
+        stage >= 1, background drops to 0 (pause) at stage 3."""
+        if slo == "interactive":
+            return -1  # sentinel: no cap
+        cap = max(0, int(base_cap))
+        if self.stage >= 1 and cap > 1:
+            # halve, floored at 1 — but an operator cap of 0 or 1
+            # stays put: the ladder may only TIGHTEN admission, never
+            # raise a stricter configured cap
+            cap = max(1, cap // 2)
+        if slo == "background" and self.stage >= 3:
+            cap = 0
+        return cap
+
+    def clamp_max_new(self, slo: str, requested: Optional[int],
+                      clamp: int) -> Optional[int]:
+        """Stage >= 2: background generations are clamped to ``clamp``
+        new tokens (shorter holds on slots/pages). Other classes and
+        lower stages pass through."""
+        if self.stage >= 2 and slo == "background" and clamp > 0:
+            return clamp if not requested else min(int(requested), clamp)
+        return requested
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"stage": self.stage,
+                "stage_name": BROWNOUT_STAGES[self.stage],
+                "target_p95_s": self.target_p95_s,
+                "enabled": self.enabled,
+                "last_interactive_p95_s": round(self._last_p95, 4),
+                "escalations": self.escalations,
+                "deescalations": self.deescalations}

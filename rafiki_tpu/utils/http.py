@@ -226,6 +226,25 @@ class HttpStatusError(RuntimeError):
         self.status = int(status)
         self.payload = payload if isinstance(payload, dict) else {}
 
+    @property
+    def shed(self) -> bool:
+        """True for an SLO *shed* 503 (overload backpressure on a
+        best-effort class: honor ``retry_after_s`` and come back) as
+        opposed to a breaker fast-fail 503 (the fleet is down/dead —
+        retrying sooner than its ``retry_after_s`` probes the same
+        outage). Both carry ``retry_after_s``; only sheds carry
+        ``shed: true``."""
+        return bool(self.payload.get("shed"))
+
+    @property
+    def retry_after_s(self) -> Optional[float]:
+        """The server's structured retry hint, when present and
+        numeric."""
+        v = self.payload.get("retry_after_s")
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return float(v)
+        return None
+
 
 def _open_request(method: str, url: str, body: Any,
                   headers: Optional[Dict[str, str]], timeout: float,

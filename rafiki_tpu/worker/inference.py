@@ -25,6 +25,8 @@ TPU-first deltas:
 
 from __future__ import annotations
 
+import collections
+import math
 import threading
 import time
 import traceback
@@ -37,6 +39,7 @@ from ..obs import (MetricsRegistry, ObsServer, StatsMap, TraceBuffer,
                    mint_trace_id)
 from ..serving.queues import (EXPIRY_SKEW_TOLERANCE_S, QueueHub,
                               pack_message, unpack_message)
+from ..serving.slo import SLO_CLASSES, normalize_slo
 from ..store.param_store import ParamStore
 
 #: expiry pad for the RELATIVE (ttl_s) deadline path: the residual
@@ -90,10 +93,16 @@ class InferenceWorker:
                  draft_knobs: Optional[dict] = None,
                  kv_page_size: int = 0, kv_pages: int = 0,
                  paged_kernel: Optional[bool] = None,
+                 default_slo: str = "",
                  chaos: Optional[Any] = None) -> None:
         self.worker_id = worker_id
         self.hub = hub
         self.max_batch_msgs = max_batch_msgs
+        #: admission class applied to requests that carry no ``slo``
+        #: of their own (the per-job default; per-request override
+        #: rides the scatter payload). Validated at boot: a typo'd
+        #: job default must fail the deploy, not degrade silently.
+        self.default_slo = normalize_slo(default_slo)
         #: visible drop accounting: silent expiry drops look identical to
         #: gather timeouts from the predictor side, so the worker keeps
         #: its own count (and logs) — the first diagnostic to check when
@@ -157,10 +166,46 @@ class InferenceWorker:
             "one fused engine step() — admission + K decode tokens "
             "(seconds); read next to paged_kernel_active to see the "
             "kernel-vs-gather difference on a live worker")
-        #: engine request id -> (trace_id, queued monotonic). Touched
-        #: only by the serve-loop thread (submits, step, span hook all
-        #: run there), so no lock
-        self._req_obs: Dict[Any, Tuple[str, float]] = {}
+        # class-labeled latency histograms: the brownout ladder feeds
+        # on the INTERACTIVE p95 alone, and an SLO story without
+        # per-class latency evidence is unverifiable. Same metric
+        # names, a `slo` label per class (the registry keys on
+        # (name, labels)); the published per-class p95 gauges below
+        # are what the predictor's controller actually reads.
+        self._h_ttft_slo = {
+            c: self.metrics.histogram(
+                "ttft_seconds",
+                "queued -> first generated token (seconds)",
+                labels={"slo": c}) for c in SLO_CLASSES}
+        self._h_e2e_slo = {
+            c: self.metrics.histogram(
+                "request_seconds",
+                "queued -> request fully answered (seconds)",
+                labels={"slo": c}) for c in SLO_CLASSES}
+        # bounded per-class (timestamp, sample) windows backing the
+        # PUBLISHED p95 gauges: the brownout ladder must see recovery,
+        # and a lifetime-cumulative histogram quantile stays polluted
+        # by an ended overload for hours (fast samples would need to
+        # outnumber slow ones ~19:1 before the p95 moves). Samples
+        # also age out by TIME (publish-side prune): when interactive
+        # traffic stops entirely, the window must drain to empty —
+        # read as cooling — instead of pinning the ladder at the last
+        # overload's p95 all night. The labeled histograms above keep
+        # the cumulative /metrics view.
+        self._slo_ttft_win = {c: collections.deque(maxlen=256)
+                              for c in SLO_CLASSES}
+        self._slo_e2e_win = {c: collections.deque(maxlen=256)
+                             for c in SLO_CLASSES}
+        #: appends run on the serve-loop thread, but _publish_stats
+        #: also runs on the obs sidecar thread (POST /drain publishes
+        #: immediately) — and _window_p95 both prunes and iterates,
+        #: so the windows need their own lock like every other
+        #: cross-thread read in this file
+        self._slo_win_lock = threading.Lock()
+        #: engine request id -> (trace_id, queued monotonic, slo).
+        #: Touched only by the serve-loop thread (submits, step, span
+        #: hook all run there), so no lock
+        self._req_obs: Dict[Any, Tuple[str, float, str]] = {}
         self._obs_server: Optional[ObsServer] = None
         self._obs_port = 0
         self._stop = threading.Event()
@@ -482,6 +527,18 @@ class InferenceWorker:
             # behind" signal (TTFT includes prefill length, queue wait
             # is pure backlog)
             stats["queue_p95_s"] = self._h_queue.quantile(0.95)
+            # per-class latency gauges: the predictor's brownout
+            # ladder steps on slo_interactive_ttft_p95_s; the rest
+            # make the SLO tradeoff visible per class on /health.
+            # WINDOWED (recent 256 samples), not the cumulative
+            # histogram quantile — the ladder must de-escalate when
+            # the overload actually ends, not hours later
+            with self._slo_win_lock:
+                for c in SLO_CLASSES:
+                    stats[f"slo_{c}_ttft_p95_s"] = _window_p95(
+                        self._slo_ttft_win[c])
+                    stats[f"slo_{c}_e2e_p95_s"] = _window_p95(
+                        self._slo_e2e_win[c])
         try:
             self.hub.put_worker_stats(self.worker_id, stats)
         except Exception:  # rafiki: noqa[silent-except] —
@@ -497,24 +554,44 @@ class InferenceWorker:
         entry = self._req_obs.get(rid)
         if entry is None:
             return
-        tid, t_queued = entry
+        tid, t_queued, slo = entry
         now = time.monotonic()
         if event == "admitted":
-            self._h_queue.observe(now - t_queued)
+            if not attrs.get("resumed"):
+                # a preempt-resume RE-admission is not queue wait: the
+                # gap since submit includes the victim's own
+                # pre-preemption generation time, and queue_p95_s is
+                # the router's least-loaded input — a worker doing
+                # preemptions (correctly protecting interactive) must
+                # not read as backlogged for it
+                self._h_queue.observe(now - t_queued)
             self.traces.add_span(tid, "admitted", worker=self.worker_id,
                                  **attrs)
         elif event == "first_token":
             self._h_ttft.observe(now - t_queued)
+            h = self._h_ttft_slo.get(slo)
+            if h is not None:
+                h.observe(now - t_queued)
+                with self._slo_win_lock:
+                    self._slo_ttft_win[slo].append((now,
+                                                    now - t_queued))
             self.traces.add_span(tid, "first_token")
         elif event == "done":
             dt = now - t_queued
             self._h_e2e.observe(dt)
+            h = self._h_e2e_slo.get(slo)
+            if h is not None:
+                h.observe(dt)
+                with self._slo_win_lock:
+                    self._slo_e2e_win[slo].append((now, dt))
             tokens = attrs.get("tokens") or 0
             if tokens and dt > 0:
                 self._h_tps.observe(tokens / dt)
             self.traces.add_span(tid, "done", **attrs)
             self._req_obs.pop(rid, None)
         else:
+            # incl. `preempted`: the span joins the timeline but the
+            # rid entry stays — the victim resumes under the same id
             self.traces.add_span(tid, event, **attrs)
 
     def _count_dropped(self, n: int) -> None:
@@ -531,6 +608,28 @@ class InferenceWorker:
                 "predictor only reports timeouts, check clock skew "
                 "between predictor and worker hosts",
                 self.worker_id, n, "y" if n == 1 else "ies", total)
+
+    def _reject_expired(self, m: dict) -> None:
+        """Answer a past-deadline query with a structured ``expired``
+        rejection instead of a silent drop: the predictor records a
+        skipped vote (unary gather) or triggers stream failover
+        IMMEDIATELY, instead of burning the remaining gather budget
+        waiting on silence. The drop counter and its diagnostic log
+        line stay — `dropped_expired` growing alongside `expired`
+        replies is still the clock-skew tell (ADVICE r3)."""
+        self._count_dropped(1)
+        if "id" not in m:
+            return
+        tid = str(m.get("trace_id") or "")
+        if tid:  # the drop is visible in the trace, not just a
+            # counter — joins the predictor's record
+            self.traces.start(tid, request_id=str(m.get("id") or ""),
+                              span="expired", worker=self.worker_id)
+        self.hub.push_prediction(m["id"], pack_message(
+            {"id": m["id"], "worker_id": self.worker_id,
+             "predictions": [], "expired": True,
+             "error": "query expired in transit "
+                      "(deadline exceeded before pop)"}))
 
     def _handle_control(self, m: dict) -> None:
         """Control messages ride the ordinary query queue (``{"control":
@@ -605,9 +704,12 @@ class InferenceWorker:
                     self._handle_control(m)
                 else:
                     serve.append(m)
-            live = [m for m in serve
-                    if not _expired(m, skew_est=self._skew)]
-            self._count_dropped(len(serve) - len(live))
+            live = []
+            for m in serve:
+                if _expired(m, skew_est=self._skew):
+                    self._reject_expired(m)
+                else:
+                    live.append(m)
             if live:
                 # messages popped alongside a drain control preceded
                 # the drain: they are in-flight and get served
@@ -649,13 +751,7 @@ class InferenceWorker:
                     raw = self.hub.pop_query(self.worker_id, 0.0)
                     continue
                 if _expired(m, skew_est=self._skew):
-                    self._count_dropped(1)
-                    tid = str(m.get("trace_id") or "")
-                    if tid:  # the drop is visible in the trace, not
-                        # just a counter — joins the predictor's record
-                        self.traces.start(
-                            tid, request_id=str(m.get("id") or ""),
-                            span="expired", worker=self.worker_id)
+                    self._reject_expired(m)
                     raw = self.hub.pop_query(self.worker_id, 0.0)
                     continue
                 qs = m["queries"]
@@ -673,6 +769,16 @@ class InferenceWorker:
                                       worker=self.worker_id,
                                       n_queries=len(qs))
                     samp = _safe_sampling(m.get("sampling"))
+                    # admission class: per-request override riding the
+                    # payload, else the job default. Defensive like
+                    # _safe_sampling: the predictor validates, but a
+                    # malformed value must degrade to the default,
+                    # never raise inside the serve loop
+                    try:
+                        slo = normalize_slo(m.get("slo"),
+                                            default=self.default_slo)
+                    except ValueError:
+                        slo = self.default_slo
                     if "max_new" in samp:
                         # per-request generation length, clamped by the
                         # worker's configured cap: a client must not be
@@ -708,7 +814,16 @@ class InferenceWorker:
                             prefix = str(fp.get(str(qi), "") or "")
                             if prefix:
                                 kwargs["forced_prefix"] = prefix
-                            self._req_obs[(m["id"], qi)] = (tid, t_queued)
+                            if getattr(self.engine, "supports_slo",
+                                       False):
+                                # capability-gated like forced_prefix:
+                                # a duck-typed user engine without the
+                                # kwarg serves classless FIFO instead
+                                # of dying on a TypeError
+                                kwargs["slo"] = slo
+                            self._req_obs[(m["id"], qi)] = (tid,
+                                                            t_queued,
+                                                            slo)
                             self.engine.submit((m["id"], qi), str(text),
                                                **kwargs)
                     except ValueError as e:
@@ -746,7 +861,8 @@ class InferenceWorker:
                     streaming.clear()
                     # every in-flight request's timeline ends HERE, not
                     # in silence: the reset below preempts all occupants
-                    for _rid, (tid, _t) in list(self._req_obs.items()):
+                    for _rid, (tid, _t, _slo) in list(
+                            self._req_obs.items()):
                         self.traces.add_span(tid, "preempted",
                                              error="engine step failed")
                     self._req_obs.clear()
@@ -848,6 +964,30 @@ class InferenceWorker:
                     latency_s=round(dt, 4))
 
 
+#: published-p95 samples older than this stop counting: an idle class
+#: must read as recovered (empty window → 0.0 → ladder cooling), not
+#: as its last overload forever
+SLO_WINDOW_MAX_AGE_S = 60.0
+
+
+def _window_p95(samples: "collections.deque",
+                max_age_s: float = SLO_WINDOW_MAX_AGE_S) -> float:
+    """Nearest-rank p95 over a bounded recent-(timestamp, value)
+    window, pruning entries older than ``max_age_s`` first (append
+    order is time order, so the prune is a popleft loop). Same
+    quantile rule as the predictor's `nearest_rank`, kept local so
+    the worker doesn't import the predictor module. Empty window →
+    0.0, which the brownout ladder reads as cooling."""
+    cutoff = time.monotonic() - max_age_s
+    while samples and samples[0][0] < cutoff:
+        samples.popleft()
+    if not samples:
+        return 0.0
+    vals = sorted(v for _t, v in samples)
+    n = len(vals)
+    return vals[max(0, min(n - 1, math.ceil(0.95 * n) - 1))]
+
+
 def _require_dict_or_none(value: Any, name: str) -> Optional[dict]:
     """Config values that must be a JSON object when present: silently
     coercing a malformed one would hide an operator mistake until an
@@ -935,7 +1075,7 @@ def _expired(msg: dict, skew_s: float = EXPIRY_SKEW_TOLERANCE_S,
         return skew_est.elapsed_since(float(sent)) \
             > float(ttl) + TTL_EXPIRY_PAD_S
     ts = msg.get("deadline_ts")
-    return ts is not None and time.time() > float(ts) + skew_s
+    return ts is not None and time.time() > float(ts) + skew_s  # rafiki: noqa[wall-clock-deadline] — the documented wall-clock FALLBACK (old payloads); ttl_s+skew_est above is the sanctioned path
 
 
 def _tristate(v: Any) -> Optional[bool]:
@@ -1010,7 +1150,8 @@ def main(argv: Optional[list] = None) -> int:
                                           "draft_knobs"),
         kv_page_size=int(cfg.get("kv_page_size", 0)),
         kv_pages=int(cfg.get("kv_pages", 0)),
-        paged_kernel=_tristate(cfg.get("paged_kernel")))
+        paged_kernel=_tristate(cfg.get("paged_kernel")),
+        default_slo=str(cfg.get("default_slo", "")))
     # observability sidecar: /metrics + /debug/requests on an ephemeral
     # (or configured) port, written to obs_port_file for the operator
     obs_host, obs_port = worker.serve_obs(

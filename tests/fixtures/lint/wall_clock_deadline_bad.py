@@ -1,0 +1,19 @@
+"""Positive fixture: wall-clock (time.time) deadline arithmetic."""
+
+import time
+
+
+def expired(msg, skew_s=3.0):
+    ts = msg.get("deadline_ts")
+    # deadline test on the wall clock: cross-host skew rides straight in
+    return ts is not None and time.time() > float(ts) + skew_s
+
+
+def scatter_payload(timeout):
+    # wall-clock deadline stamped into a payload another host will judge
+    return {"deadline_ts": time.time() + timeout}
+
+
+def arm(budget_s):
+    deadline_ts = time.time() + budget_s
+    return deadline_ts

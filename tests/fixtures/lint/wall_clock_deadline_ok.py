@@ -1,0 +1,22 @@
+"""Negative fixture: monotonic deadlines + plain wall-clock stamps."""
+
+import time
+
+
+def deadline(timeout_s):
+    # monotonic clock: immune to wall steps and cross-host skew
+    return time.monotonic() + timeout_s
+
+
+def expired(now_mono, deadline_mono):
+    return now_mono > deadline_mono
+
+
+def stamp():
+    # stamping when something happened is not deadline arithmetic
+    return {"sent_ts": time.time(), "published_at": time.time()}
+
+
+def elapsed(skew_est, sent_ts):
+    # the sanctioned cross-host path: skew-compensated elapsed time
+    return skew_est.elapsed_since(sent_ts)

@@ -40,6 +40,7 @@ RULE_FIXTURES = {
     "silent-except": "silent_except",
     "library-internals": "library_internals",
     "obs-unregistered-metric": "obs_unregistered_metric",
+    "wall-clock-deadline": "wall_clock_deadline",
 }
 
 

@@ -270,8 +270,10 @@ def test_predictor_discards_reply_queue_after_gather():
 
 
 def test_worker_drops_expired_queries():
-    """A query popped after its gather deadline is dropped (no wasted
-    forward, no reply into a discarded queue)."""
+    """A query popped after its gather deadline skips the forward pass
+    and answers with a structured ``expired`` rejection (ISSUE 12: the
+    predictor records a skipped vote / fails a stream over immediately
+    instead of reading the drop as silence)."""
     import time
 
     from rafiki_tpu.serving.queues import pack_message, unpack_message
@@ -316,8 +318,14 @@ def test_worker_drops_expired_queries():
     store.save("t0", OneShot().dump_parameters())
     w = InferenceWorker(OneShot, "t0", {}, store, hub, "w0")
     w.run(poll_timeout=0.1, max_iterations=1)
-    # only the live query was answered
-    assert hub.pop_prediction("dead", timeout=0.1) is None
+    # the expired query got a structured rejection, not a prediction
+    # (and not silence) — and the drop counter still tells the
+    # clock-skew story
+    dead = hub.pop_prediction("dead", timeout=1.0)
+    assert dead is not None
+    m = unpack_message(dead)
+    assert m["expired"] is True and m["predictions"] == []
+    assert w.stats["dropped_expired"] == 1
     live = hub.pop_prediction("live", timeout=1.0)
     assert live is not None and unpack_message(live)["id"] == "live"
 
