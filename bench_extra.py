@@ -32,6 +32,17 @@ from _bench_common import (collect_errors, record as _record,
 
 DEADLINE = float(os.environ.get("RAFIKI_BENCH_DEADLINE", "480"))
 
+#: RAFIKI_BENCH_ONLY=kv_tier,disagg_prefill narrows a run to the named
+#: stages — how a PR's committed BENCH_<stage>.json lines are produced
+#: without paying for the whole suite. Empty (default) = run all.
+_ONLY = frozenset(s.strip() for s in
+                  os.environ.get("RAFIKI_BENCH_ONLY", "").split(",")
+                  if s.strip())
+
+
+def _want(stage: str) -> bool:
+    return not _ONLY or stage in _ONLY
+
 
 # ----------------------------------------------------------------- child
 
@@ -640,6 +651,342 @@ def _bench_paged_decode(out_path: str) -> None:
         "kv_pages_total": k_stats["kv_pages_total"],
         "requests": len(reqs), "max_new": max_new,
         "page_size": page, "max_len": max_len, "max_slots": slots})
+
+
+def _bench_kv_tier(out_path: str) -> None:
+    """Two-tier KV capacity at a FIXED HBM page budget (ISSUE 13
+    tentpole evidence): the same decode-heavy traffic through the same
+    tiny HBM pool, once HBM-only (admission serializes once the pool's
+    worst-case reservations are spoken for) and once with the
+    pinned-host page tier behind it (cold slots park, their pages
+    evict to host, the prefetcher stages them back) — max concurrent
+    streams, admission stalls, and tokens/s, with every output checked
+    token-exact against an untiered big-pool reference engine. Off-TPU
+    the numbers measure the TIERING plane (park/evict/prefetch policy
+    + the transfer thread) rather than HBM bandwidth — provenance says
+    so; the ≥2× concurrency claim is a policy property that holds
+    wherever the page budget, not compute, is the binding constraint."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rafiki_tpu.models.llama_lora import Llama
+    from rafiki_tpu.serving.decode_engine import DecodeEngine
+
+    backend = jax.default_backend()
+    on_accel = backend not in ("cpu",)
+    vocab, max_len, slots, page = 1 << 10, 64, 8, 8
+    dims = dict(vocab_size=vocab, max_len=max_len,
+                hidden_dim=256 if on_accel else 64,
+                depth=4 if on_accel else 2, n_heads=4, n_kv_heads=2,
+                mlp_dim=1024 if on_accel else 256, lora_rank=0)
+    params = Llama(**dims).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+    # 16 requests at 2-3 pages worst-case each (~40 pages combined)
+    # against 5 usable HBM pages: HBM-only MUST serialize; the host
+    # tier must absorb the overflow and fill all 8 slots
+    rng = np.random.default_rng(0)
+    max_new = 12
+    reqs = [(r, rng.integers(1, vocab,
+                             size=int(rng.integers(4, 9))
+                             ).astype(np.int32), max_new)
+            for r in range(16)]
+    HBM_PAGES, HOST_PAGES = 6, 64  # 6 pool pages = 5 usable (page 0
+    #                                is scratch) + the host tier
+
+    def run(kv_pages: int, host_pages: int):
+        eng = DecodeEngine(
+            Llama(**dims, kv_page_size=page, kv_pages=kv_pages),
+            params, max_slots=slots, max_len=max_len,
+            host_kv_pages=host_pages)
+        eng.submit("warm", reqs[0][1][:4], 2)  # pay the compiles
+        while eng.busy:
+            eng.step()
+        eng.poll()
+        eng.reset_stats()
+        t0 = time.perf_counter()
+        for r in reqs:
+            eng.submit(*r)
+        done, steps = {}, 0
+        while eng.busy and steps < 5000:
+            eng.step()
+            steps += 1
+            done.update(dict(eng.poll()))
+        dt = time.perf_counter() - t0
+        return done, dt, eng.stats_snapshot(), steps < 5000
+
+    ref, _dt, _s, ref_ok = run(33, 0)          # untiered big pool
+    hbm, hbm_dt, hbm_s, hbm_ok = run(HBM_PAGES, 0)
+    tier, tier_dt, tier_s, tier_ok = run(HBM_PAGES, HOST_PAGES)
+    drained = ref_ok and hbm_ok and tier_ok \
+        and len(hbm) == len(reqs) and len(tier) == len(reqs)
+    _record(out_path, {
+        "stage": "kv_tier", "backend": backend,
+        "provenance": ("mosaic" if on_accel else "cpu-fallback") +
+                      "; real DecodeEngine + HostPageTier, tiny model "
+                      "— measures the park/evict/prefetch tiering "
+                      "plane at a fixed page budget, not HBM bandwidth",
+        "requests": len(reqs), "max_new": max_new,
+        "max_slots": slots, "page_size": page,
+        "hbm_pages_usable": HBM_PAGES - 1, "host_pages": HOST_PAGES,
+        "hbm_only_max_concurrent": hbm_s["max_concurrent"],
+        "tiered_max_concurrent": tier_s["max_concurrent"],
+        "concurrency_ratio": (tier_s["max_concurrent"]
+                              / max(hbm_s["max_concurrent"], 1)),
+        "hbm_only_admission_stalls": hbm_s["admission_stalls"],
+        "tiered_admission_stalls": tier_s["admission_stalls"],
+        "hbm_only_tokens_per_s": hbm_s["tokens_generated"] / hbm_dt,
+        "tiered_tokens_per_s": tier_s["tokens_generated"] / tier_dt,
+        "token_exact_vs_untiered": bool(hbm == ref and tier == ref),
+        "admission_deadlocks": 0 if drained else 1,
+        "kv_evictions_total": tier_s["kv_evictions_total"],
+        "kv_prefetch_hits": tier_s["kv_prefetch_hits"],
+        "kv_prefetch_misses": tier_s["kv_prefetch_misses"],
+        "kv_transfer_bytes_total": tier_s["kv_transfer_bytes_total"],
+        "kv_unparks_total": tier_s["kv_unparks_total"]})
+
+
+def _bench_disagg_prefill(out_path: str) -> None:
+    """Inter-token latency of ACTIVE decode streams while long prompts
+    keep arriving, unified vs disaggregated — real workers, real hub
+    wire path, tiny LM. In the unified engine every long-prompt
+    arrival interleaves its chunked prefill with the decode hot loop
+    and the actives' token gaps spike; with the prefill/decode split
+    the prefill worker chews the prompt and ships finished KV pages,
+    so the decode worker's actives hold their no-arrival baseline.
+    The kill leg stops the prefill worker mid-run and asserts every
+    stream still completes token-exact (wait window expires → local
+    re-prefill), zero dropped/duplicated deltas on the wire."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from rafiki_tpu.models.llama_lora import LlamaLoRA
+    from rafiki_tpu.serving.predictor import Predictor
+    from rafiki_tpu.serving.queues import InProcQueueHub
+    from rafiki_tpu.store.param_store import ParamStore
+    from rafiki_tpu.worker.inference import InferenceWorker
+
+    backend = jax.default_backend()
+    on_accel = backend not in ("cpu",)
+    knobs = {
+        "max_epochs": 1, "vocab_size": 1 << 14,
+        "hidden_dim": 512 if on_accel else 64,
+        "depth": 8 if on_accel else 2,
+        "n_heads": 8 if on_accel else 4, "kv_ratio": 2,
+        "lora_rank": 8, "max_len": 128 if on_accel else 32,
+        "model_parallel": 1, "learning_rate": 1e-3, "batch_size": 8,
+        "bf16": on_accel, "quick_train": True, "share_params": False,
+    }
+    model = LlamaLoRA(**knobs)
+    model._params = model._module().init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    store = ParamStore.from_uri("mem://")
+    store.save("bench-lm", model.dump_parameters())
+
+    MAX_NEW = 12
+    LONG_TOKS = 80 if on_accel else 18
+    # cpu-fallback: the tiny bench model's prompt forward is ~free, so
+    # the unified engine's prefill/decode interleave — the phenomenon
+    # this stage measures — is invisible in wall time. Dilate prompt
+    # compute to a modeled floor (seconds/token, engine knob) so the
+    # prefill:decode cost ratio matches a real long-prompt workload;
+    # every wire/install/scheduling cost stays real. Off on
+    # accelerators (prompts there are genuinely long instead).
+    PREFILL_COST_S = 0.0 if on_accel else 0.003
+    # single-token prompts: the actives exist to measure DECODE
+    # inter-token latency, so their own prompt walk must be empty —
+    # a multi-token active prompt re-prefills every closed-loop
+    # iteration and its (dilated) chunk cost pollutes the very tail
+    # the stage compares across legs
+    ACTIVE = ["tok1", "tok2"]
+    LONG = [" ".join(f"tok{(i * 3 + j * 5) % 19 + 1}"
+                     for i in range(LONG_TOKS)) for j in range(4)]
+    DUR = 10.0
+
+    def make_worker(hub, wid, **kw):
+        return InferenceWorker(LlamaLoRA, "bench-lm", knobs, store,
+                               hub, worker_id=wid, decode_loop=True,
+                               max_slots=8, max_new_tokens=MAX_NEW,
+                               steps_per_sync=6,
+                               kv_page_size=8, kv_pages=33, **kw)
+
+    def p95(xs):
+        s = sorted(xs)
+        return s[int(0.95 * (len(s) - 1))] if s else 0.0
+
+    finals = {}      # prompt -> list of final texts, across ALL legs
+    flock = threading.Lock()
+    bad = []         # wire violations (dropped/dup deltas, no final)
+
+    def leg(split: bool, arrivals: bool, kill: bool = False):
+        hub = InProcQueueHub()
+        dec = make_worker(hub, "w-dec",
+                          **({"role": "decode",
+                              "kv_wait_s": 0.4 if kill else 2.0}
+                             if split else {}))
+        workers = [dec]
+        pre = None
+        if split:
+            pre = make_worker(hub, "w-pre", role="prefill")
+            workers.append(pre)
+        if PREFILL_COST_S:
+            for w in workers:
+                w.engine.engine.prefill_token_cost_s = PREFILL_COST_S
+        threads = [threading.Thread(target=w.run, daemon=True)
+                   for w in workers]
+        for t in threads:
+            t.start()
+        try:
+            pred = Predictor(hub, [w.worker_id for w in workers],
+                             gather_timeout=120.0)
+            for _ in range(400):
+                if all(hub.get_worker_stats(w.worker_id)
+                       for w in workers):
+                    break
+                time.sleep(0.05)
+            pred._refresh_load_signals()
+
+            def consume(p, record):
+                acc, last, final = "", None, None
+                for e in pred.predict_stream([p]):
+                    d = e.get("delta")
+                    if d and "0" in d:
+                        t = time.monotonic()
+                        if record and last is not None:
+                            with flock:
+                                gaps.append(t - last)
+                        last = t
+                        acc += d["0"]
+                    if e.get("done"):
+                        final = e
+                if final is None or "predictions" not in final:
+                    bad.append((p[:16], "no final"))
+                    return
+                txt = final["predictions"][0]
+                if not txt.startswith(acc):
+                    bad.append((p[:16], "delta/final mismatch"))
+                with flock:
+                    finals.setdefault(p, []).append(txt)
+
+            # pay every compile before the clock starts (one short +
+            # one long stream warms prefill, step, and — split — the
+            # ship/install path on both workers)
+            gaps = []
+            consume(ACTIVE[0], False)
+            consume(LONG[0], False)
+            gaps = []
+            stop_at = time.monotonic() + DUR
+
+            def active_client(i):
+                while time.monotonic() < stop_at:
+                    consume(ACTIVE[i % len(ACTIVE)], True)
+
+            def arrival_client():
+                j = 0
+                while time.monotonic() < stop_at:
+                    if kill and j == 2 and pre is not None:
+                        pre.stop()  # mid-run: later legs are never
+                        #             served; wait window must expire
+                    consume(LONG[j % len(LONG)], False)
+                    j += 1
+                    time.sleep(0.02)
+
+            cts = [threading.Thread(target=active_client, args=(i,),
+                                    daemon=True) for i in range(2)]
+            if arrivals:
+                cts.append(threading.Thread(target=arrival_client,
+                                            daemon=True))
+            for c in cts:
+                c.start()
+            for c in cts:
+                c.join(timeout=DUR + 120.0)
+            wstats = {w.worker_id: dict(w.stats) for w in workers}
+            return p95(gaps), len(gaps), wstats
+        finally:
+            for w in workers:
+                w.stop()
+            for t in threads:
+                t.join(timeout=15)
+
+    # PAIRED rounds, median of per-round ratios: on a shared-core
+    # host the absolute gap quantum wanders ±20% minute to minute —
+    # far more than the split-vs-baseline delta this stage resolves.
+    # Each round measures all three legs back to back under the same
+    # drift, the RATIOS are formed within the round, and the median
+    # across rounds drops outlier rounds. Accelerator hosts are
+    # quiet; one round suffices there.
+    REPS = 1 if on_accel else 5
+    rounds = []
+    base_n = uni_n = spl_n = 0
+    spl_stats = None
+    for _ in range(REPS):
+        b, bn, _ = leg(split=False, arrivals=False)
+        u, un, _ = leg(split=False, arrivals=True)
+        s, sn, spl_stats = leg(split=True, arrivals=True)
+        rounds.append({"baseline": b, "unified": u, "split": s})
+        base_n += bn
+        uni_n += un
+        spl_n += sn
+
+    def med(vals):
+        vals = sorted(vals)
+        return vals[len(vals) // 2]
+
+    base_p95 = med([r["baseline"] for r in rounds])
+    uni_p95 = med([r["unified"] for r in rounds])
+    spl_p95 = med([r["split"] for r in rounds])
+    split_ratio = med([r["split"] / max(r["baseline"], 1e-9)
+                       for r in rounds])
+    unified_ratio = med([r["unified"] / max(r["baseline"], 1e-9)
+                         for r in rounds])
+    _k_p95, _k_n, kill_stats = leg(split=True, arrivals=True,
+                                   kill=True)
+
+    # token-exactness across every topology (greedy → one text per
+    # prompt, wherever and however its prefill ran)
+    token_exact = bool(finals) and not bad and all(
+        len(set(v)) == 1 for v in finals.values())
+    _record(out_path, {
+        "stage": "disagg_prefill", "backend": backend,
+        "provenance": ("mosaic" if on_accel else "cpu-fallback") +
+                      "; tiny LM through the REAL engine/hub/predictor "
+                      "wire path — measures the phase-split scheduling "
+                      "plane (prefill interleaving vs shipped pages), "
+                      "not kernels" +
+                      ("" if not PREFILL_COST_S else
+                       f"; prompt compute dilated to "
+                       f"{PREFILL_COST_S * 1e3:g} ms/token (engine "
+                       "prefill_token_cost_s) so the tiny model's "
+                       "prefill:decode cost ratio matches a real "
+                       "long-prompt workload — wire/install/scheduling"
+                       " costs are real, all legs equally dilated; "
+                       f"p95s are per-leg medians over {REPS} "
+                       "interleaved rounds (shared-core host drift)"),
+        "prefill_token_cost_s": PREFILL_COST_S,
+        "max_new": MAX_NEW, "long_prompt_tokens": LONG_TOKS,
+        "leg_duration_s": DUR, "steps_per_sync": 6,
+        "rounds": rounds,
+        "itl_p95_baseline_s": base_p95,
+        "itl_p95_unified_arrivals_s": uni_p95,
+        "itl_p95_split_arrivals_s": spl_p95,
+        "unified_stall_ratio": unified_ratio,
+        "split_ratio": split_ratio,
+        "gap_samples": {"baseline": base_n, "unified": uni_n,
+                        "split": spl_n},
+        "token_exact_across_legs": token_exact,
+        "wire_violations": len(bad),
+        "split_kv_ships_sent": spl_stats["w-pre"]["kv_ships_sent"],
+        "split_kv_imports_installed":
+            spl_stats["w-dec"]["kv_imports_installed"],
+        "split_kv_import_fallbacks":
+            spl_stats["w-dec"]["kv_import_fallbacks"],
+        "kill_kv_wait_timeouts":
+            kill_stats["w-dec"]["kv_wait_timeouts"],
+        "kill_kv_imports_installed":
+            kill_stats["w-dec"]["kv_imports_installed"]})
 
 
 def _bench_metrics_overhead(out_path: str) -> None:
@@ -1274,91 +1621,120 @@ def _child(out_path: str, budget: float, use_kv: bool) -> None:
     jax.devices()  # force backend init inside the child's budget
     _record(out_path, {"stage": "probe", "backend": jax.default_backend()})
 
-    try:
-        _bench_predictor(out_path, use_kv,
-                         duration=min(20.0, budget / 8.0))
-    except Exception as e:  # noqa: BLE001
-        _record(out_path, {"stage": "predictor_error",
-                           "error": repr(e)[:300]})
+    if _want("predictor"):
+        try:
+            _bench_predictor(out_path, use_kv,
+                             duration=min(20.0, budget / 8.0))
+        except Exception as e:  # noqa: BLE001
+            _record(out_path, {"stage": "predictor_error",
+                               "error": repr(e)[:300]})
 
-    if budget - (time.monotonic() - t_start) > 90:
+    if _want("generation") and \
+            budget - (time.monotonic() - t_start) > 90:
         try:
             _bench_generation(out_path, duration=min(20.0, budget / 8.0))
         except Exception as e:  # noqa: BLE001
             _record(out_path, {"stage": "generation_error",
                                "error": repr(e)[:300]})
 
-    if budget - (time.monotonic() - t_start) > 120:
+    if _want("small_draft") and \
+            budget - (time.monotonic() - t_start) > 120:
         try:
             _bench_small_draft_spec(out_path)
         except Exception as e:  # noqa: BLE001
             _record(out_path, {"stage": "small_draft_error",
                                "error": repr(e)[:300]})
 
-    if budget - (time.monotonic() - t_start) > 60:
+    if _want("kv_footprint") and \
+            budget - (time.monotonic() - t_start) > 60:
         try:
             _bench_kv_footprint(out_path)
         except Exception as e:  # noqa: BLE001
             _record(out_path, {"stage": "kv_footprint_error",
                                "error": repr(e)[:300]})
 
-    if budget - (time.monotonic() - t_start) > 60:
+    if _want("paged_decode") and \
+            budget - (time.monotonic() - t_start) > 60:
         try:
             _bench_paged_decode(out_path)
         except Exception as e:  # noqa: BLE001
             _record(out_path, {"stage": "paged_decode_error",
                                "error": repr(e)[:300]})
 
-    if budget - (time.monotonic() - t_start) > 60:
+    if _want("kv_tier") and \
+            budget - (time.monotonic() - t_start) > 60:
+        try:
+            _bench_kv_tier(out_path)
+        except Exception as e:  # noqa: BLE001
+            _record(out_path, {"stage": "kv_tier_error",
+                               "error": repr(e)[:300]})
+
+    if _want("disagg_prefill") and \
+            budget - (time.monotonic() - t_start) > 90:
+        try:
+            _bench_disagg_prefill(out_path)
+        except Exception as e:  # noqa: BLE001
+            _record(out_path, {"stage": "disagg_prefill_error",
+                               "error": repr(e)[:300]})
+
+    if _want("metrics_overhead") and \
+            budget - (time.monotonic() - t_start) > 60:
         try:
             _bench_metrics_overhead(out_path)
         except Exception as e:  # noqa: BLE001
             _record(out_path, {"stage": "metrics_overhead_error",
                                "error": repr(e)[:300]})
 
-    if budget - (time.monotonic() - t_start) > 60:
+    if _want("advisor") and \
+            budget - (time.monotonic() - t_start) > 60:
         try:
             _bench_advisor(out_path, n_trials=6)
         except Exception as e:  # noqa: BLE001
             _record(out_path, {"stage": "advisor_error",
                                "error": repr(e)[:300]})
 
-    if budget - (time.monotonic() - t_start) > 60:
+    if _want("advisor_gang") and \
+            budget - (time.monotonic() - t_start) > 60:
         try:
             _bench_advisor_gang(out_path)
         except Exception as e:  # noqa: BLE001
             _record(out_path, {"stage": "advisor_gang_error",
                                "error": repr(e)[:300]})
 
-    if budget - (time.monotonic() - t_start) > 60:
+    if _want("failover") and \
+            budget - (time.monotonic() - t_start) > 60:
         try:
             _bench_failover(out_path)
         except Exception as e:  # noqa: BLE001
             _record(out_path, {"stage": "failover_error",
                                "error": repr(e)[:300]})
 
-    if budget - (time.monotonic() - t_start) > 45:
+    if _want("scaleout") and \
+            budget - (time.monotonic() - t_start) > 45:
         try:
             _bench_scaleout(out_path)
         except Exception as e:  # noqa: BLE001
             _record(out_path, {"stage": "scaleout_error",
                                "error": repr(e)[:300]})
 
-    if budget - (time.monotonic() - t_start) > 40:
+    if _want("slo_overload") and \
+            budget - (time.monotonic() - t_start) > 40:
         try:
             _bench_slo_overload(out_path)
         except Exception as e:  # noqa: BLE001
             _record(out_path, {"stage": "slo_overload_error",
                                "error": repr(e)[:300]})
 
-    if budget - (time.monotonic() - t_start) > 30:
+    if _want("admin_recovery") and \
+            budget - (time.monotonic() - t_start) > 30:
         try:
             _bench_admin_recovery(out_path)
         except Exception as e:  # noqa: BLE001
             _record(out_path, {"stage": "admin_recovery_error",
                                "error": repr(e)[:300]})
 
-    if budget - (time.monotonic() - t_start) > 120:
+    if _want("stream_search") and \
+            budget - (time.monotonic() - t_start) > 120:
         try:
             _bench_stream_search(out_path)
         except Exception as e:  # noqa: BLE001
@@ -1439,6 +1815,8 @@ def main() -> None:
     out_path = os.path.abspath(f".benchx_stages_{os.getpid()}.jsonl")
 
     def _no_results(records: list) -> bool:
+        if _ONLY:
+            return not any(r.get("stage") in _ONLY for r in records)
         return not any(r.get("stage") in ("predictor", "generation",
                                           "advisor") for r in records)
 
@@ -1571,6 +1949,60 @@ def main() -> None:
             "batch_tokens_per_s": round(sl["batch_tokens_per_s"], 1),
             "background_tokens_per_s": round(
                 sl["background_tokens_per_s"], 1)}))
+    kt = next((r for r in records if r.get("stage") == "kv_tier"),
+              None)
+    if kt:
+        print(json.dumps({
+            "metric": "kv_tier_max_concurrency_ratio",
+            "value": round(kt["concurrency_ratio"], 2), "unit": "x",
+            "backend": kt["backend"], "provenance": kt["provenance"],
+            "hbm_pages_usable": kt["hbm_pages_usable"],
+            "host_pages": kt["host_pages"],
+            "hbm_only_max_concurrent": kt["hbm_only_max_concurrent"],
+            "tiered_max_concurrent": kt["tiered_max_concurrent"],
+            "hbm_only_admission_stalls":
+                kt["hbm_only_admission_stalls"],
+            "tiered_admission_stalls": kt["tiered_admission_stalls"],
+            "hbm_only_tokens_per_s": round(
+                kt["hbm_only_tokens_per_s"], 1),
+            "tiered_tokens_per_s": round(kt["tiered_tokens_per_s"], 1),
+            "token_exact_vs_untiered": kt["token_exact_vs_untiered"],
+            "admission_deadlocks": kt["admission_deadlocks"],
+            "kv_evictions_total": kt["kv_evictions_total"],
+            "kv_prefetch_hits": kt["kv_prefetch_hits"],
+            "kv_prefetch_misses": kt["kv_prefetch_misses"],
+            "kv_transfer_bytes_total": kt["kv_transfer_bytes_total"],
+            "requests": kt["requests"], "max_slots": kt["max_slots"],
+            "max_new": kt["max_new"]}))
+    dp = next((r for r in records
+               if r.get("stage") == "disagg_prefill"), None)
+    if dp:
+        print(json.dumps({
+            "metric": "disagg_prefill_itl_p95_ratio",
+            "value": round(dp["split_ratio"], 3), "unit": "x",
+            "backend": dp["backend"], "provenance": dp["provenance"],
+            "itl_p95_baseline_s": round(dp["itl_p95_baseline_s"], 4),
+            "itl_p95_unified_arrivals_s": round(
+                dp["itl_p95_unified_arrivals_s"], 4),
+            "itl_p95_split_arrivals_s": round(
+                dp["itl_p95_split_arrivals_s"], 4),
+            "unified_stall_ratio": round(dp["unified_stall_ratio"], 3),
+            "token_exact_across_legs": dp["token_exact_across_legs"],
+            "wire_violations": dp["wire_violations"],
+            "split_kv_ships_sent": dp["split_kv_ships_sent"],
+            "split_kv_imports_installed":
+                dp["split_kv_imports_installed"],
+            "split_kv_import_fallbacks":
+                dp["split_kv_import_fallbacks"],
+            "kill_kv_wait_timeouts": dp["kill_kv_wait_timeouts"],
+            "kill_kv_imports_installed":
+                dp["kill_kv_imports_installed"],
+            "gap_samples": dp["gap_samples"],
+            "long_prompt_tokens": dp["long_prompt_tokens"],
+            "max_new": dp["max_new"],
+            "steps_per_sync": dp.get("steps_per_sync"),
+            "rounds": dp.get("rounds"),
+            "leg_duration_s": dp["leg_duration_s"]}))
     ar = next((r for r in records
                if r.get("stage") == "admin_recovery"), None)
     if ar:

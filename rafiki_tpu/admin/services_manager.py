@@ -982,6 +982,56 @@ class ServicesManager:
                     f"SLO_P95_TARGET_S={budget['SLO_P95_TARGET_S']} "
                     "must be > 0 (target interactive TTFT p95, "
                     "seconds)")
+        # Disaggregated prefill/decode + host KV tier budget keys,
+        # validated HERE at the create API like every serving knob.
+        # WORKER_ROLE: one role broadcast to every worker, or a
+        # comma-separated role per worker index ("prefill,decode,
+        # decode") — any prefill role requires at least one serving
+        # (decode/unified) role or nothing would answer queries.
+        # HOST_KV_PAGES (>= 1, requires KV_PAGE_SIZE): pinned-host KV
+        # page tier per worker — admission budget becomes HBM + host.
+        # KV_WAIT_S (>= 0): how long a decode worker holds a request
+        # for its KV shipment before re-prefilling locally.
+        from ..serving.kv_transfer import normalize_role
+        roles: List[str] = []
+        if budget.get("WORKER_ROLE"):
+            try:
+                roles = [normalize_role(r) for r in
+                         str(budget["WORKER_ROLE"]).split(",")]
+            except ValueError as e:
+                raise ValueError(f"WORKER_ROLE: {e}") from e
+            if len(roles) == 1:
+                roles = roles * len(services)
+            if len(roles) != len(services):
+                raise ValueError(
+                    f"WORKER_ROLE names {len(roles)} roles for "
+                    f"{len(services)} workers (one per worker, or a "
+                    "single role for all)")
+            if any(r == "prefill" for r in roles) and \
+                    all(r == "prefill" for r in roles):
+                raise ValueError(
+                    "WORKER_ROLE: an all-prefill pool serves nothing "
+                    "— at least one worker must be decode or unified")
+        host_kv_pages = 0
+        if budget.get("HOST_KV_PAGES"):
+            host_kv_pages = int(budget["HOST_KV_PAGES"])
+            if host_kv_pages < 1:
+                raise ValueError(
+                    f"HOST_KV_PAGES={host_kv_pages} must be >= 1 "
+                    "(host-tier page count)")
+            if not budget.get("KV_PAGE_SIZE"):
+                raise ValueError(
+                    "HOST_KV_PAGES requires KV_PAGE_SIZE in the same "
+                    "budget (pages are the host tier's transfer unit)")
+        kv_wait_s = None
+        if "KV_WAIT_S" in budget:
+            kv_wait_s = float(budget["KV_WAIT_S"])
+            if kv_wait_s < 0:
+                raise ValueError(f"KV_WAIT_S={kv_wait_s} must be >= 0")
+            if not roles:
+                raise ValueError(
+                    "KV_WAIT_S requires WORKER_ROLE in the same "
+                    "budget (it tunes the disaggregated decode leg)")
         bg_clamp = 0
         if "SLO_BACKGROUND_MAX_NEW" in budget:
             # membership, not truthiness: 0 must FAIL the create call
@@ -1086,6 +1136,25 @@ class ServicesManager:
                     "PAGED_KERNEL requires KV_PAGE_SIZE in the same "
                     "budget (it selects the PAGED decode path's "
                     "implementation)")
+            if host_kv_pages:
+                # KV_PAGE_SIZE validation above already guaranteed the
+                # decode loop and a paged engine
+                cfg["host_kv_pages"] = host_kv_pages
+            if roles:
+                if not decode_loop:
+                    raise ValueError(
+                        "WORKER_ROLE requires a language-modeling "
+                        "deployment (the decode loop owns the KV "
+                        f"shipments); task {model['task']} serves "
+                        "through the micro-batcher")
+                if roles[i] != "unified":
+                    cfg["role"] = roles[i]
+            if kv_wait_s is not None:
+                cfg["kv_wait_s"] = kv_wait_s
+            # the job's pool id keys cross-worker shared state (the
+            # prefix-snapshot blob): one replica prefills the shared
+            # prefix, every peer imports it
+            cfg["pool_id"] = inference_job_id
             if decode_loop and budget.get("SPECULATE_K"):
                 # speculative decoding at the DEPLOYMENT surface:
                 # SPECULATE_K alone enables prompt-lookup drafting;
@@ -1562,8 +1631,21 @@ class ServicesManager:
                 logging.getLogger(__name__).warning(
                     "autoscaler for job %s disabled on rebuild: %s",
                     job_id, e)
+            # replica template: prefer a SERVING worker's config — a
+            # disaggregated job's worker 0 may be prefill-role, and a
+            # scale-up cloning it would add capacity that never
+            # answers queries (the autoscaler grows on serving
+            # pressure). Fallback strips the role: a unified clone
+            # serves either way.
+            tmpl_cfg = next((dict(spec["config"])
+                             for _i, _w, spec in workers
+                             if spec["config"].get("role")
+                             != "prefill"), None)
+            if tmpl_cfg is None:
+                tmpl_cfg = dict(workers[0][2]["config"])
+                tmpl_cfg.pop("role", None)
             st = {"pool": [w for _, w, _ in workers],
-                  "template": dict(workers[0][2]["config"]),
+                  "template": tmpl_cfg,
                   "module": workers[0][2]["module"],
                   "next_index": max(i for i, _, _ in workers) + 1,
                   "pool_version": 0.0, "policy": policy,
@@ -1734,8 +1816,19 @@ class ServicesManager:
                        stats: Dict[str, Any]) -> Optional[str]:
         """Scale-down victim: the member with the fewest live KV pages
         (least in-flight state to fail over), ties to the most recently
-        added — the pool shrinks newest-first by default."""
-        pool = list(st["pool"])
+        added — the pool shrinks newest-first by default.
+
+        Prefill-role workers are never autoscale victims: the
+        autoscaler manages SERVING capacity, and a prefill worker's
+        near-zero page count would otherwise make it the first pick
+        every time — silently destroying a tier the operator
+        explicitly provisioned (scale-ups clone the serving
+        template, so it would never come back)."""
+        pool = []
+        for w in st["pool"]:
+            s = stats.get(w)
+            if not (isinstance(s, dict) and s.get("role") == "prefill"):
+                pool.append(w)
         if len(pool) <= 1:
             return None
 

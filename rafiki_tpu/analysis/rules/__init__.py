@@ -9,7 +9,8 @@ One module per hazard category (mirrors ``docs/linting.md``):
 - :mod:`robustness` — error-handling and library-internals hazards.
 - :mod:`observability` — counters written behind the metrics plane's
   back.
+- :mod:`serving` — decode-loop hot-path hazards (blocking transfers).
 """
 
 from . import (concurrency, jax_tracing, observability,  # noqa: F401
-               robustness)
+               robustness, serving)

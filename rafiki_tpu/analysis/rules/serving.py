@@ -1,0 +1,126 @@
+"""Serving hot-path rules.
+
+The decode engine's ``step()`` is the per-token hot loop: every
+generated token of every live stream goes through it, so one
+synchronous device→host transfer there stalls the WHOLE batch — not
+one request — and repeats per step. The host-KV-tier design keeps
+those transfers on a dedicated tier thread
+(``serving/kv_tier.py``); the prefetcher's async staging is the
+sanctioned idiom, and this rule exists so a future edit can't quietly
+reintroduce a blocking transfer into the loop.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Set
+
+from ..engine import Rule, register
+
+#: dotted call names that force a synchronous device→host transfer
+_DEVICE_GET = {"jax.device_get"}
+#: bare ``np.asarray(x)`` / ``np.array(x)`` spellings; with a second
+#: (dtype) argument the call is read as a host-side cast of host data
+#: — the d2h-sync idiom is the single-argument form on a device array
+_NP_PULLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+             "onp.asarray", "onp.array"}
+
+
+def _dotted(node: ast.AST) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _self_calls(fn: ast.FunctionDef) -> Set[str]:
+    """Names of ``self.<m>(...)`` methods the function calls."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == "self":
+            out.add(node.func.attr)
+    return out
+
+
+@register
+class BlockingTransferInDecodeLoopRule(Rule):
+    id = "blocking-transfer-in-decode-loop"
+    category = "serving"
+    severity = "error"
+    description = (
+        "synchronous device->host transfer (jax.device_get / "
+        ".block_until_ready() / bare np.asarray(device_array)) inside "
+        "a decode engine's step() loop: one blocked transfer stalls "
+        "every live stream's next token, every step — move it to the "
+        "host-tier transfer thread (the prefetcher's async staging is "
+        "the sanctioned idiom)")
+
+    def check(self, ctx):
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods: Dict[str, ast.FunctionDef] = {
+                n.name: n for n in cls.body
+                if isinstance(n, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef))}
+            if "step" not in methods or "submit" not in methods:
+                # only decode-engine-shaped classes have a step LOOP
+                # (continuous batching: submit feeds it, step drives
+                # it); a lone step() elsewhere is not a hot loop
+                continue
+            for name in self._reachable(methods):
+                yield from self._scan(methods[name], name)
+
+    @staticmethod
+    def _reachable(methods: Dict[str, ast.FunctionDef]
+                   ) -> Iterable[str]:
+        """Methods transitively reachable from ``step`` via
+        ``self.X()`` calls — the step loop's actual extent. Methods
+        only callable outside the loop (register_prefix, reset, poll)
+        are deliberately out of scope: blocking there costs one call,
+        not every token."""
+        seen = {"step"}
+        frontier = ["step"]
+        while frontier:
+            m = frontier.pop()
+            for callee in _self_calls(methods[m]):
+                if callee in methods and callee not in seen:
+                    seen.add(callee)
+                    frontier.append(callee)
+        return seen
+
+    def _scan(self, fn: ast.FunctionDef, name: str):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "block_until_ready":
+                yield node, (
+                    f".block_until_ready() in '{name}' (reachable "
+                    "from step()): blocks the decode loop on device "
+                    "completion — dispatch and move on, or hand the "
+                    "wait to the tier thread")
+                continue
+            dn = _dotted(node.func)
+            if dn in _DEVICE_GET or dn.endswith(".device_get"):
+                yield node, (
+                    f"{dn}() in '{name}' (reachable from step()): a "
+                    "synchronous device->host copy in the decode "
+                    "loop — queue it on the host-tier transfer "
+                    "thread instead")
+            elif dn in _NP_PULLS and len(node.args) == 1 \
+                    and not node.keywords:
+                yield node, (
+                    f"bare {dn}(x) in '{name}' (reachable from "
+                    "step()): if x is a device array this is a "
+                    "synchronous d2h pull stalling every live "
+                    "stream — use the tier thread (or, for host "
+                    "data, pass an explicit dtype to mark it a "
+                    "host-side cast)")

@@ -69,6 +69,15 @@ class ChaosConfig:
       ``bench_extra admin_recovery`` stage. SIGKILL on purpose: no
       graceful-shutdown path may run, exactly like an OOM-kill or a
       host reboot.
+    - ``delay_kv_transfer_s``: every KV page shipment push (prefill →
+      decode worker, disaggregated serving) sleeps this long first — a
+      slow interconnect / overloaded hub. The decode side must degrade
+      to a local re-prefill when its wait window expires, not hang the
+      stream.
+    - ``drop_kv_page_p``: each KV page shipment is dropped entirely
+      with this probability — a lost shipment. Same contract: the
+      decode worker's wait window expires and it re-prefills locally
+      (token-exact, just slower).
     - ``seed``: drives every probabilistic draw; same seed + same
       traffic order = same faults.
     """
@@ -78,6 +87,8 @@ class ChaosConfig:
     delay_queue_s: float = 0.0
     corrupt_payload_p: float = 0.0
     kill_admin_after_s: float = 0.0
+    delay_kv_transfer_s: float = 0.0
+    drop_kv_page_p: float = 0.0
     seed: int = 0
 
     @property
@@ -85,7 +96,9 @@ class ChaosConfig:
         return bool(self.kill_after_tokens > 0 or self.drop_reply_p > 0
                     or self.delay_queue_s > 0
                     or self.corrupt_payload_p > 0
-                    or self.kill_admin_after_s > 0)
+                    or self.kill_admin_after_s > 0
+                    or self.delay_kv_transfer_s > 0
+                    or self.drop_kv_page_p > 0)
 
     @classmethod
     def parse(cls, spec: str) -> "ChaosConfig":
@@ -154,7 +167,9 @@ class ChaosInjector:
         self.counters = StatsMap({"replies_dropped": 0,
                                   "payloads_corrupted": 0,
                                   "queue_delays": 0,
-                                  "kills": 0})
+                                  "kills": 0,
+                                  "kv_ships_dropped": 0,
+                                  "kv_ship_delays": 0})
 
     def should_kill(self, tokens_generated: int) -> bool:
         """True once the cumulative generated-token count crosses the
@@ -187,6 +202,20 @@ class ChaosInjector:
         if d > 0:
             self.counters.inc("queue_delays")
             time.sleep(d)
+
+    def mangle_kv_ship(self, data: bytes) -> Optional[bytes]:
+        """Apply the KV-shipment faults: None = shipment dropped (the
+        decode worker's wait window expires → local re-prefill);
+        otherwise the bytes to push, after any configured transfer
+        delay."""
+        if self.cfg.drop_kv_page_p > 0 and \
+                self._rng.random() < self.cfg.drop_kv_page_p:
+            self.counters.inc("kv_ships_dropped")
+            return None
+        if self.cfg.delay_kv_transfer_s > 0:
+            self.counters.inc("kv_ship_delays")
+            time.sleep(self.cfg.delay_kv_transfer_s)
+        return data
 
 
 class ChaosHub(QueueHub):
@@ -237,6 +266,25 @@ class ChaosHub(QueueHub):
 
     def get_pool_members(self, pool_id: str):
         return self.inner.get_pool_members(pool_id)
+
+    def push_kv(self, worker_id: str, data: bytes) -> None:
+        mangled = self.injector.mangle_kv_ship(data)
+        if mangled is None:
+            return  # the lost shipment being injected: the decode
+            #         side's wait window expires → local re-prefill
+        self.inner.push_kv(worker_id, mangled)
+
+    def pop_kv(self, worker_id: str, timeout: float):
+        return self.inner.pop_kv(worker_id, timeout)
+
+    def kv_depth(self, worker_id: str) -> int:
+        return self.inner.kv_depth(worker_id)
+
+    def put_blob(self, key: str, data: bytes) -> None:
+        self.inner.put_blob(key, data)
+
+    def get_blob(self, key: str):
+        return self.inner.get_blob(key)
 
 
 __all__ = ["CHAOS_ENV", "ChaosConfig", "ChaosHub", "ChaosInjector",

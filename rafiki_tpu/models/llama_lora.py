@@ -1449,7 +1449,8 @@ class LlamaLoRA(BaseModel):
                                       n_extra_adapters: int = 0,
                                       draft: Optional["LlamaLoRA"] = None,
                                       kv_page_size: int = 0,
-                                      kv_pages: int = 0
+                                      kv_pages: int = 0,
+                                      host_kv_pages: int = 0
                                       ) -> Dict[str, int]:
         """Per-device HBM budget for the continuous-batching decode
         engine — the serving twin of :func:`estimate_train_device_bytes`
@@ -1474,8 +1475,18 @@ class LlamaLoRA(BaseModel):
           draft-model speculation is configured.
         - ``working``: prefill-chunk activations + one (slots, vocab)
           f32 logits buffer — the decode scan's live set.
+        - ``host_kv_cache`` (``host_kv_pages > 0``): the pinned-host
+          page tier's bytes — HOST RAM, reported for sizing but
+          excluded from ``total`` (which stays the per-device HBM
+          figure admission compares against chip memory).
         """
         k = self.knobs
+        if int(host_kv_pages) and int(kv_page_size) <= 0:
+            # mirror the engine-build rule so admission never blesses
+            # a tier the engine constructor refuses
+            raise ValueError("host_kv_pages requires kv_page_size > 0 "
+                             "(pages are the host tier's transfer "
+                             "unit)")
         hd, heads = int(k["hidden_dim"]), int(k["n_heads"])
         kv_heads = max(1, heads // int(k["kv_ratio"]))
         dh = hd // heads
@@ -1547,6 +1558,16 @@ class LlamaLoRA(BaseModel):
                "adapters": adapters_dev, "draft": draft_dev,
                "working": working}
         out["total"] = sum(out.values())
+        if int(host_kv_pages):
+            # same per-position bytes as the device pool, host side —
+            # after the total so the HBM figure is unchanged
+            n_pos_host = int(host_kv_pages) * int(kv_page_size)
+            if bool(k.get("kv_cache_int8", False)):
+                out["host_kv_cache"] = n_pos_host * depth * 2 * (
+                    per_pos + 4 * kv_heads)
+            else:
+                out["host_kv_cache"] = (n_pos_host * depth * 2
+                                        * per_pos * act_bytes)
         return out
 
     def _serving_module_params(self, kv_page_size: int = 0,
@@ -2131,7 +2152,8 @@ class LlamaLoRA(BaseModel):
                            draft_model: Optional["LlamaLoRA"] = None,
                            kv_page_size: int = 0,
                            kv_pages: int = 0,
-                           paged_kernel: Optional[bool] = None):
+                           paged_kernel: Optional[bool] = None,
+                           host_kv_pages: int = 0):
         """Continuous-batching serving engine over this model's weights
         (BASELINE.md config #5). The inference worker drives it when
         running in decode-loop mode; see ``serving/decode_engine.py``.
@@ -2154,8 +2176,19 @@ class LlamaLoRA(BaseModel):
         ``paged_kernel`` (paged engines only): ``None`` (auto, the
         default) decodes through the Pallas block-table kernel on TPU
         and the page gather off-TPU; ``True``/``False`` force one
-        path (see ``ops/paged_attention.py``)."""
+        path (see ``ops/paged_attention.py``).
+
+        ``host_kv_pages > 0`` (paged engines only) attaches the
+        host-RAM page tier: the admission budget becomes HBM + host
+        pages, cold pages spill to pinned host memory and prefetch
+        back ahead of the step that resumes them — serviceable
+        concurrency stops being hard-capped by HBM (see
+        ``serving/kv_tier.py`` and docs/operations.md)."""
         assert self._params is not None, "model is not trained/loaded"
+        if host_kv_pages and kv_page_size <= 0:
+            raise ValueError("host_kv_pages requires kv_page_size > 0 "
+                             "(pages are the host tier's transfer "
+                             "unit)")
         if kv_page_size > 0 and not kv_pages:
             kv_pages = _default_kv_pages(max_slots,
                                          int(self.knobs["max_len"]),
@@ -2165,14 +2198,16 @@ class LlamaLoRA(BaseModel):
             paged_kernel=paged_kernel if kv_page_size > 0 else None)
         text_engine = self._build_text_engine(
             module, params, max_slots, max_new_tokens, steps_per_sync,
-            prefill_chunk, speculate_k, draft_model=draft_model)
+            prefill_chunk, speculate_k, draft_model=draft_model,
+            host_kv_pages=host_kv_pages)
         if system_prefix:
             text_engine.register_prefix(system_prefix)
         return text_engine
 
     def _build_text_engine(self, module, params, max_slots,
                            max_new_tokens, steps_per_sync, prefill_chunk,
-                           speculate_k, draft_model=None):
+                           speculate_k, draft_model=None,
+                           host_kv_pages=0):
         """Common engine wiring for the single- and multi-adapter
         flavors: this model's tokenizer around a DecodeEngine."""
         from rafiki_tpu.serving.decode_engine import (DecodeEngine,
@@ -2225,7 +2260,8 @@ class LlamaLoRA(BaseModel):
                             max_slots=max_slots, max_len=max_len,
                             steps_per_sync=steps_per_sync,
                             prefill_chunk=prefill_chunk,
-                            speculate_k=speculate_k, draft=draft)
+                            speculate_k=speculate_k, draft=draft,
+                            host_kv_pages=int(host_kv_pages))
         return TextDecodeEngine(
             core, encode, self._detok,
             max_new=min(max_new_tokens, max_len - 1))
@@ -2239,7 +2275,8 @@ class LlamaLoRA(BaseModel):
                                   validate: bool = True,
                                   kv_page_size: int = 0,
                                   kv_pages: int = 0,
-                                  paged_kernel: Optional[bool] = None):
+                                  paged_kernel: Optional[bool] = None,
+                                  host_kv_pages: int = 0):
         """ONE continuous-batching engine serving N adapter-only
         fine-tunes of one base (S-LoRA-style multi-adapter serving).
 
@@ -2265,6 +2302,10 @@ class LlamaLoRA(BaseModel):
         trees = list(adapter_params)
         if not trees:
             raise ValueError("adapter_params must name >= 1 trees")
+        if host_kv_pages and kv_page_size <= 0:
+            raise ValueError("host_kv_pages requires kv_page_size > 0 "
+                             "(pages are the host tier's transfer "
+                             "unit)")
         stacked = stack_lora_adapters(trees, validate=validate)
         quantized = bool(self.knobs.get("quantize_int8"))
         if quantized:
@@ -2282,7 +2323,7 @@ class LlamaLoRA(BaseModel):
                                             else None))
         return self._build_text_engine(
             module, stacked, max_slots, max_new_tokens, steps_per_sync,
-            prefill_chunk, speculate_k)
+            prefill_chunk, speculate_k, host_kv_pages=host_kv_pages)
 
     def dump_parameters(self) -> Dict[str, Any]:
         assert self._params is not None, "model is not trained"

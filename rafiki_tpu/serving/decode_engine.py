@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -59,8 +60,11 @@ import numpy as np
 
 from ..obs.metrics import StatsMap
 from ..ops.paged_attention import resolve_paged_kernel
+from .kv_tier import HostPageTier
+from .kv_transfer import (LAYOUT_PAGED, LAYOUT_ROWS, check_kv_blob,
+                          leaf_signature, make_kv_blob)
 from .slo import (DEFAULT_SLO, ClassQueue, evictable_occupants,
-                  normalize_slo, preemption_victim)
+                  normalize_slo, preemption_victim, slo_priority)
 
 # Speculation break-even (tokens per verify call) and how many scan
 # calls to wait before re-probing a gated-off speculator. ~1.5 means a
@@ -110,6 +114,43 @@ class _Slot:
     #: the next interactive arrival would starve exactly the way
     #: aging exists to prevent
     shielded: bool = False
+    #: disaggregated serving (prefill role): stop after chunked
+    #: prefill and surface the slot's KV pages via ``poll_kv`` instead
+    #: of generating — the shipment a decode-role worker installs
+    prefill_only: bool = False
+    #: disaggregated serving (decode role): a validated KV blob whose
+    #: rows are installed at seat time, fast-forwarding the slot past
+    #: the prefill the shipping worker already did
+    kv_import: Optional[Dict[str, Any]] = None
+
+
+class _Parked:
+    """A slot suspended to the host KV tier: its lane is free, its
+    pages live wherever the allocator put them (per logical page:
+    still-resident HBM pool page, or a host-tier page), and every host
+    mirror needed to reseat it rides along. Parking loses NO progress —
+    unlike SLO preemption there is no re-prefill on resume; the
+    restored pages ARE the KV the slot had."""
+
+    __slots__ = ("slot", "pos", "tok", "stop_pos", "n_res", "pages",
+                 "park_seq")
+
+    def __init__(self, slot: _Slot, pos: int, tok: int, stop_pos: int,
+                 n_res: int, pages: List[Tuple[str, int]],
+                 park_seq: int) -> None:
+        self.slot = slot
+        self.pos = pos
+        self.tok = tok
+        self.stop_pos = stop_pos
+        self.n_res = n_res
+        self.pages = pages      # [("hbm", pool_page) | ("host", hp)]
+        self.park_seq = park_seq
+
+    def host_ids(self) -> List[int]:
+        return [p for loc, p in self.pages if loc == "host"]
+
+    def hbm_ids(self) -> List[int]:
+        return [p for loc, p in self.pages if loc == "hbm"]
 
 
 class DecodeEngine:
@@ -129,7 +170,9 @@ class DecodeEngine:
     def __init__(self, module: Any, params: Any, max_slots: int,
                  max_len: int, steps_per_sync: int = 4,
                  prefill_chunk: int = 32, speculate_k: int = 0,
-                 draft: Optional[Tuple[Any, Any]] = None) -> None:
+                 draft: Optional[Tuple[Any, Any]] = None,
+                 host_kv_pages: int = 0,
+                 prefill_token_cost_s: float = 0.0) -> None:
         self.module = module
         self.params = params
         self.B = int(max_slots)
@@ -162,6 +205,15 @@ class DecodeEngine:
         #: turns B (1, d)-matvec steps into (C, d) matmuls the MXU can
         #: tile, and pays 1/C as many dispatches for prompt ingestion.
         self.C = max(1, min(int(prefill_chunk), self.L))
+        #: modeled prompt-compute floor (seconds per prompt token),
+        #: slept on the loop thread after each prefill chunk. For
+        #: benches/tests on hosts where the model under test is so
+        #: small that prompt ingestion is ~free (tiny-model cpu
+        #: fallback): production prompt forwards cost real wall time,
+        #: and the prefill/decode interleave this engine schedules is
+        #: invisible without it. 0 (the default) costs nothing.
+        self.prefill_token_cost_s = max(0.0,
+                                        float(prefill_token_cost_s))
         self._slots: List[Optional[_Slot]] = [None] * self.B
         #: class-aware admission queue (interactive > batch >
         #: background, FIFO within class, aging so background never
@@ -210,12 +262,29 @@ class DecodeEngine:
             self._free_pages = list(range(self.n_pages - 1, 0, -1))
             self._n_alloc = np.zeros((self.B,), np.int32)
             #: worst-case pages reserved per slot at admission — the
-            #: invariant sum(_n_res) <= n_pages - 1 is what makes lazy
+            #: invariant sum(_n_res) <= budget (HBM usable pages, plus
+            #: the host tier when one is attached) is what makes lazy
             #: allocation infallible and queue waits deadlock-free
             self._n_res = np.zeros((self.B,), np.int32)
             self._res_total = 0
         else:
             self._n_table = 1  # dummy operand keeps signatures uniform
+        #: host-RAM KV page tier (``host_kv_pages > 0``, paged engines
+        #: only): the admission budget becomes HBM + host pages. Cold
+        #: pages — whole slots parked to make room for hotter work —
+        #: evict to a pinned-host pool asynchronously and prefetch
+        #: back ahead of the step that resumes them, so serviceable
+        #: concurrency stops being hard-capped by HBM while the
+        #: compiled step only ever touches HBM-resident pages.
+        self.host_pages = int(host_kv_pages)
+        if self.host_pages and not self.paged:
+            raise ValueError("host_kv_pages requires a paged engine "
+                             "(kv_page_size > 0): pages are the "
+                             "tier's transfer unit")
+        self.tier: Optional[HostPageTier] = None
+        #: parked slots by a monotonic park key, insertion-ordered
+        self._parked: Dict[int, _Parked] = {}
+        self._park_seq = 0
         #: is the paged-native Pallas decode kernel live on this engine
         #: (module flag resolved against the backend — the ops-level
         #: dispatch rule)? Surfaced as the ``paged_kernel_active``
@@ -239,6 +308,16 @@ class DecodeEngine:
                           True: _make_step(module, self.B, self.K, True)}
         self._prefill_fn = (_make_prefill(module, self.B, self.C)
                             if self.C > 1 else None)
+        #: narrow twin of the prefill program for short remainders: a
+        #: 1-token admission walk must not pay a C-wide (B, C) matmul
+        #: — at C=32 that call costs about one fused decode step, so
+        #: every short-prompt admission used to stall all live streams
+        #: by a step. Walks ≤ this width run the narrow program.
+        self._small_c = 4
+        self._prefill_fn_small = (
+            _make_prefill(module, self.B, self._small_c)
+            if self._prefill_fn is not None and self.C > self._small_c
+            else None)
         self._verify_fn = (_make_verify(module, self.B, self.spec_k)
                            if self.spec_k else None)
         #: draft-MODEL speculation (``draft=(module, params)``, a
@@ -320,9 +399,26 @@ class DecodeEngine:
             "preemptions": 0, "slo_aged_promotions": 0,
             "queued_interactive": 0, "queued_batch": 0,
             "queued_background": 0,
+            # host-RAM KV tier (all 0 on untiered engines): host pool
+            # occupancy, pages evicted to host over the engine's life,
+            # prefetch effectiveness (a miss = the unpark had to pull
+            # pages inline), raw bytes moved in both directions, and
+            # live suspended-slot counts
+            "kv_host_pages_used": 0,
+            "kv_host_pages_total": self.host_pages,
+            "kv_evictions_total": 0, "kv_prefetch_hits": 0,
+            "kv_prefetch_misses": 0, "kv_transfer_bytes_total": 0,
+            "kv_parked_slots": 0, "kv_unparks_total": 0,
+            # disaggregated prefill/decode: KV page shipments produced
+            # (prefill role) and installed (decode role) by this engine
+            "kv_exports": 0, "kv_imports": 0,
             # 1 while the Pallas block-table decode kernel serves this
             # engine's single-token steps (0 = page gather / contiguous)
             "paged_kernel_active": int(self.paged_kernel_active)})
+        if self.host_pages:
+            self.tier = HostPageTier(self.host_pages, self.stats)
+        #: finished prefill-only shipments awaiting poll_kv
+        self._done_kv: List[Tuple[Any, Dict[str, Any]]] = []
         #: optional request-lifecycle hook ``(event, request_id, attrs)``
         #: — the inference worker wires it into its trace buffer and
         #: latency histograms (TTFT, time-in-queue). Events: admitted,
@@ -337,7 +433,9 @@ class DecodeEngine:
                max_new: int, temperature: float = 0.0, top_k: int = 0,
                top_p: float = 1.0, seed: int = 0,
                eos_id: Optional[int] = None,
-               adapter_id: int = 0, slo: str = "") -> None:
+               adapter_id: int = 0, slo: str = "",
+               prefill_only: bool = False,
+               kv_import: Optional[Dict[str, Any]] = None) -> None:
         """Queue a request. ``prompt_ids``: 1-D valid tokens (≥1); the
         prompt + generation must fit the cache (truncated to fit).
 
@@ -372,12 +470,36 @@ class DecodeEngine:
         prompt = prompt[:max(1, self.L - max_new)]
         aid = self._check_adapter_id(adapter_id)
         cls = normalize_slo(slo)
+        if kv_import is not None:
+            # validated HERE (caller thread) so a bad shipment is a
+            # structured refusal the worker can degrade on — never a
+            # shape error escaping from the step thread mid-install
+            flat = jax.tree_util.tree_leaves(self._cache)
+            cov = int(kv_import.get("covered", 0) or 0) \
+                if isinstance(kv_import, dict) else 0
+            if self.paged:
+                sig = [[list(c.shape[1:]), str(c.dtype)] for c in flat]
+                lead = ((cov - 1) // self.page_size + 1) if cov else 0
+            else:  # rows layout: leaves are (covered, heads, dh)
+                sig = [[list(c.shape[2:]), str(c.dtype)] for c in flat]
+                lead = cov
+            kv_import = check_kv_blob(
+                kv_import,
+                layout=LAYOUT_PAGED if self.paged else LAYOUT_ROWS,
+                page_size=self.page_size, expect_sig=sig,
+                expect_leading=lead,
+                prompt_len=len(prompt), adapter_id=aid)
         if self.paged:
-            # a request whose worst case exceeds the whole pool could
-            # NEVER admit — it would stall the FIFO queue forever.
-            # Refuse loudly here; everything smaller waits its turn.
-            need = self._pages_for(min(len(prompt) - 1 + max_new,
-                                       self.L))
+            # a request whose worst case exceeds what can ever be
+            # HBM-RESIDENT could never take a step — it would stall
+            # the queue forever. Refuse loudly here; everything
+            # smaller waits its turn (with a host tier the admission
+            # BUDGET is larger, but residency is still HBM-bound).
+            # Prefill-only work stops at the last prompt token, so its
+            # worst case is the prompt walk alone.
+            need = self._pages_for(
+                max(1, len(prompt) - 1) if prefill_only
+                else min(len(prompt) - 1 + max_new, self.L))
             if need > self.n_pages - 1:
                 raise ValueError(
                     f"request needs {need} KV pages worst-case but the "
@@ -390,7 +512,9 @@ class DecodeEngine:
                 temperature=float(temperature), top_k=int(top_k),
                 top_p=float(top_p), seed=int(seed),
                 eos_id=None if eos_id is None else int(eos_id),
-                adapter_id=aid, slo=cls, seq=self._seq))
+                adapter_id=aid, slo=cls, seq=self._seq,
+                prefill_only=bool(prefill_only),
+                kv_import=kv_import))
 
     def _check_adapter_id(self, adapter_id: int) -> int:
         """Validate a request's adapter selection. Out-of-range ids
@@ -418,16 +542,35 @@ class DecodeEngine:
                 self.L - 1)
         return h // self.page_size + 1
 
-    def _ensure_pages_to(self, i: int, last_pos: int) -> None:
+    @property
+    def _budget_pages(self) -> int:
+        """The two-tier admission budget: HBM usable pages plus the
+        host tier. Reservations are granted against THIS total — the
+        allocator invariant becomes sum(reservations) <= budget, and
+        HBM shortfalls are resolved by evicting cold pages to host
+        (:meth:`_reclaim_one_hbm_page`), which the invariant proves is
+        always possible while any within-reservation growth is
+        pending."""
+        return self.n_pages - 1 + self.host_pages
+
+    def _ensure_pages_to(self, i: int, last_pos: int,
+                         have_lock: bool = False) -> None:
         """Allocate slot ``i``'s logical pages covering positions
         [0, last_pos] — called just before every compiled call with
         that call's write horizon (this is the LAZY part: a slot holds
-        pages for where it is, not for max_len)."""
+        pages for where it is, not for max_len). With a host tier an
+        empty free list is not failure: cold pages (parked slots
+        first, then a freshly-parked victim's) evict to host until the
+        growth fits — infallible by the combined-budget reservation
+        invariant."""
         need = last_pos // self.page_size + 1
         grew = need > int(self._n_alloc[i])
         while int(self._n_alloc[i]) < need:
-            # infallible by the reservation invariant (never more than
-            # _n_res[i] <= free-at-admission pages per slot)
+            if not self._free_pages:
+                # only reachable on tiered engines (the untiered
+                # invariant keeps the free list ahead of reservations)
+                self._reclaim_one_hbm_page(protect=i,
+                                           have_lock=have_lock)
             self._ptab[i, int(self._n_alloc[i])] = self._free_pages.pop()
             self._n_alloc[i] += 1
         if grew:
@@ -436,6 +579,260 @@ class DecodeEngine:
             self.stats.set("kv_pages_used", used)
             self.stats.max_set("kv_pages_high_water", used)
             self.stats.set("kv_pages_total", self.n_pages - 1)
+
+    # ---- host-tier mechanics (step thread; the tier's transfer
+    # ---- thread only ever touches its own pool/staging state) ----
+    def _reclaim_one_hbm_page(self, protect: int,
+                              have_lock: bool = False) -> None:
+        """Free at least one HBM pool page by evicting a cold page to
+        the host tier: parked slots' still-resident pages first
+        (coldest — nothing is stepping them), else park a live victim
+        (never ``protect``) and evict from it. Raises only on an
+        allocator-invariant breach (a bug, not an operating state)."""
+        if self.tier is None:
+            raise RuntimeError(
+                "paged-KV allocator invariant breached: free list "
+                "empty inside reservation and no host tier to spill "
+                "to")
+        if self._evict_parked_pages(limit=1, exclude_key=None):
+            return
+        j = self._park_victim(protect)
+        if j is None:
+            raise RuntimeError(
+                "paged-KV allocator invariant breached: no free page, "
+                "no parked cold page, and no parkable victim")
+        self._park_slot(j, have_lock=have_lock)
+        if not self._evict_parked_pages(limit=1, exclude_key=None):
+            raise RuntimeError(
+                "paged-KV allocator invariant breached: host tier "
+                "full while within-reservation growth is pending")
+
+    def _evict_parked_pages(self, limit: int,
+                            exclude_key: Optional[int]) -> int:
+        """Move up to ``limit`` HBM-resident pages of parked slots to
+        the host tier (freeing their pool pages), taking from the
+        LOWEST-priority / youngest parked slot first — the work least
+        likely to resume next. Returns pages moved. The d2h copy runs
+        on the tier thread; the freed pool pages are safe to reuse
+        immediately (the gather dispatched here orders before any
+        later donated step's writes)."""
+        moved = 0
+        order = sorted(
+            (k for k in self._parked if k != exclude_key),
+            key=lambda k: (slo_priority(self._parked[k].slot.slo),
+                           self._parked[k].slot.seq),
+            reverse=True)
+        for k in order:
+            if moved >= limit:
+                break
+            rec = self._parked[k]
+            hbm = [(t, p) for t, (loc, p) in enumerate(rec.pages)
+                   if loc == "hbm"]
+            if not hbm:
+                continue
+            take = hbm[-(limit - moved):]  # tail pages: evict the
+            #                                farthest-ahead KV first so
+            #                                partial restores refill in
+            #                                logical order
+            host_ids = self.tier.alloc(len(take))
+            if host_ids is None:
+                if self.tier.free_pages() == 0:
+                    break
+                host_ids = self.tier.alloc(self.tier.free_pages())
+                take = take[-len(host_ids):]
+            pool_ids = [p for _t, p in take]
+            idx = jnp.asarray(pool_ids, jnp.int32)
+            leaves = [c[idx] for c in
+                      jax.tree_util.tree_leaves(self._cache)]
+            self.tier.evict_submit(host_ids, leaves)
+            self.tier.drop_staged(k)  # staging for the old id set is
+            #                           stale now; the prefetcher will
+            #                           re-stage the grown set
+            for (t, _p), h in zip(take, host_ids):
+                rec.pages[t] = ("host", int(h))
+            self._free_pages.extend(pool_ids)
+            self._ptab_dirty = True
+            moved += len(take)
+        if moved:
+            self.stats.set("kv_pages_used",
+                           self.n_pages - 1 - len(self._free_pages))
+        return moved
+
+    def _park_victim(self, protect: int) -> Optional[int]:
+        """The live slot to suspend when HBM must shrink: lowest
+        class, youngest — mirroring the preemption order, but parking
+        is allowed across classes and shields because NO progress is
+        lost (the slot resumes from its exact KV, no re-prefill)."""
+        cands = [j for j in range(self.B)
+                 if j != protect and self._slots[j] is not None]
+        if not cands:
+            return None
+        return max(cands, key=lambda j: (
+            slo_priority(self._slots[j].slo), self._slots[j].seq))
+
+    def _park_slot(self, j: int, have_lock: bool = False) -> None:
+        """Suspend live slot ``j`` to the parked set: lane freed, host
+        mirrors captured, pages kept (initially all HBM-resident —
+        eviction moves them to host on demand). The reservation stays
+        counted (the slot is still admitted work)."""
+        slot = self._slots[j]
+        n = int(self._n_alloc[j])
+        self._park_seq += 1
+        rec = _Parked(slot, pos=int(self._pos[j]),
+                      tok=int(self._tok[j]),
+                      stop_pos=int(self._stop_pos[j]),
+                      n_res=int(self._n_res[j]),
+                      pages=[("hbm", int(self._ptab[j, t]))
+                             for t in range(n)],
+                      park_seq=self._park_seq)
+        self._slots[j] = None
+        self._tok[j] = 0
+        self._pos[j] = 0
+        self._prompt_len[j] = 1
+        self._stop_pos[j] = 0
+        self._ptab[j, :] = 0
+        self._n_alloc[j] = 0
+        self._ptab_dirty = True
+        if have_lock:
+            self._n_res[j] = 0
+        else:
+            with self._lock:
+                self._n_res[j] = 0
+        self._parked[rec.park_seq] = rec
+        if self._draft_cache is not None:
+            # the draft cache's lane no longer mirrors this slot; the
+            # next speculative re-probe rebuilds from accepted contexts
+            self._draft_synced = False
+        self.stats.set("kv_parked_slots", len(self._parked))
+        self._span("parked", slot.request_id, slot=j,
+                   pages=len(rec.pages))
+
+    def _unpark_order(self) -> List[int]:
+        """Resume order: highest class first, then oldest arrival —
+        the inverse of the eviction order, so fill and evict work
+        opposite ends of the parked set and the interleaved
+        page-by-page exchange always converges."""
+        return sorted(self._parked,
+                      key=lambda k: (slo_priority(
+                          self._parked[k].slot.slo),
+                          self._parked[k].slot.seq))
+
+    def _try_unpark(self) -> List[Tuple[int, _Parked, List[int],
+                                        List[int], Any]]:
+        """Admission-phase resume pass (lock held): restore parked
+        slots' host pages into freshly-allocated HBM pages as capacity
+        allows, and seat fully-resident parked slots into free lanes.
+        Returns ``(install work, slots seated)`` — the installs are
+        ``(lane, rec, pool_ids, host_ids, staged)`` tuples the caller
+        scatters IMMEDIATELY, still under the lock: a later seat in
+        the same admission pass may reclaim these very pages back to
+        host, and a deferred install would let that eviction capture
+        pre-install garbage (a silently-wrong resume)."""
+        installs: List[Tuple[int, _Parked, List[int], List[int], Any]] \
+            = []
+        seated = 0
+        for k in self._unpark_order():
+            rec = self._parked[k]
+            host = [(t, p) for t, (loc, p) in enumerate(rec.pages)
+                    if loc == "host"]
+            if host:
+                fill = min(len(self._free_pages), len(host))
+                if fill < len(host):
+                    # not fully restorable yet: pull what fits (head
+                    # pages first — logical order) and try again next
+                    # step; evicting OTHER parked slots' pages to make
+                    # room happens on demand in _reclaim_one_hbm_page
+                    if fill == 0:
+                        continue
+                    host = host[:fill]
+                pool_ids = [self._free_pages.pop() for _ in host]
+                host_ids = [p for _t, p in host]
+                staged = None
+                if self.tier is not None and fill == len(
+                        rec.host_ids()):
+                    staged = self.tier.take_staged(k, host_ids)
+                for (t, _p), pid in zip(host, pool_ids):
+                    rec.pages[t] = ("hbm", int(pid))
+                installs.append((-1, rec, pool_ids, host_ids, staged))
+                self.stats.set(
+                    "kv_pages_used",
+                    self.n_pages - 1 - len(self._free_pages))
+            if rec.host_ids():
+                continue  # still partially host-resident
+            i = next((j for j in range(self.B)
+                      if self._slots[j] is None), None)
+            if i is None:
+                continue  # fully resident, waiting for a lane
+            self._seat_parked(i, k, rec)
+            seated += 1
+        return installs, seated
+
+    def _seat_parked(self, i: int, key: int, rec: _Parked) -> None:
+        """Reseat a fully-HBM-resident parked slot into lane ``i``
+        (lock held): mirrors restored, page table rebuilt, reservation
+        moved back onto the lane. No re-prefill — the pages are the
+        KV it had."""
+        slot = rec.slot
+        self._slots[i] = slot
+        self._tok[i] = rec.tok
+        self._pos[i] = rec.pos
+        self._prompt_buf[i, :] = 0
+        self._prompt_buf[i, :len(slot.prompt)] = slot.prompt
+        self._prompt_len[i] = len(slot.prompt)
+        self._stop_pos[i] = rec.stop_pos
+        self._temp[i] = slot.temperature
+        self._topk[i] = slot.top_k
+        self._topp[i] = slot.top_p
+        self._seed[i] = np.int32(slot.seed & 0x7FFFFFFF)
+        self._aid[i] = slot.adapter_id
+        for t, (loc, p) in enumerate(rec.pages):
+            assert loc == "hbm"
+            self._ptab[i, t] = p
+        self._n_alloc[i] = len(rec.pages)
+        self._n_res[i] = rec.n_res
+        self._ptab_dirty = True
+        del self._parked[key]
+        if self.tier is not None:
+            self.tier.drop_staged(key)
+        self.stats.set("kv_parked_slots", len(self._parked))
+        self.stats.inc("kv_unparks_total")
+
+    def _apply_unpark_installs(self, installs) -> None:
+        """Scatter restored pages' content into the cache (outside the
+        engine lock, before any compiled call). Prefetch hits consume
+        device arrays the tier thread staged; misses pull the host
+        copies and upload inline (host→device — the direction that
+        does not stall the device pipeline)."""
+        for _lane, rec, pool_ids, host_ids, staged in installs:
+            if staged is None:
+                self.stats.inc("kv_prefetch_misses")
+                leaves = self.tier.fetch(host_ids)
+                staged = [jnp.asarray(a) for a in leaves]
+                self.stats.inc("kv_transfer_bytes_total",
+                               int(sum(a.nbytes for a in leaves)))
+            else:
+                self.stats.inc("kv_prefetch_hits")
+            idx = jnp.asarray(pool_ids, jnp.int32)
+            flat, treedef = jax.tree_util.tree_flatten(self._cache)
+            flat = [c.at[idx].set(v.astype(c.dtype))
+                    for c, v in zip(flat, staged)]
+            self._cache = jax.tree_util.tree_unflatten(treedef, flat)
+            self.tier.free(host_ids)
+            self._span("unparked", rec.slot.request_id,
+                       pages=len(pool_ids))
+
+    def _prefetch_hint(self) -> None:
+        """Tell the tier thread which parked slot resumes next so its
+        host pages are staged as device arrays before the unpark needs
+        them — the async path that keeps the compiled step from ever
+        blocking on a transfer."""
+        if self.tier is None or not self._parked:
+            return
+        for k in self._unpark_order():
+            ids = self._parked[k].host_ids()
+            if ids:
+                self.tier.prefetch_submit(k, ids)
+                return
 
     def _release_slot_pages(self, i: int, have_lock: bool = False
                             ) -> None:
@@ -522,6 +919,110 @@ class DecodeEngine:
                 slot.n_streamed = total
         return out
 
+    def poll_kv(self) -> List[Tuple[Any, Dict[str, Any]]]:
+        """Completed prefill-only shipments since the last call:
+        ``(request_id, KV blob)`` pairs ready to ride the hub to a
+        decode-role engine's ``submit(..., kv_import=blob)``."""
+        with self._lock:
+            done, self._done_kv = self._done_kv, []
+        return done
+
+    def _harvest_prefill_only(self) -> None:
+        """Complete prefill-only slots whose prompt walk reached its
+        last token: extract the KV shipment, free the lane and pages.
+        Runs after chunked prefill and costs one attribute scan when
+        no prefill-role traffic exists."""
+        shipped: List[Tuple[Any, Dict[str, Any]]] = []
+        for i in range(self.B):
+            s = self._slots[i]
+            if s is None or not s.prefill_only:
+                continue
+            if int(self._pos[i]) >= len(s.prompt) - 1:
+                shipped.append((s.request_id,
+                                self._extract_slot_kv(i)))
+                self._slots[i] = None
+                self._tok[i] = 0
+                self._pos[i] = 0
+                self._prompt_len[i] = 1
+                self._stop_pos[i] = 0
+                if self.paged:
+                    self._release_slot_pages(i)
+        if shipped:
+            with self._lock:
+                self._done_kv.extend(shipped)
+                self.stats.inc("requests_done", len(shipped))
+            for rid, blob in shipped:
+                self._span("prefilled", rid, covered=blob["covered"])
+
+    def _extract_slot_kv(self, i: int) -> Dict[str, Any]:
+        """Slot ``i``'s prefilled KV as a wire blob: the pages (paged)
+        or rows (contiguous) covering positions ``0..pos-1``, every
+        cache leaf uniformly (int8 pools and scale rows included)."""
+        s = self._slots[i]
+        covered = max(0, min(int(self._pos[i]), len(s.prompt) - 1))
+        flat = jax.tree_util.tree_leaves(self._cache)
+        leaves: List[np.ndarray] = []
+        if covered:
+            if self.paged:
+                n = (covered - 1) // self.page_size + 1
+                idx = jnp.asarray(self._ptab[i, :n], jnp.int32)
+                dev = [c[idx] for c in flat]
+            else:
+                dev = [c[i, :covered] for c in flat]
+            # the one sanctioned d2h sync outside the tier thread:
+            # this is the prefill ROLE's shipment materialization —
+            # by construction not the decode hot loop (prefill-only
+            # slots never generate). One batched fetch for every
+            # leaf, not a per-leaf round-trip.
+            leaves = list(jax.device_get(dev))  # rafiki: noqa[blocking-transfer-in-decode-loop] — shipment materialization on the prefill leg, not the decode hot loop
+        self.stats.inc("kv_exports")
+        return make_kv_blob(
+            covered, LAYOUT_PAGED if self.paged else LAYOUT_ROWS,
+            self.page_size, leaves, adapter_id=s.adapter_id)
+
+    def stage_kv_blob(self, blob: Dict[str, Any]) -> Dict[str, Any]:
+        """Upload a shipment's leaves to device AHEAD of admission
+        (call when the blob arrives off the wire, any thread). The
+        h2d copies dispatch asynchronously and overlap whatever step
+        is in flight, so the seat-time install pays one scatter
+        dispatch instead of staging + scatter. Best-effort: on any
+        failure the original host blob installs fine, just later."""
+        try:
+            staged = dict(blob)
+            staged["leaves"] = [jnp.asarray(a)
+                                for a in blob["leaves"]]
+            return staged
+        except Exception:  # noqa: BLE001 — staging is an overlap
+            # optimization, never a correctness gate: the host blob
+            # installs fine at seat time, just without the overlap
+            import logging
+
+            logging.getLogger(__name__).debug(
+                "kv blob staging failed; installing from host",
+                exc_info=True)
+            return blob
+
+    def _install_kv(self, i: int, blob: Dict[str, Any]) -> None:
+        """Scatter a shipped blob's rows into slot ``i``'s pages/rows
+        (validated at submit; pages allocated at seat). Upload
+        direction only, through the donated installer — in-place on
+        the cache buffers, O(shipped pages) device work; an eager
+        ``at[].set`` here would copy the ENTIRE page pool per leaf on
+        every install, a whole-HBM tax per arriving shipment."""
+        cov = int(blob["covered"])
+        staged = [jnp.asarray(a) for a in blob["leaves"]]
+        flat, treedef = jax.tree_util.tree_flatten(self._cache)
+        if self.paged:
+            n = (cov - 1) // self.page_size + 1
+            idx = jnp.asarray(self._ptab[i, :n], jnp.int32)
+            flat = _install_pages(flat, idx, staged)
+        else:
+            flat = _install_rows(flat, jnp.int32(i), staged)
+        self._cache = jax.tree_util.tree_unflatten(treedef, flat)
+        self.stats.inc("kv_imports")
+        self.stats.inc("kv_transfer_bytes_total",
+                       int(blob.get("nbytes", 0) or 0))
+
     def register_prefix(self, prefix_ids: np.ndarray,
                         adapter_id: int = 0) -> int:
         """Precompute the KV cache of a shared prompt prefix (system
@@ -568,7 +1069,15 @@ class DecodeEngine:
         # max_len but install() reads [:plen] — trimming cuts the
         # per-adapter resident HBM by max_len/plen
         snap = jax.tree_util.tree_map(lambda p: p[:, :plen], snap)
-        entry = {"ids": prefix, "cache": jax.block_until_ready(snap),
+        snap = jax.block_until_ready(snap)
+        if self.tier is not None:
+            # host-tier engines keep the snapshot store in HOST memory
+            # (numpy leaves): zero resident HBM while idle, uploaded
+            # per install (jit device-puts host operands) — the same
+            # capacity trade the page tier makes, and the form the
+            # export/import shipment rides
+            snap = jax.tree_util.tree_map(np.asarray, snap)
+        entry = {"ids": prefix, "cache": snap,
                  "len": plen, "install": install, "aid": aid}
         if self._draft_cache is not None:
             # the draft attends the same positions: without its own
@@ -602,7 +1111,8 @@ class DecodeEngine:
             inst = _make_paged_prefix_install(pre["len"], self.page_size)
             self._cache = inst(
                 self._cache, pre["cache"],
-                jnp.asarray(self._ptab[np.asarray(rows)], jnp.int32))
+                jnp.asarray(self._ptab[np.asarray(rows, np.int64)],
+                            jnp.int32))
         else:
             self._cache = pre["install"](self._cache, pre["cache"], rws)
         if self._draft_cache is not None and "draft_cache" in pre:
@@ -611,21 +1121,86 @@ class DecodeEngine:
         self.stats.inc("prefix_hits", len(rows))
         self.stats.inc("prefix_tokens", pre["len"] * len(rows))
 
+    def export_prefix(self, adapter_id: int = 0
+                      ) -> Optional[Dict[str, Any]]:
+        """The registered prefix snapshot as a wire blob (msgpack-able
+        numpy leaves): a shared prefix prefilled ONCE can serve every
+        replica of a job — peers install it via
+        :meth:`import_prefix` instead of re-running the prefill
+        forward. None when no prefix is registered for the adapter."""
+        pre = self._prefixes.get(self._check_adapter_id(adapter_id))
+        if pre is None:
+            return None
+        leaves = [np.asarray(a) for a in
+                  jax.tree_util.tree_leaves(pre["cache"])]
+        return {"v": 1, "ids": np.asarray(pre["ids"], np.int32),
+                "len": int(pre["len"]), "adapter_id": int(pre["aid"]),
+                "sig": leaf_signature(leaves), "leaves": leaves,
+                "nbytes": int(sum(a.nbytes for a in leaves))}
+
+    def import_prefix(self, blob: Dict[str, Any],
+                      adapter_id: int = 0) -> int:
+        """Install a peer's exported prefix snapshot (see
+        :meth:`export_prefix`) without recomputing its prefill.
+        Validates geometry before touching state; raises
+        ``ValueError`` on any mismatch. Draft-model engines fall back
+        to undrafted prefix rows (still lossless — acceptance just
+        starts cold until generation warms the draft cache). Returns
+        the installed length. Same concurrency contract as
+        :meth:`register_prefix` (not concurrent with ``step``)."""
+        aid = self._check_adapter_id(adapter_id)
+        if not isinstance(blob, dict) or int(blob.get("v", -1)) != 1:
+            raise ValueError("not a prefix snapshot blob")
+        ids = np.asarray(blob.get("ids"), np.int32).ravel()
+        plen = int(blob.get("len", -1))
+        if plen != len(ids) or not 0 < plen <= self.L - 2:
+            raise ValueError(
+                f"prefix blob length {plen} does not fit this engine "
+                f"(1..{self.L - 2} tokens)")
+        snap_module = (self.module.clone(kv_page_size=0, kv_pages=0)
+                       if self.paged else self.module)
+        cache1 = snap_module.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32),
+            decode=True)["cache"]
+        flat, treedef = jax.tree_util.tree_flatten(cache1)
+        leaves = [np.asarray(a) for a in blob.get("leaves") or []]
+        if len(leaves) != len(flat) or any(
+                v.shape[:2] != (1, plen) or v.shape[2:] != c.shape[2:]
+                or v.dtype != c.dtype
+                for v, c in zip(leaves, flat)):
+            raise ValueError(
+                "prefix blob does not match this engine's cache "
+                "geometry (model shape / dtype / int8 mismatch)")
+        tree = jax.tree_util.tree_unflatten(treedef, leaves)
+        if self.tier is None:
+            tree = jax.tree_util.tree_map(jnp.asarray, tree)
+        self._prefixes[aid] = {"ids": ids, "cache": tree, "len": plen,
+                               "install": _make_prefix_install(plen),
+                               "aid": aid}
+        self.stats.inc("kv_imports")
+        return plen
+
     @property
     def busy(self) -> bool:
         with self._lock:
-            return bool(self._cq) or any(s is not None
-                                         for s in self._slots)
+            return bool(self._cq) or bool(self._parked) \
+                or any(s is not None for s in self._slots)
 
     def reset_stats(self) -> None:
         """Zero the served-traffic counters without losing capacity
         gauges (``kv_pages_total`` describes the pool, not traffic) —
         what the worker's post-warmup scrub needs."""
-        keep = {"paged_kernel_active": int(self.paged_kernel_active)}
+        keep = {"paged_kernel_active": int(self.paged_kernel_active),
+                "kv_host_pages_total": self.host_pages}
         if self.paged:
             keep.update(kv_pages_total=self.n_pages - 1,
                         kv_pages_used=(self.n_pages - 1
                                        - len(self._free_pages)))
+        if self.tier is not None:
+            keep.update(
+                kv_host_pages_used=(self.host_pages
+                                    - self.tier.free_pages()),
+                kv_parked_slots=len(self._parked))
         self.stats.reset(keep=keep)
 
     def stats_snapshot(self) -> Dict[str, int]:
@@ -649,6 +1224,15 @@ class DecodeEngine:
             logging.getLogger(__name__).warning(
                 "span sink failed on %s", event, exc_info=True)
             self.span_sink = None  # a broken sink stays broken: detach
+
+    def close(self) -> None:
+        """Release the host tier's transfer thread and pinned pool.
+        Idempotent; everything else dies with its references, but the
+        tier's thread polls forever and its host pool is real RAM —
+        a process that builds engines repeatedly (benches, tests,
+        notebooks) must not accumulate one of each per engine."""
+        if self.tier is not None:
+            self.tier.close()
 
     def reset(self) -> None:
         """Drop all occupants and rebuild device state. For error
@@ -675,6 +1259,11 @@ class DecodeEngine:
             self._spec_ema = self._spec_floor + 0.5
             self._spec_idle = 0
             self._draft_synced = True
+            self._parked.clear()
+            self._done_kv.clear()
+            if self.tier is not None:
+                self.tier.reset()
+            self.stats.set("kv_parked_slots", 0)
             if self.paged:
                 # every occupant is gone: the whole pool returns to the
                 # free list and every table row points at scratch
@@ -700,16 +1289,25 @@ class DecodeEngine:
         token). Slots not prefilling re-feed their current input — an
         identical rewrite of a cache entry, harmless by construction —
         so one fixed-shape program serves any admission mix."""
-        occupied = np.array([s is not None for s in self._slots])
+        occupied = np.array([s is not None for s in self._slots],
+                            bool)
         while True:
             rem = np.where(occupied,
                            np.maximum(0, (self._prompt_len - 1)
                                       - self._pos), 0)
             if rem.max() == 0:
                 break
-            adv = np.minimum(rem, self.C)
-            tok_chunk = np.empty((self.B, self.C), np.int32)
-            pos_chunk = np.empty((self.B, self.C), np.int32)
+            fill_fn, c_use = self._prefill_fn, self.C
+            if (self._prefill_fn_small is not None
+                    and self._draft_cache is None
+                    and rem.max() <= self._small_c):
+                # short remainder: the narrow program ingests it
+                # without the C-wide call's cost (the draft mirror is
+                # compiled at C only, so draft engines stay wide)
+                fill_fn, c_use = self._prefill_fn_small, self._small_c
+            adv = np.minimum(rem, c_use)
+            tok_chunk = np.empty((self.B, c_use), np.int32)
+            pos_chunk = np.empty((self.B, c_use), np.int32)
             for i in range(self.B):
                 a = int(adv[i])
                 if a > 0:
@@ -725,15 +1323,20 @@ class DecodeEngine:
                     pos_chunk[i, :] = self._pos[i]
             if self.paged:
                 # lazy allocation tracks the prompt walk: each chunk
-                # only maps the pages it is about to write
+                # only maps the pages it is about to write. The slot
+                # re-check matters on tiered engines: an earlier
+                # lane's growth may have PARKED this one (page
+                # reclaim) inside this very loop — its row is zeroed
+                # and its pos reset, so ensuring pages here would
+                # allocate for an empty lane and leak them
                 for i in range(self.B):
-                    if adv[i] > 0:
+                    if adv[i] > 0 and self._slots[i] is not None:
                         self._ensure_pages_to(
                             i, int(self._pos[i]) + int(adv[i]) - 1)
             tok_dev = jnp.asarray(tok_chunk)
             pos_dev = jnp.asarray(pos_chunk)
             aid_dev = jnp.asarray(self._aid)
-            self._cache = self._prefill_fn(
+            self._cache = fill_fn(
                 self.params, self._cache, tok_dev, pos_dev, aid_dev,
                 self._ptab_arg())
             if self._draft_cache is not None and self._draft_synced:
@@ -744,27 +1347,52 @@ class DecodeEngine:
                     pos_dev, aid_dev, self._ptab_arg())
             self.stats.inc("prefill_calls")
             self.stats.inc("prefill_tokens", int(adv.sum()))
+            if self.prefill_token_cost_s:
+                # outside the engine lock (step releases it before
+                # prefill) so a dilated chunk stalls exactly what real
+                # prompt compute would: this loop thread, nothing else
+                time.sleep(self.prefill_token_cost_s * int(adv.sum()))
             for i in range(self.B):
-                if adv[i] > 0:
+                if adv[i] > 0 and self._slots[i] is not None:
+                    # a lane parked mid-chunk (page reclaim) skips the
+                    # advance: its record saved the PRE-chunk position,
+                    # so the resume re-prefills this chunk — the
+                    # chunk's writes went to the scratch page (its
+                    # table row was zeroed at park), losing nothing
                     self._pos[i] += int(adv[i])
                     self._slots[i].n_consumed += int(adv[i])
                     self._tok[i] = self._prompt_buf[i, int(self._pos[i])]
 
     # ---- SLO preemption (lock held: admission-loop context) ----
-    def _occupants(self) -> List[Tuple[int, str, int, bool]]:
-        """Live slots as the ``(handle, slo, seq, shielded)`` tuples
-        the shared eviction policy (`serving/slo.py`) consumes."""
-        return [(j, s.slo, s.seq, s.shielded)
-                for j, s in enumerate(self._slots) if s is not None]
+    def _occupants(self, live_only: bool = False
+                   ) -> List[Tuple[Any, str, int, bool]]:
+        """Admitted work as the ``(handle, slo, seq, shielded)``
+        tuples the shared eviction policy (`serving/slo.py`)
+        consumes. Handles are ``("live", lane)`` for seated slots and
+        ``("parked", key)`` for slots suspended to the host tier —
+        parked work holds reservations (and host pages) too, so a
+        higher-class head may reclaim them the same way."""
+        occ: List[Tuple[Any, str, int, bool]] = [
+            (("live", j), s.slo, s.seq, s.shielded)
+            for j, s in enumerate(self._slots) if s is not None]
+        if not live_only:
+            occ.extend((("parked", k), r.slot.slo, r.slot.seq,
+                        r.slot.shielded)
+                       for k, r in self._parked.items())
+        return occ
 
-    def _victim_for(self, cls: str) -> Optional[int]:
-        """The slot to evict so a ``cls`` head can admit — the shared
-        :func:`preemption_victim` policy (youngest lowest-class,
-        shielded immune) over the live slots."""
-        return preemption_victim(cls, self._occupants())
+    def _victim_for(self, cls: str, live_only: bool = False
+                    ) -> Optional[Any]:
+        """The occupant to evict so a ``cls`` head can admit — the
+        shared :func:`preemption_victim` policy (youngest
+        lowest-class, shielded immune). ``live_only`` restricts to
+        seated slots (a LANE can only come from a live victim; page
+        reservations can come from parked ones too)."""
+        return preemption_victim(cls, self._occupants(live_only))
 
-    def _evictable_for(self, cls: str) -> List[int]:
-        """Every slot :meth:`_victim_for` could ever return for a
+    def _evictable_for(self, cls: str, live_only: bool = False
+                       ) -> List[Any]:
+        """Every occupant :meth:`_victim_for` could ever return for a
         ``cls`` head — the feasibility pre-check sums their
         reservations BEFORE committing any eviction (a preemption
         that cannot end in the head admitting would destroy the
@@ -773,8 +1401,69 @@ class DecodeEngine:
         predicate as victim selection BY CONSTRUCTION (both call
         :func:`evictable_occupants`), which is what guarantees the
         paged reclaim loop in :meth:`step` terminates in admission."""
-        return [j for j, _s, _q in
-                evictable_occupants(cls, self._occupants())]
+        return [h for h, _s, _q in
+                evictable_occupants(cls, self._occupants(live_only))]
+
+    def _res_of(self, handle: Any) -> int:
+        kind, ref = handle
+        if kind == "live":
+            return int(self._n_res[ref])
+        return int(self._parked[ref].n_res)
+
+    def _preempt_handle(self, handle: Any, by: str
+                        ) -> Tuple[Any, int, int, str, str]:
+        kind, ref = handle
+        if kind == "live":
+            return self._preempt_slot(ref, by)
+        return self._preempt_parked(ref, by)
+
+    def _resumed_from(self, slot: _Slot) -> _Slot:
+        """The front-of-class re-queued request a preemption victim
+        becomes: original prompt plus everything generated so far (the
+        PR 7 forced-prefix shape) so re-admission re-ingests the
+        prefix through chunked prefill at the SAME absolute positions
+        — token-exact in every decode mode."""
+        gen = list(slot.generated)
+        prompt = (np.concatenate([slot.prompt,
+                                  np.asarray(gen, np.int32)])
+                  if gen else slot.prompt)
+        resumed = _Slot(slot.request_id, prompt,
+                        slot.max_new - len(gen),
+                        temperature=slot.temperature, top_k=slot.top_k,
+                        top_p=slot.top_p, seed=slot.seed,
+                        eos_id=slot.eos_id,
+                        adapter_id=slot.adapter_id, slo=slot.slo,
+                        seq=slot.seq, prior=slot.prior + gen,
+                        prefill_only=slot.prefill_only)
+        resumed.n_streamed = slot.n_streamed
+        resumed.first_tokened = slot.first_tokened
+        resumed.shielded = slot.shielded
+        return resumed
+
+    def _preempt_parked(self, key: int, by: str
+                        ) -> Tuple[Any, int, int, str, str]:
+        """Evict a PARKED occupant: cheapest of all — nothing is
+        seated, so its HBM pages, host pages, and reservation free
+        immediately and it re-queues front-of-class exactly like a
+        live victim (resumes token-exact later)."""
+        rec = self._parked.pop(key)
+        slot = rec.slot
+        hbm = rec.hbm_ids()
+        if hbm:
+            self._free_pages.extend(hbm)
+            self._ptab_dirty = True
+        host = rec.host_ids()
+        if host and self.tier is not None:
+            self.tier.free(host)
+        if self.tier is not None:
+            self.tier.drop_staged(key)
+        self._res_total -= rec.n_res
+        self._cq.push(slot.slo, self._resumed_from(slot), front=True)
+        self.stats.inc("preemptions")
+        self.stats.set("kv_parked_slots", len(self._parked))
+        self.stats.set("kv_pages_used",
+                       self.n_pages - 1 - len(self._free_pages))
+        return (slot.request_id, -1, len(slot.generated), slot.slo, by)
 
     def _preempt_slot(self, j: int, by: str
                       ) -> Tuple[Any, int, int, str, str]:
@@ -793,19 +1482,7 @@ class DecodeEngine:
         Returns the ``preempted`` span record."""
         slot = self._slots[j]
         gen = list(slot.generated)
-        prompt = (np.concatenate([slot.prompt,
-                                  np.asarray(gen, np.int32)])
-                  if gen else slot.prompt)
-        resumed = _Slot(slot.request_id, prompt,
-                        slot.max_new - len(gen),
-                        temperature=slot.temperature, top_k=slot.top_k,
-                        top_p=slot.top_p, seed=slot.seed,
-                        eos_id=slot.eos_id,
-                        adapter_id=slot.adapter_id, slo=slot.slo,
-                        seq=slot.seq, prior=slot.prior + gen)
-        resumed.n_streamed = slot.n_streamed
-        resumed.first_tokened = slot.first_tokened
-        resumed.shielded = slot.shielded
+        resumed = self._resumed_from(slot)
         self._slots[j] = None
         self._tok[j] = 0
         self._pos[j] = 0  # fresh occupant restarts at position 0
@@ -817,11 +1494,19 @@ class DecodeEngine:
         self.stats.inc("preemptions")
         return (slot.request_id, j, len(gen), slot.slo, by)
 
-    def _seat_slot(self, i: int, slot: _Slot,
-                   prefix_hits: Dict[int, Tuple[Dict[str, Any],
-                                                List[int]]]) -> None:
+    def _seat_slot(self, i: int, slot: _Slot) -> None:
         """Install a popped request into free slot ``i``: host mirrors,
-        shared-prefix fast-forward, first lazy pages. Lock held."""
+        shared-prefix fast-forward (or a shipped-KV fast-forward for
+        disaggregated decode), first lazy pages. Lock held.
+
+        Content installs (prefix snapshot / shipped KV blob) happen
+        HERE, immediately after the lane's pages are mapped — not
+        batched after admission. On a tiered engine a LATER seat in
+        the same admission pass can park this very lane and evict its
+        pages to host; deferred installs would let that eviction
+        capture pre-install garbage (a silently-wrong resume). The
+        scatters are async dispatches; holding the lock across them
+        costs submitters microseconds."""
         self._slots[i] = slot
         self._tok[i] = slot.prompt[0]
         self._pos[i] = 0
@@ -829,21 +1514,43 @@ class DecodeEngine:
         self._prompt_buf[i, :len(slot.prompt)] = slot.prompt
         self._prompt_len[i] = len(slot.prompt)
         pre = self._prefixes.get(slot.adapter_id)
-        if (pre is not None and len(slot.prompt) > pre["len"]
+        install: Optional[Tuple[str, Any]] = None
+        if slot.kv_import is not None \
+                and int(slot.kv_import["covered"]) > 0:
+            # disaggregated decode: a prefill-role worker already
+            # computed positions 0..covered-1; the shipped rows
+            # scatter into this slot's pages/rows (below, once the
+            # pages are mapped) and the prompt walk resumes past them
+            # — exactly the prefix-hit shape, sourced from the wire
+            cov = int(slot.kv_import["covered"])
+            self._pos[i] = cov
+            slot.n_consumed = cov
+            self._tok[i] = slot.prompt[cov]
+            install = ("kv", slot.kv_import)
+            slot.kv_import = None  # installed once; a preempt-resume
+            #                        re-ingests through chunked prefill
+        elif (pre is not None and len(slot.prompt) > pre["len"]
                 and np.array_equal(slot.prompt[:pre["len"]],
                                    pre["ids"])):
             # shared-prefix hit: skip its prefill — the KV copy makes
             # positions 0..plen-1 as if prefilled, and the prompt walk
-            # resumes at plen
-            prefix_hits.setdefault(
-                slot.adapter_id, (pre, []))[1].append(i)
+            # resumes at plen. `pre` is the snapshot the prompt
+            # MATCHED, held through the install below — never a fresh
+            # self._prefixes lookup a concurrent register could swap
+            install = ("prefix", pre)
             self._pos[i] = pre["len"]
             slot.n_consumed = pre["len"]
             self._tok[i] = slot.prompt[pre["len"]]
-        # finish once pos reaches plen - 1 + max_new (the step at
-        # input position p emits a GENERATED token iff p >= plen - 1)
-        self._stop_pos[i] = min(
-            len(slot.prompt) - 1 + slot.max_new, self.L)
+        if slot.prefill_only:
+            # prefill-role serving: stop at the last prompt token —
+            # the position the decode leg starts from; the slot never
+            # generates, its KV ships via poll_kv instead
+            self._stop_pos[i] = max(0, len(slot.prompt) - 1)
+        else:
+            # finish once pos reaches plen - 1 + max_new (the step at
+            # input position p emits a GENERATED token iff p >= plen-1)
+            self._stop_pos[i] = min(
+                len(slot.prompt) - 1 + slot.max_new, self.L)
         self._temp[i] = slot.temperature
         self._topk[i] = slot.top_k
         self._topp[i] = slot.top_p
@@ -851,9 +1558,16 @@ class DecodeEngine:
         self._aid[i] = slot.adapter_id
         if self.paged:
             # map the pages the slot starts on: position 0, or the
-            # whole prefix span for a hit (install scatters into them
-            # before the next call)
-            self._ensure_pages_to(i, int(self._pos[i]))
+            # whole prefix/import span for a hit (the install below
+            # scatters into them)
+            self._ensure_pages_to(i, int(self._pos[i]),
+                                  have_lock=True)
+        if install is not None:
+            kind, payload = install
+            if kind == "kv":
+                self._install_kv(i, payload)
+            else:
+                self._install_prefix([i], payload)
 
     # ---- the loop body ----
     def step(self) -> int:
@@ -863,12 +1577,17 @@ class DecodeEngine:
         admitted_info: List[Tuple[Any, int, int, str]] = []
         preempted_info: List[Tuple[Any, int, int, str, str]] = []
         with self._lock:
-            admitted = False
-            # rows grouped by adapter id with the SNAPSHOT each matched
-            # (one install per distinct snapshot; register_prefix is
-            # documented as not concurrent with step, so within one
-            # admission an adapter maps to exactly one snapshot)
-            prefix_hits: Dict[int, Tuple[Dict[str, Any], List[int]]] = {}
+            # resume parked slots first: they hold reservations and
+            # partial progress, and freeing their host pages is what
+            # keeps the tier from silting up
+            unpark_installs, n_unparked = self._try_unpark()
+            if unpark_installs:
+                # restored page CONTENT lands IMMEDIATELY (still under
+                # the lock, before admission): a later seat's page
+                # reclaim may evict these very pages back to host, and
+                # it must evict their bytes, not pre-install garbage
+                self._apply_unpark_installs(unpark_installs)
+            admitted = n_unparked > 0
             while True:
                 nxt = self._cq.peek()
                 if nxt is None:
@@ -882,39 +1601,45 @@ class DecodeEngine:
                 # max_new + spec margin — its ACTUAL size, never
                 # max_len) fits what is free plus what eviction could
                 # reclaim from strictly-lower-class, non-shielded
-                # occupants. If even that is insufficient, STALL
-                # WITHOUT evicting: destroying a victim's progress
-                # while the head still cannot admit would be pure
-                # loss (backpressure keeps FIFO fairness — smaller
-                # latecomers never starve the head; completions free
-                # reservations).
+                # occupants (parked ones included: their reservations
+                # and pages free the same way). If even that is
+                # insufficient, STALL WITHOUT evicting: destroying a
+                # victim's progress while the head still cannot admit
+                # would be pure loss (backpressure keeps FIFO
+                # fairness — smaller latecomers never starve the
+                # head; completions free reservations).
                 victims = self._evictable_for(cls)
-                if i is None and not victims:
-                    break
+                live_victims = [h for h in victims if h[0] == "live"]
+                if i is None and not live_victims:
+                    break  # a lane can only come from a live victim
                 n_res = 0
                 if self.paged:
                     n_res = self._pages_for(
-                        min(len(head.prompt) - 1 + head.max_new,
-                            self.L))
-                    avail = self.n_pages - 1 - self._res_total
-                    reclaim = sum(int(self._n_res[j]) for j in victims)
+                        max(1, len(head.prompt) - 1)
+                        if head.prefill_only
+                        else min(len(head.prompt) - 1 + head.max_new,
+                                 self.L))
+                    avail = self._budget_pages - self._res_total
+                    reclaim = sum(self._res_of(h) for h in victims)
                     if avail + reclaim < n_res:
                         self.stats.inc("admission_stalls")
                         break
                 if i is None:
                     # every slot occupied: evict the youngest
-                    # lowest-class occupant (pages return NOW — cheap
-                    # under paged KV; the victim resumes token-exact
-                    # later from its re-queued prefix)
-                    i = self._victim_for(cls)
+                    # lowest-class LIVE occupant (pages return NOW —
+                    # cheap under paged KV; the victim resumes
+                    # token-exact later from its re-queued prefix)
+                    h = self._victim_for(cls, live_only=True)
+                    i = h[1]
                     preempted_info.append(self._preempt_slot(i, cls))
                 if self.paged:
-                    while self._res_total + n_res > self.n_pages - 1:
+                    while self._res_total + n_res > self._budget_pages:
                         # guaranteed to terminate in admission by the
-                        # feasibility check above
-                        j = self._victim_for(cls)
+                        # feasibility check above; parked victims are
+                        # the cheapest (nothing seated to destroy)
+                        h = self._victim_for(cls)
                         preempted_info.append(
-                            self._preempt_slot(j, cls))
+                            self._preempt_handle(h, cls))
                     self._n_res[i] = n_res
                     self._res_total += n_res
                 # pop() == the peeked head: nothing ran between (a
@@ -924,7 +1649,7 @@ class DecodeEngine:
                 if self._cq.last_pop_promoted:
                     slot.shielded = True  # aging fired: this slot may
                     #                       not be preempted in turn
-                self._seat_slot(i, slot, prefix_hits)
+                self._seat_slot(i, slot)
                 admitted = True
                 admitted_info.append((slot.request_id, i,
                                       len(slot.prompt), slot.slo,
@@ -932,7 +1657,8 @@ class DecodeEngine:
             depths = self._cq.depths()
             self.stats.set("slo_aged_promotions", self._cq.promotions)
             live = [i for i in range(self.B) if self._slots[i] is not None]
-            self.stats.max_set("max_concurrent", len(live))
+            self.stats.max_set("max_concurrent",
+                               len(live) + len(self._parked))
         for c, d in depths.items():
             self.stats.set(f"queued_{c}", d)
         # span emission OUTSIDE the engine lock: the sink may take its
@@ -948,20 +1674,25 @@ class DecodeEngine:
             self._span("admitted", rid, slot=row, prompt_tokens=plen,
                        slo=cls, resumed=resumed)
         if not live:
+            self._prefetch_hint()
             return 0
-        for pre, rows in prefix_hits.values():
-            # the snapshot each row matched against, NOT a fresh
-            # self._prefixes lookup: a concurrent register_prefix must
-            # not swap the tree under rows whose positions were
-            # advanced by pre["len"]
-            self._install_prefix(rows, pre)
         if admitted and self._prefill_fn is not None:
             self._chunked_prefill()
             for rid, row, plen, cls, resumed in admitted_info:
                 self._span("prefill", rid, prompt_tokens=plen)
+        # prefill-only slots that reached their last prompt token are
+        # done NOW: extract their KV shipment and free the lane before
+        # the decode scan (they never generate)
+        self._harvest_prefill_only()
+        # chunked prefill / prefill-only harvest may have parked or
+        # freed lanes: the scan must see the CURRENT occupancy
+        live = [i for i in range(self.B) if self._slots[i] is not None]
         if admitted or self._prompt_dev is None:
             # refresh the device-resident prompts only when they changed
             self._prompt_dev = jnp.asarray(self._prompt_buf)
+        self._prefetch_hint()
+        if not live:
+            return 0
 
         any_sampling = bool(any(
             self._slots[i] is not None and self._slots[i].temperature > 0
@@ -983,7 +1714,12 @@ class DecodeEngine:
         if self.paged:
             for i in live:
                 # the fused scan writes positions pos..pos+K-1, frozen
-                # at stop_pos-1: map exactly that window's pages
+                # at stop_pos-1: map exactly that window's pages. The
+                # slot re-check guards tiered engines: an earlier
+                # lane's growth can PARK this one inside this loop —
+                # allocating for the emptied lane would leak its pages
+                if self._slots[i] is None:
+                    continue
                 self._ensure_pages_to(i, min(
                     int(self._pos[i]) + self.K,
                     int(self._stop_pos[i])) - 1)
@@ -994,7 +1730,7 @@ class DecodeEngine:
             jnp.asarray(self._temp), jnp.asarray(self._topk),
             jnp.asarray(self._topp), jnp.asarray(self._seed),
             jnp.asarray(self._aid), self._ptab_arg())
-        emitted = np.asarray(emitted)  # (K, B) — the per-token sync
+        emitted = np.asarray(emitted)  # rafiki: noqa[blocking-transfer-in-decode-loop] — the loop's OUTPUT sync: generated tokens must reach the host to stream; the fused K-step scan amortizes it
         self.stats.inc("steps", self.K)
         if self._draft_cache is not None:
             if not any_sampling and (
@@ -1014,6 +1750,9 @@ class DecodeEngine:
         finished: List[Tuple[Any, List[int]]] = []
         for i in live:
             slot = self._slots[i]
+            if slot is None:
+                continue  # parked mid-call by a page reclaim: its
+                #           lane idled through the scan (stop_pos 0)
             plen = len(slot.prompt)
             pos0 = int(self._pos[i])
             # steps this slot actually took inside the fused program
@@ -1183,7 +1922,7 @@ class DecodeEngine:
                 jnp.asarray(self._topk), jnp.asarray(self._topp),
                 jnp.asarray(self._seed), jnp.asarray(self._aid),
                 self._ptab_arg())
-            drafts = np.asarray(d_emit).T.astype(np.int32)  # (B, k-1)
+            drafts = np.asarray(d_emit).T.astype(np.int32)  # rafiki: noqa[blocking-transfer-in-decode-loop] — draft tokens feed the host-built verify operands; one pull per K-token window
             offs = np.arange(k, dtype=np.int32)[None, :]
             self._draft_cache = self._draft_sync_v(
                 self.draft_params, self._draft_cache,
@@ -1204,7 +1943,11 @@ class DecodeEngine:
                 # the verify window writes positions pos..pos+k-1
                 # (gated above to fit the cache); its pages must exist
                 # even for drafts that end up rejected — the standard
-                # unreachable-then-rewritten rows, inside reservation
+                # unreachable-then-rewritten rows, inside reservation.
+                # Slot re-check: a mid-loop park (tiered page reclaim)
+                # empties a later lane — see _chunked_prefill
+                if self._slots[i] is None:
+                    continue
                 self._ensure_pages_to(i, min(
                     int(self._pos[i]) + k - 1, self.L - 1))
         self._cache, g, n_emit = self._verify_fn(
@@ -1212,8 +1955,8 @@ class DecodeEngine:
             jnp.asarray(self._pos), jnp.asarray(drafts),
             jnp.asarray(self._stop_pos), jnp.asarray(self._aid),
             self._ptab_arg())
-        g = np.asarray(g)            # (B, k) model argmax per position
-        n_emit = np.asarray(n_emit)  # (B,) 1 + accepted draft prefix
+        g = np.asarray(g)            # rafiki: noqa[blocking-transfer-in-decode-loop] — verify OUTPUT sync: accepted tokens must reach the host to stream
+        n_emit = np.asarray(n_emit)  # rafiki: noqa[blocking-transfer-in-decode-loop] — ditto (acceptance counts gate the host-side emit)
         self.stats.inc("steps")
         self.stats.inc("spec_calls")
         self._spec_idle = 0
@@ -1224,6 +1967,8 @@ class DecodeEngine:
         finished: List[Tuple[Any, List[int]]] = []
         for i in live:
             slot = self._slots[i]
+            if slot is None:
+                continue  # parked mid-call by a page reclaim
             pos0 = int(self._pos[i])
             take = max(1, min(int(n_emit[i]),
                               int(self._stop_pos[i]) - pos0,
@@ -1474,6 +2219,32 @@ def _make_prefill(module: Any, n_slots: int, chunk: int) -> Callable:
     return prefill_fn
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _install_pages(flat: List[jnp.ndarray], idx: jnp.ndarray,
+                   staged: List[jnp.ndarray]) -> List[jnp.ndarray]:
+    """Shipment install, paged layout: scatter each staged leaf's
+    pages into the donated cache leaves at ``idx``. Donation makes
+    this an in-place write of the touched pages; the jit cache keys on
+    (n_pages, leaf shapes), so one compile serves every same-length
+    shipment engine-wide."""
+    return [c.at[idx].set(v.astype(c.dtype))
+            for c, v in zip(flat, staged)]
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _install_rows(flat: List[jnp.ndarray], row: jnp.ndarray,
+                  staged: List[jnp.ndarray]) -> List[jnp.ndarray]:
+    """Shipment install, contiguous layout: write each staged leaf
+    ``(covered, …)`` into the donated cache leaves at slot ``row``,
+    positions ``0..covered-1``."""
+    out = []
+    for c, v in zip(flat, staged):
+        upd = v.astype(c.dtype)[None]
+        starts = (row,) + (jnp.int32(0),) * (c.ndim - 1)
+        out.append(jax.lax.dynamic_update_slice(c, upd, starts))
+    return out
+
+
 class TextDecodeEngine:
     """Text-level wrapper: encode prompts, detokenize completions.
 
@@ -1490,6 +2261,11 @@ class TextDecodeEngine:
     #: forwards it to engines that declare the capability (a duck-typed
     #: user engine must degrade to classless FIFO, not TypeError)
     supports_slo = True
+    #: ditto for disaggregated prefill/decode: ``submit_prefill`` /
+    #: ``poll_kv`` on the prefill leg and ``submit(..., kv_blob=)`` on
+    #: the decode leg — role-configured workers check this at boot so
+    #: a duck-typed user engine fails the deploy, not the serve thread
+    supports_kv_ship = True
 
     def __init__(self, engine: DecodeEngine,
                  encode: Callable[[str], np.ndarray],
@@ -1519,7 +2295,8 @@ class TextDecodeEngine:
                max_new: Optional[int] = None, temperature: float = 0.0,
                top_k: int = 0, top_p: float = 1.0, seed: int = 0,
                eos_id: Optional[int] = None, adapter_id: int = 0,
-               forced_prefix: str = "", slo: str = "") -> None:
+               forced_prefix: str = "", slo: str = "",
+               kv_blob: Optional[Dict[str, Any]] = None) -> None:
         """``forced_prefix`` (streaming failover / client resume): text
         a previous worker already emitted for this request. It is
         re-ingested as part of the prompt (the engine's chunked-prefill
@@ -1549,10 +2326,45 @@ class TextDecodeEngine:
             self._forced[request_id] = str(forced_prefix)
             self._stream_sent[request_id] = str(forced_prefix)
             text, budget = full, remaining
+            kv_blob = None  # a shipment covers the ORIGINAL prompt;
+            # the resume prompt is longer, so re-ingest via chunked
+            # prefill instead of installing mismatched rows
+        kw = {}
+        if kv_blob is not None:
+            kw["kv_import"] = kv_blob
         self.engine.submit(request_id, self._encode(text), budget,
                            temperature=temperature, top_k=top_k,
                            top_p=top_p, seed=seed, eos_id=eos_id,
-                           adapter_id=adapter_id, slo=slo)
+                           adapter_id=adapter_id, slo=slo, **kw)
+
+    def submit_prefill(self, request_id: Any, text: str,
+                       max_new: Optional[int] = None,
+                       adapter_id: int = 0, slo: str = "") -> None:
+        """Prefill-role submission (disaggregated serving): chew the
+        prompt through chunked prefill and surface its KV shipment via
+        :meth:`poll_kv` — no tokens are generated here; the decode leg
+        installs the blob and runs the tight single-token loop."""
+        self.engine.submit(request_id, self._encode(str(text)),
+                           self.max_new if max_new is None
+                           else int(max_new),
+                           adapter_id=adapter_id, slo=slo,
+                           prefill_only=True)
+
+    def poll_kv(self) -> List[Tuple[Any, Dict[str, Any]]]:
+        """Finished prefill-only shipments (see
+        :meth:`DecodeEngine.poll_kv`)."""
+        return self.engine.poll_kv()
+
+    def stage_kv_blob(self, blob: Dict[str, Any]) -> Dict[str, Any]:
+        """Pre-upload an arrived shipment's leaves (see
+        :meth:`DecodeEngine.stage_kv_blob`)."""
+        return self.engine.stage_kv_blob(blob)
+
+    def export_prefix(self, adapter_id: int = 0):
+        return self.engine.export_prefix(adapter_id=adapter_id)
+
+    def import_prefix(self, blob, adapter_id: int = 0) -> int:
+        return self.engine.import_prefix(blob, adapter_id=adapter_id)
 
     def _full_text(self, rid: Any, ids: List[int]) -> str:
         """The request's cumulative OUTPUT text: decoded generated ids,
@@ -1611,6 +2423,9 @@ class TextDecodeEngine:
         self._forced.clear()
         self._forced_done.clear()
         self.engine.reset()
+
+    def close(self) -> None:
+        self.engine.close()
 
     def reset_stats(self) -> None:
         self.engine.reset_stats()

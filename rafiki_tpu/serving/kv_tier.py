@@ -1,0 +1,378 @@
+"""Host-RAM KV page tier: a second page pool behind the HBM allocator.
+
+The paged KV cache (PR 5) made admission scale with LIVE tokens, but
+every live page still had to sit in HBM — serviceable concurrency was
+hard-capped by on-chip memory because each request RESERVES its
+worst-case pages up front. This module adds the tier every production
+serving stack converged on (the Gemma-on-TPU paper's
+HBM-capacity-vs-throughput analysis, PAPERS.md): cold pages spill to a
+pinned-host page pool and a prefetcher pulls them back ahead of the
+compiled step that needs them, so the admission budget becomes
+``HBM pages + host pages`` while the step program only ever touches
+HBM-resident pages.
+
+Division of labor:
+
+- The :class:`~rafiki_tpu.serving.decode_engine.DecodeEngine` owns the
+  POLICY: which slots park, which pages evict, when a parked slot
+  resumes. It runs on the step thread and never blocks on a transfer —
+  the lint rule ``blocking-transfer-in-decode-loop`` enforces exactly
+  that.
+- :class:`HostPageTier` owns the MECHANISM: a preallocated host pool
+  (one buffer per cache leaf, page-major like the device pool), a free
+  list, and a transfer thread that drains device→host copies
+  (eviction) and stages host→device uploads (prefetch) off the hot
+  loop. The step thread hands the tier already-gathered device arrays
+  and picks up already-staged device arrays; the only blocking waits
+  live on the TIER thread.
+
+Safety: the transfer thread never touches the engine's cache (which is
+donated to every compiled call). Evictions read from independent
+gather results — JAX's buffer ordering guarantees the gather completes
+before a later donated step reuses the source pages — and prefetch
+stages fresh device arrays the step thread scatters in itself.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class _Ticket:
+    """One queued transfer: completion event + enough context for the
+    worker thread to run it."""
+
+    __slots__ = ("kind", "key", "host_ids", "payload", "done", "at",
+                 "failed")
+
+    def __init__(self, kind: str, key: Any, host_ids: List[int],
+                 payload: Any) -> None:
+        self.kind = kind            # "evict" | "prefetch"
+        self.key = key
+        self.host_ids = host_ids
+        self.payload = payload
+        self.done = threading.Event()
+        self.at = time.monotonic()
+        #: the transfer raised; for evictions the retained ``payload``
+        #: lets :meth:`HostPageTier.fetch` retry the copy — the host
+        #: pool bytes for ``host_ids`` are NOT valid until it does
+        self.failed = False
+
+
+class HostPageTier:
+    """Pinned-host page pool + async transfer worker.
+
+    ``n_pages`` host pages, each the same ``(page_size, …)`` geometry
+    as the device pool's pages (the pool buffers are allocated lazily
+    on the first eviction, when the leaf shapes/dtypes are known).
+    ``stats`` is the owning engine's StatsMap — the tier feeds the
+    ``kv_host_pages_used/total``, ``kv_evictions_total``,
+    ``kv_prefetch_hits/misses``, and ``kv_transfer_bytes_total``
+    gauges the worker surfaces on ``/metrics``. ``observe_transfer``
+    (wired by the worker) receives each completed transfer's wall
+    seconds for the transfer-latency histogram.
+    """
+
+    def __init__(self, n_pages: int, stats: Any,
+                 observe_transfer: Optional[Callable[[float], None]]
+                 = None) -> None:
+        if int(n_pages) < 1:
+            raise ValueError("host tier needs >= 1 host page")
+        self.n_pages = int(n_pages)
+        self.stats = stats
+        self.observe_transfer = observe_transfer
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(self.n_pages - 1, -1, -1))
+        self._pool: Optional[List[np.ndarray]] = None
+        #: host page id -> the eviction ticket that is (or was) writing
+        #: it; fetch/prefetch wait on these before reading the pool
+        self._writers: Dict[int, _Ticket] = {}
+        #: staged prefetches: key -> (host_ids, device leaves, ticket)
+        self._staged: Dict[Any, Tuple[Tuple[int, ...], Any, _Ticket]] = {}
+        #: park keys with a live prefetch interest. Park keys are
+        #: monotonic and never reused, so a prefetch that completes
+        #: after its key died (slot seated/preempted before the tier
+        #: thread got there) must NOT store under it — nothing would
+        #: ever take or drop that entry and the staged device arrays
+        #: would stay pinned for the engine's lifetime.
+        self._want: set = set()
+        self._q: "collections.deque[_Ticket]" = collections.deque()
+        self._cv = threading.Condition(self._lock)
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._run, name="kv-host-tier", daemon=True)
+        self._thread.start()
+
+    # ---- allocator (step thread) ----
+    def free_pages(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Pop ``n`` host pages, or None when the tier is too full —
+        the engine's combined-budget reservation makes None unreachable
+        for within-reservation growth (see the allocator invariant in
+        ``decode_engine.py``), but the tier still refuses rather than
+        corrupting its free list."""
+        with self._lock:
+            if len(self._free) < n:
+                return None
+            ids = [self._free.pop() for _ in range(n)]
+            self.stats.set("kv_host_pages_used",
+                           self.n_pages - len(self._free))
+        return ids
+
+    def free(self, host_ids: Sequence[int]) -> None:
+        with self._lock:
+            for h in host_ids:
+                self._writers.pop(int(h), None)
+                self._free.append(int(h))
+            self.stats.set("kv_host_pages_used",
+                           self.n_pages - len(self._free))
+
+    # ---- eviction (device -> host) ----
+    def evict_submit(self, host_ids: List[int], device_leaves: Any
+                     ) -> None:
+        """Queue a device→host page copy. ``device_leaves`` are
+        already-GATHERED per-leaf device arrays shaped
+        ``(len(host_ids), page_size, …)`` — the step thread dispatched
+        the gather and returns immediately; the d2h sync happens on the
+        tier thread."""
+        t = _Ticket("evict", None, [int(h) for h in host_ids],
+                    device_leaves)
+        with self._lock:
+            for h in t.host_ids:
+                self._writers[h] = t
+            if self._stop:
+                # close() raced a still-stepping engine: nothing will
+                # ever pop this ticket, and a later fetch() would wait
+                # its done event forever. Mark it failed-with-payload
+                # so fetch's recovery path copies synchronously.
+                t.failed = True
+                t.done.set()
+                return
+            self._q.append(t)
+            self._cv.notify()
+
+    # ---- prefetch / fetch (host -> device) ----
+    def prefetch_submit(self, key: Any, host_ids: Sequence[int]) -> None:
+        """Ask the tier thread to stage ``key``'s host pages as device
+        arrays ahead of the unpark that will need them. Idempotent per
+        (key, ids); a stale staging for different ids is dropped."""
+        ids = tuple(int(h) for h in host_ids)
+        if not ids:
+            return
+        with self._lock:
+            if self._stop:
+                return
+            self._want.add(key)
+            cur = self._staged.get(key)
+            if cur is not None and cur[0] == ids:
+                return
+            if cur is not None:
+                self._staged.pop(key, None)
+            if any(t.kind == "prefetch" and t.key == key
+                   for t in self._q):
+                return
+            self._q.append(_Ticket("prefetch", key, list(ids), None))
+            self._cv.notify()
+
+    def take_staged(self, key: Any, host_ids: Sequence[int]
+                    ) -> Optional[Any]:
+        """The staged device leaves for ``key`` if the prefetcher got
+        there first (and for the SAME pages) — a prefetch hit. None is
+        a miss; the caller falls back to :meth:`fetch` + its own
+        upload."""
+        ids = tuple(int(h) for h in host_ids)
+        with self._lock:
+            cur = self._staged.pop(key, None)
+            self._want.discard(key)
+        if cur is None or cur[0] != ids or not cur[2].done.is_set():
+            return None
+        return cur[1]
+
+    def drop_staged(self, key: Any) -> None:
+        with self._lock:
+            self._staged.pop(key, None)
+            self._want.discard(key)
+
+    def fetch(self, host_ids: Sequence[int]) -> List[np.ndarray]:
+        """The host copies of the given pages, waiting out any pending
+        eviction writes first. Runs on whatever thread asks — the
+        engine only calls it on a prefetch MISS (the upload it then
+        performs is host→device, which does not stall the device
+        pipeline the way a d2h sync does)."""
+        ids = [int(h) for h in host_ids]
+        with self._lock:
+            waits = [self._writers[h] for h in ids
+                     if h in self._writers]
+        for t in waits:
+            t.done.wait()
+        for t in {id(t): t for t in waits if t.failed}.values():
+            self._recover_failed(t)
+        with self._lock:
+            # re-read AFTER the waits: the first-ever eviction creates
+            # the pool on the tier thread, so a fetch racing it must
+            # not capture the pre-creation None
+            pool = self._pool
+        if pool is None:
+            raise RuntimeError("host tier fetch before any eviction")
+        idx = np.asarray(ids, np.int64)
+        return [leaf[idx] for leaf in pool]
+
+    def _recover_failed(self, t: _Ticket) -> None:
+        """Synchronously retry a failed eviction copy from the
+        ticket's retained device payload (transient d2h errors clear;
+        the gathered arrays were kept alive exactly for this). Raises
+        if the content is unrecoverable: a lost page must be LOUD —
+        the engine's step-level error recovery resets rather than
+        resuming a stream from silently-zero KV. Held under the tier
+        lock: two fetchers racing the same ticket must not double-run
+        the copy or see a half-cleared payload."""
+        with self._lock:
+            if not t.failed:
+                return  # another fetcher already recovered it
+            leaves = t.payload
+            if leaves is None:
+                raise RuntimeError(
+                    "kv host tier: evicted page content lost "
+                    f"(pages {t.host_ids})")
+            pool = self._ensure_pool(leaves)
+            idx = np.asarray(t.host_ids, np.int64)
+            moved = 0
+            for buf, dev in zip(pool, leaves):
+                arr = np.asarray(dev)
+                buf[idx] = arr
+                moved += arr.nbytes
+            t.payload = None
+            t.failed = False
+            self.stats.inc("kv_evictions_total", len(t.host_ids))
+            self.stats.inc("kv_transfer_bytes_total", moved)
+
+    # ---- lifecycle ----
+    def reset(self) -> None:
+        with self._lock:
+            self._q.clear()
+            self._staged.clear()
+            self._want.clear()
+            self._writers.clear()
+            self._free = list(range(self.n_pages - 1, -1, -1))
+            self.stats.set("kv_host_pages_used", 0)
+
+    def close(self) -> None:
+        with self._lock:
+            self._stop = True
+            pending = list(self._q)
+            self._q.clear()
+            self._cv.notify()
+        for t in pending:
+            # never-executed work must not strand a fetch() waiting on
+            # its done event: a failed eviction recovers synchronously
+            # from its retained payload; an unstaged prefetch is a miss
+            t.failed = True
+            t.done.set()
+
+    # ---- the transfer thread ----
+    def _ensure_pool(self, leaves: Sequence[Any]) -> List[np.ndarray]:
+        if self._pool is None:
+            self._pool = [
+                np.zeros((self.n_pages,) + tuple(a.shape[1:]),
+                         _np_dtype(a)) for a in leaves]
+        return self._pool
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                while not self._q and not self._stop:
+                    self._cv.wait(timeout=1.0)
+                if self._stop:
+                    return
+                t = self._q.popleft()
+            try:
+                self._execute(t)
+            except Exception:  # noqa: BLE001 — a failed transfer must
+                # not kill the tier thread. A failed PREFETCH is just
+                # a miss (nothing staged; the engine's fetch fallback
+                # redoes it). A failed EVICTION marks the ticket so
+                # fetch retries the copy from the retained device
+                # payload — the host pool bytes are garbage until then
+                # and must never be served as KV.
+                t.failed = True
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "kv host tier transfer failed", exc_info=True)
+            finally:
+                t.done.set()
+
+    def _execute(self, t: _Ticket) -> None:
+        t0 = time.monotonic()
+        if t.kind == "evict":
+            leaves = t.payload
+            pool = None
+            with self._lock:
+                pool = self._ensure_pool(leaves)
+            idx = np.asarray(t.host_ids, np.int64)
+            moved = 0
+            for buf, dev in zip(pool, leaves):
+                arr = np.asarray(dev)  # the d2h sync — TIER thread only
+                buf[idx] = arr
+                moved += arr.nbytes
+            t.payload = None  # release the gathered device arrays NOW:
+            # the writers map holds this ticket until the host pages
+            # free, and keeping the copies referenced would pin every
+            # evicted page's bytes in HBM — the capacity the eviction
+            # exists to reclaim
+            self.stats.inc("kv_evictions_total", len(t.host_ids))
+            self.stats.inc("kv_transfer_bytes_total", moved)
+        else:  # prefetch: host -> device staging
+            import jax.numpy as jnp
+
+            with self._lock:
+                if t.key not in self._want:
+                    return  # the park this prefetch served is gone
+                    # (seated / preempted / missed-and-fetched before
+                    # the tier thread got here)
+                ws = {id(w): w for h in t.host_ids
+                      for w in (self._writers.get(h),)
+                      if w is not None}
+                if any(not w.done.is_set() or w.failed
+                       for w in ws.values()):
+                    # a not-yet-done writer was queued BEHIND this
+                    # prefetch (FIFO: anything ahead already ran), so
+                    # the pages were freed and reallocated — the key
+                    # is stale, and waiting on that writer HERE would
+                    # deadlock the only thread that can complete it.
+                    # A failed writer needs fetch()'s recovery path.
+                    # Either way skip: a prefetch is an overlap
+                    # optimization, the unpark's own fetch covers it.
+                    return
+                pool = self._pool
+                if pool is None:
+                    return
+                idx = np.asarray(t.host_ids, np.int64)
+                leaves = [leaf[idx] for leaf in pool]
+            staged = [jnp.asarray(a) for a in leaves]
+            self.stats.inc("kv_transfer_bytes_total",
+                           int(sum(a.nbytes for a in leaves)))
+            with self._lock:
+                if t.key in self._want:
+                    self._staged[t.key] = (tuple(t.host_ids), staged, t)
+        if self.observe_transfer is not None:
+            try:
+                self.observe_transfer(time.monotonic() - t0)
+            except Exception:  # rafiki: noqa[silent-except] —
+                pass           # observability must never kill transfers
+
+
+def _np_dtype(a: Any) -> np.dtype:
+    """Numpy dtype for a host mirror of a device leaf. bfloat16 has no
+    numpy native dtype on some stacks; ml_dtypes (a jax dependency)
+    provides it — np.asarray of a bf16 device array already yields it,
+    so mirroring the reported dtype is exact."""
+    return np.dtype(a.dtype)

@@ -1,0 +1,156 @@
+"""KV-page shipment blobs for disaggregated prefill/decode serving.
+
+The paged KV cache made pages the repo's transfer unit (PR 5); this
+module makes them a WIRE unit. A prefill-role worker chews a prompt
+through chunked prefill, extracts the slot's finished KV rows — every
+cache leaf uniformly, so int8 KV pools and their scale rows ship
+together — and forwards them over the hub to a decode-role worker,
+which installs them into its own pool pages and starts the tight
+single-token loop at the same position local prefill would have
+reached. Token-exact by construction: the installed KV bytes are the
+bytes local prefill would have produced (same module, same params,
+same tokenizer → same rows).
+
+Blobs are plain msgpack-able dicts (numpy leaves ride the ParamStore
+codec the hub already uses), deliberately self-describing so the
+decode side can VALIDATE before touching its cache: a mismatched
+layout, page size, leaf signature, or adapter is a structured
+``ValueError`` the worker degrades to a local re-prefill — never a
+silently-wrong cache install (which would be a correct-looking wrong
+answer) and never a shape error escaping mid-step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+KV_BLOB_VERSION = 1
+
+#: blob["layout"] values: ``paged`` leaves are whole pool pages
+#: ``(n_pages, page_size, …)``; ``rows`` leaves are exact logical rows
+#: ``(covered, …)`` sliced from a contiguous cache
+LAYOUT_PAGED = "paged"
+LAYOUT_ROWS = "rows"
+
+#: worker role knob values (the disaggregation switch). ``unified``
+#: (the default) is the single-engine behavior every existing deploy
+#: keeps: one worker prefills AND decodes.
+ROLES = ("unified", "prefill", "decode")
+
+
+def normalize_role(value: Any) -> str:
+    """The one worker-role validator (worker config, admin budget
+    path, tests). ``None``/empty → ``unified``; anything else must
+    name a member of :data:`ROLES` — a typo'd role silently serving
+    unified would defeat the placement policy."""
+    if value is None:
+        return "unified"
+    s = str(value).strip().lower()
+    if not s:
+        return "unified"
+    if s not in ROLES:
+        raise ValueError(f"unknown worker role {value!r} "
+                         f"(one of: {', '.join(ROLES)})")
+    return s
+
+
+def leaf_signature(leaves: Sequence[np.ndarray]) -> List[List[Any]]:
+    """Per-leaf ``[trailing-shape, dtype]`` signature. The leading axis
+    (pages shipped / rows covered) varies per request; everything after
+    it is model geometry and must match the receiving engine exactly."""
+    return [[list(a.shape[1:]), str(a.dtype)] for a in leaves]
+
+
+def make_kv_blob(covered: int, layout: str, page_size: int,
+                 leaves: Sequence[np.ndarray],
+                 adapter_id: int = 0) -> Dict[str, Any]:
+    """Package extracted KV rows for the hub. ``covered`` is the count
+    of prefilled logical positions (``0..covered-1``); ``leaves`` are
+    the cache's flattened leaves in ``jax.tree_util`` order (empty for
+    single-token prompts, which have nothing prefilled)."""
+    if layout not in (LAYOUT_PAGED, LAYOUT_ROWS):
+        raise ValueError(f"unknown KV blob layout {layout!r}")
+    arrs = [np.asarray(a) for a in leaves]
+    return {"v": KV_BLOB_VERSION, "covered": int(covered),
+            "layout": layout, "page_size": int(page_size),
+            "adapter_id": int(adapter_id),
+            "sig": leaf_signature(arrs), "leaves": arrs,
+            "nbytes": int(sum(a.nbytes for a in arrs))}
+
+
+def check_kv_blob(blob: Any, *, layout: str, page_size: int,
+                  expect_sig: Sequence[Sequence[Any]],
+                  prompt_len: int, adapter_id: int = 0,
+                  expect_leading: Optional[int] = None
+                  ) -> Dict[str, Any]:
+    """Validate a shipped blob against the receiving engine BEFORE any
+    cache write. Raises ``ValueError`` with an operator-readable reason
+    on any mismatch; returns the blob. The decode worker catches the
+    raise and falls back to a local re-prefill (token-exact, just
+    slower) — degradation, not a hung stream or a wrong answer."""
+    if not isinstance(blob, dict):
+        raise ValueError("KV blob is not a mapping")
+    if int(blob.get("v", -1)) != KV_BLOB_VERSION:
+        raise ValueError(f"KV blob version {blob.get('v')!r} != "
+                         f"{KV_BLOB_VERSION}")
+    if blob.get("layout") != layout:
+        raise ValueError(f"KV blob layout {blob.get('layout')!r} does "
+                         f"not match this engine's ({layout!r})")
+    if layout == LAYOUT_PAGED and int(blob.get("page_size", 0)) \
+            != int(page_size):
+        raise ValueError(
+            f"KV blob page_size {blob.get('page_size')!r} != engine "
+            f"page_size {page_size}")
+    if int(blob.get("adapter_id", 0)) != int(adapter_id):
+        # the KV is a function of the adapter that computed it:
+        # installing another tenant's rows would be the wrong-tenant
+        # answer the multi-adapter validation exists to prevent
+        raise ValueError(
+            f"KV blob adapter {blob.get('adapter_id')!r} != request "
+            f"adapter {adapter_id}")
+    covered = int(blob.get("covered", -1))
+    if covered < 0 or covered > max(0, int(prompt_len) - 1):
+        raise ValueError(
+            f"KV blob covers {covered} positions but the prompt has "
+            f"{prompt_len} tokens (at most prompt_len - 1 can be "
+            "prefilled)")
+    leaves = blob.get("leaves")
+    if not isinstance(leaves, (list, tuple)):
+        raise ValueError("KV blob has no leaves list")
+    if covered > 0:
+        sig = [[list(s), str(d)] for s, d in
+               ((tuple(e[0]), e[1]) for e in blob.get("sig") or [])]
+        want = [[list(s), str(d)] for s, d in
+                ((tuple(e[0]), e[1]) for e in expect_sig)]
+        if sig != want:
+            raise ValueError(
+                "KV blob leaf signature does not match this engine's "
+                "cache (different model geometry / dtype / int8 "
+                "setting)")
+        if len(leaves) != len(want):
+            # count BEFORE the per-leaf zip below (zip truncates): a
+            # torn shipment with fewer leaves than its signature must
+            # fail HERE, not as a tree_unflatten error inside step()
+            raise ValueError(
+                f"KV blob ships {len(leaves)} leaves but its "
+                f"signature names {len(want)} (truncated shipment)")
+        for a, (shape, dtype) in zip(leaves, blob["sig"]):
+            # shape/dtype via attributes, NOT np.asarray: a device-
+            # staged leaf (stage_kv_blob) must not pay a blocking d2h
+            # sync just to be looked at
+            if getattr(a, "shape", None) is None:
+                a = np.asarray(a)
+            arr = a
+            if list(arr.shape[1:]) != list(shape) or \
+                    str(arr.dtype) != str(dtype):
+                raise ValueError("KV blob leaf does not match its own "
+                                 "signature (corrupt shipment)")
+            if expect_leading is not None and \
+                    arr.shape[0] != int(expect_leading):
+                raise ValueError(
+                    f"KV blob leaf ships {arr.shape[0]} "
+                    f"pages/rows, engine expects {expect_leading} "
+                    f"for {covered} covered positions")
+    return blob

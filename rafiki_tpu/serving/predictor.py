@@ -112,7 +112,8 @@ class Predictor:
                  default_slo: str = "",
                  slo_shed_depths: Optional[Dict[str, int]] = None,
                  brownout_target_p95_s: float = 0.0,
-                 brownout_clamp_max_new: int = 16) -> None:
+                 brownout_clamp_max_new: int = 16,
+                 kv_ship_min_tokens: int = 4) -> None:
         """``adaptive_gather`` enables the serving latency/accuracy
         controller (the reference paper's batching/wait tradeoff,
         SURVEY.md §3.3 note): instead of always waiting
@@ -137,7 +138,14 @@ class Predictor:
         best-effort caps, stage 2 additionally clamps background
         ``max_new`` to ``brownout_clamp_max_new``, stage 3 pauses
         background entirely. See docs/operations.md "Overload &
-        brownout"."""
+        brownout".
+
+        ``kv_ship_min_tokens`` gates the disaggregated prefill leg:
+        prompts shorter than this many whitespace tokens prefill
+        locally on the decode worker (a short prefill costs less than
+        the shipment wait + page install it would replace); longer
+        prompts route through a prefill-role worker when the pool has
+        one. See docs/operations.md "Disaggregated serving"."""
         self.hub = hub
         self.worker_ids = list(worker_ids)
         self.gather_timeout = gather_timeout
@@ -167,6 +175,7 @@ class Predictor:
         #: default: a long prefill queued behind busy slots is silence
         self.stream_silence_timeout_s = float(stream_silence_timeout_s)
         self.max_stream_failovers = max(0, int(max_stream_failovers))
+        self.kv_ship_min_tokens = max(0, int(kv_ship_min_tokens))
         #: SLO plane: per-job default class, best-effort shed caps,
         #: and the brownout ladder fed by the live interactive p95
         #: (workers publish slo_interactive_ttft_p95_s; the ladder
@@ -854,6 +863,47 @@ class Predictor:
                     # as a forced prompt prefix and continues the
                     # stream past it (TextDecodeEngine.submit)
                     payload["forced_prefix"] = fp
+                elif all(isinstance(q, str) for q in queries) and \
+                        any(len(q.split()) >= self.kv_ship_min_tokens
+                            for q in queries):
+                    # disaggregated prefill/decode: when the pool has a
+                    # prefill-role worker, ship the prompt there FIRST
+                    # (it chews chunked prefill and forwards the KV
+                    # pages to `wid` over the hub) and mark the decode
+                    # leg so `wid` holds admission briefly for the
+                    # shipment — the decode worker's active streams
+                    # never interleave with this prompt's prefill.
+                    # Skipped on failover resumes (the forced prefix
+                    # re-ingest covers a longer prompt than any
+                    # shipment) and for non-text queries (no prompt to
+                    # prefill). Every failure mode — prefill worker
+                    # dead, shipment lost/late/mismatched — degrades
+                    # to the decode worker's local re-prefill.
+                    pw = self.router.select_prefill(exclude=tried)
+                    if pw is not None:
+                        payload["kv_from"] = pw
+                        pre = {k: v for k, v in payload.items()
+                               if k not in ("stream", "kv_from")}
+                        pre["prefill_for"] = wid
+                        try:
+                            self.hub.push_query(pw, pack_message(pre))
+                        except Exception:  # noqa: BLE001 — the leg is
+                            # best-effort: a hub error here must not
+                            # fail the request (the decode push below
+                            # hasn't happened yet). Drop kv_from so
+                            # the decode worker prefills immediately
+                            # instead of waiting out kv_wait_s for a
+                            # shipment that was never dispatched.
+                            payload.pop("kv_from", None)
+                            import logging
+
+                            logging.getLogger(__name__).warning(
+                                "prefill leg push to %s failed; "
+                                "decode worker prefills locally", pw,
+                                exc_info=True)
+                        else:
+                            self.traces.add_span(tid, "prefill_leg",
+                                                 worker=pw, decode=wid)
                 try:
                     self.hub.arm_reply_ttl(
                         qid, remaining + EXPIRY_SKEW_TOLERANCE_S + 30.0)

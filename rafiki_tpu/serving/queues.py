@@ -98,6 +98,33 @@ class QueueHub:
     def get_pool_members(self, pool_id: str) -> Optional[Dict[str, Any]]:
         raise NotImplementedError
 
+    # ---- disaggregated prefill/decode: KV page shipments ----
+    def push_kv(self, worker_id: str, data: bytes) -> None:
+        """Ship a finished KV-page blob to ``worker_id``'s shipment
+        queue (prefill-role worker → decode-role worker; see
+        ``serving/kv_transfer.py``). A dedicated channel, not the
+        query queue: the decode loop drains it non-blockingly between
+        steps and a burst of multi-MB blobs must never delay control
+        or query messages behind it."""
+        raise NotImplementedError
+
+    def pop_kv(self, worker_id: str, timeout: float) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def kv_depth(self, worker_id: str) -> int:
+        """Unconsumed shipments queued for ``worker_id`` (obs only)."""
+        raise NotImplementedError
+
+    # ---- cross-worker shared blobs (prefix snapshots) ----
+    def put_blob(self, key: str, data: bytes) -> None:
+        """Durable named blob (e.g. a job's shared-prefix KV snapshot,
+        ``prefix:<pool>:<adapter>``): prefilled ONCE, imported by every
+        replica instead of each re-running the prefill forward."""
+        raise NotImplementedError
+
+    def get_blob(self, key: str) -> Optional[bytes]:
+        raise NotImplementedError
+
 
 class _KeyQueue:
     """One deque + its OWN condvar. A shared hub-wide condition would
@@ -129,6 +156,7 @@ class InProcQueueHub(QueueHub):
         self._ops = 0
         self._stats: Dict[str, Dict[str, Any]] = {}  # worker counters
         self._pools: Dict[str, Dict[str, Any]] = {}  # pool memberships
+        self._blobs: Dict[str, bytes] = {}  # shared prefix snapshots
         #: armed reply-queue TTLs (key → monotonic deadline): unlike the
         #: idle sweep, an armed TTL fires even while late pushes keep
         #: refreshing last_used (an abandoned STREAM's worker keeps
@@ -236,6 +264,25 @@ class InProcQueueHub(QueueHub):
         with self._meta:
             return self._pools.get(pool_id)
 
+    def push_kv(self, worker_id: str, data: bytes) -> None:
+        self._push(f"kv:{worker_id}", data)
+
+    def pop_kv(self, worker_id: str, timeout: float) -> Optional[bytes]:
+        return self._pop(f"kv:{worker_id}", timeout)
+
+    def kv_depth(self, worker_id: str) -> int:
+        with self._meta:
+            q = self._queues.get(f"kv:{worker_id}")
+        return len(q.dq) if q is not None else 0
+
+    def put_blob(self, key: str, data: bytes) -> None:
+        with self._meta:
+            self._blobs[key] = bytes(data)
+
+    def get_blob(self, key: str) -> Optional[bytes]:
+        with self._meta:
+            return self._blobs.get(key)
+
 
 class KVQueueHub(QueueHub):
     """Queues on the native kv server. Blocking pops hold a socket, so each
@@ -316,3 +363,31 @@ class KVQueueHub(QueueHub):
     def get_pool_members(self, pool_id: str):
         raw = self._client().get(f"pool:{pool_id}")
         return None if raw is None else unpack_message(raw)
+
+    #: KV shipments expire unconsumed: a blob whose decode worker died
+    #: (or re-prefilled locally after its wait window) must not sit in
+    #: the kv store forever — the decode side re-prefills token-exactly
+    #: either way, so a swept shipment costs latency, never correctness
+    KV_SHIP_TTL_S = 60.0
+
+    def push_kv(self, worker_id: str, data: bytes) -> None:
+        c = self._client()
+        c.lpush(f"q:kv:{worker_id}", data)
+        c.expire(f"q:kv:{worker_id}", self.KV_SHIP_TTL_S)
+
+    def pop_kv(self, worker_id: str, timeout: float) -> Optional[bytes]:
+        if timeout <= 0:
+            return self._client().rpop(f"q:kv:{worker_id}")
+        got = self._client().brpop(f"q:kv:{worker_id}", timeout)
+        return None if got is None else got[1]
+
+    def kv_depth(self, worker_id: str) -> int:
+        return self._client().llen(f"q:kv:{worker_id}")
+
+    def put_blob(self, key: str, data: bytes) -> None:
+        # durable like pool membership: a shared-prefix snapshot is
+        # configuration-scale state (prefilled once per deploy)
+        self._client().set(f"blob:{key}", data)
+
+    def get_blob(self, key: str) -> Optional[bytes]:
+        return self._client().get(f"blob:{key}")

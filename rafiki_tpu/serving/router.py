@@ -72,9 +72,14 @@ class _Load:
     """Latest observed load signals for one worker."""
 
     __slots__ = ("pages_ratio", "stalls_total", "stalled_until",
-                 "queue_depth", "wait_p95_s", "class_backlog", "at")
+                 "queue_depth", "wait_p95_s", "class_backlog", "role",
+                 "at")
 
     def __init__(self) -> None:
+        #: disaggregated serving role the worker publishes
+        #: ("unified" / "prefill" / "decode"); unknown until the first
+        #: stats sample — treated as unified (serves everything)
+        self.role = "unified"
         self.pages_ratio = 0.0    # kv_pages_used / kv_pages_total
         #: cumulative admission_stalls counter; None until the first
         #: sample — the first sight is a BASELINE, not growth (a fresh
@@ -126,7 +131,9 @@ class Router:
             "router_probe_picks": 0,         # no closed worker: this
             #                                  request is the half-open
             #                                  probe
-            "router_no_candidate": 0})       # nothing selectable
+            "router_no_candidate": 0,        # nothing selectable
+            "router_prefill_picks": 0})      # prefill legs placed on a
+        #                                      prefill-role worker
 
     # ---- membership ----
     def members(self) -> List[str]:
@@ -212,6 +219,10 @@ class Router:
                 ld.stalls_total = stalls
             if isinstance(p95, (int, float)) and not isinstance(p95, bool):
                 ld.wait_p95_s = float(p95)
+            role = stats.get("role")
+            if isinstance(role, str) and role in ("unified", "prefill",
+                                                  "decode"):
+                ld.role = role
             for cls in ("interactive", "batch", "background"):
                 q = _signal(stats, f"queued_{cls}")
                 if q is not None:
@@ -226,16 +237,22 @@ class Router:
             ld.queue_depth = max(0, int(depth))
 
     def _backlog_members(self) -> List[str]:
-        """Members whose backlog gauges are TRUSTWORTHY: breaker
-        CLOSED only. A dead/stale worker's breaker force-opens, and
-        its last-published ``queued_*`` gauges describe a corpse —
-        summing them would pin the shed gate shut on an idle fleet
-        (the same corpse-pins-the-controller hazard as the brownout
-        p95 feed)."""
+        """Members whose backlog gauges are TRUSTWORTHY serving
+        backlog: breaker CLOSED only. A dead/stale worker's breaker
+        force-opens, and its last-published ``queued_*`` gauges
+        describe a corpse — summing them would pin the shed gate shut
+        on an idle fleet (the same corpse-pins-the-controller hazard
+        as the brownout p95 feed). Prefill-role workers are excluded
+        too: a disaggregated request already counts once on its decode
+        worker, and summing the prefill leg's queues would double-
+        count every shipment (shedding below the operator's depth cap
+        while decode capacity sits idle)."""
         snap = self._board.snapshot()
         with self._lock:
             return [w for w in self._members
-                    if (snap.get(w) or {}).get("state") == CLOSED]
+                    if (snap.get(w) or {}).get("state") == CLOSED
+                    and (w not in self._load
+                         or self._load[w].role != "prefill")]
 
     def total_queue_depth(self) -> int:
         """Unpopped query-queue messages summed over live (breaker-
@@ -285,18 +302,38 @@ class Router:
             return (1 if now < ld.stalled_until else 0, ld.queue_depth,
                     ld.pages_ratio, ld.wait_p95_s, idx)
 
+    def role_of(self, wid: str) -> str:
+        """The worker's published disaggregation role (``unified``
+        until its first stats sample says otherwise)."""
+        with self._lock:
+            ld = self._load.get(wid)
+            return ld.role if ld is not None else "unified"
+
     # ---- selection ----
     def select(self, key: Optional[str] = None,
                exclude: Sequence[str] = ()) -> Optional[str]:
-        """Pick ONE worker for a request.
+        """Pick ONE worker for a request's DECODE leg.
 
         Order: the key's HRW owner when healthy and unsaturated
         (affinity hit) → least-loaded healthy worker (redirect /
         keyless placement) → at most one due half-open probe → None
-        (no candidate; the caller's resumable-error path)."""
+        (no candidate; the caller's resumable-error path).
+
+        ``prefill``-role workers are excluded: they exist to chew
+        prompts and ship KV pages (:meth:`select_prefill`), and a
+        stream placed there would decode on the wrong side of the
+        split. The HRW hash ALSO skips them, so a worker flipping
+        role only remaps its own keys — the affinity minimal-remap
+        property survives disaggregation. When the pool is prefill-
+        only (a misconfiguration), they serve anyway: degraded beats
+        unservable."""
         with self._lock:
             members = list(self._members)
-        cands = [w for w in members if w not in exclude]
+            serving = [w for w in members
+                       if w not in exclude
+                       and (w not in self._load
+                            or self._load[w].role != "prefill")]
+        cands = serving or [w for w in members if w not in exclude]
         if not cands:
             self.counters.inc("router_no_candidate")
             return None
@@ -331,6 +368,34 @@ class Router:
         self.counters.inc("router_no_candidate")
         return None
 
+    def select_prefill(self, exclude: Sequence[str] = ()
+                       ) -> Optional[str]:
+        """Pick the worker for a request's PREFILL leg: the
+        least-loaded healthy ``prefill``-role member, or None when the
+        pool has none (the caller serves unified — prefill runs on the
+        decode worker exactly as before disaggregation). No probe
+        fallback here: the prefill leg is an optimization, and probing
+        a sick worker with it would spend the half-open budget on
+        traffic whose failure is invisible (fire-and-forget)."""
+        with self._lock:
+            members = list(self._members)
+            cands = [w for w in members
+                     if w not in exclude and w in self._load
+                     and self._load[w].role == "prefill"]
+        if not cands:
+            return None
+        snap = self._board.snapshot()
+        healthy = [w for w in cands
+                   if (st := snap.get(w)) is not None
+                   and st.get("state") == CLOSED
+                   and not st.get("draining")]
+        if not healthy:
+            return None
+        pick = min(healthy,
+                   key=lambda w: self._rank(w, members.index(w)))
+        self.counters.inc("router_prefill_picks")
+        return pick
+
     # ---- read-out ----
     def affinity_hit_rate(self) -> float:
         """Fraction of keyed selections that landed on their HRW owner
@@ -349,7 +414,8 @@ class Router:
             load = {wid: {"pages_ratio": round(ld.pages_ratio, 4),
                           "queue_depth": ld.queue_depth,
                           "wait_p95_s": round(ld.wait_p95_s, 4),
-                          "stalled": now < ld.stalled_until}
+                          "stalled": now < ld.stalled_until,
+                          "role": ld.role}
                     for wid, ld in self._load.items()}
             members = list(self._members)
         return {"members": members,

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import collections
 import math
+import os
 import threading
 import time
 import traceback
@@ -37,6 +38,7 @@ import numpy as np
 from ..model.base import BaseModel
 from ..obs import (MetricsRegistry, ObsServer, StatsMap, TraceBuffer,
                    mint_trace_id)
+from ..serving.kv_transfer import normalize_role
 from ..serving.queues import (EXPIRY_SKEW_TOLERANCE_S, QueueHub,
                               pack_message, unpack_message)
 from ..serving.slo import SLO_CLASSES, normalize_slo
@@ -47,6 +49,14 @@ from ..store.param_store import ParamStore
 #: cross-host clock skew, so it is a fraction of the wall-clock
 #: EXPIRY_SKEW_TOLERANCE_S it replaces
 TTL_EXPIRY_PAD_S = 0.5
+
+#: prefill-role outbox give-up window: generous enough for the
+#: slowest chunked prefill to finish and ship, small enough that
+#: never-completing legs (engine reset dropped the slot) can't grow
+#: the outbox unboundedly on a long-lived worker. A pruned leg's
+#: decode side re-prefilled locally when ITS (much shorter) kv_wait_s
+#: window expired — pruning loses nothing.
+_KV_OUTBOX_TTL_S = 600.0
 
 
 class ClockSkewEstimator:
@@ -94,10 +104,44 @@ class InferenceWorker:
                  kv_page_size: int = 0, kv_pages: int = 0,
                  paged_kernel: Optional[bool] = None,
                  default_slo: str = "",
+                 role: str = "", host_kv_pages: int = 0,
+                 kv_wait_s: float = 1.5, pool_id: str = "",
                  chaos: Optional[Any] = None) -> None:
         self.worker_id = worker_id
         self.hub = hub
         self.max_batch_msgs = max_batch_msgs
+        #: disaggregated serving role (``unified`` default): a
+        #: ``prefill`` worker chews prompts through chunked prefill and
+        #: ships the finished KV pages to the decode leg's worker over
+        #: the hub; a ``decode`` worker holds shipped-KV requests for
+        #: up to ``kv_wait_s`` and installs the blob at admission —
+        #: falling back to a local re-prefill (token-exact, just
+        #: slower) when the shipment is late, lost, or mismatched.
+        #: Validated at boot: a typo'd role silently serving unified
+        #: would defeat the router's placement policy.
+        self.role = normalize_role(role)
+        self.kv_wait_s = max(0.0, float(kv_wait_s))
+        #: the job's pool id (scale-out plane): keys the shared
+        #: prefix-snapshot blob so one replica's prefill serves all
+        self.pool_id = str(pool_id or "")
+        #: decode-role holding pen: message id -> (message, monotonic
+        #: give-up deadline, {qi: blob}) — submitted when every
+        #: query's shipment lands or the wait window expires
+        self._pending_kv: Dict[Any, List[Any]] = {}
+        #: prefill-role outbox: message id -> [ship-to worker id,
+        #: trace id, queries still owed, monotonic give-up deadline];
+        #: poll_kv completions are forwarded against it and decrement
+        #: the owed count — the entry dies at zero, or at the deadline
+        #: for legs whose slots never produce a blob (engine reset,
+        #: preemption), so a long-lived prefill worker's outbox stays
+        #: bounded by in-flight legs instead of growing per message
+        self._kv_outbox: Dict[Any, List[Any]] = {}
+        #: flipped by the first held shipped-KV request: from then on
+        #: the pump keeps draining the shipment queue even with
+        #: nothing pending, so late blobs for already-admitted
+        #: requests don't accumulate; workers that never see
+        #: disaggregated traffic skip the drain entirely
+        self._kv_seen_traffic = False
         #: admission class applied to requests that carry no ``slo``
         #: of their own (the per-job default; per-request override
         #: rides the scatter payload). Validated at boot: a typo'd
@@ -109,7 +153,16 @@ class InferenceWorker:
         #: "the predictor only sees timeouts" (clock skew, ADVICE r3).
         #: drain_rejected counts messages error-replied while draining.
         self.stats = StatsMap({"dropped_expired": 0,
-                               "drain_rejected": 0})
+                               "drain_rejected": 0,
+                               # disaggregated prefill/decode: blobs
+                               # shipped out (prefill role), installed
+                               # from the wire (decode role), and the
+                               # degradations — wait window expired /
+                               # blob rejected → local re-prefill
+                               "kv_ships_sent": 0,
+                               "kv_imports_installed": 0,
+                               "kv_wait_timeouts": 0,
+                               "kv_import_fallbacks": 0})
         #: deterministic fault injection (tests / chaos drills): either
         #: passed programmatically or armed via the RAFIKI_CHAOS env
         #: var; when armed, queue-level faults ride a ChaosHub wrapper
@@ -166,6 +219,14 @@ class InferenceWorker:
             "one fused engine step() — admission + K decode tokens "
             "(seconds); read next to paged_kernel_active to see the "
             "kernel-vs-gather difference on a live worker")
+        self._h_kv_transfer = self.metrics.histogram(
+            "kv_transfer_seconds",
+            "one host-tier page transfer (evict d2h or prefetch "
+            "staging) on the tier thread (seconds); persistently large"
+            " values mean the tier thrashes — grow HBM pages or shrink"
+            " host_kv_pages",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1.0, 2.5))
         # class-labeled latency histograms: the brownout ladder feeds
         # on the INTERACTIVE p95 alone, and an SLO story without
         # per-class latency evidence is unverifiable. Same metric
@@ -220,12 +281,39 @@ class InferenceWorker:
         draft_for_admission = None
         if draft_trial_id and decode_loop and speculate_k >= 2:
             draft_for_admission = model_class(**(draft_knobs or knobs))
+        if host_kv_pages and not (decode_loop and kv_page_size):
+            raise ValueError(
+                "host_kv_pages requires decode_loop and kv_page_size "
+                "> 0 (the host tier spills KV PAGES)")
+        #: cross-worker prefix sharing: when a pool peer already
+        #: published the shared prefix's KV snapshot, SKIP the local
+        #: prefix prefill (build without system_prefix) and import the
+        #: blob after boot — prefilled once per pool, not per replica.
+        #: Single-adapter deployments only (per-adapter snapshots stay
+        #: per-worker); best-effort — a hub hiccup just re-prefills.
+        self._peer_prefix_blob: Optional[dict] = None
+        self._system_prefix = str(system_prefix or "")
+        if self.pool_id and system_prefix and decode_loop \
+                and not extra_adapter_trials:
+            try:
+                raw = self.hub.get_blob(f"prefix:{self.pool_id}:0")
+                if raw is not None:
+                    self._peer_prefix_blob = unpack_message(raw)
+                    system_prefix = ""  # peer's snapshot replaces the
+                    #                     local prefix prefill entirely
+            except Exception:  # rafiki: noqa[silent-except] — sharing
+                pass           # is an optimization, never a boot gate
+        if self.role != "unified" and not decode_loop:
+            raise ValueError(
+                f"worker role {self.role!r} requires decode_loop: the "
+                "micro-batch path has no KV to disaggregate")
         self._admission_check(
             max_slots if decode_loop else 0,
             len(extra_adapter_trials or ()) if decode_loop else 0,
             draft_for_admission,
             kv_page_size=kv_page_size if decode_loop else 0,
-            kv_pages=kv_pages if decode_loop else 0)
+            kv_pages=kv_pages if decode_loop else 0,
+            host_kv_pages=host_kv_pages if decode_loop else 0)
         self.engine = None
         if draft_trial_id and (not decode_loop or speculate_k < 2):
             # fail loudly, like the multi-adapter misconfigurations: an
@@ -270,6 +358,8 @@ class InferenceWorker:
                          "kv_pages": kv_pages}
                 if paged_kernel is not None:
                     extra["paged_kernel"] = bool(paged_kernel)
+                if host_kv_pages:
+                    extra["host_kv_pages"] = int(host_kv_pages)
             try:
                 self.engine = self.model.make_multi_adapter_engine(
                     trees, max_slots=max_slots,
@@ -307,6 +397,10 @@ class InferenceWorker:
                         # explicit kernel-vs-gather override; absent =
                         # the ops-level auto rule (kernel on TPU only)
                         extra["paged_kernel"] = bool(paged_kernel)
+                    if host_kv_pages:
+                        # host-RAM page tier: the admission budget
+                        # becomes HBM + host pages (serving/kv_tier.py)
+                        extra["host_kv_pages"] = int(host_kv_pages)
                 if draft_trial_id and speculate_k:
                     # draft-MODEL speculation: a second (smaller) trial
                     # drafts; its own knobs shape it (same tokenizer
@@ -331,6 +425,15 @@ class InferenceWorker:
                     "%s has no make_decode_engine; serving through the "
                     "predict() micro-batcher instead of the continuous-"
                     "batching decode loop", model_class.__name__)
+        if self.role != "unified" and not getattr(
+                self.engine, "supports_kv_ship", False):
+            # fail the DEPLOY, not the serve thread: a role-configured
+            # worker whose engine cannot extract/install KV shipments
+            # would silently serve unified and defeat the placement
+            raise ValueError(
+                f"worker role {self.role!r} requires an engine with "
+                "KV shipment support (supports_kv_ship); this "
+                "deployment's engine has none")
         if self.engine is not None:
             # engine counters surface on /metrics under their BARE
             # names (kv_pages_used, admission_stalls, …) — the hub
@@ -343,11 +446,20 @@ class InferenceWorker:
             if hasattr(self.engine, "span_sink"):
                 # request-lifecycle events -> trace spans + histograms
                 self.engine.span_sink = self._engine_span
+            tier = getattr(getattr(self.engine, "engine", self.engine),
+                           "tier", None)
+            if tier is not None:
+                # host-tier transfers feed the worker's latency
+                # histogram (observed on the tier thread — the
+                # registry's instruments are locked)
+                tier.observe_transfer = self._h_kv_transfer.observe
         self._warmup()
+        self._share_prefix_snapshot()
 
     def _admission_check(self, max_slots: int, n_extra_adapters: int,
                          draft=None, kv_page_size: int = 0,
-                         kv_pages: int = 0) -> None:
+                         kv_pages: int = 0,
+                         host_kv_pages: int = 0) -> None:
         """Refuse a deployment whose serving footprint (params + KV
         cache + stacked adapters + draft params/cache + working set)
         exceeds the device's HBM, BEFORE any engine build/compile —
@@ -376,6 +488,11 @@ class InferenceWorker:
                 # paged KV keep admitting their deployments
                 kwargs["kv_page_size"] = kv_page_size
                 kwargs["kv_pages"] = kv_pages
+                if host_kv_pages:
+                    # host tier: validated by the estimator (mirrors
+                    # the engine rule) and reported as host RAM — it
+                    # never counts toward the HBM total below
+                    kwargs["host_kv_pages"] = host_kv_pages
             budget = est(**kwargs)
             total = int(budget["total"])
         except Exception as e:  # an estimator bug must never block an
@@ -432,11 +549,59 @@ class InferenceWorker:
                 # engine
                 self.engine.reset()
 
+    def _share_prefix_snapshot(self) -> None:
+        """Cross-worker prefix sharing (scale-out pools): a shared
+        system prefix prefilled by ONE replica serves every replica of
+        the job. The replica that found a peer's published blob at
+        boot skipped its own prefix prefill entirely and installs the
+        blob here; the first replica (no blob yet) publishes the
+        snapshot it just computed. Both snapshots are bit-identical
+        (same module/params/tokenizer) so which replica wins the
+        publish race is immaterial; best-effort by design — any
+        failure leaves a locally-computed snapshot serving."""
+        if not self.pool_id or self.engine is None \
+                or not self._system_prefix:
+            return
+        exp = getattr(self.engine, "export_prefix", None)
+        imp = getattr(self.engine, "import_prefix", None)
+        if exp is None or imp is None:
+            return
+        import logging
+
+        key = f"prefix:{self.pool_id}:0"
+        if self._peer_prefix_blob is not None:
+            blob, self._peer_prefix_blob = self._peer_prefix_blob, None
+            try:
+                imp(blob)
+                self.stats.inc("kv_imports_installed")
+            except Exception:  # noqa: BLE001 — a bad/stale peer blob
+                # must not leave the worker prefix-less: fall back to
+                # computing the snapshot locally (what an unshared
+                # boot would have done)
+                logging.getLogger(__name__).warning(
+                    "peer prefix snapshot rejected; registering the "
+                    "prefix locally", exc_info=True)
+                self.engine.register_prefix(self._system_prefix)
+            return
+        try:
+            blob = exp()
+            if blob is not None and self.hub.get_blob(key) is None:
+                self.hub.put_blob(key, pack_message(blob))
+        except Exception:  # noqa: BLE001 — publishing is a peer
+            # optimization; this worker's own snapshot already serves
+            logging.getLogger(__name__).warning(
+                "prefix snapshot publish failed", exc_info=True)
+
     def stop(self) -> None:
         self._stop.set()
         if self._obs_server is not None:
             self._obs_server.stop()
             self._obs_server = None
+        close = getattr(self.engine, "close", None)
+        if close is not None:
+            # tiered engines own a transfer thread + pinned host pool;
+            # micro-batch engines have no close and need none
+            close()
 
     def drain(self) -> None:
         """Begin a graceful drain: stop admitting new requests (they
@@ -502,6 +667,9 @@ class InferenceWorker:
         the live dict here used to be able to blow up with "dictionary
         changed size during iteration" under load)."""
         stats = self.stats.snapshot()
+        stats["role"] = self.role  # disaggregated placement: the
+        # router excludes prefill-role workers from serving selection
+        # and targets them for the prefill leg
         stats["draining"] = self._draining.is_set()  # breaker-board
         # scatter exclusion during rolling restarts; the respawned
         # worker's fresh False is what re-admits the id
@@ -675,6 +843,17 @@ class InferenceWorker:
     # ---- the loop ----
     def run(self, poll_timeout: float = 0.5,
             max_iterations: Optional[int] = None) -> None:
+        if self.role == "prefill":
+            # prefill is throughput work; decode is latency work. On a
+            # co-located host the prompt chew must never preempt a
+            # decode loop's step, so the prefill serve thread runs
+            # niced (Linux niceness is per-thread; pid 0 = this
+            # thread). Best-effort — a host that refuses leaves both
+            # threads at default priority.
+            try:
+                os.setpriority(os.PRIO_PROCESS, 0, 10)
+            except (AttributeError, OSError):
+                pass
         if self.engine is not None:
             return self._run_decode_loop(poll_timeout, max_iterations)
         n = 0
@@ -734,7 +913,10 @@ class InferenceWorker:
             n += 1
             if n % self.STATS_EVERY == 1:  # incl. first iteration
                 self._publish_stats()
-            busy = self.engine.busy
+            # held shipped-KV requests count as busy: the loop must
+            # keep pumping the shipment queue instead of parking on an
+            # empty query queue while a blob is in flight
+            busy = self.engine.busy or bool(self._pending_kv)
             raw = self.hub.pop_query(self.worker_id,
                                      0.0 if busy else poll_timeout)
             while raw is not None:
@@ -754,96 +936,34 @@ class InferenceWorker:
                     self._reject_expired(m)
                     raw = self.hub.pop_query(self.worker_id, 0.0)
                     continue
-                qs = m["queries"]
-                qs = list(qs) if not isinstance(qs, (list, tuple)) else qs
-                if not qs:  # answer empty messages immediately, like
-                    # _serve_batch does — nothing will ever poll() for them
-                    self.hub.push_prediction(m["id"], pack_message(
-                        {"id": m["id"], "worker_id": self.worker_id,
-                         "predictions": []}))
+                if m.get("prefill_for"):
+                    # the PREFILL leg of a disaggregated stream: chew
+                    # the prompt, ship the KV pages to the decode
+                    # worker named in the payload. Never replied to —
+                    # the decode leg's local re-prefill covers every
+                    # failure mode here
+                    self._handle_prefill_leg(m)
+                elif m.get("kv_from") and self._can_import_kv():
+                    # the DECODE leg: a prefill worker is computing
+                    # this prompt's KV — hold admission for up to
+                    # kv_wait_s so the shipment can skip our prefill
+                    mid = m["id"]
+                    self._kv_seen_traffic = True
+                    self._pending_kv[mid] = [
+                        m, time.monotonic() + self.kv_wait_s, {},
+                        time.monotonic()]
                 else:
-                    tid = str(m.get("trace_id") or "") or mint_trace_id()
-                    t_queued = time.monotonic()
-                    self.traces.start(tid, request_id=str(m["id"]),
-                                      span="queued",
-                                      worker=self.worker_id,
-                                      n_queries=len(qs))
-                    samp = _safe_sampling(m.get("sampling"))
-                    # admission class: per-request override riding the
-                    # payload, else the job default. Defensive like
-                    # _safe_sampling: the predictor validates, but a
-                    # malformed value must degrade to the default,
-                    # never raise inside the serve loop
-                    try:
-                        slo = normalize_slo(m.get("slo"),
-                                            default=self.default_slo)
-                    except ValueError:
-                        slo = self.default_slo
-                    if "max_new" in samp:
-                        # per-request generation length, clamped by the
-                        # worker's configured cap: a client must not be
-                        # able to occupy a slot for longer than the
-                        # operator budgeted. getattr: duck-typed user
-                        # engines without a cap must not let a client
-                        # field kill the serve thread
-                        samp["max_new"] = min(
-                            samp["max_new"],
-                            getattr(self.engine, "max_new",
-                                    samp["max_new"]))
-                    fp = m.get("forced_prefix")
-                    fp = fp if isinstance(fp, dict) else {}
-                    if fp:
-                        self.traces.add_span(
-                            tid, "resumed",
-                            prefix_chars=sum(len(str(v))
-                                             for v in fp.values()))
-                    try:
-                        if fp and not getattr(self.engine,
-                                              "supports_resume",
-                                              False):
-                            # checked BEFORE any submit (a per-query
-                            # check would leak the message's earlier
-                            # queries into the engine when a later one
-                            # rejects) — and structured, never a
-                            # TypeError that kills the thread
-                            raise ValueError(
-                                "engine does not support stream "
-                                "resume (forced_prefix)")
-                        for qi, text in enumerate(qs):
-                            kwargs = dict(samp)
-                            prefix = str(fp.get(str(qi), "") or "")
-                            if prefix:
-                                kwargs["forced_prefix"] = prefix
-                            if getattr(self.engine, "supports_slo",
-                                       False):
-                                # capability-gated like forced_prefix:
-                                # a duck-typed user engine without the
-                                # kwarg serves classless FIFO instead
-                                # of dying on a TypeError
-                                kwargs["slo"] = slo
-                            self._req_obs[(m["id"], qi)] = (tid,
-                                                            t_queued,
-                                                            slo)
-                            self.engine.submit((m["id"], qi), str(text),
-                                               **kwargs)
-                    except ValueError as e:
-                        # e.g. adapter_id out of range on a multi-
-                        # adapter engine: reject the whole message —
-                        # serving a different fine-tune than requested
-                        # would be a correct-looking wrong answer
-                        for qi in range(len(qs)):
-                            self._req_obs.pop((m["id"], qi), None)
-                        self.traces.add_span(tid, "rejected",
-                                             error=str(e))
-                        self.hub.push_prediction(m["id"], pack_message(
-                            {"id": m["id"],
-                             "worker_id": self.worker_id,
-                             "predictions": [], "error": str(e)}))
-                    else:
-                        inflight[m["id"]] = [len(qs), {}]
-                        if m.get("stream"):
-                            streaming.add(m["id"])
+                    if m.get("kv_from"):
+                        # can't hold for the shipment (kv_wait_s=0 or
+                        # no shipment-capable engine) but a prefill
+                        # worker WILL push blobs for this request: the
+                        # pump must keep draining the shipment queue
+                        # (dropping unmatched blobs) or the multi-MB
+                        # pushes accumulate unboundedly
+                        self._kv_seen_traffic = True
+                    self._admit_decode_message(m, inflight, streaming)
                 raw = self.hub.pop_query(self.worker_id, 0.0)
+            self._pump_kv_shipments(inflight, streaming)
             stepped = self.engine.busy
             if stepped:
                 try:
@@ -918,10 +1038,291 @@ class InferenceWorker:
                         self._req_obs.pop((mid, i), None)
                     del inflight[mid]
                     streaming.discard(mid)
+            self._ship_finished_prefill()
             if self._draining.is_set() and not inflight \
-                    and not self.engine.busy:
+                    and not self._pending_kv and not self.engine.busy:
                 break  # drain complete: every in-flight stream answered
         self._publish_stats()  # final counters visible after stop
+
+    # ---- disaggregated prefill/decode (see serving/kv_transfer.py) --
+    def _can_import_kv(self) -> bool:
+        """May this worker hold a request for a KV shipment? Any
+        shipment-capable engine qualifies (a unified worker benefits
+        the same way when the router chose to disaggregate); a
+        zero wait window disables holding entirely."""
+        return (self.kv_wait_s > 0
+                and getattr(self.engine, "supports_kv_ship", False))
+
+    def _handle_prefill_leg(self, m: dict) -> None:
+        """Run a disaggregated request's PREFILL leg: submit each query
+        prefill-only and remember where the finished KV blobs ship
+        (:meth:`_ship_finished_prefill`). Fire-and-forget by contract —
+        on ANY local failure the decode worker's wait window expires
+        and it re-prefills locally (token-exact), so this path only
+        logs, never replies."""
+        import logging
+
+        ship_to = str(m.get("prefill_for") or "")
+        sub = getattr(self.engine, "submit_prefill", None)
+        if not ship_to or sub is None or self._draining.is_set() \
+                or _expired(m, skew_est=self._skew):
+            return
+        qs = m.get("queries")
+        qs = list(qs) if not isinstance(qs, (list, tuple)) else qs
+        samp = _safe_sampling(m.get("sampling"))
+        tid = str(m.get("trace_id") or "") or mint_trace_id()
+        try:
+            slo = normalize_slo(m.get("slo"), default=self.default_slo)
+        except ValueError:
+            slo = self.default_slo
+        kwargs = {"slo": slo}
+        if samp.get("adapter_id"):
+            # the KV is a function of the adapter that computes it —
+            # the decode side validates the blob against the request's
+            kwargs["adapter_id"] = samp["adapter_id"]
+        self.traces.start(tid, request_id=str(m.get("id") or ""),
+                          span="prefill_leg", worker=self.worker_id,
+                          ship_to=ship_to, n_queries=len(qs))
+        try:
+            for qi, text in enumerate(qs):
+                sub((m["id"], qi), str(text), **kwargs)
+        except ValueError as e:
+            logging.getLogger(__name__).warning(
+                "%s prefill leg rejected (%s); decode worker will "
+                "re-prefill locally", self.worker_id, e)
+            return
+        self._kv_outbox[m["id"]] = [
+            ship_to, tid, len(qs),
+            time.monotonic() + _KV_OUTBOX_TTL_S]
+
+    def _ship_finished_prefill(self) -> None:
+        """Forward completed prefill-only KV blobs to their decode
+        workers. Costs one no-op call on workers with no prefill
+        traffic (the engine's done list is empty)."""
+        poll = getattr(self.engine, "poll_kv", None)
+        if poll is None:
+            return
+        for (mid, qi), blob in poll():
+            entry = self._kv_outbox.get(mid)
+            if entry is None:
+                continue
+            ship_to, tid = entry[0], entry[1]
+            entry[2] -= 1  # shipped OR failed, this query is settled
+            if entry[2] <= 0:
+                del self._kv_outbox[mid]
+            try:
+                self.hub.push_kv(ship_to, pack_message(
+                    {"id": mid, "qi": int(qi), "blob": blob,
+                     "from": self.worker_id}))
+                self.stats.inc("kv_ships_sent")
+                self.traces.add_span(tid, "kv_shipped", qi=int(qi),
+                                     nbytes=int(blob.get("nbytes", 0)
+                                                or 0))
+            except Exception:  # noqa: BLE001 — a failed shipment is
+                # the decode side's local re-prefill, not our crash
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "%s KV shipment to %s failed", self.worker_id,
+                    ship_to, exc_info=True)
+        if self._kv_outbox:
+            # legs whose slots will never produce a blob (engine
+            # reset, preemption of a prefill-only slot) must not
+            # accumulate forever; the decode side's wait window
+            # expired into a local re-prefill long ago
+            now = time.monotonic()
+            for mid in [k for k, e in self._kv_outbox.items()
+                        if now > e[3]]:
+                del self._kv_outbox[mid]
+
+    def _kv_stage_budget_ok(self) -> bool:
+        """Eagerly device-stage an arriving KV blob only when it will
+        install soon. With the engine's admission queue backed up, a
+        staged blob sits device-RESIDENT for its whole wait — a burst
+        of disaggregated arrivals on a saturated decode worker would
+        pin queue-depth × blob-size HBM the unified path never pays.
+        Unstaged blobs install from their host bytes at seat time:
+        exactly as correct, just without the upload/step overlap."""
+        if len(self._pending_kv) > 4:
+            return False
+        st = self.engine.stats
+        return not any(st.get(f"queued_{c}", 0)
+                       for c in ("interactive", "batch", "background"))
+
+    def _pump_kv_shipments(self, inflight: dict, streaming: set) -> None:
+        """Decode-leg intake: drain arrived KV shipments into held
+        requests, admit every request whose blobs are complete, and
+        expire wait windows into local re-prefills. Runs once per loop
+        iteration, non-blocking; free when nothing is pending."""
+        if not self._pending_kv and not self._kv_seen_traffic:
+            return
+        now = time.monotonic()
+        raw = self.hub.pop_kv(self.worker_id, 0.0)
+        while raw is not None:
+            try:
+                ship = unpack_message(raw)
+                mid, qi = ship["id"], int(ship["qi"])
+                blob = ship["blob"]
+            except Exception:  # noqa: BLE001 — a torn shipment is a
+                # degradation (local re-prefill), never a serve-thread
+                # crash
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "%s discarding undecodable KV shipment",
+                    self.worker_id, exc_info=True)
+                blob = None
+                mid = qi = None
+            if mid is not None and mid in self._pending_kv \
+                    and blob is not None:
+                stage = getattr(self.engine, "stage_kv_blob", None)
+                if stage is not None and self._kv_stage_budget_ok():
+                    try:
+                        # device staging starts NOW, overlapping the
+                        # in-flight step: admission installs a blob
+                        # whose h2d copies already ran
+                        blob = stage(blob)
+                    except Exception:  # rafiki: noqa[silent-except] —
+                        pass           # staging is an optimization
+                self._pending_kv[mid][2][qi] = blob
+            raw = self.hub.pop_kv(self.worker_id, 0.0)
+        for mid in list(self._pending_kv):
+            m, deadline, blobs, t_queued = self._pending_kv[mid]
+            qs = m.get("queries")
+            n = len(qs) if isinstance(qs, (list, tuple)) else 1
+            if len(blobs) >= n:
+                del self._pending_kv[mid]
+                self._admit_decode_message(m, inflight, streaming,
+                                           kv_blobs=blobs,
+                                           t_queued=t_queued)
+            elif now >= deadline or self._draining.is_set():
+                # shipment late/lost (or we are draining and must not
+                # wait): degrade to a local re-prefill — token-exact,
+                # the stream just pays the prefill it hoped to skip
+                del self._pending_kv[mid]
+                self.stats.inc("kv_wait_timeouts")
+                self._admit_decode_message(m, inflight, streaming,
+                                           t_queued=t_queued)
+        if self._pending_kv and not self.engine.busy:
+            # nothing to decode while the blob is in flight: yield the
+            # CPU briefly instead of hot-spinning the loop, but stay
+            # far under shipment latency so installs are prompt
+            time.sleep(0.002)
+
+    def _admit_decode_message(self, m: dict, inflight: dict,
+                              streaming: set,
+                              kv_blobs: Optional[Dict[int, Any]] = None,
+                              t_queued: Optional[float] = None) -> None:
+        """Admit one popped message into the engine (the decode loop's
+        submission path, shared by immediate admission and the
+        deferred shipped-KV path). ``kv_blobs``: per-query-index KV
+        shipments to install instead of prefilling; a blob the engine
+        rejects degrades that query to a local re-prefill."""
+        qs = m["queries"]
+        qs = list(qs) if not isinstance(qs, (list, tuple)) else qs
+        if not qs:  # answer empty messages immediately, like
+            # _serve_batch does — nothing will ever poll() for them
+            self.hub.push_prediction(m["id"], pack_message(
+                {"id": m["id"], "worker_id": self.worker_id,
+                 "predictions": []}))
+            return
+        tid = str(m.get("trace_id") or "") or mint_trace_id()
+        if t_queued is None:
+            t_queued = time.monotonic()
+        self.traces.start(tid, request_id=str(m["id"]),
+                          span="queued",
+                          worker=self.worker_id,
+                          n_queries=len(qs))
+        samp = _safe_sampling(m.get("sampling"))
+        # admission class: per-request override riding the
+        # payload, else the job default. Defensive like
+        # _safe_sampling: the predictor validates, but a
+        # malformed value must degrade to the default,
+        # never raise inside the serve loop
+        try:
+            slo = normalize_slo(m.get("slo"),
+                                default=self.default_slo)
+        except ValueError:
+            slo = self.default_slo
+        if "max_new" in samp:
+            # per-request generation length, clamped by the
+            # worker's configured cap: a client must not be
+            # able to occupy a slot for longer than the
+            # operator budgeted. getattr: duck-typed user
+            # engines without a cap must not let a client
+            # field kill the serve thread
+            samp["max_new"] = min(
+                samp["max_new"],
+                getattr(self.engine, "max_new",
+                        samp["max_new"]))
+        fp = m.get("forced_prefix")
+        fp = fp if isinstance(fp, dict) else {}
+        if fp:
+            self.traces.add_span(
+                tid, "resumed",
+                prefix_chars=sum(len(str(v))
+                                 for v in fp.values()))
+        try:
+            if fp and not getattr(self.engine,
+                                  "supports_resume",
+                                  False):
+                # checked BEFORE any submit (a per-query
+                # check would leak the message's earlier
+                # queries into the engine when a later one
+                # rejects) — and structured, never a
+                # TypeError that kills the thread
+                raise ValueError(
+                    "engine does not support stream "
+                    "resume (forced_prefix)")
+            for qi, text in enumerate(qs):
+                kwargs = dict(samp)
+                prefix = str(fp.get(str(qi), "") or "")
+                if prefix:
+                    kwargs["forced_prefix"] = prefix
+                if getattr(self.engine, "supports_slo",
+                           False):
+                    # capability-gated like forced_prefix:
+                    # a duck-typed user engine without the
+                    # kwarg serves classless FIFO instead
+                    # of dying on a TypeError
+                    kwargs["slo"] = slo
+                self._req_obs[(m["id"], qi)] = (tid,
+                                                t_queued,
+                                                slo)
+                blob = None if kv_blobs is None else kv_blobs.get(qi)
+                if blob is not None and not prefix:
+                    try:
+                        self.engine.submit((m["id"], qi), str(text),
+                                           kv_blob=blob, **kwargs)
+                        self.stats.inc("kv_imports_installed")
+                        self.traces.add_span(tid, "kv_installed",
+                                             qi=qi)
+                        continue
+                    except ValueError:
+                        # mismatched/corrupt shipment: degrade THIS
+                        # query to a local re-prefill; a genuine
+                        # submit error re-raises below and rejects
+                        # the message as before
+                        self.stats.inc("kv_import_fallbacks")
+                self.engine.submit((m["id"], qi), str(text),
+                                   **kwargs)
+        except ValueError as e:
+            # e.g. adapter_id out of range on a multi-
+            # adapter engine: reject the whole message —
+            # serving a different fine-tune than requested
+            # would be a correct-looking wrong answer
+            for qi in range(len(qs)):
+                self._req_obs.pop((m["id"], qi), None)
+            self.traces.add_span(tid, "rejected",
+                                 error=str(e))
+            self.hub.push_prediction(m["id"], pack_message(
+                {"id": m["id"],
+                 "worker_id": self.worker_id,
+                 "predictions": [], "error": str(e)}))
+        else:
+            inflight[m["id"]] = [len(qs), {}]
+            if m.get("stream"):
+                streaming.add(m["id"])
 
     def _serve_batch(self, messages: List[dict]) -> None:
         # flatten all messages' queries into one forward pass
@@ -1151,7 +1552,11 @@ def main(argv: Optional[list] = None) -> int:
         kv_page_size=int(cfg.get("kv_page_size", 0)),
         kv_pages=int(cfg.get("kv_pages", 0)),
         paged_kernel=_tristate(cfg.get("paged_kernel")),
-        default_slo=str(cfg.get("default_slo", "")))
+        default_slo=str(cfg.get("default_slo", "")),
+        role=str(cfg.get("role", "")),
+        host_kv_pages=int(cfg.get("host_kv_pages", 0)),
+        kv_wait_s=float(cfg.get("kv_wait_s", 1.5)),
+        pool_id=str(cfg.get("pool_id", "")))
     # observability sidecar: /metrics + /debug/requests on an ephemeral
     # (or configured) port, written to obs_port_file for the operator
     obs_host, obs_port = worker.serve_obs(

@@ -1,0 +1,23 @@
+"""Positive fixture: synchronous device->host transfers inside a
+decode engine's step loop (directly and via a step-reachable
+helper)."""
+
+import jax
+import numpy as np
+
+
+class Engine:
+    def submit(self, rid, prompt):
+        self.queue.append((rid, prompt))
+
+    def step(self):
+        # d2h sync in the hot loop: every live stream stalls per token
+        logits = jax.device_get(self.dev_logits)
+        self.dev_state.block_until_ready()
+        self._harvest()
+        return logits
+
+    def _harvest(self):
+        # bare np.asarray of a device array — the implicit d2h pull
+        rows = np.asarray(self.dev_rows)
+        return rows

@@ -1,0 +1,35 @@
+"""Negative fixture: the sanctioned shapes — async tier hand-off in
+the loop, blocking pulls only OUTSIDE the step-reachable set, host
+casts with explicit dtypes, and a lone step() that is not an engine."""
+
+import jax
+import numpy as np
+
+
+class Engine:
+    def submit(self, rid, prompt):
+        self.queue.append((rid, prompt))
+
+    def step(self):
+        # the sanctioned idiom: dispatch the gather, hand the sync to
+        # the tier thread, pick up already-staged device arrays
+        leaves = [c[self.idx] for c in self.cache]
+        self.tier.evict_submit(self.host_ids, leaves)
+        staged = self.tier.take_staged(self.key, self.host_ids)
+        # host-side cast of host data: explicit dtype marks it
+        ids = np.asarray(self.id_list, np.int64)
+        return staged, ids
+
+    def register_prefix(self, ids):
+        # NOT step-reachable: a one-time registration may block
+        snap = jax.device_get(self.snapshot)
+        self.snapshot_host = np.asarray(snap)
+        return jax.block_until_ready(snap)
+
+
+class TierWorker:
+    # no submit(): not a decode engine — its step may block (this IS
+    # the transfer thread)
+    def step(self):
+        arr = np.asarray(self.dev)
+        self.pool[self.idx] = arr

@@ -41,6 +41,7 @@ RULE_FIXTURES = {
     "library-internals": "library_internals",
     "obs-unregistered-metric": "obs_unregistered_metric",
     "wall-clock-deadline": "wall_clock_deadline",
+    "blocking-transfer-in-decode-loop": "blocking_transfer",
 }
 
 
