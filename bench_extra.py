@@ -1609,6 +1609,137 @@ def _bench_admin_recovery(out_path: str) -> None:
                   file=sys.stderr)
 
 
+def _bench_kvd_recovery(out_path: str) -> None:
+    """kill -9 the kvd DATA PLANE under streaming + blob-write load,
+    let the admin's supervisor respawn it on the same port with WAL
+    replay, and measure what matters: time-to-reconverge (kill → first
+    successful round-trip on the respawned server), message loss
+    (target: zero — dedup-id pushes + WAL replay), double delivery
+    (target: zero — the dedup recent-set survives the crash), and
+    durable-blob integrity through the outage."""
+    import os
+    import shutil
+    import signal
+    import tempfile
+    import threading
+
+    from rafiki_tpu.admin.services_manager import ServicesManager
+    from rafiki_tpu.native.client import KVClient
+    from rafiki_tpu.parallel.mesh import DeviceSpec
+    from rafiki_tpu.store.meta_store import MetaStore
+
+    workdir = tempfile.mkdtemp(prefix="bench_kvd_recovery_")
+    meta = MetaStore(f"{workdir}/meta.db")
+    mgr = ServicesManager(meta, workdir, slot_size=1, platform="cpu",
+                          devices=[DeviceSpec(id=0)])
+    try:
+        mgr.start_data_plane()
+        host, port = mgr.kv_host, mgr.kv_port
+        kv_pid = mgr._kv_proc.pid
+
+        stop = threading.Event()
+        sent, got, times = [], [], []
+        blobs: dict = {}
+
+        def stream_load() -> None:
+            # sequence-numbered dedup-push → blocking-pop round trips:
+            # a missing seq = a dropped message, a repeated seq = a
+            # double delivery, gaps in `times` = plane unavailability
+            cli = KVClient(host, port, retry_window_s=20.0)
+            seq = 0
+            while not stop.is_set():
+                try:
+                    cli.lpush_dedup("bench:stream", f"s{seq}",
+                                    str(seq).encode())
+                    sent.append(seq)
+                    out = cli.brpop("bench:stream", timeout=5.0)
+                    if out is not None:
+                        got.append(int(out[1]))
+                        times.append(time.monotonic())
+                    seq += 1
+                    time.sleep(0.004)
+                except (ConnectionError, OSError):
+                    time.sleep(0.05)  # window exhausted: retry; shows
+                    # up as a round-trip gap, which is the measurement
+
+        def blob_load() -> None:
+            # the train-side pattern: durable param blobs written
+            # straight through the outage (SET retries transparently)
+            cli = KVClient(host, port, retry_window_s=20.0)
+            i = 0
+            while not stop.is_set():
+                key = f"params:bench-{i % 32}"
+                val = (b"%06d" % i) * 256
+                try:
+                    cli.set(key, val)
+                    blobs[key] = val
+                    i += 1
+                except (ConnectionError, OSError):
+                    pass  # unacked write: not in `blobs`, not owed
+                time.sleep(0.01)
+
+        loaders = [threading.Thread(target=stream_load, daemon=True),
+                   threading.Thread(target=blob_load, daemon=True)]
+        for th in loaders:
+            th.start()
+        time.sleep(1.0)  # steady-state load before the kill
+
+        t_kill = time.monotonic()
+        os.kill(kv_pid, signal.SIGKILL)
+        # the supervisor: the admin monitor's poll tick. Deadline-
+        # bounded: a respawn path that goes degraded (port grabbed,
+        # poisoned data dir) must record a stage error, not hang the
+        # whole bench run
+        while mgr.recovery["kvd_respawns"] < 1:
+            if time.monotonic() - t_kill > 30.0:
+                raise RuntimeError(
+                    "kvd never respawned within 30s "
+                    f"(degraded={mgr.degraded_jobs()})")
+            mgr.poll()
+            time.sleep(0.02)
+        respawn_s = time.monotonic() - t_kill
+        assert mgr.kv_port == port  # same address, clients reconnect
+
+        time.sleep(1.5)  # load continues against the respawned kvd
+        stop.set()
+        for th in loaders:
+            th.join(timeout=30)
+        after = [t for t in times if t > t_kill]
+        reconverge_s = (after[0] - t_kill) if after else None
+        gaps = [b - a for a, b in zip(times, times[1:])]
+
+        blob_losses = 0
+        check = KVClient(host, port)
+        for key, val in blobs.items():
+            if check.get(key) != val:
+                blob_losses += 1
+        stats = check.stats()
+        _record(out_path, {
+            "stage": "kvd_recovery", "backend": "cpu",
+            "provenance": "cpu fallback — measures the supervision/"
+                          "replay/reconnect plane, not kernels",
+            "respawn_s": round(respawn_s, 3),
+            "reconverge_s": (round(reconverge_s, 3)
+                             if reconverge_s is not None else None),
+            "replay_seconds": stats.get("replay_seconds"),
+            "replayed_records": stats.get("replayed_records"),
+            "wal_bytes": stats.get("wal_bytes"),
+            "stream_msgs": len(sent),
+            "dropped_stream_msgs": len(set(sent[:-1]) - set(got)),
+            "double_delivered_msgs": len(got) - len(set(got)),
+            "stream_max_gap_s": round(max(gaps), 3) if gaps else None,
+            "blobs_written": len(blobs),
+            "blob_losses": blob_losses,
+        })
+    finally:
+        try:
+            mgr.stop_all()
+        except Exception as e:  # noqa: BLE001 — cleanup best-effort
+            print(f"kvd_recovery cleanup failed: {e!r}",
+                  file=sys.stderr)
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def _child(out_path: str, budget: float, use_kv: bool) -> None:
     t_start = time.monotonic()
 
@@ -1731,6 +1862,14 @@ def _child(out_path: str, budget: float, use_kv: bool) -> None:
             _bench_admin_recovery(out_path)
         except Exception as e:  # noqa: BLE001
             _record(out_path, {"stage": "admin_recovery_error",
+                               "error": repr(e)[:300]})
+
+    if _want("kvd_recovery") and \
+            budget - (time.monotonic() - t_start) > 20:
+        try:
+            _bench_kvd_recovery(out_path)
+        except Exception as e:  # noqa: BLE001
+            _record(out_path, {"stage": "kvd_recovery_error",
                                "error": repr(e)[:300]})
 
     if _want("stream_search") and \
@@ -2019,6 +2158,23 @@ def main() -> None:
             "dropped_stream_msgs": ar["dropped_stream_msgs"],
             "stream_max_gap_s": ar["stream_max_gap_s"],
             "stream_msgs": ar["stream_msgs"]}))
+    kr = next((r for r in records
+               if r.get("stage") == "kvd_recovery"), None)
+    if kr:
+        print(json.dumps({
+            "metric": "kvd_recovery_reconverge_s",
+            "value": kr["reconverge_s"], "unit": "s",
+            "backend": kr["backend"],
+            "provenance": kr["provenance"],
+            "respawn_s": kr["respawn_s"],
+            "replay_seconds": kr["replay_seconds"],
+            "replayed_records": kr["replayed_records"],
+            "stream_msgs": kr["stream_msgs"],
+            "dropped_stream_msgs": kr["dropped_stream_msgs"],
+            "double_delivered_msgs": kr["double_delivered_msgs"],
+            "stream_max_gap_s": kr["stream_max_gap_s"],
+            "blobs_written": kr["blobs_written"],
+            "blob_losses": kr["blob_losses"]}))
     mo = next((r for r in records
                if r.get("stage") == "metrics_overhead"), None)
     if mo:

@@ -75,6 +75,12 @@ class AdminApp:
             "admin_autoscale_blocked",
             "autoscale-up decisions skipped for want of a device slot",
             fn=lambda: svcs.scaling["autoscale_blocked"])
+        # data-plane persistence health, re-exported from the kvd's
+        # STATS verb (kvd_up / kvd_wal_bytes / kvd_snapshot_age_s /
+        # kvd_last_fsync_age_s / kvd_replay_seconds / kvd_respawns —
+        # docs/observability.md). Cached inside kvd_metrics so a
+        # scrape costs at most one socket round-trip per 2s.
+        self.metrics.register_stats(svcs.kvd_metrics)
         self.http = JsonHttpService(host, port, registry=self.metrics)
         r = self.http.route
         # /metrics is numeric-only and stays open like /health; the
@@ -194,7 +200,10 @@ class AdminApp:
                      "scaling": svc.scaling.snapshot(),
                      # boot-reconciler outcome + lease state: feeds the
                      # dashboard's recovery banner
-                     "recovery": svc.recovery_stats()}
+                     "recovery": svc.recovery_stats(),
+                     # kvd persistence + supervision (feeds the
+                     # dashboard's data-plane banner)
+                     "data_plane": svc.data_plane_status()}
 
     def _login(self, _m, body, _h) -> Tuple[int, Any]:
         try:
@@ -413,13 +422,20 @@ def main(argv: Optional[list] = None) -> int:
               flush=True)
     manager.start_data_plane()
 
-    # deterministic chaos: arm the admin-suicide timer when configured
-    # (RAFIKI_CHAOS kill_admin_after_s — the "SIGKILL mid-load" drill)
-    from ..chaos import ChaosConfig, arm_admin_kill
+    # deterministic chaos: arm the admin-suicide timer and/or the
+    # data-plane kill timer when configured (RAFIKI_CHAOS
+    # kill_admin_after_s / kill_kvd_after_s — the "SIGKILL mid-load"
+    # drills). The kvd killer takes a CALLABLE pid so it targets
+    # whatever kvd is live when it fires (the supervisor may have
+    # respawned it since arming).
+    from ..chaos import ChaosConfig, arm_admin_kill, arm_kvd_kill
 
     chaos_cfg = ChaosConfig.from_env()
     if chaos_cfg is not None:
         arm_admin_kill(chaos_cfg)
+        arm_kvd_kill(chaos_cfg,
+                     lambda: (manager._kv_proc.pid
+                              if manager._kv_proc is not None else 0))
     admin = Admin(meta, manager)
     admin.start_monitor()
     app = AdminApp(admin, cfg.get("host", "127.0.0.1"),

@@ -123,6 +123,8 @@ def audit_workdir(workdir: str,
             f"stale obs_port files (no live service on the recorded "
             f"port): {', '.join(stale_ports)}")
 
+    report["data_plane"] = _audit_data_plane(rows, drift)
+
     lease = meta.get_admin_lease()
     if lease:
         age = time.time() - float(lease.get("heartbeat_at") or 0)
@@ -151,6 +153,16 @@ def render_text(report: Dict[str, Any]) -> str:
             f"alive={str(s['pid_alive']).lower()} "
             f"identity={str(s['identity_ok']).lower()}"
             + (f" devices={s['devices']}" if s["devices"] else ""))
+    dp = report.get("data_plane")
+    if dp:
+        rep = dp.get("replay") or {}
+        lines.append(
+            f"data plane: kvd {dp.get('host')}:{dp.get('port')} "
+            f"reachable={str(dp.get('reachable')).lower()} "
+            f"wal_bytes={dp.get('wal_bytes')} "
+            f"last_fsync_age={dp.get('last_fsync_age_s')}s "
+            f"replay_ok={str(rep.get('ok')).lower()} "
+            f"replayable_records={rep.get('replayable_records')}")
     lease = report.get("lease")
     if lease:
         lines.append(
@@ -163,6 +175,83 @@ def render_text(report: Dict[str, Any]) -> str:
     else:
         lines.append("no drift: recorded state matches the live world")
     return "\n".join(lines)
+
+
+def _audit_data_plane(rows: List[Dict[str, Any]],
+                      drift: List[str]) -> Optional[Dict[str, Any]]:
+    """The kvd data-plane check: reachable on its recorded port,
+    WAL/snapshot present under the recorded ``--data-dir``, last-fsync
+    age (from the STATS verb), and a DRY-RUN replay integrity verdict
+    over the persistence files (read-only; corruption a real boot
+    would refuse is drift). Returns the report block, or None when no
+    data-plane row exists."""
+    live = [r for r in rows
+            if r["service_type"] == "DATA_PLANE"
+            and r["status"] in _LIVE_STATES]
+    if not live:
+        dead = [r for r in rows if r["service_type"] == "DATA_PLANE"]
+        row = dead[-1] if dead else None
+    else:
+        row = live[-1]
+    if row is None:
+        return None
+    spec_cfg = (row.get("spawn_spec") or {}).get("config") or {}
+    host = row.get("host") or "127.0.0.1"
+    port = int(row.get("port") or 0)
+    data_dir = str(spec_cfg.get("data_dir") or "")
+    block: Dict[str, Any] = {
+        "row_id": row["id"], "status": row["status"],
+        "host": host, "port": port, "data_dir": data_dir,
+        "reachable": False}
+    label = f"DATA_PLANE {row['id'][:8]}"
+    if port > 0:
+        try:
+            from ..native.client import KVClient
+
+            c = KVClient(host, port, connect_timeout=2.0)
+            try:
+                block["reachable"] = c.ping()
+                stats = c.stats()
+            finally:
+                c.close()
+            block["last_fsync_age_s"] = stats.get("last_fsync_age_s")
+            block["wal_bytes"] = stats.get("wal_bytes")
+            block["snapshot_age_s"] = stats.get("snapshot_age_s")
+            block["fsync_policy"] = stats.get("fsync_policy")
+            if not stats.get("persist_enabled"):
+                drift.append(
+                    f"{label}: kvd is serving WITHOUT persistence "
+                    "(no --data-dir) — a crash loses every blob and "
+                    "queue")
+            else:
+                age = stats.get("last_fsync_age_s")
+                if isinstance(age, (int, float)) and age > 30.0:
+                    drift.append(
+                        f"{label}: last WAL fsync was {age:.0f}s ago "
+                        "under a non-`no` policy — the fsync loop "
+                        "looks wedged (host-crash durability window "
+                        "is growing)")
+        except (OSError, RuntimeError) as e:
+            block["probe_error"] = str(e)
+            if row["status"] in _LIVE_STATES:
+                drift.append(
+                    f"{label}: row is {row['status']} but the kvd at "
+                    f"{host}:{port} does not answer ({e}) — restart "
+                    "the admin (it respawns the kvd with WAL replay)")
+    if data_dir:
+        from ..native import wal as kvwal
+
+        replay = kvwal.dry_run_replay(data_dir)
+        block["replay"] = replay
+        if not replay["ok"]:
+            for f in replay["findings"]:
+                drift.append(f"{label}: {f}")
+    elif row["status"] in _LIVE_STATES:
+        drift.append(
+            f"{label}: no data_dir recorded in the spawn spec — the "
+            "supervisor cannot respawn-with-replay (pre-persistence "
+            "row?)")
+    return block
 
 
 def _devices(row: Dict[str, Any]) -> List[int]:

@@ -143,6 +143,7 @@ class ServicesManager:
         self.kv_host: str = ""
         self.kv_port: int = 0
         self._kv_proc: Optional[subprocess.Popen] = None
+        self._kv_server: Any = None
         #: self-healing: spawn spec per live service so a CRASHED worker
         #: (train or inference) can be respawned while its parent job is
         #: still RUNNING. Lineage = (type, job id): the restart budget is
@@ -186,8 +187,20 @@ class ServicesManager:
         self.recovery = StatsMap({
             "services_adopted": 0, "services_crashed": 0,
             "orphans_reaped": 0, "respawns_queued": 0,
-            "kv_adopted": 0, "lease_takeovers": 0,
+            "kv_adopted": 0, "kvd_respawns": 0,
+            "kvd_replay_seconds": 0.0, "lease_takeovers": 0,
             "last_recovery_at": 0.0})
+        #: kvd persistence: where the WAL + snapshot live (recorded in
+        #: the spawn spec so a restarted admin respawns WITH replay)
+        self._kv_data_dir: str = ""
+        #: cached kvd STATS (scrapes must not open a socket per hit);
+        #: guarded by its own lock — never op_lock, a scrape must not
+        #: contend with a slow spawn
+        self._kvd_stats_cache: Dict[str, Any] = {}
+        self._kvd_stats_at = 0.0
+        self._kvd_stats_lock = threading.Lock()
+        #: consecutive failed kvd boot attempts (one per monitor tick)
+        self._kv_boot_attempts = 0
         #: horizontal scale-out state per inference job: routing pool,
         #: spawn template for extra replicas, autoscale policy (when
         #: the budget armed one), warming/draining workers in flight.
@@ -521,8 +534,10 @@ class ServicesManager:
     def _reconcile_data_plane(self, row: Dict[str, Any]) -> None:
         """Adopt a surviving rafiki-kvd (param blobs + queues live in
         its memory — killing it would drop every in-flight stream and
-        deployed trial's params), or mark the row CRASHED so
-        ``start_data_plane`` boots a fresh one."""
+        deployed trial's params). A DEAD kvd whose row records a data
+        dir is respawned on the SAME port with WAL replay — "row
+        present, process dead" is a recovery case, never a cold
+        start."""
         import logging
 
         from .proc import pid_alive
@@ -531,6 +546,7 @@ class ServicesManager:
         start_time = float(row.get("start_time") or 0)
         host, port = row.get("host") or "127.0.0.1", \
             int(row.get("port") or 0)
+        spec_cfg = (row.get("spawn_spec") or {}).get("config") or {}
         ok = False
         # identity first (recycled pid must not be PINGed as ours);
         # kvd's cmdline is "rafiki-kvd ..." so cmdline_is_ours holds
@@ -546,6 +562,7 @@ class ServicesManager:
                 ok = False  # refused / protocol error: not a live kvd
         if ok:
             self.kv_host, self.kv_port = host, port
+            self._kv_data_dir = str(spec_cfg.get("data_dir") or "")
             server = _AdoptedKVServer(host, port,
                                       AdoptedProcess(pid, start_time))
             self._kv_server = server
@@ -555,12 +572,21 @@ class ServicesManager:
             logging.getLogger(__name__).info(
                 "adopted data plane kvd pid %d on %s:%d", pid, host,
                 port)
-        else:
-            if identity_matches(pid, start_time):
-                terminate_pid(pid, start_time)
-            self.meta.update_service(row["id"],
-                                     status=ServiceStatus.CRASHED)
-            self.recovery.inc("services_crashed")
+            return
+        if identity_matches(pid, start_time):
+            terminate_pid(pid, start_time)
+        self.meta.update_service(row["id"],
+                                 status=ServiceStatus.CRASHED)
+        self.recovery.inc("services_crashed")
+        if port > 0 and spec_cfg.get("data_dir"):
+            # respawn-with-replay on the recorded address: surviving
+            # workers/predictors reconnect to the same host:port and
+            # the WAL restores blobs, membership, queued messages
+            self.kv_host, self.kv_port = host, port
+            self._kv_data_dir = str(spec_cfg["data_dir"])
+            self._kv_service_id = row["id"]
+            self._kv_proc = _DeadProc()  # respawn path's "died" handle
+            self._respawn_data_plane("dead at admin reconcile")
 
     def recovery_stats(self) -> Dict[str, Any]:
         """Reconciler + lease counters for /metrics, /health, and the
@@ -571,26 +597,220 @@ class ServicesManager:
         return out
 
     # ---- data plane ----
+    #: kvd WAL fsync policy (overridable via RAFIKI_KVD_FSYNC):
+    #: `everysec` matches the Redis default — at most ~1s of
+    #: acknowledged writes lost to a HOST crash; a process crash
+    #: (kill -9, OOM) loses nothing under any policy because the
+    #: records are already written to the fd
+    KVD_FSYNC_DEFAULT = "everysec"
+
     def start_data_plane(self) -> None:
+        """Boot the kvd data plane with WAL + snapshot persistence
+        under ``workdir/kvd-data`` (no-op when already running or
+        adopted by :meth:`reconcile`). The full boot recipe — data dir,
+        fsync policy, host/port — persists in the service row's spawn
+        spec, so both this admin's monitor and a RESTARTED admin can
+        respawn a dead kvd with replay instead of cold-starting an
+        empty one."""
         if self.kv_port:
             return  # already running or adopted by reconcile()
         self._check_fence()
+        data_dir = str(self.workdir / "kvd-data")
+        fsync = os.environ.get("RAFIKI_KVD_FSYNC",
+                               self.KVD_FSYNC_DEFAULT)
+        self._boot_data_plane("127.0.0.1", 0, data_dir, fsync)
+
+    def _boot_data_plane(self, host: str, port: int, data_dir: str,
+                         fsync: str) -> None:
+        """Spawn a kvd (fresh or respawn-with-replay when ``port`` is
+        pinned and the data dir already holds a WAL) and record its
+        row + spawn spec."""
         from ..native.client import KVServer
 
-        server = KVServer()
+        server = KVServer(host=host, port=port, data_dir=data_dir,
+                          fsync=fsync)
         self._kv_server = server
         self._kv_proc = server._proc
         self.kv_host, self.kv_port = server.host, server.port
+        self._kv_data_dir = data_dir
         row = self.meta.create_service(
             ServiceType.DATA_PLANE, host=server.host, port=server.port,
             pid=server._proc.pid,
-            spawn_spec={"module": "rafiki-kvd", "config": {},
+            spawn_spec={"module": "rafiki-kvd",
+                        "config": {"data_dir": data_dir,
+                                   "fsync": fsync,
+                                   "host": server.host,
+                                   "port": server.port},
                         "service_type": ServiceType.DATA_PLANE,
                         "needs_slot": False, "meta_kwargs": {}},
             start_time=proc_start_time(server._proc.pid))
         self._kv_service_id = row["id"]
         self.meta.update_service(row["id"],
                                  status=ServiceStatus.RUNNING)
+        # replay time is the recovery-latency half the bench measures;
+        # stats() may briefly race the listener coming up — best-effort
+        try:
+            st = self._fresh_kvd_stats()
+            self.recovery.set("kvd_replay_seconds",
+                              float(st.get("replay_seconds") or 0.0))
+        except (OSError, RuntimeError) as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "could not read kvd replay stats: %s", e)
+
+    def _respawn_data_plane(self, reason: str) -> bool:
+        """Respawn a dead kvd on its RECORDED host:port + data dir —
+        clients reconnect to the same address and the WAL replay
+        restores blobs, pool membership, and queued messages. Budgeted
+        like worker respawns (persisted lineage ``(DATA_PLANE, kvd)``)
+        so a crash-looping data dir converges to a loud degraded state
+        instead of a respawn storm. Returns True when a kvd is
+        serving again."""
+        import logging
+
+        log = logging.getLogger(__name__)
+        host, port = self.kv_host, self.kv_port
+        data_dir = self._kv_data_dir or str(self.workdir / "kvd-data")
+        lineage = (ServiceType.DATA_PLANE, "kvd")
+        if self._respawn_counts.get(lineage, 0) >= self.max_respawns:
+            log.error(
+                "kvd respawn budget exhausted (%s) — the data plane "
+                "appears to crash deterministically; stack is degraded "
+                "until an operator intervenes", reason)
+            self._degraded["data-plane"] = \
+                "kvd respawn budget exhausted"
+            self._kv_proc = None  # stop supervising the corpse (the
+            # degraded flag + kvd_up 0 carry the signal from here)
+            return False
+        old_id = getattr(self, "_kv_service_id", None)
+        if old_id:
+            self.meta.update_service(old_id,
+                                     status=ServiceStatus.CRASHED)
+        log.warning("kvd data plane died (%s): respawning on %s:%d "
+                    "with WAL replay from %s", reason, host, port,
+                    data_dir)
+        fsync = os.environ.get("RAFIKI_KVD_FSYNC",
+                               self.KVD_FSYNC_DEFAULT)
+        t0 = time.monotonic()
+        # ONE boot attempt per monitor tick: poll() holds op_lock, and
+        # an in-line wait-for-the-port retry loop here would stall
+        # every admin operation for its duration. A failed attempt
+        # leaves the dead handle in place so the NEXT poll retries;
+        # ~20 ticks of failures (a port that never frees, a corrupt
+        # dir the budget check didn't see) go degraded-loud instead.
+        try:
+            self.kv_host, self.kv_port = "", 0  # let boot re-record
+            self._boot_data_plane(host, port, data_dir, fsync)
+        except (OSError, RuntimeError) as e:
+            self.kv_host, self.kv_port = host, port
+            self._kv_boot_attempts += 1
+            if self._kv_boot_attempts >= 20:
+                self._degraded["data-plane"] = \
+                    f"kvd respawn failed: {e}"
+                self._kv_proc = None  # see budget branch above
+                log.error("kvd respawn failed %d times, giving up: "
+                          "%s", self._kv_boot_attempts, e)
+            else:
+                log.warning("kvd respawn attempt %d failed (%s) — "
+                            "retrying on the next monitor tick",
+                            self._kv_boot_attempts, e)
+            return False
+        self._kv_boot_attempts = 0
+        try:
+            self._respawn_counts[lineage] = \
+                self.meta.incr_respawn_count(ServiceType.DATA_PLANE,
+                                             "kvd")
+        except Exception as e:  # noqa: BLE001 — never lose healing to
+            # a store hiccup; fall back to the in-memory count
+            log.warning("kvd respawn budget write-through failed: %s",
+                        e)
+            self._respawn_counts[lineage] = \
+                self._respawn_counts.get(lineage, 0) + 1
+        self.recovery.inc("kvd_respawns")
+        self._degraded.pop("data-plane", None)
+        log.warning("kvd respawned in %.2fs (pid %d, replay %.3fs)",
+                    time.monotonic() - t0, self._kv_proc.pid,
+                    float(self.recovery["kvd_replay_seconds"]))
+        return True
+
+    def _check_data_plane(self) -> None:
+        """Monitor-tick half of kvd supervision: a data-plane process
+        that died (kill -9, OOM) is respawned on its recorded port and
+        replays its WAL. Runs under op_lock (poll)."""
+        if self._kv_proc is None or self.fenced:
+            return
+        if self._kv_proc.poll() is None:
+            return  # alive
+        self._respawn_data_plane(
+            f"process exited rc={self._kv_proc.returncode}")
+
+    def _fresh_kvd_stats(self) -> Dict[str, Any]:
+        from ..native.client import KVClient
+
+        # op_timeout bounds the read too: a wedged (or compaction-busy)
+        # kvd must surface as a caught timeout, not hang every /metrics
+        # and /health behind _kvd_stats_lock
+        c = KVClient(self.kv_host, self.kv_port, connect_timeout=2.0,
+                     op_timeout_s=2.0)
+        try:
+            return c.stats()
+        finally:
+            c.close()
+
+    def kvd_stats(self, max_age_s: float = 2.0) -> Dict[str, Any]:
+        """Cached kvd STATS (persistence health: wal_bytes,
+        snapshot_age_s, last_fsync_age_s, ...) plus ``up``. Guarded by
+        its own lock and cached so /metrics scrapes cost at most one
+        socket round-trip per ``max_age_s``."""
+        with self._kvd_stats_lock:
+            now = time.monotonic()
+            if now - self._kvd_stats_at < max_age_s:
+                return dict(self._kvd_stats_cache)
+            if not self.kv_port:
+                self._kvd_stats_cache = {"up": 0}
+            else:
+                try:
+                    st = self._fresh_kvd_stats()
+                    st["up"] = 1
+                    self._kvd_stats_cache = st
+                except (OSError, RuntimeError) as e:
+                    import logging
+
+                    logging.getLogger(__name__).debug(
+                        "kvd stats probe failed: %s", e)
+                    self._kvd_stats_cache = {"up": 0}
+            self._kvd_stats_at = now
+            return dict(self._kvd_stats_cache)
+
+    def kvd_metrics(self) -> Dict[str, Any]:
+        """Numeric re-export for the admin /metrics collector:
+        ``kvd_up``, ``kvd_wal_bytes``, ``kvd_snapshot_age_s``,
+        ``kvd_last_fsync_age_s``, ``kvd_replay_seconds``,
+        ``kvd_respawns``."""
+        st = self.kvd_stats()
+        out = {"kvd_up": int(st.get("up") or 0),
+               "kvd_respawns": self.recovery["kvd_respawns"],
+               "kvd_replay_seconds":
+                   self.recovery["kvd_replay_seconds"]}
+        for k in ("wal_bytes", "snapshot_bytes", "snapshot_age_s",
+                  "last_fsync_age_s", "compactions",
+                  "wal_truncated_bytes"):
+            if k in st:
+                out[f"kvd_{k}"] = st[k]
+        return out
+
+    def data_plane_status(self) -> Dict[str, Any]:
+        """The /health ``data_plane`` block: up/down, address, data
+        dir, respawn + replay counters, and the persistence stats."""
+        st = self.kvd_stats()
+        return {"up": bool(st.get("up")),
+                "host": self.kv_host, "port": self.kv_port,
+                "data_dir": self._kv_data_dir,
+                "respawns": self.recovery["kvd_respawns"],
+                "replay_seconds":
+                    self.recovery["kvd_replay_seconds"],
+                "stats": {k: v for k, v in st.items() if k != "up"}}
 
     @property
     def param_store_uri(self) -> str:
@@ -1251,6 +1471,7 @@ class ServicesManager:
             self._poll()
 
     def _poll(self) -> None:
+        self._check_data_plane()
         if self._pending_respawns:
             still_pending: List[Dict[str, Any]] = []
             for item in self._pending_respawns:
@@ -2142,7 +2363,7 @@ class ServicesManager:
         for sid in list(self.services):
             with self.op_lock:
                 self._stop_service(sid, timeout=10.0)
-        if self._kv_proc is not None:
+        if self._kv_proc is not None and self._kv_server is not None:
             self._kv_server.stop()
             self._kv_proc = None
             self.kv_host, self.kv_port = "", 0
@@ -2150,6 +2371,19 @@ class ServicesManager:
                 self.meta.update_service(self._kv_service_id,
                                          status=ServiceStatus.STOPPED)
         self.release_lease()
+
+
+class _DeadProc:
+    """Popen-shaped placeholder for a kvd the reconciler found DEAD
+    (row present, process gone): gives the respawn path a non-None,
+    already-exited handle so data-plane supervision state stays
+    uniform."""
+
+    pid = 0
+    returncode = -1
+
+    def poll(self) -> int:
+        return self.returncode
 
 
 class _AdoptedKVServer:

@@ -78,6 +78,19 @@ class ChaosConfig:
       with this probability — a lost shipment. Same contract: the
       decode worker's wait window expires and it re-prefills locally
       (token-exact, just slower).
+    - ``kill_kvd_after_s``: SIGKILL the kvd DATA-PLANE process this
+      many seconds after arming (:func:`arm_kvd_kill` — the admin
+      holds the kvd's pid) — the deterministic "data plane dies
+      mid-load" drill behind the WAL-replay/respawn machinery and the
+      ``bench_extra kvd_recovery`` stage. SIGKILL on purpose: the
+      graceful-shutdown fsync must NOT run; recovery has to come from
+      the WAL alone.
+    - ``drop_hub_conn_p``: each hub RPC first force-closes the calling
+      thread's kvd client socket with this probability — a per-RPC
+      connection drop (flaky network, dying server). The reconnect
+      layer must retry idempotently: no lost durable blob, no
+      double-delivered queue message (dedup ids), blocking pops
+      resumed.
     - ``seed``: drives every probabilistic draw; same seed + same
       traffic order = same faults.
     """
@@ -89,6 +102,8 @@ class ChaosConfig:
     kill_admin_after_s: float = 0.0
     delay_kv_transfer_s: float = 0.0
     drop_kv_page_p: float = 0.0
+    kill_kvd_after_s: float = 0.0
+    drop_hub_conn_p: float = 0.0
     seed: int = 0
 
     @property
@@ -98,7 +113,9 @@ class ChaosConfig:
                     or self.corrupt_payload_p > 0
                     or self.kill_admin_after_s > 0
                     or self.delay_kv_transfer_s > 0
-                    or self.drop_kv_page_p > 0)
+                    or self.drop_kv_page_p > 0
+                    or self.kill_kvd_after_s > 0
+                    or self.drop_hub_conn_p > 0)
 
     @classmethod
     def parse(cls, spec: str) -> "ChaosConfig":
@@ -155,6 +172,46 @@ def arm_admin_kill(cfg: ChaosConfig) -> Optional["object"]:
     return timer
 
 
+def arm_kvd_kill(cfg: ChaosConfig, get_pid,
+                 injector: Optional["ChaosInjector"] = None
+                 ) -> Optional["object"]:
+    """Arm the data-plane kill timer: SIGKILL the kvd process
+    ``cfg.kill_kvd_after_s`` seconds from now. ``get_pid`` is a
+    zero-arg callable returning the kvd's CURRENT pid (the admin owns
+    it; a callable, not a snapshot, so arming before the data plane
+    boots still kills the right process). Returns the started timer
+    (or None when the knob is off) so a test can cancel it. SIGKILL —
+    not SHUTDOWN — because the drill exists to prove WAL replay,
+    not the graceful-shutdown fsync."""
+    if cfg.kill_kvd_after_s <= 0:
+        return None
+    import logging
+    import os
+    import signal
+    import threading
+
+    def _kill() -> None:
+        pid = get_pid()
+        if not pid:
+            logging.getLogger(__name__).warning(
+                "chaos kvd kill fired but no kvd pid is known")
+            return
+        if injector is not None:
+            injector.counters.inc("kvd_kills")
+        logging.getLogger(__name__).warning(
+            "chaos: SIGKILLing kvd pid %d", pid)
+        try:
+            os.kill(int(pid), signal.SIGKILL)
+        except OSError as e:
+            logging.getLogger(__name__).warning(
+                "chaos kvd kill of pid %s failed: %s", pid, e)
+
+    timer = threading.Timer(cfg.kill_kvd_after_s, _kill)
+    timer.daemon = True
+    timer.start()
+    return timer
+
+
 class ChaosInjector:
     """Seeded decision core. One injector per faulty process; all
     decisions funnel through it so a (seed, traffic order) pair replays
@@ -169,7 +226,9 @@ class ChaosInjector:
                                   "queue_delays": 0,
                                   "kills": 0,
                                   "kv_ships_dropped": 0,
-                                  "kv_ship_delays": 0})
+                                  "kv_ship_delays": 0,
+                                  "kvd_kills": 0,
+                                  "hub_conn_drops": 0})
 
     def should_kill(self, tokens_generated: int) -> bool:
         """True once the cumulative generated-token count crosses the
@@ -203,6 +262,17 @@ class ChaosInjector:
             self.counters.inc("queue_delays")
             time.sleep(d)
 
+    def should_drop_conn(self) -> bool:
+        """Seeded per-RPC connection-drop decision (the fault behind
+        ``drop_hub_conn_p``); counted so a chaos run's /metrics shows
+        how many drops actually fired."""
+        if self.cfg.drop_hub_conn_p <= 0:
+            return False
+        if self._rng.random() >= self.cfg.drop_hub_conn_p:
+            return False
+        self.counters.inc("hub_conn_drops")
+        return True
+
     def mangle_kv_ship(self, data: bytes) -> Optional[bytes]:
         """Apply the KV-shipment faults: None = shipment dropped (the
         decode worker's wait window expires → local re-prefill);
@@ -220,20 +290,32 @@ class ChaosInjector:
 
 class ChaosHub(QueueHub):
     """A :class:`QueueHub` decorator applying the injector's queue
-    faults. Pops and stats pass through untouched — the faults modeled
-    here live on the PUSH side (a worker failing to get its answer
-    out), which is where the breaker/failover machinery must catch
-    them."""
+    faults. Reply/shipment faults live on the PUSH side (a worker
+    failing to get its answer out), which is where the breaker/failover
+    machinery must catch them; the per-RPC connection-drop fault
+    (``drop_hub_conn_p``) applies to EVERY hub op — it force-closes the
+    inner hub's thread-local socket right before the call, so the op
+    itself lands on a dead transport and must come back through the
+    reconnect + idempotent-replay layer. On a socketless inner hub
+    (in-proc) the drop is a counted no-op."""
 
     def __init__(self, inner: QueueHub, injector: ChaosInjector) -> None:
         self.inner = inner
         self.injector = injector
 
+    def _maybe_drop_conn(self) -> None:
+        if self.injector.should_drop_conn():
+            drop = getattr(self.inner, "drop_conn", None)
+            if drop is not None:
+                drop()
+
     def push_query(self, worker_id: str, data: bytes) -> None:
         self.injector.maybe_delay()
+        self._maybe_drop_conn()
         self.inner.push_query(worker_id, data)
 
     def pop_query(self, worker_id: str, timeout: float):
+        self._maybe_drop_conn()
         return self.inner.pop_query(worker_id, timeout)
 
     def push_prediction(self, query_id: str, data: bytes) -> None:
@@ -241,30 +323,39 @@ class ChaosHub(QueueHub):
         mangled = self.injector.mangle_reply(data)
         if mangled is None:
             return  # dropped on the floor — the fault being injected
+        self._maybe_drop_conn()
         self.inner.push_prediction(query_id, mangled)
 
     def pop_prediction(self, query_id: str, timeout: float):
+        self._maybe_drop_conn()
         return self.inner.pop_prediction(query_id, timeout)
 
     def query_depth(self, worker_id: str) -> int:
+        self._maybe_drop_conn()
         return self.inner.query_depth(worker_id)
 
     def discard_prediction_queue(self, query_id: str) -> None:
+        self._maybe_drop_conn()
         self.inner.discard_prediction_queue(query_id)
 
     def arm_reply_ttl(self, query_id: str, ttl_s: float) -> None:
+        self._maybe_drop_conn()
         self.inner.arm_reply_ttl(query_id, ttl_s)
 
     def put_worker_stats(self, worker_id: str, stats) -> None:
+        self._maybe_drop_conn()
         self.inner.put_worker_stats(worker_id, stats)
 
     def get_worker_stats(self, worker_id: str):
+        self._maybe_drop_conn()
         return self.inner.get_worker_stats(worker_id)
 
     def put_pool_members(self, pool_id: str, members) -> None:
+        self._maybe_drop_conn()
         self.inner.put_pool_members(pool_id, members)
 
     def get_pool_members(self, pool_id: str):
+        self._maybe_drop_conn()
         return self.inner.get_pool_members(pool_id)
 
     def push_kv(self, worker_id: str, data: bytes) -> None:
@@ -272,20 +363,31 @@ class ChaosHub(QueueHub):
         if mangled is None:
             return  # the lost shipment being injected: the decode
             #         side's wait window expires → local re-prefill
+        self._maybe_drop_conn()
         self.inner.push_kv(worker_id, mangled)
 
     def pop_kv(self, worker_id: str, timeout: float):
+        self._maybe_drop_conn()
         return self.inner.pop_kv(worker_id, timeout)
 
     def kv_depth(self, worker_id: str) -> int:
+        self._maybe_drop_conn()
         return self.inner.kv_depth(worker_id)
 
     def put_blob(self, key: str, data: bytes) -> None:
+        self._maybe_drop_conn()
         self.inner.put_blob(key, data)
 
     def get_blob(self, key: str):
+        self._maybe_drop_conn()
         return self.inner.get_blob(key)
+
+    def drop_conn(self) -> None:
+        """Pass-through so stacked decorators keep the chaos surface."""
+        drop = getattr(self.inner, "drop_conn", None)
+        if drop is not None:
+            drop()
 
 
 __all__ = ["CHAOS_ENV", "ChaosConfig", "ChaosHub", "ChaosInjector",
-           "arm_admin_kill"]
+           "arm_admin_kill", "arm_kvd_kill"]

@@ -261,16 +261,20 @@ class Client:
         Best-effort classes admit after interactive, may be preempted
         (resuming token-exact), and may be SHED under overload.
 
-        Two distinct structured 503s, both retried ONCE after
+        Three distinct structured 503s, all retried ONCE after
         honoring the server's ``retry_after_s`` (capped at
         ``MAX_RETRY_AFTER_S``): a *shed* 503
         (``HttpStatusError.shed`` — overload backpressure on a
         best-effort class; retrying after the hint is expected to
-        work) and a breaker *fast-fail* 503 (fleet down/draining;
-        retrying probes the outage). When the retry also fails the
-        typed :class:`~rafiki_tpu.utils.http.HttpStatusError`
-        surfaces with ``.shed``/``.retry_after_s`` so callers can
-        schedule their own backoff. Disable with
+        work), a *data-plane-down* 503
+        (``HttpStatusError.data_plane_down`` — the kvd is being
+        respawned with WAL replay; shed-like, the honored retry is
+        expected to land), and a breaker *fast-fail* 503 (fleet
+        down/draining; retrying probes the outage). When the retry
+        also fails the typed
+        :class:`~rafiki_tpu.utils.http.HttpStatusError` surfaces with
+        ``.shed``/``.data_plane_down``/``.retry_after_s`` so callers
+        can schedule their own backoff. Disable with
         ``retry_on_503=False``."""
         body: Dict[str, Any] = {"queries": _jsonable(queries)}
         if timeout is not None:

@@ -4,6 +4,8 @@
 in for the reference deployment's Redis (params + query queues).
 """
 
-from .client import KVClient, KVServer, ensure_built, wait_for_server
+from .client import (CLIENT_STATS, KVClient, KVServer, ensure_built,
+                     wait_for_server)
 
-__all__ = ["KVClient", "KVServer", "ensure_built", "wait_for_server"]
+__all__ = ["CLIENT_STATS", "KVClient", "KVServer", "ensure_built",
+           "wait_for_server"]

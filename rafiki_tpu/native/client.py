@@ -5,21 +5,54 @@ trial parameter blobs and the predictor's query/prediction queues in a
 Redis container (SURVEY.md §2, §5.8(b)); here the same data plane is a
 single small C++ binary on the TPU-VM host. The wire protocol is a
 RESP-compatible subset, so this client is a thin framing layer.
+
+Crash survival (two halves, both here):
+
+- **Server side**: :class:`KVServer` can spawn the kvd with a
+  ``--data-dir`` so every mutating command lands in a CRC-checksummed
+  WAL (compacted into an atomic-rename snapshot); a respawned kvd
+  replays it and picks up where the dead one stopped.
+- **Client side**: :class:`KVClient` owns a reconnect-with-exponential-
+  backoff layer. Verbs with idempotent replay semantics (reads, SET,
+  DEL, EXPIRE, and the dedup-id pushes) are retried transparently
+  across a connection drop for up to ``retry_window_s``; a blocked
+  ``BRPOP`` resumes on the new socket with its remaining timeout.
+  Non-idempotent verbs (plain LPUSH/RPUSH, INCR) are NOT retried — a
+  reconnecting caller must use the dedup pushes
+  (:meth:`KVClient.lpush_dedup`) so a retry can never double-deliver.
+  Reconnects/retries count into the module-level :data:`CLIENT_STATS`
+  (``hub_reconnects_total`` / ``hub_rpc_retries_total``), which
+  workers and the predictor re-export on their ``/metrics``.
 """
 
 from __future__ import annotations
 
-import os
+import logging
 import shutil
 import socket
 import subprocess
 import threading
 import time
 from pathlib import Path
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
+
+from ..obs.metrics import StatsMap
 
 _NATIVE_DIR = Path(__file__).resolve().parent
 _BINARY = _NATIVE_DIR / "build" / "rafiki-kvd"
+
+#: process-wide client-resilience counters, re-exported on every
+#: /metrics surface that talks to the kvd (one socket layer, one truth)
+CLIENT_STATS = StatsMap({"hub_reconnects_total": 0,
+                         "hub_rpc_retries_total": 0})
+
+#: verbs whose replay is idempotent (reads; SET/DEL/EXPIRE which
+#: overwrite; dedup pushes which the server's recent-set makes safe;
+#: STATS). Plain pops are included: a retried pop is a fresh command —
+#: see the at-most-once note on :meth:`KVClient._cmd`.
+_RETRYABLE = frozenset({
+    "PING", "GET", "SET", "DEL", "EXISTS", "KEYS", "EXPIRE", "TTL",
+    "LLEN", "LPUSHD", "RPUSHD", "LPOP", "RPOP", "STATS", "FLUSHALL"})
 
 
 #: buildable native artifacts and their sources (Makefile targets)
@@ -48,16 +81,39 @@ def ensure_built(force: bool = False,
 
 
 class KVServer:
-    """Spawn/own a rafiki-kvd process (test + single-host deployments)."""
+    """Spawn/own a rafiki-kvd process (test + single-host deployments).
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+    ``data_dir`` arms WAL + snapshot persistence: the server replays it
+    at boot, so a respawn on the same dir (and, for live clients, the
+    same port) restores every durable blob, queue, and dedup id. A
+    boot that refuses a corrupt WAL (server exit code 4) surfaces here
+    as a RuntimeError carrying the server's structured JSON error."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 data_dir: Optional[str] = None,
+                 fsync: Optional[str] = None,
+                 wal_rotate_bytes: Optional[int] = None) -> None:
         binary = ensure_built()
-        self._proc = subprocess.Popen(
-            [str(binary), "--host", host, "--port", str(port)],
-            stdout=subprocess.PIPE, text=True)
+        cmd = [str(binary), "--host", host, "--port", str(port)]
+        if data_dir:
+            cmd += ["--data-dir", str(data_dir)]
+        if fsync:
+            if fsync not in ("always", "everysec", "no"):
+                raise ValueError(f"bad fsync policy {fsync!r} "
+                                 "(always|everysec|no)")
+            cmd += ["--fsync", fsync]
+        if wal_rotate_bytes:
+            cmd += ["--wal-rotate-bytes", str(int(wal_rotate_bytes))]
+        self.data_dir = str(data_dir) if data_dir else None
+        self._proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                      text=True)
         line = self._proc.stdout.readline()  # "... listening on H:P"
         if "listening on" not in line:
-            raise RuntimeError(f"rafiki-kvd failed to start: {line!r}")
+            # a corrupt WAL prints a structured JSON error and exits 4
+            # instead of serving wrong state — surface that verbatim
+            self._proc.wait(timeout=5)
+            raise RuntimeError(f"rafiki-kvd failed to start: {line!r} "
+                               f"(rc={self._proc.returncode})")
         hp = line.rsplit(" ", 1)[-1].strip()
         self.host, _, port_s = hp.partition(":")
         self.port = int(port_s)
@@ -91,33 +147,83 @@ class KVClient:
 
     For concurrent blocking pops (inference workers) use one client per
     thread — a BRPOP holds the socket for up to its timeout.
+
+    ``retry_window_s > 0`` arms the reconnect layer: a connection error
+    on a retryable verb triggers reconnect-with-exponential-backoff and
+    a transparent re-send for up to that many seconds before a
+    ``ConnectionError`` finally surfaces. The window bounds how long a
+    caller can stall on a dead data plane — the predictor keeps it
+    short (fast-fail into a structured 503), workers keep it long
+    enough to ride out a supervised kvd respawn + WAL replay.
+
+    At-most-once edge: a non-blocking pop whose reply is lost between
+    the server's WAL append and the socket write loses that one message
+    on retry. The window is microseconds around a server crash; queue
+    consumers that cannot tolerate it already re-request via their own
+    end-to-end protocol (stream resumes, gather timeouts).
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 6399,
-                 connect_timeout: float = 5.0) -> None:
-        self._sock = socket.create_connection((host, port),
-                                              timeout=connect_timeout)
-        self._sock.settimeout(None)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._buf = b""
+                 connect_timeout: float = 5.0,
+                 retry_window_s: float = 0.0,
+                 op_timeout_s: Optional[float] = None) -> None:
+        """``op_timeout_s`` bounds every socket read/write (None = the
+        default, block forever — what BRPOP holders need). Probe-style
+        callers (the admin's cached STATS scrape) set it so a wedged
+        or compaction-busy kvd surfaces as a caught timeout instead of
+        hanging the prober."""
+        self._host, self._port = host, port
+        self._connect_timeout = connect_timeout
+        self._op_timeout_s = op_timeout_s
+        self.retry_window_s = float(retry_window_s)
         self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._buf = b""
+        self._connect()  # constructor contract: raises if unreachable
+
+    # ---- connection lifecycle ----
+    def _connect(self) -> None:
+        sock = socket.create_connection(
+            (self._host, self._port), timeout=self._connect_timeout)
+        sock.settimeout(self._op_timeout_s)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
+        self._buf = b""
+
+    def _teardown(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError as e:
+                logging.getLogger(__name__).debug(
+                    "kv socket close failed: %s", e)
+            self._sock = None
+        self._buf = b""
+
+    def drop_conn(self) -> None:
+        """Force-close the socket (chaos / tests): the next command
+        finds a dead transport and exercises the reconnect layer."""
+        with self._lock:
+            self._teardown()
 
     # ---- framing ----
+    def _recv_more(self) -> None:
+        if self._sock is None:
+            raise ConnectionError("kv client not connected")
+        chunk = self._sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("kv server closed connection")
+        self._buf += chunk
+
     def _read_line(self) -> bytes:
         while b"\r\n" not in self._buf:
-            chunk = self._sock.recv(65536)
-            if not chunk:
-                raise ConnectionError("kv server closed connection")
-            self._buf += chunk
+            self._recv_more()
         line, self._buf = self._buf.split(b"\r\n", 1)
         return line
 
     def _read_n(self, n: int) -> bytes:
         while len(self._buf) < n:
-            chunk = self._sock.recv(65536)
-            if not chunk:
-                raise ConnectionError("kv server closed connection")
-            self._buf += chunk
+            self._recv_more()
         out, self._buf = self._buf[:n], self._buf[n:]
         return out
 
@@ -144,12 +250,64 @@ class KVClient:
             return [self._read_reply() for _ in range(n)]
         raise RuntimeError(f"bad reply tag {line!r}")
 
+    def _send_recv(self, enc: bytes):
+        if self._sock is None:
+            raise ConnectionError("kv client not connected")
+        self._sock.sendall(enc)
+        return self._read_reply()
+
+    def _reconnect_and_retry(self, enc: bytes, verb: str,
+                             first_err: Exception,
+                             deadline: Optional[float] = None):
+        """The reconnect layer: exponential backoff up to
+        ``retry_window_s`` (or an explicit monotonic ``deadline``),
+        re-sending ``enc`` after each successful reconnect. Caller
+        holds the lock. Raises ConnectionError when the window
+        closes."""
+        log = logging.getLogger(__name__)
+        if deadline is None:
+            deadline = time.monotonic() + self.retry_window_s
+        backoff = 0.05
+        last: Exception = first_err
+        log.warning("kv connection lost during %s (%s): retrying for "
+                    "up to %.1fs", verb, first_err,
+                    max(0.0, deadline - time.monotonic()))
+        while True:
+            self._teardown()
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise ConnectionError(
+                    f"kv server {self._host}:{self._port} unreachable "
+                    f"after retry window ({verb}): {last}") from last
+            time.sleep(min(backoff, remaining))
+            backoff = min(backoff * 2, 1.0)
+            try:
+                self._connect()
+                CLIENT_STATS.inc("hub_reconnects_total")
+                CLIENT_STATS.inc("hub_rpc_retries_total")
+                return self._send_recv(enc)
+            except (OSError, ConnectionError) as e:
+                last = e  # next loop iteration backs off and re-tries
+
     def _cmd(self, *args) -> object:
-        enc = [a if isinstance(a, bytes) else str(a).encode()
-               for a in args]
+        enc_args = [a if isinstance(a, bytes) else str(a).encode()
+                    for a in args]
+        verb = enc_args[0].decode().upper()
+        enc = _encode(enc_args)
         with self._lock:
-            self._sock.sendall(_encode(enc))
-            return self._read_reply()
+            try:
+                if self._sock is None:
+                    # a prior drop/teardown left no transport: treat
+                    # like a mid-command drop (retry path decides)
+                    raise ConnectionError("kv client not connected")
+                return self._send_recv(enc)
+            except (OSError, ConnectionError) as e:
+                if self.retry_window_s <= 0 or verb not in _RETRYABLE:
+                    self._teardown()
+                    raise ConnectionError(
+                        f"kv server {self._host}:{self._port} "
+                        f"connection lost ({verb}): {e}") from e
+                return self._reconnect_and_retry(enc, verb, e)
 
     # ---- api ----
     def ping(self) -> bool:
@@ -179,6 +337,17 @@ class KVClient:
     def rpush(self, key: str, *values: bytes) -> int:
         return int(self._cmd("RPUSH", key, *values))
 
+    def lpush_dedup(self, key: str, dedup_id: str, *values: bytes) -> int:
+        """Deduplicated LPUSH: the server keeps a bounded recent-set of
+        ``dedup_id``s (persisted in the WAL), so a RETRY of this exact
+        push — after a connection drop or a kvd respawn — never
+        double-delivers. The id is client-minted (uuid per logical
+        push)."""
+        return int(self._cmd("LPUSHD", key, dedup_id, *values))
+
+    def rpush_dedup(self, key: str, dedup_id: str, *values: bytes) -> int:
+        return int(self._cmd("RPUSHD", key, dedup_id, *values))
+
     def lpop(self, key: str) -> Optional[bytes]:
         return self._cmd("LPOP", key)
 
@@ -201,14 +370,80 @@ class KVClient:
 
     def brpop(self, keys, timeout: float
               ) -> Optional[Tuple[str, bytes]]:
-        """Blocking tail-pop across ``keys``; None on timeout."""
+        """Blocking tail-pop across ``keys``; None on timeout.
+
+        With the reconnect layer armed, a connection lost mid-wait
+        RESUMES on a fresh socket with the remaining timeout — an
+        in-flight blocking pop survives a kvd respawn (the queue
+        content survives via the WAL)."""
         if isinstance(keys, str):
             keys = [keys]
-        reply = self._cmd("BRPOP", *keys, timeout)
-        if reply is None:
-            return None
-        k, v = reply
-        return k.decode(), v
+        enc_keys = list(keys)
+        deadline = None if timeout <= 0 else time.monotonic() + timeout
+        while True:
+            remaining = timeout if deadline is None \
+                else deadline - time.monotonic()
+            if deadline is not None and remaining <= 0:
+                return None
+            enc = _encode([b"BRPOP"]
+                          + [k.encode() if isinstance(k, str) else k
+                             for k in enc_keys]
+                          + [str(remaining).encode()])
+            with self._lock:
+                try:
+                    if self._sock is None:
+                        raise ConnectionError("kv client not connected")
+                    reply = self._send_recv(enc)
+                except (OSError, ConnectionError) as e:
+                    if self.retry_window_s <= 0:
+                        self._teardown()
+                        raise ConnectionError(
+                            f"kv server {self._host}:{self._port} "
+                            f"connection lost (BRPOP): {e}") from e
+                    # reconnect within the retry window, then LOOP to
+                    # reissue with the remaining pop budget (the wait
+                    # budget itself is the caller's, not the window's)
+                    retry_dl = time.monotonic() + self.retry_window_s
+                    if deadline is not None:
+                        retry_dl = max(retry_dl, deadline)
+                    reply = self._reconnect_and_retry(
+                        _encode([b"PING"]), "BRPOP", e,
+                        deadline=retry_dl)
+                    if reply != "PONG":
+                        raise ConnectionError(
+                            "kv server answered garbage to the "
+                            "reconnect probe") from e
+                    continue  # fresh socket: reissue the blocking pop
+            if reply is None:
+                return None
+            k, v = reply
+            return k.decode(), v
+
+    def stats(self) -> Dict[str, object]:
+        """The kvd's ``STATS`` verb (persistence health): wal_bytes,
+        snapshot_bytes, snapshot_age_s, last_fsync_age_s,
+        replay_seconds, replayed_records, wal_truncated_bytes,
+        compactions, dedup_ids, keys, lists, fsync_policy."""
+        raw = self._cmd("STATS")
+        out: Dict[str, object] = {}
+        for line in (raw or b"").decode().splitlines():
+            key, _, val = line.partition(" ")
+            if not key:
+                continue
+            try:
+                out[key] = int(val)
+            except ValueError:
+                try:
+                    out[key] = float(val)
+                except ValueError:
+                    out[key] = val
+        return out
+
+    def compact(self) -> None:
+        """Force a WAL compaction into a fresh snapshot (operator /
+        test hook; the server also rotates automatically past
+        ``--wal-rotate-bytes``)."""
+        self._cmd("COMPACT")
 
     def flushall(self) -> None:
         self._cmd("FLUSHALL")
@@ -221,7 +456,8 @@ class KVClient:
 
     def close(self) -> None:
         try:
-            self._sock.close()
+            if self._sock is not None:
+                self._sock.close()
         except OSError:
             pass
 

@@ -226,6 +226,24 @@ class Predictor:
         self._c_fast_fail = self.metrics.counter(
             "requests_fast_failed",
             "requests 503'd with every breaker open")
+        # data-plane survival: the shared kv-client reconnect counters
+        # (hub_reconnects_total / hub_rpc_retries_total) plus a down
+        # flag — set when a hub op exhausts its reconnect window,
+        # cleared by the next op that reaches the kvd. Drives /health,
+        # /metrics, and the dashboard's data-plane banner.
+        from ..native.client import CLIENT_STATS as _kv_client_stats
+
+        self.metrics.register_stats(_kv_client_stats)
+        self._c_dp_failures = self.metrics.counter(
+            "data_plane_failures",
+            "requests failed with the kvd unreachable past the "
+            "reconnect window (structured 503 / resumable event)")
+        self._dp_down_at: Optional[float] = None
+        self.metrics.gauge(
+            "data_plane_down",
+            "1 while the last hub op found the kvd unreachable "
+            "(predictor fast-fails 503 until it returns)",
+            fn=lambda: 0 if self._dp_down_at is None else 1)
         self._c_failover = self.metrics.counter(
             "stream_failovers",
             "mid-stream failovers to another worker")
@@ -520,10 +538,22 @@ class Predictor:
         traffic."""
         t0 = time.monotonic()
         cls = normalize_slo(slo, default=self.default_slo)
+        tid = sanitize_trace_id(trace_id) or mint_trace_id()
+        # the down-gate runs FIRST: everything below (the shed gate's
+        # load refresh included) touches the hub, and a known-down
+        # plane must cost one 0.25s-bounded probe, not a reconnect
+        # window per hub op
+        gate = self._data_plane_gate(tid)
+        if gate is not None:
+            self._c_requests.inc()
+            return [], {"workers_answered": 0, "workers_asked": 0,
+                        "workers_skipped": len(self.worker_ids),
+                        "latency_s": time.monotonic() - t0,
+                        "errors": [gate["error"]],
+                        "trace_id": tid, **gate}
         shed = self.shed_verdict(cls)
         if shed is not None:
             self._c_requests.inc()
-            tid = sanitize_trace_id(trace_id) or mint_trace_id()
             self.traces.start(tid, request_id="", span="shed",
                               slo=cls,
                               retry_after_s=shed["retry_after_s"])
@@ -536,7 +566,6 @@ class Predictor:
         adaptive = timeout is None and self.adaptive_gather
         timeout = self._gather_deadline_s() if timeout is None else timeout
         qid = uuid.uuid4().hex
-        tid = sanitize_trace_id(trace_id) or mint_trace_id()
         self.traces.start(tid, request_id=qid, span="received",
                           n_queries=len(queries),
                           timeout_s=round(float(timeout), 4))
@@ -591,14 +620,25 @@ class Predictor:
                 qid, timeout + EXPIRY_SKEW_TOLERANCE_S + 30.0)
         except Exception:  # rafiki: noqa[silent-except] — the
             pass           # TTL is defense-in-depth
-        for wid in targets:
-            self.hub.push_query(wid, msg)
-        self.traces.add_span(tid, "scattered", workers=len(targets))
-
         per_worker: List[List[Any]] = []
         errors: List[str] = []
         answered: set = set()
         n_draining = 0
+        try:
+            for wid in targets:
+                self.hub.push_query(wid, msg)
+        except ConnectionError as e:
+            # the kvd is unreachable past the client's reconnect
+            # window: fast-fail with a structured shed-style 503
+            # instead of hanging the caller into a gather timeout
+            verdict = self._data_plane_lost(tid, e)
+            self._c_requests.inc()
+            return [], {"workers_answered": 0, "workers_asked": 0,
+                        "workers_skipped": len(self.worker_ids),
+                        "latency_s": time.monotonic() - t0,
+                        "errors": [verdict["error"]],
+                        "trace_id": tid, **verdict}
+        self.traces.add_span(tid, "scattered", workers=len(targets))
         try:
             for _ in targets:
                 remaining = deadline - time.monotonic()
@@ -642,6 +682,19 @@ class Predictor:
                 self._h_reply.observe(reply_lat)
                 self.traces.add_span(tid, "reply", worker=wid_r)
                 per_worker.append(list(reply["predictions"]))
+        except ConnectionError as e:
+            # mid-gather data-plane loss (reconnect window exhausted):
+            # same structured fast-fail — answers gathered so far are
+            # a partial quorum nobody can complete
+            verdict = self._data_plane_lost(tid, e)
+            self._c_requests.inc()
+            return [], {"workers_answered": len(per_worker),
+                        "workers_asked": len(targets),
+                        "workers_skipped":
+                            len(self.worker_ids) - len(targets),
+                        "latency_s": time.monotonic() - t0,
+                        "errors": errors + [verdict["error"]],
+                        "trace_id": tid, **verdict}
         finally:
             # drop the reply queue even on a gather error: late answers
             # must not accumulate in the hub/kv store forever
@@ -649,6 +702,8 @@ class Predictor:
                 self.hub.discard_prediction_queue(qid)
             except Exception:  # rafiki: noqa[silent-except] —
                 pass           # cleanup is best-effort
+        self._data_plane_ok()  # the gather reached the kvd: clear the
+        #                        down flag (banner + 503 gate)
         latency = time.monotonic() - t0
         self._c_queries.inc(len(queries))
         self._c_requests.inc()
@@ -740,6 +795,82 @@ class Predictor:
             wid = self.router.select(key, exclude=exclude)
         return wid
 
+    #: retry hint handed out with the data-plane-down 503: a supervised
+    #: kvd respawn + WAL replay lands within ~1-2s, so the first
+    #: honored retry is expected to succeed
+    DATA_PLANE_RETRY_S = 2.0
+
+    def _dp_verdict(self) -> Dict[str, Any]:
+        """The one data-plane-down 503 payload (gated and mid-request
+        paths must not diverge: clients type on ``data_plane_down``)."""
+        return {"error": "data plane unreachable (kvd down?) — "
+                         "retry after the hint",
+                "data_plane_down": True, "fast_fail": True,
+                "retry_after_s": self.DATA_PLANE_RETRY_S}
+
+    def _data_plane_lost(self, tid: str, err: Exception
+                         ) -> Dict[str, Any]:
+        """Record a hub op that exhausted its reconnect window and
+        build the structured shed-style verdict: the HTTP front maps
+        it to a 503 with ``retry_after_s`` + ``data_plane_down`` so
+        clients back off instead of hanging into a gather timeout."""
+        import logging
+
+        self._c_dp_failures.inc()
+        with self._lock:
+            self._dp_down_at = time.monotonic()
+        logging.getLogger(__name__).warning(
+            "data plane unreachable (%s): fast-failing with "
+            "retry_after_s=%.1f", err, self.DATA_PLANE_RETRY_S)
+        self.traces.add_span(tid, "data_plane_down",
+                             retry_after_s=self.DATA_PLANE_RETRY_S)
+        return self._dp_verdict()
+
+    def _data_plane_ok(self) -> None:
+        if self._dp_down_at is None:
+            return
+        with self._lock:
+            self._dp_down_at = None
+
+    def _data_plane_gate(self, tid: str) -> Optional[Dict[str, Any]]:
+        """Fast-fail gate for requests arriving while the plane is
+        known-down: one cheap TCP liveness probe (0.25s bound; a dead
+        port refuses in ~0) decides — up → clear the flag and serve,
+        down → an INSTANT structured 503 instead of re-stalling every
+        request in the client's reconnect window. None = proceed."""
+        with self._lock:
+            if self._dp_down_at is None:
+                return None
+        host = getattr(self.hub, "_host", None)
+        port = int(getattr(self.hub, "_port", 0) or 0)
+        if not host or port <= 0:
+            return None  # socketless hub (in-proc): nothing to gate
+        import socket
+
+        try:
+            socket.create_connection((host, port), timeout=0.25).close()
+        except OSError:
+            self._c_dp_failures.inc()
+            if tid:  # the HTTP front's SSE pre-flight gates with no
+                # trace record yet
+                self.traces.add_span(tid, "data_plane_down",
+                                     gated=True,
+                                     retry_after_s=self.DATA_PLANE_RETRY_S)
+            return self._dp_verdict()
+        self._data_plane_ok()  # the plane answered: serve normally
+        return None
+
+    def data_plane_health(self) -> Dict[str, Any]:
+        """The /health ``data_plane`` block (feeds the dashboard
+        banner)."""
+        with self._lock:
+            down_at = self._dp_down_at
+        return {"down": down_at is not None,
+                "down_for_s": (0.0 if down_at is None
+                               else round(time.monotonic() - down_at,
+                                          2)),
+                "failures": int(self._c_dp_failures.value)}
+
     def _resumable_final(self, acc: Dict[int, str], n_queries: int,
                          error: str, qid: str, tid: str) -> Dict:
         """The structured terminal event for a stream that could not be
@@ -803,14 +934,7 @@ class Predictor:
         before the SSE response commits)."""
         t0 = time.monotonic()
         cls = normalize_slo(slo, default=self.default_slo)
-        shed = self.shed_verdict(cls)
-        if shed is not None:
-            yield {"done": True, **shed}
-            return
-        sampling = self._brownout_sampling(cls, sampling)
-        timeout = self.STREAM_TIMEOUT if timeout is None else timeout
         tid = sanitize_trace_id(trace_id) or mint_trace_id()
-        deadline = t0 + timeout
         # accumulated text per query index — the final predictions
         # message may carry tokens never sent as deltas (the request
         # finished mid-fused-step); the tail is emitted before "done".
@@ -821,6 +945,27 @@ class Predictor:
             for i, p in enumerate(list(resume_partial)[:len(queries)]):
                 if isinstance(p, str) and p:
                     acc[i] = p
+        # the down-gate runs FIRST (the shed gate's load refresh
+        # touches the hub): a known-down plane costs one 0.25s-bounded
+        # probe, then an instant RESUMABLE terminal event carrying any
+        # resume seed — the SDK honors retry_after_s and re-opens
+        # against the respawned kvd
+        gate = self._data_plane_gate(tid)
+        if gate is not None:
+            self._c_resumable.inc()
+            yield {"done": True, "resumable": True, "qid": "",
+                   "trace_id": tid,
+                   "partial": [acc.get(i)
+                               for i in range(len(queries))],
+                   **gate}
+            return
+        shed = self.shed_verdict(cls)
+        if shed is not None:
+            yield {"done": True, **shed}
+            return
+        sampling = self._brownout_sampling(cls, sampling)
+        timeout = self.STREAM_TIMEOUT if timeout is None else timeout
+        deadline = t0 + timeout
         self.traces.start(tid, request_id="", span="received",
                           n_queries=len(queries), stream=True,
                           resumed=bool(acc))
@@ -949,6 +1094,11 @@ class Predictor:
                     # silence budget is long
                     reply_bytes = self.hub.pop_prediction(
                         qid, min(remaining, silence_left, 1.0))
+                    # the pop RETURNED (bytes or a clean timeout):
+                    # the hub is reachable — clear the down flag the
+                    # unary path clears at gather end (streams may be
+                    # the only traffic)
+                    self._data_plane_ok()
                     if reply_bytes is None:
                         continue  # re-check timeout/silence/breaker
                     saw_event = True
@@ -1051,6 +1201,19 @@ class Predictor:
                     self.traces.add_span(tid, "worker_lost",
                                          worker=wid,
                                          reason=failover_reason)
+        except ConnectionError as e:
+            # the kvd went unreachable past the reconnect window
+            # mid-stream: end with a RESUMABLE event carrying the
+            # delivered text — the client SDK honors retry_after_s and
+            # auto-resumes against the respawned (WAL-replayed) data
+            # plane without re-paying delivered tokens
+            verdict = self._data_plane_lost(tid, e)
+            self._c_resumable.inc()
+            final = {"done": True, "resumable": True,
+                     "qid": qid, "trace_id": tid,
+                     "partial": [acc.get(i)
+                                 for i in range(len(queries))],
+                     **verdict}
         except Exception as e:  # noqa: BLE001 — the SSE response is
             # already committed (200 + headers) when this generator
             # runs, so errors can't become an HTTP status: every
@@ -1123,6 +1286,9 @@ class Predictor:
                 "router": self.router.snapshot(),
                 "stream_failovers": int(self._c_failover.value),
                 "requests_fast_failed": int(self._c_fast_fail.value),
+                # data-plane survival: down flag + failure count (the
+                # dashboard's data-plane banner reads this)
+                "data_plane": self.data_plane_health(),
                 # per-worker published counters (drop accounting, decode-
                 # engine stats): a worker silently dropping expired
                 # queries shows up HERE, not as mystery timeouts
@@ -1299,14 +1465,19 @@ class PredictorService:
                              "info": info}
             if info.get("fast_fail"):
                 # structured 503: every breaker open (or the whole
-                # fleet draining) — the client is told when retrying
-                # can possibly help instead of burning its own timeout
-                return 503, {"error": info["errors"][0]
-                             if info.get("errors")
-                             else "no worker available",
-                             "retry_after_s": info.get("retry_after_s",
-                                                       1.0),
-                             "info": info}
+                # fleet draining, or the DATA PLANE down — flagged
+                # top-level so HttpStatusError.data_plane_down types
+                # it) — the client is told when retrying can possibly
+                # help instead of burning its own timeout
+                out = {"error": info["errors"][0]
+                       if info.get("errors")
+                       else "no worker available",
+                       "retry_after_s": info.get("retry_after_s",
+                                                 1.0),
+                       "info": info}
+                if info.get("data_plane_down"):
+                    out["data_plane_down"] = True
+                return 503, out
             return 504, {"error": "no worker answered in time",
                          "info": info}
         return 200, {"predictions": preds, "info": info}
@@ -1329,6 +1500,12 @@ class PredictorService:
             return 400, {"error": "resume must be a list of partial "
                                   "texts (one per query, null for "
                                   "none)"}
+        gate = self.predictor._data_plane_gate("")
+        if gate is not None:
+            # pre-flight the down-gate into a REAL 503 (same reasoning
+            # as the shed pre-flight below), typed for the SDK's
+            # stream-open retry via data_plane_down
+            return 503, {**gate, "info": {"data_plane_down": True}}
         shed = self.predictor.shed_verdict(slo)
         if shed is not None:
             # pre-flight the shed verdict into a REAL 503 — once the
@@ -1370,7 +1547,12 @@ def main(argv: Optional[list] = None) -> int:
     args = parser.parse_args(argv)
     with open(args.config) as f:
         cfg = json.load(f)
-    hub = KVQueueHub(cfg["kv_host"], int(cfg["kv_port"]))
+    # shorter reconnect window than the worker default: the predictor
+    # is the latency surface, and anything past a couple of seconds of
+    # stalling belongs to the down-gate's instant 503, not a hang
+    hub = KVQueueHub(cfg["kv_host"], int(cfg["kv_port"]),
+                     retry_window_s=float(
+                         cfg.get("hub_retry_window_s", 2.0)))
     predictor = Predictor(hub, cfg["worker_ids"],
                           gather_timeout=float(cfg.get("gather_timeout",
                                                        30.0)),

@@ -286,10 +286,30 @@ class InProcQueueHub(QueueHub):
 
 class KVQueueHub(QueueHub):
     """Queues on the native kv server. Blocking pops hold a socket, so each
-    hub keeps one client per calling thread (thread-local)."""
+    hub keeps one client per calling thread (thread-local).
 
-    def __init__(self, host: str, port: int) -> None:
+    Crash-survivable by construction: every thread-local client carries
+    the reconnect layer (``retry_window_s``, see
+    :class:`~rafiki_tpu.native.client.KVClient`), and every queue push
+    mints a dedup id so the retry of a push whose ack was lost — a
+    connection drop, a kvd kill -9 and supervised respawn — can never
+    double-deliver. Reads and ``put_blob`` retry transparently;
+    in-flight blocking pops resume on the new socket. When the window
+    closes a ``ConnectionError`` surfaces and the caller degrades
+    (predictor: structured 503; workers: pause the serve loop)."""
+
+    #: default reconnect window: long enough to ride out a supervised
+    #: kvd respawn + WAL replay (~1-2s observed), short enough that a
+    #: truly dead data plane surfaces as a structured failure, not a
+    #: hang
+    RETRY_WINDOW_S = 8.0
+
+    def __init__(self, host: str, port: int,
+                 retry_window_s: Optional[float] = None) -> None:
         self._host, self._port = host, port
+        self.retry_window_s = (self.RETRY_WINDOW_S
+                               if retry_window_s is None
+                               else float(retry_window_s))
         self._tl = threading.local()
 
     def _client(self):
@@ -297,12 +317,28 @@ class KVQueueHub(QueueHub):
 
         c = getattr(self._tl, "client", None)
         if c is None:
-            c = KVClient(self._host, self._port)
+            c = KVClient(self._host, self._port,
+                         retry_window_s=self.retry_window_s)
             self._tl.client = c
         return c
 
+    def drop_conn(self) -> None:
+        """Force-close the calling thread's client socket (chaos /
+        tests): the next hub op finds a dead transport and exercises
+        the reconnect + idempotent-replay path."""
+        c = getattr(self._tl, "client", None)
+        if c is not None:
+            c.drop_conn()
+
+    @staticmethod
+    def _dedup_id() -> str:
+        import uuid
+
+        return uuid.uuid4().hex
+
     def push_query(self, worker_id: str, data: bytes) -> None:
-        self._client().lpush(f"q:queries:{worker_id}", data)
+        self._client().lpush_dedup(f"q:queries:{worker_id}",
+                                   self._dedup_id(), data)
 
     def pop_query(self, worker_id: str, timeout: float) -> Optional[bytes]:
         if timeout <= 0:  # non-blocking drain (BRPOP 0 means block forever)
@@ -318,7 +354,7 @@ class KVQueueHub(QueueHub):
 
     def push_prediction(self, query_id: str, data: bytes) -> None:
         c = self._client()
-        c.lpush(f"q:preds:{query_id}", data)
+        c.lpush_dedup(f"q:preds:{query_id}", self._dedup_id(), data)
         c.expire(f"q:preds:{query_id}", self.REPLY_TTL_S)
 
     def pop_prediction(self, query_id: str,
@@ -372,7 +408,7 @@ class KVQueueHub(QueueHub):
 
     def push_kv(self, worker_id: str, data: bytes) -> None:
         c = self._client()
-        c.lpush(f"q:kv:{worker_id}", data)
+        c.lpush_dedup(f"q:kv:{worker_id}", self._dedup_id(), data)
         c.expire(f"q:kv:{worker_id}", self.KV_SHIP_TTL_S)
 
     def pop_kv(self, worker_id: str, timeout: float) -> Optional[bytes]:

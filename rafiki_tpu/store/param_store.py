@@ -169,12 +169,19 @@ class FileBackend(ParamBackend):
 
 
 class KVBackend(ParamBackend):
-    """Backend over the native kv/queue data-plane server (Redis stand-in)."""
+    """Backend over the native kv/queue data-plane server (Redis
+    stand-in). The client's reconnect window means a blob save/load
+    rides out a supervised kvd respawn + WAL replay instead of
+    erroring the trial that issued it (every verb here — SET/GET/DEL/
+    KEYS/EXISTS — replays idempotently)."""
+
+    RETRY_WINDOW_S = 8.0
 
     def __init__(self, host: str = "127.0.0.1", port: int = 6399) -> None:
         from ..native.client import KVClient
 
-        self._client = KVClient(host, port)
+        self._client = KVClient(host, port,
+                                retry_window_s=self.RETRY_WINDOW_S)
 
     def put(self, key: str, data: bytes) -> None:
         self._client.set(f"params:{key}", data)

@@ -237,6 +237,15 @@ class HttpStatusError(RuntimeError):
         return bool(self.payload.get("shed"))
 
     @property
+    def data_plane_down(self) -> bool:
+        """True for the data-plane-down 503: the predictor could not
+        reach the kvd (param blobs + queues). Shed-like semantics —
+        the supervisor respawns the kvd with WAL replay in seconds, so
+        honoring ``retry_after_s`` and retrying once is expected to
+        succeed."""
+        return bool(self.payload.get("data_plane_down"))
+
+    @property
     def retry_after_s(self) -> Optional[float]:
         """The server's structured retry hint, when present and
         numeric."""

@@ -162,7 +162,15 @@ class InferenceWorker:
                                "kv_ships_sent": 0,
                                "kv_imports_installed": 0,
                                "kv_wait_timeouts": 0,
-                               "kv_import_fallbacks": 0})
+                               "kv_import_fallbacks": 0,
+                               # data-plane survival: 1 while the hub
+                               # is unreachable past the reconnect
+                               # window (the serve loop PAUSES — obs
+                               # sidecar keeps answering); outages
+                               # counts distinct pause episodes
+                               "data_plane_down": 0,
+                               "hub_outages": 0})
+        self._dp_down = False
         #: deterministic fault injection (tests / chaos drills): either
         #: passed programmatically or armed via the RAFIKI_CHAOS env
         #: var; when armed, queue-level faults ride a ChaosHub wrapper
@@ -191,6 +199,12 @@ class InferenceWorker:
         #: lifecycle histograms the engine's span hook feeds
         self.metrics = MetricsRegistry()
         self.metrics.register_stats(self.stats)
+        # hub reconnect/retry counters from the shared kv client layer
+        # (hub_reconnects_total / hub_rpc_retries_total): the worker's
+        # /metrics shows how hard the data plane made it work
+        from ..native.client import CLIENT_STATS as _kv_client_stats
+
+        self.metrics.register_stats(_kv_client_stats)
         if self.chaos is not None:
             # injected faults are observable, not a mystery: chaos_*
             # gauges ride the worker's /metrics like any counter
@@ -840,6 +854,48 @@ class InferenceWorker:
                 self._reject_draining(m)
             raw = self.hub.pop_query(self.worker_id, 0.0)
 
+    # ---- data-plane outage handling ----
+    #: ceiling on the pause between hub retries while the data plane
+    #: is down — long enough not to spin, short enough that the worker
+    #: notices the respawned kvd within a beat of its WAL replay
+    HUB_OUTAGE_PAUSE_S = 0.5
+
+    def _hub_outage_pause(self, err: Exception,
+                          poll_timeout: float) -> None:
+        """The kvd is unreachable past the client's reconnect window:
+        PAUSE the serve loop instead of crashing into a respawn storm.
+        The obs sidecar keeps answering /metrics and /health the whole
+        time (it never touches the hub), `data_plane_down` flips to 1,
+        and in-flight engine state stays seated — when the supervisor's
+        respawn-with-replay brings the kvd back, the next loop tick
+        picks up exactly where it paused."""
+        import logging
+
+        if not self._dp_down:
+            self._dp_down = True
+            self.stats.set("data_plane_down", 1)
+            self.stats.inc("hub_outages")
+            logging.getLogger(__name__).warning(
+                "%s: data plane unreachable (%s) — serve loop paused "
+                "(health stays up; retrying every %.1fs)",
+                self.worker_id, err,
+                min(self.HUB_OUTAGE_PAUSE_S, max(poll_timeout, 0.05)))
+        self._stop.wait(min(self.HUB_OUTAGE_PAUSE_S,
+                            max(poll_timeout, 0.05)))
+
+    def _hub_ok(self) -> None:
+        """A hub op reached the kvd again: clear the outage flag."""
+        if self._dp_down:
+            import logging
+
+            self._dp_down = False
+            self.stats.set("data_plane_down", 0)
+            logging.getLogger(__name__).warning(
+                "%s: data plane reachable again — serve loop resumed",
+                self.worker_id)
+            self._publish_stats()  # fresh liveness beats the stale
+            #                        pre-outage publish immediately
+
     # ---- the loop ----
     def run(self, poll_timeout: float = 0.5,
             max_iterations: Optional[int] = None) -> None:
@@ -863,36 +919,42 @@ class InferenceWorker:
             n += 1
             if n % self.STATS_EVERY == 1:  # incl. first iteration:
                 self._publish_stats()      # fresh boots appear at once
-            if self._draining.is_set():
-                # micro-batch serving has no in-flight state between
-                # iterations: reject what is queued and leave
-                self._drain_reject_queued()
-                break
-            first = self.hub.pop_query(self.worker_id, poll_timeout)
-            if first is None:
-                continue
-            messages = [unpack_message(first)]
-            while len(messages) < self.max_batch_msgs:
-                more = self.hub.pop_query(self.worker_id, 0.0)
-                if more is None:
+            try:
+                if self._draining.is_set():
+                    # micro-batch serving has no in-flight state
+                    # between iterations: reject what is queued, leave
+                    self._drain_reject_queued()
                     break
-                messages.append(unpack_message(more))
-            serve = []
-            for m in messages:
-                if m.get("control"):
-                    self._handle_control(m)
-                else:
-                    serve.append(m)
-            live = []
-            for m in serve:
-                if _expired(m, skew_est=self._skew):
-                    self._reject_expired(m)
-                else:
-                    live.append(m)
-            if live:
-                # messages popped alongside a drain control preceded
-                # the drain: they are in-flight and get served
-                self._serve_batch(live)
+                first = self.hub.pop_query(self.worker_id, poll_timeout)
+                self._hub_ok()
+                if first is None:
+                    continue
+                messages = [unpack_message(first)]
+                while len(messages) < self.max_batch_msgs:
+                    more = self.hub.pop_query(self.worker_id, 0.0)
+                    if more is None:
+                        break
+                    messages.append(unpack_message(more))
+                serve = []
+                for m in messages:
+                    if m.get("control"):
+                        self._handle_control(m)
+                    else:
+                        serve.append(m)
+                live = []
+                for m in serve:
+                    if _expired(m, skew_est=self._skew):
+                        self._reject_expired(m)
+                    else:
+                        live.append(m)
+                if live:
+                    # messages popped alongside a drain control
+                    # preceded the drain: they are in-flight and served
+                    self._serve_batch(live)
+            except ConnectionError as e:
+                # data plane unreachable past the reconnect window:
+                # pause and retry — health stays up on the obs sidecar
+                self._hub_outage_pause(e, poll_timeout)
         self._publish_stats()  # final counters visible after stop
 
     def _run_decode_loop(self, poll_timeout: float,
@@ -902,15 +964,38 @@ class InferenceWorker:
 
         One loop iteration = (drain the queue, admit, one engine step,
         harvest). While the engine is busy the queue pop is non-blocking
-        so decoding never stalls on an empty queue."""
+        so decoding never stalls on an empty queue.
+
+        Data-plane outages (a hub op exhausting its reconnect window)
+        PAUSE the loop here — in-flight engine state, the inflight
+        table, and streaming ids all survive the pause, so when the
+        supervisor's respawn-with-replay brings the kvd back the loop
+        resumes decoding the same streams; a delta pushed into the
+        dead window is healed by the final predictions message (the
+        client's replace/tail contract)."""
         # message id -> [n_pending, {query_index: text}]
         inflight: dict = {}
         streaming: set = set()  # message ids that asked for token deltas
-        n = 0
+        state = {"n": 0}
         while not self._stop.is_set():
+            try:
+                self._decode_serve(inflight, streaming, state,
+                                   poll_timeout, max_iterations)
+                break  # served to completion (stop/drain/iterations)
+            except ConnectionError as e:
+                self._hub_outage_pause(e, poll_timeout)
+        if self.chaos_killed:
+            return  # injected sudden death: no final publish either
+        self._publish_stats()  # final counters visible after stop
+
+    def _decode_serve(self, inflight: dict, streaming: set,
+                      state: dict, poll_timeout: float,
+                      max_iterations: Optional[int]) -> None:
+        while not self._stop.is_set():
+            n = state["n"]
             if max_iterations is not None and n >= max_iterations:
                 break
-            n += 1
+            n = state["n"] = n + 1
             if n % self.STATS_EVERY == 1:  # incl. first iteration
                 self._publish_stats()
             # held shipped-KV requests count as busy: the loop must
@@ -919,6 +1004,7 @@ class InferenceWorker:
             busy = self.engine.busy or bool(self._pending_kv)
             raw = self.hub.pop_query(self.worker_id,
                                      0.0 if busy else poll_timeout)
+            self._hub_ok()
             while raw is not None:
                 m = unpack_message(raw)
                 if m.get("control"):
@@ -1042,7 +1128,6 @@ class InferenceWorker:
             if self._draining.is_set() and not inflight \
                     and not self._pending_kv and not self.engine.busy:
                 break  # drain complete: every in-flight stream answered
-        self._publish_stats()  # final counters visible after stop
 
     # ---- disaggregated prefill/decode (see serving/kv_transfer.py) --
     def _can_import_kv(self) -> bool:

@@ -1,0 +1,347 @@
+"""kvd WAL + snapshot persistence: replay, torn tails, CRC refusal,
+dedup pushes, compaction, the STATS verb, and the Python dry-run
+scanner.
+
+These are the unit-level halves of the crash-survivable data plane
+(docs/operations.md "Data-plane death & recovery"); the integration
+halves — supervised respawn, reconnecting hub clients, the kill -9
+acceptance drill — live in tests/test_hub_reconnect.py.
+"""
+
+import os
+import signal
+import struct
+import time
+import zlib
+from pathlib import Path
+
+import pytest
+
+from rafiki_tpu.native import wal as kvwal
+from rafiki_tpu.native.client import KVClient, KVServer, ensure_built
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _built():
+    ensure_built()
+
+
+def _boot(data_dir, **kw):
+    return KVServer(data_dir=str(data_dir), **kw)
+
+
+def _kill9(server):
+    os.kill(server._proc.pid, signal.SIGKILL)
+    server._proc.wait()
+
+
+# ------------------------------------------------------ basic replay
+
+def test_graceful_restart_restores_state(tmp_path):
+    """SHUTDOWN fsyncs; a reboot on the same data dir restores blobs,
+    list content AND order, and the effect of pops/deletes."""
+    s = _boot(tmp_path / "dd")
+    c = KVClient(s.host, s.port)
+    c.set("params:t1", b"\x00blob\xff")
+    c.set("doomed", b"x")
+    c.delete("doomed")
+    for v in (b"a", b"b", b"c", b"d"):
+        c.rpush("q", v)
+    assert c.brpop("q", 1.0) == ("q", b"d")  # tail pop logged
+    assert c.lpop("q") == b"a"               # head pop logged
+    c.incr("ctr")
+    c.incr("ctr")
+    c.shutdown()
+    s._proc.wait(timeout=5)
+
+    s2 = _boot(tmp_path / "dd")
+    c2 = KVClient(s2.host, s2.port)
+    assert c2.get("params:t1") == b"\x00blob\xff"
+    assert c2.get("doomed") is None
+    # surviving list content in original order: b then c
+    assert c2.lpop("q") == b"b"
+    assert c2.lpop("q") == b"c"
+    assert c2.llen("q") == 0
+    # INCR is WAL-logged as its resulting SET — replay can't double it
+    assert c2.incr("ctr") == 3
+    s2.stop()
+
+
+def test_kill9_restart_restores_state_without_fsync(tmp_path):
+    """A PROCESS crash loses nothing even under --fsync no: records
+    are written to the fd per command, and kill -9 only discards
+    user-space state. (The fsync policy guards against host crashes.)"""
+    s = _boot(tmp_path / "dd", fsync="no")
+    c = KVClient(s.host, s.port)
+    c.set("k", b"v")
+    c.lpush("q", b"m")
+    _kill9(s)
+    s2 = _boot(tmp_path / "dd", fsync="no")
+    c2 = KVClient(s2.host, s2.port)
+    assert c2.get("k") == b"v"
+    assert c2.llen("q") == 1
+    s2.stop()
+
+
+def test_fsync_policies_accepted(tmp_path):
+    for i, policy in enumerate(("always", "everysec", "no")):
+        s = _boot(tmp_path / f"dd{i}", fsync=policy)
+        c = KVClient(s.host, s.port)
+        c.set("k", b"v")
+        assert c.stats()["fsync_policy"] == policy
+        s.stop()
+    with pytest.raises(ValueError):
+        KVServer(data_dir=str(tmp_path / "bad"), fsync="sometimes")
+
+
+def test_expiry_rearmed_after_replay(tmp_path):
+    """EXPIRE records replay by re-arming from boot time: a condemned
+    key is still collected after a crash (late, never early)."""
+    s = _boot(tmp_path / "dd")
+    c = KVClient(s.host, s.port)
+    c.set("mortal", b"v")
+    c.expire("mortal", 0.5)
+    _kill9(s)
+    s2 = _boot(tmp_path / "dd")
+    c2 = KVClient(s2.host, s2.port)
+    assert c2.get("mortal") == b"v"  # TTL re-armed, not pre-fired
+    time.sleep(0.8)
+    c2.ping()  # trigger the purge scan
+    assert c2.get("mortal") is None
+    s2.stop()
+
+
+# ------------------------------------------------- torn tail / corrupt
+
+def test_torn_tail_truncated_loudly_and_served(tmp_path):
+    s = _boot(tmp_path / "dd")
+    c = KVClient(s.host, s.port)
+    c.set("k", b"v")
+    c.shutdown()
+    s._proc.wait(timeout=5)
+    wal_path = tmp_path / "dd" / "wal"
+    good = wal_path.read_bytes()
+    # a half-written record: plausible header promising more bytes
+    # than exist (exactly what kill -9 mid-append leaves behind)
+    wal_path.write_bytes(good + struct.pack("<II", 64, 0) + b"GARBAGE")
+    s2 = _boot(tmp_path / "dd")
+    c2 = KVClient(s2.host, s2.port)
+    assert c2.get("k") == b"v"
+    st = c2.stats()
+    assert st["wal_truncated_bytes"] == 8 + len(b"GARBAGE")
+    # the torn bytes were truncated IN the file, not just skipped
+    assert wal_path.read_bytes() == good
+    s2.stop()
+
+
+def test_crc_corrupt_record_refuses_boot(tmp_path):
+    """A full-length record whose CRC mismatches is disk/operator
+    damage: the boot must FAIL with a structured JSON error, not serve
+    silently-wrong state."""
+    s = _boot(tmp_path / "dd")
+    c = KVClient(s.host, s.port)
+    c.set("k", b"A" * 64)
+    c.set("k2", b"B" * 64)
+    c.shutdown()
+    s._proc.wait(timeout=5)
+    wal_path = tmp_path / "dd" / "wal"
+    data = bytearray(wal_path.read_bytes())
+    data[20] ^= 0xFF  # flip a byte inside the first record's payload
+    wal_path.write_bytes(bytes(data))
+    with pytest.raises(RuntimeError) as ei:
+        _boot(tmp_path / "dd")
+    assert "kvd_wal_corrupt" in str(ei.value)
+    assert "rc=4" in str(ei.value)
+
+
+# -------------------------------------------------------- dedup pushes
+
+def test_dedup_push_within_and_across_restart(tmp_path):
+    s = _boot(tmp_path / "dd")
+    c = KVClient(s.host, s.port)
+    assert c.lpush_dedup("q", "id-1", b"m1") == 1
+    assert c.lpush_dedup("q", "id-1", b"m1") == 1  # retry: no-op
+    assert c.lpush_dedup("q", "id-2", b"m2") == 2
+    _kill9(s)
+    s2 = _boot(tmp_path / "dd")
+    c2 = KVClient(s2.host, s2.port)
+    assert c2.llen("q") == 2
+    # the recent-set survived the crash via the WAL: a client retrying
+    # its unacked push against the RESPAWNED server still can't
+    # double-deliver
+    assert c2.lpush_dedup("q", "id-2", b"m2") == 2
+    assert c2.llen("q") == 2
+    s2.stop()
+
+
+# --------------------------------------------------------- compaction
+
+def test_compact_shrinks_wal_and_preserves_state(tmp_path):
+    s = _boot(tmp_path / "dd")
+    c = KVClient(s.host, s.port)
+    for i in range(50):
+        c.set("hot", b"v%d" % i)  # 50 overwrites -> 1 snapshot record
+    c.rpush("q", b"a", b"b")
+    c.lpush_dedup("q", "idX", b"c")
+    wal_before = c.stats()["wal_bytes"]
+    assert wal_before > 0
+    c.compact()
+    st = c.stats()
+    # the reset WAL holds only the snapshot-pairing WALHDR record
+    assert 0 < st["wal_bytes"] < 64, st["wal_bytes"]
+    assert st["snapshot_bytes"] > 0
+    assert st["compactions"] == 1
+    assert st["snapshot_age_s"] >= 0
+    _kill9(s)
+    s2 = _boot(tmp_path / "dd")
+    c2 = KVClient(s2.host, s2.port)
+    assert c2.get("hot") == b"v49"
+    assert c2.lpop("q") == b"c"  # LPUSHD pushed front
+    assert c2.lpop("q") == b"a"
+    assert c2.lpop("q") == b"b"
+    # dedup ids ride the snapshot as DEDUP records
+    assert c2.lpush_dedup("q2", "idX", b"zzz") == 0
+    s2.stop()
+
+
+def test_stale_wal_after_snapshot_rename_not_double_applied(tmp_path):
+    """The compaction crash window: a kill between the snapshot rename
+    and the WAL truncate leaves the NEW snapshot next to the FULL
+    pre-compaction WAL. Replaying both would double-deliver every
+    queued message since the previous compaction — the epoch pairing
+    (snapshot `EPOCH` ↔ WAL `WALHDR`) must make the boot DISCARD the
+    stale WAL instead."""
+    s = _boot(tmp_path / "dd")
+    c = KVClient(s.host, s.port)
+    c.rpush("q", b"m1", b"m2")
+    c.set("k", b"v")
+    c.shutdown()
+    s._proc.wait(timeout=5)
+    dd = tmp_path / "dd"
+    stale_wal = (dd / "wal").read_bytes()
+    assert stale_wal  # the pre-compaction records
+
+    # run the compaction on a live server, then SIMULATE the crash
+    # window by restoring the pre-compaction WAL next to the new
+    # snapshot (exactly what dying before the truncate leaves behind)
+    s = _boot(tmp_path / "dd")
+    KVClient(s.host, s.port).compact()
+    _kill9(s)
+    (dd / "wal").write_bytes(stale_wal)
+
+    s2 = _boot(tmp_path / "dd")
+    c2 = KVClient(s2.host, s2.port)
+    assert c2.get("k") == b"v"
+    assert c2.llen("q") == 2  # NOT 4: the stale WAL was discarded
+    assert c2.lpop("q") == b"m1"
+    # the Python dry-run scanner agrees with the boot's verdict
+    c2.shutdown()
+    s2._proc.wait(timeout=5)
+    state = kvwal.replay_state(str(dd))
+    assert len(state["lists"]["q"]) == 1  # m2 (m1 popped, logged)
+
+
+def test_auto_compaction_on_rotate_threshold(tmp_path):
+    s = _boot(tmp_path / "dd", wal_rotate_bytes=2048)
+    c = KVClient(s.host, s.port)
+    for i in range(100):
+        # distinct keys so the write that CROSSES the rotate threshold
+        # is distinguishable — rotation must run after the mutation
+        # lands, or the boundary write would be snapshot-less AND
+        # truncated out of the WAL (durably lost)
+        c.set("k%d" % i, b"x" * 64)
+    st = c.stats()
+    assert st["compactions"] >= 1
+    assert st["wal_bytes"] <= 2048
+    _kill9(s)
+    s2 = _boot(tmp_path / "dd", wal_rotate_bytes=2048)
+    c2 = KVClient(s2.host, s2.port)
+    for i in range(100):  # every acknowledged write survived, incl.
+        # the ones that triggered a rotation
+        assert c2.get("k%d" % i) == b"x" * 64, i
+    s2.stop()
+
+
+def test_stats_verb_fields(tmp_path):
+    s = _boot(tmp_path / "dd")
+    c = KVClient(s.host, s.port)
+    st = c.stats()
+    for key in ("persist_enabled", "fsync_policy", "wal_bytes",
+                "snapshot_bytes", "snapshot_age_s", "last_fsync_age_s",
+                "replay_seconds", "replayed_records",
+                "wal_truncated_bytes", "compactions", "dedup_ids",
+                "keys", "lists"):
+        assert key in st, key
+    assert st["persist_enabled"] == 1
+    s.stop()
+
+
+def test_no_data_dir_is_pure_memory():
+    with KVServer() as s:
+        c = KVClient(s.host, s.port)
+        st = c.stats()
+        assert st["persist_enabled"] == 0
+        with pytest.raises(RuntimeError):
+            c.compact()  # structured error, not a crash
+
+
+# ------------------------------------------- the Python dry-run scanner
+
+def test_wal_scanner_matches_server_verdicts(tmp_path):
+    s = _boot(tmp_path / "dd")
+    c = KVClient(s.host, s.port)
+    c.set("params:t1", b"blob")
+    c.rpush("q", b"a", b"b")
+    assert c.brpop("q", 1.0) == ("q", b"b")  # BRPOP pops the tail
+    c.shutdown()
+    s._proc.wait(timeout=5)
+
+    rep = kvwal.dry_run_replay(str(tmp_path / "dd"))
+    assert rep["ok"], rep["findings"]
+    assert rep["replayable_records"] == 3  # SET, RPUSH, logged RPOP
+    state = kvwal.replay_state(str(tmp_path / "dd"))
+    assert state["kv"] == {"params:t1": b"blob"}
+    assert state["lists"]["q"] == [b"a"]
+
+    # torn tail: reported, still ok (a real boot truncates and serves)
+    wal_path = tmp_path / "dd" / "wal"
+    wal_path.write_bytes(wal_path.read_bytes() + b"\x01\x02\x03")
+    rep = kvwal.dry_run_replay(str(tmp_path / "dd"))
+    assert rep["ok"]
+    assert rep["wal"]["torn_tail_bytes"] == 3
+
+    # corruption: not ok, with the offset in the finding
+    data = bytearray(wal_path.read_bytes()[:-3])
+    data[9] ^= 0xFF  # inside the first record's crc/payload area
+    wal_path.write_bytes(bytes(data))
+    rep = kvwal.dry_run_replay(str(tmp_path / "dd"))
+    assert not rep["ok"]
+    assert any("corrupt" in f for f in rep["findings"])
+
+
+def test_wal_scanner_crc_parity_with_server(tmp_path):
+    """The Python scanner and the C++ loader must agree on framing and
+    CRC — a record the scanner blesses replays on a real boot."""
+    s = _boot(tmp_path / "dd")
+    c = KVClient(s.host, s.port)
+    payload = bytes(range(256)) * 3 + b"\r\n$*"
+    c.set("bin", payload)
+    c.shutdown()
+    s._proc.wait(timeout=5)
+    recs = kvwal.iter_records(tmp_path / "dd" / "wal")
+    assert recs == [[b"SET", b"bin", payload]]
+    # independent CRC check over the raw record bytes
+    raw = (tmp_path / "dd" / "wal").read_bytes()
+    length, crc = struct.unpack_from("<II", raw, 0)
+    assert (zlib.crc32(raw[8:8 + length]) & 0xFFFFFFFF) == crc
+    s2 = _boot(tmp_path / "dd")
+    c2 = KVClient(s2.host, s2.port)
+    assert c2.get("bin") == payload
+    s2.stop()
+
+
+def test_scanner_empty_dir_not_ok(tmp_path):
+    rep = kvwal.dry_run_replay(str(tmp_path / "empty"))
+    assert not rep["ok"]
+    assert any("cold-start" in f for f in rep["findings"])
+    assert Path(rep["data_dir"]).name == "empty"
