@@ -382,7 +382,11 @@ class ServicesManager:
         admin dying. Returns the recovery counter snapshot.
         """
         with self.op_lock:
-            return self._reconcile()
+            # op_lock intentionally serializes whole admin operations,
+            # terminate/spawn waits included — overlapping reconciles
+            # would double-spawn; see "Admin op serialization" in
+            # docs/linting.md
+            return self._reconcile()  # rafiki: noqa[lock-order-cycle]
 
     def _reconcile(self) -> Dict[str, Any]:
         import logging
@@ -889,7 +893,9 @@ class ServicesManager:
                               n_workers: Optional[int] = None
                               ) -> List[ManagedService]:
         with self.op_lock:
-            return self._create_train_services(
+            # op_lock serializes admin ops end-to-end, spawn port-waits
+            # included (see docs/linting.md "Admin op serialization")
+            return self._create_train_services(  # rafiki: noqa[lock-order-cycle]
                 train_job_id,
                 self.default_workers if n_workers is None else n_workers)
 
@@ -1138,7 +1144,10 @@ class ServicesManager:
 
         with self.op_lock:
             try:
-                return self._create_inference_services(
+                # op_lock serializes admin ops end-to-end, spawn
+                # port-waits included (see docs/linting.md "Admin op
+                # serialization")
+                return self._create_inference_services(  # rafiki: noqa[lock-order-cycle]
                     inference_job_id, best, slots,
                     multi_adapter=multi_adapter)
             except BaseException:
@@ -1468,7 +1477,10 @@ class ServicesManager:
     def poll(self) -> None:
         """Reap exited children; release their slots; record status."""
         with self.op_lock:
-            self._poll()
+            # op_lock serializes admin ops end-to-end; _poll's respawn
+            # path waits on spawn port files by design (see
+            # docs/linting.md "Admin op serialization")
+            self._poll()  # rafiki: noqa[lock-order-cycle]
 
     def _poll(self) -> None:
         self._check_data_plane()
@@ -1763,7 +1775,11 @@ class ServicesManager:
                             f"worker {sid} — rolling restart aborted "
                             "mid-way")
                 try:
-                    new = self._spawn(spec["module"], spec["config"],
+                    # rolling restart must hold op_lock across the
+                    # spawn wait — releasing it mid-restart would let
+                    # a concurrent scale op grab the vacated slot (see
+                    # docs/linting.md "Admin op serialization")
+                    new = self._spawn(spec["module"], spec["config"],  # rafiki: noqa[lock-order-cycle]
                                       spec["service_type"], slot=slot,
                                       **spec["meta_kwargs"])
                 except Exception:
@@ -1948,7 +1964,10 @@ class ServicesManager:
             except OSError:         # must not instantly promote
                 pass
             try:
-                self._spawn(st["module"], cfg,
+                # scale-up holds op_lock across the spawn wait so the
+                # claimed slot cannot be double-assigned (see
+                # docs/linting.md "Admin op serialization")
+                self._spawn(st["module"], cfg,  # rafiki: noqa[lock-order-cycle]
                             ServiceType.INFERENCE_WORKER, slot=slot,
                             inference_job_id=job_id)
             except Exception:

@@ -10,24 +10,38 @@ engine targeting exactly those classes, run over ``rafiki_tpu/`` itself
 by a tier-1 test (``tests/test_lint.py``) so the repo stays self-clean
 and every future PR is gated.
 
-Public API:
+Two scopes:
 
-- :func:`analyze_paths` / :func:`analyze_source` — run all (or selected)
-  rules, returning :class:`Finding` objects.
-- :class:`Rule`, :func:`register` — the rule framework; see
-  ``docs/linting.md`` for how to add a rule.
-- ``# rafiki: noqa[rule-id]`` on a finding's line suppresses it.
+- **per-module rules** (:class:`Rule`) see one file at a time via
+  :func:`analyze_paths` / :func:`analyze_source`;
+- **project rules** (:class:`ProjectRule`, ``lint --project``) see the
+  whole package at once via :func:`analyze_project` — cross-layer
+  contracts (hub verb parity, lock ordering across classes, metric
+  catalog drift) live here; see ``docs/linting.md``.
+
+``# rafiki: noqa[rule-id]`` on a finding's line suppresses it in both
+scopes — inside the comment syntax of whatever file the finding lands
+in (Python, C++, Markdown, HTML).
 """
 
 from .engine import (Finding, Rule, all_rules, analyze_paths,
                      analyze_source, get_rule, register)
+from .project import (ProjectContext, ProjectRule, all_project_rules,
+                      analyze_project, get_project_rule,
+                      register_project)
 
 __all__ = [
     "Finding",
+    "ProjectContext",
+    "ProjectRule",
     "Rule",
+    "all_project_rules",
     "all_rules",
     "analyze_paths",
+    "analyze_project",
     "analyze_source",
+    "get_project_rule",
     "get_rule",
     "register",
+    "register_project",
 ]

@@ -6,18 +6,32 @@ in :mod:`rafiki_tpu.cli`), the ``rafiki-tpu-lint`` console entry
 of them parse the same flags and exit with the same contract:
 
 - 0 — no unsuppressed findings (the CI gate passes)
-- 1 — findings (printed to stdout, text or ``--format json``)
-- 2 — usage/IO error (bad rule id, unreadable path)
+- 1 — findings (printed to stdout; text, ``--format json``, or
+  ``--format sarif``)
+- 2 — usage/IO error (bad rule id, unreadable path, git failure)
+
+Two scopes compose:
+
+- per-module rules always run over the requested paths (narrowed to
+  ``git diff`` output under ``--changed-only``);
+- ``--project`` additionally runs the whole-program rules
+  (:mod:`rafiki_tpu.analysis.project`) over the same roots — ALWAYS
+  whole-tree, even under ``--changed-only``, because cross-layer
+  contracts (hub verb parity, lock ordering) can be broken by the
+  files you did NOT touch.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
+import subprocess
 import sys
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
-from .engine import (all_rules, analyze_paths, get_rule, render_json,
-                     render_text)
+from .engine import (all_rules, analyze_paths, render_json,
+                     render_sarif, render_text)
+from .project import all_project_rules, analyze_project
 
 
 def add_lint_arguments(parser: argparse.ArgumentParser) -> None:
@@ -25,11 +39,23 @@ def add_lint_arguments(parser: argparse.ArgumentParser) -> None:
         "paths", nargs="*", default=["rafiki_tpu"],
         help="files or directories to analyze (default: rafiki_tpu)")
     parser.add_argument(
-        "--format", choices=["text", "json"], default="text",
+        "--format", choices=["text", "json", "sarif"], default="text",
         help="finding output format")
     parser.add_argument(
         "--select", default=None, metavar="RULE[,RULE...]",
-        help="run only these rule ids (default: all registered rules)")
+        help="run only these rule ids (default: all registered rules, "
+             "per-module and project alike)")
+    parser.add_argument(
+        "--project", action="store_true",
+        help="also run the whole-program rules (lock-order-cycle, "
+             "hub-verb-parity, ...) over the full tree — the repo "
+             "self-check runs with this on")
+    parser.add_argument(
+        "--changed-only", nargs="?", const="HEAD", default=None,
+        metavar="BASE_REF",
+        help="scope per-module rules to files changed vs BASE_REF "
+             "(default HEAD: staged+worktree changes) plus untracked "
+             "files; project rules still see the whole tree")
     parser.add_argument(
         "--show-suppressed", action="store_true",
         help="include findings silenced by `# rafiki: noqa[...]` "
@@ -40,33 +66,105 @@ def add_lint_arguments(parser: argparse.ArgumentParser) -> None:
         help="print the registered rules and exit")
 
 
+def _changed_files(base_ref: str) -> List[str]:
+    """Paths changed vs ``base_ref`` plus untracked files, absolute.
+
+    Raises ``OSError`` (-> exit 2) when git is unusable: a typo'd ref
+    must not silently lint nothing and report clean.
+    """
+    out: List[str] = []
+    for cmd in (["git", "diff", "--name-only", base_ref, "--"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise OSError(
+                f"--changed-only: {' '.join(cmd)} failed: "
+                f"{proc.stderr.strip()}")
+        out.extend(line.strip() for line in proc.stdout.splitlines()
+                   if line.strip())
+    top = subprocess.run(["git", "rev-parse", "--show-toplevel"],
+                         capture_output=True, text=True)
+    root = top.stdout.strip() if top.returncode == 0 else os.getcwd()
+    return [os.path.join(root, p) for p in out]
+
+
+def _scope_to_changed(paths: List[str],
+                      changed: List[str]) -> List[str]:
+    """Changed ``.py`` files that fall under the requested paths."""
+    roots = [os.path.abspath(p) for p in paths]
+    keep = []
+    for path in changed:
+        if not path.endswith(".py") or not os.path.exists(path):
+            continue  # deleted files have no content to lint
+        ap = os.path.abspath(path)
+        if any(ap == r or ap.startswith(r + os.sep) for r in roots):
+            keep.append(ap)
+    return keep
+
+
+def _split_select(select_arg: Optional[str]
+                  ) -> Tuple[Optional[List[str]], Optional[List[str]]]:
+    """``--select`` string -> (per-module ids, project ids).
+
+    Unknown ids raise ``KeyError`` so the caller can exit 2.
+    """
+    if not select_arg:
+        return None, None
+    ids = [r.strip() for r in select_arg.split(",") if r.strip()]
+    module_rules, project_rules = all_rules(), all_project_rules()
+    known = set(module_rules) | set(project_rules)
+    for rule_id in ids:
+        if rule_id not in known:
+            raise KeyError(
+                f"unknown rule {rule_id!r} "
+                f"(known: {', '.join(sorted(known))})")
+    return ([r for r in ids if r in module_rules],
+            [r for r in ids if r in project_rules])
+
+
 def run_lint(args: argparse.Namespace) -> int:
     if args.list_rules:
         for rule_id, rule in sorted(all_rules().items()):
             print(f"{rule_id} [{rule.category}/{rule.severity}]\n"
                   f"    {rule.description}")
+        for rule_id, rule in sorted(all_project_rules().items()):
+            print(f"{rule_id} [project:{rule.category}/{rule.severity}]"
+                  f"\n    {rule.description}")
         return 0
-    select = None
-    if args.select:
-        select = [r.strip() for r in args.select.split(",") if r.strip()]
-        try:
-            for rule_id in select:  # validate ids up front: usage error
-                get_rule(rule_id)
-        except KeyError as e:
-            # KeyError's str() wraps its message in quotes; unwrap
-            print(f"rafiki-tpu lint: {e.args[0]}", file=sys.stderr)
-            return 2
     try:
-        findings = analyze_paths(args.paths, select=select,
-                                 with_suppressed=args.show_suppressed)
+        file_select, project_select = _split_select(args.select)
+    except KeyError as e:
+        # KeyError's str() wraps its message in quotes; unwrap
+        print(f"rafiki-tpu lint: {e.args[0]}", file=sys.stderr)
+        return 2
+    try:
+        file_paths = list(args.paths)
+        if args.changed_only is not None:
+            file_paths = _scope_to_changed(
+                file_paths, _changed_files(args.changed_only))
+        findings = []
+        if file_select != [] and file_paths:
+            findings.extend(analyze_paths(
+                file_paths, select=file_select,
+                with_suppressed=args.show_suppressed))
+        if args.project and project_select != []:
+            findings.extend(analyze_project(
+                args.paths, select=project_select,
+                with_suppressed=args.show_suppressed))
     except OSError as e:
         # str(OSError) keeps errno text AND the path; a rule bug
         # (any other exception) propagates with its traceback instead
         # of masquerading as a usage error
         print(f"rafiki-tpu lint: {e}", file=sys.stderr)
         return 2
+    # the per-module and project passes both report parse errors for
+    # the same broken file — dedupe before rendering
+    findings = list(dict.fromkeys(findings))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     if args.format == "json":
         print(render_json(findings))
+    elif args.format == "sarif":
+        print(render_sarif(findings))
     elif findings:
         print(render_text(findings))
     else:

@@ -265,6 +265,66 @@ def render_text(findings: Sequence[Finding]) -> str:
     return "\n".join(lines)
 
 
+def render_sarif(findings: Sequence[Finding]) -> str:
+    """SARIF 2.1.0 — the interchange format editors and CI annotation
+    APIs (GitHub code scanning, VS Code SARIF viewer) consume.
+
+    One run, one driver; every rule that produced a finding gets a
+    ``rules`` entry with its description so viewers can show it inline.
+    Paths are emitted relative to the working directory when possible —
+    SARIF consumers resolve relative URIs against the repo root.
+    """
+    rule_meta: Dict[str, Dict[str, object]] = {}
+
+    def _describe(rule_id: str) -> None:
+        if rule_id in rule_meta:
+            return
+        from .project import all_project_rules
+
+        rule = all_rules().get(rule_id) or \
+            all_project_rules().get(rule_id)
+        entry: Dict[str, object] = {"id": rule_id}
+        if rule is not None:
+            entry["shortDescription"] = {"text": rule.description}
+            entry["properties"] = {"category": rule.category}
+        rule_meta[rule_id] = entry
+
+    results = []
+    for f in findings:
+        _describe(f.rule)
+        path = f.path
+        if os.path.isabs(path):
+            try:
+                path = os.path.relpath(path)
+            except ValueError:  # different drive (windows) — keep abs
+                pass
+        results.append({
+            "ruleId": f.rule,
+            "level": f.severity,  # SARIF levels include error/warning
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": path.replace(os.sep, "/")},
+                    "region": {"startLine": max(f.line, 1),
+                               "startColumn": f.col + 1},
+                },
+            }],
+        })
+    return json.dumps({
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "rafiki-tpu-lint",
+                "rules": [rule_meta[r] for r in sorted(rule_meta)],
+            }},
+            "results": results,
+        }],
+    }, indent=2)
+
+
 def render_json(findings: Sequence[Finding]) -> str:
     return json.dumps({
         "findings": [f.to_dict() for f in findings],

@@ -1,0 +1,484 @@
+"""Whole-program analysis: :class:`ProjectContext` + :class:`ProjectRule`.
+
+The per-module engine (:mod:`rafiki_tpu.analysis.engine`) sees one
+``ModuleContext`` at a time, so the bug classes that actually cost
+review passes — a hub decorator silently not wrapping four verbs, a
+lock cycle spanning two classes, a metric registered in one layer and
+documented (or dashboarded) in another — were not expressible as rules.
+This module parses the whole package ONCE and hands every project rule
+the same shared view:
+
+- **module registry** — dotted module name -> the same ``ModuleContext``
+  the per-file rules use (parsed once, shared);
+- **import graph** — per module, local name -> fully qualified target,
+  with relative imports resolved;
+- **class/attribute resolution** — every class with its methods, its
+  resolved project bases, and a light ``self.attr`` -> class type map
+  (from ``self.x = ClassName(...)`` assignments);
+- **light call graph** — per function, best-effort resolution of
+  ``self.m()`` / ``helper()`` / ``self.attr.m()`` call sites to other
+  project functions;
+- **text resources** — the non-Python files cross-layer contracts live
+  in (``kv_server.cc``, ``docs/*.md``, ``dashboard.html``), loaded as
+  line lists so rules can diff code against them.
+
+Suppression reuses the repo dialect: ``# rafiki: noqa[rule-id]`` on the
+finding's line. For findings anchored in non-Python resources the same
+token works inside that file's own comment syntax (``<!-- rafiki:
+noqa[rule] -->`` in HTML/Markdown, ``// rafiki: noqa[rule]`` in C++) —
+the engine just searches the finding's line for the token.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import (Dict, Iterator, List, Optional, Sequence, Set,
+                    Tuple)
+
+from .engine import (Finding, ModuleContext, _NOQA_RE, SEVERITIES,
+                     iter_python_files)
+
+#: the suppression token inside non-Python comment syntaxes: C++
+#: (``// rafiki: noqa[x]``), HTML/Markdown (``<!-- rafiki: noqa[x]
+#: -->``), block comments. Same grammar as the Python dialect.
+_RES_NOQA_RE = re.compile(
+    r"(?:#|//|<!--|/\*)\s*rafiki:\s*noqa"
+    r"(?:\[([^\]]*)\]|(?![\w\[-]))")
+
+#: extra (non-``.py``) files worth loading as text resources: the other
+#: halves of cross-layer contracts.
+_RESOURCE_EXTS = (".cc", ".cpp", ".h", ".md", ".html")
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    """One class, resolved against the project."""
+
+    module: str
+    name: str
+    node: ast.ClassDef
+    #: base spellings resolved to project-qualified ``module:Class``
+    #: where possible (unresolved externals keep their dotted spelling)
+    bases: List[str]
+    methods: Dict[str, ast.AST]
+    #: ``attr`` -> ``module:Class`` for ``self.attr = ClassName(...)``
+    attr_types: Dict[str, str]
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.module}:{self.name}"
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """One function or method with its light call-site resolution."""
+
+    module: str
+    #: ``Class.method`` or bare ``name``
+    name: str
+    node: ast.AST
+    cls: Optional[str]  # owning class qualname, if a method
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.module}:{self.name}"
+
+
+class TextResource:
+    """A non-Python file a contract lives in (docs, C++, dashboard)."""
+
+    def __init__(self, path: str, text: str):
+        self.path = path
+        self.text = text
+        self.lines = text.splitlines()
+
+    def find_line(self, needle: str, start: int = 1) -> int:
+        """1-based line of the first occurrence of ``needle`` at or
+        after ``start`` (0 when absent) — for anchoring findings."""
+        for i in range(start - 1, len(self.lines)):
+            if needle in self.lines[i]:
+                return i + 1
+        return 0
+
+
+def _module_name(path: str, root: str) -> str:
+    rel = os.path.relpath(path, root)
+    parts = rel[:-3].split(os.sep)  # strip .py
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    base = os.path.basename(os.path.abspath(root))
+    return ".".join([base] + parts) if parts else base
+
+
+class ProjectContext:
+    """Everything a :class:`ProjectRule` may inspect, parsed once."""
+
+    def __init__(self, roots: Sequence[str]):
+        self.roots = [os.path.abspath(r) for r in roots]
+        self.modules: Dict[str, ModuleContext] = {}
+        self.module_infos: Dict[str, Tuple[str, str]] = {}  # name->(path,root)
+        self.parse_errors: List[Finding] = []
+        self.resources: Dict[str, TextResource] = {}  # basename -> res
+        self.classes: Dict[str, ClassInfo] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        #: per module: local name -> fully qualified project target
+        self.imports: Dict[str, Dict[str, str]] = {}
+        self._noqa_cache: Dict[str, Dict[int, frozenset]] = {}
+        self._load()
+        self._index()
+
+    # ---- loading ----
+
+    def _load(self) -> None:
+        for root in self.roots:
+            root_dir = root if os.path.isdir(root) else os.path.dirname(root)
+            for path in iter_python_files([root]):
+                with open(path, "rb") as f:
+                    raw = f.read()
+                try:
+                    source = raw.decode("utf-8")
+                    ctx = ModuleContext(source, path)
+                except (UnicodeDecodeError, SyntaxError) as e:
+                    line = getattr(e, "lineno", 1) or 1
+                    self.parse_errors.append(Finding(
+                        "parse-error", "error", path, line, 0,
+                        f"could not parse: {e}"))
+                    continue
+                name = _module_name(path, root_dir)
+                self.modules[name] = ctx
+                self.module_infos[name] = (path, root_dir)
+            self._load_resources(root_dir)
+            # docs/ conventionally sits NEXT to the package dir (repo
+            # root) — include it so doc-parity rules see the catalog
+            sibling_docs = os.path.join(os.path.dirname(root_dir), "docs")
+            if os.path.isdir(sibling_docs):
+                self._load_resources(sibling_docs)
+
+    def _load_resources(self, root_dir: str) -> None:
+        for cur, dirs, files in os.walk(root_dir):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in ("__pycache__", ".git", "build",
+                                          "dist", "node_modules"))
+            for fname in sorted(files):
+                if not fname.endswith(_RESOURCE_EXTS):
+                    continue
+                path = os.path.join(cur, fname)
+                try:
+                    with open(path, "r", encoding="utf-8",
+                              errors="replace") as f:
+                        text = f.read()
+                except OSError:
+                    continue
+                # first one wins per basename: rules address resources
+                # by filename (``kv_server.cc``), and fixtures mirror
+                # the real layout
+                self.resources.setdefault(fname, TextResource(path, text))
+
+    def resource(self, basename: str) -> Optional[TextResource]:
+        return self.resources.get(basename)
+
+    def md_resources(self) -> List[TextResource]:
+        return [r for n, r in sorted(self.resources.items())
+                if n.endswith(".md")]
+
+    # ---- indexing ----
+
+    def _index(self) -> None:
+        # pass 1: class + function defs, import tables
+        for mod, ctx in self.modules.items():
+            self.imports[mod] = self._import_table(mod, ctx.tree)
+            for node in ctx.tree.body:
+                self._index_top(mod, node)
+        # pass 2: resolve bases + attr types against the global table
+        self._short = {}  # bare class name -> qualnames (ambiguity-aware)
+        for q, info in self.classes.items():
+            self._short.setdefault(info.name, []).append(q)
+        for info in self.classes.values():
+            info.bases = [self.resolve_class(info.module, b) or b
+                          for b in info.bases]
+            for attr, spelling in list(info.attr_types.items()):
+                q = self.resolve_class(info.module, spelling)
+                if q:
+                    info.attr_types[attr] = q
+                else:
+                    del info.attr_types[attr]
+
+    def _index_top(self, mod: str, node: ast.AST,
+                   depth: int = 0) -> None:
+        if isinstance(node, ast.ClassDef):
+            self._index_class(mod, node)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fi = FunctionInfo(mod, node.name, node, None)
+            self.functions.setdefault(fi.qualname, fi)
+        elif isinstance(node, (ast.If, ast.Try)) and depth < 2:
+            for child in ast.iter_child_nodes(node):
+                self._index_top(mod, child, depth + 1)
+
+    def _index_class(self, mod: str, cls: ast.ClassDef) -> None:
+        from .astutil import dotted
+
+        methods: Dict[str, ast.AST] = {}
+        attr_types: Dict[str, str] = {}
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                methods[item.name] = item
+        # self.x = ClassName(...) anywhere in the class body types
+        # the attribute (last assignment wins — fine for lint)
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not (isinstance(node.value, ast.Call)):
+                continue
+            ctor = dotted(node.value.func)
+            if not ctor:
+                continue
+            for t in node.targets:
+                path = dotted(t)
+                if path and path.startswith("self.") and \
+                        path.count(".") == 1:
+                    attr_types[path[5:]] = ctor
+        info = ClassInfo(mod, cls.name, cls,
+                         [b for b in (dotted(b) for b in cls.bases)
+                          if b], methods, attr_types)
+        self.classes[info.qualname] = info
+        for name, m in methods.items():
+            fi = FunctionInfo(mod, f"{cls.name}.{name}", m,
+                              info.qualname)
+            self.functions.setdefault(fi.qualname, fi)
+
+    def _import_table(self, mod: str,
+                      tree: ast.Module) -> Dict[str, str]:
+        """Local name -> dotted target, with relative imports resolved
+        against this module's package."""
+        table: Dict[str, str] = {}
+        pkg_parts = mod.split(".")
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    table[alias.asname or
+                          alias.name.split(".")[0]] = alias.name
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    # relative: level 1 = this package
+                    base_parts = pkg_parts[:-(node.level)] \
+                        if len(pkg_parts) >= node.level else []
+                    base = ".".join(base_parts + (
+                        [node.module] if node.module else []))
+                else:
+                    base = node.module or ""
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    table[alias.asname or alias.name] = \
+                        f"{base}.{alias.name}" if base else alias.name
+        return table
+
+    # ---- resolution helpers ----
+
+    def resolve_class(self, mod: str, spelling: str) -> Optional[str]:
+        """A class spelling as seen from ``mod`` -> project qualname."""
+        if spelling in self.classes:
+            return spelling
+        head, _, rest = spelling.partition(".")
+        target = self.imports.get(mod, {}).get(head)
+        if target:
+            full = f"{target}.{rest}" if rest else target
+            # full is module.path.Class — split at the last dot
+            m, _, c = full.rpartition(".")
+            if m in self.modules and f"{m}:{c}" in self.classes:
+                return f"{m}:{c}"
+        # same module?
+        if not rest and f"{mod}:{head}" in self.classes:
+            return f"{mod}:{head}"
+        # unique bare name anywhere in the project (light but right
+        # far more often than not inside one package)
+        cands = getattr(self, "_short", {}).get(
+            spelling.rsplit(".", 1)[-1], [])
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+    def class_mro(self, qualname: str) -> List[ClassInfo]:
+        """The project-resolvable part of a class's MRO (itself first);
+        cycles and externals are skipped."""
+        out: List[ClassInfo] = []
+        seen: Set[str] = set()
+        stack = [qualname]
+        while stack:
+            q = stack.pop(0)
+            if q in seen or q not in self.classes:
+                continue
+            seen.add(q)
+            info = self.classes[q]
+            out.append(info)
+            stack.extend(b for b in info.bases if b in self.classes)
+        return out
+
+    def resolve_call(self, caller: FunctionInfo,
+                     call: ast.Call) -> Optional[FunctionInfo]:
+        """Best-effort project target of one call site."""
+        from .astutil import dotted
+
+        name = dotted(call.func)
+        if not name:
+            return None
+        parts = name.split(".")
+        mod = caller.module
+        # self.m() / self.attr.m()
+        if parts[0] == "self" and caller.cls:
+            if len(parts) == 2:
+                return self._method(caller.cls, parts[1])
+            if len(parts) == 3:
+                info = self.classes.get(caller.cls)
+                for c in self.class_mro(caller.cls):
+                    t = c.attr_types.get(parts[1])
+                    if t:
+                        return self._method(t, parts[2])
+                return None
+            return None
+        # bare helper()
+        if len(parts) == 1:
+            q = f"{mod}:{parts[0]}"
+            if q in self.functions:
+                return self.functions[q]
+            target = self.imports.get(mod, {}).get(parts[0])
+            if target:
+                m, _, f = target.rpartition(".")
+                if f"{m}:{f}" in self.functions:
+                    return self.functions[f"{m}:{f}"]
+            return None
+        # imported_module.func() or ImportedClass.method()
+        target = self.imports.get(mod, {}).get(parts[0])
+        if target and len(parts) == 2:
+            if f"{target}:{parts[1]}" in self.functions:
+                return self.functions[f"{target}:{parts[1]}"]
+            cq = self.resolve_class(mod, parts[0])
+            if cq:
+                return self._method(cq, parts[1])
+        return None
+
+    def _method(self, cls_q: str, name: str) -> Optional[FunctionInfo]:
+        for c in self.class_mro(cls_q):
+            fi = self.functions.get(f"{c.module}:{c.name}.{name}")
+            if fi is not None:
+                return fi
+        return None
+
+    # ---- suppression ----
+
+    def suppressed(self, rule_id: str, path: str, line: int) -> bool:
+        for ctx in self.modules.values():
+            if ctx.path == path:
+                return ctx.suppressed(rule_id, line)
+        # non-Python resource: search the line itself for the token
+        noqa = self._noqa_cache.get(path)
+        if noqa is None:
+            noqa = {}
+            res = next((r for r in self.resources.values()
+                        if r.path == path), None)
+            if res is not None:
+                for i, text in enumerate(res.lines):
+                    m = _RES_NOQA_RE.search(text)
+                    if m:
+                        noqa[i + 1] = frozenset(
+                            p.strip()
+                            for p in (m.group(1) or "").split(",")
+                            if p.strip())
+            self._noqa_cache[path] = noqa
+        ids = noqa.get(line)
+        if ids is None:
+            return False
+        return not ids or rule_id in ids
+
+
+class ProjectRule:
+    """Base class for whole-program rules.
+
+    Like :class:`~rafiki_tpu.analysis.engine.Rule` but ``check`` takes
+    the :class:`ProjectContext` and yields ``(path, line, col,
+    message)`` tuples — project findings may anchor in ANY file the
+    contract touches (a Python module, ``docs/observability.md``,
+    ``kv_server.cc``), so rules name locations explicitly. The helper
+    :meth:`at` converts a ``(ModuleContext, ast-node)`` pair.
+    """
+
+    id: str = ""
+    category: str = "project"
+    severity: str = "error"
+    description: str = ""
+
+    def check(self, project: ProjectContext
+              ) -> Iterator[Tuple[str, int, int, str]]:
+        raise NotImplementedError  # pragma: no cover - interface
+        yield
+
+    @staticmethod
+    def at(ctx: ModuleContext, node: ast.AST, message: str
+           ) -> Tuple[str, int, int, str]:
+        return (ctx.path, getattr(node, "lineno", 1),
+                getattr(node, "col_offset", 0), message)
+
+
+_PROJECT_REGISTRY: Dict[str, ProjectRule] = {}
+
+
+def register_project(cls):
+    """Class decorator adding a project rule to the registry."""
+    if not cls.id:
+        raise ValueError(f"project rule {cls.__name__} has no id")
+    if cls.severity not in SEVERITIES:
+        raise ValueError(f"rule {cls.id}: bad severity {cls.severity!r}")
+    if cls.id in _PROJECT_REGISTRY:
+        raise ValueError(f"duplicate project rule id {cls.id!r}")
+    _PROJECT_REGISTRY[cls.id] = cls()
+    return cls
+
+
+def all_project_rules() -> Dict[str, ProjectRule]:
+    from . import rules  # noqa: F401 — import side effect registers
+
+    return dict(_PROJECT_REGISTRY)
+
+
+def get_project_rule(rule_id: str) -> ProjectRule:
+    rules = all_project_rules()
+    if rule_id not in rules:
+        raise KeyError(f"unknown project rule {rule_id!r} "
+                       f"(known: {', '.join(sorted(rules))})")
+    return rules[rule_id]
+
+
+def analyze_project(paths: Sequence[str],
+                    select: Optional[Sequence[str]] = None,
+                    with_suppressed: bool = False) -> List[Finding]:
+    """Run project rules over the whole tree; sorted findings.
+
+    ``select`` filters to the named project rules (unknown ids raise
+    ``KeyError`` like the per-module engine). Parse failures surface as
+    ``parse-error`` findings — a module the project pass cannot see is
+    itself a finding, not a silent shrink of the analyzed surface.
+    """
+    rules = all_project_rules()
+    if select is not None:
+        chosen = [get_project_rule(r) for r in select
+                  if r in rules]
+    else:
+        chosen = list(rules.values())
+    project = ProjectContext(paths)
+    findings: List[Finding] = list(project.parse_errors)
+    for rule in chosen:
+        for path, line, col, message in rule.check(project):
+            if not with_suppressed and \
+                    project.suppressed(rule.id, path, line):
+                continue
+            findings.append(Finding(rule.id, rule.severity, path,
+                                    line, col, message))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+#: shared shape for "does this look like a metric/identifier name"
+NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*$")

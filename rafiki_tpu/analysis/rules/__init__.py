@@ -10,7 +10,19 @@ One module per hazard category (mirrors ``docs/linting.md``):
 - :mod:`observability` — counters written behind the metrics plane's
   back.
 - :mod:`serving` — decode-loop hot-path hazards (blocking transfers).
+
+Project-scope rules (``lint --project``), one module per contract:
+
+- :mod:`project_locks` — interprocedural lock-order cycles and locks
+  held across blocking calls.
+- :mod:`project_hub` — hub verb parity across server/client/interface/
+  decorator layers.
+- :mod:`project_metrics` — metric catalog drift across code, docs, and
+  dashboard.
+- :mod:`project_budget` — budget-key / worker-config / docs parity.
+- :mod:`project_spans` — span streams that can never terminate.
 """
 
 from . import (concurrency, jax_tracing, observability,  # noqa: F401
-               robustness, serving)
+               project_budget, project_hub, project_locks,
+               project_metrics, project_spans, robustness, serving)

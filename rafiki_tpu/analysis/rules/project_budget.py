@@ -1,0 +1,244 @@
+"""budget-key-parity: budget keys and worker config must stay a closed
+loop.
+
+A budget key lives in four places: the admin create API validates it
+(``budget.get("KV_PAGES")``), the services manager turns it into a
+worker config entry (``cfg["kv_pages"] = ...``), the spawned service
+consumes that entry (``cfg.get("kv_pages")``), and the operator docs
+table explains it. Each hop is a different file — usually a different
+process, with the config crossing as JSON — so nothing type-checks the
+chain, and the observed drift modes are all silent: a validated key the
+docs never mention (operators can't know it exists), a config entry
+produced but consumed nowhere (dead knob, reads as supported), and a
+required config read no producer writes (KeyError at spawn, or a
+``None`` default silently winning forever).
+
+The contract edge is recovered from the spawn calls themselves:
+``self._spawn("rafiki_tpu.worker.inference", cfg, ...)`` names the
+consumer module as a string constant, so the rule knows exactly which
+modules' ``cfg`` reads belong to the admin-produced config — reads of
+unrelated ``cfg`` dicts elsewhere (harness configs, server settings)
+are out of contract and never flagged.
+
+Three sub-checks:
+
+- **docs parity** — every SCREAMING_CASE key read off a ``*budget``
+  receiver must appear backticked somewhere in the collected markdown;
+- **dead knobs** — keys produced (dict-literal ``_spawn`` args,
+  stores into ``cfg``/``*_cfg`` dicts in budget-handling modules) but
+  consumed by no spawn-target module;
+- **missing producers** — *required* reads in spawn-target modules
+  (``cfg["k"]`` subscripts and defaultless ``cfg.get("k")``) whose key
+  no producer writes. Reads with an explicit default
+  (``cfg.get("k", 4)``) declare the key optional and are exempt — that
+  is the repo's idiom for standalone/manual deployment knobs.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from ..astutil import dotted
+from ..project import ProjectContext, ProjectRule, register_project
+
+_BUDGET_KEY_RE = re.compile(r"^[A-Z][A-Z0-9_]*$")
+_CFG_KEY_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_BACKTICK_RE = re.compile(r"`([^`]+)`")
+
+
+def _receiver_is_budget(node: ast.AST) -> bool:
+    path = dotted(node)
+    if not path:
+        return False
+    last = path.rsplit(".", 1)[-1]
+    return last == "budget" or last.endswith("_budget")
+
+
+def _receiver_is_cfg(node: ast.AST) -> bool:
+    path = dotted(node)
+    if not path:
+        return False
+    last = path.rsplit(".", 1)[-1]
+    return last in ("cfg", "config") or \
+        last.endswith(("_cfg", "_config"))
+
+
+def _cfg_name(name: str) -> bool:
+    return name in ("cfg", "config") or \
+        name.endswith(("_cfg", "_config"))
+
+
+def _const_str(node: ast.AST):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+@register_project
+class BudgetKeyParityRule(ProjectRule):
+    id = "budget-key-parity"
+    category = "robustness"
+    severity = "error"
+    description = (
+        "budget/config contract drift: an admin-validated budget key "
+        "with no docs mention, a config key produced for a spawned "
+        "service that never reads it (dead knob), or a required read "
+        "in a spawned service that no producer writes")
+
+    def check(self, project: ProjectContext):
+        budget_sites: Dict[str, Tuple[str, int]] = {}
+        budget_modules: Set[str] = set()
+        for mod, ctx in sorted(project.modules.items()):
+            for node in ast.walk(ctx.tree):
+                for key in self._budget_keys(node):
+                    budget_sites.setdefault(
+                        key, (ctx.path, node.lineno))
+                    budget_modules.add(mod)
+
+        produced: Dict[str, Tuple[str, int]] = {}
+        targets: Set[str] = set()
+        for mod in sorted(budget_modules):
+            ctx = project.modules[mod]
+            for node in ast.walk(ctx.tree):
+                for key, line in self._produced_keys(node):
+                    produced.setdefault(key, (ctx.path, line))
+                targets.update(self._spawn_targets(node))
+
+        consumed: Dict[str, Tuple[str, int]] = {}
+        required: Dict[str, Tuple[str, int]] = {}
+        for mod in sorted(targets):
+            if mod not in project.modules:
+                continue
+            ctx = project.modules[mod]
+            for node in ast.walk(ctx.tree):
+                for key, line, req in self._consumed_keys(node):
+                    consumed.setdefault(key, (ctx.path, line))
+                    if req:
+                        required.setdefault(key, (ctx.path, line))
+
+        yield from self._docs_parity(project, budget_sites)
+        if not targets:
+            return  # no spawn edge in this tree — config checks moot
+        for key, (path, line) in sorted(produced.items()):
+            if key not in consumed:
+                yield (path, line, 0, (
+                    f"config key '{key}' is produced here but no "
+                    "spawned service module ever reads it — a dead "
+                    "knob that looks supported; consume it or stop "
+                    "producing it"))
+        for key, (path, line) in sorted(required.items()):
+            if key not in produced:
+                yield (path, line, 0, (
+                    f"config key '{key}' is required here (read with "
+                    "no default) but no budget-handling module ever "
+                    "produces it — this spawn path cannot work; "
+                    "produce the key or give the read an explicit "
+                    "default"))
+
+    # ---- extraction ----
+
+    @staticmethod
+    def _budget_keys(node: ast.AST):
+        key = None
+        if isinstance(node, ast.Subscript) and \
+                _receiver_is_budget(node.value):
+            key = _const_str(node.slice)
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "get" and node.args and \
+                _receiver_is_budget(node.func.value):
+            key = _const_str(node.args[0])
+        elif isinstance(node, ast.Compare) and \
+                len(node.ops) == 1 and \
+                isinstance(node.ops[0], (ast.In, ast.NotIn)) and \
+                _receiver_is_budget(node.comparators[0]):
+            key = _const_str(node.left)
+        if key is not None and _BUDGET_KEY_RE.match(key):
+            yield key
+
+    @staticmethod
+    def _consumed_keys(node: ast.AST):
+        """(key, line, required?) reads; subscripts and defaultless
+        ``.get`` are required, ``.get(k, default)`` is optional."""
+        if isinstance(node, ast.Subscript) and \
+                isinstance(node.ctx, ast.Load) and \
+                _receiver_is_cfg(node.value):
+            key = _const_str(node.slice)
+            if key is not None and _CFG_KEY_RE.match(key):
+                yield key, node.lineno, True
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "get" and node.args and \
+                _receiver_is_cfg(node.func.value):
+            key = _const_str(node.args[0])
+            if key is not None and _CFG_KEY_RE.match(key):
+                yield key, node.lineno, len(node.args) < 2
+
+    @classmethod
+    def _produced_keys(cls, node: ast.AST):
+        # cfg["k"] = ... subscript stores
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and \
+                        _receiver_is_cfg(t.value):
+                    key = _const_str(t.slice)
+                    if key is not None and _CFG_KEY_RE.match(key):
+                        yield key, t.lineno
+                elif isinstance(t, ast.Name) and _cfg_name(t.id) and \
+                        isinstance(node.value, ast.Dict):
+                    yield from cls._dict_keys(node.value)
+        # pred_cfg: Dict[str, Any] = {...} — annotated form of the same
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name) and \
+                _cfg_name(node.target.id) and \
+                isinstance(node.value, ast.Dict):
+            yield from cls._dict_keys(node.value)
+        # dict literal handed straight to a spawn call
+        elif isinstance(node, ast.Call) and cls._is_spawn(node):
+            for arg in list(node.args) + \
+                    [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Dict):
+                    yield from cls._dict_keys(arg)
+
+    @staticmethod
+    def _is_spawn(node: ast.Call) -> bool:
+        return (dotted(node.func) or "").rsplit(".", 1)[-1] \
+            in ("_spawn", "spawn")
+
+    @classmethod
+    def _spawn_targets(cls, node: ast.AST) -> List[str]:
+        """Module names named by constant first args of spawn calls."""
+        if not (isinstance(node, ast.Call) and cls._is_spawn(node)
+                and node.args):
+            return []
+        mod = _const_str(node.args[0])
+        if mod is not None and "." in mod and \
+                re.fullmatch(r"[\w.]+", mod):
+            return [mod]
+        return []
+
+    @staticmethod
+    def _dict_keys(node: ast.Dict):
+        for k in node.keys:
+            key = _const_str(k) if k is not None else None
+            if key is not None and _CFG_KEY_RE.match(key):
+                yield key, k.lineno
+
+    # ---- docs ----
+
+    def _docs_parity(self, project: ProjectContext, budget_sites):
+        docs = project.md_resources()
+        if not docs:
+            return  # fixture trees without docs check config only
+        mentioned: Set[str] = set()
+        for res in docs:
+            for line in res.lines:
+                mentioned.update(_BACKTICK_RE.findall(line))
+        for key, (path, line) in sorted(budget_sites.items()):
+            if key not in mentioned:
+                yield (path, line, 0, (
+                    f"budget key '{key}' is read at the admin API but "
+                    "documented nowhere (no backticked mention in any "
+                    "collected .md) — add it to the operator docs"))

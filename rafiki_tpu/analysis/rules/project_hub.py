@@ -1,0 +1,240 @@
+"""hub-verb-parity: the data-plane verb surface must agree everywhere.
+
+The hub contract crosses four layers and two languages: the C++ server
+dispatches on verb strings (``native/kv_server.cc``), the Python client
+sends them (``KVClient._cmd("SET", ...)``), ``QueueHub`` names the
+transport-neutral verb interface, and the decorators/backends
+(``ChaosHub``, ``KVQueueHub``, ``InProcQueueHub``) each re-implement
+that surface. PR 14 shipped a ChaosHub that silently did NOT wrap four
+verbs — the base class's default no-op bodies meant nothing raised, the
+injector simply never saw those calls. Exactly the bug class a
+whole-program rule can make structural:
+
+- **implementation parity** — any project class that subclasses a verb
+  interface (a class with >= 3 ``raise NotImplementedError`` methods)
+  and is instantiated anywhere must override every abstract method.
+- **decorator parity** — a subclass that WRAPS another instance of the
+  interface (``__init__`` stores a param typed/named as the interface)
+  must override EVERY public method of the interface, *including the
+  ones with default bodies* — a default body is precisely where a
+  missed wrap hides, because nothing raises.
+- **wire parity** — every verb the Python client sends
+  (``*._cmd("VERB", ...)`` and ``_encode([b"VERB", ...])`` framings)
+  must appear in the C++ server's dispatch (``cmd == "VERB"``).
+  Server-only verbs are fine (WAL-replay internals, aliases); a client
+  verb the server never dispatches is a guaranteed runtime error.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from ..astutil import dotted
+from ..project import (ClassInfo, ProjectContext, ProjectRule,
+                       register_project)
+
+#: a class is treated as a verb interface once this many methods are
+#: bodies of nothing but ``raise NotImplementedError``
+_MIN_ABSTRACT = 3
+
+_CC_DISPATCH_RE = re.compile(r'cmd\s*==\s*"([A-Z][A-Z0-9_]*)"')
+
+
+def _is_abstract_body(fn: ast.AST) -> bool:
+    body = list(fn.body)
+    if body and isinstance(body[0], ast.Expr) and \
+            isinstance(body[0].value, ast.Constant) and \
+            isinstance(body[0].value.value, str):
+        body = body[1:]  # docstring
+    if len(body) != 1 or not isinstance(body[0], ast.Raise):
+        return False
+    exc = body[0].exc
+    if isinstance(exc, ast.Call):
+        exc = exc.func
+    return isinstance(exc, ast.Name) and \
+        exc.id == "NotImplementedError"
+
+
+def _interface_methods(info: ClassInfo) -> Dict[str, bool]:
+    """public method name -> is_abstract; {} unless interface-shaped."""
+    out: Dict[str, bool] = {}
+    n_abstract = 0
+    for name, fn in info.methods.items():
+        if name.startswith("_"):
+            continue
+        abstract = _is_abstract_body(fn)
+        out[name] = abstract
+        n_abstract += abstract
+    return out if n_abstract >= _MIN_ABSTRACT else {}
+
+
+def _instantiated_classes(project: ProjectContext) -> Set[str]:
+    out: Set[str] = set()
+    for mod, ctx in project.modules.items():
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                name = dotted(node.func)
+                if name:
+                    q = project.resolve_class(mod, name)
+                    if q:
+                        out.add(q)
+    return out
+
+
+def _wrapped_param(project: ProjectContext, info: ClassInfo,
+                   iface: str) -> Optional[str]:
+    """If ``info.__init__`` takes and stores an instance of ``iface``
+    (decorator shape), the param name; else None."""
+    init = info.methods.get("__init__")
+    if init is None:
+        return None
+    stored: Set[str] = set()
+    for node in ast.walk(init):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Name):
+            for t in node.targets:
+                p = dotted(t)
+                if p and p.startswith("self."):
+                    stored.add(node.value.id)
+    for arg in init.args.args + init.args.kwonlyargs:
+        if arg.arg == "self" or arg.arg not in stored:
+            continue
+        if arg.annotation is not None:
+            ann = dotted(arg.annotation)
+            if ann and project.resolve_class(info.module, ann) == iface:
+                return arg.arg
+        if arg.arg == "inner":
+            return arg.arg
+    return None
+
+
+@register_project
+class HubVerbParityRule(ProjectRule):
+    id = "hub-verb-parity"
+    category = "serving"
+    severity = "error"
+    description = (
+        "hub/data-plane verb surface drift: an interface implementation "
+        "missing abstract verbs, a decorator silently passing verbs "
+        "through to the wrapped hub (the ChaosHub bug), or a client "
+        "verb the C++ server never dispatches")
+
+    def check(self, project: ProjectContext):
+        yield from self._class_parity(project)
+        yield from self._wire_parity(project)
+
+    # ---- interface / decorator parity ----
+
+    def _class_parity(self, project: ProjectContext):
+        interfaces = {q: m for q, m in
+                      ((q, _interface_methods(i))
+                       for q, i in project.classes.items()) if m}
+        if not interfaces:
+            return
+        live = _instantiated_classes(project)
+        for q, info in sorted(project.classes.items()):
+            if q in interfaces:
+                continue
+            mro = project.class_mro(q)
+            iface = next((c.qualname for c in mro[1:]
+                          if c.qualname in interfaces), None)
+            if iface is None:
+                continue
+            methods = interfaces[iface]
+            # every method overridden somewhere strictly below the
+            # interface in the MRO
+            overridden: Set[str] = set()
+            for c in mro:
+                if c.qualname == iface:
+                    break
+                overridden |= set(c.methods)
+            ctx = project.modules[info.module]
+            iface_name = iface.rsplit(":", 1)[-1]
+            wraps = _wrapped_param(project, info, iface)
+            if wraps is not None:
+                required = set(methods)
+            elif q in live:
+                required = {m for m, is_abs in methods.items()
+                            if is_abs}
+            else:
+                continue  # abstract intermediate bases are fine
+            missing = sorted(required - overridden)
+            if not missing:
+                continue
+            if wraps is not None:
+                msg = (
+                    f"'{info.name}' wraps a {iface_name} (via "
+                    f"'{wraps}') but does not override "
+                    f"{', '.join(missing)} — those verbs silently "
+                    "bypass the wrapper (the base default body runs "
+                    "instead); wrap every verb or forward explicitly")
+            else:
+                msg = (
+                    f"'{info.name}' is instantiated but never "
+                    f"implements {iface_name}.{'/'.join(missing)} — "
+                    "calls will raise NotImplementedError at runtime")
+            yield self.at(ctx, info.node, msg)
+
+    # ---- client <-> server wire parity ----
+
+    def _wire_parity(self, project: ProjectContext):
+        server = None
+        for name, res in sorted(project.resources.items()):
+            if not name.endswith((".cc", ".cpp")):
+                continue
+            verbs = set(_CC_DISPATCH_RE.findall(res.text))
+            if verbs:
+                server = (res, verbs)
+                break
+        if server is None:
+            return  # no C++ side in this tree — nothing to diff
+        res, served = server
+        for mod, ctx in sorted(project.modules.items()):
+            for node in ast.walk(ctx.tree):
+                verb = _client_verb(node)
+                if verb is None or verb in served:
+                    continue
+                yield self.at(ctx, node, (
+                    f"client sends verb '{verb}' but "
+                    f"{res.path.rsplit('/', 1)[-1]} has no "
+                    f"'cmd == \"{verb}\"' dispatch — the server will "
+                    "reject it; add the handler or drop the call"))
+
+
+def _client_verb(node: ast.AST) -> Optional[str]:
+    """The wire verb sent by this call node, if any.
+
+    Two framings exist in the client: ``self._cmd("VERB", ...)`` for
+    the common path, and ``self._encode([b"VERB", ...])`` for calls
+    that need custom response handling (BRPOP).
+    """
+    if not isinstance(node, ast.Call) or not node.args:
+        return None
+    name = dotted(node.func) or ""
+    last = name.rsplit(".", 1)[-1]
+    if last == "_cmd":
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and \
+                isinstance(first.value, str) and \
+                re.fullmatch(r"[A-Z][A-Z0-9_]*", first.value):
+            return first.value
+        return None
+    if last == "_encode":
+        arg: ast.AST = node.args[0]
+        # _encode([b"BRPOP"] + keys + [timeout]) — take the leftmost
+        # list literal in a BinOp chain
+        while isinstance(arg, ast.BinOp):
+            arg = arg.left
+        if isinstance(arg, ast.List) and arg.elts:
+            first = arg.elts[0]
+            if isinstance(first, ast.Constant) and \
+                    isinstance(first.value, bytes):
+                try:
+                    text = first.value.decode("ascii")
+                except UnicodeDecodeError:
+                    return None
+                if re.fullmatch(r"[A-Z][A-Z0-9_]*", text):
+                    return text
+    return None

@@ -1,0 +1,381 @@
+"""lock-order-cycle: interprocedural lock-acquisition analysis.
+
+The per-file ``inconsistent-lock`` rule answers "is this attr written
+with the lock held"; it cannot answer the question that took three
+review passes of the tier-thread PR: *given everything anybody calls
+while holding a lock, can two threads arrive at the same pair of locks
+in opposite orders?* That needs the project view:
+
+1. name every lock in the project (``module:Class.attr`` for
+   ``self.x = threading.Lock()``, ``module:name`` for module globals;
+   ``threading.Condition(self._lock)`` ALIASES the wrapped lock — the
+   kv-tier and mesh pattern);
+2. scan every function for ``with <lock>:`` scopes and ``.acquire()``
+   sites, tracking the held set through nesting;
+3. propagate through the call graph: calling ``f`` while holding L
+   charges L -> M for every M that ``f`` (transitively) acquires;
+4. flag cycles in the resulting acquired-while-holding graph, and —
+   the softer sibling hazard — locks held across known-blocking calls
+   (``time.sleep``, socket ``recv``/``accept``, ``subprocess.run``),
+   which turn "brief critical section" into "everyone stalls behind a
+   sleeping thread". ``Condition.wait``/``wait_for`` are exempt: they
+   release the wrapped lock while waiting.
+
+Heuristics are deliberately conservative: a ``with`` whose context we
+cannot resolve to a named project lock contributes nothing, and a bare
+``.acquire()`` records an acquisition EVENT (for ordering edges) but
+does not extend the held scope — we don't guess where the matching
+``release()`` is.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..astutil import dotted
+from ..engine import ModuleContext
+from ..project import (FunctionInfo, ProjectContext, ProjectRule,
+                       register_project)
+from .concurrency import _LOCK_CTORS
+
+#: calls that block the calling thread for unbounded / wall-clock time.
+#: Exact dotted names…
+_BLOCKING_EXACT = {
+    "time.sleep", "subprocess.run", "subprocess.check_output",
+    "subprocess.check_call", "subprocess.call", "select.select",
+}
+#: …and attribute suffixes (socket/pipe receivers). ``.join``/``.get``
+#: are NOT here: str.join and dict.get would drown the signal.
+_BLOCKING_ATTRS = {"recv", "accept", "communicate", "recv_into"}
+
+#: Condition methods that RELEASE the wrapped lock while waiting
+_CV_RELEASING = {"wait", "wait_for", "notify", "notify_all"}
+
+
+class _LockNames:
+    """Lock identity tables for one project."""
+
+    def __init__(self, project: ProjectContext):
+        self.project = project
+        # class qualname -> {attr -> canonical attr on same class}
+        self.class_locks: Dict[str, Dict[str, str]] = {}
+        # module -> {global name}
+        self.module_locks: Dict[str, Set[str]] = {}
+        for q, info in project.classes.items():
+            self.class_locks[q] = self._scan_class(info.node)
+        for mod, ctx in project.modules.items():
+            globs: Set[str] = set()
+            for node in ctx.tree.body:
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call) and \
+                        dotted(node.value.func) in _LOCK_CTORS:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            globs.add(t.id)
+            self.module_locks[mod] = globs
+
+    @staticmethod
+    def _scan_class(cls: ast.ClassDef) -> Dict[str, str]:
+        attrs: Dict[str, str] = {}
+        alias: Dict[str, str] = {}
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            ctor = dotted(node.value.func)
+            if ctor not in _LOCK_CTORS:
+                continue
+            targets = [dotted(t) for t in node.targets]
+            names = [t[5:] for t in targets
+                     if t and t.startswith("self.") and
+                     t.count(".") == 1]
+            if not names:
+                continue
+            # Condition(self.X) aliases the wrapped lock
+            wrapped = None
+            if ctor.rsplit(".", 1)[-1] == "Condition" and \
+                    node.value.args:
+                arg = dotted(node.value.args[0])
+                if arg and arg.startswith("self.") and \
+                        arg.count(".") == 1:
+                    wrapped = arg[5:]
+            for name in names:
+                attrs[name] = name
+                if wrapped:
+                    alias[name] = wrapped
+        # collapse alias chains (bounded — chains are length 1 in
+        # practice, but don't loop forever on a self-alias)
+        for name, target in alias.items():
+            seen = {name}
+            while target in alias and target not in seen:
+                seen.add(target)
+                target = alias[target]
+            if target in attrs:
+                attrs[name] = target
+        return attrs
+
+    def resolve(self, fn: FunctionInfo,
+                expr: ast.AST) -> Optional[str]:
+        """Lock id for a with-context / acquire receiver, or None."""
+        path = dotted(expr)
+        if not path:
+            return None
+        if path.startswith("self.") and path.count(".") == 1 and fn.cls:
+            attr = path[5:]
+            for c in self.project.class_mro(fn.cls):
+                table = self.class_locks.get(c.qualname, {})
+                if attr in table:
+                    return f"{c.qualname}.{table[attr]}"
+            return None
+        if "." not in path and \
+                path in self.module_locks.get(fn.module, ()):
+            return f"{fn.module}:{path}"
+        return None
+
+
+class _FnSummary:
+    """What one function does with locks, from a single body scan."""
+
+    def __init__(self):
+        #: (lock id, node, held-at-acquire tuple)
+        self.acquires: List[Tuple[str, ast.AST, Tuple[str, ...]]] = []
+        #: (call node, dotted name, held tuple)
+        self.calls: List[Tuple[ast.Call, str, Tuple[str, ...]]] = []
+
+
+def _scan_function(fn: FunctionInfo, names: _LockNames) -> _FnSummary:
+    out = _FnSummary()
+    for stmt in fn.node.body:
+        _scan_node(fn, stmt, (), names, out)
+    return out
+
+
+def _scan_node(fn: FunctionInfo, node: ast.AST, held: Tuple[str, ...],
+               names: _LockNames, out: _FnSummary) -> None:
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda, ast.ClassDef)):
+        return  # nested defs run later, on their own stack
+    if isinstance(node, (ast.With, ast.AsyncWith)):
+        inner = held
+        for item in node.items:
+            # the context expression itself evaluates under the OUTER
+            # held set
+            _scan_node(fn, item.context_expr, held, names, out)
+            lid = names.resolve(fn, item.context_expr)
+            if lid is not None:
+                out.acquires.append((lid, node, inner))
+                if lid not in inner:
+                    inner = inner + (lid,)
+        for stmt in node.body:
+            _scan_node(fn, stmt, inner, names, out)
+        return
+    if isinstance(node, ast.Call):
+        name = dotted(node.func)
+        if name and name.endswith(".acquire"):
+            lid = names.resolve(fn, node.func.value)
+            if lid is not None:
+                # acquisition EVENT only: without matching the
+                # release we don't extend the held scope
+                out.acquires.append((lid, node, held))
+                name = None
+        if name:
+            out.calls.append((node, name, held))
+    for child in ast.iter_child_nodes(node):
+        _scan_node(fn, child, held, names, out)
+
+
+@register_project
+class LockOrderCycleRule(ProjectRule):
+    id = "lock-order-cycle"
+    category = "concurrency"
+    severity = "error"
+    description = (
+        "interprocedural lock-order analysis: two locks acquired in "
+        "opposite orders on different call paths (deadlock once the "
+        "threads interleave), or a lock held across a known-blocking "
+        "call (sleep/recv/subprocess) that stalls every other taker")
+
+    def check(self, project: ProjectContext):
+        names = _LockNames(project)
+        summaries: Dict[str, _FnSummary] = {
+            q: _scan_function(fi, names)
+            for q, fi in project.functions.items()}
+
+        # transitive lock set acquired by each function (memoized DFS)
+        acq_memo: Dict[str, Set[str]] = {}
+
+        def acquires(q: str, stack: Set[str]) -> Set[str]:
+            if q in acq_memo:
+                return acq_memo[q]
+            if q in stack:
+                return set()  # recursion — resolved by the outer call
+            stack = stack | {q}
+            s = summaries[q]
+            got = {lid for lid, _, _ in s.acquires}
+            for call, _name, _held in s.calls:
+                target = project.resolve_call(project.functions[q],
+                                              call)
+                if target is not None and target.qualname in summaries:
+                    got |= acquires(target.qualname, stack)
+            acq_memo[q] = got
+            return got
+
+        # blocking reachability: does calling q (eventually) hit a
+        # blocking call? memoized; value = dotted name or None
+        blk_memo: Dict[str, Optional[str]] = {}
+
+        def blocks(q: str, stack: Set[str]) -> Optional[str]:
+            if q in blk_memo:
+                return blk_memo[q]
+            if q in stack:
+                return None
+            stack = stack | {q}
+            found = None
+            for call, name, _held in summaries[q].calls:
+                if _is_blocking(name):
+                    found = name
+                    break
+                target = project.resolve_call(project.functions[q],
+                                              call)
+                if target is not None and target.qualname in summaries:
+                    deeper = blocks(target.qualname, stack)
+                    if deeper is not None:
+                        found = f"{name} -> {deeper}"
+                        break
+            blk_memo[q] = found
+            return found
+
+        # edges: L -> M means "M acquired while L held", with one
+        # representative site kept per edge
+        edges: Dict[str, Dict[str, Tuple[str, ast.AST, str]]] = {}
+
+        def edge(l: str, m: str, mod: str, node: ast.AST,
+                 how: str) -> None:
+            if l != m:
+                edges.setdefault(l, {}).setdefault(m, (mod, node, how))
+
+        findings = []
+        for q, s in summaries.items():
+            fi = project.functions[q]
+            ctx = project.modules[fi.module]
+            for lid, node, held in s.acquires:
+                for h in held:
+                    edge(h, lid, fi.module, node,
+                         f"'{q}' acquires {_short(lid)} while holding "
+                         f"{_short(h)}")
+            for call, name, held in s.calls:
+                if not held:
+                    continue
+                if _is_blocking(name) and \
+                        not self._cv_exempt(name, held, fi, names):
+                    findings.append(self.at(ctx, call, (
+                        f"'{q}' calls blocking '{name}' while holding "
+                        f"{'/'.join(_short(h) for h in held)} — every "
+                        "other taker of the lock stalls behind it; "
+                        "move the blocking call outside the critical "
+                        "section")))
+                    continue
+                target = project.resolve_call(fi, call)
+                if target is None or target.qualname not in summaries:
+                    continue
+                for m in acquires(target.qualname, set()):
+                    for h in held:
+                        edge(h, m, fi.module, call,
+                             f"'{q}' holds {_short(h)} and calls "
+                             f"'{target.qualname}', which acquires "
+                             f"{_short(m)}")
+                deep = blocks(target.qualname, set())
+                if deep is not None and \
+                        not self._cv_exempt(deep, held, fi, names):
+                    findings.append(self.at(ctx, call, (
+                        f"'{q}' holds "
+                        f"{'/'.join(_short(h) for h in held)} across "
+                        f"'{target.qualname}', which blocks in "
+                        f"{deep} — hoist the blocking work out of the "
+                        "locked region")))
+
+        findings.extend(self._cycles(project, edges))
+        return findings
+
+    @staticmethod
+    def _cv_exempt(name: str, held: Tuple[str, ...], fn: FunctionInfo,
+                   names: _LockNames) -> bool:
+        """``cv.wait()`` style calls release the held lock — never a
+        hold-across-block hazard for the lock the cv wraps."""
+        last = name.rsplit(".", 1)[-1].split(" ")[0]
+        return last in _CV_RELEASING
+
+    def _cycles(self, project: ProjectContext, edges) -> List[tuple]:
+        # Tarjan SCC over the acquired-while-holding graph; any SCC
+        # with >1 lock (or a self-loop, which edge() already filters)
+        # is an order inversion
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on: Set[str] = set()
+        stack: List[str] = []
+        sccs: List[List[str]] = []
+        counter = [0]
+
+        def strongconnect(v: str) -> None:
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on.add(v)
+            for w in edges.get(v, ()):  # successors
+                if w not in index:
+                    strongconnect(w)
+                    low[v] = min(low[v], low[w])
+                elif w in on:
+                    low[v] = min(low[v], index[w])
+            if low[v] == index[v]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    scc.append(w)
+                    if w == v:
+                        break
+                sccs.append(scc)
+
+        nodes = set(edges)
+        for tos in edges.values():
+            nodes.update(tos)
+        for v in sorted(nodes):
+            if v not in index:
+                strongconnect(v)
+
+        out = []
+        for scc in sccs:
+            if len(scc) < 2:
+                continue
+            scc_set = set(scc)
+            parts = []
+            anchor = None
+            for l in sorted(scc_set):
+                for m, (mod, node, how) in sorted(
+                        edges.get(l, {}).items()):
+                    if m in scc_set:
+                        parts.append(how)
+                        if anchor is None:
+                            anchor = (mod, node)
+            mod, node = anchor
+            ctx = project.modules[mod]
+            out.append(self.at(ctx, node, (
+                "lock-order cycle among "
+                + ", ".join(_short(l) for l in sorted(scc_set))
+                + ": " + "; ".join(parts)
+                + " — pick one global order (or collapse to one lock)")))
+        return out
+
+
+def _is_blocking(name: str) -> bool:
+    if name in _BLOCKING_EXACT:
+        return True
+    last = name.rsplit(".", 1)[-1]
+    return "." in name and last in _BLOCKING_ATTRS
+
+
+def _short(lock_id: str) -> str:
+    """``pkg.mod:Class.attr`` -> ``Class.attr`` for messages."""
+    return lock_id.rsplit(":", 1)[-1]

@@ -1,0 +1,362 @@
+"""metric-catalog-drift: code, docs, and dashboard must name the same
+metrics.
+
+Three surfaces claim to describe the observability plane and nothing
+used to force them to agree: the code registers instruments
+(``metrics.counter("...")`` / ``StatsMap`` keys), the catalog in
+``docs/observability.md`` documents them, and ``admin/dashboard.html``
+reads them off the worker-stats objects (``s.engine_kv_pages_used``).
+Every rename or addition that touches one surface and not the others is
+silent until an operator stares at an empty dashboard panel.
+
+The rule builds the *published-name universe* from code:
+
+- instrument names: first-arg string constants of
+  ``*.counter/gauge/histogram("name")`` and direct
+  ``Counter/Gauge/Histogram("name")`` constructors (histograms also
+  publish ``<name>_count``/``<name>_sum`` in snapshots);
+- StatsMap keys: first-arg constants of ``*.inc/set/max_set("key")``
+  — published bare, or under a prefix: ``register_stats(...,
+  prefix="chaos_")`` kwargs and published f-string keys
+  (``stats[f"engine_{k}"]``) contribute the prefix set;
+- worker-published literal keys: ``stats["role"] = ...`` stores and
+  ``stats.update({...})`` keys on a receiver named ``stats`` (the
+  ``_publish_stats`` convention);
+- f-string keys become shape patterns (``f"slo_{c}_ttft_p95_s"`` ->
+  ``slo_*_ttft_p95_s``).
+
+and diffs three ways: registered-but-undocumented (no mention anywhere
+in the markdown catalog), documented-but-stale (a catalog TABLE row —
+first-cell backticked name — matching nothing registered), and
+dashboard-referenced-but-never-published (``s.<name>`` in the
+dashboard matching no published key). Docs placeholders
+(``slo_<class>_ttft_p95_s``) and globs (``chaos_*``) match shapes.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..astutil import dotted
+from ..project import (ProjectContext, ProjectRule, TextResource,
+                       register_project)
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_SHAPE_RE = re.compile(r"^[a-z*][a-z0-9_*]*$")
+_BACKTICK_RE = re.compile(r"`([^`]+)`")
+_DASH_REF_RE = re.compile(r"\bs\.([a-z][a-z0-9_]*)\b")
+
+#: attribute accesses on the dashboard's stats objects that are JS,
+#: not metrics
+_JS_ATTRS = {"length", "map", "filter", "forEach", "join", "push",
+             "sort", "slice", "toFixed", "concat", "indexOf", "trim"}
+
+_INSTRUMENT_METHODS = {"counter", "gauge", "histogram"}
+_INSTRUMENT_CTORS = {"Counter", "Gauge", "Histogram"}
+_STATSMAP_WRITES = {"inc", "max_set", "set"}
+
+
+def _fstring_shape(node: ast.JoinedStr) -> Optional[str]:
+    parts: List[str] = []
+    for v in node.values:
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            parts.append(v.value)
+        elif isinstance(v, ast.FormattedValue):
+            parts.append("*")
+        else:
+            return None
+    shape = "".join(parts)
+    return shape if _SHAPE_RE.match(shape) and "*" in shape else None
+
+
+def _shape_regex(shape: str) -> re.Pattern:
+    """A catalog/code shape -> regex: ``*`` and ``<placeholder>``
+    match one name segment or more."""
+    pat = re.sub(r"<[^>]*>", "*", shape)
+    pat = re.escape(pat).replace(r"\*", r"[a-z0-9_]+")
+    return re.compile(rf"^{pat}$")
+
+
+class _Universe:
+    """Everything the code publishes, with match helpers."""
+
+    def __init__(self):
+        #: concrete name -> (path, line) of the defining site
+        self.concrete: Dict[str, Tuple[str, int]] = {}
+        #: StatsMap keys (documented bare OR under any prefix)
+        self.statsmap: Dict[str, Tuple[str, int]] = {}
+        self.prefixes: Set[str] = {""}
+        #: shape string -> (path, line)
+        self.shapes: Dict[str, Tuple[str, int]] = {}
+        self._regexes: Optional[List[re.Pattern]] = None
+
+    def all_names(self) -> Set[str]:
+        names = set(self.concrete)
+        for k in self.statsmap:
+            names.update(p + k for p in self.prefixes)
+        return names
+
+    def published(self, name: str) -> bool:
+        if name in self.concrete:
+            return True
+        for p in sorted(self.prefixes, key=len, reverse=True):
+            if name.startswith(p) and name[len(p):] in self.statsmap:
+                return True
+        if self._regexes is None:
+            self._regexes = [_shape_regex(s) for s in self.shapes]
+        return any(r.match(name) for r in self._regexes)
+
+
+def _doc_names(res: TextResource) -> Iterator[Tuple[str, int]]:
+    """Backticked tokens anywhere in the markdown (the lenient,
+    "is it mentioned at all" surface)."""
+    for i, line in enumerate(res.lines):
+        for tok in _BACKTICK_RE.findall(line):
+            yield tok, i + 1
+
+
+def _doc_catalog_rows(res: TextResource) -> Iterator[Tuple[str, int]]:
+    """First-cell backticked names of table rows (the strict catalog
+    surface the staleness check runs against)."""
+    for i, line in enumerate(res.lines):
+        if not line.lstrip().startswith("|"):
+            continue
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        if not cells or set(cells[0]) <= {"-", ":", " "}:
+            continue  # separator row
+        for tok in _BACKTICK_RE.findall(cells[0]):
+            shape = re.sub(r"<[^>]*>", "*", tok)
+            if _SHAPE_RE.match(shape):
+                yield tok, i + 1
+
+
+@register_project
+class MetricCatalogDriftRule(ProjectRule):
+    id = "metric-catalog-drift"
+    category = "observability"
+    severity = "error"
+    description = (
+        "metric surfaces drifted: a registered metric missing from "
+        "docs/observability.md, a catalog row naming a metric the code "
+        "no longer publishes, or a dashboard reference to a key no "
+        "worker publishes")
+
+    def check(self, project: ProjectContext):
+        uni = self._collect(project)
+        docs = project.md_resources()
+        catalog = [d for d in docs
+                   if d.path.endswith("observability.md")]
+        yield from self._undocumented(uni, docs, catalog)
+        for res in catalog:
+            yield from self._stale(uni, res)
+        dash = project.resource("dashboard.html")
+        if dash is not None:
+            yield from self._dashboard(uni, dash)
+
+    # ---- code side ----
+
+    def _collect(self, project: ProjectContext) -> _Universe:
+        uni = _Universe()
+        # pass 1: names of callables handed to register_stats —
+        # their returned dict literals ARE published keys (the admin's
+        # kvd_metrics() re-export pattern)
+        exporters: Set[str] = set()
+        for mod, ctx in sorted(project.modules.items()):
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.Call) and \
+                        (dotted(node.func) or "").rsplit(".", 1)[-1] \
+                        == "register_stats" and node.args:
+                    arg = dotted(node.args[0])
+                    if arg:
+                        exporters.add(arg.rsplit(".", 1)[-1])
+        for mod, ctx in sorted(project.modules.items()):
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.Call):
+                    self._collect_call(ctx.path, node, uni)
+                elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                    self._collect_store(ctx.path, node, uni)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)) and \
+                        node.name in exporters:
+                    # everything an exporter builds is published: dict
+                    # literals AND incremental out[f"kvd_{k}"] = ...
+                    # subscript stores (kvd_metrics' loop idiom)
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Dict):
+                            self._collect_keys(ctx.path, sub, uni)
+                        elif isinstance(sub, ast.Assign):
+                            for t in sub.targets:
+                                if isinstance(t, ast.Subscript):
+                                    self._collect_key_node(
+                                        ctx.path, t.slice, uni,
+                                        statsmap=True)
+        return uni
+
+    def _collect_call(self, path: str, node: ast.Call,
+                      uni: _Universe) -> None:
+        name = dotted(node.func)
+        if not name:
+            return
+        last = name.rsplit(".", 1)[-1]
+        loc = (path, node.lineno)
+        first = node.args[0] if node.args else None
+        first_str = first.value if (
+            isinstance(first, ast.Constant)
+            and isinstance(first.value, str)) else None
+        if (("." in name and last in _INSTRUMENT_METHODS)
+                or name in _INSTRUMENT_CTORS) and first_str and \
+                _NAME_RE.match(first_str):
+            uni.concrete.setdefault(first_str, loc)
+            if last == "histogram" or name == "Histogram":
+                # snapshot exporters flatten histograms
+                uni.concrete.setdefault(first_str + "_count", loc)
+                uni.concrete.setdefault(first_str + "_sum", loc)
+        elif "." in name and last in _STATSMAP_WRITES:
+            # .set() is generic; demand a receiver path so a bare
+            # set(...) builtin call never lands here, and skip
+            # known non-metric receivers (threading.Event has no
+            # string-arg set, so in practice this is StatsMap)
+            if first_str and _NAME_RE.match(first_str):
+                uni.statsmap.setdefault(first_str, loc)
+            elif isinstance(first, ast.JoinedStr):
+                shape = _fstring_shape(first)
+                if shape:  # .inc(f"requests_shed_{cls}")
+                    uni.shapes.setdefault(shape, loc)
+        elif last == "StatsMap" or name == "StatsMap":
+            # StatsMap({"requests_shed_batch": 0, ...}) seeds keys
+            if isinstance(first, ast.Dict):
+                for k in first.keys:
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(k.value, str) and \
+                            _NAME_RE.match(k.value):
+                        uni.statsmap.setdefault(
+                            k.value, (path, k.lineno))
+        elif last == "register_stats":
+            for kw in node.keywords:
+                if kw.arg == "prefix" and \
+                        isinstance(kw.value, ast.Constant) and \
+                        isinstance(kw.value.value, str):
+                    uni.prefixes.add(kw.value.value)
+        elif last == "update" and "." in name and \
+                name.rsplit(".", 2)[-2] == "stats" and node.args:
+            self._collect_keys(path, node.args[0], uni)
+
+    def _collect_store(self, path: str, node: ast.AST,
+                       uni: _Universe) -> None:
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            recv = dotted(t)
+            if recv is not None and \
+                    recv.rsplit(".", 1)[-1] == "stats" and \
+                    isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Dict):
+                # self.stats = {"decode_steps": 0, ...} — the decode
+                # engine's plain-dict stats surface
+                for k in node.value.keys:
+                    if k is not None:
+                        self._collect_key_node(path, k, uni,
+                                               statsmap=True)
+            if not (isinstance(t, ast.Subscript) and
+                    (dotted(t.value) or "").rsplit(".", 1)[-1]
+                    == "stats"):
+                continue
+            self._collect_key_node(path, t.slice, uni,
+                                   statsmap=True)
+
+    def _collect_keys(self, path: str, node: ast.AST,
+                      uni: _Universe) -> None:
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                if k is not None:
+                    self._collect_key_node(path, k, uni,
+                                           statsmap=True)
+        elif isinstance(node, ast.DictComp):
+            self._collect_key_node(path, node.key, uni,
+                                   statsmap=True)
+
+    def _collect_key_node(self, path: str, node: ast.AST,
+                          uni: _Universe,
+                          statsmap: bool = False) -> None:
+        loc = (path, getattr(node, "lineno", 1))
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, str) and \
+                _NAME_RE.match(node.value):
+            bucket = uni.statsmap if statsmap else uni.concrete
+            bucket.setdefault(node.value, loc)
+        elif isinstance(node, ast.JoinedStr):
+            shape = _fstring_shape(node)
+            if shape:
+                uni.shapes.setdefault(shape, loc)
+                if shape.endswith("*") and shape.count("*") == 1:
+                    # f"engine_{k}" republishes a StatsMap under a
+                    # prefix — let docs document the prefixed form
+                    uni.prefixes.add(shape[:-1])
+
+    # ---- diffs ----
+
+    def _undocumented(self, uni: _Universe, docs, catalog):
+        if not catalog:
+            return  # no catalog in this tree — nothing to hold code to
+        mentioned: Set[str] = set()
+        patterns: List[re.Pattern] = []
+        for res in docs:
+            for tok, _line in _doc_names(res):
+                shape = re.sub(r"<[^>]*>", "*", tok)
+                if "*" in shape and _SHAPE_RE.match(shape):
+                    patterns.append(_shape_regex(tok))
+                else:
+                    mentioned.add(tok)
+
+        def documented(name: str) -> bool:
+            return name in mentioned or \
+                any(p.match(name) for p in patterns)
+
+        for name, (path, line) in sorted(uni.concrete.items()):
+            if name.endswith(("_count", "_sum")) and \
+                    name.rsplit("_", 1)[0] in uni.concrete:
+                continue  # histogram expansions ride the base name
+            if not documented(name):
+                yield (path, line, 0,
+                       f"metric '{name}' is registered here but "
+                       "appears nowhere in docs/observability.md — "
+                       "add a catalog row (or rename to a documented "
+                       "name)")
+        for key, (path, line) in sorted(uni.statsmap.items()):
+            if not any(documented(p + key)
+                       for p in sorted(uni.prefixes)):
+                yield (path, line, 0,
+                       f"stats key '{key}' is published here (bare or "
+                       "via a registered prefix) but no form of it is "
+                       "documented in docs/observability.md")
+
+    def _stale(self, uni: _Universe, res: TextResource):
+        for tok, line in _doc_catalog_rows(res):
+            shape = re.sub(r"<[^>]*>", "*", tok)
+            if "*" in shape:
+                rx = _shape_regex(tok)
+                if any(rx.match(n) for n in uni.all_names()) or \
+                        shape in uni.shapes:
+                    continue
+            elif uni.published(tok):
+                continue
+            yield (res.path, line, 0,
+                   f"catalog row documents '{tok}' but the code no "
+                   "longer publishes it — drop the row or restore the "
+                   "metric")
+
+    def _dashboard(self, uni: _Universe, res: TextResource):
+        seen: Set[str] = set()
+        for i, text in enumerate(res.lines):
+            for name in _DASH_REF_RE.findall(text):
+                if name in _JS_ATTRS or name in seen:
+                    continue
+                seen.add(name)
+                if not uni.published(name):
+                    yield (res.path, i + 1, 0,
+                           f"dashboard reads 's.{name}' but no worker "
+                           "publishes that key — the panel renders "
+                           "undefined; fix the reference or publish "
+                           "the key")

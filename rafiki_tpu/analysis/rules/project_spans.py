@@ -1,0 +1,94 @@
+"""span-lifecycle: every span stream must be able to end.
+
+Trace spans are the only request-scoped truth the stack has — the
+predictor, the engine hook, and the chaos harnesses all emit them via
+a ``span_sink`` / ``_span`` / ``add_span`` call. A component that emits
+progress events (``admitted``, ``prefill``, ``first_token``) but never
+a *terminal* one (``done`` / ``expired`` / ``rejected`` / ``preempted``
+/ ``errored``) produces traces that all look permanently in-flight:
+dashboards count them as live, TTL sweepers can't distinguish leaked
+from slow, and every debugging session starts with "is it stuck or did
+we just never emit the end?".
+
+The rule groups span emissions by component (the enclosing class, or
+the module for free functions) and flags any component whose emitted
+event set contains no terminal. Matching is by constant event name;
+``*_done`` / ``*_errored`` style names count as terminal (the train
+worker's ``trial_done``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from ..astutil import dotted
+from ..project import ProjectContext, ProjectRule, register_project
+
+_TERMINALS = {"done", "expired", "rejected", "preempted", "errored"}
+_TERMINAL_SUFFIXES = ("_done", "_expired", "_rejected", "_errored")
+
+
+def _emitted_event(node: ast.AST):
+    """Constant event name of a span emission call, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted(node.func)
+    if not name:
+        return None
+    last = name.rsplit(".", 1)[-1]
+    arg = None
+    if last in ("span_sink", "_span"):
+        arg = node.args[0] if node.args else None
+    elif last == "add_span":
+        arg = node.args[1] if len(node.args) > 1 else None
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    return None
+
+
+def _is_terminal(event: str) -> bool:
+    return event in _TERMINALS or event.endswith(_TERMINAL_SUFFIXES)
+
+
+@register_project
+class SpanLifecycleRule(ProjectRule):
+    id = "span-lifecycle"
+    category = "observability"
+    severity = "error"
+    description = (
+        "a component emits trace spans but never a terminal event "
+        "(done/expired/rejected/preempted/errored): every trace it "
+        "produces looks permanently in-flight")
+
+    def check(self, project: ProjectContext):
+        # component name -> [(event, ctx, node)]
+        comps: Dict[str, List[Tuple[str, object, ast.AST]]] = {}
+        for mod, ctx in sorted(project.modules.items()):
+            class_nodes = [n for n in ast.walk(ctx.tree)
+                           if isinstance(n, ast.ClassDef)]
+            in_class = set()
+            for cls in class_nodes:
+                for node in ast.walk(cls):
+                    in_class.add(node)
+                    ev = _emitted_event(node)
+                    if ev is not None:
+                        comps.setdefault(f"{mod}:{cls.name}",
+                                         []).append((ev, ctx, node))
+            for node in ast.walk(ctx.tree):
+                if node in in_class:
+                    continue
+                ev = _emitted_event(node)
+                if ev is not None:
+                    comps.setdefault(mod, []).append((ev, ctx, node))
+        for comp, emissions in sorted(comps.items()):
+            events = {ev for ev, _, _ in emissions}
+            if any(_is_terminal(ev) for ev in events):
+                continue
+            ev, ctx, node = emissions[0]
+            yield self.at(ctx, node, (
+                f"'{comp.rsplit(':', 1)[-1]}' emits span event(s) "
+                f"{', '.join(sorted(events))} but never a terminal "
+                "event (done/expired/rejected/preempted/errored) — "
+                "every trace from this component looks permanently "
+                "in-flight; emit a terminal on each exit path"))

@@ -300,14 +300,20 @@ class KVClient:
                     # a prior drop/teardown left no transport: treat
                     # like a mid-command drop (retry path decides)
                     raise ConnectionError("kv client not connected")
-                return self._send_recv(enc)
+                # _lock is held across the socket round-trip by
+                # design: one socket carries one request/response at
+                # a time, so the recv IS the critical section (see
+                # docs/linting.md "KV client serialization")
+                return self._send_recv(enc)  # rafiki: noqa[lock-order-cycle]
             except (OSError, ConnectionError) as e:
                 if self.retry_window_s <= 0 or verb not in _RETRYABLE:
                     self._teardown()
                     raise ConnectionError(
                         f"kv server {self._host}:{self._port} "
                         f"connection lost ({verb}): {e}") from e
-                return self._reconnect_and_retry(enc, verb, e)
+                # reconnect backoff must also stay under _lock: other
+                # threads' commands cannot use the dead socket anyway
+                return self._reconnect_and_retry(enc, verb, e)  # rafiki: noqa[lock-order-cycle]
 
     # ---- api ----
     def ping(self) -> bool:
@@ -393,7 +399,10 @@ class KVClient:
                 try:
                     if self._sock is None:
                         raise ConnectionError("kv client not connected")
-                    reply = self._send_recv(enc)
+                    # held across the blocking pop on purpose: the
+                    # socket is single-flight (see docs/linting.md
+                    # "KV client serialization")
+                    reply = self._send_recv(enc)  # rafiki: noqa[lock-order-cycle]
                 except (OSError, ConnectionError) as e:
                     if self.retry_window_s <= 0:
                         self._teardown()
@@ -406,7 +415,7 @@ class KVClient:
                     retry_dl = time.monotonic() + self.retry_window_s
                     if deadline is not None:
                         retry_dl = max(retry_dl, deadline)
-                    reply = self._reconnect_and_retry(
+                    reply = self._reconnect_and_retry(  # rafiki: noqa[lock-order-cycle]
                         _encode([b"PING"]), "BRPOP", e,
                         deadline=retry_dl)
                     if reply != "PONG":

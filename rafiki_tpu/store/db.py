@@ -194,7 +194,10 @@ def sqlite_ddl_to_postgres(schema_sql: str) -> str:
     return sql
 
 
-class PostgresAdapter(DatabaseAdapter):
+# backup() is intentionally unimplemented: Postgres has no one-file
+# snapshot, and the DatabaseAdapter contract says such engines raise
+# NotImplementedError (callers feature-test via try/except)
+class PostgresAdapter(DatabaseAdapter):  # rafiki: noqa[hub-verb-parity]
     """MetaStore on a PostgreSQL server (multi-host control planes).
 
     Translation is pure string work (unit-tested without a server); the

@@ -3,7 +3,9 @@
 
 Equivalent to ``rafiki-tpu lint`` / ``rafiki-tpu-lint``; defaults to
 analyzing ``rafiki_tpu/`` relative to the repo root so CI can run it
-as ``python scripts/lint.py`` from anywhere.
+as ``python scripts/lint.py`` from anywhere. The repo self-check runs
+the whole-program rules too, so ``--project`` is ON by default here —
+pass explicit flags to opt into a narrower run.
 """
 
 import os
@@ -16,4 +18,7 @@ from rafiki_tpu.analysis.cli import main  # noqa: E402
 
 if __name__ == "__main__":
     os.chdir(_REPO_ROOT)  # "rafiki_tpu" default path resolves here
-    sys.exit(main())
+    argv = sys.argv[1:]
+    if "--project" not in argv:
+        argv = ["--project"] + argv
+    sys.exit(main(argv))

@@ -1,0 +1,12 @@
+#!/bin/sh
+# Pre-commit lint gate. Install with:
+#   ln -s ../../scripts/precommit.sh .git/hooks/pre-commit
+#
+# Per-module rules run only on the files you changed (vs HEAD, plus
+# untracked files) so the hook stays fast on a big tree; the
+# whole-program rules always see the full package, because cross-layer
+# contracts (hub verb parity, lock ordering, metric catalogs) can be
+# broken by files you did NOT touch.
+set -e
+cd "$(dirname "$0")/.."
+exec python scripts/lint.py --changed-only HEAD --project rafiki_tpu

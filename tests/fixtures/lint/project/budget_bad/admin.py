@@ -1,0 +1,20 @@
+"""Admin side of the budget contract, three ways broken: the
+MAX_REPLICAS budget key is documented nowhere (README.md only
+mentions KV_PAGES), burst_window is produced but the worker never
+reads it (dead knob), and the worker's required lease_s read has no
+producer here."""
+
+
+class Admin:
+    def __init__(self, mgr):
+        self.mgr = mgr
+
+    def create(self, budget):
+        if "KV_PAGES" not in budget:
+            raise ValueError("KV_PAGES is required")
+        cfg = {
+            "kv_pages": budget["KV_PAGES"],
+            "max_replicas": budget.get("MAX_REPLICAS"),
+            "burst_window": 30,
+        }
+        return self.mgr._spawn("budget_bad.worker", cfg)

@@ -1,0 +1,9 @@
+"""The spawned service: reads its config at startup. lease_s is a
+required read (plain subscript) that admin.py never produces."""
+
+
+def start(cfg):
+    pages = cfg["kv_pages"]
+    replicas = cfg.get("max_replicas", 1)
+    lease_s = cfg["lease_s"]
+    return pages, replicas, lease_s

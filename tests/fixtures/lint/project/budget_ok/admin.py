@@ -1,0 +1,17 @@
+"""The closed loop: every budget key is documented, every produced
+config key is consumed, every required read has a producer."""
+
+
+class Admin:
+    def __init__(self, mgr):
+        self.mgr = mgr
+
+    def create(self, budget):
+        if "KV_PAGES" not in budget:
+            raise ValueError("KV_PAGES is required")
+        cfg = {
+            "kv_pages": budget["KV_PAGES"],
+            "max_replicas": budget.get("MAX_REPLICAS"),
+            "lease_s": 30,
+        }
+        return self.mgr._spawn("budget_ok.worker", cfg)

@@ -1,0 +1,8 @@
+"""The spawned service: consumes everything admin.py produces."""
+
+
+def start(cfg):
+    pages = cfg["kv_pages"]
+    replicas = cfg.get("max_replicas", 1)
+    lease_s = cfg["lease_s"]
+    return pages, replicas, lease_s

@@ -1,0 +1,20 @@
+"""The ChaosHub bug repro: a fault-injecting decorator that wraps a
+VerbHub but does not override ping() — VerbHub.ping's default body
+runs and the injector silently never sees those calls."""
+
+from .iface import VerbHub
+
+
+class ChaosHub(VerbHub):
+    def __init__(self, inner: VerbHub, fail_rate=0.0):
+        self.inner = inner
+        self.fail_rate = fail_rate
+
+    def put(self, key, value):
+        return self.inner.put(key, value)
+
+    def get(self, key):
+        return self.inner.get(key)
+
+    def drop(self, key):
+        return self.inner.drop(key)

@@ -1,0 +1,13 @@
+"""Wire client: XSTATS has no cmd == "XSTATS" dispatch in
+kv_server.cc, so the server rejects it at runtime."""
+
+
+class WireClient:
+    def _cmd(self, *parts):
+        return parts
+
+    def put(self, key, value):
+        return self._cmd("PUT", key, value)
+
+    def stats(self):
+        return self._cmd("XSTATS")

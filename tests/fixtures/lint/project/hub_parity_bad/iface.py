@@ -1,0 +1,18 @@
+"""The transport-neutral verb interface: three abstract verbs plus one
+with a default body (exactly where a missed wrap hides)."""
+
+
+class VerbHub:
+    def put(self, key, value):
+        raise NotImplementedError
+
+    def get(self, key):
+        raise NotImplementedError
+
+    def drop(self, key):
+        raise NotImplementedError
+
+    def ping(self):
+        """Default no-op health check — subclass wrappers must still
+        override it or the wrapped hub never sees the call."""
+        return True

@@ -1,0 +1,8 @@
+// Minimal dispatch mirror of the real server for fixture purposes.
+// XSTATS is deliberately absent.
+static Reply dispatch(const std::string& cmd, const Args& args) {
+  if (cmd == "PUT") { return do_put(args); }
+  if (cmd == "GET") { return do_get(args); }
+  if (cmd == "DROP") { return do_drop(args); }
+  return Reply::error("unknown command");
+}

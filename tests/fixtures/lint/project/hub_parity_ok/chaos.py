@@ -1,0 +1,22 @@
+"""The fixed decorator: every public verb of the interface is
+wrapped, ping() included."""
+
+from .iface import VerbHub
+
+
+class ChaosHub(VerbHub):
+    def __init__(self, inner: VerbHub, fail_rate=0.0):
+        self.inner = inner
+        self.fail_rate = fail_rate
+
+    def put(self, key, value):
+        return self.inner.put(key, value)
+
+    def get(self, key):
+        return self.inner.get(key)
+
+    def drop(self, key):
+        return self.inner.drop(key)
+
+    def ping(self):
+        return self.inner.ping()

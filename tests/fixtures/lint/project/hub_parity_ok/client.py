@@ -1,0 +1,12 @@
+"""Wire client sending only verbs the server dispatches."""
+
+
+class WireClient:
+    def _cmd(self, *parts):
+        return parts
+
+    def put(self, key, value):
+        return self._cmd("PUT", key, value)
+
+    def drop(self, key):
+        return self._cmd("DROP", key)

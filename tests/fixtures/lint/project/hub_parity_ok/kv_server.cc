@@ -1,0 +1,9 @@
+// Dispatch covers every client verb; WAL_REPLAY is server-only,
+// which is allowed (internal replay path, no client sender).
+static Reply dispatch(const std::string& cmd, const Args& args) {
+  if (cmd == "PUT") { return do_put(args); }
+  if (cmd == "GET") { return do_get(args); }
+  if (cmd == "DROP") { return do_drop(args); }
+  if (cmd == "WAL_REPLAY") { return do_replay(args); }
+  return Reply::error("unknown command");
+}

@@ -1,0 +1,13 @@
+"""A module-level lock held across a blocking sleep: every other
+sender stalls behind the backoff."""
+
+import threading
+import time
+
+SEND_GATE = threading.Lock()
+
+
+def backoff_send(payload):
+    with SEND_GATE:
+        time.sleep(0.2)
+        return payload

@@ -1,0 +1,31 @@
+"""Two locks, two public entry points, opposite orders — the classic
+inversion lock-order-cycle exists to catch: allocate() takes
+alloc_lock then evict_lock (through _reclaim), evict() takes them the
+other way round (through _touch). Either order alone is fine; two
+threads interleaving deadlock."""
+
+import threading
+
+
+class PageTable:
+    def __init__(self):
+        self.alloc_lock = threading.Lock()
+        self.evict_lock = threading.Lock()
+        self.pages = {}
+
+    def allocate(self, key):
+        with self.alloc_lock:
+            self._reclaim()
+            return key
+
+    def _reclaim(self):
+        with self.evict_lock:
+            return len(self.pages)
+
+    def evict(self, key):
+        with self.evict_lock:
+            self._touch(key)
+
+    def _touch(self, key):
+        with self.alloc_lock:
+            return self.pages.get(key)

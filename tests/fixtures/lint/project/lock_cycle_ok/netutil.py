@@ -1,0 +1,13 @@
+"""The backoff sleeps OUTSIDE the critical section; the lock only
+guards the actual send."""
+
+import threading
+import time
+
+SEND_GATE = threading.Lock()
+
+
+def backoff_send(payload):
+    time.sleep(0.2)
+    with SEND_GATE:
+        return payload

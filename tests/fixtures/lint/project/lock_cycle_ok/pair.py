@@ -1,0 +1,25 @@
+"""Same two locks, one global order: everybody takes alloc_lock
+before evict_lock, so no interleaving can deadlock."""
+
+import threading
+
+
+class PageTable:
+    def __init__(self):
+        self.alloc_lock = threading.Lock()
+        self.evict_lock = threading.Lock()
+        self.pages = {}
+
+    def allocate(self, key):
+        with self.alloc_lock:
+            self._reclaim()
+            return key
+
+    def _reclaim(self):
+        with self.evict_lock:
+            return len(self.pages)
+
+    def evict(self, key):
+        with self.alloc_lock:
+            with self.evict_lock:
+                return self.pages.get(key)

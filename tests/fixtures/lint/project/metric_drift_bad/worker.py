@@ -1,0 +1,13 @@
+"""Publishes two instruments; the catalog and the dashboard each
+drifted a different way (see observability.md / dashboard.html in
+this directory)."""
+
+
+class Worker:
+    def __init__(self, metrics):
+        self.requests = metrics.counter("requests_total")
+        self.latency = metrics.histogram("request_latency_s")
+
+    def handle(self, req):
+        self.requests.inc()
+        return req

@@ -1,0 +1,13 @@
+"""Same instruments as the bad twin — here all three surfaces
+agree."""
+
+
+class Worker:
+    def __init__(self, metrics):
+        self.requests = metrics.counter("requests_total")
+        self.latency = metrics.histogram("request_latency_s")
+        self.depth = metrics.gauge("queue_depth")
+
+    def handle(self, req):
+        self.requests.inc()
+        return req

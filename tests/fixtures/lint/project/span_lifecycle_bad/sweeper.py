@@ -1,0 +1,6 @@
+"""A clean sibling component: the sweeper's span stream does emit a
+terminal, so only tracker.py should be flagged."""
+
+
+def sweep(span_sink, rid):
+    span_sink("expired", rid)

@@ -1,0 +1,13 @@
+"""Emits admission/progress spans but never a terminal event — every
+trace from this component looks permanently in-flight."""
+
+
+class RequestTracker:
+    def __init__(self, span_sink):
+        self.span_sink = span_sink
+
+    def admit(self, rid):
+        self.span_sink("admitted", rid)
+
+    def first_token(self, rid):
+        self.span_sink("first_token", rid)

@@ -1,0 +1,5 @@
+"""Sweeper twin: terminal-only emission is trivially fine."""
+
+
+def sweep(span_sink, rid):
+    span_sink("expired", rid)

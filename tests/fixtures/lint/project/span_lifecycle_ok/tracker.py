@@ -1,0 +1,19 @@
+"""Every exit path emits a terminal event; the progress events are
+fine once a done/rejected can close the stream."""
+
+
+class RequestTracker:
+    def __init__(self, span_sink):
+        self.span_sink = span_sink
+
+    def admit(self, rid):
+        self.span_sink("admitted", rid)
+
+    def first_token(self, rid):
+        self.span_sink("first_token", rid)
+
+    def finish(self, rid):
+        self.span_sink("done", rid)
+
+    def shed(self, rid):
+        self.span_sink("rejected", rid)

@@ -21,12 +21,15 @@ import sys
 
 import pytest
 
-from rafiki_tpu.analysis import (all_rules, analyze_paths,
-                                 analyze_source, get_rule)
+from rafiki_tpu.analysis import (all_project_rules, all_rules,
+                                 analyze_paths, analyze_project,
+                                 analyze_source, get_project_rule,
+                                 get_rule)
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PACKAGE = os.path.join(REPO_ROOT, "rafiki_tpu")
 FIXTURES = os.path.join(REPO_ROOT, "tests", "fixtures", "lint")
+PROJECT_FIXTURES = os.path.join(FIXTURES, "project")
 
 #: rule id -> fixture stem; every registered rule must appear here
 #: (the completeness test below enforces it), so adding a rule without
@@ -42,6 +45,17 @@ RULE_FIXTURES = {
     "obs-unregistered-metric": "obs_unregistered_metric",
     "wall-clock-deadline": "wall_clock_deadline",
     "blocking-transfer-in-decode-loop": "blocking_transfer",
+}
+
+#: project rule id -> fixture directory stem under
+#: tests/fixtures/lint/project/ (``<stem>_bad/`` + ``<stem>_ok/``
+#: multi-module trees); completeness enforced like RULE_FIXTURES
+PROJECT_RULE_FIXTURES = {
+    "lock-order-cycle": "lock_cycle",
+    "hub-verb-parity": "hub_parity",
+    "metric-catalog-drift": "metric_drift",
+    "budget-key-parity": "budget",
+    "span-lifecycle": "span_lifecycle",
 }
 
 
@@ -98,6 +112,118 @@ def test_every_registered_rule_has_fixtures():
     for rule_id in RULE_FIXTURES:
         rule = get_rule(rule_id)
         assert rule.description and rule.category and rule.severity
+
+
+# ---- project (whole-program) rules ----
+
+def test_repo_is_self_clean_under_project_rules():
+    """The CI gate for the cross-layer contracts: lock ordering, hub
+    verb parity, metric catalogs, budget keys, span lifecycles."""
+    findings = analyze_project([PACKAGE])
+    assert not findings, (
+        "rafiki_tpu/ has unsuppressed project-lint findings — fix the "
+        "contract drift or, for a documented intentional pattern, "
+        "suppress the line with `# rafiki: noqa[rule-id]` (``//`` / "
+        "``<!--`` markers work in non-Python files):\n"
+        + "\n".join(f.format() for f in findings))
+
+
+@pytest.mark.parametrize("rule_id", sorted(PROJECT_RULE_FIXTURES))
+def test_project_rule_fires_on_positive_fixture(rule_id):
+    root = os.path.join(PROJECT_FIXTURES,
+                        PROJECT_RULE_FIXTURES[rule_id] + "_bad")
+    findings = analyze_project([root], select=[rule_id])
+    assert findings, f"{rule_id} missed its positive fixture project"
+    assert all(f.rule == rule_id for f in findings)
+    assert all(f.line > 0 for f in findings)
+
+
+@pytest.mark.parametrize("rule_id", sorted(PROJECT_RULE_FIXTURES))
+def test_project_rule_quiet_on_negative_fixture(rule_id):
+    root = os.path.join(PROJECT_FIXTURES,
+                        PROJECT_RULE_FIXTURES[rule_id] + "_ok")
+    findings = analyze_project([root], select=[rule_id])
+    assert not findings, (
+        f"{rule_id} false-positives on its negative fixture project:\n"
+        + "\n".join(f.format() for f in findings))
+
+
+def test_project_positive_fixtures_trigger_no_foreign_rules():
+    for rule_id, stem in PROJECT_RULE_FIXTURES.items():
+        root = os.path.join(PROJECT_FIXTURES, stem + "_bad")
+        rules_hit = {f.rule for f in analyze_project([root])}
+        assert rules_hit == {rule_id}, (stem, rules_hit)
+
+
+def test_every_project_rule_has_fixtures():
+    assert set(PROJECT_RULE_FIXTURES) == set(all_project_rules()), (
+        "keep PROJECT_RULE_FIXTURES in sync with the project registry "
+        "(one positive + one negative fixture project per rule)")
+    for rule_id in PROJECT_RULE_FIXTURES:
+        rule = get_project_rule(rule_id)
+        assert rule.description and rule.category and rule.severity
+
+
+def test_hub_fixture_reproduces_the_chaoshub_bug():
+    """The historical regression this rule exists for: a decorator
+    that silently fails to wrap a default-body verb."""
+    root = os.path.join(PROJECT_FIXTURES, "hub_parity_bad")
+    findings = analyze_project([root], select=["hub-verb-parity"])
+    wrapper = [f for f in findings if "does not override" in f.message]
+    assert wrapper and "ping" in wrapper[0].message
+    wire = [f for f in findings if "XSTATS" in f.message]
+    assert wire and wire[0].path.endswith("client.py")
+
+
+def test_lock_fixture_reports_the_two_lock_cycle():
+    root = os.path.join(PROJECT_FIXTURES, "lock_cycle_bad")
+    findings = analyze_project([root], select=["lock-order-cycle"])
+    cycles = [f for f in findings if "lock-order cycle" in f.message]
+    assert len(cycles) == 1
+    assert "alloc_lock" in cycles[0].message
+    assert "evict_lock" in cycles[0].message
+
+
+def test_project_findings_anchor_in_non_python_resources():
+    """Drift findings point at the md/html surface that drifted, not
+    just at Python."""
+    root = os.path.join(PROJECT_FIXTURES, "metric_drift_bad")
+    findings = analyze_project([root], select=["metric-catalog-drift"])
+    exts = {f.path.rsplit(".", 1)[-1] for f in findings}
+    assert {"md", "html", "py"} <= exts
+
+
+def test_resource_noqa_suppression(tmp_path):
+    """``// rafiki: noqa[rule]`` on the finding line silences a
+    dashboard finding; audit mode still surfaces it."""
+    (tmp_path / "w.py").write_text(
+        "class W:\n"
+        "    def __init__(self, metrics):\n"
+        "        self.c = metrics.counter(\"requests_total\")\n")
+    (tmp_path / "dashboard.html").write_text(
+        "<script>\n"
+        "panel.textContent = s.requests_total +\n"
+        "  s.ghost_key;  // rafiki: noqa[metric-catalog-drift]\n"
+        "</script>\n")
+    root = str(tmp_path)
+    clean = analyze_project([root], select=["metric-catalog-drift"])
+    assert not clean, "\n".join(f.format() for f in clean)
+    audit = analyze_project([root], select=["metric-catalog-drift"],
+                            with_suppressed=True)
+    assert [f for f in audit if "ghost_key" in f.message]
+
+
+def test_project_pass_runtime_budget():
+    """The whole-program pass over the full package must stay cheap
+    enough for a pre-commit hook (tier-1 budget: < 30s on CPU)."""
+    import time
+    t0 = time.monotonic()
+    analyze_project([PACKAGE])
+    elapsed = time.monotonic() - t0
+    assert elapsed < 30.0, (
+        f"project lint pass took {elapsed:.1f}s — over the 30s "
+        "pre-commit budget; profile ProjectContext indexing or the "
+        "rule bodies")
 
 
 # ---- suppressions ----
@@ -201,5 +327,111 @@ def test_cli_bad_path_exits_two():
 def test_scripts_lint_runner():
     proc = subprocess.run(
         [sys.executable, os.path.join("scripts", "lint.py")],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_project_flag_runs_whole_program_rules():
+    bad = os.path.join("tests", "fixtures", "lint", "project",
+                       "lock_cycle_bad")
+    proc = _run_cli("--project", "--select", "lock-order-cycle", bad)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "lock-order cycle" in proc.stdout
+    # without --project the project rules never run
+    proc = _run_cli("--select", "lock-order-cycle", bad)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_list_rules_includes_project_rules():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    for rule_id in PROJECT_RULE_FIXTURES:
+        assert rule_id in proc.stdout
+
+
+def test_cli_sarif_output_schema_shape():
+    proc = _run_cli("--project",
+                    os.path.join("tests", "fixtures", "lint",
+                                 "project", "budget_bad"),
+                    "--format", "sarif")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in doc["$schema"]
+    run = doc["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "rafiki-tpu-lint"
+    rule_ids = {r["id"] for r in driver["rules"]}
+    assert "budget-key-parity" in rule_ids
+    assert run["results"], "findings must map to SARIF results"
+    for res in run["results"]:
+        assert res["ruleId"] in rule_ids
+        assert res["level"] in ("error", "warning")
+        assert res["message"]["text"]
+        loc = res["locations"][0]["physicalLocation"]
+        uri = loc["artifactLocation"]["uri"]
+        assert "\\" not in uri, "SARIF URIs use forward slashes"
+        assert loc["region"]["startLine"] >= 1
+        assert loc["region"]["startColumn"] >= 1
+
+
+def _git(*args, cwd):
+    return subprocess.run(["git", *args], capture_output=True,
+                          text=True, cwd=cwd)
+
+
+def test_cli_changed_only_scopes_to_changed_files(tmp_path):
+    """Only files changed vs the base ref (plus untracked) are linted
+    by the per-module pass."""
+    repo = tmp_path / "repo"
+    pkg = repo / "pkg"
+    pkg.mkdir(parents=True)
+    committed_bad = (
+        "def f(job):\n"
+        "    try:\n"
+        "        return job()\n"
+        "    except Exception:\n"
+        "        return None\n")
+    (pkg / "old.py").write_text(committed_bad)
+    for cmd in (("init", "-q"),
+                ("config", "user.email", "lint@test"),
+                ("config", "user.name", "lint"),
+                ("add", "."), ("commit", "-q", "-m", "seed")):
+        proc = _git(*cmd, cwd=repo)
+        assert proc.returncode == 0, proc.stderr
+    # a NEW (untracked) file with the same hazard
+    (pkg / "new.py").write_text(committed_bad.replace("f(", "g("))
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    proc = subprocess.run(
+        [sys.executable, "-m", "rafiki_tpu.cli", "lint",
+         "--changed-only", "HEAD", "--format", "json", "pkg"],
+        capture_output=True, text=True, cwd=repo, env=env)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    paths = {f["path"] for f in json.loads(proc.stdout)["findings"]}
+    assert any(p.endswith("new.py") for p in paths)
+    assert not any(p.endswith("old.py") for p in paths), (
+        "committed-unchanged files must not be linted under "
+        "--changed-only")
+
+
+def test_cli_changed_only_bad_ref_exits_two(tmp_path):
+    """A typo'd base ref must fail loudly, not lint nothing and
+    report clean."""
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    (repo / "x.py").write_text("A = 1\n")
+    assert _git("init", "-q", cwd=repo).returncode == 0
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    proc = subprocess.run(
+        [sys.executable, "-m", "rafiki_tpu.cli", "lint",
+         "--changed-only", "no-such-ref", "."],
+        capture_output=True, text=True, cwd=repo, env=env)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "lint" in proc.stderr
+
+
+def test_scripts_precommit_hook():
+    proc = subprocess.run(
+        ["sh", os.path.join("scripts", "precommit.sh")],
         capture_output=True, text=True, cwd=REPO_ROOT)
     assert proc.returncode == 0, proc.stdout + proc.stderr
