@@ -131,7 +131,7 @@ def audit_workdir(workdir: str,
         report["lease"] = {**lease, "heartbeat_age_s": round(age, 1)}
         live_rows = any(s["status"] in _LIVE_STATES
                         for s in report["services"])
-        if live_rows and age > 60.0:
+        if live_rows and age > 60.0:  # rafiki: noqa[taint-wall-clock-flow] — heartbeat_at is a PERSISTED wall-clock stamp from another process; monotonic cannot age it across restarts
             drift.append(
                 f"admin lease heartbeat is {age:.0f}s old while "
                 "service rows claim to be live — the admin is gone; "

@@ -977,33 +977,42 @@ class ServicesManager:
                 slot = self.allocator.acquire(timeout=0.0)
                 if slot is None:
                     break  # no free sub-mesh; trials queue on fewer workers
-                worker = self._spawn(
-                    "rafiki_tpu.worker.train",
-                    {"advisor_url": advisor.url,
-                     "model_file": str(model_file),
-                     "model_class": model["model_class"],
-                     "model_id": model["id"],
-                     "train_dataset": job["train_dataset_id"],
-                     "val_dataset": job["val_dataset_id"],
-                     "param_store_uri": self.param_store_uri,
-                     "meta_store_path": self.meta._db_path,
-                     "sub_train_job_id": sub["id"],
-                     "profile_dir": profile_dir,
-                     "knob_overrides": overrides,
-                     # gang trial mode: K trials per compiled step on
-                     # this worker's sub-mesh (small-zoo templates)
-                     "gang_size": int(job["train_args"].get(
-                         "gang_size") or 0),
-                     "checkpoint_interval_s": job["train_args"].get(
-                         "checkpoint_interval_s", 30.0),
-                     "worker_id": f"tw-{sub['id'][:8]}-{w}",
-                     # /metrics + /debug/requests sidecar: ephemeral
-                     # port, discoverable from this file
-                     "obs_port_file": str(
-                         self.workdir / f"tw-{sub['id'][:8]}-{w}"
-                                        ".obs_port")},
-                    ServiceType.TRAIN_WORKER, slot=slot,
-                    train_job_id=train_job_id, sub_train_job_id=sub["id"])
+                try:
+                    worker = self._spawn(
+                        "rafiki_tpu.worker.train",
+                        {"advisor_url": advisor.url,
+                         "model_file": str(model_file),
+                         "model_class": model["model_class"],
+                         "model_id": model["id"],
+                         "train_dataset": job["train_dataset_id"],
+                         "val_dataset": job["val_dataset_id"],
+                         "param_store_uri": self.param_store_uri,
+                         "meta_store_path": self.meta._db_path,
+                         "sub_train_job_id": sub["id"],
+                         "profile_dir": profile_dir,
+                         "knob_overrides": overrides,
+                         # gang trial mode: K trials per compiled step
+                         # on this worker's sub-mesh (small-zoo
+                         # templates)
+                         "gang_size": int(job["train_args"].get(
+                             "gang_size") or 0),
+                         "checkpoint_interval_s": job["train_args"].get(
+                             "checkpoint_interval_s", 30.0),
+                         "worker_id": f"tw-{sub['id'][:8]}-{w}",
+                         # /metrics + /debug/requests sidecar:
+                         # ephemeral port, discoverable from this file
+                         "obs_port_file": str(
+                             self.workdir / f"tw-{sub['id'][:8]}-{w}"
+                                            ".obs_port")},
+                        ServiceType.TRAIN_WORKER, slot=slot,
+                        train_job_id=train_job_id,
+                        sub_train_job_id=sub["id"])
+                except Exception:
+                    # the slot was never handed to a live service:
+                    # return it to the pool or it is gone until admin
+                    # restart (every sibling spawn site guards this)
+                    self.allocator.release(slot)
+                    raise
                 spawned.append(worker)
             self.meta.update_sub_train_job(
                 sub["id"], status=SubTrainJobStatus.RUNNING)

@@ -1,0 +1,429 @@
+"""Per-function control-flow graphs for the flow rules.
+
+The per-module rules (:mod:`.engine`) and project rules
+(:mod:`.project`) are pattern matchers: they see shapes, not *paths*.
+The bug classes this third layer exists for — a ``release()`` missing
+on the exception path, a buffer read after it was donated to a
+compiled call, wire data reaching config on one branch only — are
+properties of paths, so they need a CFG.
+
+The graph is statement-level: a :class:`Block` holds a run of
+statements that execute together; compound statements (``if``,
+``while``, ``for``, ``try``, ``with``, ``match``) terminate their
+block, with the compound node itself appended last so rules can
+inspect its test/iterator/context expressions in evaluation position.
+Edges carry a ``kind`` the witness renderer turns into English:
+``flow``, ``true``/``false`` (branches), ``loop`` (back edge),
+``exc`` (an exception raised somewhere in the source block),
+``break``/``continue``/``return``/``raise`` (abrupt completion).
+
+``try``/``finally`` is modeled with ONE instance of the finally body
+and *kind-matched continuations*: every route out of the protected
+region (normal completion, ``return``, ``break``, an exception)
+enters the finally entry with its own edge kind, and the finally's
+normal exit fans out through ``fin:<kind>``-tagged edges to each
+continuation that entered it. Path walkers
+(:func:`rafiki_tpu.analysis.dataflow.path_search`) keep a stack of
+entry kinds so a path that entered the finally normally cannot leave
+it on the exception continuation — the classic false-path of
+single-instance finally modeling. A ``return`` inside the finally
+itself overrides pending continuations, exactly like CPython.
+
+Exception edges are block-granular: every block built inside a
+``try`` gets one ``exc`` successor per reachable handler entry (plus
+the adjacent finally entry, since no handler may match), meaning
+"some statement here raised". Rules that care which *statement*
+raised treat the ``exc`` successor as available from any statement
+that can actually raise (one containing a call) — conservative in
+the direction lint wants.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+__all__ = ["Block", "CFG", "build_cfg", "EDGE_NOTES"]
+
+#: edge kind -> phrase used in witness traces (``fin:`` fan-outs
+#: reuse the base kind's phrase)
+EDGE_NOTES = {
+    "flow": "then",
+    "true": "when the branch is taken",
+    "false": "when the branch is not taken",
+    "loop": "looping back",
+    "exc": "if this raises",
+    "break": "breaking out of the loop",
+    "continue": "continuing the loop",
+    "return": "returning",
+    "raise": "raising",
+}
+
+
+class Block:
+    """One basic block: statements plus typed successor edges."""
+
+    __slots__ = ("id", "stmts", "succs", "preds")
+
+    def __init__(self, bid: int):
+        self.id = bid
+        self.stmts: List[ast.AST] = []
+        self.succs: List[Tuple["Block", str]] = []
+        self.preds: List[Tuple["Block", str]] = []
+
+    def edge_to(self, other: "Block", kind: str = "flow") -> None:
+        for b, k in self.succs:
+            if b is other and k == kind:
+                return
+        self.succs.append((other, kind))
+        other.preds.append((self, kind))
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        succ = ", ".join(f"{k}->{b.id}" for b, k in self.succs)
+        return f"<Block {self.id} [{len(self.stmts)} stmt] {succ}>"
+
+
+class CFG:
+    """The graph for one function: entry, exit, and every block."""
+
+    def __init__(self, fn: ast.AST, entry: Block, exit_block: Block,
+                 blocks: List[Block], finally_entries: Set[int]):
+        self.fn = fn
+        self.entry = entry
+        self.exit = exit_block
+        self.blocks = blocks
+        #: ids of blocks that are finally-body entries — path walkers
+        #: push the entry edge's kind here and pop it at the matching
+        #: ``fin:<kind>`` fan-out edge
+        self.finally_entries = finally_entries
+
+    def statements(self) -> Iterator[Tuple[Block, int, ast.AST]]:
+        """Every (block, index, statement) triple, in block order."""
+        for block in self.blocks:
+            for i, stmt in enumerate(block.stmts):
+                yield block, i, stmt
+
+
+def _can_raise(stmt: ast.AST) -> bool:
+    """Can executing this statement plausibly raise? Anything that
+    calls, raises, asserts, or indexes can; pure name/constant moves
+    cannot (for lint purposes)."""
+    for node in ast.walk(stmt):
+        if isinstance(node, (ast.Call, ast.Raise, ast.Assert,
+                             ast.Subscript, ast.Await, ast.Yield,
+                             ast.YieldFrom)):
+            return True
+    return False
+
+
+class _FinallyFrame:
+    """One open ``try``'s finally body, collecting the continuations
+    routed through it (kind-matched)."""
+
+    def __init__(self, entry: Block):
+        self.entry = entry
+        #: (continuation block, base edge kind) — fan-out becomes a
+        #: ``fin:<kind>`` edge from the finally's normal exit
+        self.targets: List[Tuple[Block, str]] = []
+        self.saw_exc = False  # an exc/raise route entered this frame
+
+    def add_target(self, block: Block, kind: str) -> None:
+        for b, k in self.targets:
+            if b is block and k == kind:
+                return
+        self.targets.append((block, kind))
+
+
+class _Builder:
+    def __init__(self, fn: ast.AST):
+        self.fn = fn
+        self.blocks: List[Block] = []
+        self.exit = self._new()
+        self.entry = self._new()
+        self.cur: Block = self.entry
+        self.finally_entries: Set[int] = set()
+        # control stack entries:
+        #   ("loop", break_target, continue_target)
+        #   ("except", [handler entry blocks])
+        #   ("finally", _FinallyFrame)
+        self.stack: List[tuple] = []
+
+    # ---- plumbing ----
+
+    def _new(self) -> Block:
+        b = Block(len(self.blocks))
+        self.blocks.append(b)
+        return b
+
+    def _start(self, block: Optional[Block] = None) -> Block:
+        """Begin filling ``block`` (or a fresh one), wiring the
+        current exception targets as ``exc`` successors."""
+        b = block if block is not None else self._new()
+        for target, frame in self._exc_targets():
+            b.edge_to(target, "exc")
+            if frame is not None:
+                frame.saw_exc = True
+        self.cur = b
+        return b
+
+    def _exc_targets(self) -> List[Tuple[Block, Optional["_FinallyFrame"]]]:
+        """Where an exception raised *here* can transfer control: the
+        innermost handlers, plus their try's adjacent finally entry
+        (no handler may match)."""
+        out: List[Tuple[Block, Optional[_FinallyFrame]]] = []
+        for entry in reversed(self.stack):
+            if entry[0] == "except":
+                out.extend((h, None) for h in entry[1])
+                continue  # the paired finally sits just beneath
+            if entry[0] == "finally":
+                out.append((entry[1].entry, entry[1]))
+                break
+            if out:
+                break
+        return out
+
+    def _terminate(self) -> None:
+        """Current block ended abruptly; subsequent statements (dead
+        code) land in a fresh unreachable block."""
+        self.cur = self._new()
+        # deliberately no exc edges: the block is unreachable
+
+    # ---- abrupt-completion routing (through finallys) ----
+
+    def _route(self, kind: str, target: Block,
+               until: Optional[tuple] = None) -> None:
+        """Jump from ``self.cur`` to ``target`` with edge ``kind``,
+        detouring through every open finally between here and
+        ``until`` (a stack entry) / the stack bottom."""
+        hops: List[_FinallyFrame] = []
+        for entry in reversed(self.stack):
+            if until is not None and entry is until:
+                break
+            if entry[0] == "finally":
+                hops.append(entry[1])
+        if not hops:
+            self.cur.edge_to(target, kind)
+            return
+        self.cur.edge_to(hops[0].entry, kind)
+        for inner, outer in zip(hops, hops[1:]):
+            inner.add_target(outer.entry, kind)
+        hops[-1].add_target(target, kind)
+
+    def _route_raise(self) -> None:
+        """An explicit ``raise``: to the innermost handlers, chaining
+        through finallys; to the exit when nothing catches."""
+        prev: Optional[_FinallyFrame] = None
+
+        def _to(block: Block) -> None:
+            if prev is None:
+                self.cur.edge_to(block, "raise")
+            else:
+                prev.add_target(block, "raise")
+
+        for entry in reversed(self.stack):
+            if entry[0] == "except":
+                for h in entry[1]:
+                    _to(h)
+                return
+            if entry[0] == "finally":
+                frame = entry[1]
+                _to(frame.entry)
+                frame.saw_exc = True
+                prev = frame
+        _to(self.exit)
+
+    def _innermost_loop(self) -> Optional[tuple]:
+        for entry in reversed(self.stack):
+            if entry[0] == "loop":
+                return entry
+        return None
+
+    # ---- statement dispatch ----
+
+    def build(self) -> CFG:
+        self._start(self.entry)
+        self._body(self.fn.body)
+        self.cur.edge_to(self.exit, "flow")
+        return CFG(self.fn, self.entry, self.exit, self.blocks,
+                   self.finally_entries)
+
+    def _body(self, stmts: List[ast.stmt]) -> None:
+        for stmt in stmts:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.If):
+            self._if(stmt)
+        elif isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            self._loop(stmt)
+        elif isinstance(stmt, ast.Try):
+            self._try(stmt)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self._with(stmt)
+        elif isinstance(stmt, ast.Match):
+            self._match(stmt)
+        elif isinstance(stmt, ast.Return):
+            self.cur.stmts.append(stmt)
+            self._route("return", self.exit)
+            self._terminate()
+        elif isinstance(stmt, ast.Raise):
+            self.cur.stmts.append(stmt)
+            self._route_raise()
+            self._terminate()
+        elif isinstance(stmt, ast.Break):
+            self.cur.stmts.append(stmt)
+            loop = self._innermost_loop()
+            if loop is not None:
+                self._route("break", loop[1], until=loop)
+            self._terminate()
+        elif isinstance(stmt, ast.Continue):
+            self.cur.stmts.append(stmt)
+            loop = self._innermost_loop()
+            if loop is not None:
+                self._route("continue", loop[2], until=loop)
+            self._terminate()
+        else:
+            # simple statement (incl. nested def/class: their bodies
+            # get their own CFGs; the def itself is one binding stmt)
+            self.cur.stmts.append(stmt)
+
+    # ---- compound statements ----
+
+    def _if(self, stmt: ast.If) -> None:
+        self.cur.stmts.append(stmt)
+        head = self.cur
+        after = self._new()
+        self._start()
+        head.edge_to(self.cur, "true")
+        self._body(stmt.body)
+        self.cur.edge_to(after, "flow")
+        if stmt.orelse:
+            self._start()
+            head.edge_to(self.cur, "false")
+            self._body(stmt.orelse)
+            self.cur.edge_to(after, "flow")
+        else:
+            head.edge_to(after, "false")
+        self._start(after)
+
+    def _loop(self, stmt) -> None:
+        head = self._new()
+        self.cur.edge_to(head, "flow")
+        self._start(head)
+        head.stmts.append(stmt)  # test / iterator evaluates here
+        after = self._new()
+        body = self._new()
+        head.edge_to(body, "true")
+        self.stack.append(("loop", after, head))
+        self._start(body)
+        self._body(stmt.body)
+        self.cur.edge_to(head, "loop")
+        self.stack.pop()
+        if stmt.orelse:
+            self._start()
+            head.edge_to(self.cur, "false")
+            self._body(stmt.orelse)
+            self.cur.edge_to(after, "flow")
+        else:
+            head.edge_to(after, "false")
+        self._start(after)
+
+    def _with(self, stmt) -> None:
+        self.cur.stmts.append(stmt)  # context exprs evaluate here
+        body = self._new()
+        self.cur.edge_to(body, "flow")
+        self._start(body)
+        self._body(stmt.body)
+        after = self._new()
+        self.cur.edge_to(after, "flow")
+        self._start(after)
+
+    def _match(self, stmt: ast.Match) -> None:
+        self.cur.stmts.append(stmt)
+        head = self.cur
+        after = self._new()
+        for case in stmt.cases:
+            self._start()
+            head.edge_to(self.cur, "true")
+            self._body(case.body)
+            self.cur.edge_to(after, "flow")
+        head.edge_to(after, "false")  # no case matched
+        self._start(after)
+
+    def _try(self, stmt: ast.Try) -> None:
+        after = self._new()
+        fin_frame: Optional[_FinallyFrame] = None
+        if stmt.finalbody:
+            fin_frame = _FinallyFrame(self._new())
+            self.finally_entries.add(fin_frame.entry.id)
+            self.stack.append(("finally", fin_frame))
+        handler_entries = [self._new() for _ in stmt.handlers]
+        if handler_entries:
+            self.stack.append(("except", handler_entries))
+
+        body = self._new()
+        self.cur.edge_to(body, "flow")
+        self._start(body)
+        self._body(stmt.body)
+        if stmt.orelse:
+            self._body(stmt.orelse)
+        end_of_try = self.cur
+        if handler_entries:
+            self.stack.pop()  # handler bodies raise to the OUTER try
+
+        # normal completion of try/else: through THIS finally only
+        self.cur = end_of_try
+        self._normal_completion(fin_frame, after)
+        for handler, entry in zip(stmt.handlers, handler_entries):
+            self._start(entry)
+            if handler.type is not None or handler.name:
+                entry.stmts.append(handler)  # anchor `except X as e:`
+            self._body(handler.body)
+            self._normal_completion(fin_frame, after)
+
+        if fin_frame is not None:
+            self.stack.pop()
+            if fin_frame.saw_exc:
+                # an unmatched exception that entered this finally
+                # keeps unwinding afterwards: chain to the next
+                # handler/finally outward, or the function exit
+                save = self.cur
+                self.cur = fin_frame.entry  # (unused by _route_raise
+                #                              when prev is not None)
+                prev = fin_frame
+                done = False
+                for entry in reversed(self.stack):
+                    if entry[0] == "except":
+                        for h in entry[1]:
+                            prev.add_target(h, "raise")
+                        done = True
+                        break
+                    if entry[0] == "finally":
+                        prev.add_target(entry[1].entry, "raise")
+                        entry[1].saw_exc = True
+                        prev = entry[1]
+                if not done and prev is not None:
+                    prev.add_target(self.exit, "raise")
+                self.cur = save
+            self._start(fin_frame.entry)
+            self._body(stmt.finalbody)
+            # the finally's normal exit fans out, kind-matched, to
+            # every continuation that routed through it; a finally
+            # that itself completed abruptly already jumped and
+            # leaves an unreachable `cur` (CPython's override)
+            for target, kind in fin_frame.targets:
+                self.cur.edge_to(target, "fin:" + kind)
+        self._start(after)
+
+    def _normal_completion(self, fin_frame: Optional[_FinallyFrame],
+                           after: Block) -> None:
+        if fin_frame is not None:
+            self.cur.edge_to(fin_frame.entry, "flow")
+            fin_frame.add_target(after, "flow")
+        else:
+            self.cur.edge_to(after, "flow")
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """Build the CFG of one ``FunctionDef`` / ``AsyncFunctionDef``."""
+    return _Builder(fn).build()

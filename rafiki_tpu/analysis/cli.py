@@ -29,8 +29,9 @@ import subprocess
 import sys
 from typing import List, Optional, Tuple
 
-from .engine import (all_rules, analyze_paths, render_json,
-                     render_sarif, render_text)
+from .dataflow import all_flow_rules
+from .engine import (all_rules, analyze_paths, analyze_source,
+                     render_json, render_sarif, render_text)
 from .project import all_project_rules, analyze_project
 
 
@@ -63,7 +64,13 @@ def add_lint_arguments(parser: argparse.ArgumentParser) -> None:
              "audit mode, not the CI gate)")
     parser.add_argument(
         "--list-rules", action="store_true",
-        help="print the registered rules and exit")
+        help="print the registered rules and exit (flow rules are "
+             "tagged [flow:...], project rules [project:...])")
+    parser.add_argument(
+        "--explain", default=None, metavar="RULE",
+        help="print one rule's full story — description, and for "
+             "flow rules the declared sources/sinks/sanitizers plus "
+             "an example with its witness trace — and exit")
 
 
 def _changed_files(base_ref: str) -> List[str]:
@@ -112,21 +119,69 @@ def _split_select(select_arg: Optional[str]
         return None, None
     ids = [r.strip() for r in select_arg.split(",") if r.strip()]
     module_rules, project_rules = all_rules(), all_project_rules()
-    known = set(module_rules) | set(project_rules)
+    flow_rules = all_flow_rules()
+    known = set(module_rules) | set(project_rules) | set(flow_rules)
     for rule_id in ids:
         if rule_id not in known:
             raise KeyError(
                 f"unknown rule {rule_id!r} "
                 f"(known: {', '.join(sorted(known))})")
-    return ([r for r in ids if r in module_rules],
+    # flow rules run in the per-file pass alongside module rules —
+    # that is what makes --changed-only scope them for free
+    return ([r for r in ids
+             if r in module_rules or r in flow_rules],
             [r for r in ids if r in project_rules])
 
 
+def _explain(rule_id: str) -> int:
+    """Print one rule's full story; exit 0, or 2 on an unknown id."""
+    module_rules, project_rules = all_rules(), all_project_rules()
+    flow_rules = all_flow_rules()
+    if rule_id in flow_rules:
+        rule, tag = flow_rules[rule_id], "flow"
+    elif rule_id in module_rules:
+        rule, tag = module_rules[rule_id], "module"
+    elif rule_id in project_rules:
+        rule, tag = project_rules[rule_id], "project"
+    else:
+        known = set(module_rules) | set(project_rules) | set(flow_rules)
+        print(f"rafiki-tpu lint: unknown rule {rule_id!r} "
+              f"(known: {', '.join(sorted(known))})", file=sys.stderr)
+        return 2
+    print(f"{rule_id} [{tag}:{rule.category}/{rule.severity}]")
+    print(f"    {rule.description}")
+    for heading, lines in (("sources", getattr(rule, "sources", ())),
+                           ("sinks", getattr(rule, "sinks", ())),
+                           ("sanitizers",
+                            getattr(rule, "sanitizers", ()))):
+        if lines:
+            print(f"  {heading}:")
+            for line in lines:
+                print(f"    - {line}")
+    example = getattr(rule, "example", "")
+    if example:
+        print("  example:")
+        for line in example.rstrip("\n").splitlines():
+            print(f"    | {line}")
+        findings = analyze_source(example, path="<example>",
+                                  select=[rule_id])
+        if findings:
+            print("  which the rule reports as:")
+            for line in findings[0].format().splitlines():
+                print(f"    {line}")
+    return 0
+
+
 def run_lint(args: argparse.Namespace) -> int:
+    if args.explain:
+        return _explain(args.explain)
     if args.list_rules:
         for rule_id, rule in sorted(all_rules().items()):
             print(f"{rule_id} [{rule.category}/{rule.severity}]\n"
                   f"    {rule.description}")
+        for rule_id, rule in sorted(all_flow_rules().items()):
+            print(f"{rule_id} [flow:{rule.category}/{rule.severity}]"
+                  f"\n    {rule.description}")
         for rule_id, rule in sorted(all_project_rules().items()):
             print(f"{rule_id} [project:{rule.category}/{rule.severity}]"
                   f"\n    {rule.description}")

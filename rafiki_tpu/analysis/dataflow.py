@@ -1,0 +1,551 @@
+"""Forward dataflow over the CFG: taint propagation, path search,
+and the :class:`FlowRule` base the path-sensitive rules implement.
+
+Two engines, matched to the two shapes of flow question:
+
+- :func:`path_search` — explicit path enumeration from a program
+  point ("is there a path from this ``.acquire()`` to the function
+  exit with no ``release()``?", "is this name read again after being
+  donated?"). Statement-granular, kill-aware, and finally-disciplined:
+  a path that entered a ``finally`` normally cannot leave it on the
+  exception continuation (see :mod:`.cfg`). Returns witness paths.
+
+- :class:`TaintEngine` — a label-propagating lattice run to fixpoint
+  over the CFG ("does wall-clock time reach a deadline?", "does a hub
+  payload field reach subprocess argv?"). State maps variable paths
+  (``x``, ``self.deadline``) to a :class:`Taint` carrying the witness
+  chain; assignments/arithmetic/casts propagate, sanitizer calls cut,
+  rebinding to a clean value kills. Merges keep the first (shortest)
+  witness; convergence is judged on key sets only, so loop-carried
+  taint stabilizes in O(vars) iterations.
+
+A :class:`FlowRule` declares its ``sources``/``sinks``/``sanitizers``
+(human-readable, shown by ``lint --explain``) and an ``example``
+snippet, and implements ``check(ctx)`` yielding ``(node, message,
+trace)`` triples; the engine attaches location, suppression, and
+rendering (text indented steps, SARIF ``codeFlows``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import (Callable, Dict, Iterator, List, Optional,
+                    Sequence, Set, Tuple)
+
+from .cfg import CFG, EDGE_NOTES, Block, _can_raise, build_cfg
+from .engine import SEVERITIES, TraceStep
+
+__all__ = [
+    "FlowRule", "PathHit", "Taint", "TaintEngine", "all_flow_rules",
+    "functions", "get_flow_rule", "has_source", "header_exprs",
+    "path_search",
+    "register_flow", "tainted_return_helpers",
+]
+
+
+def header_exprs(stmt: ast.AST) -> List[ast.AST]:
+    """The parts of a statement that evaluate *at its CFG position*.
+
+    Compound statements sit in a block as terminators but own nested
+    bodies that belong to OTHER blocks — predicates must only look at
+    the header (test/iterator/context managers), never the body.
+    """
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in stmt.items]
+    if isinstance(stmt, ast.Match):
+        return [stmt.subject]
+    if isinstance(stmt, ast.Return):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, ast.Raise):
+        return [n for n in (stmt.exc, stmt.cause) if n is not None]
+    if isinstance(stmt, ast.ExceptHandler):
+        return [stmt.type] if stmt.type is not None else []
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return list(stmt.decorator_list)
+    return [stmt]
+
+
+def _walk_headers(stmt: ast.AST) -> Iterator[ast.AST]:
+    for part in header_exprs(stmt):
+        yield from ast.walk(part)
+
+
+# --------------------------------------------------- path search
+
+@dataclasses.dataclass
+class PathHit:
+    """One witness path: the hit statement plus the steps to it."""
+
+    stmt: ast.AST
+    note: str
+    #: (anchor node, phrase) pairs from just after the start point to
+    #: the hit — branch decisions, exception hops, the hit itself
+    steps: List[Tuple[ast.AST, str]]
+
+
+def _norm_kind(kind: str) -> str:
+    return "raise" if kind in ("exc", "raise") else kind
+
+
+def path_search(cfg: CFG, start_block: Block, start_idx: int, *,
+                kill: Callable[[ast.AST], Optional[str]],
+                hit: Optional[Callable[[ast.AST], Optional[str]]] = None,
+                to_exit: bool = False,
+                exit_note: str = "the function can exit here",
+                soft_exc_note: Optional[str] = None,
+                max_hits: int = 16) -> List[PathHit]:
+    """Enumerate paths from (block, stmt index) until ``kill``.
+
+    ``kill(stmt)`` returns falsy (keep walking), ``"hard"``/truthy
+    (this statement settles the obligation — stop, including its
+    exception path), or ``"soft"`` (the statement settles it ONLY if
+    it completes: stop the normal path but keep exploring its
+    exception path; with ``to_exit`` and no enclosing try, the
+    potential raise itself is an exit witness, noted with
+    ``soft_exc_note``). ``hit(stmt)`` returning a note records a
+    witness at that statement; with ``to_exit`` an edge into
+    ``cfg.exit`` records one anchored at the last statement walked.
+    Each distinct hit statement is reported once, with the first
+    (BFS-shortest) path as its witness. Exception successors are only
+    taken from statements that can actually raise; ``fin:`` fan-out
+    edges must match the kind the path entered the finally with.
+    """
+    hits: List[PathHit] = []
+    seen_hit_ids: Set[int] = set()
+    # state: (block id, stmt index, finally-entry-kind stack)
+    start = (start_block.id, start_idx, ())
+    parents: Dict[tuple, Tuple[Optional[tuple], Optional[ast.AST], str]] = {
+        start: (None, None, "")}
+    by_id = {b.id: b for b in cfg.blocks}
+    frontier = [start]
+    visited = {start}
+
+    def _steps(state: tuple, final: Tuple[ast.AST, str]
+               ) -> List[Tuple[ast.AST, str]]:
+        chain: List[Tuple[ast.AST, str]] = []
+        cur = state
+        while cur is not None:
+            parent, anchor, kind = parents[cur]
+            if anchor is not None and kind and kind != "flow":
+                chain.append((anchor, EDGE_NOTES.get(
+                    kind.replace("fin:", ""), kind)))
+            cur = parent
+        chain.reverse()
+        chain.append(final)
+        return chain
+
+    def _record(state: tuple, stmt: ast.AST, note: str) -> None:
+        if id(stmt) in seen_hit_ids or len(hits) >= max_hits:
+            return
+        seen_hit_ids.add(id(stmt))
+        hits.append(PathHit(stmt, note, _steps(state, (stmt, note))))
+
+    def _push(state: tuple, nxt: tuple, anchor: Optional[ast.AST],
+              kind: str) -> None:
+        if nxt in visited:
+            return
+        visited.add(nxt)
+        parents[nxt] = (state, anchor, kind)
+        frontier.append(nxt)
+
+    def _take_edge(state: tuple, anchor: Optional[ast.AST],
+                   succ: Block, kind: str) -> None:
+        fin_stack = state[2]
+        if kind.startswith("fin:"):
+            base = _norm_kind(kind[4:])
+            if fin_stack:
+                if fin_stack[-1] != base:
+                    return  # continuation does not match the entry
+                fin_stack = fin_stack[:-1]
+            # empty stack: the search started inside this finally —
+            # every continuation is plausible
+        if succ.id in cfg.finally_entries:
+            fin_stack = fin_stack + (_norm_kind(
+                kind[4:] if kind.startswith("fin:") else kind),)
+        if succ is cfg.exit:
+            if to_exit:
+                _record(state, anchor if anchor is not None
+                        else cfg.fn, exit_note)
+            return
+        _push(state, (succ.id, 0, fin_stack), anchor, kind)
+
+    while frontier:
+        state = frontier.pop(0)
+        bid, idx, fin_stack = state
+        block = by_id[bid]
+        if idx < len(block.stmts):
+            stmt = block.stmts[idx]
+            if hit is not None:
+                note = hit(stmt)
+                if note:
+                    _record(state, stmt, note)
+            verdict = kill(stmt)
+            # exception successors are available from any statement
+            # that can plausibly raise — unless a hard kill settled
+            # the obligation outright
+            if verdict != "hard" and \
+                    any(_can_raise(p) for p in header_exprs(stmt)):
+                exc_succs = [(s, k) for s, k in block.succs
+                             if k == "exc"]
+                for succ, kind in exc_succs:
+                    _take_edge(state, stmt, succ, kind)
+                if verdict == "soft" and to_exit and not exc_succs:
+                    # no enclosing try: if the settling call raises,
+                    # the obligation escapes with the exception
+                    _record(state, stmt, soft_exc_note or exit_note)
+            if verdict:
+                continue
+            _push(state, (bid, idx + 1, fin_stack), None, "flow")
+            continue
+        # past the last statement: leave the block
+        anchor = block.stmts[-1] if block.stmts else None
+        for succ, kind in block.succs:
+            if kind == "exc":
+                continue  # taken per raising statement above
+            _take_edge(state, anchor, succ, kind)
+    return hits
+
+
+# --------------------------------------------------- taint engine
+
+@dataclasses.dataclass(frozen=True)
+class Taint:
+    """A tainted value's witness: (line, col, note) hops, source first."""
+
+    steps: Tuple[Tuple[int, int, str], ...]
+
+    def extend(self, node: ast.AST, note: str) -> "Taint":
+        step = (getattr(node, "lineno", 0),
+                getattr(node, "col_offset", 0), note)
+        if self.steps and self.steps[-1][:2] == step[:2]:
+            return self  # same-line hop adds noise, not signal
+        return Taint(self.steps + (step,))
+
+
+def _merge_taint(a: Optional[Taint], b: Optional[Taint]
+                 ) -> Optional[Taint]:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a if len(a.steps) <= len(b.steps) else b
+
+
+#: dataflow state: variable path (``x`` / ``self.deadline``) -> Taint
+_State = Dict[str, Taint]
+
+#: callables whose RESULT carries their arguments' taint — value-
+#: preserving casts and aggregates. Arbitrary calls do NOT propagate
+#: argument taint to their result (``cur = self._exec(sql, (now,))``
+#: returns a cursor, not the timestamp); method calls on a tainted
+#: object still propagate through the function expression itself.
+_PASSTHROUGH = {"abs", "bool", "deepcopy", "dict", "float", "int",
+                "list", "max", "min", "round", "set", "sorted", "str",
+                "sum", "tuple"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class TaintEngine:
+    """Fixpoint taint propagation over one function's CFG.
+
+    ``source(node)`` returns a note when the expression node itself
+    introduces taint (e.g. a ``time.time()`` call); ``sanitizer(call)``
+    returns True when a call's result is clean regardless of its
+    arguments (the taint does not flow THROUGH it). After
+    :meth:`run`, :meth:`state_before` gives the state at any
+    statement and :meth:`eval` judges any expression in that state.
+    """
+
+    def __init__(self, cfg: CFG,
+                 source: Callable[[ast.AST], Optional[str]],
+                 sanitizer: Optional[Callable[[ast.Call], bool]] = None):
+        self.cfg = cfg
+        self.source = source
+        self.sanitizer = sanitizer or (lambda call: False)
+        self._before: Dict[int, _State] = {}  # id(stmt) -> state
+
+    # ---- expression evaluation ----
+
+    def eval(self, expr: Optional[ast.AST],
+             state: _State) -> Optional[Taint]:
+        if expr is None:
+            return None
+        note = self.source(expr)
+        if note:
+            return Taint(((expr.lineno, expr.col_offset, note),))
+        if isinstance(expr, ast.Call):
+            if self.sanitizer(expr):
+                return None
+            out = self.eval(expr.func, state)
+            name = (_dotted(expr.func) or "").rsplit(".", 1)[-1]
+            if name in _PASSTHROUGH:
+                for part in list(expr.args) + [
+                        kw.value for kw in expr.keywords]:
+                    out = _merge_taint(out, self.eval(part, state))
+            return out
+        if isinstance(expr, ast.Name):
+            return state.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            path = _dotted(expr)
+            if path is not None:
+                t = state.get(path)
+                if t is not None:
+                    return t
+            return self.eval(expr.value, state)
+        if isinstance(expr, ast.Lambda):
+            return None  # deferred body: not evaluated here
+        out = None
+        for child in ast.iter_child_nodes(expr):
+            out = _merge_taint(out, self.eval(child, state))
+        return out
+
+    # ---- statement transfer ----
+
+    def _assign(self, state: _State, target: ast.AST,
+                taint: Optional[Taint], node: ast.AST) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._assign(state, elt, taint, node)
+            return
+        if isinstance(target, ast.Starred):
+            target = target.value
+        if isinstance(target, ast.Subscript):
+            # d[k] = v: tainting the whole container would drown later
+            # membership/flag reads in noise — keyed sinks (deadline-
+            # named keys) are judged at the sink site instead
+            return
+        path = _dotted(target)
+        if path is None:
+            return
+        if taint is None:
+            state.pop(path, None)
+        else:
+            state[path] = taint.extend(
+                node, f"flows into '{path}'")
+
+    def transfer(self, stmt: ast.AST, state: _State) -> None:
+        if isinstance(stmt, ast.Assign):
+            t = self.eval(stmt.value, state)
+            for target in stmt.targets:
+                self._assign(state, target, t, stmt)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._assign(state, stmt.target,
+                         self.eval(stmt.value, state), stmt)
+        elif isinstance(stmt, ast.AugAssign):
+            t = self.eval(stmt.value, state)
+            path = _dotted(stmt.target)
+            if path is not None:
+                t = _merge_taint(t, state.get(path))
+                if t is not None:
+                    state[path] = t.extend(
+                        stmt, f"flows into '{path}'")
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._assign(state, stmt.target,
+                         self.eval(stmt.iter, state), stmt)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if item.optional_vars is not None:
+                    self._assign(state, item.optional_vars,
+                                 self.eval(item.context_expr, state),
+                                 stmt)
+        elif isinstance(stmt, ast.Delete):
+            for target in stmt.targets:
+                path = _dotted(target)
+                if path is not None:
+                    state.pop(path, None)
+
+    # ---- fixpoint ----
+
+    def run(self) -> "TaintEngine":
+        cfg = self.cfg
+        in_states: Dict[int, _State] = {cfg.entry.id: {}}
+        worklist = [cfg.entry]
+        while worklist:
+            block = worklist.pop(0)
+            state = dict(in_states.get(block.id, {}))
+            for stmt in block.stmts:
+                self.transfer(stmt, state)
+            for succ, _kind in block.succs:
+                if succ is cfg.exit:
+                    continue
+                prev = in_states.get(succ.id)
+                if prev is None:
+                    in_states[succ.id] = dict(state)
+                    worklist.append(succ)
+                    continue
+                grew = False
+                for var, taint in state.items():
+                    if var not in prev:
+                        prev[var] = taint
+                        grew = True
+                if grew and succ not in worklist:
+                    worklist.append(succ)
+        # final pass: record the state before every statement
+        for block in cfg.blocks:
+            state = dict(in_states.get(block.id, {}))
+            for stmt in block.stmts:
+                self._before[id(stmt)] = dict(state)
+                self.transfer(stmt, state)
+        return self
+
+    def state_before(self, stmt: ast.AST) -> _State:
+        return self._before.get(id(stmt), {})
+
+    def taint_at(self, expr: Optional[ast.AST],
+                 stmt: ast.AST) -> Optional[Taint]:
+        """Judge ``expr`` (part of ``stmt``) in the state before it."""
+        return self.eval(expr, self.state_before(stmt))
+
+
+def tainted_return_helpers(
+        tree: ast.Module,
+        source: Callable[[ast.AST], Optional[str]],
+        sanitizer: Optional[Callable[[ast.Call], bool]] = None
+) -> Dict[str, Taint]:
+    """Module-local helpers whose RETURN value is tainted — one level
+    of interprocedural reach (``def now(): return time.time()`` makes
+    ``now()`` call sites sources). Methods register both ``name`` and
+    ``self.name``."""
+    out: Dict[str, Taint] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        # a fixpoint per function is the expensive part — skip
+        # functions that return nothing or contain no source at all
+        if not any(isinstance(sub, ast.Return) and sub.value is not None
+                   for sub in ast.walk(node)):
+            continue
+        if not any(source(sub) for sub in ast.walk(node)):
+            continue
+        eng = TaintEngine(build_cfg(node), source, sanitizer).run()
+        for block in eng.cfg.blocks:
+            for stmt in block.stmts:
+                if not isinstance(stmt, ast.Return):
+                    continue
+                t = eng.taint_at(stmt.value, stmt)
+                if t is None:
+                    continue
+                t = t.extend(stmt, f"returned from '{node.name}'")
+                out[node.name] = _merge_taint(out.get(node.name), t)
+                out["self." + node.name] = out[node.name]
+    return out
+
+
+# --------------------------------------------------- FlowRule base
+
+class FlowRule:
+    """Base class for path-sensitive (CFG/dataflow) rules.
+
+    Like :class:`~rafiki_tpu.analysis.engine.Rule` but ``check``
+    yields ``(node, message, trace)`` triples, where ``trace`` is a
+    tuple of :class:`~rafiki_tpu.analysis.engine.TraceStep` rendering
+    the source→sink witness. ``sources``/``sinks``/``sanitizers`` are
+    one-line human descriptions (``lint --explain``); ``example`` is
+    a self-contained snippet the rule fires on, used to print an
+    example trace.
+    """
+
+    id: str = ""
+    category: str = ""
+    severity: str = "error"
+    description: str = ""
+    sources: Tuple[str, ...] = ()
+    sinks: Tuple[str, ...] = ()
+    sanitizers: Tuple[str, ...] = ()
+    example: str = ""
+
+    def check(self, ctx):  # pragma: no cover - interface
+        raise NotImplementedError
+        yield
+
+    # -- shared helpers --
+
+    @staticmethod
+    def trace_from_taint(taint: Taint,
+                         sink_node: ast.AST,
+                         sink_note: str) -> Tuple[TraceStep, ...]:
+        steps = [TraceStep(line, col, note)
+                 for line, col, note in taint.steps]
+        steps.append(TraceStep(sink_node.lineno,
+                               sink_node.col_offset, sink_note))
+        return tuple(steps)
+
+    @staticmethod
+    def trace_from_path(source_node: ast.AST, source_note: str,
+                        hit: PathHit) -> Tuple[TraceStep, ...]:
+        steps = [TraceStep(source_node.lineno,
+                           source_node.col_offset, source_note)]
+        for anchor, phrase in hit.steps:
+            steps.append(TraceStep(getattr(anchor, "lineno", 0),
+                                   getattr(anchor, "col_offset", 0),
+                                   phrase))
+        # collapse consecutive same-line steps
+        out: List[TraceStep] = []
+        for step in steps:
+            if out and (out[-1].line, out[-1].col) == (step.line,
+                                                       step.col):
+                out[-1] = step if step is steps[-1] else out[-1]
+                continue
+            out.append(step)
+        return tuple(out)
+
+
+def functions(ctx) -> Iterator[Tuple[ast.AST, CFG]]:
+    """Every function in the module with its (cached) CFG."""
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, ctx.cfg(node)
+
+
+def has_source(fn: ast.AST,
+               source: Callable[[ast.AST], Optional[str]]) -> bool:
+    """Does any node of this function introduce taint? A single AST
+    walk — taint rules call this before paying for a fixpoint, since
+    a function with no source cannot reach any sink."""
+    return any(source(sub) for sub in ast.walk(fn))
+
+
+_FLOW_REGISTRY: Dict[str, FlowRule] = {}
+
+
+def register_flow(cls):
+    """Class decorator adding a flow rule to the registry."""
+    if not cls.id:
+        raise ValueError(f"flow rule {cls.__name__} has no id")
+    if cls.severity not in SEVERITIES:
+        raise ValueError(f"rule {cls.id}: bad severity {cls.severity!r}")
+    if cls.id in _FLOW_REGISTRY:
+        raise ValueError(f"duplicate flow rule id {cls.id!r}")
+    _FLOW_REGISTRY[cls.id] = cls()
+    return cls
+
+
+def all_flow_rules() -> Dict[str, FlowRule]:
+    from . import rules  # noqa: F401 — import side effect registers
+
+    return dict(_FLOW_REGISTRY)
+
+
+def get_flow_rule(rule_id: str) -> FlowRule:
+    rules = all_flow_rules()
+    if rule_id not in rules:
+        raise KeyError(
+            f"unknown flow rule {rule_id!r} "
+            f"(known: {', '.join(sorted(rules))})")
+    return rules[rule_id]

@@ -36,8 +36,22 @@ SEVERITIES = ("error", "warning")
 
 
 @dataclasses.dataclass(frozen=True)
+class TraceStep:
+    """One hop of a flow finding's source→sink witness path."""
+
+    line: int
+    col: int
+    note: str
+
+
+@dataclasses.dataclass(frozen=True)
 class Finding:
-    """One diagnostic, pinned to a file location."""
+    """One diagnostic, pinned to a file location.
+
+    Flow-rule findings additionally carry ``trace`` — the witness
+    path from source to sink, rendered as indented steps in text
+    output and as ``codeFlows`` in SARIF.
+    """
 
     rule: str
     severity: str
@@ -45,10 +59,16 @@ class Finding:
     line: int
     col: int
     message: str
+    trace: tuple = ()
 
     def format(self) -> str:
-        return (f"{self.path}:{self.line}:{self.col}: "
+        head = (f"{self.path}:{self.line}:{self.col}: "
                 f"[{self.severity}] {self.rule}: {self.message}")
+        if not self.trace:
+            return head
+        steps = [f"    {i}. line {s.line}:{s.col + 1}: {s.note}"
+                 for i, s in enumerate(self.trace, 1)]
+        return "\n".join([head] + steps)
 
     def to_dict(self) -> Dict[str, object]:
         return dataclasses.asdict(self)
@@ -69,6 +89,11 @@ class ModuleContext:
                 self.parents[child] = node
         self._noqa = _collect_noqa(source)
         self._traced = None  # lazy; see traced()
+        self._cfgs: Dict[ast.AST, object] = {}  # lazy; see cfg()
+        #: scratch cache for rule-computed module facts (e.g. the jit
+        #: callables table both jit flow rules need) — keyed by the
+        #: computing module's own name, shared across rules
+        self.memo: Dict[str, object] = {}
 
     def traced(self):
         """The module's traced-function map
@@ -79,6 +104,16 @@ class ModuleContext:
 
             self._traced = traced_functions(self.tree)
         return self._traced
+
+    def cfg(self, fn: ast.AST):
+        """The function's control-flow graph
+        (:func:`rafiki_tpu.analysis.cfg.build_cfg`), built once and
+        shared by every flow rule."""
+        if fn not in self._cfgs:
+            from .cfg import build_cfg
+
+            self._cfgs[fn] = build_cfg(fn)
+        return self._cfgs[fn]
 
     def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
         cur = self.parents.get(node)
@@ -176,10 +211,29 @@ def get_rule(rule_id: str) -> Rule:
 
 
 def _resolve_rules(select: Optional[Sequence[str]]) -> List[Rule]:
+    """Module + flow rules, by id or all of them.
+
+    Flow rules (:mod:`.dataflow`) live in their own registry but run
+    in the same per-file pass — so ``--changed-only`` and fixture
+    isolation scope them exactly like per-module rules.
+    """
+    from .dataflow import all_flow_rules
+
     rules = all_rules()
+    flow_rules = all_flow_rules()
     if select is None:
-        return list(rules.values())
-    return [get_rule(r) for r in select]
+        return list(rules.values()) + list(flow_rules.values())
+    out = []
+    for rule_id in select:
+        if rule_id in rules:
+            out.append(rules[rule_id])
+        elif rule_id in flow_rules:
+            out.append(flow_rules[rule_id])
+        else:
+            known = sorted(set(rules) | set(flow_rules))
+            raise KeyError(f"unknown rule {rule_id!r} "
+                           f"(known: {', '.join(known)})")
+    return out
 
 
 def analyze_source(source: str, path: str = "<string>",
@@ -198,13 +252,17 @@ def analyze_source(source: str, path: str = "<string>",
                         f"could not parse: {e.msg}")]
     findings: List[Finding] = []
     for rule in _resolve_rules(select):
-        for node, message in rule.check(ctx):
+        for item in rule.check(ctx):
+            # module rules yield (node, message); flow rules yield
+            # (node, message, trace)
+            node, message = item[0], item[1]
+            trace = tuple(item[2]) if len(item) > 2 else ()
             line = getattr(node, "lineno", 1)
             col = getattr(node, "col_offset", 0)
             if not with_suppressed and ctx.suppressed(rule.id, line):
                 continue
             findings.append(Finding(rule.id, rule.severity, path,
-                                    line, col, message))
+                                    line, col, message, trace))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
@@ -235,6 +293,11 @@ def analyze_paths(paths: Iterable[str],
                   select: Optional[Sequence[str]] = None,
                   with_suppressed: bool = False) -> List[Finding]:
     """Run rules over files/trees; nonexistent paths raise ``OSError``."""
+    for path in paths:
+        # validate every argument BEFORE analyzing any: a typo'd CI
+        # argument must fail fast, not after a full-package pass
+        if not os.path.isfile(path) and not os.path.isdir(path):
+            raise OSError(f"no such file or directory: {path!r}")
     findings: List[Finding] = []
     seen = False
     for path in iter_python_files(paths):
@@ -279,10 +342,12 @@ def render_sarif(findings: Sequence[Finding]) -> str:
     def _describe(rule_id: str) -> None:
         if rule_id in rule_meta:
             return
+        from .dataflow import all_flow_rules
         from .project import all_project_rules
 
         rule = all_rules().get(rule_id) or \
-            all_project_rules().get(rule_id)
+            all_project_rules().get(rule_id) or \
+            all_flow_rules().get(rule_id)
         entry: Dict[str, object] = {"id": rule_id}
         if rule is not None:
             entry["shortDescription"] = {"text": rule.description}
@@ -298,19 +363,35 @@ def render_sarif(findings: Sequence[Finding]) -> str:
                 path = os.path.relpath(path)
             except ValueError:  # different drive (windows) — keep abs
                 pass
-        results.append({
+        uri = path.replace(os.sep, "/")
+        result: Dict[str, object] = {
             "ruleId": f.rule,
             "level": f.severity,  # SARIF levels include error/warning
             "message": {"text": f.message},
             "locations": [{
                 "physicalLocation": {
-                    "artifactLocation": {
-                        "uri": path.replace(os.sep, "/")},
+                    "artifactLocation": {"uri": uri},
                     "region": {"startLine": max(f.line, 1),
                                "startColumn": f.col + 1},
                 },
             }],
-        })
+        }
+        if f.trace:
+            # the witness path: codeFlows for flow-aware viewers,
+            # relatedLocations for everything else
+            step_locs = [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": uri},
+                    "region": {"startLine": max(s.line, 1),
+                               "startColumn": s.col + 1},
+                },
+                "message": {"text": s.note},
+            } for s in f.trace]
+            result["codeFlows"] = [{"threadFlows": [{
+                "locations": [{"location": loc} for loc in step_locs],
+            }]}]
+            result["relatedLocations"] = step_locs
+        results.append(result)
     return json.dumps({
         "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
                     "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),

@@ -21,8 +21,21 @@ Project-scope rules (``lint --project``), one module per contract:
   dashboard.
 - :mod:`project_budget` — budget-key / worker-config / docs parity.
 - :mod:`project_spans` — span streams that can never terminate.
+
+Flow-scope rules (path-sensitive, CFG + dataflow; see
+:mod:`rafiki_tpu.analysis.dataflow`), run in the per-file pass:
+
+- :mod:`flow_locks` — a manual ``.acquire()`` missing its release on
+  some path.
+- :mod:`flow_jit` — use-after-donate reads and runtime-varying values
+  in static jit args.
+- :mod:`flow_clock` — real wall-clock taint into deadlines (replaces
+  the name-heuristic ``wall-clock-deadline``).
+- :mod:`flow_wire` — wire-payload fields reaching config/paths/argv
+  without a registered validator.
 """
 
-from . import (concurrency, jax_tracing, observability,  # noqa: F401
+from . import (concurrency, flow_clock, flow_jit,  # noqa: F401
+               flow_locks, flow_wire, jax_tracing, observability,
                project_budget, project_hub, project_locks,
                project_metrics, project_spans, robustness, serving)

@@ -1,0 +1,194 @@
+"""Taint rule replacing the name-heuristic ``wall-clock-deadline``.
+
+The old per-module rule fired only when ``time.time()`` appeared
+*textually inside* a deadline assignment or comparison — it missed
+every flow through an intermediate variable (``now = time.time();
+deadline = now + ttl``) and through module-local helpers
+(``def _now(): return time.time()``). This version propagates real
+taint through assignments, arithmetic, and one level of local
+returns, so those flows are caught; and it knows the repo's two
+sanctioned laundering paths — ``time.monotonic`` conversions and
+ClockSkewEstimator-adjusted values — so the documented-fallback
+suppression list gets *shorter*, not longer.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Optional, Tuple
+
+from ..astutil import dotted
+from ..dataflow import (FlowRule, TaintEngine, functions, has_source,
+                        header_exprs, register_flow,
+                        tainted_return_helpers)
+
+_DEADLINE = re.compile(r"deadline|expir", re.IGNORECASE)
+
+
+def _wall_source(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Call) and not node.args and not node.keywords:
+        if dotted(node.func) in ("time.time", "time"):
+            return "wall-clock read (time.time())"
+    return None
+
+
+def _skew_sanitizer(call: ast.Call) -> bool:
+    """Calls that convert a wall-clock value into a safe one: the
+    monotonic-conversion helpers and anything on the ClockSkew path
+    (estimator methods, skew_adjust helpers)."""
+    name = (dotted(call.func) or "").lower()
+    return "skew" in name or "monotonic" in name
+
+
+def _deadline_target(target: ast.AST) -> bool:
+    if isinstance(target, ast.Name):
+        return bool(_DEADLINE.search(target.id))
+    if isinstance(target, ast.Attribute):
+        return bool(_DEADLINE.search(target.attr))
+    if isinstance(target, ast.Subscript):
+        sl = target.slice
+        return (isinstance(sl, ast.Constant)
+                and isinstance(sl.value, str)
+                and bool(_DEADLINE.search(sl.value)))
+    return False
+
+
+@register_flow
+class TaintWallClockFlowRule(FlowRule):
+    id = "taint-wall-clock-flow"
+    category = "robustness"
+    severity = "warning"
+    description = (
+        "wall-clock time.time() flows (through assignments, "
+        "arithmetic, or local helper returns) into a deadline/expiry "
+        "value or comparison: clock steps and cross-host skew shift "
+        "it silently — compute deadlines on time.monotonic(), or "
+        "ship relative ttl_s judged through ClockSkewEstimator")
+    sources = (
+        "time.time() / bare time() calls",
+        "calls to module-local helpers whose return value is "
+        "wall-clock tainted (one level of propagation)",
+    )
+    sinks = (
+        "assignments to deadline/expiry-named targets "
+        "(`deadline = ...`, `self.expiry = ...`, `d['deadline'] = ...`)",
+        "deadline/expiry-named dict keys and keyword arguments",
+        "ordering comparisons (< <= > >=) with a tainted operand — "
+        "a deadline test",
+    )
+    sanitizers = (
+        "any call whose dotted name contains 'monotonic' or 'skew' "
+        "(time.monotonic conversions, ClockSkewEstimator methods)",
+    )
+    example = (
+        "def enqueue(self, ttl_s):\n"
+        "    now = time.time()\n"
+        "    self.deadline = now + ttl_s   # tainted through 'now'\n")
+
+    _MSG = (
+        "wall-clock time.time() taints this {what}: a clock step or "
+        "cross-host skew shifts the deadline silently — compute it "
+        "on time.monotonic(), or ship relative ttl_s + sent_ts "
+        "judged through ClockSkewEstimator; suppress only the "
+        "documented wall-clock FALLBACK paths")
+
+    def check(self, ctx) -> Iterator[Tuple[ast.AST, str, tuple]]:
+        helpers = tainted_return_helpers(ctx.tree, _wall_source,
+                                         _skew_sanitizer)
+
+        def source(node: ast.AST) -> Optional[str]:
+            note = _wall_source(node)
+            if note:
+                return note
+            if isinstance(node, ast.Call):
+                name = dotted(node.func)
+                if name in helpers:
+                    return (f"wall-clock value returned by "
+                            f"'{name.rsplit('.', 1)[-1]}()'")
+            return None
+
+        # the skew estimator's own internals ARE the sanctioned
+        # laundering path — its raw wall-clock math is the point
+        skip = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef) and \
+                    "skew" in node.name.lower():
+                for sub in ast.walk(node):
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        skip.add(sub)
+
+        for fn, cfg in functions(ctx):
+            if fn in skip or not has_source(fn, source):
+                continue
+            eng = TaintEngine(cfg, source, _skew_sanitizer).run()
+            for block, idx, stmt in cfg.statements():
+                yield from self._check_stmt(eng, stmt)
+
+    def _check_stmt(self, eng, stmt):
+        state = eng.state_before(stmt)
+        # sink 1: deadline-named assignment targets
+        if isinstance(stmt, ast.Assign):
+            taint = eng.eval(stmt.value, state)
+            if taint is not None and any(_deadline_target(t)
+                                         for t in stmt.targets):
+                yield stmt, self._MSG.format(
+                    what="deadline assignment"), self.trace_from_taint(
+                        taint, stmt, "assigned to a deadline/expiry "
+                        "name here")
+        elif isinstance(stmt, ast.AugAssign):
+            taint = eng.eval(stmt.value, state)
+            if taint is not None and _deadline_target(stmt.target):
+                yield stmt, self._MSG.format(
+                    what="deadline assignment"), self.trace_from_taint(
+                        taint, stmt, "folded into a deadline/expiry "
+                        "name here")
+        for part in header_exprs(stmt):
+            for node in ast.walk(part):
+                # sink 2: ORDERING comparisons — a deadline test.
+                # Equality/membership/identity on a tainted value is
+                # not a deadline judgment (sentinel checks, `k in d`).
+                if isinstance(node, ast.Compare):
+                    if not any(isinstance(op, (ast.Lt, ast.LtE,
+                                               ast.Gt, ast.GtE))
+                               for op in node.ops):
+                        continue
+                    for side in [node.left, *node.comparators]:
+                        taint = eng.eval(side, state)
+                        if taint is not None:
+                            yield node, self._MSG.format(
+                                what="comparison (deadline test)"), \
+                                self.trace_from_taint(
+                                    taint, node, "compared here — a "
+                                    "wall-clock deadline test")
+                            break
+                # sink 3: deadline-named dict keys
+                elif isinstance(node, ast.Dict):
+                    for k, v in zip(node.keys, node.values):
+                        if (isinstance(k, ast.Constant)
+                                and isinstance(k.value, str)
+                                and _DEADLINE.search(k.value)
+                                and v is not None):
+                            taint = eng.eval(v, state)
+                            if taint is not None:
+                                yield v, self._MSG.format(
+                                    what=f"dict entry "
+                                    f"{k.value!r}"), \
+                                    self.trace_from_taint(
+                                        taint, v, f"stored under "
+                                        f"dict key {k.value!r} here")
+                # sink 4: deadline-named keyword arguments
+                elif isinstance(node, ast.Call):
+                    for kw in node.keywords:
+                        if kw.arg is not None and \
+                                _DEADLINE.search(kw.arg):
+                            taint = eng.eval(kw.value, state)
+                            if taint is not None:
+                                yield kw.value, self._MSG.format(
+                                    what=f"keyword argument "
+                                    f"'{kw.arg}'"), \
+                                    self.trace_from_taint(
+                                        taint, kw.value,
+                                        f"passed as keyword "
+                                        f"'{kw.arg}' here")

@@ -1,0 +1,344 @@
+"""Path/flow rules for compiled-call hazards.
+
+``use-after-donate``: ``donate_argnums``/``donate_argnames`` hands a
+buffer's storage to XLA — after the call the array is deleted, and
+reading it raises (or silently aliases under some backends). The safe
+idiom rebinds in the same statement (``params = step(params)``);
+anything else that can reach a later read of the donated name on SOME
+path is a bug only a path engine can see.
+
+``jit-recompile-hazard``: a value that varies at runtime (clock reads,
+``len()`` of mutable state, queue depths) flowing into a
+``static_argnums``/``static_argnames`` position of a compiled call
+recompiles on every new value — the process "works", 300ms slower per
+step, forever. Bucketing/rounding helpers sanitize: a bucketed size
+takes a handful of values, which is the whole point of buckets.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, Optional, Set, Tuple
+
+from ..astutil import (JIT_NAMES, _const_ints, _const_strs, dotted,
+                       param_names)
+from ..dataflow import (FlowRule, TaintEngine, functions, has_source,
+                        header_exprs, path_search, register_flow)
+
+
+@dataclasses.dataclass
+class _JitCallable:
+    """A name that, when called in this module, runs a compiled fn."""
+
+    params: list
+    static: Set[str]
+    donate_idx: Set[int]
+    donate_names: Set[str]
+    offset: int  # 1 when called bound (self.step(...)): arg i -> param i+1
+
+
+def _donation_kwargs(call: ast.Call) -> Tuple[Set[int], Set[str]]:
+    idxs: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            idxs |= _const_ints(kw.value)
+        elif kw.arg == "donate_argnames":
+            names |= _const_strs(kw.value)
+    return idxs, names
+
+
+def jit_callables(ctx) -> Dict[str, _JitCallable]:
+    """Map call-site spelling -> compiled-callable info.
+
+    Covers decorated defs (``@jax.jit`` / ``@partial(jax.jit, ...)``,
+    registered under ``name`` and ``self.name`` for methods) and
+    wrapper assignments (``step = jax.jit(fn, ...)``, registered under
+    the assign target, including ``self.step``). Memoized on the
+    module context — both jit flow rules ask for it.
+    """
+    cached = ctx.memo.get("jit_callables")
+    if cached is None:
+        cached = ctx.memo["jit_callables"] = _jit_callables(ctx)
+    return cached
+
+
+def _jit_callables(ctx) -> Dict[str, _JitCallable]:
+    out: Dict[str, _JitCallable] = {}
+    for fn, info in ctx.traced().items():
+        call = info.decorator if isinstance(info.decorator,
+                                            ast.Call) else None
+        d_idx, d_names = _donation_kwargs(call) if call else (set(),
+                                                              set())
+        if not (info.static_names or d_idx or d_names):
+            continue
+        params = param_names(fn)
+        entry = _JitCallable(params, set(info.static_names),
+                             d_idx, d_names, 0)
+        out.setdefault(fn.name, entry)
+        if params and params[0] in ("self", "cls"):
+            out.setdefault("self." + fn.name, dataclasses.replace(
+                entry, offset=1))
+    # wrapper assignments: step = jax.jit(fn, donate_argnums=(0,))
+    by_name = {n.name: n for n in ast.walk(ctx.tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and dotted(node.value.func) in JIT_NAMES
+                and node.value.args):
+            continue
+        target = node.value.args[0]
+        fn = by_name.get(target.id) if isinstance(target,
+                                                  ast.Name) else None
+        if fn is None:
+            continue
+        params = param_names(fn)
+        static: Set[str] = set()
+        for kw in node.value.keywords:
+            if kw.arg == "static_argnames":
+                static |= _const_strs(kw.value)
+            elif kw.arg == "static_argnums":
+                static |= {params[i] for i in _const_ints(kw.value)
+                           if 0 <= i < len(params)}
+        d_idx, d_names = _donation_kwargs(node.value)
+        if not (static or d_idx or d_names):
+            continue
+        for tgt in node.targets:
+            name = dotted(tgt)
+            if name is not None:
+                out.setdefault(name, _JitCallable(
+                    params, static, d_idx, d_names, 0))
+    return out
+
+
+def _var_path(node: ast.AST) -> Optional[str]:
+    """A donated argument we can track: a bare name or self-ish
+    attribute chain."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return dotted(node)
+    return None
+
+
+def _reads(stmt: ast.AST, path: str) -> bool:
+    for part in header_exprs(stmt):
+        for node in ast.walk(part):
+            if "." in path:
+                if isinstance(node, ast.Attribute) and \
+                        isinstance(node.ctx, ast.Load) and \
+                        dotted(node) == path:
+                    return True
+            elif isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load) and node.id == path:
+                return True
+    return False
+
+
+def _bind_targets(stmt: ast.AST):
+    if isinstance(stmt, ast.Assign):
+        return stmt.targets
+    if isinstance(stmt, ast.AnnAssign):
+        return [stmt.target]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.target]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [i.optional_vars for i in stmt.items if i.optional_vars]
+    return []
+
+
+def _rebinds(stmt: ast.AST, path: str) -> bool:
+    for target in _bind_targets(stmt):
+        for node in ast.walk(target):
+            if isinstance(getattr(node, "ctx", None), ast.Store) and \
+                    dotted(node) == path:
+                return True
+    return False
+
+
+@register_flow
+class UseAfterDonateRule(FlowRule):
+    id = "use-after-donate"
+    category = "jax"
+    severity = "error"
+    description = (
+        "a buffer passed at a donate_argnums/donate_argnames position "
+        "is read again on some later path: donation hands the storage "
+        "to XLA, so the read sees a deleted (or silently aliased) "
+        "array — rebind in the donating statement or drop the "
+        "donation")
+    sources = (
+        "an argument at a donated position of a jit'd call "
+        "(@jax.jit(donate_argnums=...) decorations and "
+        "`step = jax.jit(fn, donate_argnums=...)` wrappers)",
+    )
+    sinks = (
+        "any later read of that name on any path (including the next "
+        "loop iteration) before it is rebound",
+    )
+    sanitizers = (
+        "rebinding in the donating statement itself "
+        "(`params = step(params)`) or on every path before the read",
+    )
+    example = (
+        "def train_step(params, batch): ...\n"
+        "step = jax.jit(train_step, donate_argnums=(0,))\n"
+        "def loop(params, batches):\n"
+        "    for b in batches:\n"
+        "        loss = step(params, b)   # donates params...\n"
+        "        log(loss)                # ...but never rebinds it:\n"
+        "                                 # iteration 2 reads a freed "
+        "buffer\n")
+
+    def check(self, ctx) -> Iterator[Tuple[ast.AST, str, tuple]]:
+        table = jit_callables(ctx)
+        if not any(c.donate_idx or c.donate_names
+                   for c in table.values()):
+            return
+        for fn, cfg in functions(ctx):
+            for block, idx, stmt in cfg.statements():
+                for part in header_exprs(stmt):
+                    for call in ast.walk(part):
+                        if isinstance(call, ast.Call):
+                            yield from self._check_call(
+                                cfg, block, idx, stmt, call, table)
+
+    def _check_call(self, cfg, block, idx, stmt, call, table):
+        info = table.get(dotted(call.func) or "")
+        if info is None or not (info.donate_idx or info.donate_names):
+            return
+        donated = []
+        for i, arg in enumerate(call.args):
+            if (i + info.offset) in info.donate_idx:
+                donated.append(arg)
+            elif 0 <= i + info.offset < len(info.params) and \
+                    info.params[i + info.offset] in info.donate_names:
+                donated.append(arg)
+        for kw in call.keywords:
+            if kw.arg in info.donate_names:
+                donated.append(kw.value)
+        callee = dotted(call.func)
+        for arg in donated:
+            path = _var_path(arg)
+            if path is None or _rebinds(stmt, path):
+                continue  # `params = step(params)` — the safe idiom
+            hits = path_search(
+                cfg, block, idx + 1,
+                kill=lambda s, p=path: _rebinds(s, p),
+                hit=lambda s, p=path: (
+                    f"'{p}' read here — the buffer was already "
+                    f"donated" if _reads(s, p) else None))
+            for h in hits:
+                trace = self.trace_from_path(
+                    stmt, f"'{path}' donated to '{callee}' here", h)
+                yield stmt, (
+                    f"'{path}' is donated to '{callee}' but read "
+                    f"again at line {h.stmt.lineno} — donation frees "
+                    f"the buffer, so that read sees deleted (or "
+                    f"aliased) storage; rebind it in the donating "
+                    f"statement or drop the donation"), trace
+                break  # one witness per donated arg
+
+
+@register_flow
+class JitRecompileHazardRule(FlowRule):
+    id = "jit-recompile-hazard"
+    category = "jax"
+    severity = "warning"
+    description = (
+        "a runtime-varying value (clock read, len() of mutable state, "
+        "queue depth) flows into a static_argnums/static_argnames "
+        "position of a compiled call: every new value is a new cache "
+        "key, so the call silently recompiles per step — bucket or "
+        "round the value, or make the argument dynamic")
+    sources = (
+        "time.time()/time.monotonic()/time.perf_counter() reads",
+        "len() of a variable or attribute (mutable state)",
+        ".qsize()/.stats()/.depth() queue and stats reads",
+    )
+    sinks = (
+        "arguments at static positions of jit'd calls (resolved from "
+        "static_argnums/static_argnames on decorations and wrappers)",
+    )
+    sanitizers = (
+        "bucketing/rounding/padding helpers (any callable whose name "
+        "contains bucket/round/pad/align) — a bucketed value takes "
+        "few distinct values, which is what static args require",
+    )
+    example = (
+        "def decode(batch, max_len): ...\n"
+        "step = jax.jit(decode, static_argnames=('max_len',))\n"
+        "def serve(self, batch):\n"
+        "    n = len(self.pending)        # varies every call...\n"
+        "    return step(batch, max_len=n)  # ...recompiles every "
+        "call\n")
+
+    _CLOCKS = {"time.time", "time.monotonic", "time.perf_counter",
+               "time"}
+    _STATS_ATTRS = ("qsize", "stats", "depth", "llen", "approx_len")
+    _SANITIZE = ("bucket", "round", "pad", "align")
+
+    def _source(self, node: ast.AST) -> Optional[str]:
+        if not isinstance(node, ast.Call):
+            return None
+        name = dotted(node.func)
+        if name in self._CLOCKS and not node.args:
+            return f"runtime-varying clock read ({name}())"
+        if isinstance(node.func, ast.Name) and node.func.id == "len" \
+                and node.args and isinstance(
+                    node.args[0], (ast.Name, ast.Attribute)):
+            what = dotted(node.args[0]) or "state"
+            return f"len({what}) varies with runtime state"
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in self._STATS_ATTRS:
+            return f".{node.func.attr}() varies per call"
+        return None
+
+    def _sanitizer(self, call: ast.Call) -> bool:
+        name = (dotted(call.func) or "").rsplit(".", 1)[-1].lower()
+        return any(tok in name for tok in self._SANITIZE)
+
+    def check(self, ctx) -> Iterator[Tuple[ast.AST, str, tuple]]:
+        table = {name: info for name, info in jit_callables(ctx).items()
+                 if info.static}
+        if not table:
+            return
+        for fn, cfg in functions(ctx):
+            if not has_source(fn, self._source):
+                continue
+            eng = TaintEngine(cfg, self._source, self._sanitizer).run()
+            for block, idx, stmt in cfg.statements():
+                for part in header_exprs(stmt):
+                    for call in ast.walk(part):
+                        if isinstance(call, ast.Call):
+                            yield from self._check_call(
+                                eng, stmt, call, table)
+
+    def _check_call(self, eng, stmt, call, table):
+        info = table.get(dotted(call.func) or "")
+        if info is None:
+            return
+        callee = dotted(call.func)
+        judged = []
+        for i, arg in enumerate(call.args):
+            pos = i + info.offset
+            if 0 <= pos < len(info.params) and \
+                    info.params[pos] in info.static:
+                judged.append((info.params[pos], arg))
+        for kw in call.keywords:
+            if kw.arg in info.static:
+                judged.append((kw.arg, kw.value))
+        for pname, arg in judged:
+            taint = eng.taint_at(arg, stmt)
+            if taint is None:
+                continue
+            sink_note = (f"flows into static arg '{pname}' of "
+                         f"'{callee}' — new value => recompile")
+            yield arg, (
+                f"runtime-varying value flows into static arg "
+                f"'{pname}' of jit'd '{callee}': each distinct value "
+                f"recompiles the function silently — bucket/round it "
+                f"first, or drop it from static_argnums"), \
+                self.trace_from_taint(taint, arg, sink_note)

@@ -1,0 +1,263 @@
+"""Path-sensitive lock rule: a manual ``.acquire()`` whose matching
+``release()`` is missing on SOME path.
+
+The per-module concurrency rules check lock *placement*; this one
+checks lock *paths*. The bug class: code acquires a lock, releases it
+on the straight-line path, but an early ``return`` or a raising call
+between the two leaks the lock — every later waiter deadlocks. The
+fix is ``with lock:`` (exempt here by construction) or try/finally.
+
+The same acquire/release discipline governs the device-slot
+allocator (``self.allocator.acquire(timeout=...)`` hands out a slot
+HANDLE that must be released or handed to a live service), so the
+receiver pattern covers ``alloc*`` too. Handle semantics bring escape
+analysis: storing the handle (``slots.append(slot)``, ``self._slot =
+slot``, ``return slot``) transfers ownership and settles the
+obligation outright; passing it to a general call
+(``self._spawn(..., slot=slot)``) settles it only if the call
+COMPLETES — if the call raises before taking ownership, the handle
+leaks with the exception, which is exactly the path this rule walks.
+
+Arming: the plain forms arm only when the function releases the same
+receiver somewhere — an acquire with NO release at all is a wrapper
+method (``def lock(self): self._mu.acquire()``), a different (and
+intentional) shape. The guarded timeout form (``slot = a.acquire(
+timeout=...)`` + ``if slot is None:``) is self-arming: a function
+that handles acquisition failure is no wrapper.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Optional, Tuple
+
+from ..astutil import dotted
+from ..dataflow import (FlowRule, functions, header_exprs, path_search,
+                        register_flow)
+
+#: receiver names that plausibly denote a lock or an acquire/release-
+#: disciplined resource allocator
+_LOCKISH = re.compile(r"(?:^|_)(?:lock|mutex|sem|cond|cv|alloc)\w*$",
+                      re.IGNORECASE)
+
+#: collection stores that take ownership of a handle and cannot fail
+#: halfway through doing so
+_STORE_METHODS = {"add", "append", "appendleft", "insert", "push",
+                  "put", "put_nowait", "setdefault"}
+
+
+def _lock_recv(call: ast.Call, method: str) -> Optional[str]:
+    """``self._mu.acquire()`` -> ``self._mu`` when the receiver is
+    lock-ish and the method matches."""
+    if not isinstance(call.func, ast.Attribute) or \
+            call.func.attr != method:
+        return None
+    recv = dotted(call.func.value)
+    if recv is None:
+        return None
+    last = recv.rsplit(".", 1)[-1]
+    return recv if _LOCKISH.search(last) else None
+
+
+def _nonblocking(call: ast.Call) -> bool:
+    """acquire(blocking=False) / acquire(timeout=...) may NOT hold the
+    lock afterwards — only the guarded form knows."""
+    for kw in call.keywords:
+        if kw.arg in ("blocking", "timeout"):
+            return True
+    return bool(call.args)  # positional blocking/timeout
+
+
+def _releases(stmt: ast.AST, recv: str) -> bool:
+    for part in header_exprs(stmt):
+        for node in ast.walk(part):
+            if isinstance(node, ast.Call) and \
+                    _lock_recv(node, "release") == recv:
+                return True
+    return False
+
+
+def _mentions(node: ast.AST, var: str) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id == var and \
+                isinstance(sub.ctx, ast.Load):
+            return True
+    return False
+
+
+def _held_guard(test: ast.AST, var: str) -> Optional[str]:
+    """Which edge of ``if <test>:`` keeps the handle held.
+
+    ``if v is None:`` / ``if not v:`` -> held on "false";
+    ``if v is not None:`` / ``if v:`` -> held on "true"."""
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        test = test.operand
+        if isinstance(test, ast.Name) and test.id == var:
+            return "false"
+        return None
+    if isinstance(test, ast.Name) and test.id == var:
+        return "true"
+    if isinstance(test, ast.Compare) and len(test.ops) == 1 and \
+            isinstance(test.left, ast.Name) and test.left.id == var and \
+            isinstance(test.comparators[0], ast.Constant) and \
+            test.comparators[0].value is None:
+        if isinstance(test.ops[0], ast.Is):
+            return "false"
+        if isinstance(test.ops[0], ast.IsNot):
+            return "true"
+    return None
+
+
+def _settles(stmt: ast.AST, recv: str,
+             var: Optional[str]) -> Optional[str]:
+    """Does this statement settle the release obligation?
+
+    "hard" — settled even if the statement raises (release, or an
+    ownership store that cannot fail halfway). "soft" — settled only
+    on normal completion (handle passed to a general call that may
+    raise before taking ownership). None — still held.
+    """
+    if _releases(stmt, recv):
+        return "hard"
+    if var is None:
+        return None
+    verdict = None
+    for part in header_exprs(stmt):
+        for node in ast.walk(part):
+            if not (isinstance(node, ast.Call)
+                    and any(_mentions(a, var) for a in
+                            list(node.args)
+                            + [kw.value for kw in node.keywords])):
+                continue
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _STORE_METHODS:
+                return "hard"
+            verdict = "soft"
+    if verdict:
+        return verdict
+    # plain store / return / alias outside any call: ownership is
+    # visibly transferred and a store cannot fail halfway
+    if any(_mentions(part, var) for part in header_exprs(stmt)):
+        return "hard"
+    return None
+
+
+@register_flow
+class LockReleasePathRule(FlowRule):
+    id = "lock-release-path"
+    category = "concurrency"
+    severity = "error"
+    description = (
+        "a manual .acquire() misses its release() on some path "
+        "(early return / raising call): that path leaks the lock or "
+        "slot handle and every later waiter deadlocks or the slot is "
+        "gone until restart — use `with lock:`, widen the try/finally, "
+        "or release the handle before re-raising")
+    sources = (
+        "`lock.acquire()` as a statement (blocking acquire)",
+        "`ok = lock.acquire()` without blocking=/timeout= "
+        "(blocking acquire, held from the next statement)",
+        "`if lock.acquire(...):` / `if not lock.acquire(...):` "
+        "(held only on the succeeding branch)",
+        "`slot = alloc.acquire(timeout=...)` followed by a None/"
+        "falsy guard (handle held on the surviving branch)",
+    )
+    sinks = (
+        "any function exit (return / fall-through / propagating "
+        "exception) reached while the lock or handle is still held — "
+        "including a raise INSIDE the call the handle was being "
+        "passed to",
+    )
+    sanitizers = (
+        "`lock.release()` on the path (usually in a finally:)",
+        "`with lock:` blocks — never tracked, release is structural",
+        "storing/returning the handle (ownership transfer): "
+        "`slots.append(slot)`, `self._slot = slot`, `return slot`",
+    )
+    example = (
+        "def leak(self):\n"
+        "    self._lock.acquire()\n"
+        "    if self.closed:\n"
+        "        return          # <- exits with self._lock held\n"
+        "    self.work()\n"
+        "    self._lock.release()\n")
+
+    def check(self, ctx) -> Iterator[Tuple[ast.AST, str, tuple]]:
+        for fn, cfg in functions(ctx):
+            released = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    recv = _lock_recv(node, "release")
+                    if recv:
+                        released.add(recv)
+            for block, idx, stmt in cfg.statements():
+                for recv, var, start in self._acquires(
+                        block, idx, stmt, released):
+                    hits = path_search(
+                        cfg, start[0], start[1],
+                        kill=lambda s, r=recv, v=var: _settles(s, r, v),
+                        to_exit=True,
+                        exit_note=(f"the function can exit here with "
+                                   f"'{recv}' still held"),
+                        soft_exc_note=(
+                            f"if this call raises, the exception "
+                            f"leaves the function with the handle "
+                            f"from '{recv}' neither released nor "
+                            f"handed over"))
+                    for h in hits:
+                        trace = self.trace_from_path(
+                            stmt, f"'{recv}' acquired here", h)
+                        yield stmt, (
+                            f"'{recv}.acquire()' is not matched by a "
+                            f"release() on every path — the path "
+                            f"ending at line {h.stmt.lineno} leaks "
+                            f"it (use `with {recv}:`, a finally that "
+                            f"covers this path, or release before "
+                            f"re-raising)"), trace
+                        break  # one witness per acquire is enough
+
+    def _acquires(self, block, idx, stmt, released):
+        """Yield (receiver, handle var or None, held-start point)."""
+        # bare statement: lock.acquire()
+        if isinstance(stmt, ast.Expr) and \
+                isinstance(stmt.value, ast.Call):
+            recv = _lock_recv(stmt.value, "acquire")
+            if recv in released and not _nonblocking(stmt.value):
+                yield recv, None, (block, idx + 1)
+            return
+        if isinstance(stmt, ast.Assign) and \
+                isinstance(stmt.value, ast.Call) and \
+                len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            recv = _lock_recv(stmt.value, "acquire")
+            if recv is None:
+                return
+            var = stmt.targets[0].id
+            if not _nonblocking(stmt.value):
+                # ok = lock.acquire()  — blocking form always holds;
+                # result is a bool, not a handle: no escape tracking
+                if recv in released:
+                    yield recv, None, (block, idx + 1)
+                return
+            # slot = alloc.acquire(timeout=...) + guard: self-arming
+            if idx + 1 < len(block.stmts) and \
+                    isinstance(block.stmts[idx + 1], ast.If):
+                held_kind = _held_guard(block.stmts[idx + 1].test, var)
+                if held_kind is not None:
+                    for succ, kind in block.succs:
+                        if kind == held_kind:
+                            yield recv, var, (succ, 0)
+            return
+        # if lock.acquire(...):  /  if not lock.acquire(...):
+        if isinstance(stmt, ast.If):
+            test, held_kind = stmt.test, "true"
+            if isinstance(test, ast.UnaryOp) and \
+                    isinstance(test.op, ast.Not):
+                test, held_kind = test.operand, "false"
+            if isinstance(test, ast.Call):
+                recv = _lock_recv(test, "acquire")
+                if recv in released:
+                    for succ, kind in block.succs:
+                        if kind == held_kind:
+                            yield recv, None, (succ, 0)

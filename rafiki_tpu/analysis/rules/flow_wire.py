@@ -1,0 +1,198 @@
+"""Taint rule for wire-origin data reaching trusted sinks.
+
+Hub payloads and HTTP bodies are parsed into plain dicts; nothing in
+Python stops a field from flowing straight into an engine config, a
+file path, or a subprocess argv. The repo's contract is that wire
+fields pass a registered validator first (``normalize_slo``,
+``check_kv_blob``, ``validate_override_keys``, or any
+``validate_*``/``check_*``/``normalize_*``/``sanitize_*`` helper) —
+this rule taints field reads off wire-named payloads and
+``json.loads`` results and reports any flow that reaches a sink
+unwashed, with the full path in the finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Optional, Tuple
+
+from ..astutil import dotted
+from ..dataflow import (FlowRule, TaintEngine, functions, has_source,
+                        header_exprs, register_flow)
+
+#: variables that denote wire-origin data by repo naming convention
+_WIRE_NAME = re.compile(
+    r"(?:^|_)(?:payload|body|msg|frame|wire|packet|request)s?$",
+    re.IGNORECASE)
+
+#: the registered validators (docs/linting.md "registered validator"
+#: list) plus the conventional validator-shaped prefixes
+_VALIDATORS = {"normalize_slo", "check_kv_blob",
+               "validate_override_keys"}
+_VALIDATOR_PREFIX = ("validate_", "check_", "normalize_", "sanitize_",
+                     "parse_")
+#: numeric casts produce a value the sink can bound-check trivially
+_CAST_FUNCS = {"int", "float", "bool"}
+
+_CONFIG_TARGET = re.compile(r"(?:^|_)(?:config|cfg|options?)$",
+                            re.IGNORECASE)
+_SUBPROCESS = {"subprocess.run", "subprocess.Popen",
+               "subprocess.check_call", "subprocess.check_output",
+               "os.system", "os.execv", "os.execvp"}
+_PATH_FUNCS = {"open", "Path", "pathlib.Path", "os.remove",
+               "os.unlink", "os.makedirs", "shutil.rmtree"}
+
+
+def _wire_base(node: ast.AST) -> Optional[str]:
+    """The wire-named variable a subscript/.get chain hangs off."""
+    name = dotted(node)
+    if name is None:
+        return None
+    last = name.rsplit(".", 1)[-1]
+    return name if _WIRE_NAME.search(last) else None
+
+
+#: reads that mark a json.loads argument as wire-origin (vs a local
+#: config file, whose json.load/loads is trusted operator input)
+_RECV_ATTRS = {"read", "recv", "recv_bytes", "recv_json"}
+
+
+def _wire_read(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        return _wire_base(node) is not None
+    if isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute):
+        return node.func.attr in _RECV_ATTRS
+    return False
+
+
+def _wire_source(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Call):
+        name = dotted(node.func)
+        # json.loads of wire-named data or a socket/stream read; a
+        # plain json.load(f) of a local config file is NOT wire input
+        if name == "json.loads":
+            for arg in node.args:
+                if any(_wire_read(sub) for sub in ast.walk(arg)):
+                    return "wire payload parsed here (json.loads)"
+            return None
+        # payload.get("field")
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "get":
+            base = _wire_base(node.func.value)
+            if base:
+                return f"field read from wire payload '{base}'"
+        return None
+    if isinstance(node, ast.Subscript):
+        base = _wire_base(node.value)
+        if base:
+            return f"field read from wire payload '{base}'"
+    return None
+
+
+def _validator(call: ast.Call) -> bool:
+    name = (dotted(call.func) or "").rsplit(".", 1)[-1]
+    if name in _VALIDATORS or name in _CAST_FUNCS:
+        return True
+    return name.startswith(_VALIDATOR_PREFIX)
+
+
+@register_flow
+class UnvalidatedWireInputRule(FlowRule):
+    id = "unvalidated-wire-input"
+    category = "robustness"
+    severity = "error"
+    description = (
+        "a field read off a wire payload (hub message / HTTP body / "
+        "json.loads result) reaches engine or worker config, a file "
+        "path, or subprocess args without passing a registered "
+        "validator — wash it through normalize_slo / check_kv_blob / "
+        "validate_override_keys (or a validate_*/check_* helper) "
+        "first")
+    sources = (
+        "subscript or .get() reads off wire-named variables "
+        "(payload/body/msg/frame/wire/packet/request)",
+        "json.loads() of wire-named data or .read()/.recv() results "
+        "(json.load of a local config file is trusted)",
+    )
+    sinks = (
+        "subprocess.run/Popen/check_* and os.system/exec* arguments",
+        "open()/Path()/os.remove()-style file-path arguments",
+        "constructors named *Config/*Engine/*Worker/*Spec",
+        "assignments to config/cfg/options-named targets",
+    )
+    sanitizers = (
+        "registered validators: normalize_slo, check_kv_blob, "
+        "validate_override_keys",
+        "validate_*/check_*/normalize_*/sanitize_*/parse_* helpers",
+        "numeric casts (int/float/bool)",
+    )
+    example = (
+        "def on_override(self, payload):\n"
+        "    path = payload['snapshot_path']     # wire field\n"
+        "    subprocess.run(['cp', path, self.dir])  # unwashed argv\n")
+
+    _CTOR = re.compile(r"(?:Config|Engine|Worker|Spec)$")
+
+    def check(self, ctx) -> Iterator[Tuple[ast.AST, str, tuple]]:
+        for fn, cfg in functions(ctx):
+            if not has_source(fn, _wire_source):
+                continue
+            eng = TaintEngine(cfg, _wire_source, _validator).run()
+            for block, idx, stmt in cfg.statements():
+                yield from self._check_stmt(eng, stmt)
+
+    def _check_stmt(self, eng, stmt):
+        state = eng.state_before(stmt)
+        # sink: config-named assignment targets
+        if isinstance(stmt, ast.Assign):
+            targets = [t for t in stmt.targets
+                       if self._config_target(t)]
+            if targets:
+                taint = eng.eval(stmt.value, state)
+                if taint is not None:
+                    name = dotted(targets[0]) or "config"
+                    yield stmt, self._msg(f"config value '{name}'"), \
+                        self.trace_from_taint(
+                            taint, stmt,
+                            f"stored into config '{name}' here")
+        for part in header_exprs(stmt):
+            for node in ast.walk(part):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted(node.func) or ""
+                sink = None
+                if name in _SUBPROCESS:
+                    sink = f"subprocess args ({name})"
+                elif name in _PATH_FUNCS:
+                    sink = f"a file path ({name})"
+                elif self._CTOR.search(name.rsplit(".", 1)[-1]):
+                    sink = f"'{name}(...)' construction"
+                if sink is None:
+                    continue
+                for arg in list(node.args) + [kw.value for kw in
+                                              node.keywords]:
+                    taint = eng.eval(arg, state)
+                    if taint is not None:
+                        yield arg, self._msg(sink), \
+                            self.trace_from_taint(
+                                taint, arg, f"reaches {sink} here")
+                        break  # one finding per call is enough
+
+    @staticmethod
+    def _config_target(target: ast.AST) -> bool:
+        if isinstance(target, ast.Name):
+            return bool(_CONFIG_TARGET.search(target.id))
+        if isinstance(target, ast.Attribute):
+            return bool(_CONFIG_TARGET.search(target.attr))
+        return False
+
+    @staticmethod
+    def _msg(sink: str) -> str:
+        return (f"unvalidated wire-payload data reaches {sink}: a "
+                f"malformed or hostile field flows straight into a "
+                f"trusted surface — wash it through a registered "
+                f"validator (normalize_slo / check_kv_blob / "
+                f"validate_override_keys or a validate_*/check_* "
+                f"helper) first")

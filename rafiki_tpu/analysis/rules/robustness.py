@@ -12,7 +12,6 @@ degrades in whatever way the surrounding code happens to allow.
 from __future__ import annotations
 
 import ast
-import re
 from typing import Set
 
 from ..astutil import attr_depth, chain_root, dotted
@@ -92,83 +91,6 @@ class SilentExceptRule(Rule):
             if name in _BROAD:
                 return name
         return None
-
-
-@register
-class WallClockDeadlineRule(Rule):
-    id = "wall-clock-deadline"
-    category = "robustness"
-    severity = "warning"
-    description = (
-        "time.time()-based deadline arithmetic: wall clocks differ "
-        "across hosts and step under NTP/VM migration — deadlines "
-        "belong on time.monotonic(), or on the relative ttl_s + "
-        "ClockSkewEstimator path for cross-host expiry (the ADVICE r3 "
-        "skew bug silently dropped every fresh query this way)")
-
-    _DEADLINE = re.compile(r"deadline|expir", re.IGNORECASE)
-    _ARITH_MSG = (
-        "wall-clock deadline arithmetic (time.time() feeding a "
-        "deadline/expiry value): a clock step or cross-host skew "
-        "shifts the deadline silently — compute deadlines on "
-        "time.monotonic(), or ship a relative ttl_s + sent_ts pair "
-        "judged through ClockSkewEstimator; suppress only the "
-        "documented wall-clock FALLBACK paths")
-
-    def check(self, ctx):
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.Compare):
-                if any(self._wallclock(s)
-                       for s in [node.left, *node.comparators]):
-                    yield node, (
-                        "comparing wall-clock time.time() against a "
-                        "stamp is a deadline test that breaks under "
-                        "cross-host skew and clock steps — use "
-                        "time.monotonic() for local deadlines, or the "
-                        "relative ttl_s + ClockSkewEstimator path for "
-                        "cross-host expiry; suppress only the "
-                        "documented wall-clock FALLBACK paths")
-            elif isinstance(node, ast.Assign):
-                if self._wallclock(node.value) and any(
-                        self._deadline_name(t) for t in node.targets):
-                    yield node, self._ARITH_MSG
-            elif isinstance(node, ast.Dict):
-                for k, v in zip(node.keys, node.values):
-                    if (isinstance(k, ast.Constant)
-                            and isinstance(k.value, str)
-                            and self._DEADLINE.search(k.value)
-                            and v is not None and self._wallclock(v)):
-                        yield v, self._ARITH_MSG
-            elif isinstance(node, ast.keyword):
-                if (node.arg is not None
-                        and self._DEADLINE.search(node.arg)
-                        and self._wallclock(node.value)):
-                    yield node.value, self._ARITH_MSG
-
-    @staticmethod
-    def _wallclock(node: ast.AST) -> bool:
-        """Does this expression call the wall clock (``time.time()``,
-        or a bare ``time()`` from ``from time import time``)?"""
-        for n in ast.walk(node):
-            if isinstance(n, ast.Call) and not n.args and not n.keywords:
-                name = dotted(n.func)
-                if name in ("time.time", "time"):
-                    return True
-        return False
-
-    def _deadline_name(self, target: ast.AST) -> bool:
-        """Is the assignment target deadline-ish (``deadline = ...``,
-        ``self.expiry = ...``, ``d["deadline_ts"] = ...``)?"""
-        if isinstance(target, ast.Name):
-            return bool(self._DEADLINE.search(target.id))
-        if isinstance(target, ast.Attribute):
-            return bool(self._DEADLINE.search(target.attr))
-        if isinstance(target, ast.Subscript):
-            sl = target.slice
-            return (isinstance(sl, ast.Constant)
-                    and isinstance(sl.value, str)
-                    and bool(self._DEADLINE.search(sl.value)))
-        return False
 
 
 @register

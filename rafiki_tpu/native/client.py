@@ -28,6 +28,7 @@ Crash survival (two halves, both here):
 from __future__ import annotations
 
 import logging
+import os
 import shutil
 import socket
 import subprocess
@@ -58,16 +59,34 @@ _RETRYABLE = frozenset({
 #: buildable native artifacts and their sources (Makefile targets)
 _SOURCES = {"rafiki-kvd": "kv_server.cc", "librbpe.so": "bpe_encoder.cc"}
 
+#: sanitizer modes the Makefile knows (SANITIZE=...); instrumented
+#: artifacts get distinct names so they never shadow production ones
+_SANITIZERS = ("address", "thread", "undefined")
+
+
+def _artifact_name(target: str, sanitize: Optional[str]) -> str:
+    """``rafiki-kvd``+address -> ``rafiki-kvd-address``;
+    ``librbpe.so``+address -> ``librbpe-address.so``."""
+    if not sanitize:
+        return target
+    stem, dot, ext = target.partition(".")
+    return f"{stem}-{sanitize}{dot}{ext}"
+
 
 def ensure_built(force: bool = False,
-                 target: str = "rafiki-kvd") -> Path:
+                 target: str = "rafiki-kvd",
+                 sanitize: Optional[str] = None) -> Path:
     """Compile a native artifact if missing/stale; returns its path.
 
     Builds ONLY the named Makefile target (a broken sibling source
     must not disable this one), and the Makefile installs via
     temp-file + atomic rename so processes holding the old artifact
-    keep a valid inode."""
-    out = _NATIVE_DIR / "build" / target
+    keep a valid inode. ``sanitize`` selects an instrumented flavor
+    (``address``/``thread``/``undefined``) built under its own name."""
+    if sanitize is not None and sanitize not in _SANITIZERS:
+        raise ValueError(f"bad sanitize mode {sanitize!r} "
+                         f"({'|'.join(_SANITIZERS)})")
+    out = _NATIVE_DIR / "build" / _artifact_name(target, sanitize)
     src = _NATIVE_DIR / _SOURCES[target]
     if not force and out.exists() and \
             out.stat().st_mtime >= src.stat().st_mtime:
@@ -75,8 +94,10 @@ def ensure_built(force: bool = False,
     make = shutil.which("make")
     if make is None:
         raise RuntimeError(f"`make` not found; cannot build {target}")
-    subprocess.run([make, "-C", str(_NATIVE_DIR), str(out)], check=True,
-                   capture_output=True)
+    cmd = [make, "-C", str(_NATIVE_DIR), str(out)]
+    if sanitize:
+        cmd.append(f"SANITIZE={sanitize}")
+    subprocess.run(cmd, check=True, capture_output=True)
     return out
 
 
@@ -92,8 +113,13 @@ class KVServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  data_dir: Optional[str] = None,
                  fsync: Optional[str] = None,
-                 wal_rotate_bytes: Optional[int] = None) -> None:
-        binary = ensure_built()
+                 wal_rotate_bytes: Optional[int] = None,
+                 sanitize: Optional[str] = None) -> None:
+        # RAFIKI_KVD_SANITIZE lets a whole test run opt into an
+        # instrumented kvd without touching call sites
+        if sanitize is None:
+            sanitize = os.environ.get("RAFIKI_KVD_SANITIZE") or None
+        binary = ensure_built(sanitize=sanitize)
         cmd = [str(binary), "--host", host, "--port", str(port)]
         if data_dir:
             cmd += ["--data-dir", str(data_dir)]

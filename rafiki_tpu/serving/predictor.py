@@ -606,7 +606,7 @@ class Predictor:
         # ttl_s/sent_ts are the relative twin — workers prefer them,
         # judged against their own skew estimate (see worker._expired)
         payload = {"id": qid, "queries": _stack(queries),
-                   "deadline_ts": time.time() + timeout,  # rafiki: noqa[wall-clock-deadline] — legacy-worker fallback; ttl_s+sent_ts below is the sanctioned path
+                   "deadline_ts": time.time() + timeout,  # rafiki: noqa[taint-wall-clock-flow] — legacy-worker fallback; ttl_s+sent_ts below is the sanctioned path
                    "ttl_s": float(timeout), "sent_ts": time.time(),
                    "trace_id": tid, "slo": cls}
         if sampling:
@@ -996,7 +996,7 @@ class Predictor:
                 remaining = deadline - time.monotonic()
                 payload = {"id": qid, "queries": _stack(queries),
                            "stream": True,
-                           "deadline_ts": time.time() + remaining,  # rafiki: noqa[wall-clock-deadline] — legacy-worker fallback; ttl_s+sent_ts is the sanctioned path
+                           "deadline_ts": time.time() + remaining,  # rafiki: noqa[taint-wall-clock-flow] — legacy-worker fallback; ttl_s+sent_ts is the sanctioned path
                            "ttl_s": float(remaining),
                            "sent_ts": time.time(), "trace_id": tid,
                            "slo": cls}
@@ -1333,7 +1333,7 @@ class Predictor:
             pub = s.get("published_at")
             s["stale"] = bool(
                 isinstance(pub, (int, float))
-                and time.time() - float(pub) > budget)  # rafiki: noqa[wall-clock-deadline] — fallback for workers predating the monotonic uptime_s pair
+                and time.time() - float(pub) > budget)  # rafiki: noqa[taint-wall-clock-flow] — fallback for workers predating the monotonic uptime_s pair
         if s["stale"]:
             self.breakers.record_stale(wid)
         if "draining" in s:

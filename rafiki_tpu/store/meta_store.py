@@ -651,7 +651,7 @@ class MetaStore:
                         "generation": int(row["generation"]),
                         "took_over": False}
             held_ttl = float(row["ttl_s"] or 0) or ttl_s
-            if now - float(row["heartbeat_at"] or 0) <= held_ttl:
+            if now - float(row["heartbeat_at"] or 0) <= held_ttl:  # rafiki: noqa[taint-wall-clock-flow] — lease takeover must survive host reboots; monotonic resets to 0 on reboot and would fence takeover out forever
                 return None  # live other admin: fenced out
             gen = int(row["generation"]) + 1
             cur = self._exec(

@@ -1561,7 +1561,7 @@ def _expired(msg: dict, skew_s: float = EXPIRY_SKEW_TOLERANCE_S,
         return skew_est.elapsed_since(float(sent)) \
             > float(ttl) + TTL_EXPIRY_PAD_S
     ts = msg.get("deadline_ts")
-    return ts is not None and time.time() > float(ts) + skew_s  # rafiki: noqa[wall-clock-deadline] — the documented wall-clock FALLBACK (old payloads); ttl_s+skew_est above is the sanctioned path
+    return ts is not None and time.time() > float(ts) + skew_s  # rafiki: noqa[taint-wall-clock-flow] — the documented wall-clock FALLBACK (old payloads); ttl_s+skew_est above is the sanctioned path
 
 
 def _tristate(v: Any) -> Optional[bool]:

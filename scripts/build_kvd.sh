@@ -1,0 +1,30 @@
+#!/bin/sh
+# Build the rafiki-kvd data plane binary and the BPE shared object.
+#
+#   scripts/build_kvd.sh                              # optimized
+#   scripts/build_kvd.sh --sanitize=address           # ASan
+#   scripts/build_kvd.sh --sanitize=thread            # TSan
+#   scripts/build_kvd.sh --sanitize=undefined         # UBSan
+#
+# Sanitized artifacts get distinct names (rafiki-kvd-address,
+# librbpe-address.so) so they never shadow the production binary;
+# tests opt in per-process via KVServer(sanitize="address") or the
+# RAFIKI_KVD_SANITIZE environment variable.
+set -e
+cd "$(dirname "$0")/../rafiki_tpu/native"
+
+SANITIZE=""
+for arg in "$@"; do
+  case "$arg" in
+    --sanitize=address|--sanitize=thread|--sanitize=undefined)
+      SANITIZE="${arg#--sanitize=}" ;;
+    *)
+      echo "usage: $0 [--sanitize=address|thread|undefined]" >&2
+      exit 2 ;;
+  esac
+done
+
+if [ -n "$SANITIZE" ]; then
+  exec make all "SANITIZE=$SANITIZE"
+fi
+exec make all

@@ -2,11 +2,13 @@
 # Pre-commit lint gate. Install with:
 #   ln -s ../../scripts/precommit.sh .git/hooks/pre-commit
 #
-# Per-module rules run only on the files you changed (vs HEAD, plus
-# untracked files) so the hook stays fast on a big tree; the
-# whole-program rules always see the full package, because cross-layer
-# contracts (hub verb parity, lock ordering, metric catalogs) can be
-# broken by files you did NOT touch.
+# Per-module AND path-sensitive flow rules (lock-release-path,
+# use-after-donate, ...) run only on the files you changed (vs HEAD,
+# plus untracked files) so the hook stays fast on a big tree — flow
+# rules live in the same per-file pass, so --changed-only scopes them
+# for free. The whole-program rules always see the full package,
+# because cross-layer contracts (hub verb parity, lock ordering,
+# metric catalogs) can be broken by files you did NOT touch.
 set -e
 cd "$(dirname "$0")/.."
 exec python scripts/lint.py --changed-only HEAD --project rafiki_tpu

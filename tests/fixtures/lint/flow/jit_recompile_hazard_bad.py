@@ -1,0 +1,15 @@
+"""Positive fixture: runtime-varying value in a static jit arg."""
+
+import jax
+
+
+def decode(batch, max_len):
+    return batch
+
+
+step = jax.jit(decode, static_argnames=("max_len",))
+
+
+def serve(pending, batch):
+    n = len(pending)  # varies every call...
+    return step(batch, max_len=n)  # ...so every call recompiles

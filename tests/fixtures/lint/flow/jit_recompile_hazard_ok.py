@@ -1,0 +1,23 @@
+"""Negative fixture: bucketed sizes and constants are static-safe."""
+
+import jax
+
+
+def decode(batch, max_len):
+    return batch
+
+
+step = jax.jit(decode, static_argnames=("max_len",))
+
+
+def bucket_len(n):
+    return max(8, n)
+
+
+def serve(pending, batch):
+    n = bucket_len(len(pending))  # few distinct values by design
+    return step(batch, max_len=n)
+
+
+def serve_fixed(batch):
+    return step(batch, max_len=128)  # constant: one cache entry

@@ -1,0 +1,24 @@
+"""Positive fixture: acquire whose release is missing on SOME path."""
+
+
+def work():
+    pass
+
+
+def leak_on_early_return(lock, closed):
+    lock.acquire()
+    if closed:
+        return None  # exits with the lock held
+    work()
+    lock.release()
+    return True
+
+
+def leak_on_raising_spawn(alloc, mgr):
+    slot = alloc.acquire(timeout=0.0)
+    if slot is None:
+        return None
+    # if spawn raises before taking ownership, the slot handle is
+    # gone until restart — no try/except returns it to the pool
+    mgr.spawn(slot=slot)
+    return True

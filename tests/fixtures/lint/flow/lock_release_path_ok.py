@@ -1,0 +1,33 @@
+"""Negative fixture: every path releases or hands the handle over."""
+
+
+def work():
+    pass
+
+
+def balanced(lock, closed):
+    lock.acquire()
+    try:
+        if closed:
+            return None
+        work()
+    finally:
+        lock.release()
+
+
+def guarded_spawn(alloc, mgr, slots):
+    slot = alloc.acquire(timeout=0.5)
+    if slot is None:
+        return None
+    try:
+        mgr.spawn(slot=slot)
+    except Exception:
+        alloc.release(slot)  # return the slot to the pool
+        raise
+    slots.append(slot)  # ownership transferred to the registry
+    return slot
+
+
+def structural(lock):
+    with lock:  # release is structural — never tracked
+        work()

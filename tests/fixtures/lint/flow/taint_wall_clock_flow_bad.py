@@ -1,0 +1,24 @@
+"""Positive fixture: time.time() flowing into deadline values/tests."""
+
+import time
+
+
+def arm(ttl_s):
+    now = time.time()
+    deadline_ts = now + ttl_s  # tainted through the intermediate
+    return deadline_ts
+
+
+def scatter(req, ttl_s):
+    # wall-clock deadline stamped for another host to judge
+    req["deadline_ts"] = time.time() + ttl_s
+    return req
+
+
+def _now():
+    return time.time()
+
+
+def expired(deadline_ts):
+    # taint propagates through the module-local helper's return
+    return _now() > deadline_ts

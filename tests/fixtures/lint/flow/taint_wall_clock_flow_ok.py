@@ -14,7 +14,8 @@ def expired(now_mono, deadline_mono):
 
 def stamp():
     # stamping when something happened is not deadline arithmetic
-    return {"sent_ts": time.time(), "published_at": time.time()}
+    sent = time.time()
+    return {"sent_ts": sent, "published_at": sent}
 
 
 def elapsed(skew_est, sent_ts):

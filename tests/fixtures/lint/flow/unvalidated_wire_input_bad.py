@@ -1,0 +1,13 @@
+"""Positive fixture: wire fields reaching trusted sinks unwashed."""
+
+import subprocess
+
+
+def on_override(payload, dest):
+    path = payload["snapshot_path"]  # wire field
+    subprocess.run(["cp", path, dest])  # unwashed argv
+
+
+class Applier:
+    def apply(self, msg):
+        self.config = msg.get("overrides")  # straight into config

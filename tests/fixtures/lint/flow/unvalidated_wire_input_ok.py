@@ -1,0 +1,23 @@
+"""Negative fixture: validated, cast, or local-config flows."""
+
+import json
+import subprocess
+
+
+def on_override(payload, dest):
+    path = validate_snapshot_path(payload["snapshot_path"])
+    subprocess.run(["cp", path, dest])
+
+
+class Applier:
+    def apply(self, msg):
+        self.config = normalize_slo(msg.get("overrides"))
+
+    def set_shards(self, msg):
+        self.shards = int(msg.get("shards", 1))  # numeric cast
+
+
+def load_local_config(path):
+    # json.load of a local config file is trusted operator input
+    with open(path) as f:
+        return json.load(f)

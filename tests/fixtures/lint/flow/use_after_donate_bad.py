@@ -1,0 +1,18 @@
+"""Positive fixture: donated buffer read again on a later path."""
+
+import jax
+
+
+def train_step(params, batch):
+    return params
+
+
+step = jax.jit(train_step, donate_argnums=(0,))
+
+
+def loop(params, batches, log):
+    for b in batches:
+        # donates params but never rebinds it: iteration 2 passes a
+        # freed buffer back into the compiled call
+        loss = step(params, b)
+        log(loss)

@@ -1,0 +1,16 @@
+"""Negative fixture: rebinding in the donating statement is safe."""
+
+import jax
+
+
+def train_step(params, batch):
+    return params
+
+
+step = jax.jit(train_step, donate_argnums=(0,))
+
+
+def loop(params, batches):
+    for b in batches:
+        params = step(params, b)  # rebinds: the safe donation idiom
+    return params

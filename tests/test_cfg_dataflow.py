@@ -1,0 +1,375 @@
+"""Unit tests for the flow layer's machinery: per-function CFGs
+(``analysis/cfg.py``) and the dataflow engines (``analysis/
+dataflow.py``) — separate from the rule-level tests in test_lint.py
+so a rule regression and an engine regression point at different
+files.
+
+The scenarios are the ones single-instance finally modeling and naive
+taint lattices historically get wrong: a ``return`` routed through a
+``finally``, the false path that enters a finally normally and leaves
+on the exception continuation, nested try/finally unwinding, ``break``
+jumping out of a ``with``, and loop-carried taint.
+"""
+
+import ast
+import textwrap
+
+from rafiki_tpu.analysis.cfg import EDGE_NOTES, build_cfg
+from rafiki_tpu.analysis.dataflow import (TaintEngine, header_exprs,
+                                          path_search,
+                                          tainted_return_helpers)
+
+
+def _cfg(src):
+    tree = ast.parse(textwrap.dedent(src))
+    fn = next(n for n in ast.walk(tree)
+              if isinstance(n, ast.FunctionDef))
+    return build_cfg(fn)
+
+
+def _calls(stmt, method):
+    """Does this statement's header call ``<anything>.<method>()``?"""
+    for part in header_exprs(stmt):
+        for node in ast.walk(part):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == method:
+                return True
+    return False
+
+
+def _after(cfg, method):
+    """The (block, index) just past the first ``.<method>()`` call."""
+    for block, idx, stmt in cfg.statements():
+        if _calls(stmt, method):
+            return block, idx + 1
+    raise AssertionError(f"no .{method}() call in fixture")
+
+
+def _search_release(cfg, **kw):
+    block, idx = _after(cfg, "acquire")
+    return path_search(
+        cfg, block, idx,
+        kill=lambda s: "hard" if _calls(s, "release") else None,
+        to_exit=True, **kw)
+
+
+# ---- path_search: finally discipline ----
+
+def test_finally_covers_return_path():
+    cfg = _cfg("""
+        def f(lock):
+            lock.acquire()
+            try:
+                return work()
+            finally:
+                lock.release()
+    """)
+    assert _search_release(cfg) == [], (
+        "a finally release covers both the return and the exception "
+        "route out of the protected region")
+
+
+def test_early_return_without_finally_is_a_leak():
+    cfg = _cfg("""
+        def f(lock, closed):
+            lock.acquire()
+            if closed:
+                return None
+            lock.release()
+    """)
+    hits = _search_release(cfg)
+    assert len(hits) == 1
+    notes = [note for _, note in hits[0].steps]
+    assert EDGE_NOTES["true"] in notes, (
+        "the witness must show the branch decision that reaches the "
+        "leaking return")
+
+
+def test_normal_entry_cannot_leave_finally_on_exception_continuation():
+    """The classic false path of single-instance finally modeling: the
+    body cannot raise, so the only route through the finally is the
+    normal one — the exception continuation out of the SAME finally
+    block must not be taken."""
+    cfg = _cfg("""
+        def f(lock, flag):
+            lock.acquire()
+            try:
+                x = flag
+            finally:
+                note()
+            lock.release()
+    """)
+    assert _search_release(cfg) == [], (
+        "kind-matched fin: continuations must stop a normally-entered "
+        "path from exiting on the raise continuation")
+
+
+def test_nested_finally_unwinds_to_outer_release():
+    cfg = _cfg("""
+        def f(lock):
+            lock.acquire()
+            try:
+                try:
+                    step()
+                finally:
+                    inner_cleanup()
+            finally:
+                lock.release()
+            tail()
+    """)
+    assert _search_release(cfg) == [], (
+        "an exception from step() unwinds inner finally -> outer "
+        "finally, where the release settles it")
+
+
+def test_nested_finally_without_release_reports_the_exception_path():
+    cfg = _cfg("""
+        def f(lock):
+            lock.acquire()
+            try:
+                try:
+                    step()
+                finally:
+                    inner_cleanup()
+            finally:
+                log()
+            lock.release()
+    """)
+    hits = _search_release(cfg)
+    assert hits, "the unwinding exception skips the final release"
+    notes = [note for _, note in hits[0].steps]
+    assert EDGE_NOTES["exc"] in notes
+
+
+def test_return_inside_finally_overrides_pending_continuation():
+    """CPython semantics: a return in the finally wins over the try
+    body's return — the function exits AT the finally's return."""
+    cfg = _cfg("""
+        def f():
+            try:
+                return 1
+            finally:
+                return 2
+    """)
+    hits = path_search(cfg, cfg.entry, 0, kill=lambda s: None,
+                       to_exit=True)
+    assert len(hits) == 1
+    exit_stmt = hits[0].stmt
+    assert isinstance(exit_stmt, ast.Return)
+    assert exit_stmt.value.value == 2
+
+
+def test_break_out_of_with_leaks_past_the_release():
+    """break inside a with jumps straight past the release at the
+    bottom of the loop body — a with block is NOT a finally."""
+    cfg = _cfg("""
+        def f(lock, jobs, guard):
+            for j in jobs:
+                lock.acquire()
+                with guard:
+                    if j:
+                        break
+                lock.release()
+            done()
+    """)
+    hits = _search_release(cfg)
+    assert len(hits) == 1
+    notes = [note for _, note in hits[0].steps]
+    assert EDGE_NOTES["break"] in notes
+
+
+def test_exception_reaches_handler_where_release_settles():
+    cfg = _cfg("""
+        def f(lock):
+            lock.acquire()
+            try:
+                step()
+            except ValueError:
+                lock.release()
+                raise
+            lock.release()
+    """)
+    assert _search_release(cfg) == []
+
+
+# ---- path_search: soft kills ----
+
+def test_soft_kill_reports_the_raise_inside_the_settling_call():
+    cfg = _cfg("""
+        def f(alloc, mgr):
+            slot = alloc.acquire()
+            mgr.spawn(slot)
+            tail()
+    """)
+    block, idx = _after(cfg, "acquire")
+    hits = path_search(
+        cfg, block, idx,
+        kill=lambda s: "soft" if _calls(s, "spawn") else None,
+        to_exit=True, soft_exc_note="LEAK")
+    assert [h.note for h in hits] == ["LEAK"]
+    assert _calls(hits[0].stmt, "spawn")
+
+
+def test_soft_kill_with_guarding_handler_is_settled():
+    cfg = _cfg("""
+        def f(alloc, mgr):
+            slot = alloc.acquire()
+            try:
+                mgr.spawn(slot)
+            except Exception:
+                alloc.release(slot)
+                raise
+            tail()
+    """)
+    block, idx = _after(cfg, "acquire")
+    hits = path_search(
+        cfg, block, idx,
+        kill=lambda s: ("hard" if _calls(s, "release")
+                        else "soft" if _calls(s, "spawn") else None),
+        to_exit=True, soft_exc_note="LEAK")
+    assert hits == [], (
+        "the except handler releases the handle before re-raising — "
+        "the soft kill's exception path is covered")
+
+
+# ---- TaintEngine ----
+
+def _wall_source(node):
+    if isinstance(node, ast.Call) and isinstance(node.func,
+                                                 ast.Attribute):
+        if node.func.attr == "time":
+            return "wall-clock read"
+    return None
+
+
+def _taint_engine(src, sanitizer=None):
+    tree = ast.parse(textwrap.dedent(src))
+    fn = next(n for n in ast.walk(tree)
+              if isinstance(n, ast.FunctionDef))
+    cfg = build_cfg(fn)
+    return TaintEngine(cfg, _wall_source, sanitizer).run(), cfg
+
+
+def _sink_arg(cfg, name="sink"):
+    """The (stmt, first-arg node) of the ``sink(...)`` call."""
+    for block, idx, stmt in cfg.statements():
+        for part in header_exprs(stmt):
+            for node in ast.walk(part):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Name) and \
+                        node.func.id == name:
+                    return stmt, node.args[0]
+    raise AssertionError(f"no {name}() call in fixture")
+
+
+def test_loop_carried_taint_reaches_the_previous_iterations_read():
+    eng, cfg = _taint_engine("""
+        def f(jobs):
+            prev = None
+            for j in jobs:
+                sink(prev)
+                prev = time.time()
+    """)
+    stmt, arg = _sink_arg(cfg)
+    taint = eng.taint_at(arg, stmt)
+    assert taint is not None, (
+        "iteration 2 reads the taint assigned in iteration 1 — the "
+        "fixpoint must carry it around the back edge")
+    assert taint.steps[0][2] == "wall-clock read"
+    assert any("prev" in note for _, _, note in taint.steps)
+
+
+def test_rebinding_to_a_clean_value_kills_taint():
+    eng, cfg = _taint_engine("""
+        def f():
+            t = time.time()
+            t = 0
+            sink(t)
+    """)
+    stmt, arg = _sink_arg(cfg)
+    assert eng.taint_at(arg, stmt) is None
+
+
+def test_sanitizer_call_cuts_the_flow():
+    def wash(call):
+        return isinstance(call.func, ast.Name) and \
+            call.func.id == "wash"
+
+    eng, cfg = _taint_engine("""
+        def f():
+            t = time.time()
+            d = wash(t)
+            sink(d)
+    """, sanitizer=wash)
+    stmt, arg = _sink_arg(cfg)
+    assert eng.taint_at(arg, stmt) is None
+
+
+def test_taint_on_one_branch_survives_the_merge():
+    """May-analysis: taint reaching the join point on EITHER branch
+    taints the join — one hostile path is enough for a finding."""
+    eng, cfg = _taint_engine("""
+        def f(c):
+            if c:
+                t = time.time()
+            else:
+                t = 0
+            sink(t)
+    """)
+    stmt, arg = _sink_arg(cfg)
+    assert eng.taint_at(arg, stmt) is not None
+
+
+def test_arbitrary_call_does_not_launder_nor_propagate_args():
+    """A general call's RESULT does not carry its arguments' taint
+    (it returns a cursor, not the timestamp) — but value-preserving
+    casts do."""
+    eng, cfg = _taint_engine("""
+        def f(db):
+            t = time.time()
+            cur = db.execute(t)
+            sink(cur)
+    """)
+    stmt, arg = _sink_arg(cfg)
+    assert eng.taint_at(arg, stmt) is None
+
+    eng, cfg = _taint_engine("""
+        def f():
+            t = time.time()
+            v = float(t)
+            sink(v)
+    """)
+    stmt, arg = _sink_arg(cfg)
+    assert eng.taint_at(arg, stmt) is not None
+
+
+def test_tainted_return_helpers_one_level_of_interprocedural_reach():
+    tree = ast.parse(textwrap.dedent("""
+        def _now():
+            return time.time()
+
+        def fixed():
+            return 42
+    """))
+    helpers = tainted_return_helpers(tree, _wall_source)
+    assert "_now" in helpers and "self._now" in helpers
+    assert "fixed" not in helpers
+    assert helpers["_now"].steps[0][2] == "wall-clock read"
+
+
+# ---- header_exprs ----
+
+def test_header_exprs_sees_headers_not_bodies():
+    tree = ast.parse(textwrap.dedent("""
+        if cond():
+            body_call()
+    """))
+    if_stmt = tree.body[0]
+    parts = header_exprs(if_stmt)
+    names = {n.func.id for p in parts for n in ast.walk(p)
+             if isinstance(n, ast.Call)
+             and isinstance(n.func, ast.Name)}
+    assert names == {"cond"}, (
+        "a compound statement evaluates only its header at its CFG "
+        "position — the body belongs to other blocks")

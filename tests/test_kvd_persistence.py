@@ -9,8 +9,10 @@ acceptance drill — live in tests/test_hub_reconnect.py.
 """
 
 import os
+import random
 import signal
 import struct
+import subprocess
 import time
 import zlib
 from pathlib import Path
@@ -345,3 +347,91 @@ def test_scanner_empty_dir_not_ok(tmp_path):
     assert not rep["ok"]
     assert any("cold-start" in f for f in rep["findings"])
     assert Path(rep["data_dir"]).name == "empty"
+
+
+# ---------------------------------------- seeded corruption fuzzing
+
+def _healthy_wal(tmp_path) -> bytes:
+    """A WAL exercising every record shape (SET, pushes, a logged
+    pop, a dedup push, INCR-as-SET)."""
+    s = _boot(tmp_path / "seed")
+    c = KVClient(s.host, s.port)
+    c.set("k1", b"A" * 40)
+    c.rpush("q", b"m1", b"m2", b"m3")
+    assert c.lpop("q") == b"m1"
+    c.lpush_dedup("q", "id-1", b"m0")
+    c.incr("ctr")
+    c.shutdown()
+    s._proc.wait(timeout=5)
+    return (tmp_path / "seed" / "wal").read_bytes()
+
+
+def test_wal_corruption_fuzz_scanner_agrees_with_server(tmp_path):
+    """Seeded fuzz: random truncations and single-bit flips over a
+    healthy WAL. On EVERY mutant the Python dry-run scanner and the
+    C++ boot must reach the same verdict — a scanner-ok mutant boots
+    and serves, a scanner-corrupt mutant refuses with the structured
+    rc=4 error. Divergence means an operator preflight blesses a WAL
+    the server then rejects (or, worse, the reverse)."""
+    good = _healthy_wal(tmp_path)
+    rng = random.Random(0xC0FFEE)
+    mutants = [("trunc", good[:rng.randrange(len(good))])
+               for _ in range(10)]
+    for _ in range(14):
+        data = bytearray(good)
+        data[rng.randrange(len(data))] ^= 1 << rng.randrange(8)
+        mutants.append(("flip", bytes(data)))
+    for i, (kind, data) in enumerate(mutants):
+        dd = tmp_path / f"m{i}"
+        dd.mkdir()
+        (dd / "wal").write_bytes(data)
+        rep = kvwal.dry_run_replay(str(dd))
+        try:
+            s = KVServer(data_dir=str(dd))
+        except RuntimeError as e:
+            # refusals must be the structured exit-4 path, never a
+            # crash or a hang
+            assert "rc=4" in str(e), (i, kind, str(e))
+            server_ok = False
+        else:
+            server_ok = True
+            s.stop()
+        assert rep["ok"] == server_ok, (
+            i, kind, rep["findings"], server_ok)
+
+
+# ----------------------------------- sanitizer builds (slow tier)
+
+@pytest.fixture(scope="module")
+def asan_kvd():
+    """Build + boot-check an address-sanitized kvd, or skip cleanly
+    where the toolchain/runtime can't produce or run one."""
+    try:
+        ensure_built(sanitize="address")
+    except (RuntimeError, OSError,
+            subprocess.CalledProcessError) as e:
+        pytest.skip(f"ASan build unavailable: {e}")
+    try:
+        s = KVServer(sanitize="address")
+    except (RuntimeError, OSError) as e:
+        pytest.skip(f"ASan kvd cannot run here: {e}")
+    s.stop()
+    return "address"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("case", [
+    test_graceful_restart_restores_state,
+    test_kill9_restart_restores_state_without_fsync,
+    test_torn_tail_truncated_loudly_and_served,
+    test_crc_corrupt_record_refuses_boot,
+    test_dedup_push_within_and_across_restart,
+], ids=lambda case: case.__name__)
+def test_asan_rerun_core_cases(tmp_path, asan_kvd, case, monkeypatch):
+    """The WAL-persistence core cases again, against an
+    AddressSanitizer-instrumented kvd: replay, torn-tail truncation,
+    and CRC refusal are exactly the buffer-math paths ASan watches.
+    Runs through the RAFIKI_KVD_SANITIZE env hook so every KVServer
+    the case spawns is instrumented."""
+    monkeypatch.setenv("RAFIKI_KVD_SANITIZE", "address")
+    case(tmp_path)
