@@ -65,7 +65,8 @@ def add_lint_arguments(parser: argparse.ArgumentParser) -> None:
     parser.add_argument(
         "--list-rules", action="store_true",
         help="print the registered rules and exit (flow rules are "
-             "tagged [flow:...], project rules [project:...])")
+             "tagged [flow:...], project rules [project:...], "
+             "thread-model rules [threads:...])")
     parser.add_argument(
         "--explain", default=None, metavar="RULE",
         help="print one rule's full story — description, and for "
@@ -137,12 +138,15 @@ def _explain(rule_id: str) -> int:
     """Print one rule's full story; exit 0, or 2 on an unknown id."""
     module_rules, project_rules = all_rules(), all_project_rules()
     flow_rules = all_flow_rules()
+    is_project = False
     if rule_id in flow_rules:
         rule, tag = flow_rules[rule_id], "flow"
     elif rule_id in module_rules:
         rule, tag = module_rules[rule_id], "module"
     elif rule_id in project_rules:
-        rule, tag = project_rules[rule_id], "project"
+        rule = project_rules[rule_id]
+        tag = getattr(rule, "layer", "project")
+        is_project = True
     else:
         known = set(module_rules) | set(project_rules) | set(flow_rules)
         print(f"rafiki-tpu lint: unknown rule {rule_id!r} "
@@ -163,13 +167,55 @@ def _explain(rule_id: str) -> int:
         print("  example:")
         for line in example.rstrip("\n").splitlines():
             print(f"    | {line}")
-        findings = analyze_source(example, path="<example>",
-                                  select=[rule_id])
+        if is_project:
+            findings = _explain_project_example(rule_id, rule, example)
+        else:
+            findings = analyze_source(example, path="<example>",
+                                      select=[rule_id])
         if findings:
             print("  which the rule reports as:")
             for line in findings[0].format().splitlines():
                 print(f"    {line}")
     return 0
+
+
+def _explain_project_example(rule_id: str, rule, example: str):
+    """Lint a project rule's example as a one-module mini-project;
+    for thread-layer rules, first print the thread model the example
+    discovers — the roots are half the story of a race finding."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        pkg = os.path.join(td, "example")
+        os.makedirs(pkg)
+        with open(os.path.join(pkg, "app.py"), "w") as f:
+            f.write(example)
+        if getattr(rule, "layer", "") == "threads":
+            from .project import ProjectContext
+            from .threads import ThreadModel
+
+            model = ThreadModel(ProjectContext([pkg]))
+            if model.roots:
+                print("  thread model:")
+                for root in model.roots:
+                    extra = " multi-instance" if root.multi else ""
+                    extra += " daemon" if root.daemon else ""
+                    print(f"    - [{root.label}] runs "
+                          f"'{root.target.rsplit(':', 1)[-1]}', "
+                          f"spawned at line {root.line}"
+                          f"{extra}")
+        findings = analyze_project([pkg], select=[rule_id])
+    # strip the tempdir from rendered paths so the output is stable
+    return [f.__class__(f.rule, f.severity,
+                        os.path.basename(f.path), f.line, f.col,
+                        f.message, f.trace, tuple(
+                            (label, tuple(
+                                s.__class__(s.line, s.col, s.note,
+                                            os.path.basename(s.path)
+                                            if s.path else "")
+                                for s in steps))
+                            for label, steps in f.threads))
+            for f in findings]
 
 
 def run_lint(args: argparse.Namespace) -> int:
@@ -183,7 +229,8 @@ def run_lint(args: argparse.Namespace) -> int:
             print(f"{rule_id} [flow:{rule.category}/{rule.severity}]"
                   f"\n    {rule.description}")
         for rule_id, rule in sorted(all_project_rules().items()):
-            print(f"{rule_id} [project:{rule.category}/{rule.severity}]"
+            tag = getattr(rule, "layer", "project")
+            print(f"{rule_id} [{tag}:{rule.category}/{rule.severity}]"
                   f"\n    {rule.description}")
         return 0
     try:

@@ -34,14 +34,39 @@ _NOQA_RE = re.compile(r"#\s*rafiki:\s*noqa(?:\[([^\]]*)\]|(?![\w\[-]))")
 
 SEVERITIES = ("error", "warning")
 
+#: retired rule id -> the successor ids a legacy suppression still
+#: covers. PR 18 replaced the per-module Eraser-vote rules with the
+#: interprocedural race detector; every ``# rafiki: noqa[...]`` written
+#: against the old ids keeps suppressing the new rules on its line —
+#: a rename must never silently turn a documented suppression into
+#: a no-op (or the suppressed line into a CI failure).
+RULE_ALIASES: Dict[str, tuple] = {
+    "inconsistent-lock": ("shared-state-race", "atomic-rmw-race"),
+    "thread-unlocked-global": ("shared-state-race", "atomic-rmw-race"),
+}
+
+
+def suppression_matches(rule_id: str, ids: frozenset) -> bool:
+    """Does a ``noqa[ids]`` set silence ``rule_id``? Empty = blanket;
+    retired ids silence their :data:`RULE_ALIASES` successors."""
+    if not ids or rule_id in ids:
+        return True
+    return any(rule_id in RULE_ALIASES.get(old, ()) for old in ids)
+
 
 @dataclasses.dataclass(frozen=True)
 class TraceStep:
-    """One hop of a flow finding's source→sink witness path."""
+    """One hop of a flow finding's source→sink witness path.
+
+    ``path`` is empty for single-file flow traces (the finding's own
+    file is implied); project-scope thread traces set it because a
+    call chain crosses modules.
+    """
 
     line: int
     col: int
     note: str
+    path: str = ""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -50,7 +75,10 @@ class Finding:
 
     Flow-rule findings additionally carry ``trace`` — the witness
     path from source to sink, rendered as indented steps in text
-    output and as ``codeFlows`` in SARIF.
+    output and as ``codeFlows`` in SARIF. Race findings instead carry
+    ``threads``: ``(label, steps)`` pairs, one stack per thread
+    context, rendered as paired traces in text and as two
+    ``threadFlows`` inside one ``codeFlow`` in SARIF.
     """
 
     rule: str
@@ -60,15 +88,21 @@ class Finding:
     col: int
     message: str
     trace: tuple = ()
+    threads: tuple = ()
 
     def format(self) -> str:
         head = (f"{self.path}:{self.line}:{self.col}: "
                 f"[{self.severity}] {self.rule}: {self.message}")
-        if not self.trace:
-            return head
-        steps = [f"    {i}. line {s.line}:{s.col + 1}: {s.note}"
-                 for i, s in enumerate(self.trace, 1)]
-        return "\n".join([head] + steps)
+        lines = [head]
+        lines += [f"    {i}. line {s.line}:{s.col + 1}: {s.note}"
+                  for i, s in enumerate(self.trace, 1)]
+        for label, steps in self.threads:
+            lines.append(f"    thread [{label}]:")
+            for i, s in enumerate(steps, 1):
+                where = (f"{s.path}:{s.line}" if s.path
+                         else f"line {s.line}")
+                lines.append(f"      {i}. {where}: {s.note}")
+        return "\n".join(lines)
 
     def to_dict(self) -> Dict[str, object]:
         return dataclasses.asdict(self)
@@ -132,7 +166,7 @@ class ModuleContext:
         ids = self._noqa.get(line)
         if ids is None:
             return False
-        return not ids or rule_id in ids
+        return suppression_matches(rule_id, ids)
 
 
 def _collect_noqa(source: str) -> Dict[int, frozenset]:
@@ -376,21 +410,48 @@ def render_sarif(findings: Sequence[Finding]) -> str:
                 },
             }],
         }
-        if f.trace:
-            # the witness path: codeFlows for flow-aware viewers,
-            # relatedLocations for everything else
-            step_locs = [{
+        def _step_loc(s: TraceStep) -> Dict[str, object]:
+            step_uri = uri
+            if s.path:
+                p = s.path
+                if os.path.isabs(p):
+                    try:
+                        p = os.path.relpath(p)
+                    except ValueError:
+                        pass
+                step_uri = p.replace(os.sep, "/")
+            return {
                 "physicalLocation": {
-                    "artifactLocation": {"uri": uri},
+                    "artifactLocation": {"uri": step_uri},
                     "region": {"startLine": max(s.line, 1),
                                "startColumn": s.col + 1},
                 },
                 "message": {"text": s.note},
-            } for s in f.trace]
+            }
+
+        if f.trace:
+            # the witness path: codeFlows for flow-aware viewers,
+            # relatedLocations for everything else
+            step_locs = [_step_loc(s) for s in f.trace]
             result["codeFlows"] = [{"threadFlows": [{
                 "locations": [{"location": loc} for loc in step_locs],
             }]}]
             result["relatedLocations"] = step_locs
+        elif f.threads:
+            # a race: ONE codeFlow whose threadFlows are the two
+            # stacks — one per thread context — exactly the shape
+            # SARIF reserves for concurrent witnesses
+            thread_flows = []
+            related = []
+            for label, steps in f.threads:
+                locs = [_step_loc(s) for s in steps]
+                thread_flows.append({
+                    "id": label,
+                    "locations": [{"location": loc} for loc in locs],
+                })
+                related.extend(locs)
+            result["codeFlows"] = [{"threadFlows": thread_flows}]
+            result["relatedLocations"] = related
         results.append(result)
     return json.dumps({
         "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"

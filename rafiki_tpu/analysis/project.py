@@ -39,7 +39,7 @@ from typing import (Dict, Iterator, List, Optional, Sequence, Set,
                     Tuple)
 
 from .engine import (Finding, ModuleContext, _NOQA_RE, SEVERITIES,
-                     iter_python_files)
+                     iter_python_files, suppression_matches)
 
 #: the suppression token inside non-Python comment syntaxes: C++
 #: (``// rafiki: noqa[x]``), HTML/Markdown (``<!-- rafiki: noqa[x]
@@ -127,6 +127,10 @@ class ProjectContext:
         #: per module: local name -> fully qualified project target
         self.imports: Dict[str, Dict[str, str]] = {}
         self._noqa_cache: Dict[str, Dict[int, frozenset]] = {}
+        #: scratch cache for rule-computed whole-program facts (the
+        #: thread model + access summaries all three race rules share)
+        #: — keyed by the computing module's name
+        self.memo: Dict[str, object] = {}
         self._load()
         self._index()
 
@@ -391,7 +395,7 @@ class ProjectContext:
         ids = noqa.get(line)
         if ids is None:
             return False
-        return not ids or rule_id in ids
+        return suppression_matches(rule_id, ids)
 
 
 class ProjectRule:
@@ -403,12 +407,20 @@ class ProjectRule:
     contract touches (a Python module, ``docs/observability.md``,
     ``kv_server.cc``), so rules name locations explicitly. The helper
     :meth:`at` converts a ``(ModuleContext, ast-node)`` pair.
+
+    Rules may append a fifth element: ``threads``, a tuple of
+    ``(label, trace-steps)`` pairs carried onto the finding — the
+    concurrency layer uses it to render one stack per thread context.
+    ``layer`` distinguishes the sub-registries ``--list-rules`` tags:
+    plain cross-layer contracts are ``"project"``, the thread-model
+    rules (:mod:`.rules.project_threads`) are ``"threads"``.
     """
 
     id: str = ""
     category: str = "project"
     severity: str = "error"
     description: str = ""
+    layer: str = "project"
 
     def check(self, project: ProjectContext
               ) -> Iterator[Tuple[str, int, int, str]]:
@@ -470,12 +482,14 @@ def analyze_project(paths: Sequence[str],
     project = ProjectContext(paths)
     findings: List[Finding] = list(project.parse_errors)
     for rule in chosen:
-        for path, line, col, message in rule.check(project):
+        for item in rule.check(project):
+            path, line, col, message = item[:4]
+            threads = tuple(item[4]) if len(item) > 4 else ()
             if not with_suppressed and \
                     project.suppressed(rule.id, path, line):
                 continue
             findings.append(Finding(rule.id, rule.severity, path,
-                                    line, col, message))
+                                    line, col, message, (), threads))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 

@@ -4,8 +4,6 @@ One module per hazard category (mirrors ``docs/linting.md``):
 
 - :mod:`jax_tracing` — hazards that only exist under ``jax.jit`` /
   ``pjit`` / ``shard_map`` tracing.
-- :mod:`concurrency` — shared-state hazards across the serving/worker
-  threads.
 - :mod:`robustness` — error-handling and library-internals hazards.
 - :mod:`observability` — counters written behind the metrics plane's
   back.
@@ -21,6 +19,16 @@ Project-scope rules (``lint --project``), one module per contract:
   dashboard.
 - :mod:`project_budget` — budget-key / worker-config / docs parity.
 - :mod:`project_spans` — span streams that can never terminate.
+
+Thread-model rules (``lint --project``, tagged ``[threads:...]``;
+see :mod:`rafiki_tpu.analysis.threads`):
+
+- :mod:`project_threads` — interprocedural data races, unlocked
+  read-modify-writes, and non-daemon threads with no join on the
+  teardown path. These supersede the retired per-module
+  ``inconsistent-lock`` / ``thread-unlocked-global`` rules (their
+  noqa ids still apply via aliasing; :mod:`concurrency` keeps the
+  shared lock/mutator vocabulary).
 
 Flow-scope rules (path-sensitive, CFG + dataflow; see
 :mod:`rafiki_tpu.analysis.dataflow`), run in the per-file pass:
@@ -38,4 +46,5 @@ Flow-scope rules (path-sensitive, CFG + dataflow; see
 from . import (concurrency, flow_clock, flow_jit,  # noqa: F401
                flow_locks, flow_wire, jax_tracing, observability,
                project_budget, project_hub, project_locks,
-               project_metrics, project_spans, robustness, serving)
+               project_metrics, project_spans, project_threads,
+               robustness, serving)

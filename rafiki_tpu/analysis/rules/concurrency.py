@@ -1,21 +1,25 @@
-"""Concurrency-hazard rules.
+"""Shared concurrency vocabulary for the analysis layers.
 
-The serving/worker stack is thread-heavy (decode loop, micro-batcher,
-heartbeats, services manager, SSE writers), and every one of the
-observed races had the same shape: state that is CLEARLY meant to be
-lock-protected — because the same class protects it elsewhere — written
-without the lock, or module globals mutated straight from a thread
-target. Both are invisible to type checkers; both are mechanical to
-find in the AST.
+This module used to host two per-module rules, ``inconsistent-lock``
+and ``thread-unlocked-global``. Both were retired in favor of the
+interprocedural thread-model layer
+(:mod:`.project_threads`): the per-module versions could only vote on
+lock discipline inside one class body and guess thread targets inside
+one file, so they missed every cross-module race and flagged
+single-owner mirrors. Their ``# rafiki: noqa[...]`` ids still apply —
+:data:`~rafiki_tpu.analysis.engine.RULE_ALIASES` maps them onto
+``shared-state-race`` / ``atomic-rmw-race``.
+
+What remains here is the vocabulary the newer layers share: which
+constructors build locks, which container methods mutate their
+receiver, and which names a function binds locally (and therefore
+shadow module globals).
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Set, Tuple
-
-from ..astutil import dotted
-from ..engine import Rule, register
+from typing import Set
 
 #: constructors whose result is a lock-ish guard object
 _LOCK_CTORS = {
@@ -29,266 +33,6 @@ _MUTATORS = {
     "append", "extend", "insert", "add", "update", "setdefault",
     "pop", "popitem", "remove", "discard", "clear", "appendleft",
 }
-
-
-def _with_lock_exprs(node: ast.With) -> List[str]:
-    return [dotted(item.context_expr) or
-            (dotted(item.context_expr.func) or ""
-             if isinstance(item.context_expr, ast.Call) else "")
-            for item in node.items]
-
-
-def _lockish(name: str, known_locks: Set[str]) -> bool:
-    """Does this with-context expression look like acquiring a lock?
-
-    ``known_locks`` holds attribute paths assigned a Lock/Condition in
-    the same class (exact match); beyond those, any name containing
-    lock/mutex/cv/cond counts — the rule must not fire on code that is
-    visibly TRYING to lock, even through an alias we can't resolve.
-    """
-    if name in known_locks:
-        return True
-    lowered = name.rsplit(".", 1)[-1].lower()
-    return any(tok in lowered for tok in ("lock", "mutex", "cv", "cond",
-                                          "sem"))
-
-
-class _FunctionScanner:
-    """Classifies every write inside one function/method body as
-    locked (within a ``with <lock>``) or bare."""
-
-    def __init__(self, fn: ast.AST, known_locks: Set[str]):
-        self.fn = fn
-        self.known_locks = known_locks
-        # write target path -> list of (node, locked?)
-        self.writes: List[Tuple[str, ast.AST, bool]] = []
-        self._scan(fn.body, locked=False)
-
-    def _scan(self, body, locked: bool) -> None:
-        for node in body:
-            if isinstance(node, ast.With):
-                inner = locked or any(
-                    _lockish(n, self.known_locks)
-                    for n in _with_lock_exprs(node) if n)
-                self._scan(node.body, inner)
-                continue
-            self._record(node, locked)
-            for child in ast.iter_child_nodes(node):
-                if isinstance(child, (ast.FunctionDef,
-                                      ast.AsyncFunctionDef, ast.Lambda)):
-                    continue  # nested defs have their own discipline
-                self._scan([child], locked)
-
-    def _record(self, node: ast.AST, locked: bool) -> None:
-        targets: List[ast.AST] = []
-        if isinstance(node, ast.Assign):
-            targets = list(node.targets)
-        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
-            targets = [node.target]
-        elif isinstance(node, ast.Call) and \
-                isinstance(node.func, ast.Attribute) and \
-                node.func.attr in _MUTATORS:
-            path = dotted(node.func.value)
-            if path:
-                self.writes.append((path, node, locked))
-            return
-        for t in targets:
-            base = t
-            while isinstance(base, ast.Subscript):
-                base = base.value  # d[k] = v writes d
-            path = dotted(base)
-            if path:
-                self.writes.append((path, node, locked))
-
-
-def _class_lock_attrs(cls: ast.ClassDef) -> Set[str]:
-    """Attribute paths (``self.X``) assigned a Lock/Condition anywhere
-    in the class body."""
-    out: Set[str] = set()
-    for node in ast.walk(cls):
-        if not isinstance(node, ast.Assign):
-            continue
-        value = node.value
-        if not (isinstance(value, ast.Call)
-                and dotted(value.func) in _LOCK_CTORS):
-            continue
-        for t in node.targets:
-            path = dotted(t)
-            if path:
-                out.add(path)
-    return out
-
-
-@register
-class InconsistentLockRule(Rule):
-    id = "inconsistent-lock"
-    category = "concurrency"
-    severity = "error"
-    description = (
-        "attribute written under the class's lock everywhere else but "
-        "bare in one method: either that write is a race or the "
-        "discipline is an illusion — both deserve a look")
-
-    #: methods allowed to write anything bare: construction happens
-    #: before the object is shared, and teardown after.
-    _SETUP = {"__init__", "__new__", "__enter__", "__post_init__"}
-
-    def check(self, ctx):
-        for cls in ast.walk(ctx.tree):
-            if not isinstance(cls, ast.ClassDef):
-                continue
-            locks = _class_lock_attrs(cls)
-            if not locks:
-                continue
-            methods = [n for n in cls.body
-                       if isinstance(n, (ast.FunctionDef,
-                                         ast.AsyncFunctionDef))]
-            # Eraser-style lockset vote per attribute: an attr counts
-            # as lock-protected only when bare writes are a strict
-            # minority (< 1/3 of non-setup writes). Classes that hold
-            # a lock for a narrow handoff while a single owner thread
-            # writes its private mirrors bare (the decode engine) vote
-            # those attrs "unprotected" and stay clean; one stray bare
-            # write against an otherwise-locked attr gets flagged.
-            setup = self._setup_methods(methods)
-            locked_by: Dict[str, str] = {}  # attr -> a locking method
-            counts: Dict[str, List[int]] = {}  # attr -> [locked, bare]
-            bare_sites = []
-            for m in methods:
-                scan = _FunctionScanner(m, locks)
-                is_setup = m.name in setup
-                holds_by_name = m.name.endswith("_locked")
-                for path, node, locked in scan.writes:
-                    if not path.startswith("self.") or path in locks:
-                        continue
-                    if is_setup:
-                        continue  # object not shared yet
-                    if locked or holds_by_name:
-                        counts.setdefault(path, [0, 0])[0] += 1
-                        if locked:
-                            locked_by.setdefault(path, m.name)
-                    else:
-                        counts.setdefault(path, [0, 0])[1] += 1
-                        bare_sites.append((path, node, m.name))
-            for path, node, method in bare_sites:
-                n_locked, n_bare = counts[path]
-                if path not in locked_by or locked_by[path] == method:
-                    continue
-                if n_bare * 2 > n_locked:
-                    continue  # attr votes "not lock-protected"
-                yield node, (
-                    f"'{path}' is written under "
-                    f"{'/'.join(sorted(locks))} in "
-                    f"'{cls.name}.{locked_by[path]}' (and "
-                    f"{n_locked} locked write(s) total) but bare here "
-                    f"in '{method}' — hold the lock (or rename the "
-                    "method *_locked if the caller holds it)")
-
-    @classmethod
-    def _setup_methods(cls, methods) -> Set[str]:
-        """Constructor closure: ``__init__`` etc. plus helpers every
-        one of whose in-class callers is itself setup — the object is
-        not shared with other threads while they run."""
-        names = {m.name for m in methods}
-        callers: Dict[str, Set[str]] = {n: set() for n in names}
-        for m in methods:
-            for node in ast.walk(m):
-                if isinstance(node, ast.Call) and \
-                        isinstance(node.func, ast.Attribute) and \
-                        isinstance(node.func.value, ast.Name) and \
-                        node.func.value.id == "self" and \
-                        node.func.attr in callers:
-                    callers[node.func.attr].add(m.name)
-        setup = set(cls._SETUP)
-        changed = True
-        while changed:
-            changed = False
-            for name in names - setup:
-                if callers[name] and callers[name] <= setup:
-                    setup.add(name)
-                    changed = True
-        return setup
-
-
-def _thread_target_names(tree: ast.Module) -> Dict[str, ast.AST]:
-    """Function/method names passed as ``Thread(target=...)`` (plus
-    ``start_new_thread``/executor ``submit`` forms) in this module."""
-    out: Dict[str, ast.AST] = {}
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fname = dotted(node.func) or ""
-        candidates: List[ast.AST] = []
-        if fname.endswith("Thread") or fname.endswith("Timer"):
-            candidates += [kw.value for kw in node.keywords
-                           if kw.arg == "target"]
-        elif fname.rsplit(".", 1)[-1] == "submit" and node.args:
-            candidates.append(node.args[0])
-        for cand in candidates:
-            path = dotted(cand)
-            if path:
-                out[path.rsplit(".", 1)[-1]] = node
-    return out
-
-
-@register
-class ThreadUnlockedGlobalRule(Rule):
-    id = "thread-unlocked-global"
-    category = "concurrency"
-    severity = "error"
-    description = (
-        "module-level mutable state mutated inside a thread target "
-        "without any lock held: a data race the GIL only hides until "
-        "the interleaving changes")
-
-    _MUTABLE_CTORS = {"dict", "list", "set", "collections.defaultdict",
-                      "defaultdict", "collections.OrderedDict",
-                      "OrderedDict", "collections.deque", "deque",
-                      "Counter", "collections.Counter"}
-
-    def _module_mutables(self, tree: ast.Module) -> Set[str]:
-        out: Set[str] = set()
-        for node in tree.body:
-            if not isinstance(node, ast.Assign):
-                continue
-            v = node.value
-            mutable = isinstance(v, (ast.Dict, ast.List, ast.Set,
-                                     ast.ListComp, ast.DictComp,
-                                     ast.SetComp)) or (
-                isinstance(v, ast.Call)
-                and dotted(v.func) in self._MUTABLE_CTORS)
-            if not mutable:
-                continue
-            for t in node.targets:
-                if isinstance(t, ast.Name):
-                    out.add(t.id)
-        return out
-
-    def check(self, ctx):
-        mutables = self._module_mutables(ctx.tree)
-        if not mutables:
-            return
-        targets = _thread_target_names(ctx.tree)
-        if not targets:
-            return
-        for fn in ast.walk(ctx.tree):
-            if not isinstance(fn, (ast.FunctionDef,
-                                   ast.AsyncFunctionDef)):
-                continue
-            if fn.name not in targets:
-                continue
-            scan = _FunctionScanner(fn, set())
-            local_names = _local_bindings(fn)
-            for path, node, locked in scan.writes:
-                root = path.split(".", 1)[0]
-                if locked or root not in mutables or \
-                        root in local_names:
-                    continue
-                yield node, (
-                    f"thread target '{fn.name}' mutates module-level "
-                    f"'{root}' with no lock held: wrap the write in a "
-                    "lock (or move the state into an object that owns "
-                    "one)")
 
 
 def _local_bindings(fn: ast.AST) -> Set[str]:

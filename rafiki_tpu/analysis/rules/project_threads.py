@@ -1,0 +1,328 @@
+"""The thread-model rules: interprocedural race + lifecycle analysis.
+
+Fourth analysis layer (``--list-rules`` tags these ``[threads:...]``):
+per-module rules see shapes, flow rules see paths, project rules see
+cross-layer contracts — these rules see *threads*. They fuse the
+:class:`~rafiki_tpu.analysis.threads.ThreadModel` (which thread
+contexts run each function) with
+:class:`~rafiki_tpu.analysis.summaries.AccessSummaries` (what shared
+state each function touches, under which must-held locks) and report:
+
+- ``shared-state-race`` (error) — a field/global written in one
+  thread context and accessed in another with disjoint locksets.
+  Exemptions, in the order they are applied: internally-synchronized
+  fields never produce accesses (queues, Events, locks, StatsMap, obs
+  instruments — see :mod:`..summaries`); writes in a class's setup
+  closure happen-before any root its constructor starts
+  (init-before-``start()``); a bare ``self.flag = True``-style
+  constant store observed only by reads is a GIL-atomic handoff, not
+  a torn update.
+- ``atomic-rmw-race`` (warning) — ``+=``-style read-modify-write on a
+  shared target outside any lock: both interleavings of the read and
+  the write lose updates even though no single access is torn.
+- ``thread-lifecycle`` (error) — a class that starts a non-daemon
+  thread/timer must join or cancel it on its close/stop path, or
+  interpreter shutdown blocks on a thread nobody owns.
+
+Race findings carry BOTH sides: ``Finding.threads`` holds one
+spawn-site → call-chain → access stack per context, rendered as
+paired traces in text and two ``threadFlows`` in one SARIF
+``codeFlow``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..engine import TraceStep
+from ..project import (ProjectContext, ProjectRule, register_project)
+
+# NOTE: ..threads / ..summaries are imported lazily inside the
+# functions below — they import shared vocabulary back out of this
+# rules package, so a module-level import here would be circular.
+
+#: methods that constitute a component's teardown path
+_CLOSERS = {"close", "stop", "shutdown", "join", "terminate",
+            "cancel", "__exit__", "__del__", "aclose"}
+
+
+def _analysis(project: ProjectContext
+              ) -> Tuple[ThreadModel, AccessSummaries]:
+    """The (thread model, access summaries) pair, computed once per
+    project and shared by all three rules via ``project.memo``."""
+    from ..summaries import AccessSummaries
+    from ..threads import ThreadModel
+    if "project_threads" not in project.memo:
+        model = ThreadModel(project)
+        project.memo["project_threads"] = \
+            (model, AccessSummaries(project, model))
+    return project.memo["project_threads"]
+
+
+def _race_pairs(project: ProjectContext
+                ) -> Dict[str, Tuple[Access, Access, str, str]]:
+    """target -> best (write, other access, ctx-of-write, ctx-of-other)
+    conflicting pair, memoized — ``shared-state-race`` reports these
+    and ``atomic-rmw-race`` skips their targets."""
+    if "race_pairs" in project.memo:
+        return project.memo["race_pairs"]
+    model, summ = _analysis(project)
+    out: Dict[str, Tuple[Access, Access, str, str]] = {}
+    for target in sorted(summ.by_target):
+        pair = _best_pair(model, summ.by_target[target])
+        if pair is not None:
+            out[target] = pair
+    project.memo["race_pairs"] = out
+    return out
+
+
+def _best_pair(model: ThreadModel, accesses: List[Access]
+               ) -> Optional[Tuple[Access, Access, str, str]]:
+    from ..threads import MAIN
+    best = None
+    best_score = -1
+    for w in accesses:
+        if w.kind == "read":
+            continue
+        cw = model.contexts_of(w.func)
+        if not cw:
+            continue
+        for a in accesses:
+            if (a.path, a.line) == (w.path, w.line):
+                continue  # one site racing itself is rmw territory
+            if w.locks & a.locks:
+                continue  # a common lock orders them
+            if w.atomic and (a.kind == "read" or a.atomic):
+                continue  # GIL-atomic constant store / flag handoff
+            for ca in sorted(cw):
+                for cb in sorted(model.contexts_of(a.func)):
+                    if ca == cb and (not model.is_multi(ca) or
+                                     w.func == a.func):
+                        # same single-instance context is ordered;
+                        # one function racing its own multi-instance
+                        # self is atomic-rmw-race's report
+                        continue
+                    if model.happens_before(w.func, w.line, cb) or \
+                            model.happens_before(a.func, a.line, ca):
+                        continue  # init-before-start()
+                    score = (ca != MAIN) + (cb != MAIN) + \
+                        (a.kind != "read")
+                    if score > best_score:
+                        best, best_score = (w, a, ca, cb), score
+    return best
+
+
+def _access_step(a: Access, target: str, verb: str) -> TraceStep:
+    locks = ("holding " + "/".join(
+        sorted(lock.rsplit(":", 1)[-1] for lock in a.locks))
+        if a.locks else "with no lock held")
+    return TraceStep(
+        a.line, a.col,
+        f"'{_short(a.func)}' {verb} '{_short(target)}' {locks}",
+        a.path)
+
+
+def _stack(model: ThreadModel, label: str, a: Access, target: str,
+           verb: str) -> Tuple[str, tuple]:
+    return (label, model.trace(label, a.func)
+            + (_access_step(a, target, verb),))
+
+
+def _verb(a: Access) -> str:
+    return {"read": "reads", "write": "writes",
+            "rmw": "read-modify-writes"}[a.kind]
+
+
+def _short(name: str) -> str:
+    return name.rsplit(":", 1)[-1]
+
+
+@register_project
+class SharedStateRaceRule(ProjectRule):
+    id = "shared-state-race"
+    category = "concurrency"
+    severity = "error"
+    layer = "threads"
+    description = (
+        "a field or module global written in one thread context and "
+        "accessed in another with disjoint locksets: the interleaving "
+        "the GIL happens to allow today decides what the reader sees "
+        "— guard both sides with one lock (supersedes the per-module "
+        "inconsistent-lock / thread-unlocked-global rules)")
+
+    example = (
+        "import threading\n"
+        "\n"
+        "\n"
+        "class Buffer:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._items = {}\n"
+        "        self._t = threading.Thread(target=self._drain,\n"
+        "                                   daemon=True)\n"
+        "        self._t.start()\n"
+        "\n"
+        "    def put(self, key, value):\n"
+        "        with self._lock:\n"
+        "            self._items[key] = value\n"
+        "\n"
+        "    def _drain(self):\n"
+        "        while self._items:\n"
+        "            self._items.clear()  # no lock: races put()\n")
+
+    def check(self, project: ProjectContext) -> Iterator[tuple]:
+        model, _summ = _analysis(project)
+        for target, (w, a, ca, cb) in sorted(
+                _race_pairs(project).items()):
+            w_locks = "/".join(sorted(
+                lock.rsplit(":", 1)[-1] for lock in w.locks)) or "none"
+            a_locks = "/".join(sorted(
+                lock.rsplit(":", 1)[-1] for lock in a.locks)) or "none"
+            yield (w.path, w.line, w.col,
+                   f"'{_short(target)}' is written by "
+                   f"'{_short(w.func)}' [{ca}] and "
+                   f"{'written' if a.kind != 'read' else 'read'} by "
+                   f"'{_short(a.func)}' [{cb}] with disjoint locksets "
+                   f"({w_locks} vs {a_locks}) — the two threads "
+                   "interleave freely; guard both sides with one lock",
+                   (_stack(model, ca, w, target, _verb(w)),
+                    _stack(model, cb, a, target, _verb(a))))
+
+
+@register_project
+class AtomicRmwRaceRule(ProjectRule):
+    id = "atomic-rmw-race"
+    category = "concurrency"
+    severity = "warning"
+    layer = "threads"
+    description = (
+        "+= / read-modify-write on a shared field outside any lock: "
+        "no single access is torn, but two threads interleaving the "
+        "read and the write lose updates — wrap the whole "
+        "read-modify-write in a lock")
+
+    example = (
+        "class Api:\n"
+        "    def __init__(self, svc):\n"
+        "        self.hits = 0\n"
+        "        svc.route('GET', '/stats', self._stats)\n"
+        "\n"
+        "    def _stats(self, request):\n"
+        "        self.hits += 1  # two handler threads lose updates\n"
+        "        return {'hits': self.hits}\n")
+
+    def check(self, project: ProjectContext) -> Iterator[tuple]:
+        model, summ = _analysis(project)
+        raced = _race_pairs(project)
+        for target in sorted(summ.by_target):
+            if target in raced:
+                continue  # already reported as a full race
+            for a in summ.by_target[target]:
+                if a.kind != "rmw" or a.locks:
+                    continue
+                ctxs = sorted(model.contexts_of(a.func))
+                multi = [c for c in ctxs if model.is_multi(c)]
+                if not multi and len(ctxs) < 2:
+                    continue
+                if multi:
+                    how = (f"two instances of [{multi[0]}] interleave "
+                           "the read and the write")
+                    labels = (multi[0], multi[0])
+                else:
+                    how = (f"[{ctxs[0]}] and [{ctxs[1]}] interleave "
+                           "the read and the write")
+                    labels = (ctxs[0], ctxs[1])
+                yield (a.path, a.line, a.col,
+                       f"read-modify-write of '{_short(target)}' in "
+                       f"'{_short(a.func)}' holds no lock: {how} and "
+                       "updates are lost — make the whole "
+                       "read-modify-write atomic under a lock",
+                       tuple(_stack(model, label, a, target,
+                                    "read-modify-writes")
+                             for label in labels))
+                break  # one finding per target
+
+
+@register_project
+class ThreadLifecycleRule(ProjectRule):
+    id = "thread-lifecycle"
+    category = "concurrency"
+    severity = "error"
+    layer = "threads"
+    description = (
+        "a component that starts a non-daemon thread or timer must "
+        "join/cancel it on its close()/stop() path — otherwise "
+        "interpreter shutdown blocks on a thread nobody owns (make "
+        "it daemon= if it truly has no teardown contract)")
+
+    example = (
+        "import queue\n"
+        "import threading\n"
+        "\n"
+        "\n"
+        "class Pump:\n"
+        "    def __init__(self):\n"
+        "        self._q = queue.Queue()\n"
+        "        self._t = threading.Thread(target=self._run)\n"
+        "        self._t.start()\n"
+        "\n"
+        "    def _run(self):\n"
+        "        while True:\n"
+        "            if self._q.get() is None:\n"
+        "                return\n"
+        "\n"
+        "    def close(self):\n"
+        "        self._q.put(None)  # stops the loop, never join()s\n")
+
+    def check(self, project: ProjectContext) -> Iterator[tuple]:
+        model, _summ = _analysis(project)
+        for root in model.roots:
+            if root.kind not in ("thread", "timer") or root.daemon:
+                continue
+            if root.spawner is None:
+                continue  # module-level scripts own their threads
+            sp = model.functions.get(root.spawner)
+            if sp is None or sp.cls is None:
+                continue  # free-function spawner: caller's contract
+            if self._join_on_close_path(project, sp.cls):
+                continue
+            yield (root.path, root.line, root.col,
+                   f"'{_short(sp.cls)}.{_method(root.spawner)}' starts "
+                   f"non-daemon {root.kind} '{root.name}' but no "
+                   "close/stop/shutdown path joins or cancels it — "
+                   "interpreter exit will hang on it; join it in "
+                   "close() (or pass daemon=True if it has no "
+                   "teardown contract)")
+
+    @staticmethod
+    def _join_on_close_path(project: ProjectContext,
+                            cls_q: str) -> bool:
+        """Does any teardown method (or a helper it calls on
+        ``self``) contain a ``.join(...)`` / ``.cancel(...)``?"""
+        from ..threads import walk_own
+        methods: Dict[str, ast.AST] = {}
+        for c in project.class_mro(cls_q):
+            for name, node in c.methods.items():
+                methods.setdefault(name, node)
+        seen = set()
+        frontier = [n for n in methods if n in _CLOSERS]
+        while frontier:
+            name = frontier.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            for node in walk_own(methods[name]):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute):
+                    if node.func.attr in ("join", "cancel"):
+                        return True
+                    if isinstance(node.func.value, ast.Name) and \
+                            node.func.value.id == "self" and \
+                            node.func.attr in methods:
+                        frontier.append(node.func.attr)
+        return False
+
+
+def _method(qualname: str) -> str:
+    return qualname.rsplit(".", 1)[-1]

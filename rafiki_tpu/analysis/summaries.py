@@ -1,0 +1,417 @@
+"""Per-function shared-state access summaries with must-hold locksets.
+
+The second half of the race detector (:mod:`.rules.project_threads`):
+where :mod:`.threads` answers *which threads run this function*, this
+module answers *what shared state it touches and which locks it
+provably holds at each touch*. For every project function we record
+each ``self.field`` / module-global access as an :class:`Access`
+annotated with its **effective lockset**, built from three sources:
+
+1. **``with``-scope locks** — an AST walk tracking ``with <lock>:``
+   nesting, with lock identity resolved through the project-wide
+   :class:`~.rules.project_locks._LockNames` table (``Condition(lock)``
+   aliases the wrapped lock, MRO-aware for inherited lock attrs).
+2. **Manual ``acquire()``/``release()``** — a forward must-dataflow
+   over the function's CFG (meet = intersection, the same modeling the
+   ``lock-release-path`` flow rule uses): a lock counts as held at a
+   statement only when EVERY path to it acquired and did not release.
+   Only functions that actually call ``.acquire`` on a named lock pay
+   for the CFG.
+3. **Interprocedural ``held_in``** — the locks held at *every*
+   resolved call site of the function (intersection over callers,
+   callers' own ``held_in`` included), computed as a descending
+   fixpoint over the call graph. ``foo_locked()`` helpers called under
+   a lock inherit it; a helper reachable both locked and bare inherits
+   nothing, which is exactly the hazard.
+
+Fields that are **internally synchronized** never produce accesses:
+lock/Condition/Semaphore objects themselves, ``queue.Queue`` family,
+``threading.Event``, ``collections.deque`` (GIL-atomic append/pop),
+``StatsMap`` and obs-registry instruments (counter/gauge/histogram own
+their locking), plus lock-named attributes. Unresolvable fields
+(never assigned in any project class of the MRO) are skipped too.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .astutil import dotted
+from .cfg import build_cfg
+from .project import ClassInfo, FunctionInfo, ProjectContext
+from .rules.concurrency import _LOCK_CTORS, _MUTATORS, _local_bindings
+from .rules.project_locks import _LockNames
+from .threads import ThreadModel, walk_own
+
+#: constructors whose instances synchronize internally — accesses to
+#: fields holding one are never race candidates
+_SYNC_CTORS = _LOCK_CTORS | {
+    "threading.Semaphore", "threading.BoundedSemaphore",
+    "threading.Event", "Event", "threading.local",
+    "queue.Queue", "Queue", "queue.SimpleQueue", "SimpleQueue",
+    "queue.LifoQueue", "LifoQueue", "queue.PriorityQueue",
+    "PriorityQueue", "collections.deque", "deque",
+    "StatsMap",
+}
+
+#: obs-registry instrument factories: ``self.c = metrics.counter(...)``
+_INSTRUMENT_ATTRS = {"counter", "gauge", "histogram"}
+
+#: field names that are synchronized (or synchronization) by contract
+#: in this codebase, whatever the constructor spelling
+_SYNC_NAME_RE = re.compile(
+    r"(?:^|_)(?:lock|mutex|sem|cv|cond|event)s?(?:_|$)|"
+    r"^_?(?:stats|metrics|registry|traces)$")
+
+#: module-level constructors that make a global worth tracking
+_MUTABLE_CTORS = {"dict", "list", "set", "collections.defaultdict",
+                  "defaultdict", "collections.OrderedDict",
+                  "OrderedDict", "collections.deque", "deque",
+                  "Counter", "collections.Counter"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Access:
+    """One read/write of a shared target, with its effective lockset."""
+
+    target: str   # ``mod:Class.field`` or ``mod:global``
+    kind: str     # "read" | "write" | "rmw"
+    func: str     # qualname of the accessing function
+    path: str
+    line: int
+    col: int
+    locks: frozenset  # effective must-hold lockset at this point
+    #: a bare ``self.f = <constant>`` rebind — GIL-atomic, so a
+    #: write/read pair on it is a benign flag handoff, not a race
+    atomic: bool = False
+
+
+class AccessSummaries:
+    """Shared-state accesses for every function of one project."""
+
+    def __init__(self, project: ProjectContext, model: ThreadModel):
+        self.project = project
+        self.model = model
+        self.names = _LockNames(project)
+        #: target -> accesses (effective locksets already folded in)
+        self.by_target: Dict[str, List[Access]] = {}
+        #: callee qualname -> [(caller qualname, locks at call site)]
+        self._caller_edges: Dict[str, List[Tuple[str, frozenset]]] = {}
+        #: function qualname -> locks held at every resolved call site
+        self.held_in: Dict[str, frozenset] = {}
+        self._field_kind: Dict[str, Dict[str, str]] = {}
+        self._raw: List[Access] = []
+        self._globals: Dict[str, Set[str]] = {
+            mod: self._module_globals(ctx.tree)
+            for mod, ctx in project.modules.items()}
+        for q in sorted(model.functions):
+            self._scan_function(model.functions[q])
+        self._fixpoint_held_in()
+        for a in self._raw:
+            eff = a.locks | self.held_in.get(a.func, frozenset())
+            self.by_target.setdefault(a.target, []).append(
+                dataclasses.replace(a, locks=eff))
+
+    # ---- field classification ----
+
+    def _class_field_kinds(self, info: ClassInfo) -> Dict[str, str]:
+        """``attr -> "plain" | "sync"`` for fields assigned anywhere
+        in the class body (sync wins when both are seen)."""
+        q = info.qualname
+        if q in self._field_kind:
+            return self._field_kind[q]
+        kinds: Dict[str, str] = {}
+        for node in ast.walk(info.node):
+            targets: List[ast.AST] = []
+            value: Optional[ast.AST] = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                targets, value = [node.target], node.value
+            for t in targets:
+                path = dotted(t)
+                if not (path and path.startswith("self.") and
+                        path.count(".") == 1):
+                    continue
+                attr = path[5:]
+                sync = _SYNC_NAME_RE.search(attr) is not None
+                if isinstance(value, ast.Call):
+                    ctor = dotted(value.func)
+                    if ctor in _SYNC_CTORS:
+                        sync = True
+                    elif isinstance(value.func, ast.Attribute) and \
+                            value.func.attr in _INSTRUMENT_ATTRS:
+                        sync = True
+                if sync or kinds.get(attr) != "sync":
+                    kinds[attr] = "sync" if sync else \
+                        kinds.get(attr, "plain")
+                if sync:
+                    kinds[attr] = "sync"
+        self._field_kind[q] = kinds
+        return kinds
+
+    def _field_target(self, fi: FunctionInfo,
+                      attr: str) -> Optional[str]:
+        """Canonical ``mod:Class.attr`` for a ``self.attr`` access —
+        keyed on the most-base project class assigning the field, so a
+        subclass write and a base-class read meet on one target. None
+        for sync fields, method references, and unknown attrs."""
+        if fi.cls is None:
+            return None
+        owner: Optional[ClassInfo] = None
+        for c in self.project.class_mro(fi.cls):
+            if attr in c.methods:
+                return None  # bound-method reference, not data
+            kinds = self._class_field_kinds(c)
+            if attr in kinds:
+                if kinds[attr] == "sync":
+                    return None
+                owner = c
+        if owner is None:
+            return None
+        return f"{owner.qualname}.{attr}"
+
+    @staticmethod
+    def _module_globals(tree: ast.Module) -> Set[str]:
+        out: Set[str] = set()
+        for node in tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            v = node.value
+            mutable = isinstance(v, (ast.Dict, ast.List, ast.Set,
+                                     ast.ListComp, ast.DictComp,
+                                     ast.SetComp)) or (
+                isinstance(v, ast.Call)
+                and dotted(v.func) in _MUTABLE_CTORS)
+            if not mutable:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        return out
+
+    # ---- per-function scan ----
+
+    def _scan_function(self, fi: FunctionInfo) -> None:
+        self._locals = _local_bindings(fi.node)
+        self._manual: Dict[int, frozenset] = {}
+        if self._has_manual_acquire(fi):
+            self._manual = _manual_locksets(fi, self.names)
+        for stmt in fi.node.body:
+            self._scan(fi, stmt, frozenset())
+
+    def _has_manual_acquire(self, fi: FunctionInfo) -> bool:
+        for node in walk_own(fi.node):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "acquire" and \
+                    self.names.resolve(fi, node.func.value):
+                return True
+        return False
+
+    def _scan(self, fi: FunctionInfo, node: ast.AST,
+              held: frozenset) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return  # nested defs are scanned as their own entries
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in node.items:
+                self._scan(fi, item.context_expr, held)
+                lid = self.names.resolve(fi, item.context_expr)
+                if lid is not None:
+                    inner = inner | {lid}
+            for stmt in node.body:
+                self._scan(fi, stmt, inner)
+            return
+        if isinstance(node, ast.AugAssign):
+            self._record_store(fi, node.target, "rmw", held, node)
+            self._scan(fi, node.value, held)
+            return
+        if isinstance(node, ast.Assign):
+            atomic = isinstance(node.value, ast.Constant)
+            for t in node.targets:
+                self._record_store(fi, t, "write", held, node,
+                                   atomic=atomic)
+            self._scan(fi, node.value, held)
+            return
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name and isinstance(node.func, ast.Attribute):
+                if node.func.attr in _MUTATORS:
+                    self._record_access(fi, node.func.value, "write",
+                                        held, node)
+                elif node.func.attr in ("acquire", "release") and \
+                        self.names.resolve(fi, node.func.value):
+                    # lock-protocol calls are not data accesses
+                    for arg in node.args:
+                        self._scan(fi, arg, held)
+                    return
+            if name:
+                target = self.project.resolve_call(fi, node)
+                if target is not None and \
+                        target.qualname in self.model.functions:
+                    eff = held | self._manual.get(id(node),
+                                                  frozenset())
+                    self._caller_edges.setdefault(
+                        target.qualname, []).append(
+                            (fi.qualname, eff))
+        elif isinstance(node, ast.Attribute) and \
+                isinstance(node.ctx, ast.Load):
+            self._record_access(fi, node, "read", held, node)
+        elif isinstance(node, ast.Name) and \
+                isinstance(node.ctx, ast.Load):
+            self._record_access(fi, node, "read", held, node)
+        for child in ast.iter_child_nodes(node):
+            self._scan(fi, child, held)
+
+    def _record_store(self, fi: FunctionInfo, target: ast.AST,
+                      kind: str, held: frozenset, anchor: ast.AST,
+                      atomic: bool = False) -> None:
+        base = target
+        while isinstance(base, ast.Subscript):
+            base = base.value  # d[k] = v mutates d
+        if base is not target:
+            atomic = False  # container mutation, not a rebind
+        if isinstance(base, (ast.Tuple, ast.List)):
+            for el in base.elts:
+                self._record_store(fi, el, kind, held, anchor)
+            return
+        self._record_access(fi, base, kind, held, anchor,
+                            atomic=atomic)
+
+    def _record_access(self, fi: FunctionInfo, node: ast.AST,
+                       kind: str, held: frozenset, anchor: ast.AST,
+                       atomic: bool = False) -> None:
+        target: Optional[str] = None
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self":
+            target = self._field_target(fi, node.attr)
+        elif isinstance(node, ast.Name):
+            if node.id in self._globals.get(fi.module, ()) and \
+                    node.id not in self._locals:
+                target = f"{fi.module}:{node.id}"
+        if target is None:
+            return
+        eff = held | self._manual.get(id(anchor), frozenset())
+        ctx = self.project.modules.get(fi.module)
+        self._raw.append(Access(
+            target, kind, fi.qualname,
+            ctx.path if ctx else "", anchor.lineno,
+            anchor.col_offset, eff, atomic))
+
+    # ---- interprocedural held_in ----
+
+    def _fixpoint_held_in(self) -> None:
+        """Descending fixpoint: ``held_in(f)`` = intersection over
+        resolved call sites of (locks at the site ∪ caller's own
+        ``held_in``). No callers -> nothing assumed; a caller cycle
+        with no outside entry also decays to nothing."""
+        state: Dict[str, Optional[frozenset]] = {}
+        for q in self.model.functions:
+            state[q] = None if q in self._caller_edges else frozenset()
+        changed = True
+        while changed:
+            changed = False
+            for q, edges in self._caller_edges.items():
+                vals = [held | state[caller]
+                        for caller, held in edges
+                        if state.get(caller) is not None]
+                new: Optional[frozenset]
+                if vals:
+                    new = frozenset.intersection(*vals)
+                else:
+                    new = state[q]
+                if new != state[q]:
+                    state[q] = new
+                    changed = True
+        self.held_in = {q: (v if v is not None else frozenset())
+                        for q, v in state.items()}
+
+
+# ---- manual acquire/release must-dataflow ----
+
+def _manual_locksets(fi: FunctionInfo,
+                     names: _LockNames) -> Dict[int, frozenset]:
+    """``id(node) -> must-held manual locks`` for every AST node of
+    the function, from a forward must-dataflow over the CFG (gen at
+    ``.acquire()``, kill at ``.release()``, meet = intersection)."""
+    cfg = build_cfg(fi.node)
+
+    def events(stmt: ast.AST) -> List[Tuple[str, str]]:
+        out = []
+        for node in _header_nodes(stmt):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("acquire", "release"):
+                lid = names.resolve(fi, node.func.value)
+                if lid is not None:
+                    out.append((node.func.attr, lid))
+        return out
+
+    # block in-states: ⊤ (None) until reached; entry = ∅
+    n = len(cfg.blocks)
+    in_state: List[Optional[frozenset]] = [None] * n
+    in_state[cfg.entry.id] = frozenset()
+    work = [cfg.entry]
+    while work:
+        block = work.pop()
+        state = in_state[block.id]
+        assert state is not None
+        for stmt in block.stmts:
+            for op, lid in events(stmt):
+                state = (state | {lid}) if op == "acquire" \
+                    else (state - {lid})
+        for succ, _kind in block.succs:
+            prev = in_state[succ.id]
+            new = state if prev is None else (prev & state)
+            if new != prev:
+                in_state[succ.id] = new
+                work.append(succ)
+
+    held_at: Dict[int, frozenset] = {}
+    for block in cfg.blocks:
+        state = in_state[block.id]
+        if state is None:
+            continue  # unreachable
+        for stmt in block.stmts:
+            for node in _header_nodes(stmt):
+                held_at.setdefault(id(node), state)
+            for op, lid in events(stmt):
+                state = (state | {lid}) if op == "acquire" \
+                    else (state - {lid})
+    return held_at
+
+
+#: compound statements whose bodies live in their own CFG blocks —
+#: only their header expressions belong to the statement itself
+_COMPOUND = (ast.If, ast.While, ast.For, ast.AsyncFor, ast.With,
+             ast.AsyncWith, ast.Try, ast.Match)
+
+
+def _header_nodes(stmt: ast.AST) -> List[ast.AST]:
+    """The nodes evaluated *as part of this CFG statement* — for a
+    compound, the test/iter/context expressions, not the body."""
+    if not isinstance(stmt, _COMPOUND):
+        out = [stmt]
+        for node in ast.walk(stmt):
+            if node is not stmt:
+                out.append(node)
+        return out
+    headers: List[ast.AST] = [stmt]
+    exprs: List[ast.AST] = []
+    if isinstance(stmt, (ast.If, ast.While)):
+        exprs = [stmt.test]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        exprs = [stmt.iter, stmt.target]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        exprs = [item.context_expr for item in stmt.items]
+    elif isinstance(stmt, ast.Match):
+        exprs = [stmt.subject]
+    for e in exprs:
+        headers.extend(ast.walk(e))
+    return headers

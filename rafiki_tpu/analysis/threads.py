@@ -1,0 +1,460 @@
+"""Thread model: root discovery + interprocedural context reachability.
+
+The per-module concurrency rules retired in PR 18 could see *locks*
+but not *threads*: a field locked in ``serving/`` and written bare
+from a loop spawned in ``worker/`` looked fine to both files. This
+module gives the project pass the missing half — *which threads
+actually run which code*:
+
+1. **Root discovery** — every way this codebase starts concurrent
+   execution: ``threading.Thread(target=...)`` / ``threading.Timer``
+   (including the dominant nested-``def loop()`` idiom and
+   ``Thread(target=w.run)`` through a locally constructed object),
+   ``executor.submit(fn, ...)``, and ``svc.route(method, pattern,
+   handler)`` HTTP handler registrations (``JsonHttpService`` /
+   ``ObsServer`` dispatch handlers on per-connection server threads).
+2. **Reachability** — a BFS per root over the ProjectContext call
+   graph, so every function carries the set of thread contexts it can
+   run under. The ``main`` pseudo-context seeds from every function
+   with no resolved project caller that is not itself a thread target
+   (public API, CLI entry points, test surface) and propagates
+   forward like any other context.
+3. **Witness traces** — BFS parent pointers reconstruct, for any
+   (context, function) pair, the spawn-site → call-chain stack the
+   race renderer shows as one SARIF ``threadFlow``.
+
+Targets we cannot resolve to a project function (``functools.partial``
+wrappers, stdlib callables like ``server.serve_forever``) contribute
+no root — the handlers those servers dispatch to are discovered
+through ``.route`` instead, which is where the shared state actually
+gets touched.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .astutil import dotted
+from .engine import TraceStep
+from .project import FunctionInfo, ProjectContext
+
+#: the pseudo-context for code reachable without any spawn: whatever
+#: thread constructed the object / called the public API
+MAIN = "main"
+
+#: methods that start threads when named as ``<obj>.<method>`` — the
+#: executor-submit form (one task per call, arbitrarily many in flight)
+_SUBMIT_ATTRS = {"submit"}
+
+#: constructor/teardown methods whose writes happen before the object
+#: is shared (or after it stops being) — the seed of the setup closure
+SETUP_METHODS = {"__init__", "__new__", "__enter__", "__post_init__"}
+
+
+@dataclasses.dataclass(frozen=True)
+class ThreadRoot:
+    """One discovered way the project starts concurrent execution."""
+
+    kind: str            # "thread" | "timer" | "executor" | "handler"
+    name: str            # display name (name= kwarg, route, or target)
+    target: str          # qualname of the entry function
+    path: str            # file of the spawn site
+    line: int
+    col: int
+    daemon: bool
+    spawner: Optional[str]   # qualname of the spawning function
+    multi: bool          # >1 instance may run concurrently
+    #: first line at which the thread can actually be running — the
+    #: ``.start()`` call when we find one, else the spawn expression.
+    #: Writes in the spawner before this line happen-before the root.
+    start_line: int = 0
+
+    @property
+    def label(self) -> str:
+        return f"{self.kind}:{self.name}"
+
+
+def walk_own(fn_node: ast.AST) -> Iterator[ast.AST]:
+    """``ast.walk`` minus nested function/class bodies: what THIS
+    function executes when called, not what its closures do later."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class ThreadModel:
+    """Roots + per-function thread contexts for one project."""
+
+    def __init__(self, project: ProjectContext):
+        self.project = project
+        #: project functions plus synthetic entries for nested-def
+        #: thread targets (``mod:Cls.meth.<locals>.loop``)
+        self.functions: Dict[str, FunctionInfo] = dict(project.functions)
+        self.roots: List[ThreadRoot] = []
+        self._discover()
+        #: caller qualname -> {callee qualname: representative call}
+        self._adj: Dict[str, Dict[str, ast.Call]] = {}
+        self._build_adjacency()
+        #: context label -> set of reachable function qualnames
+        self.reach: Dict[str, Set[str]] = {}
+        #: (label, qualname) -> (caller qualname, call node)
+        self._parent: Dict[Tuple[str, str], Tuple[str, ast.Call]] = {}
+        self._roots_by_label: Dict[str, ThreadRoot] = {}
+        self._compute_reachability()
+        self._setup_cache: Dict[str, Set[str]] = {}
+
+    # ---- discovery ----
+
+    def _discover(self) -> None:
+        for mod, ctx in sorted(self.project.modules.items()):
+            node_to_fi = {id(fi.node): fi
+                          for fi in self.project.functions.values()
+                          if fi.module == mod}
+            for call in ast.walk(ctx.tree):
+                if not isinstance(call, ast.Call):
+                    continue
+                spec = self._classify(call)
+                if spec is None:
+                    continue
+                kind, target_expr, name = spec
+                fi = self._enclosing(ctx, call, node_to_fi)
+                target = self._resolve_target(mod, fi, target_expr)
+                if target is None:
+                    continue
+                in_loop = any(isinstance(a, (ast.For, ast.While,
+                                             ast.AsyncFor))
+                              for a in ctx.ancestors(call))
+                daemon = self._daemon(call, fi)
+                if not name:
+                    name = target.rsplit(":", 1)[-1].rsplit(".", 1)[-1]
+                self.roots.append(ThreadRoot(
+                    kind=kind, name=name, target=target,
+                    path=ctx.path, line=call.lineno,
+                    col=call.col_offset, daemon=daemon,
+                    spawner=fi.qualname if fi else None,
+                    multi=in_loop or kind in ("executor", "handler"),
+                    start_line=self._start_line(call, fi)))
+
+    @staticmethod
+    def _classify(call: ast.Call):
+        """(kind, target expression, display name) or None."""
+        fname = dotted(call.func) or ""
+        last = fname.rsplit(".", 1)[-1]
+        kwargs = {kw.arg: kw.value for kw in call.keywords if kw.arg}
+        if last == "Thread":
+            target = kwargs.get("target")
+            return ("thread", target, _const_str(kwargs.get("name"))) \
+                if target is not None else None
+        if last == "Timer":
+            # threading.Timer(interval, function)
+            target = kwargs.get("function") or (
+                call.args[1] if len(call.args) > 1 else None)
+            return ("timer", target, None) \
+                if target is not None else None
+        if isinstance(call.func, ast.Attribute):
+            if last in _SUBMIT_ATTRS and call.args:
+                return ("executor", call.args[0], None)
+            if last == "route" and len(call.args) >= 3:
+                # svc.route(method, pattern, handler): the handler
+                # runs on the HTTP server's per-connection threads
+                return ("handler", call.args[2],
+                        _const_str(call.args[1]))
+        return None
+
+    @staticmethod
+    def _enclosing(ctx, node: ast.AST,
+                   node_to_fi) -> Optional[FunctionInfo]:
+        """The innermost *indexed* function containing ``node`` (a
+        spawn inside a nested def charges the enclosing method)."""
+        for anc in ctx.ancestors(node):
+            fi = node_to_fi.get(id(anc))
+            if fi is not None:
+                return fi
+        return None
+
+    def _resolve_target(self, mod: str, fi: Optional[FunctionInfo],
+                        expr: ast.AST) -> Optional[str]:
+        """Target expression -> qualname of the entry function."""
+        path = dotted(expr)
+        if not path:
+            return None
+        segs = path.split(".")
+        project = self.project
+        if segs[0] == "self" and fi is not None and fi.cls:
+            if len(segs) == 2:
+                m = project._method(fi.cls, segs[1])
+                return m.qualname if m else None
+            if len(segs) == 3:
+                for c in project.class_mro(fi.cls):
+                    t = c.attr_types.get(segs[1])
+                    if t:
+                        m = project._method(t, segs[2])
+                        return m.qualname if m else None
+            return None
+        if len(segs) == 1:
+            name = segs[0]
+            # the dominant idiom: a nested ``def loop():`` in the
+            # spawning function — promote it to a synthetic entry
+            if fi is not None:
+                for node in ast.walk(fi.node):
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) and \
+                            node.name == name and node is not fi.node:
+                        syn = FunctionInfo(
+                            fi.module,
+                            f"{fi.name}.<locals>.{name}", node, fi.cls)
+                        self.functions.setdefault(syn.qualname, syn)
+                        return syn.qualname
+            if f"{mod}:{name}" in self.functions:
+                return f"{mod}:{name}"
+            imp = project.imports.get(mod, {}).get(name)
+            if imp:
+                m, _, f = imp.rpartition(".")
+                if f"{m}:{f}" in self.functions:
+                    return f"{m}:{f}"
+            return None
+        if len(segs) == 2:
+            # w = Worker(...); Thread(target=w.run)
+            if fi is not None:
+                for node in walk_own(fi.node):
+                    if isinstance(node, ast.Assign) and \
+                            isinstance(node.value, ast.Call) and \
+                            any(isinstance(t, ast.Name) and
+                                t.id == segs[0]
+                                for t in node.targets):
+                        ctor = dotted(node.value.func)
+                        cq = ctor and project.resolve_class(mod, ctor)
+                        if cq:
+                            m = project._method(cq, segs[1])
+                            return m.qualname if m else None
+            imp = project.imports.get(mod, {}).get(segs[0])
+            if imp:
+                if f"{imp}:{segs[1]}" in self.functions:
+                    return f"{imp}:{segs[1]}"
+                cq = project.resolve_class(mod, segs[0])
+                if cq:
+                    m = project._method(cq, segs[1])
+                    return m.qualname if m else None
+        return None
+
+    @staticmethod
+    def _daemon(call: ast.Call, fi: Optional[FunctionInfo]) -> bool:
+        for kw in call.keywords:
+            if kw.arg == "daemon":
+                return isinstance(kw.value, ast.Constant) and \
+                    bool(kw.value.value)
+        if fi is not None:
+            # t.daemon = True after construction, same function
+            for node in walk_own(fi.node):
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Attribute) and
+                        t.attr == "daemon"
+                        for t in node.targets):
+                    v = node.value
+                    return isinstance(v, ast.Constant) and bool(v.value)
+        return False
+
+    @staticmethod
+    def _start_line(call: ast.Call,
+                    fi: Optional[FunctionInfo]) -> int:
+        """Line of the matching ``.start()`` (first one at or after
+        the spawn expression) — the happens-before frontier."""
+        best = 0
+        if fi is not None:
+            for node in walk_own(fi.node):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "start" and \
+                        node.lineno >= call.lineno:
+                    if best == 0 or node.lineno < best:
+                        best = node.lineno
+        return best or call.lineno
+
+    # ---- call graph + reachability ----
+
+    def _build_adjacency(self) -> None:
+        project = self.project
+        for q, fi in self.functions.items():
+            edges: Dict[str, ast.Call] = {}
+            for node in walk_own(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = project.resolve_call(fi, node)
+                if target is not None and \
+                        target.qualname in self.functions:
+                    edges.setdefault(target.qualname, node)
+            self._adj[q] = edges
+
+    def _bfs(self, label: str, seeds: List[str]) -> Set[str]:
+        seen: Set[str] = set()
+        frontier = [q for q in seeds if q in self.functions]
+        seen.update(frontier)
+        while frontier:
+            nxt: List[str] = []
+            for q in frontier:
+                for callee, call in sorted(
+                        self._adj.get(q, {}).items()):
+                    if callee in seen:
+                        continue
+                    seen.add(callee)
+                    self._parent[(label, callee)] = (q, call)
+                    nxt.append(callee)
+            frontier = nxt
+        return seen
+
+    def _compute_reachability(self) -> None:
+        labels: Dict[str, int] = {}
+        for root in self.roots:
+            # labels must be unique — two services both routing
+            # "/health" are distinct contexts
+            base = root.label
+            n = labels.get(base, 0)
+            labels[base] = n + 1
+            label = base if n == 0 else f"{base}#{n + 1}"
+            self._roots_by_label[label] = root
+            self.reach[label] = self._bfs(label, [root.target])
+        targets = {r.target for r in self.roots}
+        called: Set[str] = set()
+        for edges in self._adj.values():
+            called.update(edges)
+        seeds = sorted(q for q in self.functions
+                       if q not in targets and q not in called)
+        self.reach[MAIN] = self._bfs(MAIN, seeds)
+
+    # ---- queries ----
+
+    def contexts_of(self, qualname: str) -> frozenset:
+        return frozenset(label for label, reach in self.reach.items()
+                         if qualname in reach)
+
+    def root_of(self, label: str) -> Optional[ThreadRoot]:
+        return self._roots_by_label.get(label)
+
+    def is_multi(self, label: str) -> bool:
+        root = self._roots_by_label.get(label)
+        return root.multi if root is not None else False
+
+    def module_path(self, qualname: str) -> str:
+        fi = self.functions.get(qualname)
+        if fi is None:
+            return ""
+        ctx = self.project.modules.get(fi.module)
+        return ctx.path if ctx is not None else ""
+
+    def trace(self, label: str, qualname: str) -> Tuple[TraceStep, ...]:
+        """Spawn-site → call-chain stack placing ``qualname`` under
+        context ``label`` (empty when it is not reachable there)."""
+        if qualname not in self.reach.get(label, ()):
+            return ()
+        hops: List[TraceStep] = []
+        cur = qualname
+        while True:
+            parent = self._parent.get((label, cur))
+            if parent is None:
+                break
+            caller, call = parent
+            hops.append(TraceStep(
+                call.lineno, call.col_offset,
+                f"'{_short(caller)}' calls '{_short(cur)}'",
+                self.module_path(caller)))
+            cur = caller
+        hops.reverse()
+        root = self._roots_by_label.get(label)
+        if root is not None:
+            spawned = (f"in '{_short(root.spawner)}'"
+                       if root.spawner else "at module scope")
+            head = TraceStep(
+                root.line, root.col,
+                f"{root.kind} [{label}] spawned {spawned}, running "
+                f"'{_short(root.target)}'", root.path)
+            return (head,) + tuple(hops)
+        entry = TraceStep(
+            getattr(self.functions[cur].node, "lineno", 1),
+            getattr(self.functions[cur].node, "col_offset", 0),
+            f"'{_short(cur)}' runs on the caller's thread [main]",
+            self.module_path(cur))
+        return (entry,) + tuple(hops)
+
+    # ---- happens-before ----
+
+    def setup_closure(self, cls_q: str) -> Set[str]:
+        """Method names of ``cls_q`` only reachable from construction
+        (``__init__`` etc. plus helpers all of whose in-class callers
+        are themselves setup) — the object is not shared with other
+        threads while they run."""
+        if cls_q in self._setup_cache:
+            return self._setup_cache[cls_q]
+        info = self.project.classes.get(cls_q)
+        methods = dict(info.methods) if info else {}
+        callers: Dict[str, Set[str]] = {n: set() for n in methods}
+        for name, node in methods.items():
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Attribute) and \
+                        isinstance(sub.func.value, ast.Name) and \
+                        sub.func.value.id == "self" and \
+                        sub.func.attr in callers:
+                    callers[sub.func.attr].add(name)
+        setup = set(SETUP_METHODS)
+        changed = True
+        while changed:
+            changed = False
+            for name in set(methods) - setup:
+                if callers[name] and callers[name] <= setup:
+                    setup.add(name)
+                    changed = True
+        self._setup_cache[cls_q] = setup
+        return setup
+
+    def happens_before(self, access_func: str, access_line: int,
+                       other_label: str) -> bool:
+        """Init-before-``start()`` exemption: does an access in
+        ``access_func`` at ``access_line`` happen-before the root
+        behind ``other_label`` even starts?
+
+        Two orderings qualify. Inside the spawning function itself,
+        anything before the ``.start()`` line runs before the thread
+        exists. And a write in a class's setup closure (``__init__``
+        and helpers only construction reaches) completes before the
+        object is shared with ANY thread — except a root the same
+        setup closure itself started (``self`` escaped mid-
+        construction), which runs concurrently with the rest of it.
+        """
+        root = self._roots_by_label.get(other_label)
+        if root is None:
+            return False
+        if access_func == root.spawner:
+            return access_line < root.start_line
+        fi = self.functions.get(access_func)
+        if fi is None or fi.cls is None:
+            return False
+        setup = self.setup_closure(fi.cls)
+        if _method_name(fi) not in setup:
+            return False
+        sp = self.functions.get(root.spawner) if root.spawner else None
+        if sp is not None and sp.cls == fi.cls and \
+                _method_name(sp) in setup:
+            return False  # self escaped during construction
+        return True
+
+
+def _method_name(fi: FunctionInfo) -> str:
+    return fi.name.rsplit(".", 1)[-1] if "." in fi.name else fi.name
+
+
+def _short(qualname: Optional[str]) -> str:
+    """``pkg.mod:Cls.meth`` -> ``Cls.meth`` for messages."""
+    return qualname.rsplit(":", 1)[-1] if qualname else "?"
+
+
+def _const_str(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None

@@ -310,7 +310,10 @@ class Predictor:
         if not force and now - self._last_drain_refresh < \
                 self.DRAIN_REFRESH_EVERY_S:
             return
-        self._last_drain_refresh = now
+        # lock-free rate-limiter stamp: threads racing the
+        # check-then-set at worst both refresh (one redundant hub
+        # read), never corrupt state
+        self._last_drain_refresh = now  # rafiki: noqa[shared-state-race]
         for wid, st in self.breakers.snapshot().items():
             if not st.get("draining"):
                 continue
@@ -337,7 +340,11 @@ class Predictor:
         with self._lock:
             if wid in self.worker_ids:
                 return
-            self.worker_ids.append(wid)
+            # membership mutations all hold _lock; the lock-free
+            # readers are single GIL-atomic len()/list() snapshots in
+            # advisory payload fields, where one-refresh staleness is
+            # part of the contract (see _refresh_membership)
+            self.worker_ids.append(wid)  # rafiki: noqa[shared-state-race]
         self.breakers.add_worker(wid)
         self.router.add_worker(wid)
 
@@ -369,7 +376,9 @@ class Predictor:
         if not force and now - self._last_pool_refresh < \
                 self.POOL_REFRESH_EVERY_S:
             return
-        self._last_pool_refresh = now
+        # lock-free rate-limiter stamp, same contract as
+        # _last_drain_refresh above
+        self._last_pool_refresh = now  # rafiki: noqa[shared-state-race]
         try:
             pool = self.hub.get_pool_members(self.pool_id)
         except Exception:  # rafiki: noqa[silent-except] — a hub hiccup
@@ -385,7 +394,10 @@ class Predictor:
             version = 0.0
         if version and version <= self._pool_version:
             return  # already applied (or an out-of-order straggler)
-        self._pool_version = max(self._pool_version, version)
+        # monotone float under max(): two racing refreshers at worst
+        # re-apply the same membership diff, which is idempotent
+        self._pool_version = max(  # rafiki: noqa[shared-state-race]
+            self._pool_version, version)
         with self._lock:
             have = list(self.worker_ids)
         for wid in workers:
@@ -444,7 +456,8 @@ class Predictor:
             return self.gather_timeout
         with self._lock:
             lat = sorted(self._reply_lat)
-        if len(lat) < 2 * len(self.worker_ids):
+            n_workers = len(self.worker_ids)
+        if len(lat) < 2 * n_workers:
             return self.gather_timeout  # warmup: no signal yet
         return max(self.min_gather_timeout,
                    min(self.gather_timeout,
@@ -827,8 +840,6 @@ class Predictor:
         return self._dp_verdict()
 
     def _data_plane_ok(self) -> None:
-        if self._dp_down_at is None:
-            return
         with self._lock:
             self._dp_down_at = None
 

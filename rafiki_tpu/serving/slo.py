@@ -36,6 +36,7 @@ chaos harness's stub engine run the SAME policy code:
 from __future__ import annotations
 
 import collections
+import threading
 from typing import Any, Deque, Dict, Optional, Tuple
 
 #: priority order, highest first: admission serves interactive before
@@ -238,7 +239,12 @@ class BrownoutController:
         self.exit_ratio = max(0.0, min(float(exit_ratio),
                                        self.enter_ratio))
         self.dwell = max(1, int(dwell))
-        self.stage = 0
+        # observe() runs on whatever request thread refreshed the load
+        # snapshot, and several can race: streak counters and the stage
+        # ladder mutate under the lock, readers come through the stage
+        # property
+        self._lock = threading.Lock()
+        self._stage = 0
         self.escalations = 0
         self.deescalations = 0
         self._hot = 0
@@ -249,6 +255,17 @@ class BrownoutController:
     def enabled(self) -> bool:
         return self.target_p95_s > 0
 
+    @property
+    def stage(self) -> int:
+        with self._lock:
+            return self._stage
+
+    @stage.setter
+    def stage(self, value: int) -> None:
+        # operator/test override: pin the ladder at a stage
+        with self._lock:
+            self._stage = value
+
     def observe(self, p95_s: Optional[float]) -> int:
         """Feed one interactive-p95 observation; returns the (possibly
         changed) stage. ``None``/non-positive observations (no
@@ -258,28 +275,30 @@ class BrownoutController:
             return self.stage
         v = float(p95_s) if isinstance(p95_s, (int, float)) and \
             not isinstance(p95_s, bool) else 0.0
-        self._last_p95 = v
-        if v > self.target_p95_s * self.enter_ratio:
-            self._hot += 1
-            self._cool = 0
-            if self._hot >= self.dwell and \
-                    self.stage < len(BROWNOUT_STAGES) - 1:
-                self.stage += 1
-                self.escalations += 1
-                self._hot = 0
-        elif v < self.target_p95_s * self.exit_ratio:
-            self._cool += 1
-            self._hot = 0
-            if self._cool >= self.dwell and self.stage > 0:
-                self.stage -= 1
-                self.deescalations += 1
+        with self._lock:
+            self._last_p95 = v
+            if v > self.target_p95_s * self.enter_ratio:
+                self._hot += 1
                 self._cool = 0
-        else:
-            # the sticky band between exit and enter: neither streak
-            # survives it — transitions need consecutive evidence
-            self._hot = 0
-            self._cool = 0
-        return self.stage
+                if self._hot >= self.dwell and \
+                        self._stage < len(BROWNOUT_STAGES) - 1:
+                    self._stage += 1
+                    self.escalations += 1
+                    self._hot = 0
+            elif v < self.target_p95_s * self.exit_ratio:
+                self._cool += 1
+                self._hot = 0
+                if self._cool >= self.dwell and self._stage > 0:
+                    self._stage -= 1
+                    self.deescalations += 1
+                    self._cool = 0
+            else:
+                # the sticky band between exit and enter: neither
+                # streak survives it — transitions need consecutive
+                # evidence
+                self._hot = 0
+                self._cool = 0
+            return self._stage
 
     # ---- what each stage means for admission (shared semantics:
     # ---- predictor shed gate and docs both read these) ----
@@ -289,13 +308,14 @@ class BrownoutController:
         stage >= 1, background drops to 0 (pause) at stage 3."""
         if slo == "interactive":
             return -1  # sentinel: no cap
+        stage = self.stage
         cap = max(0, int(base_cap))
-        if self.stage >= 1 and cap > 1:
+        if stage >= 1 and cap > 1:
             # halve, floored at 1 — but an operator cap of 0 or 1
             # stays put: the ladder may only TIGHTEN admission, never
             # raise a stricter configured cap
             cap = max(1, cap // 2)
-        if slo == "background" and self.stage >= 3:
+        if slo == "background" and stage >= 3:
             cap = 0
         return cap
 
@@ -309,10 +329,11 @@ class BrownoutController:
         return requested
 
     def snapshot(self) -> Dict[str, Any]:
-        return {"stage": self.stage,
-                "stage_name": BROWNOUT_STAGES[self.stage],
-                "target_p95_s": self.target_p95_s,
-                "enabled": self.enabled,
-                "last_interactive_p95_s": round(self._last_p95, 4),
-                "escalations": self.escalations,
-                "deescalations": self.deescalations}
+        with self._lock:
+            return {"stage": self._stage,
+                    "stage_name": BROWNOUT_STAGES[self._stage],
+                    "target_p95_s": self.target_p95_s,
+                    "enabled": self.enabled,
+                    "last_interactive_p95_s": round(self._last_p95, 4),
+                    "escalations": self.escalations,
+                    "deescalations": self.deescalations}

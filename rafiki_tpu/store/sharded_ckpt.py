@@ -244,7 +244,10 @@ class ShardedCheckpointer:
                         f.write(data.tobytes())
                 self._commit(d, manifest, process_index)
             except BaseException as e:  # noqa: BLE001 — held for wait()
-                self._pending_error = e
+                # wait() joins the thread before touching the parked
+                # error, so the two writers are join-ordered — a
+                # happens-before edge the static race model can't see
+                self._pending_error = e  # rafiki: noqa[shared-state-race]
 
         with self._async_lock:
             self._pending = threading.Thread(target=run, daemon=True)

@@ -660,7 +660,10 @@ class InferenceWorker:
             lambda _m, _b, _h: (self.drain() or
                                 (200, {"ok": True, "draining": True})))
         host, port = self._obs_server.start()
-        self._obs_port = port
+        # GIL-atomic int store read by the serve loop's stats
+        # publisher; a stale 0 only delays the obs_port advertisement
+        # by one publication
+        self._obs_port = port  # rafiki: noqa[shared-state-race]
         return host, port
 
     #: loop iterations between stats publications to the hub
@@ -1371,9 +1374,12 @@ class InferenceWorker:
                     # kwarg serves classless FIFO instead
                     # of dying on a TypeError
                     kwargs["slo"] = slo
-                self._req_obs[(m["id"], qi)] = (tid,
-                                                t_queued,
-                                                slo)
+                # _engine_span mutates this map too, but it is the
+                # engine's span_sink callback and runs on this same
+                # serve-loop thread — the model can't resolve callback
+                # registration, so it sees a second context
+                self._req_obs[(m["id"], qi)] = (  # rafiki: noqa[shared-state-race]
+                    tid, t_queued, slo)
                 blob = None if kv_blobs is None else kv_blobs.get(qi)
                 if blob is not None and not prefix:
                     try:

@@ -8,7 +8,10 @@
 # rules live in the same per-file pass, so --changed-only scopes them
 # for free. The whole-program rules always see the full package,
 # because cross-layer contracts (hub verb parity, lock ordering,
-# metric catalogs) can be broken by files you did NOT touch.
+# metric catalogs) can be broken by files you did NOT touch — and the
+# thread-model race layer (shared-state-race, atomic-rmw-race,
+# thread-lifecycle) rides in the same --project pass: a race pairs a
+# spawn site in one file with a bare write in another.
 set -e
 cd "$(dirname "$0")/.."
 exec python scripts/lint.py --changed-only HEAD --project rafiki_tpu

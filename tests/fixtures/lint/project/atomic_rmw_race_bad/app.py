@@ -1,0 +1,7 @@
+"""Wires the stats service onto an HTTP server."""
+
+from service import StatsService
+
+
+def main(http):
+    return StatsService(http)

@@ -1,0 +1,12 @@
+"""Request counter bumped from concurrent handler threads with no lock."""
+
+STATS = {"requests": 0}
+
+
+class StatsService:
+    def __init__(self, http):
+        http.route("GET", "/work", self._work)
+
+    def _work(self, request):
+        STATS["requests"] += 1
+        return {"ok": True}

@@ -1,0 +1,16 @@
+"""Request counter guarded by a module lock: read-modify-write is safe."""
+
+import threading
+
+STATS = {"requests": 0}
+_STATS_LOCK = threading.Lock()
+
+
+class StatsService:
+    def __init__(self, http):
+        http.route("GET", "/work", self._work)
+
+    def _work(self, request):
+        with _STATS_LOCK:
+            STATS["requests"] += 1
+        return {"ok": True}

@@ -1,0 +1,20 @@
+"""Slot table whose writers disagree about holding the lock.
+
+``admit`` mutates ``_live`` under ``_lock`` but ``evict_all`` clears it
+bare, so a reaper thread calling ``evict_all`` races every admit.
+"""
+
+import threading
+
+
+class SlotTable:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._live = {}
+
+    def admit(self, rid, slot):
+        with self._lock:
+            self._live[rid] = slot
+
+    def evict_all(self):
+        self._live.clear()

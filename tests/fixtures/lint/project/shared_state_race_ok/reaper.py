@@ -1,0 +1,19 @@
+"""Background reaper; safe because the table locks both sides."""
+
+import threading
+
+from slots import SlotTable
+
+
+class Reaper:
+    def __init__(self):
+        self.table = SlotTable()
+        self._t = threading.Thread(target=self._sweep, daemon=True)
+        self._t.start()
+
+    def admit(self, rid, slot):
+        self.table.admit(rid, slot)
+
+    def _sweep(self):
+        while True:
+            self.table.evict_all()

@@ -1,0 +1,24 @@
+"""Slot table where every ``_live`` access holds ``_lock``.
+
+``_evict_locked`` itself takes no lock: its only caller acquires it, so
+the interprocedural held-in fixpoint credits the helper with the lock.
+"""
+
+import threading
+
+
+class SlotTable:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._live = {}
+
+    def admit(self, rid, slot):
+        with self._lock:
+            self._live[rid] = slot
+
+    def evict_all(self):
+        with self._lock:
+            self._evict_locked()
+
+    def _evict_locked(self):
+        self._live.clear()

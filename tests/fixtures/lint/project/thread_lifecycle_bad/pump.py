@@ -1,0 +1,22 @@
+"""Non-daemon worker thread that ``close`` signals but never joins."""
+
+import queue
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._q = queue.Queue()
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+
+    def push(self, item):
+        self._q.put(item)
+
+    def _run(self):
+        while True:
+            if self._q.get() is None:
+                return
+
+    def close(self):
+        self._q.put(None)

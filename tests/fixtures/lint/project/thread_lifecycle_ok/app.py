@@ -1,0 +1,10 @@
+"""Drives the pump for a batch of items."""
+
+from pump import Pump
+
+
+def main(items):
+    p = Pump()
+    for item in items:
+        p.push(item)
+    p.close()

@@ -1,0 +1,41 @@
+"""Worker threads with sound lifecycles.
+
+``Pump.close`` joins its non-daemon worker; ``Beacon`` never joins but
+its thread is daemonic, so interpreter shutdown is not blocked.
+"""
+
+import queue
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._q = queue.Queue()
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+
+    def push(self, item):
+        self._q.put(item)
+
+    def _run(self):
+        while True:
+            if self._q.get() is None:
+                return
+
+    def close(self):
+        self._q.put(None)
+        self._t.join(timeout=5)
+
+
+class Beacon:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._blink, daemon=True)
+        self._t.start()
+
+    def _blink(self):
+        while not self._stop.wait(1.0):
+            pass
+
+    def close(self):
+        self._stop.set()

@@ -435,3 +435,36 @@ def test_asan_rerun_core_cases(tmp_path, asan_kvd, case, monkeypatch):
     the case spawns is instrumented."""
     monkeypatch.setenv("RAFIKI_KVD_SANITIZE", "address")
     case(tmp_path)
+
+
+@pytest.fixture(scope="module")
+def tsan_kvd():
+    """Build + boot-check a thread-sanitized kvd, or skip cleanly
+    where the toolchain/runtime can't produce or run one."""
+    try:
+        ensure_built(sanitize="thread")
+    except (RuntimeError, OSError,
+            subprocess.CalledProcessError) as e:
+        pytest.skip(f"TSan build unavailable: {e}")
+    try:
+        s = KVServer(sanitize="thread")
+    except (RuntimeError, OSError) as e:
+        pytest.skip(f"TSan kvd cannot run here: {e}")
+    s.stop()
+    return "thread"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("case", [
+    test_fsync_policies_accepted,
+    test_graceful_restart_restores_state,
+    test_dedup_push_within_and_across_restart,
+], ids=lambda case: case.__name__)
+def test_tsan_rerun_core_cases(tmp_path, tsan_kvd, case, monkeypatch):
+    """The dynamic counterpart of the static race layer: the same
+    kvd, instrumented by ThreadSanitizer, driven through the cases
+    that exercise its fsync thread and concurrent connection handling.
+    TSan aborts the server on any data race, which the cases surface
+    as protocol/boot failures."""
+    monkeypatch.setenv("RAFIKI_KVD_SANITIZE", "thread")
+    case(tmp_path)

@@ -1,0 +1,235 @@
+"""Unit tests for the whole-program thread model (analysis/threads.py).
+
+Each test builds a tiny on-disk project (the model resolves targets
+through ProjectContext, so sources must live in files) and asserts on
+root discovery, context reachability, witness traces, and the
+happens-before exemptions the race rules lean on.
+
+No jax import, no device work — runs in milliseconds.
+"""
+
+import textwrap
+
+from rafiki_tpu.analysis.project import ProjectContext
+from rafiki_tpu.analysis.threads import MAIN, ThreadModel
+
+
+def _model(tmp_path, **modules):
+    # module names include the root dir basename: pin it to ``proj``
+    # so qualnames are stable (``proj.svc:Svc._run``)
+    root = tmp_path / "proj"
+    root.mkdir()
+    for name, src in modules.items():
+        (root / f"{name}.py").write_text(textwrap.dedent(src))
+    return ThreadModel(ProjectContext([str(root)]))
+
+
+def _root(model, kind=None):
+    roots = [r for r in model.roots if kind is None or r.kind == kind]
+    assert len(roots) == 1, [r.label for r in model.roots]
+    return roots[0]
+
+
+# ---- root discovery ----
+
+def test_discovers_thread_target_method(tmp_path):
+    model = _model(tmp_path, svc="""\
+        import threading
+
+        class Svc:
+            def start(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                pass
+        """)
+    root = _root(model, "thread")
+    assert root.target == "proj.svc:Svc._run"
+    assert root.spawner == "proj.svc:Svc.start"
+    assert root.daemon
+    assert not root.multi
+
+
+def test_discovers_nested_def_loop_target(tmp_path):
+    model = _model(tmp_path, svc="""\
+        import threading
+
+        class Svc:
+            def start(self):
+                def loop():
+                    self.tick()
+                threading.Thread(target=loop).start()
+
+            def tick(self):
+                pass
+        """)
+    root = _root(model, "thread")
+    assert root.target == "proj.svc:Svc.start.<locals>.loop"
+    assert not root.daemon
+    # the synthetic nested-def entry reaches through to tick()
+    assert root.label in model.contexts_of("proj.svc:Svc.tick")
+
+
+def test_discovers_timer_and_executor_and_handler_roots(tmp_path):
+    model = _model(tmp_path, svc="""\
+        import threading
+
+        class Svc:
+            def __init__(self, http, pool):
+                http.route("GET", "/stats", self._stats)
+                pool.submit(self._warm)
+
+            def kick(self):
+                threading.Timer(5.0, self._expire).start()
+
+            def _stats(self, request):
+                pass
+
+            def _warm(self):
+                pass
+
+            def _expire(self):
+                pass
+        """)
+    kinds = {r.kind: r for r in model.roots}
+    assert set(kinds) == {"timer", "executor", "handler"}
+    assert kinds["handler"].target == "proj.svc:Svc._stats"
+    assert kinds["executor"].target == "proj.svc:Svc._warm"
+    assert kinds["timer"].target == "proj.svc:Svc._expire"
+    # handlers and executor tasks run arbitrarily many instances
+    assert kinds["handler"].multi
+    assert kinds["executor"].multi
+    assert not kinds["timer"].multi
+
+
+def test_spawn_inside_loop_is_multi_instance(tmp_path):
+    model = _model(tmp_path, svc="""\
+        import threading
+
+        class Pool:
+            def start(self, n):
+                for _ in range(n):
+                    threading.Thread(target=self._worker).start()
+
+            def _worker(self):
+                pass
+        """)
+    root = _root(model, "thread")
+    assert root.multi
+    assert model.is_multi(root.label)
+
+
+# ---- reachability + traces ----
+
+def test_reachability_propagates_through_calls(tmp_path):
+    model = _model(tmp_path, svc="""\
+        import threading
+
+        class Svc:
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                self._step()
+
+            def _step(self):
+                pass
+
+            def api(self):
+                self._step()
+        """)
+    label = _root(model, "thread").label
+    # _step runs under BOTH the thread (via _run) and main (via api)
+    assert model.contexts_of("proj.svc:Svc._step") == {label, MAIN}
+    assert model.contexts_of("proj.svc:Svc._run") == {label}
+    # api has no resolved caller: main-seeded
+    assert model.contexts_of("proj.svc:Svc.api") == {MAIN}
+
+
+def test_trace_walks_spawn_site_to_access_function(tmp_path):
+    model = _model(tmp_path, svc="""\
+        import threading
+
+        class Svc:
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                self._step()
+
+            def _step(self):
+                pass
+        """)
+    label = _root(model, "thread").label
+    steps = model.trace(label, "proj.svc:Svc._step")
+    assert len(steps) == 2
+    assert "spawned" in steps[0].note and "_run" in steps[0].note
+    assert "_run" in steps[1].note and "_step" in steps[1].note
+    assert all(s.path.endswith("svc.py") for s in steps)
+    # not reachable under a context -> empty witness
+    assert model.trace(label, "proj.svc:Svc.start") == ()
+
+
+# ---- happens-before exemptions ----
+
+def test_writes_before_start_happen_before_the_thread(tmp_path):
+    model = _model(tmp_path, svc="""\
+        class Svc:
+            def start(self, threading):
+                self.n = 0
+                t = threading.Thread(target=self._run)
+                t.start()
+                self.n = 1
+
+            def _run(self):
+                pass
+        """)
+    root = _root(model, "thread")
+    before, after = 3, 6
+    assert model.happens_before("proj.svc:Svc.start", before, root.label)
+    assert not model.happens_before("proj.svc:Svc.start", after, root.label)
+
+
+def test_setup_closure_writes_happen_before_foreign_roots(tmp_path):
+    model = _model(tmp_path, svc="""\
+        import threading
+
+        class Sink:
+            def __init__(self):
+                self.n = 0
+                self._configure()
+
+            def _configure(self):
+                self.n = 1
+
+        class Driver:
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                pass
+        """)
+    root = _root(model, "thread")
+    # __init__ and its private helper finish before the object can be
+    # handed to any thread
+    assert "_configure" in model.setup_closure("proj.svc:Sink")
+    assert model.happens_before("proj.svc:Sink.__init__", 5, root.label)
+    assert model.happens_before("proj.svc:Sink._configure", 8, root.label)
+
+
+def test_self_escape_during_construction_is_not_exempt(tmp_path):
+    model = _model(tmp_path, svc="""\
+        import threading
+
+        class Svc:
+            def __init__(self):
+                threading.Thread(target=self._run).start()
+                self.n = 0
+
+            def _run(self):
+                pass
+        """)
+    root = _root(model, "thread")
+    # the same __init__ spawned the thread before the write: no edge
+    assert not model.happens_before("proj.svc:Svc.__init__", 6, root.label)
