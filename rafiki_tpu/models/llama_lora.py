@@ -45,8 +45,11 @@ from rafiki_tpu.model import (BaseModel, CategoricalKnob, FixedKnob,
                               TrainContext, same_tree_shapes, train_epoch)
 from rafiki_tpu.models.bert import _TOKEN_RE, PAD_ID, HashTokenizer
 from rafiki_tpu.ops.attention import flash_attention
-from rafiki_tpu.ops.paged_attention import (paged_decode_attention,
-                                            resolve_paged_kernel)
+from rafiki_tpu.ops.paged_attention import (kv_cache_write,
+                                            paged_decode_attention,
+                                            paged_window_attention,
+                                            resolve_paged_kernel,
+                                            resolve_paged_window_kernel)
 from rafiki_tpu.parallel.sharding import (DATA_AXIS, MODEL_AXIS,
                                           batch_sharding, make_mesh,
                                           param_shardings)
@@ -290,14 +293,18 @@ class _DecoderAttention(nn.Module):
     kv_page_size: int = 0
     kv_pages: int = 0
     #: paged decode dispatch (kv_page_size > 0 only): ``None`` (auto)
-    #: runs the Pallas paged-attention kernel — which walks the block
+    #: runs the Pallas paged-attention kernels — which walk the block
     #: table directly instead of gathering pages back to logical order
     #: — on TPU and the page gather off-TPU; ``True``/``False`` force
     #: one path (tests force ``True``, riding the interpreter on CPU).
-    #: Only the single-token decode step (s == 1, the generation hot
-    #: loop) takes the kernel; chunked prefill and speculative verify
-    #: windows keep the gather (multi-query windows are matmul-bound,
-    #: not page-walk-bound). See ``ops/paged_attention.py``.
+    #: EVERY decode call is kernel-eligible: the single-token step
+    #: (s == 1, the generation hot loop) takes
+    #: ``paged_decode_attention`` and multi-token windows (chunked
+    #: prefill, speculative verify) take ``paged_window_attention``,
+    #: which adds the in-window causal mask. Windows honor one extra
+    #: operational escape hatch — ``RAFIKI_PAGED_KERNEL_WINDOWS=0``
+    #: drops them back onto the gather (step-only mode) without
+    #: touching the hot loop. See ``ops/paged_attention.py``.
     paged_kernel: Optional[bool] = None
 
     @nn.compact
@@ -393,11 +400,15 @@ class _DecoderAttention(nn.Module):
                             (b, page_tables.shape[1]
                              * self.kv_page_size) + c.shape[2:])
                     return c
-                # the paged-native kernel takes the single-token decode
-                # step (the generation hot loop); multi-token windows
-                # (chunked prefill, speculative verify) keep the gather
-                use_kernel = (paged and s == 1
-                              and resolve_paged_kernel(self.paged_kernel))
+                # every paged decode call is kernel-eligible: the
+                # single-token step takes the step kernel, multi-token
+                # windows (chunked prefill, speculative verify) take
+                # the window kernel — unless the window escape hatch
+                # drops them back onto the gather (step-only mode)
+                use_kernel = (
+                    paged and resolve_paged_kernel(self.paged_kernel)
+                    and (s == 1 or
+                         resolve_paged_window_kernel(self.paged_kernel)))
                 if self.kv_int8:
                     def q8(u):
                         scale = jnp.maximum(
@@ -410,23 +421,40 @@ class _DecoderAttention(nn.Module):
 
                     qk_, sk_ = q8(k)
                     qv_, sv_ = q8(v)
-                    ck.value = ck.value.at[widx].set(qk_)
-                    cv.value = cv.value.at[widx].set(qv_)
-                    sk.value = sk.value.at[widx].set(sk_)
-                    sv.value = sv.value.at[widx].set(sv_)
+                    writes = [(ck, qk_), (cv, qv_), (sk, sk_),
+                              (sv, sv_)]
                 else:
-                    ck.value = ck.value.at[widx].set(k)
-                    cv.value = cv.value.at[widx].set(v)
+                    writes = [(ck, k), (cv, v)]
+                # EVERY cache write — paged or contiguous, kernel or
+                # gather — goes through the partitioner shield (a
+                # no-op on real TPU and single-device CPU): under a
+                # multi-device interpret mesh the inline set-scatter
+                # is re-lowered so cache replicas diverge and
+                # reconcile additively, storing K exactly DOUBLED
+                # (see ops/paged_attention.kv_cache_write)
+                for var, val in writes:
+                    var.value = kv_cache_write(
+                        var.value, widx[0], widx[1], val)
                 if use_kernel:
                     # walk the block table directly: partial softmax
                     # per pool page, LSE-merged, int8 dequant fused
-                    # into the page load, dead pages skipped — per-step
+                    # into the page load, dead pages skipped — per-call
                     # HBM traffic scales with live tokens
-                    o = paged_decode_attention(
-                        q[:, 0], ck.value, cv.value, page_tables,
-                        t[:, 0], sm_scale=1.0 / float(np.sqrt(dh)),
-                        **({"k_scale": sk.value, "v_scale": sv.value}
-                           if self.kv_int8 else {}))[:, None]
+                    scales = ({"k_scale": sk.value, "v_scale": sv.value}
+                              if self.kv_int8 else {})
+                    sm = 1.0 / float(np.sqrt(dh))
+                    if s == 1:  # generation hot loop — unchanged
+                        o = paged_decode_attention(
+                            q[:, 0], ck.value, cv.value, page_tables,
+                            t[:, 0], sm_scale=sm, **scales)[:, None]
+                    else:
+                        # window positions are nondecreasing per row
+                        # by construction of the engine's prefill and
+                        # verify windows (idle/overhang rows repeat
+                        # the last real entry) — the kernel's contract
+                        o = paged_window_attention(
+                            q, ck.value, cv.value, page_tables, t,
+                            sm_scale=sm, **scales)
                 elif self.kv_int8:
                     # multiply in f32 and cast the PRODUCT: casting the
                     # scales to bf16 first would throw away the very
@@ -2174,9 +2202,13 @@ class LlamaLoRA(BaseModel):
         contiguous (drafts are small).
 
         ``paged_kernel`` (paged engines only): ``None`` (auto, the
-        default) decodes through the Pallas block-table kernel on TPU
+        default) decodes through the Pallas block-table kernels on TPU
         and the page gather off-TPU; ``True``/``False`` force one
-        path (see ``ops/paged_attention.py``).
+        path. Every decode leg is covered: the s==1 step, chunked
+        prefill windows, and speculative-verify windows (the last two
+        via ``paged_window_attention``; ``RAFIKI_PAGED_KERNEL_WINDOWS=0``
+        drops just the windows back onto the gather). See
+        ``ops/paged_attention.py``.
 
         ``host_kv_pages > 0`` (paged engines only) attaches the
         host-RAM page tier: the admission budget becomes HBM + host

@@ -35,19 +35,37 @@ generated token. This kernel consumes the pool **directly**:
   ``flash_attention``'s head tiling (env default via
   ``_env_block_h``, same divisibility fallback).
 
+The single-token step above was PR 10; ``paged_window_attention``
+generalizes it to an (s >= 1) **query window** so chunked prefill and
+speculative-verify calls run paged-native too. The grid gains a
+query-tile dimension (``block_q`` window rows per program), the same
+block-table walk and LSE-merge recurrence stream across pages per query
+tile, and the causal mask becomes per ROW: window token i at absolute
+position ``positions[b, i]`` sees keys ``k_pos <= positions[b, i]``
+(the s==1 "last token sees everything" rule is the degenerate case).
+Window positions must be NONDECREASING along each row — exactly what
+the engine's prefill/verify windows provide (idle and overhang rows
+repeat the last real entry) — so a query tile's last row bounds its
+live pages and dead-page skipping carries over per tile.
+
 Dispatch policy (mirrors ``ops/attention.py``): the decode path runs
 the kernel on TPU by default and falls back to the page gather off-TPU
-(``resolve_paged_kernel``); ``interpret=True`` forces the kernel
-through the Pallas interpreter, which is how the CPU tier-1 equivalence
-tests run it. Numerics: f32 accumulation regardless of pool dtype; the
-online softmax is the associativity-reordered twin of the gather path's
-masked softmax, so outputs agree to f32 roundoff (token-exact in
-practice — proven per decode mode in ``tests/test_paged_kv.py``).
+(``resolve_paged_kernel``); multi-token windows additionally honor the
+``RAFIKI_PAGED_KERNEL_WINDOWS`` escape hatch
+(``resolve_paged_window_kernel``), which drops the engine back to
+step-only kernel mode without touching the s==1 hot loop.
+``interpret=True`` forces the kernel through the Pallas interpreter,
+which is how the CPU tier-1 equivalence tests run it. Numerics: f32
+accumulation regardless of pool dtype; the online softmax is the
+associativity-reordered twin of the gather path's masked softmax, so
+outputs agree to f32 roundoff (token-exact in practice — proven per
+decode mode in ``tests/test_paged_kv.py``).
 """
 
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -68,6 +86,92 @@ def resolve_paged_kernel(flag: Optional[bool]) -> bool:
     if flag is None:
         return jax.default_backend() == "tpu"
     return bool(flag)
+
+
+def resolve_paged_window_kernel(flag: Optional[bool]) -> bool:
+    """Dispatch rule for the MULTI-TOKEN window legs (chunked prefill,
+    speculative verify). Windows ride the same tri-state ``paged_kernel``
+    flag as the s==1 step, with one extra operational escape hatch:
+    ``RAFIKI_PAGED_KERNEL_WINDOWS=0`` (or ``false``/``off``) forces the
+    window legs back onto the gather fallback — step-only kernel mode —
+    without touching the single-token hot loop. Default is enabled, so
+    wherever ``resolve_paged_kernel`` says kernel, windows go kernel
+    too."""
+    if os.environ.get("RAFIKI_PAGED_KERNEL_WINDOWS", "1").lower() in (
+            "0", "false", "off"):
+        return False
+    return resolve_paged_kernel(flag)
+
+
+def _partitioner_shield(call, *operands):
+    """Run a pallas call as a fully-replicated ``shard_map`` manual
+    region when the Pallas INTERPRETER executes under a multi-device
+    backend (the CPU tier-1 test mesh).
+
+    Interpret mode lowers the kernel to an ordinary XLA while-loop, and
+    the auto-SPMD partitioner is free to slice its internals across
+    devices. Empirically that choice leaks OUT of the kernel: with the
+    loop in the program, the partitioner re-shards the surrounding
+    cache-update scatter into an add-combined form that applies every
+    update once PER REPLICA GROUP — the KV pool comes back exactly
+    doubled (reproduced under the 8-device CPU mesh; the gather-only
+    twin of the same program is correct). Marking the kernel region
+    manual with every operand replicated keeps the partitioner out of
+    the interpreter loop entirely, and the surrounding program then
+    partitions exactly as the gather path does. Real-TPU programs
+    (``interpret=False``) never take this wrapper: there the kernel is
+    an opaque custom call and partitions as it always has.
+    """
+    import numpy as np
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    mesh = Mesh(np.asarray(jax.devices()), ("_pk_replica",))
+    spec = PartitionSpec()
+    # materialize TRUE replicas first: an operand may reach this point
+    # as a pending partial-sum (the partitioner splitting an upstream
+    # contraction), and ``check_rep=False`` would hand each device its
+    # partial as if it were the whole value. The explicit constraint
+    # forces the all-reduce BEFORE the manual region.
+    replicated = NamedSharding(mesh, spec)
+    operands = tuple(
+        jax.lax.with_sharding_constraint(o, replicated)
+        for o in operands)
+    return shard_map(
+        call, mesh=mesh, in_specs=(spec,) * len(operands),
+        out_specs=spec, check_rep=False)(*operands)
+
+
+def kv_cache_write(cache, idx0, idx1, values,
+                   interpret: Optional[bool] = None):
+    """Scatter a decode window's K/V (or scale) rows into the KV cache:
+    ``cache[idx0[b, i], idx1[b, i]] = values[b, i]`` — ``(pool page,
+    page slot)`` indices for the paged layout, ``(batch row, position)``
+    for the contiguous one.
+
+    Semantically this is nothing but ``cache.at[idx0, idx1].set(values)``
+    — and that is exactly what runs on real TPU and on a single-device
+    CPU. Under a MULTI-device interpret mesh it detours through the
+    partitioner shield instead, because the auto-SPMD partitioner
+    re-lowers the inline set-scatter in a way that lets the cache
+    replicas diverge and then reconciles them ADDITIVELY: the rope'd K
+    projection reaches the scatter as a pending partial-sum, each
+    replica group writes its partial, and the stored K comes back
+    exactly DOUBLED (reproduced on the 8-device CPU test mesh against
+    a single-device ground truth; V, whose updates happen to reach the
+    scatter fully reduced, survives). The corruption was invisible
+    while every decode program shared it — token parity held between
+    equally-wrong twins — and surfaced the moment one path stopped
+    being wrong. Routing the write through the replicated manual
+    region (see :func:`_partitioner_shield`) pins the single-device
+    lowering everywhere the interpreter runs.
+    """
+    def write(c, i0, i1, v):
+        return c.at[i0, i1].set(v)
+
+    if _resolve_interpret(interpret) and jax.device_count() > 1:
+        return _partitioner_shield(write, cache, idx0, idx1, values)
+    return write(cache, idx0, idx1, values)
 
 
 def _paged_decode_kernel(t_ref, tab_ref, q_ref, k_ref, v_ref, *rest,
@@ -217,13 +321,216 @@ def paged_decode_attention(q, k_pool, v_pool, page_tables, positions,
         _paged_decode_kernel, sm_scale=float(sm_scale),
         page_size=page_size, block_h=block_h, n_tables=n_tables,
         quantized=quantized)
-    out = pl.pallas_call(
+    call = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, n_kv, rep, dh), q.dtype),
         interpret=interpret,
-    )(t, tabs, *operands)
+    )
+    if interpret and jax.device_count() > 1:
+        out = _partitioner_shield(call, t, tabs, *operands)
+    else:
+        out = call(t, tabs, *operands)
     return out.reshape(b, n_heads, dh)
+
+
+def _paged_window_kernel(t_ref, tab_ref, q_ref, k_ref, v_ref,
+                         *rest, sm_scale: float, page_size: int,
+                         block_h: int, block_q: int, rep: int,
+                         n_tables: int, quantized: bool):
+    from jax.experimental import pallas as pl
+
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_scr, l_scr, acc_scr = rest
+    bi = pl.program_id(0)
+    qt = pl.program_id(2)
+    pg = pl.program_id(3)
+    # this query tile's absolute positions, straight off the scalar
+    # prefetch (SMEM) — the same array the index maps walk, so masks
+    # and fetches can never disagree
+    tile_t = t_ref[bi, pl.ds(qt * block_q, block_q)]  # (block_q,)
+    # positions are NONDECREASING along the window (the engine repeats
+    # the last real entry into idle/overhang rows), so this tile's last
+    # row bounds its live pages — the per-tile twin of the step
+    # kernel's n_live
+    n_live = tile_t[block_q - 1] // page_size + 1
+
+    @pl.when(pg == 0)
+    def _init():  # fresh (batch, head-tile, query-tile) row
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(pg < n_live)
+    def _partial():  # dead pages: no compute, fetch collapsed onto the
+        # scratch page by the index map
+        k_pos = pg * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)  # (1, page_size)
+        # per-ROW causal horizon: query row r is window token r // rep
+        # and sees keys k_pos <= its own absolute position — inside the
+        # window, earlier tokens do NOT see later tokens' keys
+        t_rows = jnp.repeat(tile_t, rep)[:, None]  # (bq*rep, 1)
+        mask = k_pos <= t_rows  # (block_q*rep, page_size)
+        for hh in range(block_h):  # static unroll over the head tile
+            q = (q_ref[0, hh].reshape(block_q * rep, -1)
+                 .astype(jnp.float32) * sm_scale)  # (bq*rep, dh)
+            k = k_ref[0, :, hh, :].astype(jnp.float32)  # (page_size, dh)
+            v = v_ref[0, :, hh, :].astype(jnp.float32)
+            if quantized:  # dequant in registers, fused into the math
+                k = k * ks_ref[0, :, hh][:, None]
+                v = v * vs_ref[0, :, hh][:, None]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # (bq*rep, psz)
+            s = jnp.where(mask, s, NEG_INF)
+
+            m_prev = m_scr[hh]  # (bq*rep, 1) running max
+            m_new = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)
+            l_scr[hh] = l_scr[hh] * alpha + jnp.sum(p, -1, keepdims=True)
+            acc_scr[hh] = acc_scr[hh] * alpha + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)  # (bq*rep, dh)
+            m_scr[hh] = m_new
+
+    @pl.when(pg == n_tables - 1)
+    def _finish():  # k_pos 0 <= any position, so l > 0 on every row
+        o_ref[0] = (acc_scr[...] /
+                    jnp.maximum(l_scr[...], 1e-30)).reshape(
+                        block_h, block_q, rep, -1).astype(o_ref.dtype)
+
+
+def _default_block_q(s: int) -> int:
+    """Largest window-tile width <= 16 that divides the window — the
+    same divisibility-fallback spirit as ``_env_block_h``."""
+    for d in range(min(s, 16), 0, -1):
+        if s % d == 0:
+            return d
+    return 1
+
+
+def paged_window_attention(q, k_pool, v_pool, page_tables, positions,
+                           sm_scale: float,
+                           k_scale=None, v_scale=None,
+                           block_h: Optional[int] = None,
+                           block_q: Optional[int] = None,
+                           interpret: Optional[bool] = None
+                           ) -> jnp.ndarray:
+    """Multi-token window attention straight off a paged KV pool.
+
+    The (s >= 1) generalization of ``paged_decode_attention`` serving
+    chunked prefill and speculative-verify windows:
+
+    - ``q``: (b, s, n_heads, dh) — a window of s query vectors per slot.
+    - ``k_pool``/``v_pool``/``k_scale``/``v_scale``: exactly as in
+      ``paged_decode_attention`` (the window's own K/V rows are already
+      written into the pool before the call — the decode branch writes
+      the chunk first, then attends).
+    - ``page_tables``: (b, n_tables) int32, dead entries on the scratch
+      page, live-width slices welcome — identical contract to the step
+      kernel.
+    - ``positions``: (b, s) int32, the absolute position of every window
+      token; row i of the window sees keys ``k_pos <= positions[b, i]``
+      (causal INSIDE the window, not just at its end). Rows must be
+      NONDECREASING: the engine's windows guarantee this (prefill pads
+      overhang with the last entry, verify freezes inactive slots), and
+      the kernel exploits it to bound live pages per query tile.
+
+    Returns (b, s, n_heads, dh) in ``q``'s dtype. ``block_q`` tiles the
+    window (must divide s; default: largest divisor <= 16), ``block_h``
+    tiles kv heads as in the step kernel. With s == 1 this computes
+    bit-for-bit the same output as ``paged_decode_attention`` — same op
+    shapes, same order — which the property tests pin.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, s, n_heads, dh = q.shape
+    n_pages, page_size, n_kv, dh_k = k_pool.shape
+    if dh_k != dh:
+        raise ValueError(f"head_dim mismatch: q has {dh}, pool {dh_k}")
+    rep = gqa_repeat_factor(n_heads, n_kv)
+    n_tables = page_tables.shape[1]
+    if block_h is None:
+        block_h = _env_block_h(n_kv)
+    if block_h < 1 or n_kv % block_h:
+        raise ValueError(f"block_h={block_h} must be >= 1 and divide "
+                         f"the kv head count ({n_kv})")
+    if block_q is None:
+        block_q = _default_block_q(s)
+    if block_q < 1 or s % block_q:
+        raise ValueError(f"block_q={block_q} must be >= 1 and divide "
+                         f"the window length ({s})")
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None):
+        raise ValueError("k_scale and v_scale must be passed together")
+    interpret = _resolve_interpret(interpret)
+
+    t = jnp.asarray(positions, jnp.int32)
+    if t.shape != (b, s):
+        raise ValueError(f"positions must be (b, s)=({b}, {s}), got "
+                         f"{t.shape}")
+    # group GQA query rows per kv head, window-major inside the head
+    # tile: (b, n_kv, s, rep, dh) — rep rows of one token stay adjacent
+    qw = q.reshape(b, s, n_kv, rep, dh).transpose(0, 2, 1, 3, 4)
+    tabs = jnp.asarray(page_tables, jnp.int32)
+
+    def q_map(bi, kh, qt, pg, t_ref, tab_ref):
+        return (bi, kh, qt, 0, 0)
+
+    def kv_map(bi, kh, qt, pg, t_ref, tab_ref):
+        # the block-table walk, bounded per QUERY TILE: nondecreasing
+        # positions make the tile's last row its page horizon, so dead
+        # pages collapse onto the scratch page exactly as in the step
+        # kernel
+        live = pg <= t_ref[bi, qt * block_q + block_q - 1] // page_size
+        return (jnp.where(live, tab_ref[bi, pg], 0), 0, kh, 0)
+
+    def sc_map(bi, kh, qt, pg, t_ref, tab_ref):
+        live = pg <= t_ref[bi, qt * block_q + block_q - 1] // page_size
+        return (jnp.where(live, tab_ref[bi, pg], 0), 0, kh)
+
+    in_specs = [
+        pl.BlockSpec((1, block_h, block_q, rep, dh), q_map),
+        pl.BlockSpec((1, page_size, block_h, dh), kv_map),
+        pl.BlockSpec((1, page_size, block_h, dh), kv_map),
+    ]
+    operands = [qw, k_pool, v_pool]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, page_size, block_h), sc_map),
+                     pl.BlockSpec((1, page_size, block_h), sc_map)]
+        operands += [k_scale, v_scale]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, n_kv // block_h, s // block_q, n_tables),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, block_h, block_q, rep, dh), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((block_h, block_q * rep, 1), jnp.float32),
+            pltpu.VMEM((block_h, block_q * rep, 1), jnp.float32),
+            pltpu.VMEM((block_h, block_q * rep, dh), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_window_kernel, sm_scale=float(sm_scale),
+        page_size=page_size, block_h=block_h, block_q=block_q, rep=rep,
+        n_tables=n_tables, quantized=quantized)
+    call = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, n_kv, s, rep, dh), q.dtype),
+        interpret=interpret,
+    )
+    if interpret and jax.device_count() > 1:
+        out = _partitioner_shield(call, t, tabs, *operands)
+    else:
+        out = call(t, tabs, *operands)
+    return out.transpose(0, 2, 1, 3, 4).reshape(b, s, n_heads, dh)
 
 
 def _paged_attention_reference(q, k_pool, v_pool, page_tables, positions,
@@ -255,3 +562,34 @@ def _paged_attention_reference(q, k_pool, v_pool, page_tables, positions,
                   s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhk,bkhd->bhd", p, v).astype(q.dtype)
+
+
+def _paged_window_reference(q, k_pool, v_pool, page_tables, positions,
+                            sm_scale: float, k_scale=None,
+                            v_scale=None) -> jnp.ndarray:
+    """Pure-XLA window oracle: gather the pages back into logical order
+    and run the per-row masked softmax in f32 — the same math the
+    multi-token gather fallback in ``_DecoderAttention`` computes."""
+    b, s, n_heads, dh = q.shape
+    _, page_size, n_kv, _ = k_pool.shape
+    rep = gqa_repeat_factor(n_heads, n_kv)
+    n_tables = page_tables.shape[1]
+    length = n_tables * page_size
+
+    def rows(pool):  # (b, length, n_kv, ...) logical view
+        return pool[page_tables].reshape((b, length) + pool.shape[2:])
+
+    k = rows(k_pool).astype(jnp.float32)
+    v = rows(v_pool).astype(jnp.float32)
+    if k_scale is not None:
+        k = k * rows(k_scale)[..., None]
+        v = v * rows(v_scale)[..., None]
+    k = jnp.repeat(k, rep, axis=2)
+    v = jnp.repeat(v, rep, axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k) * sm_scale
+    k_pos = jnp.arange(length)[None, None, None, :]
+    t = jnp.asarray(positions)[:, None, :, None]  # (b, 1, s, 1)
+    scores = jnp.where(k_pos <= t, scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v).astype(q.dtype)

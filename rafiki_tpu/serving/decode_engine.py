@@ -59,7 +59,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..obs.metrics import StatsMap
-from ..ops.paged_attention import resolve_paged_kernel
+from ..ops.paged_attention import (resolve_paged_kernel,
+                                   resolve_paged_window_kernel)
 from .kv_tier import HostPageTier
 from .kv_transfer import (LAYOUT_PAGED, LAYOUT_ROWS, check_kv_blob,
                           leaf_signature, make_kv_blob)
@@ -285,14 +286,24 @@ class DecodeEngine:
         #: parked slots by a monotonic park key, insertion-ordered
         self._parked: Dict[int, _Parked] = {}
         self._park_seq = 0
-        #: is the paged-native Pallas decode kernel live on this engine
+        #: which paged-native Pallas kernels are live on this engine
         #: (module flag resolved against the backend — the ops-level
-        #: dispatch rule)? Surfaced as the ``paged_kernel_active``
-        #: gauge so kernel-vs-gather fleets are tellable apart on
-        #: /metrics.
+        #: dispatch rules)? ``paged_kernel_active``: the s==1 step
+        #: kernel; ``paged_kernel_windowed``: the multi-token window
+        #: kernel on top (chunked prefill + speculative verify).
+        #: Surfaced as the ``paged_kernel_mode`` gauge (0 = gather /
+        #: contiguous, 1 = step-only, 2 = windowed) so kernel-vs-gather
+        #: fleets — and step-only escape-hatch fleets — are tellable
+        #: apart on /metrics.
+        _pk_flag = getattr(module, "paged_kernel", None)
         self.paged_kernel_active = bool(
-            self.paged and resolve_paged_kernel(
-                getattr(module, "paged_kernel", None)))
+            self.paged and resolve_paged_kernel(_pk_flag))
+        self.paged_kernel_windowed = bool(
+            self.paged_kernel_active
+            and resolve_paged_window_kernel(_pk_flag))
+        self.paged_kernel_mode = (2 if self.paged_kernel_windowed
+                                  else 1 if self.paged_kernel_active
+                                  else 0)
         self._ptab = np.zeros((self.B, self._n_table), np.int32)
         self._ptab_dev = jnp.asarray(self._ptab)
         self._ptab_dev_width = self._n_table
@@ -412,9 +423,17 @@ class DecodeEngine:
             # disaggregated prefill/decode: KV page shipments produced
             # (prefill role) and installed (decode role) by this engine
             "kv_exports": 0, "kv_imports": 0,
-            # 1 while the Pallas block-table decode kernel serves this
-            # engine's single-token steps (0 = page gather / contiguous)
-            "paged_kernel_active": int(self.paged_kernel_active)})
+            # which decode legs the Pallas block-table kernels serve:
+            # 0 = page gather / contiguous, 1 = step-only (s==1 hot
+            # loop; windows on the gather — the
+            # RAFIKI_PAGED_KERNEL_WINDOWS=0 escape hatch), 2 = windowed
+            # (chunked prefill + speculative verify too). The token
+            # counters say how much traffic each kernel actually
+            # carried: window tokens count prefill ingestion plus
+            # verify-window rows, step tokens count fused-scan rows.
+            "paged_kernel_mode": self.paged_kernel_mode,
+            "paged_kernel_step_tokens": 0,
+            "paged_kernel_window_tokens": 0})
         if self.host_pages:
             self.tier = HostPageTier(self.host_pages, self.stats)
         #: finished prefill-only shipments awaiting poll_kv
@@ -1190,7 +1209,7 @@ class DecodeEngine:
         """Zero the served-traffic counters without losing capacity
         gauges (``kv_pages_total`` describes the pool, not traffic) —
         what the worker's post-warmup scrub needs."""
-        keep = {"paged_kernel_active": int(self.paged_kernel_active),
+        keep = {"paged_kernel_mode": self.paged_kernel_mode,
                 "kv_host_pages_total": self.host_pages}
         if self.paged:
             keep.update(kv_pages_total=self.n_pages - 1,
@@ -1347,6 +1366,11 @@ class DecodeEngine:
                     pos_dev, aid_dev, self._ptab_arg())
             self.stats.inc("prefill_calls")
             self.stats.inc("prefill_tokens", int(adv.sum()))
+            if self.paged_kernel_windowed:
+                # these prompt tokens attended through the window
+                # kernel (the chunk call is an s=C window)
+                self.stats.inc("paged_kernel_window_tokens",
+                               int(adv.sum()))
             if self.prefill_token_cost_s:
                 # outside the engine lock (step releases it before
                 # prefill) so a dilated chunk stalls exactly what real
@@ -1732,6 +1756,12 @@ class DecodeEngine:
             jnp.asarray(self._aid), self._ptab_arg())
         emitted = np.asarray(emitted)  # rafiki: noqa[blocking-transfer-in-decode-loop] — the loop's OUTPUT sync: generated tokens must reach the host to stream; the fused K-step scan amortizes it
         self.stats.inc("steps", self.K)
+        if self.paged_kernel_active:
+            # every live lane ran K single-token steps through the
+            # step kernel inside this fused call
+            self.stats.inc(
+                "paged_kernel_step_tokens",
+                self.K * sum(1 for s in self._slots if s is not None))
         if self._draft_cache is not None:
             if not any_sampling and (
                     self._spec_ema >= self._spec_floor
@@ -1959,6 +1989,11 @@ class DecodeEngine:
         n_emit = np.asarray(n_emit)  # rafiki: noqa[blocking-transfer-in-decode-loop] — ditto (acceptance counts gate the host-side emit)
         self.stats.inc("steps")
         self.stats.inc("spec_calls")
+        if self.paged_kernel_windowed:
+            # each live lane attended a k-wide verify window through
+            # the window kernel (the draft model's own mirror passes
+            # stay contiguous and are not counted here)
+            self.stats.inc("paged_kernel_window_tokens", k * len(live))
         self._spec_idle = 0
         self._spec_ema = (SPEC_EMA_DECAY * self._spec_ema
                           + (1 - SPEC_EMA_DECAY)

@@ -231,7 +231,7 @@ class InferenceWorker:
         self._h_step = self.metrics.histogram(
             "decode_step_seconds",
             "one fused engine step() — admission + K decode tokens "
-            "(seconds); read next to paged_kernel_active to see the "
+            "(seconds); read next to paged_kernel_mode to see the "
             "kernel-vs-gather difference on a live worker")
         self._h_kv_transfer = self.metrics.histogram(
             "kv_transfer_seconds",

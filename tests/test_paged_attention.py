@@ -17,8 +17,11 @@ import jax
 import jax.numpy as jnp
 
 from rafiki_tpu.ops.paged_attention import (_paged_attention_reference,
+                                            _paged_window_reference,
                                             paged_decode_attention,
-                                            resolve_paged_kernel)
+                                            paged_window_attention,
+                                            resolve_paged_kernel,
+                                            resolve_paged_window_kernel)
 
 
 def _setup(positions, n_kv=2, rep=2, dh=8, ps=8, n_tables=4,
@@ -187,3 +190,206 @@ def test_resolve_paged_kernel_dispatch_rule():
     assert auto == (jax.default_backend() == "tpu")
     assert resolve_paged_kernel(True) is True
     assert resolve_paged_kernel(False) is False
+
+
+# ---------------------------------------------------------------------
+# multi-token WINDOW kernel (ISSUE 19): chunked prefill and speculative
+# verify attend (s >= 1) query windows straight off the pool, causal
+# INSIDE the window
+# ---------------------------------------------------------------------
+
+
+def _wsetup(positions, n_kv=2, rep=2, dh=8, ps=8, n_tables=4,
+            n_pages=12, seed=0, int8=False, scale=1.0,
+            dtype=np.float32):
+    """Window twin of ``_setup``: ``positions`` is (b, s) with
+    NONDECREASING rows (the engine's window invariant). Live pages
+    cover each row's maximum position; scratch page 0 carries loud
+    garbage."""
+    t = np.asarray(positions, np.int32)
+    b, s = t.shape
+    rng = np.random.default_rng(seed)
+    heads = n_kv * rep
+    q = (rng.normal(size=(b, s, heads, dh)) * scale).astype(dtype)
+    if int8:
+        kp = rng.integers(-127, 128,
+                          size=(n_pages, ps, n_kv, dh)).astype(np.int8)
+        vp = rng.integers(-127, 128,
+                          size=(n_pages, ps, n_kv, dh)).astype(np.int8)
+        ks = rng.uniform(1e-3, 0.1,
+                         size=(n_pages, ps, n_kv)).astype(np.float32)
+        vs = rng.uniform(1e-3, 0.1,
+                         size=(n_pages, ps, n_kv)).astype(np.float32)
+        scales = (ks, vs)
+    else:
+        kp = (rng.normal(size=(n_pages, ps, n_kv, dh))
+              * scale).astype(dtype)
+        vp = (rng.normal(size=(n_pages, ps, n_kv, dh))
+              * scale).astype(dtype)
+        kp[0], vp[0] = 1e3, -1e3  # scratch garbage: leaks are loud
+        scales = None
+    tabs = np.zeros((b, n_tables), np.int32)
+    free = list(rng.permutation(np.arange(1, n_pages)))
+    for i in range(b):
+        for pg in range(int(t[i].max()) // ps + 1):
+            tabs[i, pg] = free.pop()
+    return q, kp, vp, tabs, t, scales
+
+
+def _wboth(q, kp, vp, tabs, t, scales=None, **kw):
+    sm = 1.0 / np.sqrt(q.shape[-1])
+    sk, sv = scales if scales else (None, None)
+    out = paged_window_attention(q, kp, vp, tabs, t, sm_scale=sm,
+                                 k_scale=sk, v_scale=sv,
+                                 interpret=True, **kw)
+    ref = _paged_window_reference(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tabs), t, sm,
+        None if sk is None else jnp.asarray(sk),
+        None if sv is None else jnp.asarray(sv))
+    return np.asarray(out, np.float32), np.asarray(ref, np.float32)
+
+
+@pytest.mark.parametrize("positions", [
+    [[0, 1, 2, 3], [0, 1, 2, 3]],          # fresh prompts from zero
+    [[3, 4, 5, 6], [1, 2, 3, 4]],          # partial first page
+    [[5, 6, 7, 8], [13, 14, 15, 16]],      # window STRADDLES a page
+                                           # boundary (7→8, 15→16)
+    [[20, 21, 22, 23], [9, 9, 9, 9]],      # deep window + frozen row
+                                           # (an idle verify lane)
+    [[0, 1, 1, 1], [26, 27, 28, 28]],      # overhang rows repeating
+                                           # the last real entry
+])
+def test_window_causal_mask_matches_reference(positions):
+    """Per-ROW causality: window token i sees keys only up to its OWN
+    position — including rows mid-page, rows at page boundaries, and
+    frozen/padded rows."""
+    q, kp, vp, tabs, t, _ = _wsetup(positions)
+    out, ref = _wboth(q, kp, vp, tabs, t)
+    np.testing.assert_allclose(out, ref, atol=2e-6, rtol=1e-5)
+
+
+def test_window_scratch_garbage_never_leaks():
+    """The in-window causal mask must keep every row clear of the
+    scratch page's 1e3 garbage AND of later tokens' freshly-written
+    keys: the kernel answer equals an oracle over a pool whose scratch
+    page is ZEROED."""
+    q, kp, vp, tabs, t, _ = _wsetup([[2, 3, 4, 5], [14, 15, 16, 17],
+                                     [25, 26, 27, 28]])
+    sm = 1.0 / np.sqrt(q.shape[-1])
+    out = np.asarray(paged_window_attention(
+        q, kp, vp, tabs, t, sm_scale=sm, interpret=True), np.float32)
+    kz, vz = kp.copy(), vp.copy()
+    kz[0], vz[0] = 0.0, 0.0
+    ref0 = np.asarray(_paged_window_reference(
+        jnp.asarray(q), jnp.asarray(kz), jnp.asarray(vz),
+        jnp.asarray(tabs), t, sm), np.float32)
+    np.testing.assert_allclose(out, ref0, atol=2e-6, rtol=1e-5)
+
+
+def test_window_partial_last_pages_and_live_width():
+    """Rows whose last live page is partial, plus the live-width table
+    slice: the answer must not depend on dead trailing columns."""
+    pos = [[9, 10, 11, 12], [1, 2, 3, 4]]
+    q, kp, vp, tabs, t, _ = _wsetup(pos, n_tables=8)
+    full, ref = _wboth(q, kp, vp, tabs, t)
+    np.testing.assert_allclose(full, ref, atol=2e-6, rtol=1e-5)
+    narrow, _ = _wboth(q, kp, vp, tabs[:, :2], t)
+    np.testing.assert_allclose(full, narrow, atol=2e-6, rtol=1e-5)
+
+
+def test_window_lse_merge_across_magnitude_spread():
+    """Cross-page LSE merge stability with a per-row mask in play:
+    live pages scaled by 10^page move the running max on every merge
+    step for every window row."""
+    q, kp, vp, tabs, t, _ = _wsetup([[28, 29, 30, 31]] * 4, n_pages=20)
+    for i in range(tabs.shape[0]):
+        for pg in range(4):
+            kp[tabs[i, pg]] *= 10.0 ** pg
+    out, ref = _wboth(q, kp, vp, tabs, t)
+    # keys span 3 decades; the merge reorders the reduction, so allow
+    # a touch more roundoff than the unscaled cases
+    np.testing.assert_allclose(out, ref, atol=5e-5, rtol=1e-3)
+
+
+def test_window_gqa_ratios_block_h_and_block_q():
+    """rep in {1, 2, 4} × head tiling × window tiling all reproduce
+    the oracle; indivisible block_q fails as loudly as block_h."""
+    pos = [[4, 5, 6, 7, 8, 9], [17, 18, 19, 20, 21, 22]]
+    for n_kv, rep in ((4, 1), (2, 2), (1, 4)):
+        q, kp, vp, tabs, t, _ = _wsetup(pos, n_kv=n_kv, rep=rep,
+                                        seed=n_kv)
+        out, ref = _wboth(q, kp, vp, tabs, t)
+        np.testing.assert_allclose(out, ref, atol=2e-6, rtol=1e-5)
+    q, kp, vp, tabs, t, _ = _wsetup(pos, n_kv=4, rep=2)
+    for bq in (1, 2, 3, 6):
+        out, ref = _wboth(q, kp, vp, tabs, t, block_h=2, block_q=bq)
+        np.testing.assert_allclose(out, ref, atol=2e-6, rtol=1e-5)
+    with pytest.raises(ValueError, match="block_q"):
+        paged_window_attention(q, kp, vp, tabs, t, sm_scale=0.3,
+                               block_q=4, interpret=True)
+    with pytest.raises(ValueError, match="block_h"):
+        paged_window_attention(q, kp, vp, tabs, t, sm_scale=0.3,
+                               block_h=3, interpret=True)
+
+
+def test_window_int8_scale_rows_dequant_in_kernel():
+    """int8 pools + f32 absmax scale rows through the window kernel:
+    fused dequant matches the dequantize-then-attend oracle."""
+    q, kp, vp, tabs, t, scales = _wsetup([[3, 4, 5, 6], [13, 14, 15, 16],
+                                          [27, 28, 29, 30]], int8=True)
+    out, ref = _wboth(q, kp, vp, tabs, t, scales=scales)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-4)
+
+
+def test_window_s1_degenerate_bit_identical_to_step_kernel():
+    """s == 1 through the window kernel is the SAME computation as the
+    step kernel — same op shapes, same order — so outputs must be
+    bit-for-bit identical, f32 and int8 alike. This is what lets the
+    engine keep its hot loop on the step kernel while the window
+    kernel serves everything else."""
+    for int8 in (False, True):
+        q, kp, vp, tabs, t, scales = _setup([2, 9, 17, 30], int8=int8,
+                                            seed=int(int8))
+        sm = 1.0 / np.sqrt(q.shape[-1])
+        sk, sv = scales if scales else (None, None)
+        step = paged_decode_attention(q, kp, vp, tabs, t, sm_scale=sm,
+                                      k_scale=sk, v_scale=sv,
+                                      interpret=True)
+        win = paged_window_attention(q[:, None], kp, vp, tabs, t[:, None],
+                                     sm_scale=sm, k_scale=sk, v_scale=sv,
+                                     interpret=True)
+        assert np.array_equal(np.asarray(step), np.asarray(win[:, 0])), \
+            f"int8={int8}: window(s=1) diverged from the step kernel"
+
+
+def test_window_composes_with_jit():
+    """Prefill/verify programs call the window kernel from inside jit
+    with traced positions/tables — must trace cleanly."""
+    q, kp, vp, tabs, t, _ = _wsetup([[2, 3, 4, 5], [14, 15, 16, 17]])
+    sm = 1.0 / np.sqrt(q.shape[-1])
+
+    @jax.jit
+    def win(q, kp, vp, tabs, t):
+        return paged_window_attention(q, kp, vp, tabs, t, sm_scale=sm,
+                                      interpret=True)
+
+    out = np.asarray(win(q, kp, vp, tabs, t), np.float32)
+    _, ref = _wboth(q, kp, vp, tabs, t)
+    np.testing.assert_allclose(out, ref, atol=2e-6, rtol=1e-5)
+
+
+def test_resolve_paged_window_kernel_rule(monkeypatch):
+    """Windows follow the same tri-state flag as the step kernel, with
+    the RAFIKI_PAGED_KERNEL_WINDOWS escape hatch on top: unset/enabled
+    means windows go wherever the step kernel goes; 0/false/off forces
+    step-only mode."""
+    monkeypatch.delenv("RAFIKI_PAGED_KERNEL_WINDOWS", raising=False)
+    assert resolve_paged_window_kernel(True) is True
+    assert resolve_paged_window_kernel(False) is False
+    assert resolve_paged_window_kernel(None) == resolve_paged_kernel(None)
+    for off in ("0", "false", "off"):
+        monkeypatch.setenv("RAFIKI_PAGED_KERNEL_WINDOWS", off)
+        assert resolve_paged_window_kernel(True) is False
+    monkeypatch.setenv("RAFIKI_PAGED_KERNEL_WINDOWS", "1")
+    assert resolve_paged_window_kernel(True) is True

@@ -334,10 +334,12 @@ def test_worker_admission_consumes_paged_estimate(trained, monkeypatch):
     # gauge publishes (gather on CPU tier-1) and the worker's /metrics
     # carries the decode_step_seconds histogram the kernel difference
     # shows up in
-    assert s["engine_paged_kernel_active"] == 0
+    assert s["engine_paged_kernel_mode"] == 0
     prom = w.metrics.render_prometheus()
     assert "decode_step_seconds" in prom
-    assert "paged_kernel_active" in prom
+    assert "paged_kernel_mode" in prom
+    assert "paged_kernel_window_tokens" in prom
+    assert "paged_kernel_step_tokens" in prom
     # multi-adapter path: same limit arithmetic through its estimator
     # call (re-centred between ITS paged/contiguous totals — the
     # stacked adapters add a term of their own)
@@ -358,23 +360,34 @@ def test_worker_admission_consumes_paged_estimate(trained, monkeypatch):
 def _kernel_vs_gather(trained, reqs, engine_kw=None, submit_kw=None,
                       module_kw=None, params=None, pages=9):
     """Same paged traffic through the gather fallback and the Pallas
-    block-table kernel (forced on — the interpreter on CPU): tokens
-    must match exactly, and the obs gauge must tell the paths apart."""
+    block-table kernels (forced on — the interpreter on CPU): tokens
+    must match exactly, and the obs mode gauge must tell the paths
+    apart (0 = gather, 2 = step + window kernels; prefill and verify
+    windows dispatch through the window kernel too). Returns the
+    kernel run's outputs and its pre-scrub stats snapshot."""
     engine_kw = engine_kw or {}
     module_kw = module_kw or {}
     params = trained._params if params is None else params
     outs = {}
+    kstats = None
     for flag in (False, True):
         eng = DecodeEngine(
             trained._module(kv_page_size=PS, kv_pages=pages,
                             paged_kernel=flag, **module_kw),
             params, max_slots=4, max_len=L, **engine_kw)
         outs[flag] = _drain(eng, reqs, submit_kw)
-        assert eng.stats["paged_kernel_active"] == int(flag)
+        assert eng.stats["paged_kernel_mode"] == (2 if flag else 0)
+        if flag:
+            kstats = eng.stats_snapshot()
+        else:
+            # the gather engine's kernel token counters must not move
+            assert eng.stats["paged_kernel_step_tokens"] == 0
+            assert eng.stats["paged_kernel_window_tokens"] == 0
         eng.reset_stats()  # the worker's warmup scrub keeps the gauge
-        assert eng.stats["paged_kernel_active"] == int(flag)
+        assert eng.stats["paged_kernel_mode"] == (2 if flag else 0)
+        assert eng.stats["paged_kernel_step_tokens"] == 0
     assert outs[True] == outs[False], (outs[True], outs[False])
-    return outs[True]
+    return outs[True], kstats
 
 
 def test_kernel_matches_gather_greedy_and_sampled(trained):
@@ -413,15 +426,121 @@ def test_kernel_matches_gather_multi_adapter(trained):
 
 
 def test_kernel_matches_gather_speculative(trained):
-    """Speculative decoding: scan steps take the kernel, verify
-    windows keep the gather — the interleaving is still greedy-
-    lossless and token-identical to the all-gather engine."""
+    """Prompt-lookup speculation: scan steps take the step kernel AND
+    verify windows take the WINDOW kernel — the interleaving is still
+    greedy-lossless and token-identical to the all-gather engine, and
+    the window-token counter proves the verify windows actually rode
+    the kernel."""
     reqs = [(0, np.asarray([1, 7, 2, 7, 2, 7, 2], np.int32), 8),
             (1, np.asarray([1, 5, 9, 13], np.int32), 8),
             (2, np.asarray([1, 3], np.int32), 8)]
-    out = _kernel_vs_gather(trained, reqs, pages=13,
-                            engine_kw={"speculate_k": 4})
-    assert out  # all three drained through the mixed kernel/gather path
+    out, ks = _kernel_vs_gather(trained, reqs, pages=13,
+                                engine_kw={"speculate_k": 4})
+    assert out  # all three drained through the all-kernel path
+    assert ks["spec_calls"] > 0
+    # every verify call pushed a k-wide window per live lane through
+    # the window kernel (k=4, >= 1 live lane per call)
+    assert ks["paged_kernel_window_tokens"] >= 4 * ks["spec_calls"]
+
+
+def test_kernel_matches_gather_draft_model_verify(trained):
+    """Draft-MODEL speculation on a paged target: the draft's own
+    contiguous mirror passes stay off the paged kernels, but the
+    TARGET's verify window must dispatch through the window kernel —
+    token-identical to the all-gather engine."""
+    perfect = LlamaLoRA(**KNOBS)
+    perfect.load_parameters(trained.dump_parameters())
+    reqs = [(0, np.asarray([1, 7, 2, 7, 2, 7, 2], np.int32), 8),
+            (1, np.asarray([1, 5, 9, 13], np.int32), 8)]
+    outs = {}
+    kstats = None
+    for flag in (False, True):
+        eng = trained.make_decode_engine(
+            max_slots=4, max_new_tokens=8, speculate_k=4,
+            draft_model=perfect, kv_page_size=PS, kv_pages=13,
+            paged_kernel=flag).engine
+        for rid, p, mn in reqs:
+            eng.submit(rid, p, mn)
+        done = {}
+        for _ in range(600):
+            eng.step()
+            done.update(dict(eng.poll()))
+            if len(done) == len(reqs):
+                break
+        assert len(done) == len(reqs), (flag, sorted(done))
+        outs[flag] = done
+        assert eng.stats["paged_kernel_mode"] == (2 if flag else 0)
+        if flag:
+            kstats = eng.stats_snapshot()
+    assert outs[True] == outs[False], (outs[True], outs[False])
+    assert kstats["spec_draft_model_calls"] > 0
+    assert kstats["paged_kernel_window_tokens"] >= \
+        4 * kstats["spec_draft_model_calls"]
+
+
+def test_windowed_prefill_kernel_exact_and_counters(trained):
+    """Chunked prefill dispatches through the window kernel: long
+    prompts ingest token-exact vs the gather engine, and every prefill
+    token is accounted to ``paged_kernel_window_tokens`` (no spec
+    traffic here, so the two counters must agree exactly) while the
+    fused scan keeps feeding ``paged_kernel_step_tokens``."""
+    reqs = [("lp", np.arange(1, 20, dtype=np.int32), 5),
+            ("sp", np.asarray([3, 1, 4, 1, 5], np.int32), 5)]
+    _, ks = _kernel_vs_gather(trained, reqs,
+                              engine_kw={"prefill_chunk": 8})
+    assert ks["prefill_calls"] >= 1
+    assert ks["paged_kernel_window_tokens"] == ks["prefill_tokens"] > 0
+    assert ks["paged_kernel_step_tokens"] > 0
+
+
+def test_window_escape_hatch_forces_step_only_mode(trained, monkeypatch):
+    """RAFIKI_PAGED_KERNEL_WINDOWS=0: the engine reports step-only mode
+    (gauge 1), window traffic goes back to the gather (window-token
+    counter stays 0) while the s==1 hot loop keeps the step kernel —
+    and tokens stay exact vs the all-gather engine. A fresh pool
+    geometry (pages=11) keeps the cached compiled fns from other tests
+    (traced with windows enabled) out of this engine."""
+    monkeypatch.setenv("RAFIKI_PAGED_KERNEL_WINDOWS", "0")
+    reqs = _mixed_reqs(5, seed=11)
+    outs = {}
+    for flag in (False, True):
+        eng = DecodeEngine(
+            trained._module(kv_page_size=PS, kv_pages=11,
+                            paged_kernel=flag),
+            trained._params, max_slots=4, max_len=L, prefill_chunk=8)
+        outs[flag] = _drain(eng, reqs)
+        assert eng.stats["paged_kernel_mode"] == (1 if flag else 0)
+        assert eng.stats["paged_kernel_window_tokens"] == 0
+        if flag:
+            assert eng.stats["paged_kernel_step_tokens"] > 0
+    assert outs[True] == outs[False]
+
+
+def test_multi_token_gather_window_rides_live_width_slice(trained):
+    """Satellite: the gather-fallback prefill window consumes the
+    engine's LIVE-WIDTH page-table slice (and the width-following
+    mask), not the full table — off-TPU prefill must not gather dead
+    pages. Page size 4 gives an 8-wide table of which this traffic
+    can only ever light up half."""
+    module = trained._module(kv_page_size=4, kv_pages=17,
+                             paged_kernel=False)
+    eng = DecodeEngine(module, trained._params, max_slots=4, max_len=L,
+                       prefill_chunk=8)
+    widths = []
+    orig = eng._ptab_arg
+
+    def spy():
+        out = orig()
+        widths.append(int(out.shape[1]))
+        return out
+
+    eng._ptab_arg = spy
+    eng.submit("lp", np.arange(1, 11, dtype=np.int32), 4)  # 14 positions
+    while eng.busy:
+        eng.step()
+    eng.poll()
+    assert widths, "no compiled call consumed the table"
+    assert max(widths) <= 4 < eng._n_table  # live slice, never full width
 
 
 def test_paged_worker_serves_end_to_end(trained):

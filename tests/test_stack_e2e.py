@@ -170,7 +170,7 @@ def test_full_stack_lm_generation(stack):
     workers = (health.get("workers") or {}).values()
     assert any(s.get("engine_kv_pages_total") == 8 for s in workers), \
         health
-    assert any(s.get("engine_paged_kernel_active") == 0
+    assert any(s.get("engine_paged_kernel_mode") == 0
                for s in workers), health
     client.stop_inference_job(ijob["id"])
 
