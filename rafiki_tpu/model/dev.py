@@ -124,6 +124,19 @@ def tune_model(model_class: Type[BaseModel], train_dataset_path: str,
         from ..tuning import supports_gang
 
         if supports_gang(model_class):
+            blockers_fn = getattr(model_class, "gang_blockers", None)
+            if callable(blockers_fn) and knob_overrides:
+                # a pinned knob can force every bucket onto the
+                # sequential path; name the culprit up front instead of
+                # letting the engine's per-bucket fallback look like a
+                # silent slowdown (gang_blockers reads knobs via .get,
+                # so probing with just the pins is well-defined)
+                pinned = blockers_fn(dict(knob_overrides))
+                if pinned:
+                    warnings.warn(
+                        f"{model_class.__name__} gang lanes blocked by "
+                        "pinned knobs: " + "; ".join(pinned)
+                        + " — affected trials fall back to sequential")
             return _tune_model_gang(model_class, advisor,
                                     train_dataset_path, val_dataset_path,
                                     gang_size, knob_overrides, keep_params)

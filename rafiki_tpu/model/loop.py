@@ -95,14 +95,21 @@ class GangSpec:
     #: same batches the template's sequential loop sees at that epoch —
     #: the engine stacks one batch per lane from per-lane iterators)
     epoch_batches: Callable[[int], Iterator[Dict[str, np.ndarray]]]
-    #: ``(state, hp, xb) -> predicted class ids [B]`` — vmapped for
-    #: scoring; engine computes masked accuracy over ``eval_batches``
+    #: scoring contract per ``score_kind``: "accuracy" → ``(state, hp,
+    #: xb) -> predicted class ids [B]`` (engine computes masked accuracy
+    #: over ``eval_batches``); "lm" → ``(state, hp, batch) ->
+    #: (loss_sum, valid_count)`` scalars (engine accumulates and scores
+    #: ``exp(-sum/count)``, the LM template's inverse perplexity)
     eval_lane: Callable[[Any, Dict[str, Any], Any], Any]
-    #: ``() -> iterator of {"x", "y", "mask"} host eval batches``
+    #: ``() -> iterator of host eval batches`` ("accuracy": ``{"x", "y",
+    #: "mask"}``; "lm": whatever ``eval_lane`` consumes — the SAME
+    #: padded batch stream the template's ``evaluate()`` walks)
     eval_batches: Callable[[], Iterator[Dict[str, np.ndarray]]]
-    #: ``(lane_state) -> blob`` — a ``dump_parameters()``-shaped blob for
-    #: the ParamStore / TuneResult (host numpy)
-    export_blob: Callable[[Any], Dict[str, Any]]
+    #: ``(lane_state, hp) -> blob`` — a ``dump_parameters()``-shaped
+    #: blob for the ParamStore / TuneResult (host numpy). ``hp`` holds
+    #: the lane's traceable knob values as floats so value-folding
+    #: exports (e.g. LoRA rank-scale folded into ``lora_b``) see them
+    export_blob: Callable[[Any, Dict[str, float]], Dict[str, Any]]
     #: ``(fresh_state, parent_blob) -> state`` — warm-start a lane from a
     #: completed trial's blob (params from the blob, optimizer fresh —
     #: exactly what the sequential warm-start path does)
@@ -111,3 +118,30 @@ class GangSpec:
     #: engine only applies a proposal's warm start when this knob is
     #: truthy in its assignment (mirrors the sequential gate)
     share_params_knob: Optional[str] = None
+    #: how the engine scores lanes over ``eval_batches``: "accuracy"
+    #: (classification zoo) or "lm" (inverse perplexity — see
+    #: ``eval_lane``)
+    score_kind: str = "accuracy"
+    #: tokens one real training sample contributes per step (LM
+    #: templates: max_len). Feeds the engine's per-lane tokens/s
+    #: gauges; 0 disables token accounting
+    tokens_per_sample: int = 0
+    #: parameter count of ONE lane's full forward (broadcast base +
+    #: adapters) — the engine's per-lane est-MFU gauge uses the
+    #: 6·N·tokens/s approximation; 0 disables the gauge
+    lane_param_count: int = 0
+    #: XLA compiler options for the gang's jitted step (e.g. the
+    #: ``overlap_collectives`` schedule knob —
+    #: :func:`rafiki_tpu.parallel.sharding.overlap_compiler_options`);
+    #: None compiles with defaults. Static by construction: the knob is
+    #: non-traceable, so each option set is its own compile bucket
+    compiler_options: Optional[Dict[str, Any]] = None
+    #: optional ``(lane_state, hp, batch) -> eval terms`` running ONE
+    #: lane on the template's *sequential* ``evaluate()`` graph (e.g.
+    #: value-folding knobs applied eagerly, then the same jitted
+    #: forward ``evaluate()`` compiles). When set, the engine scores
+    #: lanes through this instead of vmapping ``eval_lane`` — scoring
+    #: is where the bit-exactness contract is settled, and a vmapped
+    #: (or differently fused) eval graph can drift in the low bits on
+    #: large forwards even though the math is identical
+    eval_seq: Optional[Callable[[Any, Dict[str, Any], Any], Any]] = None

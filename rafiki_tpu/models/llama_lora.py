@@ -41,8 +41,9 @@ from rafiki_tpu.constants import TaskType
 from rafiki_tpu.data import batch_iterator, \
     load_text_classification_dataset
 from rafiki_tpu.model import (BaseModel, CategoricalKnob, FixedKnob,
-                              FloatKnob, IntegerKnob, KnobConfig, PolicyKnob,
-                              TrainContext, same_tree_shapes, train_epoch)
+                              FloatKnob, GangSpec, IntegerKnob, KnobConfig,
+                              Knobs, PolicyKnob, TrainContext,
+                              same_tree_shapes, train_epoch)
 from rafiki_tpu.models.bert import _TOKEN_RE, PAD_ID, HashTokenizer
 from rafiki_tpu.ops.attention import flash_attention
 from rafiki_tpu.ops.paged_attention import (kv_cache_write,
@@ -52,6 +53,7 @@ from rafiki_tpu.ops.paged_attention import (kv_cache_write,
                                             resolve_paged_window_kernel)
 from rafiki_tpu.parallel.sharding import (DATA_AXIS, MODEL_AXIS,
                                           batch_sharding, make_mesh,
+                                          overlap_compiler_options,
                                           param_shardings)
 
 BOS_ID = 1  # reuse bert's CLS slot as BOS
@@ -591,6 +593,14 @@ class Llama(nn.Module):
     # double-write it): ~1/3 more FLOPs for O(depth) less activation
     # HBM. Identical math.
     remat: bool = False
+    # three-way checkpointing schedule, superseding the legacy `remat`
+    # bool when set: "none" (save everything), "full" (save only block
+    # boundaries — max recompute, min HBM), "policy" (dots_saveable:
+    # matmul outputs stay resident, elementwise ops recompute — the
+    # middle ground). "" defers to `remat`. Identical math in all
+    # three; only the HBM/recompute trade moves, which is why the knob
+    # is searchable and feeds admission control.
+    remat_policy: str = ""
     # >0 replaces every block's dense FFN with a top-k-routed MoE of
     # this many experts (ops/moe.py); expert weights shard over the
     # mesh's `model` axis (expert parallelism). The train step picks up
@@ -671,11 +681,18 @@ class Llama(nn.Module):
         if self.dtype is not None:
             x = x.astype(self.dtype)
         block_cls = _DecoderBlock
-        if self.remat and not decode:
+        ckpt = self.remat_policy or ("full" if self.remat else "none")
+        if ckpt not in ("none", "full", "policy"):
+            raise ValueError(f"unknown remat_policy {ckpt!r} "
+                             "(none/full/policy)")
+        if ckpt != "none" and not decode:
             # decode stays static under remat (python-level branch in
             # the attention), so mark it non-traced — flax passes the
             # module itself as arg 0, putting decode at index 4
-            block_cls = nn.remat(_DecoderBlock, static_argnums=(4,))
+            block_cls = nn.remat(
+                _DecoderBlock, static_argnums=(4,),
+                policy=(jax.checkpoint_policies.dots_saveable
+                        if ckpt == "policy" else None))
         for i in range(self.depth):
             x = block_cls(self.n_heads, self.n_kv_heads, self.mlp_dim,
                           self.max_len, self.lora_rank,
@@ -1024,10 +1041,12 @@ def estimate_train_device_bytes(module: "Llama", *,
                                 grad_accum: int = 1,
                                 loss_chunk: int = 0,
                                 remat: bool = True,
+                                remat_policy: str = "",
                                 adapters_only: bool = False,
                                 pipeline_stages: int = 1,
                                 pipeline_microbatches: int = 0,
-                                fsdp_min_size: int = 2 ** 12
+                                fsdp_min_size: int = 2 ** 12,
+                                overlap_collectives: bool = False
                                 ) -> Dict[str, int]:
     """Per-device HBM budget for one train step, from real shape math.
 
@@ -1126,10 +1145,9 @@ def estimate_train_device_bytes(module: "Llama", *,
         max(1, module.max_len // sp)
     h, mlp = module.hidden_dim, module.mlp_dim
     per_block = tokens_dev * (4 * h + 3 * mlp) * act_bytes * 2  # +cotan
-    if remat:
-        acts_dev = module.depth * tokens_dev * h * act_bytes + per_block
-    else:
-        acts_dev = module.depth * per_block
+    acts_dev = _remat_activation_bytes(
+        remat_policy or ("full" if remat else "none"),
+        module.depth, tokens_dev, h, mlp, act_bytes, per_block)
     chunk = loss_chunk or module.max_len // sp
     logits_rows = max(1, batch_size // (dp * max(1, grad_accum)))
     logits_dev = logits_rows * chunk * \
@@ -1137,10 +1155,91 @@ def estimate_train_device_bytes(module: "Llama", *,
     transient = max(
         (int(np.prod(s.shard_shape(l.shape))) for l, s in
          zip(flat_p, flat_s)), default=0) * act_bytes
+    if overlap_collectives:
+        # async fsdp all-gathers double-buffer: layer k+1's gathered
+        # weights materialize while layer k computes, so one more
+        # gathered-weight copy is live at the peak
+        transient *= 2
 
     out = {"params": params_dev, "grads": grads_dev, "opt": opt_dev,
            "activations": acts_dev + logits_dev, "transient": transient}
     out["total"] = sum(out.values())
+    return out
+
+
+def _remat_activation_bytes(policy: str, depth: int, tokens: int,
+                            h: int, mlp: int, act_bytes: int,
+                            per_block: int) -> int:
+    """Activation bytes resident through the backward under each
+    checkpointing schedule — the admission lever the ``remat_policy``
+    knob moves (ordered none > policy > full at any shape):
+
+    - ``none``: every block's working set survives to the backward.
+    - ``policy`` (dots_saveable): each block's matmul OUTPUTS (~4·h
+      attention + ~3·mlp SwiGLU per token) stay resident; elementwise
+      ops recompute, and so do the cotangent temporaries (hence no ×2).
+    - ``full``: only block-boundary residuals (h per token per block)
+      survive, plus one block's recompute working set.
+    """
+    if policy == "none":
+        return depth * per_block
+    if policy == "policy":
+        return depth * tokens * (4 * h + 3 * mlp) * act_bytes + per_block
+    return depth * tokens * h * act_bytes + per_block
+
+
+def estimate_gang_device_bytes(module: "Llama", *, batch_size: int,
+                               gang_size: int, remat_policy: str = "",
+                               adapters_only: bool = False,
+                               overlap_collectives: bool = False
+                               ) -> Dict[str, int]:
+    """HBM budget for a K-lane gang train step (gang-compiled tuning).
+
+    The gang executor runs ONE unsharded program: the frozen base tree
+    is closed over (broadcast — one copy regardless of K, including its
+    never-updated trainable-leaf slots), while the K lanes stack only
+    TRAINABLE leaves plus their Adam state, and every per-token
+    activation term multiplies by K. ``params``/``grads``/``opt`` are
+    exact (the estimator-vs-measured test holds them to the real pool
+    bytes); activations follow :func:`_remat_activation_bytes`, which is
+    what lets admission admit at ``remat_policy=full`` a gang it refuses
+    at ``none``.
+    """
+    abstract = jax.eval_shape(
+        lambda: module.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, module.max_len),
+                                      jnp.int32)))["params"]
+    flat_p = jax.tree_util.tree_leaves(abstract)
+    base_bytes = sum(int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+                     for l in flat_p)
+    mask = (adapter_only_mask if adapters_only
+            else lora_trainable_mask)(abstract)
+    train_bytes = sum(
+        int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+        for l, m in zip(flat_p, jax.tree_util.tree_leaves(mask)) if m)
+    k = max(1, int(gang_size))
+    params_dev = base_bytes + k * train_bytes
+    grads_dev = k * train_bytes  # grads exist for trainable leaves only
+    opt_dev = 2 * k * train_bytes  # adam mu+nu per lane
+
+    act_bytes = 2 if module.dtype == jnp.bfloat16 else 4
+    tokens = batch_size * module.max_len
+    h, mlp = module.hidden_dim, module.mlp_dim
+    per_block = tokens * (4 * h + 3 * mlp) * act_bytes * 2
+    acts = _remat_activation_bytes(remat_policy or "none", module.depth,
+                                   tokens, h, mlp, act_bytes, per_block)
+    logits = batch_size * module.max_len * module.vocab_size * 4 * 2
+    transient = max((int(np.prod(l.shape)) for l in flat_p),
+                    default=0) * act_bytes
+    if overlap_collectives:
+        transient *= 2
+    out = {"params": params_dev, "grads": grads_dev, "opt": opt_dev,
+           "activations": (acts + logits) * k, "transient": transient}
+    out["total"] = sum(out.values())
+    # informational (already inside params): the K-independent
+    # broadcast-base share, so callers can separate one-copy cost from
+    # per-lane cost
+    out["base"] = base_bytes
     return out
 
 
@@ -1304,12 +1403,36 @@ class LlamaLoRA(BaseModel):
             "max_len": CategoricalKnob([32, 64, 128], shape_relevant=True),
             "model_parallel": CategoricalKnob([1, 2, 4],
                                               shape_relevant=True),
-            "learning_rate": FloatKnob(1e-4, 3e-2, is_exp=True),
+            # traceable: rides the gang step as a traced per-lane
+            # scalar — K learning rates share one compiled program
+            "learning_rate": FloatKnob(1e-4, 3e-2, is_exp=True,
+                                       traceable=True),
+            # LoRA rank-scale (the α/r of the LoRA paper): the forward
+            # applies scale·(x·A·B). Traceable like learning_rate —
+            # per-lane in a gang — and FOLDED into lora_b at export, so
+            # serving trees need no scale plumbing (scale=1 is the
+            # legacy forward bit-for-bit)
+            "lora_scale": FloatKnob(0.25, 4.0, is_exp=True,
+                                    traceable=True),
             "batch_size": CategoricalKnob([8, 16, 32], shape_relevant=True),
             "bf16": CategoricalKnob([True, False]),
             # gradient checkpointing (train path): bigger batches for
             # ~1/3 extra FLOPs when activations are HBM-bound
             "remat": FixedKnob(False),
+            # searchable checkpointing SCHEDULE, superseding the legacy
+            # `remat` bool when not "none": none / full / policy
+            # (dots_saveable — matmul outputs resident, elementwise
+            # recomputed). Static → each value is its own gang compile
+            # bucket; feeds estimate_device_budget so admission can
+            # trade HBM for recompute instead of refusing the job.
+            "remat_policy": CategoricalKnob(["none", "full", "policy"]),
+            # overlap the fsdp all-gather/reduce-scatter path with
+            # compute (async collectives + latency-hiding scheduler,
+            # parallel.sharding.overlap_compiler_options). TPU-only
+            # compiler options — a no-op bucket split on CPU. Costs one
+            # extra gathered-weight buffer at peak (estimator's
+            # transient term).
+            "overlap_collectives": CategoricalKnob([False, True]),
             # train ONLY the lora_a/lora_b leaves (norms/lm_head frozen
             # too): the contract multi-adapter serving needs — N trials
             # that differ ONLY in adapters can then share one engine
@@ -1425,6 +1548,7 @@ class LlamaLoRA(BaseModel):
                      lora_rank=int(k["lora_rank"]),
                      dtype=self._dtype(),
                      remat=bool(k.get("remat", False)),
+                     remat_policy=str(k.get("remat_policy", "") or ""),
                      n_experts=int(k.get("moe_experts", 0)),
                      moe_top_k=int(k.get("moe_top_k", 1) or 1),
                      quantized=quantized, n_adapters=n_adapters,
@@ -1439,14 +1563,32 @@ class LlamaLoRA(BaseModel):
                      kv_pages=int(kv_pages),
                      paged_kernel=paged_kernel)
 
-    def estimate_device_budget(self, n_devices: int) -> Dict[str, int]:
+    def estimate_device_budget(self, n_devices: int,
+                               gang_size: int = 0) -> Dict[str, int]:
         """Per-device train-step HBM budget for THIS parameterization on
         an ``n_devices`` mesh — the knob-level front of
         :func:`estimate_train_device_bytes` (admission control: a
         worker can refuse a trial whose ``total`` exceeds its chips'
         HBM instead of OOMing mid-step). Mesh factors derive exactly
         as :meth:`train` builds them: sp and model_parallel consume
-        their factors, the rest is data parallelism."""
+        their factors, the rest is data parallelism.
+
+        ``gang_size >= 1`` budgets a K-lane gang step instead
+        (:func:`estimate_gang_device_bytes`): one broadcast base, K
+        stacked adapter/optimizer lanes, unsharded — how the gang
+        executor actually runs. 0 (the default) keeps the sequential
+        mesh math."""
+        if gang_size >= 1:
+            return estimate_gang_device_bytes(
+                self._module(),
+                batch_size=int(self.knobs["batch_size"]),
+                gang_size=int(gang_size),
+                remat_policy=str(self.knobs.get("remat_policy", "")
+                                 or ""),
+                adapters_only=bool(self.knobs.get("adapters_only",
+                                                  False)),
+                overlap_collectives=bool(
+                    self.knobs.get("overlap_collectives", False)))
         sp = int(self.knobs.get("sequence_parallel", 1) or 1)
         mp = int(self.knobs.get("model_parallel", 1) or 1)
         pp = int(self.knobs.get("pipeline_stages", 1) or 1)
@@ -1468,10 +1610,13 @@ class LlamaLoRA(BaseModel):
             grad_accum=int(self.knobs.get("grad_accum", 1) or 1),
             loss_chunk=int(self.knobs.get("loss_chunk", 0) or 0),
             remat=bool(self.knobs.get("remat", False)),
+            remat_policy=str(self.knobs.get("remat_policy", "") or ""),
             adapters_only=bool(self.knobs.get("adapters_only", False)),
             pipeline_stages=pp,
             pipeline_microbatches=int(
-                self.knobs.get("pipeline_microbatches", 0) or 0))
+                self.knobs.get("pipeline_microbatches", 0) or 0),
+            overlap_collectives=bool(
+                self.knobs.get("overlap_collectives", False)))
 
     def estimate_serving_device_bytes(self, max_slots: int = 8,
                                       n_extra_adapters: int = 0,
@@ -1653,12 +1798,333 @@ class LlamaLoRA(BaseModel):
             mp //= 2
         return make_mesh(devices, model=max(1, mp))
 
+    # ---- gang-compiled tuning (vmapped LoRA lanes) ----
+    @classmethod
+    def gang_blockers(cls, knobs: Knobs) -> List[str]:
+        """Why THIS assignment cannot train as a gang lane (empty list
+        = gangable). A lane is one unsharded program over a broadcast
+        base, so every in-trial parallelism / accumulation regime —
+        and a pretrained base, since lanes share the PRNGKey(0) init —
+        stays on the sequential mesh path. Each entry names the
+        blocking knob; ``tune_model``'s fallback warning surfaces them
+        so an operator knows what to pin."""
+        def _i(name: str, default: int = 0) -> int:
+            return int(knobs.get(name, default) or default)
+
+        out: List[str] = []
+        if _i("model_parallel", 1) > 1:
+            out.append("model_parallel>1 (tensor parallelism needs the "
+                       "sharded mesh path)")
+        if _i("sequence_parallel", 1) > 1:
+            out.append("sequence_parallel>1 (sp shards activations over "
+                       "a mesh the lane step does not build)")
+        if _i("pipeline_stages", 1) > 1:
+            out.append("pipeline_stages>1 (GPipe owns the device set)")
+        if _i("grad_accum", 1) > 1:
+            out.append("grad_accum>1 (the accumulation scan is not "
+                       "factored into the lane step)")
+        if _i("moe_experts") > 0:
+            out.append("moe_experts>0 (expert parallelism + aux-loss "
+                       "sow need the mesh path)")
+        if _i("loss_chunk") > 0:
+            out.append("loss_chunk>0 (the streamed loss is not factored "
+                       "into the lane step)")
+        if str(knobs.get("pretrained_path") or ""):
+            out.append("pretrained_path set (lanes broadcast the shared "
+                       "PRNGKey(0) base; checkpoint import is a mesh-"
+                       "path feature)")
+        return out
+
+    @classmethod
+    def gang_epochs(cls, knobs: Knobs, budget_scale: float) -> int:
+        """Epoch count ``train()`` spends for this assignment — the gang
+        scheduler's per-lane budget (must mirror the sequential loop
+        exactly, quick_train cap included)."""
+        epochs = max(1, round(int(knobs["max_epochs"])
+                              * float(budget_scale)))
+        if knobs.get("quick_train"):
+            epochs = min(epochs, 2)
+        return epochs
+
+    @staticmethod
+    def _lane_functions(module: "Llama", base_params: Any,
+                        adapters_only: bool):
+        """``(init_lane, train_step, eval_lane, merge, split)`` — the
+        functional training core shared by the sequential
+        ``_train_functional`` loop and the gang engine's vmapped lanes
+        (1 lane == 1 sequential trial, bit-for-bit).
+
+        The frozen base rides as a CLOSURE: under ``jax.vmap`` a
+        closed-over tree is broadcast (``in_axes=None`` semantics), so
+        K lanes share ONE HBM copy of the base while only the trainable
+        leaves — a flat ``{path: leaf}`` dict — and their Adam state
+        stack on the lane axis. ``hp`` carries the traceable knobs as
+        traced scalars: ``optax.adamw(lr)`` is exactly
+        ``scale_by_adam → add_decayed_weights → scale(-lr)``, so
+        applying ``-lr`` to the decayed adam updates keeps the math
+        identical while lr differs per lane inside one compiled
+        program; ``lora_scale`` multiplies every ``lora_b`` leaf inside
+        ``merge`` (the LoRA α/r rank-scale), and the export path folds
+        the SAME elementwise product into the stored tree, so serving
+        needs no scale plumbing and scale=1 is the legacy forward
+        bit-for-bit."""
+        mask_fn = adapter_only_mask if adapters_only \
+            else lora_trainable_mask
+        flat = jax.tree_util.tree_flatten_with_path(base_params)[0]
+        flat_m = jax.tree_util.tree_leaves(mask_fn(base_params))
+        paths = {_kp_path(kp) for (kp, _), m in zip(flat, flat_m) if m}
+        tx = optax.chain(optax.scale_by_adam(),
+                         optax.add_decayed_weights(1e-4))
+
+        def split(tree: Any) -> Dict[str, Any]:
+            return {_kp_path(kp): leaf for kp, leaf in
+                    jax.tree_util.tree_flatten_with_path(tree)[0]
+                    if _kp_path(kp) in paths}
+
+        def merge(trainable: Dict[str, Any],
+                  hp: Dict[str, Any]) -> Any:
+            scale = hp["lora_scale"]
+
+            def fill(kp, leaf):
+                p = _kp_path(kp)
+                if p not in paths:
+                    return leaf  # frozen base — broadcast under vmap
+                t = trainable[p]
+                return scale * t if "lora_b" in p else t
+
+            return jax.tree_util.tree_map_with_path(fill, base_params)
+
+        def init_lane(rng: Any, hp: Dict[str, Any]) -> Dict[str, Any]:
+            t = split(base_params)
+            return {"params": t, "opt": tx.init(t)}
+
+        def train_step(state: Dict[str, Any], hp: Dict[str, Any],
+                       batch: Dict[str, Any]):
+            def loss_fn(t):
+                p = merge(t, hp)
+                logits = module.apply({"params": p}, batch["ids"],
+                                      lens=batch["lens"])
+                total, count = lm_loss_terms(logits, batch["ids"],
+                                             batch["lens"],
+                                             batch["mask"])
+                return total / jnp.maximum(count, 1.0)
+
+            loss, grads = jax.value_and_grad(loss_fn)(state["params"])
+            updates, opt = tx.update(grads, state["opt"],
+                                     state["params"])
+            updates = jax.tree_util.tree_map(
+                lambda u: -hp["learning_rate"] * u, updates)
+            return {"params": optax.apply_updates(state["params"],
+                                                  updates),
+                    "opt": opt}, loss
+
+        def eval_lane(state: Dict[str, Any], hp: Dict[str, Any],
+                      batch: Dict[str, Any]):
+            p = merge(state["params"], hp)
+            logits = module.apply({"params": p}, batch["ids"],
+                                  lens=batch["lens"])
+            return lm_loss_terms(logits, batch["ids"], batch["lens"])
+
+        return init_lane, train_step, eval_lane, merge, split
+
+    @classmethod
+    def make_gang_spec(cls, knobs: Knobs, train_dataset_path: str,
+                       val_dataset_path: str) -> GangSpec:
+        """Functional training recipe for the gang engine: K LoRA
+        adapter sets (+ Adam state) as lanes of one vmapped step over
+        ONE broadcast frozen base. Everything but ``learning_rate`` /
+        ``lora_scale`` (the traceable knobs) is burned in from
+        ``knobs``; ``remat_policy`` and ``overlap_collectives`` are
+        static, so each schedule is its own compile bucket."""
+        blockers = cls.gang_blockers(knobs)
+        if blockers:
+            raise ValueError("knobs block gang lanes: "
+                             + "; ".join(blockers))
+        model = cls(**knobs)  # tokenizer wiring (vocab / BPE artifact)
+        ds = load_text_classification_dataset(train_dataset_path)
+        ids, lens = model._encode_lm(ds.texts)
+        vds = load_text_classification_dataset(val_dataset_path)
+        vids, vlens = model._encode_lm(vds.texts)
+        module = model._module()
+        batch_size = int(knobs["batch_size"])
+        base = module.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, ids.shape[1]),
+                                     jnp.int32))["params"]
+        init_lane, train_step, eval_lane, merge, _split = \
+            cls._lane_functions(module, base,
+                                bool(knobs.get("adapters_only", False)))
+        meta: Dict[str, Any] = {
+            "id2tok": {str(k): v for k, v in model._id2tok.items()}}
+        if model._bpe:
+            meta["bpe_merges"] = [list(m)
+                                  for m in model.tokenizer.merges]
+
+        def epoch_batches(epoch: int):
+            return batch_iterator({"ids": ids, "lens": lens},
+                                  batch_size, seed=epoch)
+
+        def eval_batches():
+            # the SAME bucket-32 zero-padded stream evaluate() walks
+            # (padded rows have lens=0, so no loss position counts)
+            bucket = 32
+            for i in range(0, len(vids), bucket):
+                ib, lb = vids[i:i + bucket], vlens[i:i + bucket]
+                pad = bucket - len(ib)
+                if pad:
+                    ib = np.concatenate(
+                        [ib, np.zeros((pad, vids.shape[1]), ib.dtype)])
+                    lb = np.concatenate(
+                        [lb, np.zeros((pad,), lb.dtype)])
+                yield {"ids": ib, "lens": lb}
+
+        @jax.jit
+        def _nll(params, ib, lb):
+            logits = module.apply({"params": params}, ib, lens=lb)
+            return lm_loss_terms(logits, ib, lb)
+
+        def eval_seq(lane_state, hp, batch):
+            # score on the graph evaluate() compiles: fold the lane's
+            # rank-scale EAGERLY (exact elementwise product), then run
+            # the same full-params nll jit — merging inside a vmapped
+            # eval re-fuses the forward and drifts in the low bits
+            p = merge(lane_state["params"], hp)
+            return _nll(p, batch["ids"], batch["lens"])
+
+        def export_blob(lane_state, hp):
+            # fold the lane's rank-scale into lora_b — the same
+            # elementwise product the train forward applied, so the
+            # stored tree serves scale-free and token-identically
+            # (dump_parameters format: make_multi_adapter_engine /
+            # load_parameters load it as-is)
+            hp_dev = {"learning_rate": jnp.float32(
+                          float(hp["learning_rate"])),
+                      "lora_scale": jnp.float32(
+                          float(hp["lora_scale"]))}
+            folded = merge({k: jnp.asarray(v) for k, v in
+                            lane_state["params"].items()}, hp_dev)
+            return {"params": jax.tree_util.tree_map(np.asarray,
+                                                     folded),
+                    "meta": dict(meta)}
+
+        def warm_lane(fresh, blob):
+            shared = (blob or {}).get("params")
+            if shared is None or not same_tree_shapes(base, shared):
+                return fresh  # incompatible architecture → cold start
+            # adopt the parent's trainable leaves; the frozen base is
+            # already this spec's broadcast copy (pretrained bases are
+            # gang blockers, so both inits are PRNGKey(0))
+            return {"params": _split(jax.tree_util.tree_map(
+                        jnp.asarray, shared)),
+                    "opt": fresh["opt"]}
+
+        n_params = sum(int(np.prod(l.shape))
+                       for l in jax.tree_util.tree_leaves(base))
+        return GangSpec(
+            hp_names=("learning_rate", "lora_scale"),
+            init_lane=init_lane, train_step=train_step,
+            epoch_batches=epoch_batches, eval_lane=eval_lane,
+            eval_batches=eval_batches, export_blob=export_blob,
+            warm_lane=warm_lane, share_params_knob="share_params",
+            score_kind="lm", tokens_per_sample=int(knobs["max_len"]),
+            lane_param_count=n_params,
+            compiler_options=overlap_compiler_options(
+                bool(knobs.get("overlap_collectives", False))) or None,
+            eval_seq=eval_seq)
+
+    def _train_functional(self, ids: np.ndarray, lens: np.ndarray,
+                          ctx: TrainContext) -> None:
+        """The gang-compatible sequential loop: drives the SAME
+        ``_lane_functions`` the gang engine vmaps, unvmapped — a 1-lane
+        gang trial is this loop bit-for-bit (``jit(f)`` vs
+        ``jit(vmap(f))`` at K=1; tier-1 asserts score equality).
+        ``train()`` routes here whenever ``gang_blockers`` is empty;
+        parallel / accumulation / pretrained regimes keep the legacy
+        sharded mesh loop."""
+        module = self._module()
+        batch_size = int(self.knobs["batch_size"])
+        base = module.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, ids.shape[1]),
+                                     jnp.int32))["params"]
+        if self._params is not None and \
+                same_tree_shapes(base, self._params):
+            # re-train / load_parameters: current weights are the init
+            base = jax.tree_util.tree_map(jnp.asarray, self._params)
+        if ctx.shared_params is not None and \
+                self.knobs.get("share_params"):
+            if hasattr(ctx.shared_params, "restore"):
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "sharded warm-start handles target the mesh train "
+                    "path; the functional (gang-compatible) path "
+                    "cold-starts")
+            else:
+                shared = ctx.shared_params.get("params")
+                if shared is not None and same_tree_shapes(base,
+                                                           shared):
+                    base = jax.tree_util.tree_map(jnp.asarray, shared)
+        init_lane, _train_step, _eval_lane, merge, _split = \
+            self._lane_functions(
+                module, base,
+                bool(self.knobs.get("adapters_only", False)))
+        hp = {"learning_rate": jnp.float32(
+                  float(self.knobs["learning_rate"])),
+              "lora_scale": jnp.float32(
+                  float(self.knobs.get("lora_scale", 1.0)))}
+        state = init_lane(jax.random.PRNGKey(0), hp)
+        if ctx.devices:
+            # the worker pins trials to disjoint device slots:
+            # committing the state pulls the whole step onto the
+            # slot's first device
+            state = jax.device_put(state, ctx.devices[0])
+        step = jax.jit(
+            _train_step, donate_argnums=(0,),
+            compiler_options=overlap_compiler_options(
+                bool(self.knobs.get("overlap_collectives",
+                                    False))) or None)
+        epochs = self.gang_epochs(self.knobs, ctx.budget_scale)
+        ctx.logger.define_plot("LM loss", ["loss"], x_axis="epoch")
+        # donation invalidates buffers aliasing self._params (warm
+        # start / re-train): drop the stale references first
+        self._params = None
+        self._qparams = None
+        for epoch in range(epochs):
+            losses = []
+            for batch in batch_iterator({"ids": ids, "lens": lens},
+                                        batch_size, seed=epoch):
+                state, loss = step(state, hp, batch)
+                losses.append(loss)
+            mean_loss = (float(np.mean([float(l) for l in losses]))
+                         if losses else float("nan"))
+            ctx.logger.log(epoch=epoch, loss=mean_loss,
+                           tokens=int(ids.shape[0] * ids.shape[1]))
+            if ctx.checkpoint is not None:
+                # preemption safety: worker throttles + persists. The
+                # stored tree is the FOLDED merge (scale into lora_b),
+                # the same shape dump_parameters always produced
+                self._params = merge(state["params"], hp)
+                ctx.checkpoint(self.dump_parameters,
+                               frac_done=(epoch + 1) / epochs,
+                               tree={"params": self._params})
+            if ctx.should_continue is not None and \
+                    not ctx.should_continue(epoch, -mean_loss):
+                break
+        self._params = merge(state["params"], hp)
+        self._qparams = None
+        self._fwd = None
+
     # ---- contract ----
     def train(self, dataset_path: str,
               ctx: Optional[TrainContext] = None) -> None:
         ctx = ctx or TrainContext()
         ds = load_text_classification_dataset(dataset_path)
         ids, lens = self._encode_lm(ds.texts)
+
+        if not self.gang_blockers(self.knobs):
+            # unsharded single-program regime: run the functional loop
+            # the gang engine vmaps, so a sequential trial and a gang
+            # lane are the same computation (bit-exactness contract)
+            return self._train_functional(ids, lens, ctx)
 
         module = self._module()
         devices = ctx.devices or jax.local_devices()
@@ -1985,7 +2451,11 @@ class LlamaLoRA(BaseModel):
                 total, count = lm_loss_terms(logits, ib, lb, mask)
             return total, count, aux
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        @functools.partial(
+            jax.jit, donate_argnums=(0, 1),
+            compiler_options=overlap_compiler_options(
+                bool(self.knobs.get("overlap_collectives",
+                                    False))) or None)
         def train_step(params, opt_state, ib, lb, mask):
             if grad_accum > 1:
                 # gradient accumulation: scan grad_accum micro-batches,
@@ -2043,10 +2513,7 @@ class LlamaLoRA(BaseModel):
             updates, opt_state = tx.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt_state, loss
 
-        epochs = max(1, round(int(self.knobs["max_epochs"])
-                              * float(ctx.budget_scale)))
-        if self.knobs.get("quick_train"):
-            epochs = min(epochs, 2)
+        epochs = self.gang_epochs(self.knobs, ctx.budget_scale)
         def step(state, b):
             params, opt_state = state
             params, opt_state, loss = train_step(

@@ -244,7 +244,7 @@ class JaxTabularMLP(BaseModel):
         def eval_batches():
             return batch_iterator({"x": vx, "y": vy}, 256, shuffle=False)
 
-        def export_blob(lane_state):
+        def export_blob(lane_state, hp):
             return {"params": jax.tree_util.tree_map(
                         np.asarray, lane_state["params"]),
                     "mean": np.asarray(mean), "std": np.asarray(std),

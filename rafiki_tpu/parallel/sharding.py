@@ -86,6 +86,35 @@ def replicate_tree(tree: Any, mesh):
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, s), tree)
 
 
+def overlap_compiler_options(enabled: bool) -> Dict[str, Any]:
+    """XLA options overlapping the fsdp collectives with compute.
+
+    With fsdp sharding, every step all-gathers each weight before its
+    matmul and reduce-scatters the gradient after; by default XLA
+    serializes those collectives against the surrounding compute. These
+    flags turn on async collectives + the latency-hiding scheduler so
+    the gather of layer k+1's weights runs under layer k's matmuls —
+    the ``overlap_collectives`` knob's whole effect, applied via
+    ``jax.jit(..., compiler_options=...)`` so it is per-program (a
+    searchable schedule), not a process-global ``XLA_FLAGS`` setting.
+
+    TPU backend only: the flags are TPU-specific and the CPU compiler
+    rejects unknown options, so elsewhere (and when disabled) this
+    returns ``{}`` — the knob is then compile-neutral, which is exactly
+    what the CPU-fallback bench provenance records.
+    """
+    import jax
+
+    if not enabled or jax.default_backend() != "tpu":
+        return {}
+    return {
+        "xla_tpu_enable_async_collective_fusion": "true",
+        "xla_tpu_enable_async_collective_fusion_fuse_all_gather": "true",
+        "xla_tpu_overlap_compute_collective_tc": "true",
+        "xla_tpu_enable_all_experimental_scheduler_features": "true",
+    }
+
+
 # ---------------------------------------------------------------------------
 # Parameter partitioning by name rules (fsdp / tensor-parallel)
 # ---------------------------------------------------------------------------

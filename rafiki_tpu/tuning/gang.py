@@ -71,11 +71,49 @@ class _VmapExec:
         # lanes vmap over state and hp; the batch is per-lane too (axis
         # 0) so each lane sees the batch schedule its sequential twin
         # would (lane epochs differ after an in-place refill)
-        self.step = jax.jit(
-            jax.vmap(spec.train_step, in_axes=(0, 0, 0)),
-            donate_argnums=(0,))
-        self.eval_step = jax.jit(
-            jax.vmap(spec.eval_lane, in_axes=(0, 0, None)))
+        if gang_size == 1:
+            # a 1-lane gang must BE the sequential trial bit-for-bit
+            # (the tier-1 equivalence contract is exact equality, and
+            # ANY graph change around the spec's functions — a vmap
+            # lane axis, even squeeze/expand reshapes traced into the
+            # same jit — can perturb XLA fusion in the low bits on
+            # large graphs). So jit the spec's functions BARE — the
+            # identical executable the sequential loop compiles — and
+            # move the lane axis eagerly, outside the compiled program
+            self._jit_step = jax.jit(
+                spec.train_step, donate_argnums=(0,),
+                compiler_options=getattr(spec, "compiler_options",
+                                         None))
+            self._jit_eval = jax.jit(spec.eval_lane)
+
+            def _sq(t):
+                return jax.tree_util.tree_map(lambda a: a[0], t)
+
+            def _ex(t):
+                return jax.tree_util.tree_map(lambda a: a[None], t)
+
+            def step_fn(state, hp, batch):
+                s, loss = self._jit_step(_sq(state), _sq(hp),
+                                         _sq(batch))
+                return _ex(s), _ex(loss)
+
+            def eval_fn(state, hp, batch):
+                return _ex(self._jit_eval(_sq(state), _sq(hp), batch))
+
+            self.step = step_fn
+            self.eval_step = eval_fn
+        else:
+            self._jit_step = jax.jit(
+                jax.vmap(spec.train_step, in_axes=(0, 0, 0)),
+                donate_argnums=(0,),
+                # the spec's searchable schedule (e.g. async-collective
+                # overlap); static per bucket, so no extra compiles
+                compiler_options=getattr(spec, "compiler_options",
+                                         None))
+            self._jit_eval = jax.jit(
+                jax.vmap(spec.eval_lane, in_axes=(0, 0, None)))
+            self.step = self._jit_step
+            self.eval_step = self._jit_eval
         self.state: Any = None
         self.hp: Dict[str, Any] = {
             n: jnp.zeros((gang_size,), jnp.float32) for n in spec.hp_names}
@@ -121,8 +159,41 @@ class _VmapExec:
         return steps, samples
 
     def scores(self) -> np.ndarray:
-        """Masked accuracy per lane over the validation stream — the
-        vmapped twin of the template's ``evaluate``."""
+        """Per-lane score over the validation stream — the vmapped twin
+        of the template's ``evaluate``. ``score_kind="lm"`` lanes score
+        inverse perplexity ``exp(-sum/count)``; the default is masked
+        accuracy."""
+        if getattr(self.spec, "score_kind", "accuracy") == "lm":
+            # accumulate exactly as the LM template's evaluate() does:
+            # float64 (== python float) sums over the SAME padded batch
+            # stream, so a lane's score is bit-for-bit its sequential
+            # twin's
+            eval_seq = getattr(self.spec, "eval_seq", None)
+            if eval_seq is not None:
+                # per-lane on the sequential evaluate() graph — eval is
+                # a sliver of lane wall-clock, and this is where the
+                # exact-score contract is settled (a vmapped eval fuses
+                # the forward differently and drifts in the low bits)
+                import jax
+                out = np.zeros(self.k)
+                for i in range(self.k):
+                    lane = jax.tree_util.tree_map(lambda a: a[i],
+                                                  self.state)
+                    hp = {n: self.hp[n][i] for n in self.spec.hp_names}
+                    total = count = 0.0
+                    for eb in self.spec.eval_batches():
+                        s, c = eval_seq(lane, hp, eb)
+                        total += float(s)
+                        count += float(c)
+                    out[i] = np.exp(-total / max(count, 1.0))
+                return out
+            totals = np.zeros(self.k)
+            counts = np.zeros(self.k)
+            for eb in self.spec.eval_batches():
+                s, c = self.eval_step(self.state, self.hp, eb)
+                totals += np.asarray(s, np.float64)
+                counts += np.asarray(c, np.float64)
+            return np.exp(-totals / np.maximum(counts, 1.0))
         correct = np.zeros(self.k)
         total = 0.0
         for eb in self.spec.eval_batches():
@@ -139,14 +210,16 @@ class _VmapExec:
 
         lane = jax.tree_util.tree_map(lambda a: np.asarray(a[i]),
                                       self.state)
-        return self.spec.export_blob(lane)
+        hp = {n: float(np.asarray(self.hp[n][i]))
+              for n in self.spec.hp_names}
+        return self.spec.export_blob(lane, hp)
 
     def compile_count(self) -> int:
         """Distinct train-step executables this bucket compiled (1 when
         every trial shape-matched the bucket, which is the invariant
         tier-1 asserts)."""
         try:
-            return int(self.step._cache_size())
+            return int(self._jit_step._cache_size())
         except Exception:  # rafiki: noqa[silent-except]
             return -1  # cache introspection is jax-version-dependent
 
@@ -167,7 +240,8 @@ class GangEngine:
                  knob_overrides: Optional[Dict[str, Any]] = None,
                  metrics: Optional[Any] = None,
                  keep_blobs: bool = True,
-                 on_result: Optional[Any] = None) -> None:
+                 on_result: Optional[Any] = None,
+                 admission_check: Optional[Any] = None) -> None:
         if mode not in ("gang", "sequential"):
             raise ValueError(f"unknown gang mode {mode!r}")
         if gang_size < 1:
@@ -189,9 +263,14 @@ class GangEngine:
         self.hp_names = traceable_knobs(self.knob_config)
         self.keep_blobs = keep_blobs
         self.on_result = on_result  # callable(TrialResult, blob) or None
+        #: ``(knobs, gang_size) -> Optional[str]`` — a refusal reason
+        #: (e.g. the worker's HBM admission verdict) or None to admit.
+        #: A refused bucket runs its trials sequentially, visibly.
+        self.admission_check = admission_check
         self.results: List[TrialResult] = []
         self._pending: List[Proposal] = []
         self._seen_buckets: set = set()
+        self._blocked_buckets: Dict[str, str] = {}  # bucket -> reason
         self._execs: "OrderedDict[str, _VmapExec]" = OrderedDict()
         self._blobs: "OrderedDict[str, dict]" = OrderedDict()
         self._t0: Optional[float] = None
@@ -206,6 +285,7 @@ class GangEngine:
 
     # ---- obs plumbing ----
     def _wire_metrics(self, metrics: Optional[Any]) -> None:
+        self._metrics = metrics  # per-lane gauges mint lazily by label
         if metrics is None:
             self._g_active = self._c_culled = self._g_tph = \
                 self._g_sps = None
@@ -223,6 +303,40 @@ class GangEngine:
         self._g_sps = metrics.gauge(
             "gang_samples_per_s",
             "aggregate training samples/s across all lanes")
+
+    def _publish_lane_gauges(self, exec_: "_VmapExec",
+                             lanes: List[Optional[Proposal]],
+                             samples: int, dt: float) -> None:
+        """Per-lane throughput gauges for LM gangs: ``lane_tokens_per_s``
+        and ``lane_est_mfu`` (6·N·tokens/s over the host's aggregate
+        peak), labeled ``lane=<i>`` so the Prometheus exposition shows
+        every lane; idle lanes read 0. Specs without token accounting
+        (``tokens_per_sample == 0``) skip both."""
+        tokens = int(getattr(exec_.spec, "tokens_per_sample", 0) or 0)
+        if self._metrics is None or not tokens:
+            return
+        tps = samples * tokens / dt  # every active lane steps together
+        n_params = int(getattr(exec_.spec, "lane_param_count", 0) or 0)
+        peak = 0.0
+        if n_params:
+            from ..worker.train import _device_peak_flops
+            import jax
+
+            devs = jax.local_devices()
+            peak = _device_peak_flops(devs) * len(devs)
+        for i, p in enumerate(lanes):
+            lane_tps = tps if p is not None else 0.0
+            self._metrics.gauge(
+                "lane_tokens_per_s",
+                "training tokens/s of one gang lane (0 when idle)",
+                labels={"lane": str(i)}).set(lane_tps)
+            if n_params and peak > 0:
+                self._metrics.gauge(
+                    "lane_est_mfu",
+                    "estimated MFU of one gang lane "
+                    "(6*params*tokens_per_s / aggregate peak FLOP/s)",
+                    labels={"lane": str(i)}).set(
+                        6.0 * n_params * lane_tps / peak)
 
     def _publish(self, active: int) -> None:
         if self._g_active is not None:
@@ -406,9 +520,10 @@ class GangEngine:
                  for i in range(self.gang_size)])
             n_active = sum(p is not None for p in lanes)
             self.stats.inc("samples", samples * n_active)
+            dt_round = max(time.monotonic() - t_round, 1e-9)
             if self._g_sps is not None:
-                self._g_sps.set(samples * n_active
-                                / max(time.monotonic() - t_round, 1e-9))
+                self._g_sps.set(samples * n_active / dt_round)
+            self._publish_lane_gauges(exec_, lanes, samples, dt_round)
         self.stats.inc("epoch_rounds")
         finished: List[int] = []
         for i, p in enumerate(lanes):
@@ -449,12 +564,38 @@ class GangEngine:
             share = exec_.spec.share_params_knob
             exec_.fill_lane(i, p.knobs, self._warm_blob(p, share))
 
+    def _gang_refusal(self, knobs: Knobs) -> Optional[str]:
+        """Why this bucket cannot run as vmapped lanes (None = it can):
+        the template's NAMED ``gang_blockers`` first (which knob pins
+        the config to the sequential mesh path), then the caller's
+        admission check (the worker's HBM budget verdict, which sees
+        ``remat_policy`` trade activations for recompute)."""
+        blockers_fn = getattr(self.model_class, "gang_blockers", None)
+        if callable(blockers_fn):
+            blockers = blockers_fn(knobs)
+            if blockers:
+                return "knobs block gang lanes: " + "; ".join(blockers)
+        if self.admission_check is not None:
+            return self.admission_check(knobs, self.gang_size)
+        return None
+
     def _get_exec(self, bucket: str,
                   rep_knobs: Knobs) -> Optional[_VmapExec]:
         if self.mode == "sequential":
             return None
+        if bucket in self._blocked_buckets:
+            return None
         exec_ = self._execs.get(bucket)
         if exec_ is None:
+            reason = self._gang_refusal(rep_knobs)
+            if reason is not None:
+                self._blocked_buckets[bucket] = reason
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "gang bucket falls back to sequential trials: %s",
+                    reason)
+                return None
             spec = self.model_class.make_gang_spec(
                 dict(rep_knobs), self.train_dataset_path,
                 self.val_dataset_path)

@@ -603,11 +603,57 @@ class TrainWorker:
             self._c_completed.inc()
             self.trials_run += 1
 
+        def admission_check(knobs, k) -> Optional[str]:
+            """HBM admission for one gang bucket: the whole gang is ONE
+            program on one device slot, so the estimate must cover K
+            adapter/optimizer lanes plus the broadcast base — with the
+            bucket's ``remat_policy`` trading activation bytes for
+            recompute (why a denied bucket can re-admit at
+            remat_policy="full"). Returns a refusal reason (the bucket
+            then runs sequentially, each trial re-checked by the
+            per-trial admission gate) or None to admit."""
+            import jax
+
+            from .admission import resolve_device_limit
+
+            devs = self.devices or jax.local_devices()
+            limit = resolve_device_limit(devs)
+            if not limit:
+                return None
+            model = self.model_class(**knobs)
+            est = getattr(model, "estimate_device_budget", None)
+            if est is None:
+                return None
+            try:
+                try:
+                    budget = est(len(devs), gang_size=k)
+                except TypeError:
+                    return None  # estimator predates gang budgets
+                total = int(budget["total"])
+            except Exception as e:  # estimator bug: visible, not fatal
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "gang admission check skipped: "
+                    "estimate_device_budget raised %r", e, exc_info=True)
+                return None
+            if total > limit:
+                gib = {key: round(v / 2**30, 2)
+                       for key, v in budget.items()}
+                return (f"estimated {total / 2**30:.2f}GiB footprint for "
+                        f"a {k}-lane gang exceeds the "
+                        f"{limit / 2**30:.2f}GiB device limit "
+                        f"(breakdown: {gib} GiB); set remat_policy="
+                        "'full'/'policy' to trade activation HBM for "
+                        "recompute, or shrink the gang")
+            return None
+
         engine = GangEngine(
             self.model_class, self.advisor, self.train_dataset_path,
             self.val_dataset_path, gang_size=gang_size, mode="gang",
             knob_overrides=self.knob_overrides, metrics=self.metrics,
-            on_result=on_result)
+            on_result=on_result, admission_check=admission_check)
+        self.gang_engine = engine  # introspection: buckets, refusals
         results = engine.run(max_trials)
         return len(results)
 

@@ -16,3 +16,12 @@ def evaluate(params, batch):
     # reads params, never rebinds them: nothing to donate
     preds = jax.tree_util.tree_map(lambda p: p * 2, params)
     return preds, batch
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def llama_gang_step(state, hp, batch):
+    # gang lanes donate the stacked lane state: the update happens in
+    # place, one generation of adapters + Adam moments resident
+    state = jax.tree_util.tree_map(lambda s: s * hp["learning_rate"],
+                                   state)
+    return state

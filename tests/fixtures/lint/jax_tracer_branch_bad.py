@@ -25,3 +25,14 @@ def gang_train_step(state, dropout, batch):
     if dropout > 0:  # traced hyperparameter in a Python branch
         return state * (1.0 - dropout)
     return state
+
+
+@jax.jit
+def llama_lane_merge(adapters, lora_scale):
+    # the Llama LoRA gang variant: lora_scale rides as a traced
+    # per-lane scalar, so "skip the multiply when it's 1" branches on
+    # the trace — scale unconditionally (scale=1 is already identity)
+    if lora_scale != 1.0:  # traced rank-scale in a Python branch
+        return jax.tree_util.tree_map(lambda b: lora_scale * b,
+                                      adapters)
+    return adapters

@@ -44,3 +44,10 @@ def gang_train_step(state, dropout, batch):
     # traceable knobs stay in jnp-land: masks/where instead of `if`
     keep = 1.0 - dropout
     return state * jnp.where(keep > 0.5, keep, 1.0)
+
+
+@jax.jit
+def llama_lane_merge(adapters, lora_scale):
+    # the traced way: apply the rank-scale unconditionally — scale=1
+    # is bitwise identity, no branch needed
+    return jax.tree_util.tree_map(lambda b: lora_scale * b, adapters)

@@ -22,7 +22,8 @@ TINY = {"max_epochs": 6, "vocab_size": 1 << 14, "hidden_dim": 64,
         "quantize_int8": False, "sequence_parallel": 1,
         "adapters_only": False, "rope_theta": 10000.0,
         "rope_scaling": "", "grad_accum": 1, "kv_cache_int8": False,
-        "quick_train": False,
+        "quick_train": False, "lora_scale": 1.0, "remat_policy": "none",
+        "overlap_collectives": False,
         "share_params": False, "tokenizer_path": "", "pretrained_path": ""}
 
 
